@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched Schnorr-secp256k1 verification throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: 50_000 verifies/sec on a single TPU v5e chip (BASELINE.json
+north star; the reference does this on CPU via libsecp256k1 + rayon,
+consensus/src/processes/transaction_validator/tx_validation_in_utxo_context.rs:206-223).
+
+Correctness is asserted inside the run: the batch mixes valid and invalid
+signatures and the mask must match the pure-python oracle exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import numpy as np
+
+from kaspa_tpu.utils import jax_setup
+
+jax_setup.setup()
+
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.crypto.secp import schnorr_challenge
+from kaspa_tpu.ops import bigint as bi
+from kaspa_tpu.ops.secp256k1 import points as pt
+from kaspa_tpu.ops.secp256k1.verify import schnorr_verify_kernel
+
+BASELINE = 50_000.0  # verifies/sec/chip target
+B = 16384
+UNIQUE = 32  # distinct real signatures, tiled (host-side sig generation is slow)
+
+
+def main() -> None:
+    random.seed(2026)
+    sk = random.randrange(1, eclib.N)
+    pub = eclib.schnorr_pubkey(sk)
+    pk = eclib.lift_x(int.from_bytes(pub, "big"))
+    msgs = [random.randbytes(32) for _ in range(UNIQUE)]
+    sigs = [eclib.schnorr_sign(m, sk, b"\x05" * 32) for m in msgs]
+    expect = [True] * UNIQUE
+    # corrupt a quarter of them
+    for i in range(0, UNIQUE, 4):
+        sigs[i] = sigs[i][:40] + bytes([sigs[i][40] ^ 1]) + sigs[i][41:]
+        expect[i] = False
+
+    reps = B // UNIQUE
+    px = np.tile(bi.int_to_limbs(pk[0], 16), (B, 1)).astype(np.int32)
+    py = np.tile(bi.int_to_limbs(pk[1], 16), (B, 1)).astype(np.int32)
+    rc = np.tile(np.stack([bi.int_to_limbs(int.from_bytes(s[:32], "big"), 16) for s in sigs]), (reps, 1))
+    sd = np.tile(np.stack([pt.scalar_digits_msb(int.from_bytes(s[32:], "big")) for s in sigs]), (reps, 1))
+    ed = np.tile(
+        np.stack([pt.scalar_digits_msb(schnorr_challenge(s[:32], pub, msgs[i])) for i, s in enumerate(sigs)]),
+        (reps, 1),
+    )
+    ok = np.ones(B, dtype=bool)
+
+    mask = np.asarray(schnorr_verify_kernel(px, py, rc, sd, ed, ok))  # compile + warmup
+    assert mask.tolist() == expect * reps, "BENCH CORRECTNESS FAILURE: mask != oracle"
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = np.asarray(schnorr_verify_kernel(px, py, rc, sd, ed, ok))
+        best = min(best, time.perf_counter() - t0)
+    assert out.tolist() == expect * reps
+
+    value = B / best
+    print(
+        json.dumps(
+            {
+                "metric": "schnorr_secp256k1_batch_verify_throughput",
+                "value": round(value, 1),
+                "unit": "verifies/sec/chip",
+                "vs_baseline": round(value / BASELINE, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

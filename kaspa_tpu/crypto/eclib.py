@@ -1,0 +1,167 @@
+"""Pure-Python secp256k1 + BIP340 Schnorr + ECDSA (host reference / oracle).
+
+Textbook implementation over python ints.  Used as the golden oracle for the
+TPU kernels, and by host-side tooling (wallet signing, test fixtures).
+Mirrors the behaviour of the reference's libsecp256k1 usage in
+crypto/txscript/src/lib.rs:885-935:
+
+- Schnorr: BIP340 x-only keys, challenge = tagged SHA256("BIP0340/challenge").
+- ECDSA: 33-byte compressed pubkeys, 64-byte compact signatures; high-S
+  signatures are rejected (libsecp256k1's secp256k1_ecdsa_verify semantics).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+G = (GX, GY)
+
+Point = "tuple[int, int] | None"  # affine; None == identity
+
+
+def point_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2 and (y1 + y2) % P == 0:
+        return None
+    if a == b:
+        lam = (3 * x1 * x1) * pow(2 * y1, -1, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return (x3, (lam * (x1 - x3) - y1) % P)
+
+
+def point_mul(p, k):
+    r = None
+    while k:
+        if k & 1:
+            r = point_add(r, p)
+        p = point_add(p, p)
+        k >>= 1
+    return r
+
+
+def is_on_curve(p) -> bool:
+    if p is None:
+        return True
+    x, y = p
+    return (y * y - x * x * x - 7) % P == 0
+
+
+def lift_x(x: int):
+    """BIP340 lift_x: even-y point with the given x, or None."""
+    if x >= P:
+        return None
+    y_sq = (pow(x, 3, P) + 7) % P
+    y = pow(y_sq, (P + 1) // 4, P)
+    if y * y % P != y_sq:
+        return None
+    return (x, y if y % 2 == 0 else P - y)
+
+
+def tagged_hash(tag: str, data: bytes) -> bytes:
+    th = hashlib.sha256(tag.encode()).digest()
+    return hashlib.sha256(th + th + data).digest()
+
+
+def schnorr_pubkey(seckey: int) -> bytes:
+    p = point_mul(G, seckey)
+    return p[0].to_bytes(32, "big")
+
+
+def schnorr_sign(msg32: bytes, seckey: int, aux32: bytes = b"\x00" * 32) -> bytes:
+    """BIP340 signing (for tests/wallet; verification is the consensus path)."""
+    d0 = seckey
+    pt = point_mul(G, d0)
+    d = d0 if pt[1] % 2 == 0 else N - d0
+    t = d ^ int.from_bytes(tagged_hash("BIP0340/aux", aux32), "big")
+    k0 = (
+        int.from_bytes(
+            tagged_hash("BIP0340/nonce", t.to_bytes(32, "big") + pt[0].to_bytes(32, "big") + msg32), "big"
+        )
+        % N
+    )
+    if k0 == 0:
+        raise ValueError("zero nonce")
+    r_pt = point_mul(G, k0)
+    k = k0 if r_pt[1] % 2 == 0 else N - k0
+    e = (
+        int.from_bytes(
+            tagged_hash("BIP0340/challenge", r_pt[0].to_bytes(32, "big") + pt[0].to_bytes(32, "big") + msg32),
+            "big",
+        )
+        % N
+    )
+    sig = r_pt[0].to_bytes(32, "big") + ((k + e * d) % N).to_bytes(32, "big")
+    assert schnorr_verify(pt[0].to_bytes(32, "big"), msg32, sig)
+    return sig
+
+
+def schnorr_verify(pubkey32: bytes, msg32: bytes, sig64: bytes) -> bool:
+    if len(pubkey32) != 32 or len(sig64) != 64:
+        return False
+    pk = lift_x(int.from_bytes(pubkey32, "big"))
+    if pk is None:
+        return False
+    r = int.from_bytes(sig64[:32], "big")
+    s = int.from_bytes(sig64[32:], "big")
+    if r >= P or s >= N:
+        return False
+    e = int.from_bytes(tagged_hash("BIP0340/challenge", sig64[:32] + pubkey32 + msg32), "big") % N
+    rp = point_add(point_mul(G, s), point_mul((pk[0], P - pk[1]), e))
+    return rp is not None and rp[1] % 2 == 0 and rp[0] == r
+
+
+def ecdsa_pubkey(seckey: int) -> bytes:
+    x, y = point_mul(G, seckey)
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def parse_compressed(pubkey33: bytes):
+    if len(pubkey33) != 33 or pubkey33[0] not in (2, 3):
+        return None
+    x = int.from_bytes(pubkey33[1:], "big")
+    p = lift_x(x)
+    if p is None:
+        return None
+    x, y = p
+    if (y & 1) != (pubkey33[0] & 1):
+        y = P - y
+    return (x, y)
+
+
+def ecdsa_sign(msg32: bytes, seckey: int, nonce: int) -> bytes:
+    z = int.from_bytes(msg32, "big") % N
+    r_pt = point_mul(G, nonce)
+    r = r_pt[0] % N
+    s = pow(nonce, -1, N) * (z + r * seckey) % N
+    if s > N // 2:
+        s = N - s  # low-S normalization (libsecp256k1 signing behaviour)
+    if r == 0 or s == 0:
+        raise ValueError("bad nonce")
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def ecdsa_verify(pubkey33: bytes, msg32: bytes, sig64: bytes) -> bool:
+    pk = parse_compressed(pubkey33)
+    if pk is None or len(sig64) != 64:
+        return False
+    r = int.from_bytes(sig64[:32], "big")
+    s = int.from_bytes(sig64[32:], "big")
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    if s > N // 2:
+        return False  # libsecp256k1 rejects non-normalized (high-S) signatures
+    z = int.from_bytes(msg32, "big") % N
+    si = pow(s, -1, N)
+    rp = point_add(point_mul(G, z * si % N), point_mul(pk, r * si % N))
+    return rp is not None and rp[0] % N == r

@@ -1,0 +1,132 @@
+"""Host-side batched signature verification front-end.
+
+This is the framework's "communication backend" between the validation
+pipeline and the TPU: it marshals (pubkey, msg, sig) triples into fixed
+shape device arrays, dispatches the jitted kernels, and hands back a
+validity bitmask the validator consumes unchanged — mirroring the role of
+libsecp256k1 calls inside the reference's script engine
+(crypto/txscript/src/lib.rs:885-935) but batched across a whole block/DAG
+slice instead of per-input.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.ops import bigint as bi
+from kaspa_tpu.ops.secp256k1 import points as pt
+from kaspa_tpu.ops.secp256k1.verify import ecdsa_verify_kernel, schnorr_verify_kernel
+
+W = bi.FP.W
+_CHALLENGE_MID = hashlib.sha256(
+    hashlib.sha256(b"BIP0340/challenge").digest() * 2
+)  # pre-tagged sha256 state
+
+
+def _bucket(n: int) -> int:
+    """Pad batch sizes to powers of two (min 8) to bound jit recompiles."""
+    b = 8
+    while b < n:
+        b <<= 1
+    return b
+
+
+def schnorr_challenge(r32: bytes, px32: bytes, msg32: bytes) -> int:
+    h = _CHALLENGE_MID.copy()
+    h.update(r32 + px32 + msg32)
+    return int.from_bytes(h.digest(), "big") % eclib.N
+
+
+@dataclass
+class _Batch:
+    px: list = field(default_factory=list)
+    py: list = field(default_factory=list)
+    rc: list = field(default_factory=list)  # canonical limbs target (r or r mod n)
+    d1: list = field(default_factory=list)  # s / u1 digits
+    d2: list = field(default_factory=list)  # e / u2 digits
+    ok: list = field(default_factory=list)
+
+    def push_invalid(self):
+        self.px.append(0)
+        self.py.append(0)
+        self.rc.append(0)
+        self.d1.append(np.zeros(pt.N_WINDOWS, np.int32))
+        self.d2.append(np.zeros(pt.N_WINDOWS, np.int32))
+        self.ok.append(False)
+
+    def push(self, px, py, rc, d1, d2):
+        self.px.append(px)
+        self.py.append(py)
+        self.rc.append(rc)
+        self.d1.append(d1)
+        self.d2.append(d2)
+        self.ok.append(True)
+
+    def run(self, kernel):
+        n = len(self.ok)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        b = _bucket(n)
+        px = np.zeros((b, W), np.int32)
+        py = np.zeros((b, W), np.int32)
+        rc = np.zeros((b, W), np.int32)
+        d1 = np.zeros((b, pt.N_WINDOWS), np.int32)
+        d2 = np.zeros((b, pt.N_WINDOWS), np.int32)
+        ok = np.zeros(b, dtype=bool)
+        px[:n] = bi.ints_to_limbs(self.px, W)
+        py[:n] = bi.ints_to_limbs(self.py, W)
+        rc[:n] = bi.ints_to_limbs(self.rc, W)
+        d1[:n] = np.stack(self.d1)
+        d2[:n] = np.stack(self.d2)
+        ok[:n] = self.ok
+        return np.asarray(kernel(px, py, rc, d1, d2, ok))[:n]
+
+
+def schnorr_verify_batch(items) -> np.ndarray:
+    """items: iterable of (pubkey32, msg32, sig64) -> bool mask.
+
+    Encoding/range checks and lift_x run on host (failures short-circuit to
+    False without occupying useful device lanes beyond padding).
+    """
+    batch = _Batch()
+    for pub, msg, sig in items:
+        # BIP340 allows arbitrary-length messages (matching eclib oracle);
+        # kaspa consensus always passes 32-byte sighash digests.
+        if len(pub) != 32 or len(sig) != 64:
+            batch.push_invalid()
+            continue
+        pk = eclib.lift_x(int.from_bytes(pub, "big"))
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if pk is None or r >= eclib.P or s >= eclib.N:
+            batch.push_invalid()
+            continue
+        e = schnorr_challenge(sig[:32], pub, msg)
+        batch.push(pk[0], pk[1], r, pt.scalar_digits_msb(s), pt.scalar_digits_msb(e))
+    return batch.run(schnorr_verify_kernel)
+
+
+def ecdsa_verify_batch(items) -> np.ndarray:
+    """items: iterable of (pubkey33, msg32, sig64_compact) -> bool mask."""
+    batch = _Batch()
+    half_n = eclib.N // 2
+    for pub, msg, sig in items:
+        if len(sig) != 64 or len(msg) != 32:
+            batch.push_invalid()
+            continue
+        pk = eclib.parse_compressed(pub)
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if pk is None or not (1 <= r < eclib.N) or not (1 <= s < eclib.N) or s > half_n:
+            batch.push_invalid()
+            continue
+        z = int.from_bytes(msg, "big") % eclib.N
+        si = pow(s, -1, eclib.N)
+        u1 = z * si % eclib.N
+        u2 = r * si % eclib.N
+        batch.push(pk[0], pk[1], r, pt.scalar_digits_msb(u1), pt.scalar_digits_msb(u2))
+    return batch.run(ecdsa_verify_kernel)

@@ -1,0 +1,18 @@
+"""Batched secp256k1 on TPU: field/point arithmetic, Schnorr+ECDSA verify.
+
+TPU-native replacement for the reference's libsecp256k1 (C) usage in
+`crypto/txscript/src/lib.rs:885-935` (check_schnorr_signature /
+check_ecdsa_signature).  The batch dimension is the leading axis; everything
+is jit/vmap/shard_map-safe with static shapes.
+"""
+
+from kaspa_tpu.ops.secp256k1.points import (  # noqa: F401
+    G_AFFINE,
+    dual_scalar_mul_base,
+    point_add,
+    point_double,
+)
+from kaspa_tpu.ops.secp256k1.verify import (  # noqa: F401
+    ecdsa_verify_kernel,
+    schnorr_verify_kernel,
+)

@@ -1,0 +1,53 @@
+"""Jitted batched Schnorr/ECDSA verification kernels (device side).
+
+The host (kaspa_tpu/crypto/secp.py) parses/validates encodings, lifts
+pubkeys to affine coordinates, computes challenge scalars, and extracts
+4-bit window digits; the device does the heavy dual-scalar ladder and the
+final affine checks, returning a validity bitmask — the layout prescribed
+by the north star (BASELINE.json): triples in, bitmask out.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kaspa_tpu.ops import bigint as bi
+from kaspa_tpu.ops.secp256k1 import points as pt
+
+FP = bi.FP
+FN = bi.FN
+
+
+@jax.jit
+def schnorr_verify_kernel(px, py, r_canon, s_digits, e_digits, valid_in):
+    """BIP340: R = s*G + e*(-P); valid iff R finite, even-y, x(R) == r.
+
+    px/py: [B, 16] limbs of lifted pubkey (even y);  r_canon: [B, 16]
+    canonical limbs of sig r;  s_digits/e_digits: [B, 64] int32 4-bit MSB
+    windows;  valid_in: [B] bool (host-side encoding checks).
+    """
+    py_neg = bi.neg(FP, py)
+    r = pt.dual_scalar_mul_base(px, py_neg, s_digits, e_digits)
+    xa, ya, inf = pt.to_affine(r)
+    ok = ~inf
+    ok &= jnp.all(xa == r_canon, axis=-1)
+    ok &= (ya[..., 0] & 1) == 0
+    return ok & valid_in
+
+
+@jax.jit
+def ecdsa_verify_kernel(px, py, r_n_canon, u1_digits, u2_digits, valid_in):
+    """ECDSA: R = u1*G + u2*P; valid iff R finite and x(R) mod n == r.
+
+    u1 = z*s^-1 mod n, u2 = r*s^-1 mod n are computed host-side (cheap,
+    n-field inversions are per-signature scalars).
+    """
+    r = pt.dual_scalar_mul_base(px, py, u1_digits, u2_digits)
+    xa, _ya, inf = pt.to_affine(r)
+    x_mod_n = bi.canon(FN, xa)  # x < p < 2**256: reinterpret limbs mod n
+    ok = ~inf
+    ok &= jnp.all(x_mod_n == r_n_canon, axis=-1)
+    return ok & valid_in

@@ -1,0 +1,28 @@
+"""Process-wide JAX configuration for the framework.
+
+Call ``setup()`` once from every entry point (tests, bench, node, tools).
+Enables the persistent XLA compilation cache so the big crypto ladders
+compile once per machine rather than once per process.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DONE = False
+
+
+def setup(cache_dir: str | None = None) -> None:
+    global _DONE
+    if _DONE:
+        return
+    _DONE = True
+    import jax
+
+    cache_dir = cache_dir or os.environ.get(
+        "KASPA_TPU_JAX_CACHE", os.path.expanduser("~/.cache/kaspa_tpu_jax")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -1,0 +1,23 @@
+"""Test harness config: force a deterministic 8-device CPU mesh.
+
+Real-TPU runs are exercised by bench.py and the driver's compile checks;
+unit tests validate bit-exactness and sharding semantics on a virtual CPU
+mesh (fast, deterministic, no TPU contention), per the multi-chip testing
+strategy in the task brief.  Set KASPA_TPU_TEST_REAL_DEVICE=1 to run the
+suite on whatever device JAX picks (e.g. the tunneled TPU).
+"""
+
+import os
+
+if not os.environ.get("KASPA_TPU_TEST_REAL_DEVICE"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # the axon sitecustomize hook force-registers the TPU plugin when this
+    # is set (and prepends "axon" to jax_platforms); clear it for CPU tests
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+from kaspa_tpu.utils import jax_setup
+
+jax_setup.setup()
