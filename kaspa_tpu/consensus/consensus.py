@@ -1,0 +1,590 @@
+"""The consensus engine: header/body/virtual processing over the block DAG.
+
+Re-design of the reference's 4-stage pipeline (consensus/src/pipeline/) as
+explicit processing stages sharing a ConsensusStorage.  This module is the
+host-side control path; all batchable crypto (signature checks, muhash
+products) is dispatched to the TPU through the batch layers
+(txscript.batch, ops.muhash_ops).
+
+Stage semantics follow the reference call stack (SURVEY.md §3.2):
+- header stage: in-isolation checks -> parent relations -> GHOSTDAG ->
+  difficulty/DAA window checks -> PoW -> median time, mergeset limit,
+  blue score/work -> commit (header_processor/processor.rs:296-313)
+- body stage: merkle root, coinbase form, tx in-isolation checks
+  (body_processor/)
+- virtual stage: sink search, chain-block UTXO verification with muhash
+  commitments, virtual resolution (virtual_processor/processor.rs:261-384,
+  utxo_validation.rs)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import (
+    SUBNETWORK_ID_COINBASE,
+    Header,
+    ScriptPublicKey,
+    Transaction,
+    TransactionOutpoint,
+)
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.consensus.params import Params
+from kaspa_tpu.consensus.processes.coinbase import BlockRewardData, CoinbaseData, CoinbaseManager, MinerData
+from kaspa_tpu.consensus.processes.ghostdag import GhostdagManager
+from kaspa_tpu.consensus.processes.transaction_validator import (
+    FLAG_FULL,
+    FLAG_SKIP_SCRIPTS,
+    TransactionValidator,
+    TxRuleError,
+)
+from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, SampledWindowManager
+from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
+from kaspa_tpu.consensus.stores import ConsensusStorage, GhostdagData, StatusesStore
+from kaspa_tpu.consensus.utxo import UtxoCollection, UtxoDiff, UtxoView, apply_diff, unapply_diff
+from kaspa_tpu.crypto import merkle
+from kaspa_tpu.crypto.muhash import MuHash
+
+
+class RuleError(Exception):
+    pass
+
+
+def _neg_bytes(b: bytes) -> bytes:
+    """Lexicographic inversion so a min-heap orders hashes descending."""
+    return bytes(255 - x for x in b)
+
+
+@dataclass
+class VirtualState:
+    """reference: consensus/src/model/stores/virtual_state.rs"""
+
+    parents: list[bytes]
+    ghostdag_data: GhostdagData
+    daa_score: int
+    bits: int
+    past_median_time: int
+    accepted_tx_ids: list[bytes]
+    mergeset_rewards: dict
+    mergeset_non_daa: set
+
+
+class Consensus:
+    def __init__(self, params: Params):
+        self.params = params
+        self.storage = ConsensusStorage()
+        self.reachability = ReachabilityService()
+        self.ghostdag_manager = GhostdagManager(
+            params.genesis.hash,
+            params.ghostdag_k,
+            self.storage.ghostdag,
+            self.storage.relations,
+            self.storage.headers,
+            self.reachability,
+        )
+        self.window_manager = SampledWindowManager(
+            params.genesis.hash,
+            params.genesis.bits,
+            params.genesis.timestamp,
+            self.storage.ghostdag,
+            self.storage.headers,
+            params.max_difficulty_target,
+            params.target_time_per_block,
+            params.difficulty_window_size,
+            params.min_difficulty_window_size,
+            params.difficulty_sample_rate,
+            params.past_median_time_window_size,
+            params.past_median_time_sample_rate,
+        )
+        self.coinbase_manager = CoinbaseManager(
+            max_coinbase_payload_len=params.max_coinbase_payload_len,
+            deflationary_phase_daa_score=params.deflationary_phase_daa_score,
+            pre_deflationary_phase_base_subsidy=params.pre_deflationary_phase_base_subsidy,
+            bps=params.bps,
+        )
+        self.transaction_validator = TransactionValidator(params)
+
+        # virtual/UTXO state
+        self.tips: set[bytes] = set()
+        self.utxo_set = UtxoCollection()  # positioned at self.utxo_position
+        self.utxo_position: bytes = params.genesis.hash
+        self.utxo_diffs: dict[bytes, UtxoDiff] = {}  # chain-validated block -> diff vs selected parent position
+        self.multisets: dict[bytes, MuHash] = {}
+        self.acceptance_data: dict[bytes, list] = {}
+        self.virtual_state: VirtualState | None = None
+        self.daa_excluded: dict[bytes, set[bytes]] = {}
+
+        self._insert_genesis()
+
+    # ------------------------------------------------------------------
+    # genesis
+    # ------------------------------------------------------------------
+
+    def _insert_genesis(self):
+        g = self.params.genesis
+        header = Header(
+            version=g.version,
+            parents_by_level=[[]],
+            hash_merkle_root=b"\x00" * 32,
+            accepted_id_merkle_root=b"\x00" * 32,
+            utxo_commitment=MuHash().finalize(),
+            timestamp=g.timestamp,
+            bits=g.bits,
+            nonce=0,
+            daa_score=g.daa_score,
+            blue_work=0,
+            blue_score=0,
+            pruning_point=g.hash,
+        )
+        header._hash_cache = g.hash
+        self.storage.headers.insert(header)
+        self.storage.relations.insert(g.hash, [ORIGIN])
+        self.storage.ghostdag.insert(g.hash, self.ghostdag_manager.genesis_ghostdag_data())
+        self.reachability.add_block(g.hash, [ORIGIN], ORIGIN)
+        genesis_coinbase = Transaction(
+            0, [], [], 0, SUBNETWORK_ID_COINBASE, 0,
+            self.coinbase_manager.serialize_coinbase_payload(CoinbaseData(0, 0, MinerData(ScriptPublicKey(0, b"")))),
+        )
+        self.storage.block_transactions.insert(g.hash, [genesis_coinbase])
+        self.storage.statuses.set(g.hash, StatusesStore.STATUS_UTXO_VALID)
+        self.multisets[g.hash] = MuHash()
+        self.utxo_diffs[g.hash] = UtxoDiff()
+        self.daa_excluded[g.hash] = set()
+        self.tips = {g.hash}
+        self._resolve_virtual()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def validate_and_insert_block(self, block: Block) -> str:
+        """Full pipeline for one block; returns the resulting block status."""
+        self._process_header(block.header)
+        self._process_body(block)
+        self._update_tips(block.hash)
+        self._resolve_virtual()
+        status = self.storage.statuses.get(block.hash)
+        return status
+
+    def sink(self) -> bytes:
+        return self.virtual_state.ghostdag_data.selected_parent
+
+    def get_virtual_daa_score(self) -> int:
+        return self.virtual_state.daa_score
+
+    # ------------------------------------------------------------------
+    # header stage (pipeline/header_processor/)
+    # ------------------------------------------------------------------
+
+    def _process_header(self, header: Header) -> None:
+        block_hash = header.hash
+        if self.storage.headers.has(block_hash) and self.storage.statuses.get(block_hash) is not None:
+            return  # known
+        parents = header.direct_parents()
+
+        # in isolation (pre_ghostdag_validation.rs)
+        if not parents:
+            raise RuleError("block has no parents")
+        if len(parents) > self.params.max_block_parents:
+            raise RuleError(f"too many parents {len(parents)}")
+        if len(set(parents)) != len(parents):
+            raise RuleError("duplicate parents")
+
+        # parent relations
+        for p in parents:
+            if not self.storage.headers.has(p):
+                raise RuleError(f"missing parent {p.hex()}")
+            if self.storage.statuses.get(p) == StatusesStore.STATUS_INVALID:
+                raise RuleError("invalid parent")
+
+        # GHOSTDAG
+        gd = self.ghostdag_manager.ghostdag(parents)
+
+        # difficulty & DAA (pre_pow_validation.rs)
+        daa_window = self.window_manager.block_daa_window(gd)
+        expected_bits = self.window_manager.calculate_difficulty_bits(gd, daa_window)
+        if header.bits != expected_bits:
+            raise RuleError(f"unexpected difficulty bits {header.bits:#x} != {expected_bits:#x}")
+        if header.daa_score != daa_window.daa_score:
+            raise RuleError(f"unexpected daa score {header.daa_score} != {daa_window.daa_score}")
+
+        # PoW (consensus/pow): gated by skip_proof_of_work (test/sim configs)
+        if not self.params.skip_proof_of_work:
+            from kaspa_tpu.crypto.powhash import check_pow
+
+            if not check_pow(header):
+                raise RuleError("invalid proof of work")
+
+        # post-pow (post_pow_validation.rs)
+        pmt, _w = self.window_manager.calc_past_median_time(gd)
+        if header.timestamp <= pmt:
+            raise RuleError(f"timestamp {header.timestamp} not later than past median time {pmt}")
+        if gd.mergeset_size() > self.params.mergeset_size_limit:
+            raise RuleError(f"mergeset size {gd.mergeset_size()} above limit")
+        if header.blue_score != gd.blue_score:
+            raise RuleError(f"blue score mismatch {header.blue_score} != {gd.blue_score}")
+        if header.blue_work != gd.blue_work:
+            raise RuleError(f"blue work mismatch {header.blue_work} != {gd.blue_work}")
+
+        # commit (header_processor/processor.rs:361)
+        self.storage.headers.insert(header)
+        self.storage.relations.insert(block_hash, parents)
+        self.storage.ghostdag.insert(block_hash, gd)
+        self.reachability.add_block(block_hash, parents, gd.selected_parent)
+        self.daa_excluded[block_hash] = daa_window.mergeset_non_daa
+        self.window_manager.cache_block_window(block_hash, DIFFICULTY_WINDOW, daa_window.window)
+        self.storage.statuses.set(block_hash, StatusesStore.STATUS_HEADER_ONLY)
+
+    # ------------------------------------------------------------------
+    # body stage (pipeline/body_processor/)
+    # ------------------------------------------------------------------
+
+    def _process_body(self, block: Block) -> None:
+        txs = block.transactions
+        if not txs:
+            raise RuleError("block has no transactions (header-only unsupported in this path)")
+        # merkle root (body_validation_in_isolation.rs)
+        computed = merkle.calc_hash_merkle_root(txs)
+        if computed != block.header.hash_merkle_root:
+            raise RuleError("bad merkle root")
+        if not txs[0].is_coinbase():
+            raise RuleError("first tx is not coinbase")
+        for tx in txs[1:]:
+            if tx.is_coinbase():
+                raise RuleError("second coinbase")
+        coinbase_data = self.coinbase_manager.deserialize_coinbase_payload(txs[0].payload)
+        gd = self.storage.ghostdag.get(block.hash)
+        if coinbase_data.blue_score != gd.blue_score:
+            raise RuleError("coinbase blue score mismatch")
+        seen_ids = set()
+        seen_outpoints = set()
+        created_outpoints = set()
+        for tx in txs:
+            self.transaction_validator.validate_tx_in_isolation(tx)
+            txid = tx.id()
+            if txid in seen_ids:
+                raise RuleError("duplicate transactions")
+            seen_ids.add(txid)
+            for inp in tx.inputs:
+                # body_validation_in_isolation.rs check_block_double_spends
+                if inp.previous_outpoint in seen_outpoints:
+                    raise RuleError(f"double spend in same block: {inp.previous_outpoint}")
+                seen_outpoints.add(inp.previous_outpoint)
+        # check_no_chained_transactions: a tx may not spend an output created
+        # in the same block (keeps in-block txs independent -> parallelizable)
+        for tx in txs:
+            for i in range(len(tx.outputs)):
+                created_outpoints.add(TransactionOutpoint(tx.id(), i))
+        for op in seen_outpoints:
+            if op in created_outpoints:
+                raise RuleError(f"chained transaction spending in-block output {op}")
+        # in-context: tx lock times vs this block's context
+        pmt, _ = self.window_manager.calc_past_median_time(gd)
+        hdr = block.header
+        for tx in txs[1:]:
+            self.transaction_validator.validate_tx_in_header_context(tx, hdr.daa_score, pmt)
+        self.storage.block_transactions.insert(block.hash, txs)
+        self.storage.statuses.set(block.hash, StatusesStore.STATUS_UTXO_PENDING_VERIFICATION)
+
+    def _update_tips(self, new_block: bytes) -> None:
+        parents = set(self.storage.relations.get_parents(new_block))
+        self.tips = (self.tips - parents) | {new_block}
+
+    # ------------------------------------------------------------------
+    # virtual stage (pipeline/virtual_processor/)
+    # ------------------------------------------------------------------
+
+    def _resolve_virtual(self) -> None:
+        # sink search: max blue-work candidate whose chain UTXO-verifies,
+        # descending into parents of disqualified candidates
+        # (virtual_processor/processor.rs sink_search_algorithm)
+        import heapq as _hq
+
+        heap = []  # max-heap via negated key
+        seen = set()
+
+        def push(h):
+            if h not in seen:
+                seen.add(h)
+                bw = self.storage.ghostdag.get_blue_work(h)
+                _hq.heappush(heap, ((-bw, _neg_bytes(h)), h))
+
+        for t in self.tips:
+            push(t)
+        sink = None
+        while heap:
+            _, cand = _hq.heappop(heap)
+            if self.storage.statuses.get(cand) != StatusesStore.STATUS_DISQUALIFIED and self._ensure_chain_utxo_valid(cand):
+                sink = cand
+                break
+            for p in self.storage.relations.get_parents(cand):
+                if p != ORIGIN:
+                    push(p)
+        assert sink is not None, "no valid sink found"
+
+        # virtual parents: bounded count of chain-qualified tips, sink first
+        # (pick_virtual_parents, processor.rs:1013-1146; bounded-merge checks
+        # arrive with the merge-depth milestone)
+        others = sorted(
+            (t for t in self.tips if t != sink and self._ensure_chain_utxo_valid(t)),
+            key=lambda h: (self.storage.ghostdag.get_blue_work(h), h),
+            reverse=True,
+        )
+        virtual_parents = [sink] + others[: self.params.max_block_parents - 1]
+        vgd = self.ghostdag_manager.ghostdag(virtual_parents)
+
+        # compute virtual window state
+        daa_window = self.window_manager.block_daa_window(vgd)
+        bits = self.window_manager.calculate_difficulty_bits(vgd, daa_window)
+        pmt, _ = self.window_manager.calc_past_median_time(vgd)
+
+        # virtual UTXO state: replay virtual mergeset over sink position
+        self._move_utxo_position(sink)
+        ctx = self._calculate_utxo_state(vgd, daa_window.daa_score)
+        self.virtual_utxo_diff = ctx["mergeset_diff"]
+        self.virtual_state = VirtualState(
+            parents=virtual_parents,
+            ghostdag_data=vgd,
+            daa_score=daa_window.daa_score,
+            bits=bits,
+            past_median_time=pmt,
+            accepted_tx_ids=ctx["accepted_tx_ids"],
+            mergeset_rewards=ctx["mergeset_rewards"],
+            mergeset_non_daa=daa_window.mergeset_non_daa,
+        )
+
+    def _ensure_chain_utxo_valid(self, block: bytes) -> bool:
+        """Verify the selected chain up to `block` is UTXO valid; disqualify on failure."""
+        # collect unverified chain ancestors
+        chain = []
+        cur = block
+        while self.storage.statuses.get(cur) != StatusesStore.STATUS_UTXO_VALID:
+            if self.storage.statuses.get(cur) == StatusesStore.STATUS_DISQUALIFIED:
+                return False
+            chain.append(cur)
+            cur = self.storage.ghostdag.get_selected_parent(cur)
+        chain.reverse()
+        for c in chain:
+            if not self._verify_chain_block(c):
+                self.storage.statuses.set(c, StatusesStore.STATUS_DISQUALIFIED)
+                return False
+        return True
+
+    def _verify_chain_block(self, block: bytes) -> bool:
+        """verify_expected_utxo_state for one chain-candidate block."""
+        gd = self.storage.ghostdag.get(block)
+        header = self.storage.headers.get(block)
+        self._move_utxo_position(gd.selected_parent)
+        ctx = self._calculate_utxo_state(gd, header.daa_score)
+
+        # 1. utxo commitment
+        multiset = ctx["multiset"]
+        if multiset.finalize() != header.utxo_commitment:
+            return False
+        # 2. accepted id merkle root (KIP-15 two-level)
+        sp_header = self.storage.headers.get(gd.selected_parent)
+        expected_root = merkle.merkle_hash(
+            sp_header.accepted_id_merkle_root, merkle.calc_merkle_root(ctx["accepted_tx_ids"])
+        )
+        if expected_root != header.accepted_id_merkle_root:
+            return False
+        # 3. coinbase
+        txs = self.storage.block_transactions.get(block)
+        if not self._verify_coinbase_transaction(txs[0], header.daa_score, gd, ctx["mergeset_rewards"], self.daa_excluded[block]):
+            return False
+        # 4. own txs valid in own utxo view
+        own_view = UtxoView(self.utxo_set, ctx["mergeset_diff"])
+        validated = self._validate_transactions(
+            txs, own_view, header.daa_score, FLAG_FULL
+        )
+        if len(validated) < len(txs) - 1:
+            return False
+
+        # commit: store diff/multiset/acceptance, apply position
+        self.multisets[block] = multiset
+        self.utxo_diffs[block] = ctx["mergeset_diff"]
+        self.acceptance_data[block] = ctx["accepted_tx_ids"]
+        apply_diff(self.utxo_set, ctx["mergeset_diff"])
+        self.utxo_position = block
+        self.storage.statuses.set(block, StatusesStore.STATUS_UTXO_VALID)
+        return True
+
+    def _verify_coinbase_transaction(self, coinbase, daa_score, gd, mergeset_rewards, non_daa) -> bool:
+        miner_data = self.coinbase_manager.deserialize_coinbase_payload(coinbase.payload).miner_data
+        expected = self.coinbase_manager.expected_coinbase_transaction(
+            daa_score, miner_data, gd, mergeset_rewards, non_daa
+        )
+        return chash.tx_hash(coinbase) == chash.tx_hash(expected)
+
+    def _calculate_utxo_state(self, gd: GhostdagData, pov_daa_score: int) -> dict:
+        """utxo_validation.rs calculate_utxo_state relative to current position
+        (must equal gd.selected_parent)."""
+        assert self.utxo_position == gd.selected_parent
+        mergeset_diff = UtxoDiff()
+        multiset = self.multisets[gd.selected_parent].clone()
+        accepted_tx_ids: list[bytes] = []
+        mergeset_rewards: dict[bytes, BlockRewardData] = {}
+
+        sp_txs = self.storage.block_transactions.get(gd.selected_parent)
+        coinbase = sp_txs[0]
+        coinbase_entries: list = []
+        mergeset_diff.add_transaction(coinbase, coinbase_entries, pov_daa_score)
+        multiset_add_tx(multiset, coinbase, coinbase_entries, pov_daa_score)
+        accepted_tx_ids.append(coinbase.id())
+
+        ordered = [(gd.selected_parent, sp_txs)] + [
+            (b, self.storage.block_transactions.get(b)) for b in gd.ascending_mergeset_without_selected_parent(self.storage.ghostdag)
+        ]
+        for i, (merged_block, txs) in enumerate(ordered):
+            composed = UtxoView(self.utxo_set, mergeset_diff)
+            is_selected_parent = i == 0
+            flags = FLAG_SKIP_SCRIPTS if is_selected_parent else FLAG_FULL
+            block_daa = self.storage.headers.get_daa_score(merged_block)
+            validated = self._validate_transactions(txs, composed, pov_daa_score, flags)
+            block_fee = 0
+            for tx, entries, fee in validated:
+                mergeset_diff.add_transaction(tx, entries, pov_daa_score)
+                multiset_add_tx(multiset, tx, entries, pov_daa_score)
+                accepted_tx_ids.append(tx.id())
+                block_fee += fee
+            cb_data = self.coinbase_manager.deserialize_coinbase_payload(txs[0].payload)
+            mergeset_rewards[merged_block] = BlockRewardData(cb_data.subsidy, block_fee, cb_data.miner_data.script_public_key)
+
+        return {
+            "mergeset_diff": mergeset_diff,
+            "multiset": multiset,
+            "accepted_tx_ids": accepted_tx_ids,
+            "mergeset_rewards": mergeset_rewards,
+        }
+
+    def _validate_transactions(self, txs, utxo_view, pov_daa_score, flags):
+        """validate_transactions_in_parallel: returns [(tx, entries, fee)] of
+        valid non-coinbase txs; script checks batched on device."""
+        checker = self.transaction_validator.new_checker()
+        staged = []
+        for i, tx in enumerate(txs):
+            if i == 0:
+                continue  # coinbase
+            entries = []
+            missing = False
+            for inp in tx.inputs:
+                entry = utxo_view.get(inp.previous_outpoint)
+                if entry is None:
+                    missing = True
+                    break
+                entries.append(entry)
+            if missing:
+                continue
+            try:
+                fee = self.transaction_validator.validate_populated_transaction_and_get_fee(
+                    tx, entries, pov_daa_score, flags, checker=checker, token=i
+                )
+            except TxRuleError:
+                continue
+            staged.append((i, tx, entries, fee))
+        script_results = checker.dispatch()
+        out = []
+        for i, tx, entries, fee in staged:
+            if script_results.get(i) is None:
+                out.append((tx, entries, fee))
+        return out
+
+    # ------------------------------------------------------------------
+    # block building (test_consensus.rs build_*_with_parents + the
+    # template path of virtual_processor/processor.rs:1351-1510)
+    # ------------------------------------------------------------------
+
+    def build_block_with_parents(
+        self,
+        parents: list[bytes],
+        miner_data: MinerData,
+        txs: list[Transaction] | None = None,
+        timestamp: int | None = None,
+        tx_selector=None,
+    ) -> Block:
+        """Builds a fully valid block merging `parents` (any known tips).
+
+        Computes GHOSTDAG, window state and the UTXO commitments exactly as a
+        validator will, so the result passes validate_and_insert_block.
+        ``tx_selector(utxo_view, pov_daa_score) -> [Transaction]`` selects
+        transactions against the block's own UTXO context (the template
+        path's validate_block_template_transactions discipline).
+        """
+        gd = self.ghostdag_manager.ghostdag(parents)
+        if not self._ensure_chain_utxo_valid(gd.selected_parent):
+            raise RuleError("selected parent chain is disqualified")
+        daa_window = self.window_manager.block_daa_window(gd)
+        bits = self.window_manager.calculate_difficulty_bits(gd, daa_window)
+        pmt, _ = self.window_manager.calc_past_median_time(gd)
+        self._move_utxo_position(gd.selected_parent)
+        ctx = self._calculate_utxo_state(gd, daa_window.daa_score)
+        if tx_selector is not None:
+            assert txs is None
+            txs = tx_selector(UtxoView(self.utxo_set, ctx["mergeset_diff"]), daa_window.daa_score)
+        txs = txs or []
+
+        # mergeset rewards only cover merged blocks; txs of THIS block are
+        # rewarded by the block that merges it
+        coinbase = self.coinbase_manager.expected_coinbase_transaction(
+            daa_window.daa_score, miner_data, gd, ctx["mergeset_rewards"], daa_window.mergeset_non_daa
+        )
+        all_txs = [coinbase] + list(txs)
+
+        sp_header = self.storage.headers.get(gd.selected_parent)
+        accepted_root = merkle.merkle_hash(
+            sp_header.accepted_id_merkle_root, merkle.calc_merkle_root(ctx["accepted_tx_ids"])
+        )
+        header = Header(
+            version=self.params.genesis.version,
+            parents_by_level=[list(parents)],
+            hash_merkle_root=merkle.calc_hash_merkle_root(all_txs),
+            accepted_id_merkle_root=accepted_root,
+            utxo_commitment=ctx["multiset"].finalize(),
+            timestamp=timestamp if timestamp is not None else pmt + 1,
+            bits=bits,
+            nonce=0,
+            daa_score=daa_window.daa_score,
+            blue_work=gd.blue_work,
+            blue_score=gd.blue_score,
+            pruning_point=self.params.genesis.hash,
+        )
+        if header.timestamp <= pmt:
+            header.timestamp = pmt + 1
+            header.invalidate_cache()
+        return Block(header, all_txs)
+
+    def build_block_template(self, miner_data: MinerData, txs: list[Transaction], timestamp: int | None = None) -> Block:
+        """Template on top of the current virtual (mining path)."""
+        return self.build_block_with_parents(self.virtual_state.parents, miner_data, txs, timestamp)
+
+    def get_virtual_utxo_view(self) -> UtxoView:
+        """UTXO view of the current virtual (for tx selection/mempool)."""
+        self._move_utxo_position(self.sink())
+        return UtxoView(self.utxo_set, self.virtual_utxo_diff)
+
+    def _move_utxo_position(self, target: bytes) -> None:
+        """Reposition the materialized UTXO set along the selected chain."""
+        if self.utxo_position == target:
+            return
+        # walk current position down to a chain ancestor of target
+        back_path = []
+        cur = self.utxo_position
+        while not self.reachability.is_chain_ancestor_of(cur, target):
+            back_path.append(cur)
+            cur = self.storage.ghostdag.get_selected_parent(cur)
+        # walk target down to cur, collecting forward path
+        fwd_path = []
+        t = target
+        while t != cur:
+            fwd_path.append(t)
+            t = self.storage.ghostdag.get_selected_parent(t)
+        for b in back_path:
+            unapply_diff(self.utxo_set, self.utxo_diffs[b])
+        for b in reversed(fwd_path):
+            apply_diff(self.utxo_set, self.utxo_diffs[b])
+        self.utxo_position = target
+
+
+def multiset_add_tx(multiset: MuHash, tx, entries, block_daa_score: int) -> None:
+    multiset.add_transaction(tx, entries, block_daa_score)

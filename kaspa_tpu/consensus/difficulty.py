@@ -1,0 +1,54 @@
+"""Difficulty primitives: compact target codec and work calculation.
+
+Reference: math/src/lib.rs:61-97 (compact bits codec),
+consensus/src/processes/difficulty.rs:211-232 (calc_work / level_work).
+Python ints stand in for Uint256/Uint192 (exact, unbounded).
+"""
+
+from __future__ import annotations
+
+U256 = 1 << 256
+MAX_WORK_LEVEL = 192  # difficulty.rs MAX_WORK_LEVEL (Uint192 blue work)
+
+
+def compact_to_target(bits: int) -> int:
+    """Uint256::from_compact_target_bits."""
+    unshifted_expt = bits >> 24
+    if unshifted_expt <= 3:
+        mant = (bits & 0xFFFFFF) >> (8 * (3 - unshifted_expt))
+        expt = 0
+    else:
+        mant = bits & 0xFFFFFF
+        expt = 8 * (unshifted_expt - 3)
+    if mant > 0x7FFFFF:
+        return 0  # "mantissa is signed but may not be negative"
+    return (mant << expt) % U256
+
+
+def target_to_compact(target: int) -> int:
+    """Uint256::compact_target_bits."""
+    size = (target.bit_length() + 7) // 8
+    if size <= 3:
+        compact = (target << (8 * (3 - size))) & 0xFFFFFFFF
+    else:
+        compact = (target >> (8 * (size - 3))) & 0xFFFFFFFF
+    if compact & 0x00800000:
+        compact >>= 8
+        size += 1
+    return compact | (size << 24)
+
+
+def calc_work(bits: int) -> int:
+    """Work = 2**256 // (target+1), computed as in chain.cpp / difficulty.rs."""
+    target = compact_to_target(bits)
+    res = ((U256 - 1 - target) // (target + 1)) + 1
+    assert res < (1 << 192), "Work should not exceed 2**192"
+    return res
+
+
+def level_work(level: int, max_block_level: int) -> int:
+    """Lower-bound work per block at a given proof level (difficulty.rs:223)."""
+    if level == 0:
+        return 0
+    exp = level + 256 - max_block_level
+    return 1 << min(exp, MAX_WORK_LEVEL)

@@ -1,0 +1,25 @@
+"""Consensus data model: transactions, headers, UTXO entries.
+
+TPU-native re-design of the reference's consensus/core data model
+(consensus/core/src/tx.rs:50-450, header.rs:137-153).  Host-side objects are
+plain python dataclasses (the framework's Array-of-Structs boundary); device
+batching converts them into Structure-of-Arrays int32 tensors at the FFI
+edge (see kaspa_tpu/crypto/secp.py and ops/).
+"""
+
+from kaspa_tpu.consensus.model.tx import (  # noqa: F401
+    SUBNETWORK_ID_COINBASE,
+    SUBNETWORK_ID_NATIVE,
+    SUBNETWORK_ID_REGISTRY,
+    SUBNETWORK_ID_SIZE,
+    ComputeCommit,
+    Covenant,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+    subnetwork_from_byte,
+)
+from kaspa_tpu.consensus.model.header import Header  # noqa: F401

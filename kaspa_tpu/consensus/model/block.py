@@ -1,0 +1,25 @@
+"""Block = header + transactions (reference: consensus/core/src/block.rs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.model.header import Header
+from kaspa_tpu.consensus.model.tx import Transaction
+
+
+@dataclass
+class Block:
+    header: Header
+    transactions: list[Transaction] = field(default_factory=list)
+
+    @property
+    def hash(self) -> bytes:
+        return self.header.hash
+
+    def is_header_only(self) -> bool:
+        return not self.transactions
+
+    @staticmethod
+    def from_header(header: Header) -> "Block":
+        return Block(header, [])

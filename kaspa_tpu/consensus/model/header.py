@@ -1,0 +1,42 @@
+"""Block header model (reference: consensus/core/src/header.rs:137-153).
+
+``parents_by_level`` is stored expanded (list of levels, each a list of
+32-byte hashes); the run-length-compressed wire form (CompressedParents,
+header.rs:19) belongs to the P2P codec layer.  ``blue_work`` is an int
+(Uint192 range).  The cached ``hash`` is computed lazily.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Header:
+    version: int  # u16
+    parents_by_level: list[list[bytes]]
+    hash_merkle_root: bytes
+    accepted_id_merkle_root: bytes
+    utxo_commitment: bytes
+    timestamp: int  # u64 milliseconds
+    bits: int  # u32 compact difficulty target
+    nonce: int  # u64
+    daa_score: int  # u64
+    blue_work: int  # Uint192
+    blue_score: int  # u64
+    pruning_point: bytes
+    _hash_cache: bytes | None = field(default=None, repr=False, compare=False)
+
+    @property
+    def hash(self) -> bytes:
+        if self._hash_cache is None:
+            from kaspa_tpu.consensus import hashing as chash
+
+            self._hash_cache = chash.header_hash(self)
+        return self._hash_cache
+
+    def direct_parents(self) -> list[bytes]:
+        return self.parents_by_level[0] if self.parents_by_level else []
+
+    def invalidate_cache(self) -> None:
+        self._hash_cache = None

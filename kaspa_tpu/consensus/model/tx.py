@@ -1,0 +1,134 @@
+"""Transaction model (reference: consensus/core/src/tx.rs, subnets.rs).
+
+Hashes and ids are 32-byte ``bytes``; scripts/payloads are ``bytes``;
+amounts/scores are python ints (u64 range).  ``Transaction.storage_mass`` is
+the miner-committed storage mass (KIP-9), hashed into tx::hash but never
+into tx::id (tx.rs design notes in hashing/tx.rs:70-90).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+SUBNETWORK_ID_SIZE = 20
+
+
+def subnetwork_from_byte(b: int) -> bytes:
+    return bytes([b]) + b"\x00" * (SUBNETWORK_ID_SIZE - 1)
+
+
+SUBNETWORK_ID_NATIVE = subnetwork_from_byte(0)
+SUBNETWORK_ID_COINBASE = subnetwork_from_byte(1)
+SUBNETWORK_ID_REGISTRY = subnetwork_from_byte(2)
+
+
+def subnetwork_is_builtin(sid: bytes) -> bool:
+    return sid in (SUBNETWORK_ID_COINBASE, SUBNETWORK_ID_REGISTRY)
+
+
+def subnetwork_is_native(sid: bytes) -> bool:
+    return sid == SUBNETWORK_ID_NATIVE
+
+
+@dataclass(frozen=True)
+class TransactionOutpoint:
+    transaction_id: bytes  # 32
+    index: int  # u32
+
+
+@dataclass(frozen=True)
+class ComputeCommit:
+    """v0 carries a sig-op count (u8); v1 carries a compute budget (u16).
+
+    Reference: consensus/core/src/tx.rs:71-97 (ComputeCommit enum).
+    """
+
+    kind: str  # "sigops" | "budget"
+    value: int
+
+    @staticmethod
+    def sigops(n: int) -> "ComputeCommit":
+        return ComputeCommit("sigops", n)
+
+    @staticmethod
+    def budget(n: int) -> "ComputeCommit":
+        return ComputeCommit("budget", n)
+
+    def sig_op_count(self):
+        return self.value if self.kind == "sigops" else None
+
+    def compute_budget(self):
+        return self.value if self.kind == "budget" else None
+
+    @staticmethod
+    def version_expects_compute_budget_field(version: int) -> bool:
+        return version >= 1
+
+    @staticmethod
+    def version_expects_sig_op_count_field(version: int) -> bool:
+        return version < 1
+
+
+@dataclass
+class TransactionInput:
+    previous_outpoint: TransactionOutpoint
+    signature_script: bytes
+    sequence: int  # u64
+    compute_commit: ComputeCommit
+
+    @staticmethod
+    def new(outpoint: TransactionOutpoint, signature_script: bytes, sequence: int, sig_op_count: int):
+        return TransactionInput(outpoint, signature_script, sequence, ComputeCommit.sigops(sig_op_count))
+
+
+@dataclass(frozen=True)
+class ScriptPublicKey:
+    version: int  # u16
+    script: bytes
+
+
+@dataclass(frozen=True)
+class Covenant:
+    authorizing_input: int  # u16
+    covenant_id: bytes  # 32
+
+
+@dataclass
+class TransactionOutput:
+    value: int  # u64 sompi
+    script_public_key: ScriptPublicKey
+    covenant: Covenant | None = None
+
+
+@dataclass
+class Transaction:
+    version: int  # u16
+    inputs: list[TransactionInput]
+    outputs: list[TransactionOutput]
+    lock_time: int  # u64
+    subnetwork_id: bytes  # 20
+    gas: int  # u64
+    payload: bytes
+    storage_mass: int = 0  # committed storage mass (tx.rs:264)
+    _id_cache: bytes | None = field(default=None, repr=False, compare=False)
+
+    def id(self) -> bytes:
+        if self._id_cache is None:
+            from kaspa_tpu.consensus import hashing as chash
+
+            self._id_cache = chash.tx_id(self)
+        return self._id_cache
+
+    def is_coinbase(self) -> bool:
+        return self.subnetwork_id == SUBNETWORK_ID_COINBASE
+
+
+@dataclass(frozen=True)
+class UtxoEntry:
+    """Reference: consensus/core/src/tx.rs UtxoEntry."""
+
+    amount: int  # u64
+    script_public_key: ScriptPublicKey
+    block_daa_score: int
+    is_coinbase: bool
+    covenant_id: bytes | None = None

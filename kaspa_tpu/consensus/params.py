@@ -1,0 +1,176 @@
+"""Network parameters (reference: consensus/core/src/config/{params,bps,constants}.rs).
+
+The Bps class mirrors the reference's const-generic `Bps<BPS>` generator
+(config/bps.rs): every BPS-dependent constant is a function of the
+blocks-per-second value.  `Params` carries the full per-network parameter
+set; fork activation (ForkActivation gating on DAA score) is modeled with
+plain integers ("always" == 0, "never" == 2**64-1).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+# --- constants.rs consensus module ---
+NETWORK_DELAY_BOUND = 5
+GHOSTDAG_TAIL_DELTA = 0.01
+TIMESTAMP_DEVIATION_TOLERANCE = 132
+PAST_MEDIAN_TIME_SAMPLE_INTERVAL = 10
+MEDIAN_TIME_SAMPLED_WINDOW_SIZE = -(-(2 * TIMESTAMP_DEVIATION_TOLERANCE - 1) // PAST_MEDIAN_TIME_SAMPLE_INTERVAL)
+MAX_DIFFICULTY_TARGET = (1 << 255) - 1
+MIN_DIFFICULTY_WINDOW_SIZE = 150
+DIFFICULTY_WINDOW_DURATION = 2641
+DIFFICULTY_WINDOW_SAMPLE_INTERVAL = 4
+DIFFICULTY_SAMPLED_WINDOW_SIZE = -(-DIFFICULTY_WINDOW_DURATION // DIFFICULTY_WINDOW_SAMPLE_INTERVAL)
+FINALITY_DURATION = 43_200
+PRUNING_DURATION = 108_000
+MERGE_DEPTH_DURATION = 3600
+PRUNING_PROOF_M = 1000
+COINBASE_MATURITY_SECONDS = 100
+
+FORK_ALWAYS = 0
+FORK_NEVER = (1 << 64) - 1
+
+_GHOSTDAG_K_TABLE = {
+    1: 18, 2: 31, 3: 43, 4: 55, 5: 67, 6: 79, 7: 90, 8: 102, 9: 113, 10: 124,
+    11: 135, 12: 146, 13: 157, 14: 168, 15: 179, 16: 190, 17: 201, 18: 212, 19: 223, 20: 234,
+    21: 244, 22: 255, 23: 266, 24: 277, 25: 288, 26: 298, 27: 309, 28: 320, 29: 330, 30: 341,
+    31: 352, 32: 362,
+}
+
+
+def calculate_ghostdag_k(x: float, delta: float) -> int:
+    """Eq. 1, section 4.2 of the PHANTOM paper (config/bps.rs:9-21)."""
+    assert x > 0 and 0 < delta < 1
+    k_hat, sigma, fraction = 0, 0.0, 1.0
+    exp = math.e ** (-x)
+    while True:
+        sigma += exp * fraction
+        if 1.0 - sigma < delta:
+            return k_hat
+        k_hat += 1
+        fraction *= x / k_hat
+
+
+class Bps:
+    """Constants generator for a given blocks-per-second value (config/bps.rs)."""
+
+    def __init__(self, bps: int):
+        assert 1000 % bps == 0, "BPS must divide 1000"
+        self.bps = bps
+
+    def ghostdag_k(self) -> int:
+        return _GHOSTDAG_K_TABLE[self.bps]
+
+    def target_time_per_block(self) -> int:
+        return 1000 // self.bps
+
+    def max_block_parents(self) -> int:
+        return min(max(self.ghostdag_k() // 2, 10), 16)
+
+    def mergeset_size_limit(self) -> int:
+        return min(max(self.ghostdag_k() * 2, 180), 512)
+
+    def merge_depth_bound(self) -> int:
+        return self.bps * MERGE_DEPTH_DURATION
+
+    def finality_depth(self) -> int:
+        return self.bps * FINALITY_DURATION
+
+    def pruning_depth(self) -> int:
+        lower_bound = (
+            self.finality_depth()
+            + self.merge_depth_bound() * 2
+            + 4 * self.mergeset_size_limit() * self.ghostdag_k()
+            + 2 * self.ghostdag_k()
+            + 2
+        )
+        return max(lower_bound, self.bps * PRUNING_DURATION)
+
+    def past_median_time_sample_rate(self) -> int:
+        return self.bps * PAST_MEDIAN_TIME_SAMPLE_INTERVAL
+
+    def difficulty_adjustment_sample_rate(self) -> int:
+        return self.bps * DIFFICULTY_WINDOW_SAMPLE_INTERVAL
+
+    def coinbase_maturity(self) -> int:
+        return self.bps * COINBASE_MATURITY_SECONDS
+
+
+@dataclass
+class GenesisBlock:
+    hash: bytes
+    bits: int
+    timestamp: int
+    version: int = 0
+    daa_score: int = 0
+    coinbase_payload: bytes = b""
+
+
+@dataclass
+class Params:
+    """Consensus parameters for one network (config/params.rs Params)."""
+
+    name: str
+    bps: int
+    genesis: GenesisBlock
+    ghostdag_k: int
+    target_time_per_block: int  # milliseconds
+    max_block_parents: int
+    mergeset_size_limit: int
+    merge_depth: int
+    finality_depth: int
+    pruning_depth: int
+    coinbase_maturity: int
+    difficulty_window_size: int = DIFFICULTY_SAMPLED_WINDOW_SIZE
+    min_difficulty_window_size: int = MIN_DIFFICULTY_WINDOW_SIZE
+    difficulty_sample_rate: int = 4
+    past_median_time_window_size: int = MEDIAN_TIME_SAMPLED_WINDOW_SIZE
+    past_median_time_sample_rate: int = 10
+    max_difficulty_target: int = MAX_DIFFICULTY_TARGET
+    timestamp_deviation_tolerance: int = TIMESTAMP_DEVIATION_TOLERANCE
+    max_block_mass: int = 500_000
+    max_tx_inputs: int = 1_000
+    max_tx_outputs: int = 1_000
+    max_signature_script_len: int = 1_000
+    max_script_public_key_len: int = 10_000
+    max_coinbase_payload_len: int = 204
+    deflationary_phase_daa_score: int = 0
+    pre_deflationary_phase_base_subsidy: int = 50_000_000_000
+    skip_proof_of_work: bool = False
+    max_block_level: int = 225
+    pruning_proof_m: int = PRUNING_PROOF_M
+
+    @staticmethod
+    def from_bps(name: str, bps: int, genesis: GenesisBlock, **overrides) -> "Params":
+        g = Bps(bps)
+        p = Params(
+            name=name,
+            bps=bps,
+            genesis=genesis,
+            ghostdag_k=g.ghostdag_k(),
+            target_time_per_block=g.target_time_per_block(),
+            max_block_parents=g.max_block_parents(),
+            mergeset_size_limit=g.mergeset_size_limit(),
+            merge_depth=g.merge_depth_bound(),
+            finality_depth=g.finality_depth(),
+            pruning_depth=g.pruning_depth(),
+            coinbase_maturity=g.coinbase_maturity(),
+            difficulty_sample_rate=g.difficulty_adjustment_sample_rate(),
+            past_median_time_sample_rate=g.past_median_time_sample_rate(),
+        )
+        for k, v in overrides.items():
+            setattr(p, k, v)
+        return p
+
+
+def simnet_params(bps: int = 8, genesis_bits: int = 0x207FFFFF, genesis_timestamp: int = 0) -> Params:
+    """Simulation params in the style of simpa's self-tuned config
+    (simpa/src/main.rs:352-390): easy difficulty, skip-PoW, tuned to bps."""
+    genesis = GenesisBlock(hash=b"\x01" + b"\x00" * 31, bits=genesis_bits, timestamp=genesis_timestamp)
+    # short coinbase maturity so small simulations exercise real spends
+    return Params.from_bps(f"simnet-{bps}bps", bps, genesis, skip_proof_of_work=True, coinbase_maturity=8)
+
+
+MAINNET_BPS = 10

@@ -1,0 +1,137 @@
+"""Coinbase manager: subsidy schedule, mergeset reward payout, payload codec.
+
+Re-implementation of consensus/src/processes/coinbase.rs: the coinbase tx
+pays each blue mergeset block's reward to the script it declared (fees +
+subsidy), aggregates red/non-DAA rewards to the merging miner, and embeds
+(blue_score, subsidy, miner script, extra data) in the payload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kaspa_tpu.consensus.model import (
+    SUBNETWORK_ID_COINBASE,
+    ScriptPublicKey,
+    Transaction,
+    TransactionOutput,
+)
+from kaspa_tpu.consensus.processes.subsidy_table import SUBSIDY_BY_MONTH_TABLE
+from kaspa_tpu.consensus.stores import GhostdagData
+
+SECONDS_PER_MONTH = 2629800  # 30.4375 days
+MIN_PAYLOAD_LENGTH = 8 + 8 + 2 + 1
+
+TX_VERSION = 0
+
+
+class CoinbaseError(Exception):
+    pass
+
+
+@dataclass
+class MinerData:
+    script_public_key: ScriptPublicKey
+    extra_data: bytes = b""
+
+
+@dataclass
+class CoinbaseData:
+    blue_score: int
+    subsidy: int
+    miner_data: MinerData
+
+
+@dataclass
+class BlockRewardData:
+    subsidy: int
+    total_fees: int
+    script_public_key: ScriptPublicKey
+
+
+class CoinbaseManager:
+    def __init__(
+        self,
+        coinbase_payload_script_public_key_max_len: int = 150,
+        max_coinbase_payload_len: int = 204,
+        deflationary_phase_daa_score: int = 0,
+        pre_deflationary_phase_base_subsidy: int = 50_000_000_000,
+        bps: int = 1,
+    ):
+        self.coinbase_payload_script_public_key_max_len = coinbase_payload_script_public_key_max_len
+        self.max_coinbase_payload_len = max_coinbase_payload_len
+        self.deflationary_phase_daa_score = deflationary_phase_daa_score
+        self.pre_deflationary_phase_base_subsidy = pre_deflationary_phase_base_subsidy
+        self.bps = bps
+        # reward per block = (reward per second) / bps, rounded up (bps.rs style)
+        self._subsidy_table = tuple(-(-s // bps) for s in SUBSIDY_BY_MONTH_TABLE)
+
+    def calc_block_subsidy(self, daa_score: int) -> int:
+        if daa_score < self.deflationary_phase_daa_score:
+            return self.pre_deflationary_phase_base_subsidy
+        seconds = (daa_score - self.deflationary_phase_daa_score) // self.bps
+        month = seconds // SECONDS_PER_MONTH
+        return self._subsidy_table[min(month, len(self._subsidy_table) - 1)]
+
+    def expected_coinbase_transaction(
+        self,
+        daa_score: int,
+        miner_data: MinerData,
+        ghostdag_data: GhostdagData,
+        mergeset_rewards: dict[bytes, BlockRewardData],
+        mergeset_non_daa: set[bytes],
+    ) -> Transaction:
+        outputs = []
+        for blue in ghostdag_data.mergeset_blues:
+            if blue in mergeset_non_daa:
+                continue
+            reward = mergeset_rewards[blue]
+            if reward.subsidy + reward.total_fees > 0:
+                outputs.append(TransactionOutput(reward.subsidy + reward.total_fees, reward.script_public_key))
+
+        red_reward = 0
+        for red in ghostdag_data.mergeset_reds:
+            reward = mergeset_rewards[red]
+            if red in mergeset_non_daa:
+                red_reward += reward.total_fees
+            else:
+                red_reward += reward.subsidy + reward.total_fees
+        if red_reward > 0:
+            outputs.append(TransactionOutput(red_reward, miner_data.script_public_key))
+
+        subsidy = self.calc_block_subsidy(daa_score)
+        payload = self.serialize_coinbase_payload(CoinbaseData(ghostdag_data.blue_score, subsidy, miner_data))
+        return Transaction(TX_VERSION, [], outputs, 0, SUBNETWORK_ID_COINBASE, 0, payload)
+
+    def serialize_coinbase_payload(self, data: CoinbaseData) -> bytes:
+        script = data.miner_data.script_public_key.script
+        if len(script) > self.coinbase_payload_script_public_key_max_len:
+            raise CoinbaseError("script public key length above max")
+        return (
+            data.blue_score.to_bytes(8, "little")
+            + data.subsidy.to_bytes(8, "little")
+            + data.miner_data.script_public_key.version.to_bytes(2, "little")
+            + bytes([len(script)])
+            + script
+            + data.miner_data.extra_data
+        )
+
+    def deserialize_coinbase_payload(self, payload: bytes) -> CoinbaseData:
+        if len(payload) < MIN_PAYLOAD_LENGTH:
+            raise CoinbaseError(f"payload len {len(payload)} below min {MIN_PAYLOAD_LENGTH}")
+        if len(payload) > self.max_coinbase_payload_len:
+            raise CoinbaseError(f"payload len {len(payload)} above max {self.max_coinbase_payload_len}")
+        blue_score = int.from_bytes(payload[0:8], "little")
+        subsidy = int.from_bytes(payload[8:16], "little")
+        version = int.from_bytes(payload[16:18], "little")
+        script_len = payload[18]
+        if script_len > self.coinbase_payload_script_public_key_max_len:
+            raise CoinbaseError("script public key length above max")
+        if len(payload) - 19 < script_len:
+            raise CoinbaseError("payload can't contain script public key")
+        script = payload[19 : 19 + script_len]
+        extra = payload[19 + script_len :]
+        return CoinbaseData(blue_score, subsidy, MinerData(ScriptPublicKey(version, script), extra))
+
+    def validate_coinbase_payload_in_isolation_and_extract_coinbase_data(self, coinbase_tx: Transaction) -> CoinbaseData:
+        return self.deserialize_coinbase_payload(coinbase_tx.payload)

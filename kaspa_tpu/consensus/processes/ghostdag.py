@@ -1,0 +1,167 @@
+"""GHOSTDAG: k-cluster blue/red coloring of the block DAG.
+
+Faithful re-implementation of the protocol in
+consensus/src/processes/ghostdag/{protocol,mergeset,ordering}.rs — the
+PHANTOM/GHOSTDAG greedy coloring (https://eprint.iacr.org/2018/104.pdf):
+
+- selected parent = parent with max (blue_work, hash)
+- mergeset = past(new) \\ past(selected_parent), ordered ascending by
+  (blue_work, hash) (topological: ancestors have smaller blue work)
+- a candidate is blue iff adding it keeps every blue block's blue-anticone
+  <= k, tracked incrementally via blues_anticone_sizes maps
+
+This is host-side pointer-chasing by design (SURVEY.md §7 "hard parts" #6):
+the DAG walk is irregular and tiny compared to the tx-validation batches
+the TPU consumes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from kaspa_tpu.consensus.difficulty import calc_work
+from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
+from kaspa_tpu.consensus.stores import GhostdagData, GhostdagStore, HeaderStore, RelationsStore
+
+_BLUE = "blue"
+_RED = "red"
+_PENDING = "pending"
+
+
+class GhostdagManager:
+    def __init__(
+        self,
+        genesis_hash: bytes,
+        k: int,
+        ghostdag_store: GhostdagStore,
+        relations_store: RelationsStore,
+        headers_store: HeaderStore,
+        reachability: ReachabilityService,
+        level_work: int = 0,
+    ):
+        self.genesis_hash = genesis_hash
+        self.k = k
+        self.ghostdag_store = ghostdag_store
+        self.relations_store = relations_store
+        self.headers_store = headers_store
+        self.reachability = reachability
+        self.level_work = level_work
+
+    # --- construction helpers ---
+
+    def genesis_ghostdag_data(self) -> GhostdagData:
+        return GhostdagData(0, 0, ORIGIN, [], [], {})
+
+    def _new_with_selected_parent(self, selected_parent: bytes) -> GhostdagData:
+        return GhostdagData(0, 0, selected_parent, [selected_parent], [], {selected_parent: 0})
+
+    def find_selected_parent(self, parents) -> bytes:
+        return max(parents, key=lambda p: (self.ghostdag_store.get_blue_work(p), p))
+
+    def sort_blocks(self, blocks) -> list[bytes]:
+        return sorted(blocks, key=lambda h: (self.ghostdag_store.get_blue_work(h), h))
+
+    # --- mergeset (mergeset.rs) ---
+
+    def unordered_mergeset_without_selected_parent(self, selected_parent: bytes, parents) -> set[bytes]:
+        queue = deque(p for p in parents if p != selected_parent)
+        mergeset = set(queue)
+        past: set[bytes] = set()
+        while queue:
+            current = queue.popleft()
+            for parent in self.relations_store.get_parents(current):
+                if parent in mergeset or parent in past:
+                    continue
+                if self.reachability.is_dag_ancestor_of(parent, selected_parent):
+                    past.add(parent)
+                    continue
+                mergeset.add(parent)
+                queue.append(parent)
+        return mergeset
+
+    def ordered_mergeset_without_selected_parent(self, selected_parent: bytes, parents) -> list[bytes]:
+        return self.sort_blocks(self.unordered_mergeset_without_selected_parent(selected_parent, parents))
+
+    # --- coloring (protocol.rs) ---
+
+    def ghostdag(self, parents: list[bytes]) -> GhostdagData:
+        assert parents, "genesis must be added via genesis_ghostdag_data"
+        selected_parent = self.find_selected_parent(parents)
+        if selected_parent == ORIGIN:
+            return self._new_with_selected_parent(selected_parent)
+        data = self._new_with_selected_parent(selected_parent)
+
+        for candidate in self.ordered_mergeset_without_selected_parent(selected_parent, parents):
+            coloring = self._check_blue_candidate(data, candidate)
+            if coloring is not None:
+                anticone_size, anticone_sizes = coloring
+                self._add_blue(data, candidate, anticone_size, anticone_sizes)
+            else:
+                data.mergeset_reds.append(candidate)
+
+        data.blue_score = self.ghostdag_store.get_blue_score(selected_parent) + len(data.mergeset_blues)
+        added_work = sum(
+            max(calc_work(self.headers_store.get_bits(b)), self.level_work) for b in data.mergeset_blues
+        )
+        data.blue_work = self.ghostdag_store.get_blue_work(selected_parent) + added_work
+        return data
+
+    def _add_blue(self, data: GhostdagData, block: bytes, blue_anticone_size: int, anticone_sizes: dict[bytes, int]):
+        # protocol mirror of GhostdagData::add_blue (model/stores/ghostdag.rs):
+        # register the new blue, bump anticone sizes of affected blues
+        data.mergeset_blues.append(block)
+        data.blues_anticone_sizes[block] = blue_anticone_size
+        for peer in anticone_sizes:
+            data.blues_anticone_sizes[peer] = anticone_sizes[peer] + 1
+
+    def _blue_anticone_size(self, block: bytes, context: GhostdagData) -> int:
+        """|anticone(block) ∩ blues(context)|; block must be blue in context."""
+        current_sizes = context.blues_anticone_sizes
+        current_selected_parent = context.selected_parent
+        while True:
+            if block in current_sizes:
+                return current_sizes[block]
+            if current_selected_parent in (self.genesis_hash, ORIGIN):
+                raise AssertionError(f"block {block.hex()} is not in blue set of the given context")
+            current_sizes = self.ghostdag_store.get_blues_anticone_sizes(current_selected_parent)
+            current_selected_parent = self.ghostdag_store.get_selected_parent(current_selected_parent)
+
+    def _check_blue_candidate(self, data: GhostdagData, candidate: bytes):
+        """Returns (candidate_blue_anticone_size, affected_sizes) if blue, None if red."""
+        k = self.k
+        if len(data.mergeset_blues) == k + 1:
+            return None
+        candidate_sizes: dict[bytes, int] = {}
+        candidate_anticone = 0
+
+        chain_hash: bytes | None = None  # None == the new block
+        chain_data = data
+        while True:
+            state, candidate_anticone = self._check_with_chain_block(
+                data, chain_hash, chain_data, candidate, candidate_sizes, candidate_anticone
+            )
+            if state == _BLUE:
+                return candidate_anticone, candidate_sizes
+            if state == _RED:
+                return None
+            chain_hash = chain_data.selected_parent
+            chain_data = self.ghostdag_store.get(chain_hash)
+
+    def _check_with_chain_block(self, data, chain_hash, chain_data, candidate, candidate_sizes, candidate_anticone):
+        # if candidate is in the future of chain_block, all remaining blues
+        # are in its past: safe to color blue
+        if chain_hash is not None and self.reachability.is_dag_ancestor_of(chain_hash, candidate):
+            return _BLUE, candidate_anticone
+        k = self.k
+        for peer in chain_data.mergeset_blues:
+            if self.reachability.is_dag_ancestor_of(peer, candidate):
+                continue
+            peer_size = self._blue_anticone_size(peer, data)
+            candidate_sizes[peer] = peer_size
+            candidate_anticone += 1
+            if candidate_anticone > k:
+                return _RED, candidate_anticone
+            if peer_size == k:
+                return _RED, candidate_anticone
+            assert peer_size <= k, "found blue anticone larger than K"
+        return _PENDING, candidate_anticone

@@ -1,0 +1,146 @@
+"""Transaction validation (reference: consensus/src/processes/transaction_validator/).
+
+- in-isolation checks (tx_validation_in_isolation.rs): counts, duplicate
+  outpoints, script length limits, value ranges.  NOTE: the KIP-9 mass
+  calculator (compute/transient/storage mass) is not implemented yet —
+  mass commitment checks and block mass limits land with that milestone
+- header-context checks (tx_validation_in_header_context.rs): lock time
+- UTXO-context checks (tx_validation_in_utxo_context.rs): maturity, input
+  amounts, fee, sequence locks, script checks
+
+Script checks are *collected* into a BatchScriptChecker (TPU batch) rather
+than executed per input — the deferred-dispatch twist on the reference's
+rayon check_scripts_par_iter (the "TPU offload point", SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.consensus.model import SUBNETWORK_ID_NATIVE, Transaction
+from kaspa_tpu.consensus.params import Params
+from kaspa_tpu.txscript.batch import BatchScriptChecker
+from kaspa_tpu.txscript.caches import SigCache
+
+MAX_SOMPI = 29_000_000_000 * 100_000_000  # constants.rs MAX_SOMPI
+SEQUENCE_LOCK_TIME_MASK = 0x00000000FFFFFFFF
+SEQUENCE_LOCK_TIME_DISABLED = 1 << 63
+LOCK_TIME_THRESHOLD = 500_000_000_000  # tx_validation_in_header_context
+
+
+class TxRuleError(Exception):
+    pass
+
+
+FLAG_FULL = "full"
+FLAG_SKIP_SCRIPTS = "skip_scripts"
+FLAG_SKIP_MASS = "skip_mass"
+
+
+class TransactionValidator:
+    def __init__(self, params: Params, sig_cache: SigCache | None = None, vm_fallback=None):
+        self.params = params
+        self.coinbase_maturity = params.coinbase_maturity
+        self.sig_cache = sig_cache if sig_cache is not None else SigCache()
+        self.vm_fallback = vm_fallback
+
+    def new_checker(self) -> BatchScriptChecker:
+        return BatchScriptChecker(self.sig_cache, self.vm_fallback)
+
+    # --- in isolation (tx_validation_in_isolation.rs) ---
+
+    def validate_tx_in_isolation(self, tx: Transaction) -> None:
+        if not tx.is_coinbase():
+            if len(tx.inputs) == 0:
+                raise TxRuleError("transaction has no inputs")
+            if len(tx.inputs) > self.params.max_tx_inputs:
+                raise TxRuleError(f"too many inputs {len(tx.inputs)}")
+            for inp in tx.inputs:
+                if len(inp.signature_script) > self.params.max_signature_script_len:
+                    raise TxRuleError("signature script too long")
+        if len(tx.outputs) > self.params.max_tx_outputs:
+            raise TxRuleError(f"too many outputs {len(tx.outputs)}")
+        total = 0
+        for out in tx.outputs:
+            if out.value == 0:
+                raise TxRuleError("zero output value")
+            if out.value > MAX_SOMPI:
+                raise TxRuleError("output value too high")
+            total += out.value
+            if total > MAX_SOMPI:
+                raise TxRuleError("outputs total overflow")
+            if len(out.script_public_key.script) > self.params.max_script_public_key_len:
+                raise TxRuleError("script public key too long")
+        seen = set()
+        for inp in tx.inputs:
+            if inp.previous_outpoint in seen:
+                raise TxRuleError("duplicate outpoint")
+            seen.add(inp.previous_outpoint)
+        if tx.subnetwork_id == SUBNETWORK_ID_NATIVE and tx.gas > 0:
+            raise TxRuleError("gas in native subnetwork")
+
+    # --- header context (lock time) ---
+
+    def validate_tx_in_header_context(self, tx: Transaction, ctx_daa_score: int, ctx_past_median_time: int) -> None:
+        if tx.lock_time == 0:
+            return
+        if tx.lock_time < LOCK_TIME_THRESHOLD:
+            block_or_time = ctx_daa_score  # interpreted as DAA score
+        else:
+            block_or_time = ctx_past_median_time
+        # strict <: equality is NOT finalized (tx_validation_in_header_context.rs:79)
+        if tx.lock_time < block_or_time:
+            return
+        # lock time hasn't occurred: every input must have max sequence
+        if any(inp.sequence != (1 << 64) - 1 for inp in tx.inputs):
+            raise TxRuleError("tx is not finalized")
+
+    # --- utxo context (tx_validation_in_utxo_context.rs) ---
+
+    def validate_populated_transaction_and_get_fee(
+        self,
+        tx: Transaction,
+        entries: list,
+        pov_daa_score: int,
+        flags: str = FLAG_FULL,
+        checker: BatchScriptChecker | None = None,
+        token: int | None = None,
+    ) -> int:
+        self._check_coinbase_maturity(tx, entries, pov_daa_score)
+        total_in = self._check_input_amounts(entries)
+        total_out = self._check_output_values(tx, total_in)
+        fee = total_in - total_out
+        self._check_sequence_lock(tx, entries, pov_daa_score)
+        if flags in (FLAG_FULL, FLAG_SKIP_MASS):
+            assert checker is not None and token is not None, "script checks need a batch checker"
+            checker.collect_tx(token, tx, entries)
+        return fee
+
+    def _check_coinbase_maturity(self, tx, entries, pov_daa_score):
+        for i, (inp, entry) in enumerate(zip(tx.inputs, entries)):
+            if entry.is_coinbase and entry.block_daa_score + self.coinbase_maturity > pov_daa_score:
+                raise TxRuleError(
+                    f"immature coinbase spend at input {i}: utxo daa {entry.block_daa_score} pov {pov_daa_score}"
+                )
+
+    def _check_input_amounts(self, entries) -> int:
+        total = 0
+        for entry in entries:
+            total += entry.amount
+            if total > MAX_SOMPI:
+                raise TxRuleError("input amount too high")
+        return total
+
+    def _check_output_values(self, tx, total_in) -> int:
+        total_out = sum(out.value for out in tx.outputs)
+        if total_in < total_out:
+            raise TxRuleError(f"spend too high {total_out} > {total_in}")
+        return total_out
+
+    def _check_sequence_lock(self, tx, entries, pov_daa_score):
+        pov = pov_daa_score
+        for inp, entry in zip(tx.inputs, entries):
+            if inp.sequence & SEQUENCE_LOCK_TIME_DISABLED == SEQUENCE_LOCK_TIME_DISABLED:
+                continue
+            relative_lock = inp.sequence & SEQUENCE_LOCK_TIME_MASK
+            lock_daa_score = entry.block_daa_score + relative_lock - 1
+            if lock_daa_score >= pov:
+                raise TxRuleError("sequence lock conditions are not met")

@@ -1,0 +1,267 @@
+"""Sampled difficulty / past-median-time windows (KIP-0004) + DAA.
+
+Re-implementation of consensus/src/processes/{window,difficulty,
+past_median_time}.rs (SampledWindowManager & friends): bounded max-work
+heaps assembled by walking the selected chain, per-block window caches,
+daa-score / mergeset-non-daa computation, difficulty retargeting over the
+sampled window, and the 11-point median-time average.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.difficulty import compact_to_target, target_to_compact
+from kaspa_tpu.consensus.reachability import ORIGIN
+from kaspa_tpu.consensus.stores import GhostdagData, GhostdagStore, HeaderStore
+
+
+class RuleError(Exception):
+    pass
+
+
+class InsufficientDaaWindowSize(RuleError):
+    pass
+
+
+DIFFICULTY_WINDOW = "difficulty"
+MEDIAN_TIME_WINDOW = "median_time"
+
+
+class BoundedBlockHeap:
+    """Keeps the `bound` blocks with highest (blue_work, hash).
+
+    Mirror of window.rs BoundedSizeBlockHeap (reversed BinaryHeap); python
+    heapq is a min-heap so the root is the eviction candidate directly.
+    """
+
+    def __init__(self, bound: int, items=None):
+        self.bound = bound
+        self.heap: list[tuple[int, bytes]] = list(items) if items else []
+        heapq.heapify(self.heap)
+        while len(self.heap) > bound:
+            heapq.heappop(self.heap)
+
+    def reached_size_bound(self) -> bool:
+        return len(self.heap) == self.bound
+
+    def can_push(self, hash_: bytes, blue_work: int) -> bool:
+        if self.reached_size_bound():
+            return self.heap[0] <= (blue_work, hash_)
+        return True
+
+    def try_push(self, hash_: bytes, blue_work: int) -> bool:
+        item = (blue_work, hash_)
+        if self.reached_size_bound():
+            if self.heap[0] > item:
+                return False
+            heapq.heapreplace(self.heap, item)
+            return True
+        heapq.heappush(self.heap, item)
+        return True
+
+    def merge_ancestor_heap(self, ancestor_items) -> None:
+        self.heap.extend(ancestor_items)
+        heapq.heapify(self.heap)
+        while len(self.heap) > self.bound:
+            heapq.heappop(self.heap)
+
+
+@dataclass
+class DaaWindow:
+    window: list[tuple[int, bytes]]  # (blue_work, hash)
+    daa_score: int
+    mergeset_non_daa: set[bytes]
+
+
+class SampledWindowManager:
+    def __init__(
+        self,
+        genesis_hash: bytes,
+        genesis_bits: int,
+        genesis_timestamp: int,
+        ghostdag_store: GhostdagStore,
+        headers_store: HeaderStore,
+        max_difficulty_target: int,
+        target_time_per_block: int,
+        difficulty_window_size: int,
+        min_difficulty_window_size: int,
+        difficulty_sample_rate: int,
+        past_median_time_window_size: int,
+        past_median_time_sample_rate: int,
+    ):
+        assert min_difficulty_window_size <= difficulty_window_size
+        self.genesis_hash = genesis_hash
+        self.genesis_bits = genesis_bits
+        self.genesis_timestamp = genesis_timestamp
+        self.gd = ghostdag_store
+        self.headers = headers_store
+        self.max_difficulty_target = max_difficulty_target
+        self.target_time_per_block = target_time_per_block
+        self.difficulty_window_size = difficulty_window_size
+        self.min_difficulty_window_size = min_difficulty_window_size
+        self.difficulty_sample_rate = difficulty_sample_rate
+        self.past_median_time_window_size = past_median_time_window_size
+        self.past_median_time_sample_rate = past_median_time_sample_rate
+        # block_window_cache stores (consensus/src/model/stores/block_window_cache.rs)
+        self._difficulty_cache: dict[bytes, list] = {}
+        self._median_cache: dict[bytes, list] = {}
+
+    # --- sizes / rates ---
+
+    def window_size(self, window_type: str) -> int:
+        return self.difficulty_window_size if window_type == DIFFICULTY_WINDOW else self.past_median_time_window_size
+
+    def sample_rate(self, window_type: str) -> int:
+        return self.difficulty_sample_rate if window_type == DIFFICULTY_WINDOW else self.past_median_time_sample_rate
+
+    def difficulty_full_window_size(self) -> int:
+        return self.difficulty_window_size * self.difficulty_sample_rate
+
+    def lowest_daa_blue_score(self, gd: GhostdagData) -> int:
+        full = self.difficulty_full_window_size()
+        return max(gd.blue_score, full) - full
+
+    # --- window construction (window.rs build_block_window) ---
+
+    def _sampled_mergeset_iter(self, sample_rate: int, gd: GhostdagData, sp_blue_work: int):
+        """Yields ('sampled', (blue_work, hash)) / ('non_daa', hash) for the
+        mergeset in descending (blue_work, hash) order, selected parent first."""
+        sp_daa_score = self.headers.get_daa_score(gd.selected_parent)
+        threshold = self.lowest_daa_blue_score(gd)
+        rest = sorted(
+            ((self.gd.get_blue_work(h), h) for h in gd.unordered_mergeset_without_selected_parent()),
+            reverse=True,
+        )
+        index = 0
+        for blue_work, h in [(sp_blue_work, gd.selected_parent)] + rest:
+            if self.gd.get_blue_score(h) < threshold:
+                yield ("non_daa", h)
+            else:
+                index += 1
+                if (sp_daa_score + index) % sample_rate == 0:
+                    yield ("sampled", (blue_work, h))
+
+    def _push_mergeset(self, heap: BoundedBlockHeap, sample_rate: int, gd: GhostdagData, sp_blue_work: int, non_daa_out: set | None):
+        if non_daa_out is not None:
+            for kind, payload in self._sampled_mergeset_iter(sample_rate, gd, sp_blue_work):
+                if kind == "sampled":
+                    heap.try_push(payload[1], payload[0])
+                else:
+                    non_daa_out.add(payload)
+        else:
+            for kind, payload in self._sampled_mergeset_iter(sample_rate, gd, sp_blue_work):
+                if kind == "sampled" and not heap.try_push(payload[1], payload[0]):
+                    return
+
+    def build_block_window(self, gd: GhostdagData, window_type: str, non_daa_out: set | None = None) -> list:
+        window_size = self.window_size(window_type)
+        sample_rate = self.sample_rate(window_type)
+        if window_size == 0:
+            return []
+        if gd.selected_parent == self.genesis_hash:
+            if non_daa_out is not None:
+                non_daa_out.add(self.genesis_hash)
+            return []
+        if gd.selected_parent == ORIGIN:
+            raise InsufficientDaaWindowSize(0)
+
+        cache = self._difficulty_cache if window_type == DIFFICULTY_WINDOW else (
+            self._median_cache if window_type == MEDIAN_TIME_WINDOW else None
+        )
+        sp_blue_work = self.gd.get_blue_work(gd.selected_parent)
+
+        # init from selected parent's cached window
+        if cache is not None and gd.selected_parent in cache:
+            heap = BoundedBlockHeap(window_size, cache[gd.selected_parent])
+            self._push_mergeset(heap, sample_rate, gd, sp_blue_work, non_daa_out)
+            return sorted(heap.heap)
+
+        heap = BoundedBlockHeap(window_size)
+        self._push_mergeset(heap, sample_rate, gd, sp_blue_work, non_daa_out)
+
+        current = self.gd.get(gd.selected_parent)
+        while True:
+            if current.selected_parent == ORIGIN:
+                if heap.reached_size_bound():
+                    break
+                raise InsufficientDaaWindowSize(len(heap.heap))
+            if current.selected_parent == self.genesis_hash:
+                break
+            parent_gd = self.gd.get(current.selected_parent)
+            if not heap.can_push(current.selected_parent, parent_gd.blue_work):
+                break
+            self._push_mergeset(heap, sample_rate, current, parent_gd.blue_work, None)
+            if cache is not None and current.selected_parent in cache:
+                heap.merge_ancestor_heap(list(cache[current.selected_parent]))
+                break
+            current = parent_gd
+        return sorted(heap.heap)
+
+    def cache_block_window(self, block: bytes, window_type: str, window: list) -> None:
+        (self._difficulty_cache if window_type == DIFFICULTY_WINDOW else self._median_cache)[block] = window
+
+    # --- DAA (difficulty.rs) ---
+
+    def calc_daa_score_and_non_daa(self, gd: GhostdagData) -> tuple[int, set[bytes]]:
+        threshold = self.lowest_daa_blue_score(gd)
+        non_daa = {h for h in gd.unordered_mergeset() if self.gd.get_blue_score(h) < threshold}
+        sp_daa = self.headers.get_daa_score(gd.selected_parent)
+        return sp_daa + gd.mergeset_size() - len(non_daa), non_daa
+
+    def block_daa_window(self, gd: GhostdagData) -> DaaWindow:
+        non_daa: set[bytes] = set()
+        window = self.build_block_window(gd, DIFFICULTY_WINDOW, non_daa)
+        sp_daa = self.headers.get_daa_score(gd.selected_parent) if gd.selected_parent != ORIGIN else 0
+        daa_score = sp_daa + gd.mergeset_size() - len(non_daa)
+        return DaaWindow(window, daa_score, non_daa)
+
+    # --- difficulty retarget (difficulty.rs calculate_difficulty_bits) ---
+
+    def calculate_difficulty_bits(self, gd: GhostdagData, daa_window: DaaWindow) -> int:
+        window = daa_window.window
+        if len(window) < self.min_difficulty_window_size:
+            if gd.selected_parent == self.genesis_hash:
+                return self.genesis_bits
+            return self.headers.get_bits(gd.selected_parent)
+
+        # DifficultyBlock ordering: (timestamp, blue_work, hash)
+        blocks = [(self.headers.get_timestamp(h), bw, h) for bw, h in window]
+        min_block = min(blocks)
+        max_block = max(blocks)
+        min_ts, max_ts = min_block[0], max_block[0]
+        rest = list(blocks)
+        rest.remove(min_block)  # swap_remove of the minimum
+        n = len(rest)
+        targets_sum = sum(compact_to_target(self.headers.get_bits(h)) for _, _, h in rest)
+        average_target = targets_sum // n
+        measured_duration = max(max_ts - min_ts, 1)
+        expected_duration = self.target_time_per_block * self.difficulty_sample_rate * n
+        new_target = average_target * measured_duration // expected_duration
+        return target_to_compact(min(new_target, self.max_difficulty_target))
+
+    # --- past median time (past_median_time.rs) ---
+
+    def calc_past_median_time(self, gd: GhostdagData) -> tuple[int, list]:
+        window = self.build_block_window(gd, MEDIAN_TIME_WINDOW)
+        if not window:
+            return self.headers.get_timestamp(gd.selected_parent), window
+        timestamps = sorted(self.headers.get_timestamp(h) for _, h in window)
+        frame = min(len(timestamps), 11)
+        ending_index = (len(timestamps) + frame + 1) // 2
+        frame_slice = timestamps[ending_index - frame : ending_index]
+        return (sum(frame_slice) + frame // 2) // frame, window
+
+    def estimate_network_hashes_per_second(self, window: list) -> int:
+        if len(window) < 1000:
+            raise RuleError(f"window size {len(window)} below minimum 1000")
+        timestamps = [self.headers.get_timestamp(h) for _, h in window]
+        min_ts, max_ts = min(timestamps), max(timestamps)
+        if min_ts == max_ts:
+            raise RuleError("empty timestamp range")
+        duration_s = (max_ts - min_ts) // 1000
+        if duration_s == 0:
+            return 0
+        works = [bw for bw, _ in window]
+        return (max(works) - min(works)) // duration_s

@@ -1,0 +1,71 @@
+"""DAG reachability service.
+
+The reference achieves O(1) `is_dag_ancestor_of` through interval labeling
+of the selected-parent tree plus future-covering sets with dynamic
+reindexing (consensus/src/processes/reachability/, 1.6k LoC).  This module
+provides the same service interface with an interned-bitset backend:
+each block's past is one python int used as a bitmask over dense block
+indices — O(1) amortised queries, O(n/64 words) per insertion, exact for
+any DAG topology.  It is the correctness-first backend sized for
+simulation/test scale; the interval-tree backend is the planned upgrade for
+unbounded chains (tracked for a later round).
+
+Semantics mirror reachability/inquirer.rs:
+- is_dag_ancestor_of(a, b): a ∈ past(b) ∪ {b}
+- is_chain_ancestor_of(a, b): a on the selected-parent chain of b (incl. b)
+"""
+
+from __future__ import annotations
+
+ORIGIN = b"\xfe" * 32
+
+
+class ReachabilityService:
+    def __init__(self):
+        self._idx: dict[bytes, int] = {}
+        self._past: list[int] = []  # bitmask over indices
+        self._chain: list[int] = []  # bitmask over selected-parent chain
+        self._bit: list[int] = []
+        # ORIGIN is the virtual genesis: every block is in its future
+        self._add(ORIGIN, [], ORIGIN)
+
+    def _add(self, block: bytes, parents: list[bytes], selected_parent: bytes | None):
+        assert block not in self._idx, "block already added"
+        i = len(self._past)
+        self._idx[block] = i
+        bit = 1 << i
+        self._bit.append(bit)
+        past = 0
+        for p in parents:
+            pi = self._idx[p]
+            past |= self._past[pi] | self._bit[pi]
+        self._past.append(past)
+        if selected_parent is None or selected_parent == block:
+            self._chain.append(bit)
+        else:
+            si = self._idx[selected_parent]
+            self._chain.append(self._chain[si] | bit)
+
+    def add_block(self, block: bytes, parents: list[bytes], selected_parent: bytes) -> None:
+        """Insert a block; parents must already be present."""
+        self._add(block, parents, selected_parent)
+
+    def has(self, block: bytes) -> bool:
+        return block in self._idx
+
+    def is_dag_ancestor_of(self, this: bytes, queried: bytes) -> bool:
+        if this == queried:
+            return True
+        ti = self._idx[this]
+        return bool(self._past[self._idx[queried]] & self._bit[ti])
+
+    def is_dag_ancestor_of_any(self, this: bytes, queried_iter) -> bool:
+        return any(self.is_dag_ancestor_of(this, q) for q in queried_iter)
+
+    def is_any_dag_ancestor_of(self, list_iter, queried: bytes) -> bool:
+        return any(self.is_dag_ancestor_of(x, queried) for x in list_iter)
+
+    def is_chain_ancestor_of(self, this: bytes, queried: bytes) -> bool:
+        """this ∈ selected-parent chain(queried) (inclusive)."""
+        ti = self._idx[this]
+        return bool(self._chain[self._idx[queried]] & self._bit[ti])

@@ -1,0 +1,169 @@
+"""In-memory consensus stores.
+
+Mirrors the reference's store registry (consensus/src/model/stores/, 20
+stores aggregated in ConsensusStorage, consensus/src/consensus/storage.rs)
+with a pluggable in-memory backend.  The persistent (RocksDB-style C++ KV)
+backend slots behind the same interfaces in a later milestone; the store
+*interfaces* are the contract the pipeline codes against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.model import Header, Transaction
+
+
+@dataclass
+class GhostdagData:
+    """consensus/src/model/stores/ghostdag.rs GhostdagData."""
+
+    blue_score: int
+    blue_work: int
+    selected_parent: bytes
+    mergeset_blues: list[bytes]
+    mergeset_reds: list[bytes]
+    blues_anticone_sizes: dict[bytes, int]
+
+    def mergeset_size(self) -> int:
+        return len(self.mergeset_blues) + len(self.mergeset_reds)
+
+    def unordered_mergeset(self):
+        yield from self.mergeset_blues
+        yield from self.mergeset_reds
+
+    def unordered_mergeset_without_selected_parent(self):
+        yield from self.mergeset_blues[1:]
+        yield from self.mergeset_reds
+
+    def ascending_mergeset_without_selected_parent(self, gd_store):
+        """Mergeset (minus selected parent) ascending by (blue_work, hash)."""
+        return sorted(
+            self.unordered_mergeset_without_selected_parent(),
+            key=lambda h: (gd_store.get(h).blue_work, h),
+        )
+
+    def consensus_ordered_mergeset(self, gd_store):
+        return [self.selected_parent] + self.ascending_mergeset_without_selected_parent(gd_store)
+
+
+class HeaderStore:
+    def __init__(self):
+        self._headers: dict[bytes, Header] = {}
+
+    def insert(self, header: Header) -> None:
+        self._headers[header.hash] = header
+
+    def get(self, block: bytes) -> Header:
+        return self._headers[block]
+
+    def has(self, block: bytes) -> bool:
+        return block in self._headers
+
+    def get_bits(self, block: bytes) -> int:
+        return self._headers[block].bits
+
+    def get_timestamp(self, block: bytes) -> int:
+        return self._headers[block].timestamp
+
+    def get_blue_score(self, block: bytes) -> int:
+        return self._headers[block].blue_score
+
+    def get_daa_score(self, block: bytes) -> int:
+        return self._headers[block].daa_score
+
+
+class RelationsStore:
+    """Parent/child relations (level 0; higher levels added with pruning proofs)."""
+
+    def __init__(self):
+        self._parents: dict[bytes, list[bytes]] = {}
+        self._children: dict[bytes, list[bytes]] = {}
+
+    def insert(self, block: bytes, parents: list[bytes]) -> None:
+        self._parents[block] = list(parents)
+        self._children.setdefault(block, [])
+        for p in parents:
+            self._children.setdefault(p, []).append(block)
+
+    def get_parents(self, block: bytes) -> list[bytes]:
+        return self._parents[block]
+
+    def get_children(self, block: bytes) -> list[bytes]:
+        return self._children.get(block, [])
+
+    def has(self, block: bytes) -> bool:
+        return block in self._parents
+
+
+class GhostdagStore:
+    def __init__(self):
+        self._data: dict[bytes, GhostdagData] = {}
+
+    def insert(self, block: bytes, data: GhostdagData) -> None:
+        self._data[block] = data
+
+    def get(self, block: bytes) -> GhostdagData:
+        return self._data[block]
+
+    def has(self, block: bytes) -> bool:
+        return block in self._data
+
+    def get_blue_work(self, block: bytes) -> int:
+        return self._data[block].blue_work
+
+    def get_blue_score(self, block: bytes) -> int:
+        return self._data[block].blue_score
+
+    def get_selected_parent(self, block: bytes) -> bytes:
+        return self._data[block].selected_parent
+
+    def get_blues_anticone_sizes(self, block: bytes) -> dict[bytes, int]:
+        return self._data[block].blues_anticone_sizes
+
+
+class StatusesStore:
+    """Block statuses (consensus/src/model/stores/statuses.rs)."""
+
+    STATUS_INVALID = "invalid"
+    STATUS_UTXO_VALID = "utxo_valid"
+    STATUS_UTXO_PENDING_VERIFICATION = "utxo_pending"
+    STATUS_DISQUALIFIED = "disqualified"
+    STATUS_HEADER_ONLY = "header_only"
+
+    def __init__(self):
+        self._status: dict[bytes, str] = {}
+
+    def set(self, block: bytes, status: str) -> None:
+        self._status[block] = status
+
+    def get(self, block: bytes) -> str | None:
+        return self._status.get(block)
+
+    def is_valid(self, block: bytes) -> bool:
+        return self._status.get(block) in (self.STATUS_UTXO_VALID, self.STATUS_UTXO_PENDING_VERIFICATION, self.STATUS_HEADER_ONLY)
+
+
+class BlockTransactionsStore:
+    def __init__(self):
+        self._txs: dict[bytes, list[Transaction]] = {}
+
+    def insert(self, block: bytes, txs: list[Transaction]) -> None:
+        self._txs[block] = txs
+
+    def get(self, block: bytes) -> list[Transaction]:
+        return self._txs[block]
+
+    def has(self, block: bytes) -> bool:
+        return block in self._txs
+
+
+@dataclass
+class ConsensusStorage:
+    """Aggregation of all stores (consensus/src/consensus/storage.rs:38-83)."""
+
+    headers: HeaderStore = field(default_factory=HeaderStore)
+    relations: RelationsStore = field(default_factory=RelationsStore)
+    ghostdag: GhostdagStore = field(default_factory=GhostdagStore)
+    statuses: StatusesStore = field(default_factory=StatusesStore)
+    block_transactions: BlockTransactionsStore = field(default_factory=BlockTransactionsStore)

@@ -1,0 +1,102 @@
+"""UTXO collections, diffs and views.
+
+Reference: consensus/core/src/utxo/{utxo_collection,utxo_diff,utxo_view}.rs.
+A UtxoDiff is (add, remove) entry maps with reconciliation rules;
+views compose a base UTXO source with stacked diffs for O(1) lookups during
+mergeset replay.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.model import Transaction, TransactionOutpoint, UtxoEntry
+
+
+class UtxoAlgebraError(Exception):
+    pass
+
+
+class UtxoCollection(dict):
+    """outpoint -> UtxoEntry"""
+
+
+@dataclass
+class UtxoDiff:
+    add: UtxoCollection = field(default_factory=UtxoCollection)
+    remove: UtxoCollection = field(default_factory=UtxoCollection)
+
+    def add_entry(self, outpoint: TransactionOutpoint, entry: UtxoEntry) -> None:
+        # mirror utxo_diff.rs add_entry: cancel with remove set when daa scores match
+        if outpoint in self.remove and self.remove[outpoint].block_daa_score == entry.block_daa_score:
+            del self.remove[outpoint]
+        elif outpoint not in self.add:
+            self.add[outpoint] = entry
+        else:
+            raise UtxoAlgebraError(f"double add call for {outpoint}")
+
+    def remove_entry(self, outpoint: TransactionOutpoint, entry: UtxoEntry) -> None:
+        if outpoint in self.add and self.add[outpoint].block_daa_score == entry.block_daa_score:
+            del self.add[outpoint]
+        elif outpoint not in self.remove:
+            self.remove[outpoint] = entry
+        else:
+            raise UtxoAlgebraError(f"double remove call for {outpoint}")
+
+    def add_transaction(self, tx: Transaction, utxo_entries, block_daa_score: int) -> None:
+        """Spend the tx inputs and add its outputs (utxo_diff.rs:224-244)."""
+        for inp, entry in zip(tx.inputs, utxo_entries):
+            self.remove_entry(inp.previous_outpoint, entry)
+        is_coinbase = tx.is_coinbase()
+        tx_id = tx.id()
+        for i, output in enumerate(tx.outputs):
+            entry = UtxoEntry(
+                output.value,
+                output.script_public_key,
+                block_daa_score,
+                is_coinbase,
+                output.covenant.covenant_id if output.covenant is not None else None,
+            )
+            self.add_entry(TransactionOutpoint(tx_id, i), entry)
+
+    def clone(self) -> "UtxoDiff":
+        return UtxoDiff(UtxoCollection(self.add), UtxoCollection(self.remove))
+
+
+class UtxoView:
+    """Layered view: base mapping composed with a diff (utxo_view.rs)."""
+
+    def __init__(self, base, diff: UtxoDiff):
+        self.base = base
+        self.diff = diff
+
+    def get(self, outpoint: TransactionOutpoint):
+        if outpoint in self.diff.add:
+            return self.diff.add[outpoint]
+        if outpoint in self.diff.remove:
+            return None
+        if isinstance(self.base, UtxoView):
+            return self.base.get(outpoint)
+        return self.base.get(outpoint)
+
+    def compose(self, diff: UtxoDiff) -> "UtxoView":
+        return UtxoView(self, diff)
+
+
+def compose(base, diff: UtxoDiff) -> UtxoView:
+    return UtxoView(base, diff)
+
+
+def apply_diff(utxo_set: UtxoCollection, diff: UtxoDiff) -> None:
+    """In-place application of a diff to a full UTXO set."""
+    for outpoint in diff.remove:
+        del utxo_set[outpoint]
+    for outpoint, entry in diff.add.items():
+        utxo_set[outpoint] = entry
+
+
+def unapply_diff(utxo_set: UtxoCollection, diff: UtxoDiff) -> None:
+    for outpoint in diff.add:
+        del utxo_set[outpoint]
+    for outpoint, entry in diff.remove.items():
+        utxo_set[outpoint] = entry

@@ -1,0 +1,127 @@
+"""Pure-python BLAKE3 (keyed mode) — host-side, spec implementation.
+
+Needed for the reference's blake3-keyed domain hashers
+(crypto/hashes/src/hashers.rs:39-55,120-151): v1 transaction ids and the
+KIP-21 SeqCommit commitments.  Keys are the domain string zero-padded to 32
+bytes.  One-shot oriented (consensus preimages are small); a batched JAX
+kernel can replace the compression loop if SeqCommit volume ever warrants.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_IV = (0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A, 0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19)
+_PERM = (2, 6, 3, 10, 7, 0, 4, 13, 1, 11, 12, 5, 9, 14, 15, 8)
+
+CHUNK_START = 1
+CHUNK_END = 2
+PARENT = 4
+ROOT = 8
+KEYED_HASH = 16
+
+_CHUNK_LEN = 1024
+_BLOCK_LEN = 64
+_M32 = 0xFFFFFFFF
+
+
+def _rotr(x, n):
+    return ((x >> n) | (x << (32 - n))) & _M32
+
+
+def _compress(cv, block_words, counter, block_len, flags):
+    v = list(cv) + [_IV[0], _IV[1], _IV[2], _IV[3], counter & _M32, (counter >> 32) & _M32, block_len, flags]
+    m = list(block_words)
+
+    def g(a, b, c, d, mx, my):
+        v[a] = (v[a] + v[b] + mx) & _M32
+        v[d] = _rotr(v[d] ^ v[a], 16)
+        v[c] = (v[c] + v[d]) & _M32
+        v[b] = _rotr(v[b] ^ v[c], 12)
+        v[a] = (v[a] + v[b] + my) & _M32
+        v[d] = _rotr(v[d] ^ v[a], 8)
+        v[c] = (v[c] + v[d]) & _M32
+        v[b] = _rotr(v[b] ^ v[c], 7)
+
+    for r in range(7):
+        g(0, 4, 8, 12, m[0], m[1])
+        g(1, 5, 9, 13, m[2], m[3])
+        g(2, 6, 10, 14, m[4], m[5])
+        g(3, 7, 11, 15, m[6], m[7])
+        g(0, 5, 10, 15, m[8], m[9])
+        g(1, 6, 11, 12, m[10], m[11])
+        g(2, 7, 8, 13, m[12], m[13])
+        g(3, 4, 9, 14, m[14], m[15])
+        if r < 6:
+            m = [m[_PERM[i]] for i in range(16)]
+
+    return [(v[i] ^ v[i + 8]) & _M32 for i in range(8)] + [(v[i + 8] ^ cv[i]) & _M32 for i in range(8)]
+
+
+def _words(block: bytes):
+    return struct.unpack("<16I", block.ljust(64, b"\x00"))
+
+
+def _chunk_cv(key_words, chunk: bytes, chunk_index: int, base_flags: int, is_root: bool):
+    blocks = [chunk[i : i + _BLOCK_LEN] for i in range(0, len(chunk), _BLOCK_LEN)] or [b""]
+    cv = list(key_words)
+    for bi, block in enumerate(blocks):
+        flags = base_flags
+        if bi == 0:
+            flags |= CHUNK_START
+        if bi == len(blocks) - 1:
+            flags |= CHUNK_END
+            if is_root:
+                flags |= ROOT
+        cv = _compress(cv, _words(block), chunk_index, len(block), flags)[:8]
+    return cv
+
+
+def blake3_keyed(key32: bytes, data: bytes) -> bytes:
+    """BLAKE3 keyed hash, 32-byte output."""
+    assert len(key32) == 32
+    key_words = struct.unpack("<8I", key32)
+    base = KEYED_HASH
+    chunks = [data[i : i + _CHUNK_LEN] for i in range(0, len(data), _CHUNK_LEN)] or [b""]
+    if len(chunks) == 1:
+        cv = _chunk_cv(key_words, chunks[0], 0, base, is_root=True)
+        return struct.pack("<8I", *cv)
+    cvs = [_chunk_cv(key_words, c, i, base, is_root=False) for i, c in enumerate(chunks)]
+    # left-complete binary tree: combine adjacent pairs, odd tail carries up
+    while len(cvs) > 2:
+        nxt = [
+            _compress(key_words, tuple(cvs[i] + cvs[i + 1]), 0, _BLOCK_LEN, base | PARENT)[:8]
+            for i in range(0, len(cvs) - 1, 2)
+        ]
+        if len(cvs) % 2:
+            nxt.append(cvs[-1])
+        cvs = nxt
+    root = _compress(key_words, tuple(cvs[0] + cvs[1]), 0, _BLOCK_LEN, base | PARENT | ROOT)[:8]
+    return struct.pack("<8I", *root)
+
+
+def domain_key(domain: bytes) -> bytes:
+    assert len(domain) <= 32
+    return domain.ljust(32, b"\x00")
+
+
+def keyed_hash(domain: bytes, data: bytes) -> bytes:
+    return blake3_keyed(domain_key(domain), data)
+
+
+class Blake3Keyed:
+    """Incremental facade (buffers; compresses on digest)."""
+
+    def __init__(self, domain: bytes):
+        self._key = domain_key(domain)
+        self._buf = bytearray()
+
+    def update(self, data: bytes):
+        self._buf += data
+        return self
+
+    def digest(self) -> bytes:
+        return blake3_keyed(self._key, bytes(self._buf))
+
+
+PAYLOAD_ZERO_DIGEST = keyed_hash(b"PayloadDigest", b"")

@@ -1,0 +1,61 @@
+"""Domain-separated hashers (host side).
+
+Mirrors the reference's hasher registry (crypto/hashes/src/hashers.rs:22-55):
+- Blake2b-256 keyed by the domain string (blake2b_simd keyed mode ==
+  hashlib.blake2b(key=..., digest_size=32)).
+- SHA-256 prefixed once with SHA256(domain) (sha256_hasher macro).
+- cSHAKE256-based PoW hashers live in kaspa_tpu/crypto/powhash.py.
+- Blake3-keyed SeqCommit hashers (KIP-21) live in kaspa_tpu/crypto/blake3.py.
+
+Hashes are plain 32-byte ``bytes``; hex display is the natural byte order
+(crypto/hashes/src/lib.rs FromStr/Display).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+HASH_SIZE = 32
+ZERO_HASH = b"\x00" * HASH_SIZE
+
+
+def _blake2b_domain(domain: bytes):
+    def new():
+        return hashlib.blake2b(key=domain, digest_size=HASH_SIZE)
+
+    return new
+
+
+TransactionHash = _blake2b_domain(b"TransactionHash")
+TransactionID = _blake2b_domain(b"TransactionID")
+TransactionSigningHash = _blake2b_domain(b"TransactionSigningHash")
+BlockHash = _blake2b_domain(b"BlockHash")
+MerkleBranchHash = _blake2b_domain(b"MerkleBranchHash")
+MuHashElementHash = _blake2b_domain(b"MuHashElement")
+MuHashFinalizeHash = _blake2b_domain(b"MuHashFinalize")
+PersonalMessageSigningHash = _blake2b_domain(b"PersonalMessageSigningHash")
+CovenantID = _blake2b_domain(b"CovenantID")
+
+_ECDSA_DOMAIN_HASH = hashlib.sha256(b"TransactionSigningHashECDSA").digest()
+
+
+def TransactionSigningHashECDSA():
+    """SHA256 prefixed with SHA256(domain) — hashers.rs sha256_hasher macro."""
+    h = hashlib.sha256()
+    h.update(_ECDSA_DOMAIN_HASH)
+    return h
+
+
+def hash_to_hex(h: bytes) -> str:
+    return h.hex()
+
+
+def hex_to_hash(s: str) -> bytes:
+    b = bytes.fromhex(s)
+    assert len(b) == HASH_SIZE
+    return b
+
+
+def hash_from_u64_word(word: int) -> bytes:
+    """Hash::from_u64_word: the word occupies the highest little-endian u64."""
+    return b"\x00" * 24 + word.to_bytes(8, "little")

@@ -1,0 +1,53 @@
+"""Binary merkle root (reference: crypto/merkle/src/lib.rs:13-52).
+
+Leaves padded to a power of two; a present-left/absent-right pair hashes
+with ZERO_HASH as the right sibling; fully absent pairs propagate absence.
+Empty input -> ZERO_HASH; single leaf -> itself.
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.crypto import hashing as h
+
+
+def merkle_hash(left: bytes, right: bytes, hasher_factory=h.MerkleBranchHash) -> bytes:
+    hasher = hasher_factory()
+    hasher.update(left)
+    hasher.update(right)
+    return hasher.digest()
+
+
+def calc_merkle_root(hashes: list, hasher_factory=h.MerkleBranchHash) -> bytes:
+    if not hashes:
+        return h.ZERO_HASH
+    level = list(hashes)
+    if len(level) == 1:
+        return level[0]
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level), 2):
+            left = level[i]
+            right = level[i + 1] if i + 1 < len(level) else None
+            if left is None:
+                nxt.append(None)
+            else:
+                nxt.append(merkle_hash(left, right if right is not None else h.ZERO_HASH, hasher_factory))
+        level = nxt
+    return level[0]
+
+
+def calc_hash_merkle_root(txs) -> bytes:
+    """Merkle root over tx hashes (consensus/core/src/merkle.rs)."""
+    from kaspa_tpu.consensus import hashing as chash
+
+    return calc_merkle_root([chash.tx_hash(tx) for tx in txs])
+
+
+def calc_hash_merkle_root_pre_crescendo(txs) -> bytes:
+    from kaspa_tpu.consensus import hashing as chash
+
+    return calc_merkle_root([chash.tx_hash_pre_crescendo(tx) for tx in txs])
+
+
+def calc_accepted_id_merkle_root_pre_crescendo(accepted_tx_ids: list) -> bytes:
+    return calc_merkle_root(sorted(accepted_tx_ids))

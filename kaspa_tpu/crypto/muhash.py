@@ -1,0 +1,126 @@
+"""MuHash: homomorphic multiset hash for UTXO commitments.
+
+Re-implementation of the reference's kaspa-muhash (crypto/muhash/src/lib.rs,
+u3072.rs) + the consensus extensions (consensus/core/src/muhash.rs):
+
+- element = Blake2b("MuHashElement") -> ChaCha20 keystream (384 bytes) ->
+  3072-bit little-endian integer in GF(2**3072 - 1103717)
+- add = numerator *= elem; remove = denominator *= elem; combine = pairwise
+- finalize = normalize (denominator inverse) -> 384-byte LE ->
+  Blake2b("MuHashFinalize")
+
+The host object keeps exact python-int accumulators (cheap at 3072 bits);
+bulk diffs route through the TPU tree-product kernel (ops/muhash_ops.py)
+whose result combines into the accumulator with one multiply.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kaspa_tpu.crypto import chacha
+from kaspa_tpu.crypto import hashing as h
+
+ELEMENT_BYTE_SIZE = 384
+PRIME = 2**3072 - 1103717  # u3072.rs:22
+
+
+def element_hashes_to_ints(hashes: np.ndarray) -> list[int]:
+    """[N, 32] uint8 element hashes -> N field elements (vectorised chacha)."""
+    ks = chacha.keystream(hashes, ELEMENT_BYTE_SIZE)
+    return [int.from_bytes(ks[i].tobytes(), "little") % PRIME for i in range(ks.shape[0])]
+
+
+def data_to_element(data: bytes) -> int:
+    hasher = h.MuHashElementHash()
+    hasher.update(data)
+    digest = np.frombuffer(hasher.digest(), dtype=np.uint8).reshape(1, 32)
+    return element_hashes_to_ints(digest)[0]
+
+
+def serialize_utxo(outpoint, entry) -> bytes:
+    """Element preimage for a UTXO (consensus/core/src/muhash.rs write_utxo)."""
+    out = bytearray()
+    out += outpoint.transaction_id
+    out += outpoint.index.to_bytes(4, "little")
+    out += entry.block_daa_score.to_bytes(8, "little")
+    out += entry.amount.to_bytes(8, "little")
+    out += b"\x01" if entry.is_coinbase else b"\x00"
+    out += entry.script_public_key.version.to_bytes(2, "little")
+    out += len(entry.script_public_key.script).to_bytes(8, "little")
+    out += entry.script_public_key.script
+    if entry.covenant_id is not None:
+        out += entry.covenant_id
+    return bytes(out)
+
+
+class MuHash:
+    __slots__ = ("numerator", "denominator")
+
+    def __init__(self, numerator: int = 1, denominator: int = 1):
+        self.numerator = numerator
+        self.denominator = denominator
+
+    def add_element(self, data: bytes) -> None:
+        self.numerator = self.numerator * data_to_element(data) % PRIME
+
+    def remove_element(self, data: bytes) -> None:
+        self.denominator = self.denominator * data_to_element(data) % PRIME
+
+    def combine(self, other: "MuHash") -> None:
+        self.numerator = self.numerator * other.numerator % PRIME
+        self.denominator = self.denominator * other.denominator % PRIME
+
+    def normalize(self) -> None:
+        if self.denominator != 1:
+            self.numerator = self.numerator * pow(self.denominator, -1, PRIME) % PRIME
+            self.denominator = 1
+
+    def serialize(self) -> bytes:
+        self.normalize()
+        return self.numerator.to_bytes(ELEMENT_BYTE_SIZE, "little")
+
+    @staticmethod
+    def deserialize(data: bytes) -> "MuHash":
+        assert len(data) == ELEMENT_BYTE_SIZE
+        v = int.from_bytes(data, "little")
+        if v >= PRIME:
+            raise OverflowError("Overflow in the MuHash field")
+        return MuHash(v)
+
+    def finalize(self) -> bytes:
+        hasher = h.MuHashFinalizeHash()
+        hasher.update(self.serialize())
+        return hasher.digest()
+
+    def clone(self) -> "MuHash":
+        return MuHash(self.numerator, self.denominator)
+
+    # --- consensus extensions (consensus/core/src/muhash.rs) ---
+
+    def add_utxo(self, outpoint, entry) -> None:
+        self.add_element(serialize_utxo(outpoint, entry))
+
+    def remove_utxo(self, outpoint, entry) -> None:
+        self.remove_element(serialize_utxo(outpoint, entry))
+
+    def add_transaction(self, tx, utxo_entries, block_daa_score: int) -> None:
+        """Remove spent entries, add created outputs (muhash.rs:16-34)."""
+        from kaspa_tpu.consensus.model import TransactionOutpoint, UtxoEntry
+
+        tx_id = tx.id()
+        for inp, entry in zip(tx.inputs, utxo_entries):
+            self.remove_element(serialize_utxo(inp.previous_outpoint, entry))
+        for i, output in enumerate(tx.outputs):
+            outpoint = TransactionOutpoint(tx_id, i)
+            entry = UtxoEntry(
+                output.value,
+                output.script_public_key,
+                block_daa_score,
+                tx.is_coinbase(),
+                output.covenant.covenant_id if output.covenant is not None else None,
+            )
+            self.add_element(serialize_utxo(outpoint, entry))
+
+
+EMPTY_MUHASH = MuHash().finalize()

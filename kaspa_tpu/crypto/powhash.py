@@ -1,0 +1,236 @@
+"""Proof-of-work hashing: cSHAKE256 PowHash + HeavyHash matrix.
+
+Reference: crypto/hashes/src/pow_hashers.rs (cSHAKE256 with customization
+strings "ProofOfWorkHash" / "HeavyHash", single keccak-f[1600] permutation
+per hash since inputs fit one rate block) and consensus/pow/src/
+{lib.rs,matrix.rs,xoshiro.rs} (the 64x64 nibble matrix, rank-checked,
+xoshiro256++-seeded from the pre-PoW hash).
+
+The keccak permutation is implemented from the FIPS-202 spec; the cSHAKE
+prefix state is derived per NIST SP 800-185 (bytepad(encode_string("") ||
+encode_string(S), 136)) — equivalent to the reference's precomputed
+initial states, which we re-derive rather than copy.
+"""
+
+from __future__ import annotations
+
+import struct
+
+M64 = (1 << 64) - 1
+
+_ROTC = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+
+def _rotl(x, n):
+    return ((x << n) | (x >> (64 - n))) & M64
+
+
+def keccak_f1600(state: list[int]) -> list[int]:
+    """FIPS-202 permutation on 25 lanes (5x5, lane (x,y) at index x + 5y)."""
+    a = list(state)
+    for rc in _RC:
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for i in range(25):
+            a[i] ^= d[i % 5]
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], _ROTC[x][y])
+        for y in range(5):
+            row = b[5 * y : 5 * y + 5]
+            for x in range(5):
+                a[x + 5 * y] = row[x] ^ ((~row[(x + 1) % 5] & M64) & row[(x + 2) % 5])
+        a[0] ^= rc
+    return a
+
+
+def _left_encode(n: int) -> bytes:
+    b = n.to_bytes((n.bit_length() + 7) // 8 or 1, "big")
+    return bytes([len(b)]) + b
+
+
+def _encode_string(s: bytes) -> bytes:
+    return _left_encode(len(s) * 8) + s
+
+
+def _bytepad(data: bytes, w: int) -> bytes:
+    out = _left_encode(w) + data
+    if len(out) % w:
+        out += b"\x00" * (w - len(out) % w)
+    return out
+
+
+RATE = 136  # cSHAKE256 / SHA3-256-family rate for 512-bit capacity
+
+
+def cshake256_initial_state(customization: bytes) -> list[int]:
+    """State after absorbing the cSHAKE prefix block (N="", S=custom)."""
+    prefix = _bytepad(_encode_string(b"") + _encode_string(customization), RATE)
+    assert len(prefix) == RATE
+    state = [0] * 25
+    words = struct.unpack("<17Q", prefix)
+    for i, w in enumerate(words):
+        state[i] ^= w
+    return keccak_f1600(state)
+
+
+_POW_STATE = None
+_HEAVY_STATE = None
+
+
+def _pow_state():
+    global _POW_STATE
+    if _POW_STATE is None:
+        _POW_STATE = cshake256_initial_state(b"ProofOfWorkHash")
+    return _POW_STATE
+
+
+def _heavy_state():
+    global _HEAVY_STATE
+    if _HEAVY_STATE is None:
+        _HEAVY_STATE = cshake256_initial_state(b"HeavyHash")
+    return _HEAVY_STATE
+
+
+def _absorb_fixed_80(initial: list[int], data80: bytes) -> bytes:
+    """Absorb an 80-byte message + cSHAKE padding into a copy of `initial`,
+    then squeeze 32 bytes.  80 bytes < RATE so one permutation suffices
+    (mirrors PowHash::finalize_with_nonce, pow_hashers.rs:23-38)."""
+    state = list(initial)
+    words = struct.unpack("<10Q", data80)
+    for i, w in enumerate(words):
+        state[i] ^= w
+    state[10] ^= 0x04  # cSHAKE domain padding byte at position 80
+    state[16] ^= 1 << 63  # final bit of the rate block
+    state = keccak_f1600(state)
+    return struct.pack("<4Q", *state[:4])
+
+
+def pow_hash(pre_pow_hash: bytes, timestamp: int, nonce: int) -> bytes:
+    data = pre_pow_hash + timestamp.to_bytes(8, "little") + b"\x00" * 32 + nonce.to_bytes(8, "little")
+    return _absorb_fixed_80(_pow_state(), data)
+
+
+def heavy_hash(in_hash: bytes) -> bytes:
+    """cSHAKE256("HeavyHash") of 32 bytes (single block)."""
+    state = list(_heavy_state())
+    words = struct.unpack("<4Q", in_hash)
+    for i, w in enumerate(words):
+        state[i] ^= w
+    state[4] ^= 0x04  # padding byte at position 32
+    state[16] ^= 1 << 63
+    state = keccak_f1600(state)
+    return struct.pack("<4Q", *state[:4])
+
+
+# --- xoshiro256++ and the HeavyHash matrix (consensus/pow/src/) ---
+
+
+class Xoshiro256PlusPlus:
+    def __init__(self, hash32: bytes):
+        self.s = list(struct.unpack("<4Q", hash32))
+
+    def next_u64(self) -> int:
+        s = self.s
+        res = (_rotl((s[0] + s[3]) & M64, 23) + s[0]) & M64
+        t = (s[1] << 17) & M64
+        s[2] ^= s[0]
+        s[3] ^= s[1]
+        s[1] ^= s[2]
+        s[0] ^= s[3]
+        s[2] ^= t
+        s[3] = _rotl(s[3], 45)
+        return res
+
+
+class Matrix:
+    """64x64 matrix of 4-bit values, generated until full rank (matrix.rs)."""
+
+    def __init__(self, rows: list[list[int]]):
+        self.rows = rows
+
+    @staticmethod
+    def generate(pre_pow_hash: bytes) -> "Matrix":
+        gen = Xoshiro256PlusPlus(pre_pow_hash)
+        while True:
+            rows = [[0] * 64 for _ in range(64)]
+            for i in range(64):
+                for j in range(0, 64, 16):
+                    val = gen.next_u64()
+                    for shift in range(16):
+                        rows[i][j + shift] = (val >> (4 * shift)) & 0x0F
+            m = Matrix(rows)
+            if m.compute_rank() == 64:
+                return m
+
+    def compute_rank(self) -> int:
+        eps = 1e-9
+        mat = [[float(v) for v in row] for row in self.rows]
+        rank = 0
+        row_selected = [False] * 64
+        for i in range(64):
+            j = next((j for j in range(64) if not row_selected[j] and abs(mat[j][i]) > eps), None)
+            if j is None:
+                continue
+            rank += 1
+            row_selected[j] = True
+            for k in range(i + 1, 64):
+                mat[j][k] /= mat[j][i]
+            for k in range(64):
+                if k != j and abs(mat[k][i]) > eps:
+                    for l in range(i + 1, 64):
+                        mat[k][l] -= mat[j][l] * mat[k][i]
+        return rank
+
+    def heavy_hash(self, hash32: bytes) -> bytes:
+        # convert hash to 64 nibbles (big-nibble first per byte)
+        v = []
+        for byte in hash32:
+            v.append(byte >> 4)
+            v.append(byte & 0x0F)
+        products = []
+        for i in range(64):
+            s = 0
+            row = self.rows[i]
+            for j in range(64):
+                s += row[j] * v[j]
+            products.append((s >> 10) & 0x0F)
+        # XOR the product nibbles back into the hash bytes
+        out = bytearray(hash32)
+        for i in range(32):
+            out[i] ^= (products[2 * i] << 4) | products[2 * i + 1]
+        return heavy_hash(bytes(out))
+
+
+def calc_block_pow_hash(header) -> bytes:
+    """Full PoW value of a header (pow/src/lib.rs State::calculate_pow)."""
+    from kaspa_tpu.consensus import hashing as chash
+
+    pre_pow = chash.header_hash_override_nonce_time(header, 0, 0)
+    matrix = Matrix.generate(pre_pow)
+    first = pow_hash(pre_pow, header.timestamp, header.nonce)
+    return matrix.heavy_hash(first)
+
+
+def check_pow(header) -> bool:
+    """pow/src/lib.rs State::check_pow: PoW value (as LE uint) <= target."""
+    from kaspa_tpu.consensus.difficulty import compact_to_target
+
+    target = compact_to_target(header.bits)
+    value = int.from_bytes(calc_block_pow_hash(header), "little")
+    return value <= target

@@ -128,16 +128,31 @@ def _conv_matrix_np(k: int):
     return m
 
 
+@functools.lru_cache(maxsize=None)
+def _block_collect_np(nb: int):
+    """[nb*nb, 2nb-1] one-hot: block pair (i,j) -> result block i+j."""
+    m = np.zeros((nb * nb, 2 * nb - 1), np.int32)
+    for i in range(nb):
+        for j in range(nb):
+            m[i * nb + j, i + j] = 1
+    return m
+
+
+_BLK = 32  # 8-bit limbs per block in the blocked schoolbook
+
+
 def _poly_mul8(a8, b8):
     """Schoolbook column products of two 8-bit-split operands.
 
-    [..., K] x [..., K] -> [..., 2K] columns in the 2**8 radix (col 2K-1
-    unused headroom). Column magnitudes < 2K * 2**20 < 2**31 for K <= 512.
+    [..., K] x [..., K] -> [..., 2K (+pad)] columns in the 2**8 radix.
+    Column magnitudes < 2K * 2**20 < 2**31 for K <= 512.
 
     Small widths contract the outer-product against a one-hot matrix in a
-    single dot (one fat op: XLA fuses the product into the matmul operand,
-    minimising HBM round-trips and HLO size). Large widths (muhash) use the
-    shift-accumulate loop to avoid the k**2-sized intermediate.
+    single dot (XLA fuses the product into the matmul operand, minimising
+    HBM round-trips and HLO size).  Large widths (muhash U3072) use a
+    blocked schoolbook: all nb*nb block pairs go through the same 32-wide
+    contraction in one shot, then a second one-hot dot collects block pairs
+    into result blocks — two fat ops instead of K dynamic-slice updates.
     """
     k = a8.shape[-1]
     if k <= 64:
@@ -146,10 +161,28 @@ def _poly_mul8(a8, b8):
         return jax.lax.dot_general(
             p, m, (((p.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.int32
         )
-    out = jnp.zeros((*a8.shape[:-1], 2 * k), dtype=jnp.int32)
-    for j in range(k):
-        out = out.at[..., j : j + k].add(a8 * b8[..., j : j + 1])
-    return out
+    assert k % _BLK == 0, "large operands must be a multiple of the block size"
+    nb = k // _BLK
+    lead = a8.shape[:-1]
+    ab = a8.reshape(*lead, nb, _BLK)
+    bb = b8.reshape(*lead, nb, _BLK)
+    # all block-pair products through one 32-wide contraction
+    m = jnp.asarray(_conv_matrix_np(_BLK))  # [blk*blk, 2blk]
+    p = (ab[..., :, None, :, None] * bb[..., None, :, None, :]).reshape(*lead, nb * nb, _BLK * _BLK)
+    c = jax.lax.dot_general(p, m, (((p.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+    # collect pair results into blocks k = i + j  (sums of <= nb products:
+    # per-column bound nb * blk * 2**20 <= 2**31 for nb <= 16, blk = 32
+    # ... tighter: blk*2**20 per pair, nb pairs -> nb*2**25; nb<=12 ok)
+    coll = jnp.asarray(_block_collect_np(nb))
+    d = jax.lax.dot_general(
+        c, coll, (((c.ndim - 2,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )  # [..., 2blk, 2nb-1]
+    d = jnp.moveaxis(d, -1, -2)  # [..., 2nb-1, 2blk]
+    # overlap-add the two halves of each block result (phase offset blk)
+    out = jnp.zeros((*lead, 2 * nb + 1, _BLK), dtype=jnp.int32)
+    out = out.at[..., : 2 * nb - 1, :].add(d[..., :_BLK])
+    out = out.at[..., 1 : 2 * nb, :].add(d[..., _BLK:])
+    return out.reshape(*lead, (2 * nb + 1) * _BLK)
 
 
 def _pair_columns(cols8):

@@ -1,0 +1,72 @@
+"""Batched U3072 product on device: the muhash bulk-diff kernel.
+
+The reference reduces muhash over txs with a rayon map-reduce
+(consensus/src/pipeline/virtual_processor/utxo_validation.rs:334-363,
+crypto/muhash/src/lib.rs:87-90 `combine`).  Here the monoid product of a
+batch of 3072-bit field elements is a jax.lax tree reduction (log2(N)
+levels of pairwise modular multiplies) — the multiplies vectorise over the
+shrinking batch, keeping the VPU busy at every level.
+
+Elements enter as [N, 192] int32 limb arrays (see ops/bigint.int_to_limbs);
+N is padded to a power of two with ones (the monoid identity).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaspa_tpu.ops import bigint as bi
+
+F = bi.F3072
+
+
+# Fixed batch buckets: one jit compile per bucket size (the 3072-bit mul
+# body is large, so unbounded shape-polymorphism would hammer compile time).
+BUCKETS = (64, 1024)
+
+
+@functools.partial(jax.jit, static_argnames=("levels",))
+def _tree_product(x, levels: int):
+    for _ in range(levels):
+        half = x.shape[0] // 2
+        x = bi.mul(F, x[:half], x[half:])
+    return bi.canon(F, x[0])
+
+
+def batch_product_device(elements: np.ndarray) -> int:
+    """[N, 192] int32 limbs -> product mod 2**3072 - 1103717 (python int).
+
+    Batches larger than the biggest bucket are reduced bucket-by-bucket with
+    the partial products combined on host (cheap: one 3072-bit mul each).
+    """
+    n = elements.shape[0]
+    if n == 0:
+        return 1
+    result = 1
+    pos = 0
+    while pos < n:
+        remaining = n - pos
+        # largest bucket that fits the remainder, else the smallest bucket
+        # (padded with identity) — keeps the set of compiled shapes tiny
+        fitting = [b for b in BUCKETS if b <= remaining]
+        bucket = fitting[-1] if fitting else BUCKETS[0]
+        chunk = elements[pos : pos + min(bucket, remaining)]
+        levels = bucket.bit_length() - 1
+        padded = np.tile(np.asarray(F.one, dtype=np.int32), (bucket, 1))
+        padded[: chunk.shape[0]] = chunk
+        out = _tree_product(jnp.asarray(padded), levels)
+        result = result * bi.limbs_to_int(np.asarray(out)) % F.modulus
+        pos += chunk.shape[0]
+    return result
+
+
+def ints_to_elements(vals: list[int]) -> np.ndarray:
+    return bi.ints_to_limbs(vals, F.W).astype(np.int32)
+
+
+def batch_product_ints(vals: list[int]) -> int:
+    return batch_product_device(ints_to_elements(vals))

@@ -1,0 +1,161 @@
+"""simpa-equivalent DAG simulator + validation replay benchmark.
+
+Mirrors the reference's simpa tool (simpa/src/): a discrete-event
+virtual-time network of miners produces a DAG at a target BPS with a
+simulated propagation delay (concurrent miners see each other's blocks
+late — this is what creates the blue/red merge structure), with real
+schnorr-signed P2PK transactions; then the produced DAG is replayed into a
+*fresh* consensus, measuring validation wall-clock — the canonical
+validation-throughput harness (simpa/src/main.rs:327-345).
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import time
+from dataclasses import dataclass
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.consensus.model.tx import ComputeCommit, SUBNETWORK_ID_NATIVE
+from kaspa_tpu.consensus.params import Params, simnet_params
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.txscript import standard
+
+
+@dataclass
+class SimConfig:
+    bps: int = 2
+    delay: float = 2.0  # seconds propagation delay
+    num_miners: int = 4
+    num_blocks: int = 64
+    txs_per_block: int = 8
+    seed: int = 42
+
+
+@dataclass
+class SimResult:
+    blocks: list
+    params: Params
+    build_seconds: float
+    total_txs: int
+    sink: bytes
+    virtual_daa_score: int
+
+
+class Miner:
+    def __init__(self, idx: int, rng: random.Random):
+        self.idx = idx
+        self.seckey = rng.randrange(1, eclib.N)
+        self.pubkey = eclib.schnorr_pubkey(self.seckey)
+        self.spk = standard.pay_to_pub_key(self.pubkey)
+        self.miner_data = MinerData(self.spk, extra_data=f"miner-{idx}".encode())
+
+
+def _make_tx(miner: Miner, outpoint, entry, rng: random.Random) -> Transaction:
+    """Spend one UTXO back to the miner (split in two) with a real signature."""
+    half = entry.amount // 2
+    if half == 0:
+        return None
+    outputs = [TransactionOutput(half, miner.spk), TransactionOutput(entry.amount - half, miner.spk)]
+    inp = TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))
+    tx = Transaction(0, [inp], outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+    sig = eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32))
+    tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+    tx._id_cache = None
+    return tx
+
+
+def simulate(cfg: SimConfig) -> SimResult:
+    """Build a DAG with one authoritative consensus + per-miner delayed views."""
+    rng = random.Random(cfg.seed)
+    params = simnet_params(bps=cfg.bps)
+    consensus = Consensus(params)
+    miners = [Miner(i, rng) for i in range(cfg.num_miners)]
+
+    t0 = time.perf_counter()
+    events = []
+    seq = 0
+    lam = cfg.bps / cfg.num_miners
+    for m in miners:
+        events.append((rng.expovariate(lam), seq, m.idx))
+        seq += 1
+    heapq.heapify(events)
+
+    mined: dict[bytes, tuple[float, int]] = {params.genesis.hash: (-cfg.delay, -1)}  # block -> (mine time, miner)
+    total_txs = 0
+    blocks: list[Block] = []
+
+    while len(blocks) < cfg.num_blocks:
+        vtime, _, midx = heapq.heappop(events)
+        miner = miners[midx]
+        # a block is visible to this miner if it mined it, or it propagated
+        visible = {h for h, (at, owner) in mined.items() if owner == midx or at + cfg.delay <= vtime}
+        tips = [h for h in visible if not any(c in visible for c in consensus.storage.relations.get_children(h))]
+        tips.sort(key=lambda h: (consensus.storage.ghostdag.get_blue_work(h), h), reverse=True)
+        parents = tips[: params.max_block_parents]
+
+        def tx_selector(view, pov_daa_score, miner=miner):
+            txs = []
+            spent = set()
+            base_items = list(view.diff.add.items())
+            # walk the layered view: diff adds first, then underlying set
+            under = view.base
+            while hasattr(under, "base"):
+                base_items += list(under.diff.add.items())
+                under = under.base
+            base_items += list(under.items())
+            removed = set(view.diff.remove.keys())
+            for outpoint, entry in base_items:
+                if len(txs) >= cfg.txs_per_block:
+                    break
+                if outpoint in spent or outpoint in removed:
+                    continue
+                if view.get(outpoint) is None:
+                    continue
+                if entry.script_public_key != miner.spk:
+                    continue
+                if entry.is_coinbase and entry.block_daa_score + params.coinbase_maturity > pov_daa_score:
+                    continue
+                tx = _make_tx(miner, outpoint, entry, rng)
+                if tx is not None:
+                    txs.append(tx)
+                    spent.add(outpoint)
+            return txs
+
+        block = consensus.build_block_with_parents(
+            parents, miner.miner_data, timestamp=int(vtime * 1000) + 1, tx_selector=tx_selector
+        )
+        status = consensus.validate_and_insert_block(block)
+        assert status in ("utxo_valid", "utxo_pending"), f"built block rejected: {status}"
+        blocks.append(block)
+        total_txs += len(block.transactions) - 1
+        mined[block.hash] = (vtime, midx)
+
+        heapq.heappush(events, (vtime + rng.expovariate(lam), seq, midx))
+        seq += 1
+
+    build_seconds = time.perf_counter() - t0
+    return SimResult(
+        blocks, params, build_seconds, total_txs, consensus.sink(), consensus.get_virtual_daa_score()
+    )
+
+
+def replay(result: SimResult) -> tuple[float, Consensus]:
+    """Replay the DAG into a fresh consensus; returns (wall seconds, consensus)
+    — the simpa validation benchmark, with end-state equivalence checks."""
+    fresh = Consensus(result.params)
+    t0 = time.perf_counter()
+    for block in result.blocks:
+        status = fresh.validate_and_insert_block(block)
+        assert status in ("utxo_valid", "utxo_pending"), f"replay rejected block: {status}"
+    elapsed = time.perf_counter() - t0
+    assert fresh.sink() == result.sink, "replay reached a different sink"
+    assert fresh.get_virtual_daa_score() == result.virtual_daa_score
+    return elapsed, fresh

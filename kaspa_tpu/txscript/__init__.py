@@ -1,0 +1,24 @@
+"""Transaction script layer.
+
+The reference implements a full stack VM (crypto/txscript, TxScriptEngine,
+lib.rs:156) executed per input under rayon.  The TPU-native design splits
+script checking into:
+
+- classification of standard script classes (script_class.rs equivalents) —
+  P2PK-Schnorr / P2PK-ECDSA / P2SH — whose signature checks are *collected*
+  into device batches (ops/secp256k1) spanning whole blocks / mergesets;
+- a host VM for general scripts (module vm.py) for everything nonstandard.
+
+This mirrors SURVEY.md §7 step 5: the fast path must be consensus-equivalent
+to the full engine for the script forms it accepts, and falls back to the
+VM otherwise.
+"""
+
+from kaspa_tpu.txscript.standard import (  # noqa: F401
+    ScriptClass,
+    classify_script,
+    pay_to_pub_key,
+    pay_to_pub_key_ecdsa,
+    pay_to_script_hash_script,
+)
+from kaspa_tpu.txscript.batch import BatchScriptChecker, ScriptCheckError  # noqa: F401

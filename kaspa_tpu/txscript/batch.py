@@ -1,0 +1,134 @@
+"""Batched script checking: the TPU offload point.
+
+The reference validates scripts per input inside rayon par_iter
+(tx_validation_in_utxo_context.rs:206-223); here the per-input signature
+checks of an entire block/mergeset are *collected* into one device batch:
+
+    collect phase  : classify each (input, utxo) pair, compute its sighash
+                     (host, memoized per tx), queue (pubkey, msg, sig)
+    dispatch phase : one batched Schnorr kernel call + one ECDSA call
+    resolve phase  : validity bitmask mapped back to per-input results
+
+Consensus equivalence: only canonical standard P2PK spends take the batch
+path; anything else routes to the host VM (txscript.vm) — same acceptance
+decisions as running the reference's engine per input.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.crypto import secp
+from kaspa_tpu.txscript import standard
+from kaspa_tpu.txscript.caches import SigCache
+
+
+class ScriptCheckError(Exception):
+    def __init__(self, msg: str, input_index: int | None = None):
+        super().__init__(msg)
+        self.input_index = input_index
+
+
+@dataclass
+class _Job:
+    kind: str  # "schnorr" | "ecdsa"
+    pubkey: bytes
+    msg: bytes
+    sig: bytes
+    cache_key: tuple
+    callback: object  # fn(bool)
+
+
+class BatchScriptChecker:
+    """Collects signature-check jobs across many txs, dispatches once."""
+
+    def __init__(self, sig_cache: SigCache | None = None, vm_fallback=None):
+        self.sig_cache = sig_cache if sig_cache is not None else SigCache()
+        self.vm_fallback = vm_fallback  # fn(tx, entries, input_index) -> None | raise
+        self._jobs: list[_Job] = []
+        self._results: dict[int, Exception | None] = {}
+
+    def collect_tx(self, token: int, tx, utxo_entries, reused=None) -> None:
+        """Queue all input script checks of `tx`; result under `token`."""
+        if reused is None:
+            reused = chash.SigHashReusedValues()
+        self._results.setdefault(token, None)
+        for i, (inp, entry) in enumerate(zip(tx.inputs, utxo_entries)):
+            try:
+                self._collect_input(token, tx, utxo_entries, i, inp, entry, reused)
+            except ScriptCheckError as e:
+                self._fail(token, e)
+
+    def _fail(self, token: int, err: Exception) -> None:
+        if self._results.get(token) is None:
+            self._results[token] = err
+
+    def _collect_input(self, token, tx, utxo_entries, i, inp, entry, reused):
+        cls = standard.classify_script(entry.script_public_key)
+        if cls == standard.ScriptClass.PUB_KEY:
+            data = standard.parse_single_push(inp.signature_script)
+            if data is None or len(data) == 0:
+                raise ScriptCheckError("signature script is not a canonical single push", i)
+            if len(data) != 65:
+                raise ScriptCheckError(f"invalid schnorr signature length {len(data) - 1}", i)
+            sig, hash_type = data[:64], data[64]
+            if hash_type not in chash.ALLOWED_SIG_HASH_TYPES:
+                raise ScriptCheckError(f"invalid hash type {hash_type}", i)
+            pubkey = entry.script_public_key.script[1:33]
+            msg = chash.calc_schnorr_signature_hash(tx, utxo_entries, i, hash_type, reused)
+            self._queue(token, "schnorr", pubkey, msg, sig, i)
+        elif cls == standard.ScriptClass.PUB_KEY_ECDSA:
+            data = standard.parse_single_push(inp.signature_script)
+            if data is None or len(data) == 0:
+                raise ScriptCheckError("signature script is not a canonical single push", i)
+            if len(data) != 65:
+                raise ScriptCheckError(f"invalid ecdsa signature length {len(data) - 1}", i)
+            sig, hash_type = data[:64], data[64]
+            if hash_type not in chash.ALLOWED_SIG_HASH_TYPES:
+                raise ScriptCheckError(f"invalid hash type {hash_type}", i)
+            pubkey = entry.script_public_key.script[1:34]
+            msg = chash.calc_ecdsa_signature_hash(tx, utxo_entries, i, hash_type, reused)
+            self._queue(token, "ecdsa", pubkey, msg, sig, i)
+        else:
+            # non-fast-path scripts go through the host VM
+            if self.vm_fallback is None:
+                raise ScriptCheckError(f"unsupported script class {cls.value} (VM fallback not wired)", i)
+            try:
+                self.vm_fallback(tx, utxo_entries, i, reused)
+            except Exception as e:  # VM raises on invalid script
+                raise ScriptCheckError(str(e), i) from e
+
+    def _queue(self, token, kind, pubkey, msg, sig, input_index):
+        cache_key = (kind, sig, msg, pubkey)
+        cached = self.sig_cache.get(cache_key)
+        if cached is not None:
+            if not cached:
+                self._fail(token, ScriptCheckError("invalid signature (cached)", input_index))
+            return
+
+        def cb(ok: bool, token=token, input_index=input_index):
+            if not ok:
+                self._fail(token, ScriptCheckError("invalid signature", input_index))
+
+        self._jobs.append(_Job(kind, pubkey, msg, sig, cache_key, cb))
+
+    def dispatch(self) -> dict[int, Exception | None]:
+        """Run all queued checks in (at most) two device batches; returns
+        token -> None (valid) | Exception (first failure)."""
+        schnorr = [j for j in self._jobs if j.kind == "schnorr"]
+        ecdsa = [j for j in self._jobs if j.kind == "ecdsa"]
+        if schnorr:
+            mask = secp.schnorr_verify_batch([(j.pubkey, j.msg, j.sig) for j in schnorr])
+            for j, ok in zip(schnorr, mask):
+                self.sig_cache.insert(j.cache_key, bool(ok))
+                j.callback(bool(ok))
+        if ecdsa:
+            mask = secp.ecdsa_verify_batch([(j.pubkey, j.msg, j.sig) for j in ecdsa])
+            for j, ok in zip(ecdsa, mask):
+                self.sig_cache.insert(j.cache_key, bool(ok))
+                j.callback(bool(ok))
+        self._jobs.clear()
+        out = self._results
+        self._results = {}
+        return out

@@ -1,0 +1,94 @@
+"""Standard script classes and builders.
+
+Reference: crypto/txscript/src/{script_class.rs,standard.rs}.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from enum import Enum
+
+from kaspa_tpu.consensus.model import ScriptPublicKey
+
+# opcode bytes (crypto/txscript/src/opcodes/mod.rs codes)
+OP_DATA_32 = 0x20
+OP_DATA_33 = 0x21
+OP_DATA_65 = 0x41
+OP_EQUAL = 0x87
+OP_BLAKE2B = 0xAA
+OP_CHECKSIG_ECDSA = 0xAB
+OP_CHECKSIG = 0xAC
+
+MAX_SCRIPT_PUBLIC_KEY_VERSION = 0
+
+
+class ScriptClass(Enum):
+    NON_STANDARD = "nonstandard"
+    PUB_KEY = "pubkey"
+    PUB_KEY_ECDSA = "pubkeyecdsa"
+    SCRIPT_HASH = "scripthash"
+
+
+def is_pay_to_pubkey(script: bytes) -> bool:
+    return len(script) == 34 and script[0] == OP_DATA_32 and script[33] == OP_CHECKSIG
+
+
+def is_pay_to_pubkey_ecdsa(script: bytes) -> bool:
+    return len(script) == 35 and script[0] == OP_DATA_33 and script[34] == OP_CHECKSIG_ECDSA
+
+
+def is_pay_to_script_hash(script: bytes) -> bool:
+    return len(script) == 35 and script[0] == OP_BLAKE2B and script[1] == OP_DATA_32 and script[34] == OP_EQUAL
+
+
+def classify_script(spk: ScriptPublicKey) -> ScriptClass:
+    if spk.version != MAX_SCRIPT_PUBLIC_KEY_VERSION:
+        return ScriptClass.NON_STANDARD
+    if is_pay_to_pubkey(spk.script):
+        return ScriptClass.PUB_KEY
+    if is_pay_to_pubkey_ecdsa(spk.script):
+        return ScriptClass.PUB_KEY_ECDSA
+    if is_pay_to_script_hash(spk.script):
+        return ScriptClass.SCRIPT_HASH
+    return ScriptClass.NON_STANDARD
+
+
+def pay_to_pub_key(pubkey32: bytes) -> ScriptPublicKey:
+    assert len(pubkey32) == 32
+    return ScriptPublicKey(0, bytes([OP_DATA_32]) + pubkey32 + bytes([OP_CHECKSIG]))
+
+
+def pay_to_pub_key_ecdsa(pubkey33: bytes) -> ScriptPublicKey:
+    assert len(pubkey33) == 33
+    return ScriptPublicKey(0, bytes([OP_DATA_33]) + pubkey33 + bytes([OP_CHECKSIG_ECDSA]))
+
+
+def pay_to_script_hash_script(redeem_script: bytes) -> ScriptPublicKey:
+    h = hashlib.blake2b(redeem_script, digest_size=32).digest()
+    return ScriptPublicKey(0, bytes([OP_BLAKE2B, OP_DATA_32]) + h + bytes([OP_EQUAL]))
+
+
+def schnorr_signature_script(sig64: bytes, hash_type: int) -> bytes:
+    """Signature script for P2PK: a single push of sig||hash_type."""
+    assert len(sig64) == 64
+    return bytes([OP_DATA_65]) + sig64 + bytes([hash_type])
+
+
+def ecdsa_signature_script(sig64: bytes, hash_type: int) -> bytes:
+    assert len(sig64) == 64
+    return bytes([OP_DATA_65]) + sig64 + bytes([hash_type])
+
+
+def parse_single_push(script: bytes) -> bytes | None:
+    """Parse a signature script that is exactly one canonical data push.
+
+    Standard P2PK spends push one 65-byte blob (sig64 + hashtype).  Returns
+    the pushed data or None if the script isn't a single plain push
+    (1 <= opcode <= 75 direct-data form).
+    """
+    if not script:
+        return None
+    op = script[0]
+    if 1 <= op <= 75 and len(script) == 1 + op:
+        return script[1:]
+    return None

@@ -5,18 +5,22 @@ unit tests validate bit-exactness and sharding semantics on a virtual CPU
 mesh (fast, deterministic, no TPU contention), per the multi-chip testing
 strategy in the task brief.  Set KASPA_TPU_TEST_REAL_DEVICE=1 to run the
 suite on whatever device JAX picks (e.g. the tunneled TPU).
+
+NOTE: the axon sitecustomize hook registers the TPU plugin at interpreter
+startup (before this conftest runs), so env-var-based platform selection is
+too late — we must override via jax.config before any backend initializes.
 """
 
 import os
 
 if not os.environ.get("KASPA_TPU_TEST_REAL_DEVICE"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    # the axon sitecustomize hook force-registers the TPU plugin when this
-    # is set (and prepends "axon" to jax_platforms); clear it for CPU tests
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", "CPU platform override failed"
 
 from kaspa_tpu.utils import jax_setup
 

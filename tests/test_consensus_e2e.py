@@ -1,0 +1,106 @@
+"""End-to-end consensus slice: simulate a DAG, replay it, verify state.
+
+The python equivalent of the reference's simpa-based integration testing
+(simpa/src/main.rs:327-345): build a multi-miner DAG with real signed
+transactions, then replay into a fresh consensus and require identical
+sink/DAA/UTXO outcomes.  Also covers adversarial block rejection.
+"""
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus, RuleError
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
+from kaspa_tpu.txscript import standard
+
+
+@pytest.fixture(scope="module")
+def sim_result():
+    cfg = SimConfig(bps=2, delay=1.0, num_miners=3, num_blocks=30, txs_per_block=2, seed=11)
+    return simulate(cfg)
+
+
+def test_simulation_produces_merging_dag(sim_result):
+    # with delay ~2x block interval, some blocks must have multi-parent merges
+    multi_parent = sum(1 for b in sim_result.blocks if len(b.header.direct_parents()) > 1)
+    assert multi_parent > 0
+    assert sim_result.total_txs > 0
+
+
+def test_replay_reaches_identical_state(sim_result):
+    elapsed, fresh = replay(sim_result)
+    assert fresh.sink() == sim_result.sink
+    assert fresh.get_virtual_daa_score() == sim_result.virtual_daa_score
+
+
+def test_tampered_block_rejected(sim_result):
+    fresh = Consensus(sim_result.params)
+    blocks = sim_result.blocks
+    for block in blocks[:-1]:
+        fresh.validate_and_insert_block(block)
+    bad = blocks[-1]
+    # tamper: flip a byte in the utxo commitment
+    from dataclasses import replace
+
+    hdr = bad.header
+    tampered = replace(hdr, utxo_commitment=bytes([hdr.utxo_commitment[0] ^ 1]) + hdr.utxo_commitment[1:])
+    tampered._hash_cache = None
+    from kaspa_tpu.consensus.model.block import Block
+
+    tb = Block(tampered, bad.transactions)
+    # merkle still ok, header checks ok; chain verification must disqualify it
+    status = fresh.validate_and_insert_block(tb)
+    assert status in ("disqualified", "utxo_pending")
+    if status == "utxo_pending":
+        # it wasn't on the selected chain; force qualification attempt
+        ok = fresh._ensure_chain_utxo_valid(tb.hash)
+        assert not ok
+
+
+def test_wrong_difficulty_bits_rejected(sim_result):
+    fresh = Consensus(sim_result.params)
+    for block in sim_result.blocks[:5]:
+        fresh.validate_and_insert_block(block)
+    nxt = sim_result.blocks[5]
+    from dataclasses import replace
+
+    hdr = replace(nxt.header, bits=nxt.header.bits + 1)
+    hdr._hash_cache = None
+    from kaspa_tpu.consensus.model.block import Block
+
+    with pytest.raises(RuleError, match="difficulty bits"):
+        fresh.validate_and_insert_block(Block(hdr, nxt.transactions))
+
+
+def test_corrupt_signature_rejected():
+    """A block containing a tx with a corrupted signature must be disqualified."""
+    cfg = SimConfig(bps=2, delay=1.0, num_miners=2, num_blocks=24, txs_per_block=2, seed=13)
+    res = simulate(cfg)
+    tx_block_idx = next(i for i, b in enumerate(res.blocks) if len(b.transactions) > 1)
+    fresh = Consensus(res.params)
+    for block in res.blocks[:tx_block_idx]:
+        fresh.validate_and_insert_block(block)
+    victim = res.blocks[tx_block_idx]
+    tx = victim.transactions[1]
+    sig = bytearray(tx.inputs[0].signature_script)
+    sig[10] ^= 1
+    tx.inputs[0].signature_script = bytes(sig)
+    tx._id_cache = None
+    # merkle root no longer matches -> body rejection
+    with pytest.raises(RuleError, match="merkle"):
+        fresh.validate_and_insert_block(victim)
+    # rebuild merkle to sneak it past the body stage: then chain must disqualify
+    from dataclasses import replace
+
+    from kaspa_tpu.consensus.model.block import Block
+    from kaspa_tpu.crypto import merkle as mk
+
+    hdr = replace(victim.header, hash_merkle_root=mk.calc_hash_merkle_root(victim.transactions))
+    hdr._hash_cache = None
+    fixed = Block(hdr, victim.transactions)
+    status = fresh.validate_and_insert_block(fixed)
+    if status == "utxo_pending":
+        assert not fresh._ensure_chain_utxo_valid(fixed.hash)
+    else:
+        assert status == "disqualified"

@@ -1,0 +1,156 @@
+"""Sighash golden vectors (reference: consensus/core/src/hashing/sighash.rs tests).
+
+Covers the full SigHashType matrix (ALL/NONE/SINGLE x ANYONECANPAY), payload/
+gas/subnetwork coverage, v0 vs v1 compute-commit semantics, and the memoized
+reused-values path.
+"""
+
+import copy
+from dataclasses import replace
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import (
+    SUBNETWORK_ID_NATIVE,
+    ComputeCommit,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+
+PREV_TX_ID = bytes.fromhex("880eb9819a31821d9d2399e2f35e2433b72637e393d71ecc9b8d0250f49153c3")
+SPK1 = bytes.fromhex("208325613d2eeaf7176ac6c670b13c0043156c427438ed72d74b7800862ad884e8ac")
+SPK2 = bytes.fromhex("20fcef4c106cf11135bbd70f02a726a92162d2fb8b22f0469126f800862ad884e8ac")
+
+ALL = chash.SIG_HASH_ALL
+NONE = chash.SIG_HASH_NONE
+SINGLE = chash.SIG_HASH_SINGLE
+ACP = chash.SIG_HASH_ANY_ONE_CAN_PAY
+
+
+def _native_tx(version=0):
+    def cc(i):
+        if version == 0:
+            return ComputeCommit.sigops(0)
+        return ComputeCommit.budget([11, 22, 33][i])
+
+    inputs = [TransactionInput(TransactionOutpoint(PREV_TX_ID, i), b"", i, cc(i)) for i in range(3)]
+    outputs = [
+        TransactionOutput(300, ScriptPublicKey(0, SPK2)),
+        TransactionOutput(300, ScriptPublicKey(0, SPK1)),
+    ]
+    return Transaction(version, inputs, outputs, 1615462089000, SUBNETWORK_ID_NATIVE, 0, b"")
+
+
+def _entries():
+    return [
+        UtxoEntry(100, ScriptPublicKey(0, SPK1), 0, False),
+        UtxoEntry(200, ScriptPublicKey(0, SPK2), 0, False),
+        UtxoEntry(300, ScriptPublicKey(0, SPK2), 0, False),
+    ]
+
+
+def _subnetwork_tx():
+    tx = _native_tx()
+    tx.subnetwork_id = bytes([1, 2, 3, 4, 5, 6, 7, 8, 9, 10] + [0] * 10)
+    tx.gas = 250
+    tx.payload = bytes([10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20])
+    return tx
+
+
+def _run(tx_factory, hash_type, input_index, action, expected):
+    tx = tx_factory()
+    entries = _entries()
+    kind, arg = action
+    if kind == "output":
+        tx.outputs[arg].value = 100
+    elif kind == "input":
+        tx.inputs[arg].previous_outpoint = TransactionOutpoint(PREV_TX_ID, 2)
+    elif kind == "budget":
+        tx.inputs[arg].compute_commit = ComputeCommit.budget(1234)
+    elif kind == "sigops":
+        tx.inputs[arg].compute_commit = ComputeCommit.sigops(123)
+    elif kind == "amount":
+        entries[arg] = replace(entries[arg], amount=666)
+    elif kind == "prev_spk":
+        old = entries[arg].script_public_key
+        entries[arg] = replace(entries[arg], script_public_key=ScriptPublicKey(old.version, old.script + bytes([1, 2, 3])))
+    elif kind == "sequence":
+        tx.inputs[arg].sequence = 12345
+    elif kind == "payload":
+        tx.payload = bytes([6, 6, 6, 4, 2, 0, 1, 3, 3, 7])
+    elif kind == "gas":
+        tx.gas = 1234
+    elif kind == "subnetwork":
+        tx.subnetwork_id = bytes([6, 6, 6, 4, 2, 0, 1, 3, 3, 7] + [0] * 10)
+    reused = chash.SigHashReusedValues()
+    got = chash.calc_schnorr_signature_hash(tx, entries, input_index, hash_type, reused)
+    assert got.hex() == expected
+
+
+NOOP = ("none", None)
+
+VECTORS = [
+    (_native_tx, ALL, 0, NOOP, "03b7ac6927b2b67100734c3cc313ff8c2e8b3ce3e746d46dd660b706a916b1f5"),
+    (_native_tx, ALL, 0, ("input", 1), "a9f563d86c0ef19ec2e4f483901d202e90150580b6123c3d492e26e7965f488c"),
+    (_native_tx, ALL, 0, ("budget", 1), "03b7ac6927b2b67100734c3cc313ff8c2e8b3ce3e746d46dd660b706a916b1f5"),
+    (lambda: _native_tx(1), ALL, 0, ("sigops", 0), "5b2657524be672e019897646b56da3d192b453d78ae5e6e5c07f029a69f5f075"),
+    (lambda: _native_tx(1), ALL, 0, ("sigops", 1), "5b2657524be672e019897646b56da3d192b453d78ae5e6e5c07f029a69f5f075"),
+    (lambda: _native_tx(1), ALL, 0, ("budget", 0), "5b2657524be672e019897646b56da3d192b453d78ae5e6e5c07f029a69f5f075"),
+    (lambda: _native_tx(1), ALL, 0, ("budget", 1), "5b2657524be672e019897646b56da3d192b453d78ae5e6e5c07f029a69f5f075"),
+    (_native_tx, ALL, 0, ("output", 1), "aad2b61bd2405dfcf7294fc2be85f325694f02dda22d0af30381cb50d8295e0a"),
+    (_native_tx, ALL, 0, ("sequence", 1), "0818bd0a3703638d4f01014c92cf866a8903cab36df2fa2506dc0d06b94295e8"),
+    (_native_tx, ALL | ACP, 0, NOOP, "24821e466e53ff8e5fa93257cb17bb06131a48be4ef282e87f59d2bdc9afebc2"),
+    (_native_tx, ALL | ACP, 0, ("input", 0), "d09cb639f335ee69ac71f2ad43fd9e59052d38a7d0638de4cf989346588a7c38"),
+    (_native_tx, ALL | ACP, 0, ("input", 1), "24821e466e53ff8e5fa93257cb17bb06131a48be4ef282e87f59d2bdc9afebc2"),
+    (_native_tx, ALL | ACP, 0, ("sequence", 1), "24821e466e53ff8e5fa93257cb17bb06131a48be4ef282e87f59d2bdc9afebc2"),
+    (_native_tx, NONE, 0, NOOP, "38ce4bc93cf9116d2e377b33ff8449c665b7b5e2f2e65303c543b9afdaa4bbba"),
+    (_native_tx, NONE, 0, ("output", 1), "38ce4bc93cf9116d2e377b33ff8449c665b7b5e2f2e65303c543b9afdaa4bbba"),
+    (_native_tx, NONE, 0, ("sequence", 0), "d9efdd5edaa0d3fd0133ee3ab731d8c20e0a1b9f3c0581601ae2075db1109268"),
+    (_native_tx, NONE, 0, ("sequence", 1), "38ce4bc93cf9116d2e377b33ff8449c665b7b5e2f2e65303c543b9afdaa4bbba"),
+    (_native_tx, NONE | ACP, 0, NOOP, "06aa9f4239491e07bb2b6bda6b0657b921aeae51e193d2c5bf9e81439cfeafa0"),
+    (_native_tx, NONE | ACP, 0, ("amount", 0), "f07f45f3634d3ea8c0f2cb676f56e20993edf9be07a83bf0dfdb3debcf1441bf"),
+    (_native_tx, NONE | ACP, 0, ("prev_spk", 0), "20a525c54dc33b2a61201f05233c086dbe8e06e9515775181ed96550b4f2d714"),
+    (_native_tx, SINGLE, 0, NOOP, "44a0b407ff7b239d447743dd503f7ad23db5b2ee4d25279bd3dffaf6b474e005"),
+    (_native_tx, SINGLE, 0, ("output", 1), "44a0b407ff7b239d447743dd503f7ad23db5b2ee4d25279bd3dffaf6b474e005"),
+    (_native_tx, SINGLE, 0, ("sequence", 0), "83796d22879718eee1165d4aace667bb6778075dab579c32c57be945f466a451"),
+    (_native_tx, SINGLE, 0, ("sequence", 1), "44a0b407ff7b239d447743dd503f7ad23db5b2ee4d25279bd3dffaf6b474e005"),
+    (_native_tx, SINGLE, 2, NOOP, "022ad967192f39d8d5895d243e025ec14cc7a79708c5e364894d4eff3cecb1b0"),
+    (_native_tx, SINGLE, 2, ("output", 1), "022ad967192f39d8d5895d243e025ec14cc7a79708c5e364894d4eff3cecb1b0"),
+    (_native_tx, SINGLE | ACP, 0, NOOP, "43b20aba775050cf9ba8d5e48fc7ed2dc6c071d23f30382aea58b7c59cfb8ed7"),
+    (_native_tx, SINGLE | ACP, 2, NOOP, "846689131fb08b77f83af1d3901076732ef09d3f8fdff945be89aa4300562e5f"),
+    (_native_tx, ALL, 0, ("payload", None), "72ea6c2871e0f44499f1c2b556f265d9424bfea67cca9cb343b4b040ead65525"),
+    (_subnetwork_tx, ALL, 0, NOOP, "b2f421c933eb7e1a91f1d9e1efa3f120fe419326c0dbac487752189522550e0c"),
+    (_subnetwork_tx, ALL, 0, ("payload", None), "12ab63b9aea3d58db339245a9b6e9cb6075b2253615ce0fb18104d28de4435a1"),
+    (_subnetwork_tx, ALL, 0, ("gas", None), "2501edfc0068d591160c4bd98646c6e6892cdc051182a8be3ccd6d67f104fd17"),
+    (_subnetwork_tx, ALL, 0, ("subnetwork", None), "a5d1230ede0dfcfd522e04123a7bcd721462fed1d3a87352031a4f6e3c4389b6"),
+]
+
+
+def test_sighash_golden_vectors():
+    for i, (factory, ht, idx, action, expected) in enumerate(VECTORS):
+        _run(factory, ht, idx, action, expected)
+
+
+def test_ecdsa_sighash_is_domain_prefixed_sha256():
+    import hashlib
+
+    tx = _native_tx()
+    entries = _entries()
+    reused = chash.SigHashReusedValues()
+    schnorr = chash.calc_schnorr_signature_hash(tx, entries, 0, ALL, reused)
+    ecdsa = chash.calc_ecdsa_signature_hash(tx, entries, 0, ALL, chash.SigHashReusedValues())
+    dom = hashlib.sha256(b"TransactionSigningHashECDSA").digest()
+    assert ecdsa == hashlib.sha256(dom + schnorr).digest()
+
+
+def test_reused_values_memoization():
+    tx = _native_tx()
+    entries = _entries()
+    reused = chash.SigHashReusedValues()
+    h0 = chash.calc_schnorr_signature_hash(tx, entries, 0, ALL, reused)
+    assert reused.previous_outputs_hash is not None  # memoized after first input
+    h0b = chash.calc_schnorr_signature_hash(tx, entries, 0, ALL, reused)
+    assert h0 == h0b
