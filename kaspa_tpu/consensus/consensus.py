@@ -104,6 +104,9 @@ class Consensus:
             bps=params.bps,
         )
         self.transaction_validator = TransactionValidator(params)
+        from kaspa_tpu.notify.notifier import ConsensusNotificationRoot
+
+        self.notification_root = ConsensusNotificationRoot()
 
         # virtual/UTXO state
         self.tips: set[bytes] = set()
@@ -114,6 +117,10 @@ class Consensus:
         self.acceptance_data: dict[bytes, list] = {}
         self.virtual_state: VirtualState | None = None
         self.daa_excluded: dict[bytes, set[bytes]] = {}
+        # net UTXO delta accumulated between virtual resolutions (reorg-safe):
+        # emitted as one UtxosChanged per resolve
+        self._acc_added: dict = {}
+        self._acc_removed: dict = {}
 
         self._insert_genesis()
 
@@ -160,8 +167,12 @@ class Consensus:
 
     def validate_and_insert_block(self, block: Block) -> str:
         """Full pipeline for one block; returns the resulting block status."""
+        existing = self.storage.statuses.get(block.hash)
+        if existing is not None and existing != StatusesStore.STATUS_HEADER_ONLY:
+            return existing  # duplicate submission: no reprocessing, no events
         self._process_header(block.header)
         self._process_body(block)
+        self.notification_root.notify_block_added(block)
         self._update_tips(block.hash)
         self._resolve_virtual()
         status = self.storage.statuses.get(block.hash)
@@ -343,6 +354,7 @@ class Consensus:
         self._move_utxo_position(sink)
         ctx = self._calculate_utxo_state(vgd, daa_window.daa_score)
         self.virtual_utxo_diff = ctx["mergeset_diff"]
+        prev_state = self.virtual_state
         self.virtual_state = VirtualState(
             parents=virtual_parents,
             ghostdag_data=vgd,
@@ -353,6 +365,14 @@ class Consensus:
             mergeset_rewards=ctx["mergeset_rewards"],
             mergeset_non_daa=daa_window.mergeset_non_daa,
         )
+        # emit score notifications on every resolve; one net UtxosChanged
+        # only when the chain state actually moved
+        if prev_state is not None:
+            self.notification_root.notify_virtual_change(
+                self.virtual_state, list(self._acc_added.items()), list(self._acc_removed.items())
+            )
+        self._acc_added = {}
+        self._acc_removed = {}
 
     def _ensure_chain_utxo_valid(self, block: bytes) -> bool:
         """Verify the selected chain up to `block` is UTXO valid; disqualify on failure."""
@@ -405,10 +425,36 @@ class Consensus:
         self.multisets[block] = multiset
         self.utxo_diffs[block] = ctx["mergeset_diff"]
         self.acceptance_data[block] = ctx["accepted_tx_ids"]
-        apply_diff(self.utxo_set, ctx["mergeset_diff"])
+        self._apply_chain_diff(ctx["mergeset_diff"])
         self.utxo_position = block
         self.storage.statuses.set(block, StatusesStore.STATUS_UTXO_VALID)
         return True
+
+    def _apply_chain_diff(self, diff: UtxoDiff) -> None:
+        apply_diff(self.utxo_set, diff)
+        for op, entry in diff.remove.items():
+            if op in self._acc_added:
+                del self._acc_added[op]
+            else:
+                self._acc_removed[op] = entry
+        for op, entry in diff.add.items():
+            if op in self._acc_removed:
+                del self._acc_removed[op]
+            else:
+                self._acc_added[op] = entry
+
+    def _unapply_chain_diff(self, diff: UtxoDiff) -> None:
+        unapply_diff(self.utxo_set, diff)
+        for op, entry in diff.add.items():
+            if op in self._acc_added:
+                del self._acc_added[op]
+            else:
+                self._acc_removed[op] = entry
+        for op, entry in diff.remove.items():
+            if op in self._acc_removed:
+                del self._acc_removed[op]
+            else:
+                self._acc_added[op] = entry
 
     def _verify_coinbase_transaction(self, coinbase, daa_score, gd, mergeset_rewards, non_daa) -> bool:
         miner_data = self.coinbase_manager.deserialize_coinbase_payload(coinbase.payload).miner_data
@@ -580,9 +626,9 @@ class Consensus:
             fwd_path.append(t)
             t = self.storage.ghostdag.get_selected_parent(t)
         for b in back_path:
-            unapply_diff(self.utxo_set, self.utxo_diffs[b])
+            self._unapply_chain_diff(self.utxo_diffs[b])
         for b in reversed(fwd_path):
-            apply_diff(self.utxo_set, self.utxo_diffs[b])
+            self._apply_chain_diff(self.utxo_diffs[b])
         self.utxo_position = target
 
 

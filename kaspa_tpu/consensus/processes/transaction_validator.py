@@ -40,6 +40,13 @@ class TransactionValidator:
         self.params = params
         self.coinbase_maturity = params.coinbase_maturity
         self.sig_cache = sig_cache if sig_cache is not None else SigCache()
+        if vm_fallback is None:
+            # nonstandard scripts run through the host VM with the shared cache
+            from kaspa_tpu.txscript import vm as _vm
+
+            def vm_fallback(tx, entries, idx, reused, _cache=self.sig_cache):
+                _vm.vm_fallback(tx, entries, idx, reused, _cache)
+
         self.vm_fallback = vm_fallback
 
     def new_checker(self) -> BatchScriptChecker:

@@ -1,0 +1,152 @@
+"""Kaspa addresses: cashaddr-style bech32 codec.
+
+Reference: crypto/addresses/src/{lib.rs,bech32.rs} — 5-bit charset encoding
+with the BCH polymod checksum (8 five-bit checksum symbols), address
+versions PubKey (0, 32-byte x-only), PubKeyECDSA (1, 33-byte), ScriptHash
+(8, 32-byte blake2b of the redeem script).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+_REV = {c: i for i, c in enumerate(CHARSET)}
+
+VERSION_PUBKEY = 0
+VERSION_PUBKEY_ECDSA = 1
+VERSION_SCRIPT_HASH = 8
+
+_PAYLOAD_LEN = {VERSION_PUBKEY: 32, VERSION_PUBKEY_ECDSA: 33, VERSION_SCRIPT_HASH: 32}
+
+PREFIX_MAINNET = "kaspa"
+PREFIX_TESTNET = "kaspatest"
+PREFIX_SIMNET = "kaspasim"
+PREFIX_DEVNET = "kaspadev"
+
+
+class AddressError(Exception):
+    pass
+
+
+def _polymod(values) -> int:
+    c = 1
+    for d in values:
+        c0 = c >> 35
+        c = ((c & 0x07FFFFFFFF) << 5) ^ d
+        if c0 & 0x01:
+            c ^= 0x98F2BC8E61
+        if c0 & 0x02:
+            c ^= 0x79B76D99E2
+        if c0 & 0x04:
+            c ^= 0xF33E5FB3C4
+        if c0 & 0x08:
+            c ^= 0xAE2EABE2A8
+        if c0 & 0x10:
+            c ^= 0x1E4F43E470
+    return c ^ 1
+
+
+def _checksum(payload5: list[int], prefix: str) -> int:
+    stream = [ord(ch) & 0x1F for ch in prefix] + [0] + payload5 + [0] * 8
+    return _polymod(stream)
+
+
+def _conv8to5(data: bytes) -> list[int]:
+    out = []
+    buff = 0
+    bits = 0
+    for c in data:
+        buff = (buff << 8) | c
+        bits += 8
+        while bits >= 5:
+            bits -= 5
+            out.append((buff >> bits) & 0x1F)
+            buff &= (1 << bits) - 1
+    if bits:
+        out.append((buff << (5 - bits)) & 0x1F)
+    return out
+
+
+def _conv5to8(data: list[int]) -> bytes:
+    out = bytearray()
+    buff = 0
+    bits = 0
+    for c in data:
+        buff = (buff << 5) | c
+        bits += 5
+        while bits >= 8:
+            bits -= 8
+            out.append((buff >> bits) & 0xFF)
+            buff &= (1 << bits) - 1
+    return bytes(out)  # right-side padding ignored
+
+
+@dataclass(frozen=True)
+class Address:
+    prefix: str
+    version: int
+    payload: bytes
+
+    def __post_init__(self):
+        expected = _PAYLOAD_LEN.get(self.version)
+        if expected is None:
+            raise AddressError(f"unknown address version {self.version}")
+        if len(self.payload) != expected:
+            raise AddressError(f"version {self.version} payload must be {expected} bytes")
+
+    def to_string(self) -> str:
+        payload5 = _conv8to5(bytes([self.version]) + self.payload)
+        chk = _checksum(payload5, self.prefix)
+        chk5 = _conv8to5(chk.to_bytes(8, "big")[3:])
+        return self.prefix + ":" + "".join(CHARSET[c] for c in payload5 + chk5)
+
+    @staticmethod
+    def from_string(s: str) -> "Address":
+        if ":" not in s:
+            raise AddressError("missing prefix")
+        prefix, body = s.split(":", 1)
+        try:
+            u5 = [_REV[ch] for ch in body]
+        except KeyError as e:
+            raise AddressError(f"invalid character {e.args[0]!r}") from None
+        if len(u5) < 8:
+            raise AddressError("address too short")
+        if _checksum(u5[:-8], prefix) != int.from_bytes(_conv5to8(u5[-8:]).rjust(8, b"\x00"), "big"):
+            raise AddressError("bad checksum")
+        decoded = _conv5to8(u5[:-8])
+        if not decoded:
+            raise AddressError("empty payload")
+        return Address(prefix, decoded[0], decoded[1:])
+
+
+def pay_to_address_script(address: Address):
+    """standard.rs pay_to_address_script."""
+    from kaspa_tpu.txscript import standard
+
+    if address.version == VERSION_PUBKEY:
+        return standard.pay_to_pub_key(address.payload)
+    if address.version == VERSION_PUBKEY_ECDSA:
+        return standard.pay_to_pub_key_ecdsa(address.payload)
+    if address.version == VERSION_SCRIPT_HASH:
+        from kaspa_tpu.consensus.model import ScriptPublicKey
+
+        return ScriptPublicKey(
+            0,
+            bytes([standard.OP_BLAKE2B, standard.OP_DATA_32]) + address.payload + bytes([standard.OP_EQUAL]),
+        )
+    raise AddressError(f"unknown version {address.version}")
+
+
+def extract_script_pub_key_address(spk, prefix: str) -> Address:
+    """standard.rs extract_script_pub_key_address."""
+    from kaspa_tpu.txscript import standard
+
+    cls = standard.classify_script(spk)
+    if cls == standard.ScriptClass.PUB_KEY:
+        return Address(prefix, VERSION_PUBKEY, spk.script[1:33])
+    if cls == standard.ScriptClass.PUB_KEY_ECDSA:
+        return Address(prefix, VERSION_PUBKEY_ECDSA, spk.script[1:34])
+    if cls == standard.ScriptClass.SCRIPT_HASH:
+        return Address(prefix, VERSION_SCRIPT_HASH, spk.script[2:34])
+    raise AddressError("nonstandard script")

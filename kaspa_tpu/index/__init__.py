@@ -1,0 +1,1 @@
+from kaspa_tpu.index.utxoindex import UtxoIndex  # noqa: F401

@@ -1,0 +1,53 @@
+"""UTXO index: script-pubkey -> UTXO inverted index.
+
+Reference: indexes/utxoindex/src/{index.rs,update_container.rs,stores/} —
+fed by UtxosChanged virtual diffs from the consensus notification root,
+with full resync from the virtual UTXO set on reset.
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.notify.notifier import Notification
+
+
+class UtxoIndex:
+    def __init__(self, consensus: Consensus):
+        self.consensus = consensus
+        # spk script bytes -> {outpoint: UtxoEntry}
+        self._by_script: dict[bytes, dict] = {}
+        self._listener_id = consensus.notification_root.register(self._on_notification)
+        consensus.notification_root.start_notify(self._listener_id, "utxos-changed")
+        self.resync()
+
+    def _on_notification(self, n: Notification) -> None:
+        if n.event_type != "utxos-changed":
+            return
+        for outpoint, entry in n.data.get("removed", []):
+            bucket = self._by_script.get(entry.script_public_key.script)
+            if bucket is not None:
+                bucket.pop(outpoint, None)
+                if not bucket:
+                    del self._by_script[entry.script_public_key.script]
+        for outpoint, entry in n.data.get("added", []):
+            self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
+
+    def resync(self) -> None:
+        """Rebuild from the sink UTXO state (index.rs resync).
+
+        Tracks the materialized selected-chain state; the unmerged virtual
+        mergeset diff is intentionally excluded (it is replayed when those
+        blocks become chain blocks)."""
+        self._by_script.clear()
+        self.consensus._move_utxo_position(self.consensus.sink())
+        for outpoint, entry in self.consensus.utxo_set.items():
+            self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
+
+    def get_utxos_by_script(self, script: bytes) -> dict:
+        return dict(self._by_script.get(script, {}))
+
+    def get_balance_by_script(self, script: bytes) -> int:
+        return sum(e.amount for e in self._by_script.get(script, {}).values())
+
+    def get_circulating_supply(self) -> int:
+        return sum(e.amount for bucket in self._by_script.values() for e in bucket.values())

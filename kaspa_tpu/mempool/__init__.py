@@ -1,0 +1,2 @@
+from kaspa_tpu.mempool.mempool import Mempool, MempoolError, MempoolTx  # noqa: F401
+from kaspa_tpu.mempool.mining_manager import MiningManager  # noqa: F401

@@ -1,0 +1,162 @@
+"""Mempool: pending-transaction pool with orphan handling and RBF.
+
+Reference: mining/src/mempool/ (model/{pool,orphan_pool,frontier}.rs,
+validate_and_insert_transaction.rs, replace_by_fee.rs,
+handle_new_block_transactions.rs).  The weighted-feerate-sampling search
+tree (frontier/search_tree.rs) is modeled as a feerate-sorted greedy
+selector in this round.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.model import Transaction, TransactionOutpoint
+
+
+class MempoolError(Exception):
+    pass
+
+
+@dataclass
+class MempoolTx:
+    tx: Transaction
+    fee: int
+    mass: int
+    added_daa_score: int
+
+    @property
+    def feerate(self) -> float:
+        return self.fee / max(self.mass, 1)
+
+
+@dataclass
+class MempoolConfig:
+    maximum_transaction_count: int = 1_000_000
+    maximum_orphan_transaction_count: int = 500
+    transaction_expire_interval_daa_score: int = 60 * 10  # mempool/config.rs scale
+    accepted_cache_size: int = 10_000
+    allow_rbf: bool = True
+
+
+class Mempool:
+    def __init__(self, config: MempoolConfig | None = None):
+        self.config = config or MempoolConfig()
+        self.pool: dict[bytes, MempoolTx] = {}  # txid -> entry
+        self.outpoint_index: dict[TransactionOutpoint, bytes] = {}  # spent outpoint -> txid
+        self.orphans: dict[bytes, MempoolTx] = {}
+        self.accepted: dict[bytes, int] = {}  # txid -> daa score (LRU-ish)
+
+    def __len__(self):
+        return len(self.pool)
+
+    def has(self, txid: bytes) -> bool:
+        return txid in self.pool or txid in self.orphans
+
+    def get(self, txid: bytes) -> MempoolTx | None:
+        return self.pool.get(txid)
+
+    # --- insertion (validate_and_insert_transaction.rs) ---
+
+    def insert(self, entry: MempoolTx, orphan: bool = False) -> list[bytes]:
+        """Insert a pre-validated tx.  Returns txids evicted by RBF.
+
+        `orphan=True` parks the tx in the orphan pool (missing inputs).
+        """
+        txid = entry.tx.id()
+        if self.has(txid) or txid in self.accepted:
+            raise MempoolError("transaction already in mempool or recently accepted")
+        if orphan:
+            if len(self.orphans) >= self.config.maximum_orphan_transaction_count:
+                # evict the lowest-feerate orphan (orphan_pool.rs limit policy)
+                victim = min(self.orphans, key=lambda t: self.orphans[t].feerate)
+                del self.orphans[victim]
+            self.orphans[txid] = entry
+            return []
+        if len(self.pool) >= self.config.maximum_transaction_count:
+            raise MempoolError("mempool is full")
+
+        # double-spend / RBF (replace_by_fee.rs): a conflicting tx is replaced
+        # only if the new one pays a strictly higher feerate than all conflicts
+        conflicts = {self.outpoint_index[inp.previous_outpoint]
+                     for inp in entry.tx.inputs if inp.previous_outpoint in self.outpoint_index}
+        evicted = []
+        if conflicts:
+            if not self.config.allow_rbf:
+                raise MempoolError("transaction double spends mempool transaction")
+            if any(self.pool[c].feerate >= entry.feerate for c in conflicts):
+                raise MempoolError("replacement feerate not higher than conflicts")
+            for c in conflicts:
+                self._remove(c)
+                evicted.append(c)
+
+        self.pool[txid] = entry
+        for inp in entry.tx.inputs:
+            self.outpoint_index[inp.previous_outpoint] = txid
+        return evicted
+
+    def _remove(self, txid: bytes) -> None:
+        entry = self.pool.pop(txid, None)
+        if entry is None:
+            return
+        for inp in entry.tx.inputs:
+            if self.outpoint_index.get(inp.previous_outpoint) == txid:
+                del self.outpoint_index[inp.previous_outpoint]
+
+    # --- new-block handling (handle_new_block_transactions.rs) ---
+
+    def handle_accepted_transactions(self, accepted_txids: list[bytes], daa_score: int) -> None:
+        for txid in accepted_txids:
+            self._remove(txid)
+            self.orphans.pop(txid, None)
+            self.accepted[txid] = daa_score
+        # bound the accepted cache
+        if len(self.accepted) > self.config.accepted_cache_size:
+            cutoff = sorted(self.accepted.values())[len(self.accepted) - self.config.accepted_cache_size]
+            self.accepted = {t: s for t, s in self.accepted.items() if s >= cutoff}
+
+    def remove_conflicting(self, spent_outpoints) -> list[bytes]:
+        """Remove pool txs conflicting with outpoints spent by a new block."""
+        removed = []
+        for op in spent_outpoints:
+            txid = self.outpoint_index.get(op)
+            if txid is not None:
+                self._remove(txid)
+                removed.append(txid)
+        return removed
+
+    def expire(self, current_daa_score: int) -> list[bytes]:
+        horizon = current_daa_score - self.config.transaction_expire_interval_daa_score
+        stale = [t for t, e in self.pool.items() if e.added_daa_score < horizon]
+        for t in stale:
+            self._remove(t)
+        return stale
+
+    # --- selection (frontier.rs, selectors.rs) ---
+
+    def select_transactions(self, max_count: int = 300) -> list[MempoolTx]:
+        """Feerate-descending greedy selection (frontier sampling's greedy
+        limit case); in-pool dependency chains are excluded because consensus
+        forbids chained transactions within one block."""
+        chosen: list[MempoolTx] = []
+        chosen_ids: set[bytes] = set()
+        for txid, entry in sorted(self.pool.items(), key=lambda kv: kv[1].feerate, reverse=True):
+            if len(chosen) >= max_count:
+                break
+            if any(inp.previous_outpoint.transaction_id in chosen_ids for inp in entry.tx.inputs):
+                continue  # would chain onto an in-block parent
+            chosen.append(entry)
+            chosen_ids.add(txid)
+        return chosen
+
+    # --- orphans (orphan_pool.rs) ---
+
+    def unorphan_candidates(self, created_txids: set[bytes]) -> list[MempoolTx]:
+        """Orphans whose missing parents may now exist; caller revalidates."""
+        out = []
+        for txid in list(self.orphans):
+            entry = self.orphans[txid]
+            if any(inp.previous_outpoint.transaction_id in created_txids for inp in entry.tx.inputs):
+                del self.orphans[txid]
+                out.append(entry)
+        return out

@@ -1,0 +1,114 @@
+"""MiningManager: the mempool facade + block-template pipeline.
+
+Reference: mining/src/manager.rs (validate_and_insert_transaction,
+get_block_template with cache, handle_new_block_transactions) and
+mining/src/block_template/builder.rs.  Tx validation against the virtual
+UTXO view routes through the consensus validator (scripts batched on
+device); templates come from Consensus.build_block_template.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model import Transaction
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
+from kaspa_tpu.mempool.mempool import Mempool, MempoolConfig, MempoolError, MempoolTx
+
+
+@dataclass
+class TemplateCache:
+    """block_template cache (mining/src/cache.rs): short-lived reuse window."""
+
+    template: Block | None = None
+    created: float = 0.0
+    lifetime: float = 1.0  # seconds
+
+    def get(self):
+        if self.template is not None and time.monotonic() - self.created < self.lifetime:
+            return self.template
+        return None
+
+    def set(self, template: Block):
+        self.template = template
+        self.created = time.monotonic()
+
+    def clear(self):
+        self.template = None
+
+
+class MiningManager:
+    def __init__(self, consensus: Consensus, config: MempoolConfig | None = None):
+        self.consensus = consensus
+        self.mempool = Mempool(config)
+        self.template_cache = TemplateCache()
+
+    # --- tx intake (manager.rs:296-421) ---
+
+    def validate_and_insert_transaction(self, tx: Transaction) -> list[bytes]:
+        """Validate against the virtual UTXO view and insert; returns RBF-evicted
+        txids.  Raises MempoolError/TxRuleError on rejection; parks txs with
+        missing inputs in the orphan pool."""
+        validator = self.consensus.transaction_validator
+        validator.validate_tx_in_isolation(tx)
+        virtual = self.consensus.virtual_state
+        validator.validate_tx_in_header_context(tx, virtual.daa_score, virtual.past_median_time)
+
+        view = self.consensus.get_virtual_utxo_view()
+        entries = []
+        missing = False
+        for inp in tx.inputs:
+            entry = view.get(inp.previous_outpoint)
+            if entry is None:
+                missing = True
+                break
+            entries.append(entry)
+        if missing:
+            entry = MempoolTx(tx, fee=0, mass=self._mass(tx), added_daa_score=virtual.daa_score)
+            self.mempool.insert(entry, orphan=True)
+            return []
+
+        checker = validator.new_checker()
+        fee = validator.validate_populated_transaction_and_get_fee(
+            tx, entries, virtual.daa_score, checker=checker, token=0
+        )
+        err = checker.dispatch().get(0)
+        if err is not None:
+            raise TxRuleError(str(err))
+        evicted = self.mempool.insert(MempoolTx(tx, fee, self._mass(tx), virtual.daa_score))
+        self.template_cache.clear()
+        return evicted
+
+    @staticmethod
+    def _mass(tx: Transaction) -> int:
+        """Serialized-size stand-in until the KIP-9 mass calculator lands."""
+        return 200 + sum(len(i.signature_script) + 100 for i in tx.inputs) + sum(
+            len(o.script_public_key.script) + 40 for o in tx.outputs
+        )
+
+    # --- block templates (manager.rs:94-215) ---
+
+    def get_block_template(self, miner_data: MinerData, timestamp: int | None = None) -> Block:
+        cached = self.template_cache.get()
+        if cached is not None:
+            return cached
+        selected = self.mempool.select_transactions()
+        template = self.consensus.build_block_template(miner_data, [e.tx for e in selected], timestamp)
+        self.template_cache.set(template)
+        return template
+
+    # --- new-block notification (manager.rs:605 handle_new_block_transactions) ---
+
+    def handle_new_block_transactions(self, block_txs: list[Transaction], daa_score: int) -> list[MempoolTx]:
+        accepted_ids = [tx.id() for tx in block_txs]
+        self.mempool.handle_accepted_transactions(accepted_ids, daa_score)
+        spent = [inp.previous_outpoint for tx in block_txs for inp in tx.inputs]
+        self.mempool.remove_conflicting(spent)
+        self.mempool.expire(daa_score)
+        self.template_cache.clear()
+        # attempt to unorphan txs whose parents were just created
+        return self.mempool.unorphan_candidates(set(accepted_ids))

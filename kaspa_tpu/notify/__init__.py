@@ -1,0 +1,6 @@
+from kaspa_tpu.notify.notifier import (  # noqa: F401
+    EVENT_TYPES,
+    Notification,
+    Notifier,
+    Subscription,
+)

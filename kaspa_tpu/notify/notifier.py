@@ -1,0 +1,160 @@
+"""Notification pub/sub pipeline.
+
+Reference: notify/src/ (Notifier with per-listener subscriptions,
+Broadcaster, Collector/Subscriber chaining; events.rs EventType).  The
+chain consensus-root -> NotifyService -> IndexService -> RpcCoreService is
+modeled as Notifier stages that can be linked parent->child, with
+UtxosChanged address filtering per listener
+(notify/src/address/ + subscription/).
+
+Synchronous in-process delivery in this round; the async broadcaster tasks
+arrive with the service-runtime milestone.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+# notify/src/events.rs:44-55 (9 event types)
+EVENT_TYPES = (
+    "block-added",
+    "virtual-chain-changed",
+    "finality-conflict",
+    "finality-conflict-resolved",
+    "utxos-changed",
+    "sink-blue-score-changed",
+    "virtual-daa-score-changed",
+    "pruning-point-utxo-set-override",
+    "new-block-template",
+)
+
+
+@dataclass
+class Notification:
+    event_type: str
+    data: dict
+
+
+@dataclass
+class Subscription:
+    """Per-listener, per-event subscription state.
+
+    For utxos-changed: `addresses` empty == all addresses (wildcard),
+    else filter to the tracked set (notify/src/subscription/single.rs).
+    """
+
+    event_type: str
+    active: bool = False
+    addresses: set[bytes] = field(default_factory=set)  # script pubkey filter
+
+    def matches(self, notification: Notification) -> bool:
+        if not self.active or notification.event_type != self.event_type:
+            return False
+        if self.event_type == "utxos-changed" and self.addresses:
+            changed = notification.data.get("spk_set", set())
+            return bool(changed & self.addresses)
+        return True
+
+    def filter(self, notification: Notification) -> Notification:
+        if self.event_type != "utxos-changed" or not self.addresses:
+            return notification
+        data = dict(notification.data)
+        data["added"] = [u for u in data.get("added", []) if u[1].script_public_key.script in self.addresses]
+        data["removed"] = [u for u in data.get("removed", []) if u[1].script_public_key.script in self.addresses]
+        return Notification(notification.event_type, data)
+
+
+class Listener:
+    def __init__(self, listener_id: int, callback: Callable[[Notification], None]):
+        self.id = listener_id
+        self.callback = callback
+        self.subscriptions: dict[str, Subscription] = {e: Subscription(e) for e in EVENT_TYPES}
+
+
+class Notifier:
+    """notify/src/notifier.rs: listener registry + dispatch + upstream link."""
+
+    def __init__(self, name: str = "notifier", parent: "Notifier | None" = None):
+        self.name = name
+        self._listeners: dict[int, Listener] = {}
+        self._next_id = 1
+        self.parent = parent
+        self._parent_listener_id = None
+        if parent is not None:
+            # Subscriber: propagate notifications (and subscriptions) upstream
+            self._parent_listener_id = parent.register(self.notify)
+
+    def register(self, callback: Callable[[Notification], None]) -> int:
+        lid = self._next_id
+        self._next_id += 1
+        self._listeners[lid] = Listener(lid, callback)
+        return lid
+
+    def unregister(self, listener_id: int) -> None:
+        self._listeners.pop(listener_id, None)
+
+    def start_notify(self, listener_id: int, event_type: str, addresses: set[bytes] | None = None) -> None:
+        sub = self._listeners[listener_id].subscriptions[event_type]
+        sub.active = True
+        if addresses is not None:
+            sub.addresses |= addresses
+        if self.parent is not None:
+            self.parent.start_notify(self._parent_listener_id, event_type, addresses)
+
+    def stop_notify(self, listener_id: int, event_type: str, addresses: set[bytes] | None = None) -> None:
+        sub = self._listeners[listener_id].subscriptions[event_type]
+        if addresses:
+            if not sub.addresses:
+                return  # wildcard subscription: removing specific addresses is a no-op
+            sub.addresses -= addresses
+            if sub.addresses:
+                return
+        sub.active = False
+        sub.addresses.clear()
+        # propagate the stop upstream only once no local listener needs the event
+        if self.parent is not None and not any(
+            l.subscriptions[event_type].active for l in self._listeners.values()
+        ):
+            self.parent.stop_notify(self._parent_listener_id, event_type)
+
+    def notify(self, notification: Notification) -> None:
+        """Broadcast to all matching listeners (Broadcaster role)."""
+        for listener in list(self._listeners.values()):
+            sub = listener.subscriptions.get(notification.event_type)
+            if sub is not None and sub.matches(notification):
+                listener.callback(sub.filter(notification))
+
+
+class ConsensusNotificationRoot(Notifier):
+    """consensus/notify/src/root.rs: the source of consensus events."""
+
+    def __init__(self):
+        super().__init__("consensus-root")
+
+    def notify_block_added(self, block):
+        self.notify(Notification("block-added", {"block": block}))
+
+    def notify_virtual_change(self, virtual_state, added_utxos, removed_utxos):
+        self.notify(
+            Notification(
+                "virtual-daa-score-changed",
+                {"daa_score": virtual_state.daa_score},
+            )
+        )
+        self.notify(
+            Notification(
+                "sink-blue-score-changed",
+                {"blue_score": virtual_state.ghostdag_data.blue_score},
+            )
+        )
+        if added_utxos or removed_utxos:
+            spk_set = {e.script_public_key.script for _, e in added_utxos} | {
+                e.script_public_key.script for _, e in removed_utxos
+            }
+            self.notify(
+                Notification(
+                    "utxos-changed",
+                    {"added": added_utxos, "removed": removed_utxos, "spk_set": spk_set},
+                )
+            )

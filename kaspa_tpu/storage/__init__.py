@@ -1,0 +1,1 @@
+from kaspa_tpu.storage.kv import KvStore, open_store  # noqa: F401

@@ -1,0 +1,280 @@
+"""Persistent KV store: ctypes bindings over the native C++ engine.
+
+The storage layer counterpart of the reference's kaspa-database
+(database/src/: DB + DbWriter/BatchDbWriter + prefixed stores).  The C++
+engine (native/kvstore/kvstore.cc) provides crash-consistent CRC-framed
+atomic write batches over an append log with in-memory index; this module
+adds the typed prefixed-store access layer (registry.rs/access.rs shape).
+
+Builds the shared library on first use (g++, cached beside the source);
+a pure-python fallback engine keeps tests running without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvstore", "kvstore.cc")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvstore", "libkvstore.so")
+_BUILD_LOCK = threading.Lock()
+
+
+def _build_native():
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+        return _LIB_PATH
+    with _BUILD_LOCK:
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+            return _LIB_PATH
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB_PATH, _SRC],
+            check=True,
+            capture_output=True,
+        )
+    return _LIB_PATH
+
+
+_ITER_CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_void_p)
+
+
+class _NativeEngine:
+    def __init__(self, path: str):
+        lib = ctypes.CDLL(_build_native())
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_get.restype = ctypes.c_int64
+        lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_batch_begin.argtypes = [ctypes.c_void_p]
+        lib.kv_batch_commit.argtypes = [ctypes.c_void_p]
+        lib.kv_len.restype = ctypes.c_uint64
+        lib.kv_len.argtypes = [ctypes.c_void_p]
+        lib.kv_iterate.argtypes = [ctypes.c_void_p, _ITER_CB, ctypes.c_void_p]
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.kv_open(path.encode())
+        if not self._h:
+            raise IOError(f"failed to open kv store at {path}")
+
+    def put(self, key: bytes, value: bytes):
+        rc = self._lib.kv_put(self._h, key, len(key), value, len(value))
+        if rc != 0:
+            raise IOError(f"kv_put failed: {rc}")
+
+    def get(self, key: bytes):
+        n = self._lib.kv_get(self._h, key, len(key), None, 0)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(n)
+        self._lib.kv_get(self._h, key, len(key), buf, n)
+        return buf.raw
+
+    def delete(self, key: bytes):
+        self._lib.kv_delete(self._h, key, len(key))
+
+    def batch_begin(self):
+        rc = self._lib.kv_batch_begin(self._h)
+        if rc != 0:
+            raise IOError(f"kv_batch_begin failed: {rc}")
+
+    def batch_commit(self):
+        rc = self._lib.kv_batch_commit(self._h)
+        if rc != 0:
+            raise IOError(f"kv_batch_commit failed: {rc}")
+
+    def __len__(self):
+        return self._lib.kv_len(self._h)
+
+    def items(self):
+        out = []
+
+        def cb(k, klen, v, vlen, _ctx):
+            out.append((ctypes.string_at(k, klen), ctypes.string_at(v, vlen)))
+
+        self._lib.kv_iterate(self._h, _ITER_CB(cb), None)
+        return out
+
+    def compact(self):
+        rc = self._lib.kv_compact(self._h)
+        if rc != 0:
+            raise IOError(f"kv_compact failed: {rc}")
+
+    def close(self):
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+
+class _PythonEngine:
+    """Fallback with the same log format semantics (non-durable simplification:
+    full-file rewrite on close/compact, in-memory otherwise)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.index: dict[bytes, bytes] = {}
+        self._batch = False
+        if os.path.exists(path):
+            self._replay()
+        self._log = open(path, "ab")
+        self._pending = bytearray()
+
+    def _replay(self):
+        import zlib
+
+        with open(self.path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + 12 <= len(data):
+            if data[off : off + 4] != b"KBAT":
+                break
+            (plen,) = struct.unpack_from("<I", data, off + 4)
+            end = off + 8 + plen
+            if end + 4 > len(data):
+                break
+            payload = data[off + 8 : end]
+            (crc,) = struct.unpack_from("<I", data, end)
+            if zlib.crc32(payload) != crc:
+                break
+            p = 0
+            while p < plen:
+                op = payload[p]
+                klen, vlen = struct.unpack_from("<II", payload, p + 1)
+                p += 9
+                key = payload[p : p + klen]
+                p += klen
+                if op == 0:
+                    self.index[key] = payload[p : p + vlen]
+                else:
+                    self.index.pop(key, None)
+                p += vlen
+            off = end + 4
+
+    def put(self, key, value):
+        self._pending += bytes([0]) + struct.pack("<II", len(key), len(value)) + key + value
+        self.index[key] = value
+        if not self._batch:
+            self._flush()
+
+    def delete(self, key):
+        self._pending += bytes([1]) + struct.pack("<II", len(key), 0) + key
+        self.index.pop(key, None)
+        if not self._batch:
+            self._flush()
+
+    def _flush(self):
+        import zlib
+
+        if not self._pending:
+            return
+        payload = bytes(self._pending)
+        self._log.write(b"KBAT" + struct.pack("<I", len(payload)) + payload + struct.pack("<I", zlib.crc32(payload)))
+        self._log.flush()
+        self._pending = bytearray()
+
+    def get(self, key):
+        return self.index.get(key)
+
+    def batch_begin(self):
+        self._batch = True
+
+    def batch_commit(self):
+        self._batch = False
+        self._flush()
+
+    def __len__(self):
+        return len(self.index)
+
+    def items(self):
+        return list(self.index.items())
+
+    def compact(self):
+        pass
+
+    def close(self):
+        self._flush()
+        self._log.close()
+
+
+def open_store(path: str, native: bool = True):
+    if native:
+        try:
+            return _NativeEngine(path)
+        except Exception:
+            pass
+    return _PythonEngine(path)
+
+
+class KvStore:
+    """Typed prefixed access (database/src/registry.rs + access.rs shape)."""
+
+    def __init__(self, path: str, native: bool = True):
+        self.engine = open_store(path, native)
+
+    def prefixed(self, prefix: bytes) -> "PrefixedStore":
+        return PrefixedStore(self.engine, prefix)
+
+    def batch(self):
+        return _Batch(self.engine)
+
+    def close(self):
+        self.engine.close()
+
+
+class PrefixedStore:
+    def __init__(self, engine, prefix: bytes):
+        self.engine = engine
+        self.prefix = prefix
+
+    def put(self, key: bytes, value: bytes):
+        self.engine.put(self.prefix + key, value)
+
+    def get(self, key: bytes):
+        return self.engine.get(self.prefix + key)
+
+    def delete(self, key: bytes):
+        self.engine.delete(self.prefix + key)
+
+    def items(self):
+        n = len(self.prefix)
+        return [(k[n:], v) for k, v in self.engine.items() if k.startswith(self.prefix)]
+
+
+class _Batch:
+    """Atomic write batch with a real abort path.
+
+    Mutations are buffered python-side and only touch the engine inside a
+    begin/commit frame on successful exit — an exception inside the `with`
+    leaves both the engine index and the log completely untouched
+    (BatchDbWriter semantics, database/src/writer.rs)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._ops: list[tuple] = []
+
+    def put(self, key: bytes, value: bytes):
+        self._ops.append(("put", key, value))
+
+    def delete(self, key: bytes):
+        self._ops.append(("del", key, None))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self._ops:
+            self.engine.batch_begin()
+            try:
+                for op, key, value in self._ops:
+                    if op == "put":
+                        self.engine.put(key, value)
+                    else:
+                        self.engine.delete(key)
+            finally:
+                self.engine.batch_commit()
+        self._ops.clear()
+        return False

@@ -1,0 +1,835 @@
+"""TxScript VM: the general-script execution engine (host side).
+
+Faithful re-implementation of the reference's TxScriptEngine
+(crypto/txscript/src/lib.rs:156-, opcodes/mod.rs) for the pre-Toccata
+opcode set: data pushes, flow control, stack/splice ops, comparison and
+arithmetic (8-byte minimally-encoded numbers), crypto opcodes
+(Blake2b/SHA256/CheckSig/CheckMultiSig families) and lock-time/sequence
+verification, plus P2SH evaluation.  Post-Toccata extensions (covenants,
+introspection, ZK precompiles, runtime resource metering) are flag-gated
+exactly like the reference and land in a later milestone.
+
+This is the fall-back path behind the TPU batch fast-path
+(txscript/batch.py): nonstandard scripts route here; standard P2PK spends
+never do.  Signature checks inside the VM go through the shared sig cache
+and the same device batch API (single-item batches) so acceptance
+decisions are identical either way.
+
+Limits (lib.rs:76-87): stack 244 combined, element 520 bytes, script
+10_000 bytes, 201 non-push ops, 20 multisig keys.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.txscript.caches import SigCache
+
+MAX_STACK_SIZE = 244
+MAX_SCRIPTS_SIZE = 10_000
+MAX_SCRIPT_ELEMENT_SIZE = 520
+MAX_OPS_PER_SCRIPT = 201
+MAX_PUB_KEYS_PER_MULTISIG = 20
+NO_COST_OPCODE = 0x60  # opcodes <= Op16 don't count toward the ops limit
+LOCK_TIME_THRESHOLD = 500_000_000_000
+MAX_TX_IN_SEQUENCE_NUM = (1 << 64) - 1
+SEQUENCE_LOCK_TIME_DISABLED = 1 << 63
+SEQUENCE_LOCK_TIME_MASK = 0x00000000FFFFFFFF
+
+OP_0 = 0x00
+OP_PUSHDATA1, OP_PUSHDATA2, OP_PUSHDATA4 = 0x4C, 0x4D, 0x4E
+OP_1NEGATE = 0x4F
+OP_RESERVED = 0x50
+OP_1, OP_16 = 0x51, 0x60
+
+_DISABLED = {0x80, 0x81, 0x8D, 0x8E, 0x98, 0x99}  # Left,Right,2Mul,2Div,LShift,RShift
+# covenant-gated (Toccata) ops are disabled pre-fork exactly like the
+# reference (opcodes/mod.rs bodies error OpcodeDisabled when the flag is off):
+# Invert,And,Or,Xor, Cat,Substr, Mul,Div,Mod
+_PRE_TOCCATA_DISABLED = {0x83, 0x84, 0x85, 0x86, 0x7E, 0x7F, 0x95, 0x96, 0x97}
+_ALWAYS_ILLEGAL = {0x65, 0x66}  # VerIf, VerNotIf
+_RESERVED = {0x50, 0x62, 0x89, 0x8A}  # Reserved, Ver, Reserved1, Reserved2
+
+I64_MIN, I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+class TxScriptError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# number / bool codec (data_stack.rs)
+# ---------------------------------------------------------------------------
+
+def check_minimal_data_encoding(v: bytes) -> None:
+    if not v:
+        return
+    if v[-1] & 0x7F == 0:
+        if len(v) == 1 or v[-2] & 0x80 == 0:
+            raise TxScriptError(f"numeric value {v.hex()} is not minimally encoded")
+
+
+def deserialize_i64(v: bytes, enforce_minimal: bool, max_len: int = 8) -> int:
+    if len(v) > max_len:
+        raise TxScriptError(f"numeric value {v.hex()} exceeds max length {max_len}")
+    if len(v) == 0:
+        return 0
+    if enforce_minimal:
+        check_minimal_data_encoding(v)
+    msb = v[-1]
+    sign = 1 - 2 * (msb >> 7)
+    acc = msb & 0x7F
+    for byte in reversed(v[:-1]):
+        acc = (acc << 8) + byte
+    return acc * sign
+
+
+def serialize_i64(value: int) -> bytes:
+    """Sign-magnitude little-endian (data_stack.rs serialize_i64)."""
+    if value == 0:
+        return b""
+    negative = value < 0
+    positive = abs(value)
+    out = bytearray()
+    while positive:
+        out.append(positive & 0xFF)
+        positive >>= 8
+    if out[-1] & 0x80:
+        out.append(0x80 if negative else 0x00)
+    elif negative:
+        out[-1] |= 0x80
+    return bytes(out)
+
+
+def as_bool(v: bytes) -> bool:
+    """Nonzero excluding negative zero (data_stack.rs bool deserialize)."""
+    if not v:
+        return False
+    return (v[-1] & 0x7F) != 0 or any(b != 0 for b in v[:-1])
+
+
+# ---------------------------------------------------------------------------
+# script parsing (opcode stream)
+# ---------------------------------------------------------------------------
+
+def parse_script(script: bytes):
+    """Yields (opcode, data, opcode_len) — errors on truncated pushes."""
+    i = 0
+    n = len(script)
+    while i < n:
+        op = script[i]
+        if 1 <= op <= 75:
+            end = i + 1 + op
+            if end > n:
+                raise TxScriptError(f"truncated push of {op} bytes")
+            yield op, script[i + 1 : end]
+            i = end
+        elif op == OP_PUSHDATA1:
+            if i + 2 > n:
+                raise TxScriptError("truncated pushdata1 length")
+            ln = script[i + 1]
+            end = i + 2 + ln
+            if end > n:
+                raise TxScriptError("truncated pushdata1")
+            yield op, script[i + 2 : end]
+            i = end
+        elif op == OP_PUSHDATA2:
+            if i + 3 > n:
+                raise TxScriptError("truncated pushdata2 length")
+            ln = int.from_bytes(script[i + 1 : i + 3], "little")
+            end = i + 3 + ln
+            if end > n:
+                raise TxScriptError("truncated pushdata2")
+            yield op, script[i + 3 : end]
+            i = end
+        elif op == OP_PUSHDATA4:
+            if i + 5 > n:
+                raise TxScriptError("truncated pushdata4 length")
+            ln = int.from_bytes(script[i + 1 : i + 5], "little")
+            end = i + 5 + ln
+            if end > n:
+                raise TxScriptError("truncated pushdata4")
+            yield op, script[i + 5 : end]
+            i = end
+        else:
+            yield op, None
+            i += 1
+
+
+def is_push_opcode(op: int) -> bool:
+    """Opcodes through Op16 (incl. reserved 0x50) count as pushes (lib.rs:616)."""
+    return op <= NO_COST_OPCODE
+
+
+def check_minimal_data_push(op: int, data: bytes) -> None:
+    """opcodes/macros.rs check_minimal_data_push (bitcoin minimal-push rules)."""
+    ln = len(data)
+    if ln == 0:
+        if op != OP_0:
+            raise TxScriptError("empty data push must use OP_0")
+    elif ln == 1 and 1 <= data[0] <= 16:
+        if op != OP_1 + data[0] - 1:
+            raise TxScriptError(f"push of {data[0]} must use OP_{data[0]}")
+    elif ln == 1 and data[0] == 0x81:
+        if op != OP_1NEGATE:
+            raise TxScriptError("push of -1 must use OP_1NEGATE")
+    elif ln <= 75:
+        if op != ln:
+            raise TxScriptError(f"push of {ln} bytes must use direct push")
+    elif ln <= 255:
+        if op != OP_PUSHDATA1:
+            raise TxScriptError("push must use OP_PUSHDATA1")
+    elif ln <= 65535:
+        if op != OP_PUSHDATA2:
+            raise TxScriptError("push must use OP_PUSHDATA2")
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+_COND_TRUE, _COND_FALSE, _COND_SKIP = 1, 0, -1
+
+
+class TxScriptEngine:
+    """Executes (signature_script, script_public_key[, p2sh]) for one input."""
+
+    def __init__(self, tx=None, utxo_entries=None, input_index: int = 0, reused=None, sig_cache: SigCache | None = None):
+        self.tx = tx
+        self.utxo_entries = utxo_entries
+        self.input_index = input_index
+        self.reused = reused if reused is not None else chash.SigHashReusedValues()
+        self.sig_cache = sig_cache if sig_cache is not None else SigCache()
+        self.dstack: list[bytes] = []
+        self.astack: list[bytes] = []
+        self.cond_stack: list[int] = []
+        self.num_ops = 0
+
+    # --- stack helpers ---
+
+    def _push(self, item: bytes):
+        self.dstack.append(item)
+
+    def _pop(self) -> bytes:
+        if not self.dstack:
+            raise TxScriptError("attempt to pop from empty stack")
+        return self.dstack.pop()
+
+    def _pop_num(self, max_len: int = 8) -> int:
+        return deserialize_i64(self._pop(), enforce_minimal=True, max_len=max_len)
+
+    def _pop_i32(self) -> int:
+        v = deserialize_i64(self._pop(), enforce_minimal=True, max_len=4)
+        return v
+
+    def _pop_bool(self) -> bool:
+        return as_bool(self._pop())
+
+    def _push_num(self, v: int):
+        if not (I64_MIN <= v <= I64_MAX):
+            raise TxScriptError("number exceeds 64-bit signed integer range")
+        self._push(serialize_i64(v))
+
+    def _push_bool(self, b: bool):
+        self._push(b"\x01" if b else b"")
+
+    def _peek(self, depth: int = 0) -> bytes:
+        if len(self.dstack) <= depth:
+            raise TxScriptError("invalid stack operation")
+        return self.dstack[-1 - depth]
+
+    def is_executing(self) -> bool:
+        return all(c == _COND_TRUE for c in self.cond_stack)
+
+    # --- public entry points ---
+
+    def execute(self) -> None:
+        """Full input execution: sig script, spk, optional p2sh redeem."""
+        from kaspa_tpu.txscript import standard
+
+        entry = self.utxo_entries[self.input_index]
+        spk = entry.script_public_key
+        if spk.version > standard.MAX_SCRIPT_PUBLIC_KEY_VERSION:
+            return  # unknown versions are accepted without execution
+        sig_script = self.tx.inputs[self.input_index].signature_script
+        is_p2sh = standard.is_pay_to_script_hash(spk.script)
+        scripts = [sig_script, spk.script]
+        if not any(scripts):
+            raise TxScriptError("false stack entry at end of script execution")
+        for s in scripts:
+            if len(s) > MAX_SCRIPTS_SIZE:
+                raise TxScriptError(f"script size {len(s)} above limit")
+
+        saved_stack = None
+        for idx, s in enumerate(scripts):
+            if not s:
+                continue
+            if is_p2sh and idx == 1:
+                saved_stack = list(self.dstack)
+            self.execute_script(s, verify_only_push=(idx == 0))
+        if is_p2sh:
+            self._check_error_condition(final_script=False)
+            if saved_stack is None:
+                raise TxScriptError("empty stack for p2sh redeem")
+            self.dstack = saved_stack
+            redeem = self._pop()
+            self.execute_script(redeem, verify_only_push=False)
+        self._check_error_condition(final_script=True)
+
+    def execute_standalone(self, script: bytes) -> None:
+        """StandAloneScripts source (tests / script-builder checks)."""
+        if len(script) > MAX_SCRIPTS_SIZE:
+            raise TxScriptError("script too large")
+        if not script:
+            raise TxScriptError("no scripts to execute")
+        self.execute_script(script, verify_only_push=False)
+        self._check_error_condition(final_script=True)
+
+    def _check_error_condition(self, final_script: bool) -> None:
+        if final_script:
+            if len(self.dstack) > 1:
+                raise TxScriptError(f"stack contains {len(self.dstack) - 1} unexpected items")
+            if len(self.dstack) < 1:
+                raise TxScriptError("stack empty at end of script execution")
+        if not self._pop_bool():
+            raise TxScriptError("false stack entry at end of script execution")
+
+    # --- script execution ---
+
+    def execute_script(self, script: bytes, verify_only_push: bool) -> None:
+        for op, data in parse_script(script):
+            if op in _DISABLED or op in _PRE_TOCCATA_DISABLED:
+                raise TxScriptError(f"attempt to execute disabled opcode {op:#x}")
+            if op in _ALWAYS_ILLEGAL:
+                raise TxScriptError(f"attempt to execute reserved opcode {op:#x}")
+            if verify_only_push and not is_push_opcode(op):
+                raise TxScriptError("signature script is not push only")
+            self._execute_opcode(op, data)
+            if len(self.dstack) + len(self.astack) > MAX_STACK_SIZE:
+                raise TxScriptError(f"combined stack size > {MAX_STACK_SIZE}")
+        if self.cond_stack:
+            raise TxScriptError("end of script reached in conditional execution")
+        self.astack.clear()
+        self.num_ops = 0
+
+    def _execute_opcode(self, op: int, data: bytes | None) -> None:
+        if not is_push_opcode(op):
+            self.num_ops += 1
+            if self.num_ops > MAX_OPS_PER_SCRIPT:
+                raise TxScriptError(f"exceeded max operation limit of {MAX_OPS_PER_SCRIPT}")
+        elif data is not None and len(data) > MAX_SCRIPT_ELEMENT_SIZE:
+            raise TxScriptError(f"element size {len(data)} above limit")
+
+        executing = self.is_executing()
+        if not executing and not (0x63 <= op <= 0x68):  # conditionals always run
+            return
+
+        if data is not None:  # push opcodes with payload
+            if executing:
+                check_minimal_data_push(op, data)
+                self._push(data)
+            return
+
+        self._OPS[op](self)
+
+    # --- opcode implementations ---
+
+    def _op_false(self):
+        self._push(b"")
+
+    def _op_1negate(self):
+        self._push_num(-1)
+
+    def _op_reserved(self):
+        raise TxScriptError(f"attempt to execute reserved opcode")
+
+    def _op_n(self, n: int):
+        self._push_num(n)
+
+    def _op_nop(self):
+        pass
+
+    def _op_if(self):
+        if self.is_executing():
+            cond_buf = self._pop()
+            if len(cond_buf) > 1:
+                raise TxScriptError("expected boolean")
+            cond = _COND_TRUE if cond_buf == b"\x01" else (_COND_FALSE if cond_buf == b"" else None)
+            if cond is None:
+                raise TxScriptError("expected boolean")
+        else:
+            cond = _COND_SKIP
+        self.cond_stack.append(cond)
+
+    def _op_notif(self):
+        if self.is_executing():
+            cond_buf = self._pop()
+            if len(cond_buf) > 1:
+                raise TxScriptError("expected boolean")
+            cond = _COND_FALSE if cond_buf == b"\x01" else (_COND_TRUE if cond_buf == b"" else None)
+            if cond is None:
+                raise TxScriptError("expected boolean")
+        else:
+            cond = _COND_SKIP
+        self.cond_stack.append(cond)
+
+    def _op_else(self):
+        if not self.cond_stack:
+            raise TxScriptError("condition stack empty")
+        top = self.cond_stack[-1]
+        if top == _COND_TRUE:
+            self.cond_stack[-1] = _COND_FALSE
+        elif top == _COND_FALSE:
+            self.cond_stack[-1] = _COND_TRUE
+        # skip stays skip
+
+    def _op_endif(self):
+        if not self.cond_stack:
+            raise TxScriptError("condition stack empty")
+        self.cond_stack.pop()
+
+    def _op_verify(self):
+        if not self._pop_bool():
+            raise TxScriptError("verify failed")
+
+    def _op_return(self):
+        raise TxScriptError("early return")
+
+    def _op_toaltstack(self):
+        self.astack.append(self._pop())
+
+    def _op_fromaltstack(self):
+        if not self.astack:
+            raise TxScriptError("alt stack empty")
+        self._push(self.astack.pop())
+
+    def _op_2drop(self):
+        self._pop(), self._pop()
+
+    def _op_2dup(self):
+        a, b = self._peek(1), self._peek(0)
+        self._push(a), self._push(b)
+
+    def _op_3dup(self):
+        a, b, c = self._peek(2), self._peek(1), self._peek(0)
+        self._push(a), self._push(b), self._push(c)
+
+    def _op_2over(self):
+        a, b = self._peek(3), self._peek(2)
+        self._push(a), self._push(b)
+
+    def _op_2rot(self):
+        if len(self.dstack) < 6:
+            raise TxScriptError("invalid stack operation")
+        chunk = self.dstack[-6:-4]
+        del self.dstack[-6:-4]
+        self.dstack.extend(chunk)
+
+    def _op_2swap(self):
+        if len(self.dstack) < 4:
+            raise TxScriptError("invalid stack operation")
+        chunk = self.dstack[-4:-2]
+        del self.dstack[-4:-2]
+        self.dstack.extend(chunk)
+
+    def _op_ifdup(self):
+        top = self._peek()
+        if as_bool(top):
+            self._push(top)
+
+    def _op_depth(self):
+        self._push_num(len(self.dstack))
+
+    def _op_drop(self):
+        self._pop()
+
+    def _op_dup(self):
+        self._push(self._peek())
+
+    def _op_nip(self):
+        if len(self.dstack) < 2:
+            raise TxScriptError("invalid stack operation")
+        del self.dstack[-2]
+
+    def _op_over(self):
+        self._push(self._peek(1))
+
+    def _op_pick(self):
+        n = self._pop_i32()
+        if n < 0 or n >= len(self.dstack):
+            raise TxScriptError("pick at an invalid location")
+        self._push(self.dstack[-1 - n])
+
+    def _op_roll(self):
+        n = self._pop_i32()
+        if n < 0 or n >= len(self.dstack):
+            raise TxScriptError("roll at an invalid location")
+        item = self.dstack.pop(-1 - n)
+        self._push(item)
+
+    def _op_rot(self):
+        if len(self.dstack) < 3:
+            raise TxScriptError("invalid stack operation")
+        item = self.dstack.pop(-3)
+        self._push(item)
+
+    def _op_swap(self):
+        if len(self.dstack) < 2:
+            raise TxScriptError("invalid stack operation")
+        self.dstack[-1], self.dstack[-2] = self.dstack[-2], self.dstack[-1]
+
+    def _op_tuck(self):
+        if len(self.dstack) < 2:
+            raise TxScriptError("invalid stack operation")
+        self.dstack.insert(-2, self.dstack[-1])
+
+    # OpCat (0x7E) / OpSubstr (0x7F) are covenant-gated: they arrive with the
+    # Toccata milestone (reference pops (start, end) for Substr — note the
+    # operand convention when implementing).
+
+    def _op_size(self):
+        self._push_num(len(self._peek()))
+
+    def _op_equal(self):
+        b = self._pop()
+        a = self._pop()
+        self._push_bool(a == b)
+
+    def _op_equalverify(self):
+        self._op_equal()
+        if not self._pop_bool():
+            raise TxScriptError("equal verify failed")
+
+    def _op_1add(self):
+        self._push_num(self._checked(self._pop_num() + 1))
+
+    def _op_1sub(self):
+        self._push_num(self._checked(self._pop_num() - 1))
+
+    def _op_negate(self):
+        self._push_num(self._checked(-self._pop_num()))
+
+    def _op_abs(self):
+        self._push_num(self._checked(abs(self._pop_num())))
+
+    def _op_not(self):
+        self._push_num(1 if self._pop_num() == 0 else 0)
+
+    def _op_0notequal(self):
+        self._push_num(0 if self._pop_num() == 0 else 1)
+
+    def _op_add(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(self._checked(a + b))
+
+    def _op_sub(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(self._checked(a - b))
+
+    @staticmethod
+    def _checked(v: int) -> int:
+        if not (I64_MIN <= v <= I64_MAX):
+            raise TxScriptError("result exceeds 64-bit signed integer range")
+        return v
+
+    def _op_booland(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if (a != 0 and b != 0) else 0)
+
+    def _op_boolor(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if (a != 0 or b != 0) else 0)
+
+    def _op_numequal(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if a == b else 0)
+
+    def _op_numequalverify(self):
+        self._op_numequal()
+        if not self._pop_bool():
+            raise TxScriptError("num equal verify failed")
+
+    def _op_numnotequal(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if a != b else 0)
+
+    def _op_lessthan(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if a < b else 0)
+
+    def _op_greaterthan(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if a > b else 0)
+
+    def _op_lessthanorequal(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if a <= b else 0)
+
+    def _op_greaterthanorequal(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(1 if a >= b else 0)
+
+    def _op_min(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(min(a, b))
+
+    def _op_max(self):
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(max(a, b))
+
+    def _op_within(self):
+        mx, mn, x = self._pop_num(), self._pop_num(), self._pop_num()
+        self._push_num(1 if mn <= x < mx else 0)
+
+    def _op_sha256(self):
+        self._push(hashlib.sha256(self._pop()).digest())
+
+    def _op_blake2b(self):
+        self._push(hashlib.blake2b(self._pop(), digest_size=32).digest())
+
+    # --- signature checks (lib.rs:885-942 semantics via the batch backend) ---
+
+    def _require_tx(self):
+        if self.tx is None:
+            raise TxScriptError("not a transaction input")
+
+    def _verify_schnorr(self, key: bytes, sig: bytes, hash_type: int) -> bool:
+        from kaspa_tpu.crypto import eclib
+
+        self._require_tx()
+        if len(key) != 32:
+            raise TxScriptError("invalid public key encoding")
+        if eclib.lift_x(int.from_bytes(key, "big")) is None:
+            raise TxScriptError("invalid public key")
+        if len(sig) != 64:
+            raise TxScriptError("invalid signature length")
+        msg = chash.calc_schnorr_signature_hash(self.tx, self.utxo_entries, self.input_index, hash_type, self.reused)
+        cache_key = ("schnorr", sig, msg, key)
+        cached = self.sig_cache.get(cache_key)
+        if cached is None:
+            cached = eclib.schnorr_verify(key, msg, sig)
+            self.sig_cache.insert(cache_key, cached)
+        return cached
+
+    def _verify_ecdsa(self, key: bytes, sig: bytes, hash_type: int) -> bool:
+        from kaspa_tpu.crypto import eclib
+
+        self._require_tx()
+        if len(key) != 33 or key[0] not in (2, 3):
+            raise TxScriptError("invalid public key encoding")
+        if eclib.parse_compressed(key) is None:
+            raise TxScriptError("invalid public key")
+        if len(sig) != 64:
+            raise TxScriptError("invalid signature length")
+        msg = chash.calc_ecdsa_signature_hash(self.tx, self.utxo_entries, self.input_index, hash_type, self.reused)
+        cache_key = ("ecdsa", sig, msg, key)
+        cached = self.sig_cache.get(cache_key)
+        if cached is None:
+            cached = eclib.ecdsa_verify(key, msg, sig)
+            self.sig_cache.insert(cache_key, cached)
+        return cached
+
+    def _op_checksig_impl(self, ecdsa: bool):
+        sig_raw, key = self.dstack[-2:] if len(self.dstack) >= 2 else (None, None)
+        if key is None:
+            raise TxScriptError("invalid stack operation")
+        del self.dstack[-2:]
+        if not sig_raw:
+            self._push_bool(False)
+            return
+        typ = sig_raw[-1]
+        if typ not in chash.ALLOWED_SIG_HASH_TYPES:
+            raise TxScriptError(f"invalid hash type {typ:#x}")
+        sig = sig_raw[:-1]
+        valid = self._verify_ecdsa(key, sig, typ) if ecdsa else self._verify_schnorr(key, sig, typ)
+        self._push_bool(valid)
+
+    def _op_checksig_schnorr(self):
+        self._op_checksig_impl(ecdsa=False)
+
+    def _op_checksig_ecdsa(self):
+        self._op_checksig_impl(ecdsa=True)
+
+    def _op_checksigverify(self):
+        self._op_checksig_schnorr()
+        if not self._pop_bool():
+            raise TxScriptError("checksig verify failed")
+
+    def _op_checkmultisig_impl(self, ecdsa: bool):
+        num_keys = self._pop_i32()
+        if num_keys < 0:
+            raise TxScriptError("number of pubkeys is negative")
+        if num_keys > MAX_PUB_KEYS_PER_MULTISIG:
+            raise TxScriptError(f"too many pubkeys {num_keys} > {MAX_PUB_KEYS_PER_MULTISIG}")
+        self.num_ops += num_keys
+        if self.num_ops > MAX_OPS_PER_SCRIPT:
+            raise TxScriptError("exceeded max operation limit")
+        if len(self.dstack) < num_keys:
+            raise TxScriptError("invalid stack operation")
+        pub_keys = self.dstack[len(self.dstack) - num_keys :] if num_keys else []
+        del self.dstack[len(self.dstack) - num_keys :]
+        num_sigs = self._pop_i32()
+        if num_sigs < 0:
+            raise TxScriptError("number of signatures is negative")
+        if num_sigs > num_keys:
+            raise TxScriptError("more signatures than pubkeys")
+        if len(self.dstack) < num_sigs:
+            raise TxScriptError("invalid stack operation")
+        signatures = self.dstack[len(self.dstack) - num_sigs :] if num_sigs else []
+        del self.dstack[len(self.dstack) - num_sigs :]
+
+        failed = False
+        key_pos = 0
+        for sig_idx, signature in enumerate(signatures):
+            if not signature:
+                failed = True
+                break
+            typ = signature[-1]
+            if typ not in chash.ALLOWED_SIG_HASH_TYPES:
+                raise TxScriptError(f"invalid hash type {typ:#x}")
+            sig = signature[:-1]
+            while True:
+                if len(pub_keys) - key_pos < num_sigs - sig_idx:
+                    failed = True
+                    break
+                key = pub_keys[key_pos]
+                key_pos += 1
+                valid = self._verify_ecdsa(key, sig, typ) if ecdsa else self._verify_schnorr(key, sig, typ)
+                if valid:
+                    break
+            if failed:
+                break
+        if failed and any(s for s in signatures):
+            raise TxScriptError("not all signatures empty on failed checkmultisig")
+        self._push_bool(not failed)
+
+    def _op_checkmultisig(self):
+        self._op_checkmultisig_impl(ecdsa=False)
+
+    def _op_checkmultisig_ecdsa(self):
+        self._op_checkmultisig_impl(ecdsa=True)
+
+    def _op_checkmultisigverify(self):
+        self._op_checkmultisig()
+        if not self._pop_bool():
+            raise TxScriptError("checkmultisig verify failed")
+
+    def _op_checklocktimeverify(self):
+        self._require_tx()
+        raw = self._pop()
+        if len(raw) > 8:
+            raise TxScriptError("lockTime value longer than 8 bytes")
+        stack_lock_time = int.from_bytes(raw.ljust(8, b"\x00"), "little")
+        tx_lock = self.tx.lock_time
+        same_kind = (tx_lock < LOCK_TIME_THRESHOLD) == (stack_lock_time < LOCK_TIME_THRESHOLD)
+        if not same_kind:
+            raise TxScriptError("mismatched locktime types")
+        if stack_lock_time > tx_lock:
+            raise TxScriptError("locktime requirement not satisfied")
+        if self.tx.inputs[self.input_index].sequence == MAX_TX_IN_SEQUENCE_NUM:
+            raise TxScriptError("transaction input is finalized")
+
+    def _op_checksequenceverify(self):
+        self._require_tx()
+        raw = self._pop()
+        if len(raw) > 8:
+            raise TxScriptError("sequence value longer than 8 bytes")
+        stack_sequence = int.from_bytes(raw.ljust(8, b"\x00"), "little")
+        if stack_sequence & SEQUENCE_LOCK_TIME_DISABLED:
+            return
+        input_seq = self.tx.inputs[self.input_index].sequence
+        if input_seq & SEQUENCE_LOCK_TIME_DISABLED:
+            raise TxScriptError("transaction sequence has locktime-disabled bit set")
+        if (stack_sequence & SEQUENCE_LOCK_TIME_MASK) > (input_seq & SEQUENCE_LOCK_TIME_MASK):
+            raise TxScriptError("sequence requirement not satisfied")
+
+    def _op_invalid(self):
+        raise TxScriptError("attempt to execute invalid opcode")
+
+    # opcode dispatch table
+    _OPS = {}
+
+
+def _build_ops():
+    e = TxScriptEngine
+    ops = {
+        0x00: e._op_false,
+        0x4F: e._op_1negate,
+        0x61: e._op_nop,
+        0x63: e._op_if,
+        0x64: e._op_notif,
+        0x67: e._op_else,
+        0x68: e._op_endif,
+        0x69: e._op_verify,
+        0x6A: e._op_return,
+        0x6B: e._op_toaltstack,
+        0x6C: e._op_fromaltstack,
+        0x6D: e._op_2drop,
+        0x6E: e._op_2dup,
+        0x6F: e._op_3dup,
+        0x70: e._op_2over,
+        0x71: e._op_2rot,
+        0x72: e._op_2swap,
+        0x73: e._op_ifdup,
+        0x74: e._op_depth,
+        0x75: e._op_drop,
+        0x76: e._op_dup,
+        0x77: e._op_nip,
+        0x78: e._op_over,
+        0x79: e._op_pick,
+        0x7A: e._op_roll,
+        0x7B: e._op_rot,
+        0x7C: e._op_swap,
+        0x7D: e._op_tuck,
+        0x82: e._op_size,
+        0x87: e._op_equal,
+        0x88: e._op_equalverify,
+        0x8B: e._op_1add,
+        0x8C: e._op_1sub,
+        0x8F: e._op_negate,
+        0x90: e._op_abs,
+        0x91: e._op_not,
+        0x92: e._op_0notequal,
+        0x93: e._op_add,
+        0x94: e._op_sub,
+        0x9A: e._op_booland,
+        0x9B: e._op_boolor,
+        0x9C: e._op_numequal,
+        0x9D: e._op_numequalverify,
+        0x9E: e._op_numnotequal,
+        0x9F: e._op_lessthan,
+        0xA0: e._op_greaterthan,
+        0xA1: e._op_lessthanorequal,
+        0xA2: e._op_greaterthanorequal,
+        0xA3: e._op_min,
+        0xA4: e._op_max,
+        0xA5: e._op_within,
+        0xA8: e._op_sha256,
+        0xA9: e._op_checkmultisig_ecdsa,
+        0xAA: e._op_blake2b,
+        0xAB: e._op_checksig_ecdsa,
+        0xAC: e._op_checksig_schnorr,
+        0xAD: e._op_checksigverify,
+        0xAE: e._op_checkmultisig,
+        0xAF: e._op_checkmultisigverify,
+        0xB0: e._op_checklocktimeverify,
+        0xB1: e._op_checksequenceverify,
+    }
+    for n in range(1, 17):  # Op1..Op16
+        ops[0x50 + n] = (lambda n: lambda self: self._op_n(n))(n)
+    for code in _RESERVED:
+        ops[code] = e._op_reserved
+    # everything else (incl. post-Toccata introspection while gated off) is invalid
+    for code in range(256):
+        ops.setdefault(code, e._op_invalid)
+    return ops
+
+
+TxScriptEngine._OPS = _build_ops()
+
+
+def vm_fallback(tx, utxo_entries, input_index, reused, sig_cache: SigCache | None = None):
+    """Adapter used by txscript.batch.BatchScriptChecker for nonstandard scripts."""
+    engine = TxScriptEngine(tx, utxo_entries, input_index, reused, sig_cache)
+    engine.execute()

@@ -1,0 +1,237 @@
+// kaspa-tpu native storage engine: persistent KV store with atomic batches.
+//
+// The TPU-native counterpart of the reference's RocksDB-backed store layer
+// (database/src/: ConnBuilder/DB/CachedDbAccess/BatchDbWriter).  Design:
+// a crash-consistent append-only log with CRC-framed record batches plus an
+// in-memory hash index, compacted on demand.  Write batches are atomic: a
+// batch frame is only honored on recovery if its trailer CRC matches —
+// mirroring the WriteBatch atomicity the reference's crash-consistency
+// story depends on (SURVEY.md §5 failure detection/recovery).
+//
+// C ABI for ctypes; all functions return 0 on success, negative on error.
+
+#include <cstdint>
+#include <unistd.h>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+uint32_t crc32(const uint8_t* data, size_t len) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Slice {
+  std::string data;
+};
+
+// log record: u8 op (0=put, 1=del), u32 klen, u32 vlen, key, value
+// batch frame: magic "KBAT", u32 payload_len, payload, u32 crc(payload)
+constexpr char kMagic[4] = {'K', 'B', 'A', 'T'};
+
+struct Store {
+  std::string path;
+  FILE* log = nullptr;
+  std::unordered_map<std::string, std::string> index;
+  std::string pending;  // current batch payload under construction
+  bool in_batch = false;
+
+  int replay() {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) return 0;  // fresh store
+    std::vector<uint8_t> buf;
+    char magic[4];
+    long valid_end = 0;
+    while (fread(magic, 1, 4, f) == 4) {
+      if (memcmp(magic, kMagic, 4) != 0) break;
+      uint32_t plen;
+      if (fread(&plen, 4, 1, f) != 1) break;
+      buf.resize(plen);
+      if (plen && fread(buf.data(), 1, plen, f) != plen) break;
+      uint32_t crc_stored;
+      if (fread(&crc_stored, 4, 1, f) != 1) break;
+      if (crc32(buf.data(), plen) != crc_stored) break;  // torn batch: stop
+      // apply payload
+      size_t off = 0;
+      bool ok = true;
+      while (off < plen) {
+        if (off + 9 > plen) { ok = false; break; }
+        uint8_t op = buf[off];
+        uint32_t klen, vlen;
+        memcpy(&klen, &buf[off + 1], 4);
+        memcpy(&vlen, &buf[off + 5], 4);
+        off += 9;
+        if (off + klen + vlen > plen) { ok = false; break; }
+        std::string key(reinterpret_cast<char*>(&buf[off]), klen);
+        off += klen;
+        if (op == 0) {
+          index[key] = std::string(reinterpret_cast<char*>(&buf[off]), vlen);
+        } else {
+          index.erase(key);
+        }
+        off += vlen;
+      }
+      if (!ok) break;
+      valid_end = ftell(f);
+    }
+    fclose(f);
+    // truncate any torn tail so the next append starts clean
+    if (valid_end >= 0) {
+      FILE* t = fopen(path.c_str(), "rb+");
+      if (t) {
+#if defined(_WIN32)
+        (void)t;
+#else
+        if (ftruncate(fileno(t), valid_end) != 0) { /* best effort */ }
+#endif
+        fclose(t);
+      }
+    }
+    return 0;
+  }
+
+  void append_record(uint8_t op, const char* key, uint32_t klen, const char* val, uint32_t vlen) {
+    size_t base = pending.size();
+    pending.resize(base + 9 + klen + vlen);
+    char* p = &pending[base];
+    p[0] = static_cast<char>(op);
+    memcpy(p + 1, &klen, 4);
+    memcpy(p + 5, &vlen, 4);
+    memcpy(p + 9, key, klen);
+    if (vlen) memcpy(p + 9 + klen, val, vlen);
+  }
+
+  int flush_batch() {
+    if (pending.empty()) return 0;
+    uint32_t plen = static_cast<uint32_t>(pending.size());
+    uint32_t crc = crc32(reinterpret_cast<const uint8_t*>(pending.data()), plen);
+    if (fwrite(kMagic, 1, 4, log) != 4) return -10;
+    if (fwrite(&plen, 4, 1, log) != 1) return -10;
+    if (fwrite(pending.data(), 1, plen, log) != plen) return -10;
+    if (fwrite(&crc, 4, 1, log) != 1) return -10;
+    if (fflush(log) != 0) return -10;
+    pending.clear();
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  Store* s = new Store();
+  s->path = path;
+  if (s->replay() != 0) {
+    delete s;
+    return nullptr;
+  }
+  s->log = fopen(path, "ab");
+  if (!s->log) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void kv_close(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (s->log) fclose(s->log);
+  delete s;
+}
+
+int kv_put(void* h, const char* key, uint32_t klen, const char* val, uint32_t vlen) {
+  Store* s = static_cast<Store*>(h);
+  s->append_record(0, key, klen, val, vlen);
+  s->index[std::string(key, klen)] = std::string(val, vlen);
+  if (!s->in_batch) return s->flush_batch();
+  return 0;
+}
+
+int kv_delete(void* h, const char* key, uint32_t klen) {
+  Store* s = static_cast<Store*>(h);
+  s->append_record(1, key, klen, nullptr, 0);
+  s->index.erase(std::string(key, klen));
+  if (!s->in_batch) return s->flush_batch();
+  return 0;
+}
+
+// returns value length, or -1 if missing; copies up to cap bytes into out
+int64_t kv_get(void* h, const char* key, uint32_t klen, char* out, uint32_t cap) {
+  Store* s = static_cast<Store*>(h);
+  auto it = s->index.find(std::string(key, klen));
+  if (it == s->index.end()) return -1;
+  uint32_t n = static_cast<uint32_t>(it->second.size());
+  if (out && cap) memcpy(out, it->second.data(), n < cap ? n : cap);
+  return n;
+}
+
+int kv_batch_begin(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (s->in_batch) return -20;
+  s->in_batch = true;
+  return 0;
+}
+
+int kv_batch_commit(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (!s->in_batch) return -21;
+  s->in_batch = false;
+  return s->flush_batch();
+}
+
+uint64_t kv_len(void* h) { return static_cast<Store*>(h)->index.size(); }
+
+// iteration: caller provides a callback
+typedef void (*kv_iter_cb)(const char* key, uint32_t klen, const char* val, uint32_t vlen, void* ctx);
+
+void kv_iterate(void* h, kv_iter_cb cb, void* ctx) {
+  Store* s = static_cast<Store*>(h);
+  for (const auto& kv : s->index) {
+    cb(kv.first.data(), static_cast<uint32_t>(kv.first.size()), kv.second.data(),
+       static_cast<uint32_t>(kv.second.size()), ctx);
+  }
+}
+
+// compaction: rewrite the log with only live records (one atomic batch)
+int kv_compact(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (s->in_batch) return -22;
+  std::string tmp = s->path + ".compact";
+  FILE* old = s->log;
+  FILE* nf = fopen(tmp.c_str(), "wb");
+  if (!nf) return -30;
+  Store out;
+  out.log = nf;
+  for (const auto& kv : s->index) {
+    out.append_record(0, kv.first.data(), static_cast<uint32_t>(kv.first.size()), kv.second.data(),
+                      static_cast<uint32_t>(kv.second.size()));
+  }
+  if (out.flush_batch() != 0) {
+    fclose(nf);
+    remove(tmp.c_str());
+    return -31;
+  }
+  fclose(nf);
+  fclose(old);
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) return -32;
+  s->log = fopen(s->path.c_str(), "ab");
+  return s->log ? 0 : -33;
+}
+
+}  // extern "C"

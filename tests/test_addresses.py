@@ -1,0 +1,55 @@
+"""Address codec golden tests (vectors from crypto/addresses/src/lib.rs tests)."""
+
+import pytest
+
+from kaspa_tpu.crypto.addresses import (
+    PREFIX_MAINNET,
+    PREFIX_TESTNET,
+    VERSION_PUBKEY,
+    VERSION_PUBKEY_ECDSA,
+    Address,
+    AddressError,
+    extract_script_pub_key_address,
+    pay_to_address_script,
+)
+
+VECTORS = [
+    (PREFIX_TESTNET, VERSION_PUBKEY, b"\x00" * 32, "kaspatest:qqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqhqrxplya"),
+    (PREFIX_TESTNET, VERSION_PUBKEY_ECDSA, b"\x00" * 33, "kaspatest:qyqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqhe837j2d"),
+    (
+        PREFIX_TESTNET,
+        VERSION_PUBKEY_ECDSA,
+        bytes.fromhex("ba01fc5f4e9d9879599c69a3dafdb835a7255e5f2e934e9322ecd3af190ab0f60e"),
+        "kaspatest:qxaqrlzlf6wes72en3568khahq66wf27tuhfxn5nytkd8tcep2c0vrse6gdmpks",
+    ),
+    (PREFIX_MAINNET, VERSION_PUBKEY, b"\x00" * 32, "kaspa:qqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqkx9awp4e"),
+    (
+        PREFIX_MAINNET,
+        VERSION_PUBKEY,
+        bytes.fromhex("5fff3c4da18f45adcdd499e44611e9fff148ba69db3c4ea2ddd955fc46a59522"),
+        "kaspa:qp0l70zd5x85ttwd6jv7g3s3a8llzj96d8dncn4zmhv4tlzx5k2jyqh70xmfj",
+    ),
+]
+
+
+def test_address_encode_golden():
+    for prefix, version, payload, expected in VECTORS:
+        assert Address(prefix, version, payload).to_string() == expected
+
+
+def test_address_decode_roundtrip():
+    for prefix, version, payload, expected in VECTORS:
+        a = Address.from_string(expected)
+        assert (a.prefix, a.version, a.payload) == (prefix, version, payload)
+
+
+def test_bad_checksum_rejected():
+    s = "kaspa:qqqqqqqqqqqqq1qqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqkx9awp4e"
+    with pytest.raises(AddressError):
+        Address.from_string(s)
+
+
+def test_script_address_roundtrip():
+    a = Address.from_string(VECTORS[4][3])
+    spk = pay_to_address_script(a)
+    assert extract_script_pub_key_address(spk, PREFIX_MAINNET) == a

@@ -1,0 +1,137 @@
+"""Mempool + MiningManager tests: insert/validate, RBF, orphans, templates.
+
+Reference behavior model: mining/src/mempool/ and manager.rs.  Uses a small
+simulated chain to provide mature spendable UTXOs, then drives the mining
+round-trip: submit tx -> template -> insert block -> mempool update.
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE, ComputeCommit
+from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.mempool import MiningManager
+from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.sim.simulator import Miner, SimConfig, simulate
+from kaspa_tpu.txscript import standard
+
+
+@pytest.fixture(scope="module")
+def chain():
+    cfg = SimConfig(bps=2, delay=0.5, num_miners=2, num_blocks=26, txs_per_block=0, seed=17)
+    res = simulate(cfg)
+    from kaspa_tpu.consensus.consensus import Consensus
+
+    c = Consensus(res.params)
+    for b in res.blocks:
+        c.validate_and_insert_block(b)
+    return c, res
+
+
+def _signed_spend(consensus, miner: Miner, rng, fee=1000, seq=0):
+    view = consensus.get_virtual_utxo_view()
+    pov = consensus.get_virtual_daa_score()
+    # find a mature utxo of this miner
+    maturity = consensus.params.coinbase_maturity
+    for outpoint, entry in list(consensus.utxo_set.items()):
+        if view.get(outpoint) is None:
+            continue
+        if entry.script_public_key != miner.spk:
+            continue
+        if entry.is_coinbase and entry.block_daa_score + maturity > pov:
+            continue
+        tx = Transaction(
+            0,
+            [TransactionInput(outpoint, b"", seq, ComputeCommit.sigops(1))],
+            [TransactionOutput(entry.amount - fee, miner.spk)],
+            0,
+            SUBNETWORK_ID_NATIVE,
+            0,
+            b"",
+        )
+        reused = chash.SigHashReusedValues()
+        msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+        sig = eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32))
+        tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+        return tx, outpoint, entry
+    raise AssertionError("no mature utxo found")
+
+
+def test_mempool_roundtrip(chain):
+    consensus, res = chain
+    rng = random.Random(3)
+    # reconstruct a miner from the sim (same seed ordering as simulate())
+    sim_rng = random.Random(17)
+    miners = [Miner(i, sim_rng) for i in range(2)]
+    mgr = MiningManager(consensus)
+
+    tx, outpoint, entry = _signed_spend(consensus, miners[0], rng)
+    assert mgr.validate_and_insert_transaction(tx) == []
+    assert mgr.mempool.has(tx.id())
+
+    # duplicate rejected
+    with pytest.raises(MempoolError, match="already"):
+        mgr.validate_and_insert_transaction(tx)
+
+    # RBF: same outpoint, higher fee wins; lower fee loses
+    tx_low, _, _ = _signed_spend(consensus, miners[0], rng, fee=500)
+    if tx_low.inputs[0].previous_outpoint == outpoint:
+        with pytest.raises(MempoolError, match="feerate"):
+            mgr.validate_and_insert_transaction(tx_low)
+    tx_high, _, _ = _signed_spend(consensus, miners[0], rng, fee=5000)
+    if tx_high.inputs[0].previous_outpoint == outpoint:
+        evicted = mgr.validate_and_insert_transaction(tx_high)
+        assert evicted == [tx.id()]
+
+    # template includes the best tx and mines validly
+    template = mgr.get_block_template(miners[0].miner_data)
+    assert len(template.transactions) >= 2
+    status = consensus.validate_and_insert_block(template)
+    assert status in ("utxo_valid", "utxo_pending")
+
+    # mempool drained after the block
+    mgr.handle_new_block_transactions(template.transactions, consensus.get_virtual_daa_score())
+    assert all(not mgr.mempool.has(t.id()) for t in template.transactions[1:])
+
+
+def test_invalid_signature_rejected(chain):
+    consensus, res = chain
+    rng = random.Random(5)
+    sim_rng = random.Random(17)
+    miners = [Miner(i, sim_rng) for i in range(2)]
+    mgr = MiningManager(consensus)
+    tx, _, _ = _signed_spend(consensus, miners[1], rng)
+    sig = bytearray(tx.inputs[0].signature_script)
+    sig[9] ^= 1
+    tx.inputs[0].signature_script = bytes(sig)
+    tx._id_cache = None
+    with pytest.raises(TxRuleError):
+        mgr.validate_and_insert_transaction(tx)
+    assert not mgr.mempool.has(tx.id())
+
+
+def test_orphan_pool(chain):
+    consensus, res = chain
+    rng = random.Random(7)
+    sim_rng = random.Random(17)
+    miners = [Miner(i, sim_rng) for i in range(2)]
+    mgr = MiningManager(consensus)
+    # a tx spending a nonexistent outpoint goes to the orphan pool
+    from kaspa_tpu.consensus.model import TransactionOutpoint
+
+    orphan = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(b"\x99" * 32, 0), b"\x01\x01", 0, ComputeCommit.sigops(1))],
+        [TransactionOutput(100, miners[0].spk)],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    mgr.validate_and_insert_transaction(orphan)
+    assert orphan.id() in mgr.mempool.orphans
+    assert not mgr.mempool.get(orphan.id())

@@ -1,0 +1,79 @@
+"""Notification pipeline + utxoindex tests (reference: notify/, indexes/utxoindex)."""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.index import UtxoIndex
+from kaspa_tpu.notify.notifier import Notification, Notifier
+from kaspa_tpu.sim.simulator import Miner, SimConfig, simulate
+
+
+def test_notifier_subscription_filtering():
+    root = Notifier("root")
+    got = []
+    lid = root.register(got.append)
+    root.start_notify(lid, "block-added")
+    root.notify(Notification("block-added", {"n": 1}))
+    root.notify(Notification("virtual-daa-score-changed", {"n": 2}))  # not subscribed
+    assert len(got) == 1 and got[0].data["n"] == 1
+    root.stop_notify(lid, "block-added")
+    root.notify(Notification("block-added", {"n": 3}))
+    assert len(got) == 1
+
+
+def test_notifier_chaining():
+    root = Notifier("root")
+    child = Notifier("child", parent=root)
+    got = []
+    lid = child.register(got.append)
+    child.start_notify(lid, "block-added")
+    root.notify(Notification("block-added", {"n": 7}))  # flows root -> child -> listener
+    assert len(got) == 1 and got[0].data["n"] == 7
+
+
+def test_utxos_changed_address_filter():
+    root = Notifier("root")
+    got = []
+    lid = root.register(got.append)
+    root.start_notify(lid, "utxos-changed", addresses={b"spk-a"})
+
+    class _SPK:
+        def __init__(self, s):
+            self.script = s
+
+    class _E:
+        def __init__(self, s):
+            self.script_public_key = _SPK(s)
+            self.amount = 5
+
+    n = Notification(
+        "utxos-changed",
+        {"added": [("op1", _E(b"spk-a")), ("op2", _E(b"spk-b"))], "removed": [], "spk_set": {b"spk-a", b"spk-b"}},
+    )
+    root.notify(n)
+    assert len(got) == 1
+    assert [x[0] for x in got[0].data["added"]] == ["op1"]
+    # notification touching only other addresses is dropped
+    root.notify(Notification("utxos-changed", {"added": [], "removed": [], "spk_set": {b"spk-b"}}))
+    assert len(got) == 1
+
+
+def test_utxoindex_tracks_chain(tmp_path):
+    cfg = SimConfig(bps=2, delay=0.5, num_miners=2, num_blocks=20, txs_per_block=2, seed=19)
+    res = simulate(cfg)
+    c = Consensus(res.params)
+    index = UtxoIndex(c)
+    for b in res.blocks:
+        c.validate_and_insert_block(b)
+    # index must match a fresh resync of the virtual set
+    live = {s: dict(u) for s, u in index._by_script.items()}
+    index.resync()
+    assert {s: dict(u) for s, u in index._by_script.items()} == live
+    # balances: sum of index == circulating supply == sum of virtual set view
+    supply = index.get_circulating_supply()
+    assert supply > 0
+    sim_rng = random.Random(19)
+    miners = [Miner(i, sim_rng) for i in range(2)]
+    assert index.get_balance_by_script(miners[0].spk.script) > 0

@@ -1,0 +1,251 @@
+"""TxScript VM tests: opcode semantics, limits, P2SH, sig checks.
+
+Covers the engine rules of crypto/txscript/src/{lib.rs,opcodes/mod.rs,
+data_stack.rs}: minimal pushes/numbers, conditionals, stack ops, the
+201-op and 244-stack limits, P2SH redeem flow, multisig matching, CLTV/CSV,
+and fast-path <-> VM consensus equivalence for standard P2PK spends.
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import (
+    SUBNETWORK_ID_NATIVE,
+    ComputeCommit,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.txscript import standard
+from kaspa_tpu.txscript.vm import (
+    TxScriptEngine,
+    TxScriptError,
+    as_bool,
+    check_minimal_data_encoding,
+    deserialize_i64,
+    serialize_i64,
+)
+
+OP_1 = 0x51
+OP_ADD = 0x93
+OP_EQUAL = 0x87
+OP_VERIFY = 0x69
+OP_DUP = 0x76
+OP_IF, OP_ELSE, OP_ENDIF = 0x63, 0x67, 0x68
+
+
+def run_ok(script: bytes):
+    TxScriptEngine().execute_standalone(script)
+
+
+def run_err(script: bytes, match: str = ""):
+    with pytest.raises(TxScriptError, match=match):
+        TxScriptEngine().execute_standalone(script)
+
+
+def test_number_codec_roundtrip():
+    for v in (0, 1, -1, 127, -127, 128, -128, 255, -255, 2**31, -(2**31), 2**63 - 1, -(2**63) + 1):
+        enc = serialize_i64(v)
+        assert deserialize_i64(enc, True) == v, v
+    # non-minimal encodings rejected
+    for bad in (b"\x00", b"\x80", b"\x01\x00"):
+        with pytest.raises(TxScriptError):
+            check_minimal_data_encoding(bad)
+    # 0xff00 would wrongly trip without the sign-conflict exception: 255 = [0xff, 0x00]
+    check_minimal_data_encoding(bytes([0xFF, 0x00]))
+
+
+def test_bool_semantics():
+    assert not as_bool(b"")
+    assert not as_bool(b"\x80")  # negative zero
+    assert not as_bool(b"\x00\x00")
+    assert as_bool(b"\x01")
+    assert as_bool(b"\x00\x01")
+
+
+def test_simple_arithmetic_script():
+    # 1 + 2 == 3
+    run_ok(bytes([OP_1, 0x52, OP_ADD, 0x53, OP_EQUAL]))
+    run_err(bytes([OP_1, 0x52, OP_ADD, 0x54, OP_EQUAL]), "false stack")
+
+
+def test_conditionals():
+    # IF 2 ELSE 3 ENDIF == 2 (condition true)
+    run_ok(bytes([OP_1, OP_IF, 0x52, OP_ELSE, 0x53, OP_ENDIF, 0x52, OP_EQUAL]))
+    # condition false branch
+    run_ok(bytes([0x00, OP_IF, 0x52, OP_ELSE, 0x53, OP_ENDIF, 0x53, OP_EQUAL]))
+    # unbalanced conditional
+    run_err(bytes([OP_1, OP_IF, 0x52]), "conditional")
+    # non-minimal boolean condition
+    run_err(bytes([0x52, OP_IF, OP_ENDIF]), "expected boolean")
+
+
+def test_minimal_push_enforced():
+    # pushing [1] via OpData1 must use OP_1
+    run_err(bytes([0x01, 0x01, 0x75, OP_1]), "must use OP_1")
+    # pushdata1 for 3 bytes must use direct push
+    run_err(bytes([0x4C, 0x03, 1, 2, 3, 0x75, OP_1]), "direct push")
+
+
+def test_op_limit():
+    # 202 non-push ops (NOPs) exceed the 201 limit
+    script = bytes([0x61] * 202) + bytes([OP_1])
+    run_err(script, "operation limit")
+    run_ok(bytes([0x61] * 200) + bytes([OP_1]))
+
+
+def test_stack_size_limit():
+    script = bytes([OP_1] * 245)
+    run_err(script, "stack size")
+
+
+def test_early_return_and_reserved():
+    run_err(bytes([0x6A]), "early return")
+    run_err(bytes([0x50]), "reserved")
+    # reserved opcode inside a non-executed branch is fine
+    run_ok(bytes([0x00, OP_IF, 0x50, OP_ENDIF, OP_1]))
+    # disabled opcodes fail even in non-executed branches
+    run_err(bytes([0x00, OP_IF, 0x8D, OP_ENDIF, OP_1]), "disabled")
+
+
+def _p2pk_tx(seed=1):
+    rng = random.Random(seed)
+    sk = rng.randrange(1, eclib.N)
+    pub = eclib.schnorr_pubkey(sk)
+    spk = standard.pay_to_pub_key(pub)
+    entry = UtxoEntry(10_000, spk, 5, False)
+    tx = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(b"\x03" * 32, 0), b"", 0, ComputeCommit.sigops(1))],
+        [TransactionOutput(9_000, spk)],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+    sig = eclib.schnorr_sign(msg, sk, rng.randbytes(32))
+    tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+    return tx, [entry], sig
+
+
+def test_vm_executes_standard_p2pk():
+    tx, entries, _sig = _p2pk_tx()
+    TxScriptEngine(tx, entries, 0).execute()
+    # corrupt signature -> false stack result
+    bad = bytearray(tx.inputs[0].signature_script)
+    bad[5] ^= 1
+    tx.inputs[0].signature_script = bytes(bad)
+    with pytest.raises(TxScriptError, match="false stack"):
+        TxScriptEngine(tx, entries, 0).execute()
+
+
+def test_vm_matches_fast_path_decision():
+    """Fast-path batch checker and VM must agree on standard P2PK spends."""
+    from kaspa_tpu.txscript.batch import BatchScriptChecker
+
+    for seed, corrupt in ((3, False), (4, True)):
+        tx, entries, _ = _p2pk_tx(seed)
+        if corrupt:
+            b = bytearray(tx.inputs[0].signature_script)
+            b[8] ^= 1
+            tx.inputs[0].signature_script = bytes(b)
+        checker = BatchScriptChecker()
+        checker.collect_tx(0, tx, entries)
+        fast_result = checker.dispatch()[0]
+        vm_failed = False
+        try:
+            TxScriptEngine(tx, entries, 0).execute()
+        except TxScriptError:
+            vm_failed = True
+        assert (fast_result is not None) == vm_failed
+
+
+def test_p2sh_redeem():
+    # redeem script: OP_1 OP_EQUAL ; signature script pushes [1] then redeem
+    redeem = bytes([OP_1, OP_EQUAL])
+    spk = standard.pay_to_script_hash_script(redeem)
+    entry = UtxoEntry(5_000, spk, 5, False)
+    tx = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(b"\x04" * 32, 0), b"", 0, ComputeCommit.sigops(0))],
+        [],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    tx.inputs[0].signature_script = bytes([OP_1, len(redeem)]) + redeem
+    TxScriptEngine(tx, [entry], 0).execute()
+    # wrong redeem value fails
+    tx.inputs[0].signature_script = bytes([0x52, len(redeem)]) + redeem
+    with pytest.raises(TxScriptError):
+        TxScriptEngine(tx, [entry], 0).execute()
+
+
+def test_multisig_2_of_3():
+    rng = random.Random(9)
+    keys = [rng.randrange(1, eclib.N) for _ in range(3)]
+    pubs = [eclib.schnorr_pubkey(k) for k in keys]
+    # spk: OP_2 <pk1> <pk2> <pk3> OP_3 OP_CHECKMULTISIG
+    spk_script = bytes([0x52]) + b"".join(bytes([32]) + p for p in pubs) + bytes([0x53, 0xAE])
+    spk = ScriptPublicKey(0, spk_script)
+    entry = UtxoEntry(10_000, spk, 5, False)
+    tx = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(b"\x05" * 32, 1), b"", 0, ComputeCommit.sigops(3))],
+        [],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+    sigs = [eclib.schnorr_sign(msg, k, rng.randbytes(32)) + bytes([chash.SIG_HASH_ALL]) for k in keys]
+    # sign with keys 0 and 2 (order must match key order)
+    tx.inputs[0].signature_script = bytes([len(sigs[0])]) + sigs[0] + bytes([len(sigs[2])]) + sigs[2]
+    TxScriptEngine(tx, [entry], 0).execute()
+    # wrong order (sig2 then sig0) fails with NullFail-style error
+    tx.inputs[0].signature_script = bytes([len(sigs[2])]) + sigs[2] + bytes([len(sigs[0])]) + sigs[0]
+    with pytest.raises(TxScriptError):
+        TxScriptEngine(tx, [entry], 0).execute()
+
+
+def test_cltv_and_csv():
+    tx, entries, _ = _p2pk_tx(7)
+    tx.lock_time = 100
+    tx.inputs[0].sequence = 5
+    # kaspa CLTV/CSV consume their operand (opcodes/mod.rs pop_raw), so no
+    # OP_DROP is needed: <50> OP_CHECKLOCKTIMEVERIFY OP_1
+    spk = ScriptPublicKey(0, bytes([0x01, 50, 0xB0, OP_1]))
+    entries = [UtxoEntry(10, spk, 0, False)]
+    tx.inputs[0].signature_script = b""
+    TxScriptEngine(tx, entries, 0).execute()
+    # stack locktime above tx locktime fails
+    spk2 = ScriptPublicKey(0, bytes([0x01, 101, 0xB0, OP_1]))
+    with pytest.raises(TxScriptError, match="locktime"):
+        TxScriptEngine(tx, [UtxoEntry(10, spk2, 0, False)], 0).execute()
+    # CSV: stack sequence 4 <= input sequence 5 passes (OP_4: minimal push)
+    spk3 = ScriptPublicKey(0, bytes([0x54, 0xB1, OP_1]))
+    TxScriptEngine(tx, [UtxoEntry(10, spk3, 0, False)], 0).execute()
+    spk4 = ScriptPublicKey(0, bytes([0x56, 0xB1, OP_1]))
+    with pytest.raises(TxScriptError, match="sequence"):
+        TxScriptEngine(tx, [UtxoEntry(10, spk4, 0, False)], 0).execute()
+
+
+def test_unknown_spk_version_accepted():
+    tx, entries, _ = _p2pk_tx(8)
+    entry = entries[0]
+    from dataclasses import replace
+
+    entries = [replace(entry, script_public_key=ScriptPublicKey(1, b"\xff\xff"))]
+    tx.inputs[0].signature_script = b""
+    TxScriptEngine(tx, entries, 0).execute()  # accepted without execution
