@@ -1,0 +1,1 @@
+from kaspa_tpu.p2p.node import Node, connect  # noqa: F401

@@ -1,0 +1,210 @@
+"""In-process P2P: routers, flows, and block/tx relay between nodes.
+
+Reference: protocol/p2p (Adaptor/Router/Hub over tonic gRPC, ~60 payload
+types) and protocol/flows (one task per flow per peer: handshake, block
+relay with orphan resolution, tx relay, IBD).  This round models the flow
+layer over an in-process transport — the same peer/message/flow shapes,
+synchronous delivery — matching the reference's own in-process daemon
+integration strategy (testing/integration/src/common/daemon.rs).  The
+tonic-equivalent wire transport (C++ gRPC/asio) binds underneath in a
+later milestone without changing the flow logic.
+
+Messages are (type, payload) tuples; types mirror p2p.proto payload names.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.consensus import Consensus, RuleError
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.mempool import MiningManager
+from kaspa_tpu.mempool.mempool import MempoolError
+
+# p2p.proto payload types modeled this round
+MSG_VERSION = "version"
+MSG_VERACK = "verack"
+MSG_INV_BLOCK = "invrelayblock"
+MSG_REQUEST_BLOCK = "requestrelayblocks"
+MSG_BLOCK = "block"
+MSG_INV_TXS = "invtransactions"
+MSG_REQUEST_TXS = "requesttransactions"
+MSG_TX = "transaction"
+MSG_REQUEST_IBD_BLOCKS = "requestibdblocks"
+MSG_IBD_BLOCKS = "ibdblocks"
+
+PROTOCOL_VERSION = 7
+
+
+@dataclass
+class Peer:
+    """Router endpoint for one connection (p2p/src/core/router.rs)."""
+
+    node: "Node"
+    remote: "Peer | None" = None
+    handshaken: bool = False
+    inbox: deque = field(default_factory=deque)
+    known_blocks: set = field(default_factory=set)
+    known_txs: set = field(default_factory=set)
+
+    def send(self, msg_type: str, payload) -> None:
+        """Enqueue on the remote peer's inbox and drain it (sync transport)."""
+        self.remote.inbox.append((msg_type, payload))
+        self.remote.node._drain(self.remote)
+
+
+class Node:
+    """A full node instance: consensus + mempool + flow handlers + hub."""
+
+    def __init__(self, consensus: Consensus, name: str = "node"):
+        self.name = name
+        self.consensus = consensus
+        self.mining = MiningManager(consensus)
+        self.peers: list[Peer] = []  # the Hub (p2p/src/core/hub.rs)
+        self.orphan_blocks: dict[bytes, Block] = {}  # flowcontext/orphans.rs
+
+    # --- hub / relay (flow_context.rs on_new_block -> broadcast) ---
+
+    def broadcast_block(self, block: Block) -> None:
+        for peer in self.peers:
+            if block.hash not in peer.known_blocks:
+                peer.known_blocks.add(block.hash)
+                peer.send(MSG_INV_BLOCK, block.hash)
+
+    def broadcast_tx(self, tx) -> None:
+        for peer in self.peers:
+            if tx.id() not in peer.known_txs:
+                peer.known_txs.add(tx.id())
+                peer.send(MSG_INV_TXS, [tx.id()])
+
+    def submit_block(self, block: Block) -> str:
+        status = self.consensus.validate_and_insert_block(block)
+        self.mining.handle_new_block_transactions(block.transactions, self.consensus.get_virtual_daa_score())
+        self._try_unorphan(block.hash)
+        self.broadcast_block(block)
+        return status
+
+    def submit_transaction(self, tx) -> None:
+        self.mining.validate_and_insert_transaction(tx)
+        self.broadcast_tx(tx)
+
+    # --- flow handlers (protocol/flows/src/v7/) ---
+
+    def _drain(self, peer: Peer) -> None:
+        while peer.inbox:
+            msg_type, payload = peer.inbox.popleft()
+            self._handle(peer, msg_type, payload)
+
+    def _handle(self, peer: Peer, msg_type: str, payload) -> None:
+        if msg_type == MSG_VERSION:
+            peer.send(MSG_VERACK, PROTOCOL_VERSION)
+        elif msg_type == MSG_VERACK:
+            peer.handshaken = True
+        elif msg_type == MSG_INV_BLOCK:
+            # blockrelay/flow.rs: request unknown relay blocks
+            if not self.consensus.storage.statuses.is_valid(payload) and payload not in self.orphan_blocks:
+                peer.send(MSG_REQUEST_BLOCK, [payload])
+        elif msg_type == MSG_REQUEST_BLOCK:
+            for h in payload:
+                if self.consensus.storage.block_transactions.has(h):
+                    header = self.consensus.storage.headers.get(h)
+                    txs = self.consensus.storage.block_transactions.get(h)
+                    peer.send(MSG_BLOCK, Block(header, txs))
+        elif msg_type == MSG_BLOCK:
+            self._on_relay_block(peer, payload)
+        elif msg_type == MSG_INV_TXS:
+            unknown = [t for t in payload if not self.mining.mempool.has(t)]
+            if unknown:
+                peer.send(MSG_REQUEST_TXS, unknown)
+        elif msg_type == MSG_REQUEST_TXS:
+            for txid in payload:
+                entry = self.mining.mempool.get(txid)
+                if entry is not None:
+                    peer.send(MSG_TX, entry.tx)
+        elif msg_type == MSG_TX:
+            from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
+
+            peer.known_txs.add(payload.id())
+            try:
+                self.mining.validate_and_insert_transaction(payload)
+            except (MempoolError, TxRuleError):
+                return  # relay rejections are not punished unless malformed
+            self.broadcast_tx(payload)
+        elif msg_type == MSG_REQUEST_IBD_BLOCKS:
+            # serve blocks above the requested low hashes in topological order
+            blocks = self._blocks_in_topological_order()
+            have = set(payload)
+            peer.send(MSG_IBD_BLOCKS, [b for b in blocks if b.hash not in have])
+        elif msg_type == MSG_IBD_BLOCKS:
+            for block in payload:
+                try:
+                    self.consensus.validate_and_insert_block(block)
+                except RuleError:
+                    pass
+
+    def _on_relay_block(self, peer: Peer, block: Block) -> None:
+        peer.known_blocks.add(block.hash)  # sender has it: don't echo the inv back
+        parents = block.header.direct_parents()
+        missing = [p for p in parents if not self.consensus.storage.headers.has(p)]
+        if missing:
+            # orphan: request missing ancestors (orphan resolution, flow.rs)
+            self.orphan_blocks[block.hash] = block
+            peer.send(MSG_REQUEST_BLOCK, missing)
+            return
+        try:
+            self.consensus.validate_and_insert_block(block)
+        except RuleError:
+            return  # invalid relay: reference would score/ban the peer
+        self.mining.handle_new_block_transactions(block.transactions, self.consensus.get_virtual_daa_score())
+        self._try_unorphan(block.hash)
+        self.broadcast_block(block)
+
+    def _try_unorphan(self, new_hash: bytes) -> None:
+        """revalidate_orphans: process orphans whose parents arrived."""
+        progress = True
+        while progress:
+            progress = False
+            for h, block in list(self.orphan_blocks.items()):
+                if all(self.consensus.storage.headers.has(p) for p in block.header.direct_parents()):
+                    del self.orphan_blocks[h]
+                    try:
+                        self.consensus.validate_and_insert_block(block)
+                        self.broadcast_block(block)
+                        progress = True
+                    except RuleError:
+                        pass
+
+    def _blocks_in_topological_order(self) -> list[Block]:
+        """All block bodies sorted by (blue_work, hash) — a topological order
+        since ancestors always have strictly smaller blue work."""
+        gd = self.consensus.storage.ghostdag
+        hashes = [
+            h
+            for h in self.consensus.storage.headers._headers
+            if h != self.consensus.params.genesis.hash and self.consensus.storage.block_transactions.has(h)
+        ]
+        hashes.sort(key=lambda h: (gd.get_blue_work(h), h))
+        return [
+            Block(self.consensus.storage.headers.get(h), self.consensus.storage.block_transactions.get(h))
+            for h in hashes
+        ]
+
+    def ibd_from(self, peer: Peer) -> None:
+        """Naive full-sync IBD (ibd/flow.rs Sync path; proof-based sync is a
+        later milestone): request everything above what we have."""
+        have = [h for h in self.consensus.storage.headers._headers]
+        peer.send(MSG_REQUEST_IBD_BLOCKS, have)
+
+
+def connect(a: Node, b: Node) -> tuple[Peer, Peer]:
+    """Wire two nodes with a bidirectional in-process connection + handshake."""
+    pa = Peer(node=a)  # a's endpoint talking to b
+    pb = Peer(node=b)
+    pa.remote = pb
+    pb.remote = pa
+    a.peers.append(pa)
+    b.peers.append(pb)
+    pa.send(MSG_VERSION, PROTOCOL_VERSION)  # a -> b
+    pb.send(MSG_VERSION, PROTOCOL_VERSION)  # b -> a
+    return pa, pb

@@ -1,0 +1,1 @@
+from kaspa_tpu.rpc.service import RpcCoreService  # noqa: F401

@@ -1,0 +1,264 @@
+"""RpcCoreService: the RPC API implementation over consensus/mempool/indexes.
+
+Reference: rpc/core/src/api/rpc.rs (the ~45-method RpcApi trait) implemented
+by rpc/service/src/service.rs against consensus sessions, the mining
+manager, and the utxoindex.  This module is the transport-independent core:
+the gRPC/wRPC server stacks (rpc/grpc, rpc/wrpc) bind these methods to the
+wire in a later milestone; notifications flow through the same
+kaspa_tpu.notify chain the reference threads through RpcCoreService.
+
+Methods mirror the reference's names (get_block, get_block_dag_info,
+submit_block, submit_transaction, get_utxos_by_addresses, ...) and return
+plain dict/dataclass models (the Rpc* mirror types of rpc/core/src/model).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.consensus import Consensus, RuleError
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.crypto.addresses import Address, extract_script_pub_key_address, pay_to_address_script
+from kaspa_tpu.index import UtxoIndex
+from kaspa_tpu.mempool import MiningManager
+from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.notify.notifier import Notifier
+
+
+class RpcError(Exception):
+    pass
+
+
+@dataclass
+class ServerInfo:
+    rpc_api_version: int = 1
+    server_version: str = "kaspa-tpu/0.1"
+    network_id: str = ""
+    has_utxo_index: bool = True
+    is_synced: bool = True
+    virtual_daa_score: int = 0
+
+
+class RpcCoreService:
+    def __init__(self, consensus: Consensus, mining: MiningManager, utxoindex: UtxoIndex | None = None, address_prefix: str = "kaspasim"):
+        self.consensus = consensus
+        self.mining = mining
+        self.utxoindex = utxoindex if utxoindex is not None else UtxoIndex(consensus)
+        self.address_prefix = address_prefix
+        # rpc-level notifier chained onto the consensus root (the reference's
+        # consensus -> notify -> index -> rpc chain)
+        self.notifier = Notifier("rpc-core", parent=consensus.notification_root)
+        self.start_time = time.time()
+
+    # --- node / dag info ---
+
+    def get_server_info(self) -> ServerInfo:
+        return ServerInfo(
+            network_id=self.consensus.params.name,
+            virtual_daa_score=self.consensus.get_virtual_daa_score(),
+        )
+
+    def get_block_dag_info(self) -> dict:
+        vs = self.consensus.virtual_state
+        return {
+            "network": self.consensus.params.name,
+            "block_count": len(self.consensus.storage.headers._headers) - 1,
+            "tip_hashes": sorted(h.hex() for h in self.consensus.tips),
+            "virtual_parent_hashes": [h.hex() for h in vs.parents],
+            "difficulty_bits": vs.bits,
+            "past_median_time": vs.past_median_time,
+            "virtual_daa_score": vs.daa_score,
+            "sink": self.consensus.sink().hex(),
+            "pruning_point": self.consensus.params.genesis.hash.hex(),
+        }
+
+    def get_sink(self) -> bytes:
+        return self.consensus.sink()
+
+    def get_sink_blue_score(self) -> int:
+        return self.consensus.storage.ghostdag.get_blue_score(self.consensus.sink())
+
+    def get_virtual_chain_from_block(self, low: bytes) -> dict:
+        """Selected-chain path from `low` to the sink + acceptance data."""
+        if not self.consensus.storage.headers.has(low):
+            raise RpcError(f"block {low.hex()} not found")
+        chain = []
+        cur = self.consensus.sink()
+        while cur != low:
+            chain.append(cur)
+            if cur == self.consensus.params.genesis.hash:
+                raise RpcError(f"block {low.hex()} is not a chain ancestor of the sink")
+            cur = self.consensus.storage.ghostdag.get_selected_parent(cur)
+        chain.reverse()
+        return {
+            "added_chain_blocks": [h.hex() for h in chain],
+            "accepted_transaction_ids": {
+                h.hex(): [t.hex() for t in self.consensus.acceptance_data.get(h, [])] for h in chain
+            },
+        }
+
+    # --- blocks ---
+
+    def get_block(self, block_hash: bytes, include_transactions: bool = True) -> dict:
+        if not self.consensus.storage.headers.has(block_hash):
+            raise RpcError(f"block {block_hash.hex()} not found")
+        header = self.consensus.storage.headers.get(block_hash)
+        out = {
+            "hash": block_hash.hex(),
+            "header": {
+                "version": header.version,
+                "parents_by_level": [[p.hex() for p in lvl] for lvl in header.parents_by_level],
+                "hash_merkle_root": header.hash_merkle_root.hex(),
+                "accepted_id_merkle_root": header.accepted_id_merkle_root.hex(),
+                "utxo_commitment": header.utxo_commitment.hex(),
+                "timestamp": header.timestamp,
+                "bits": header.bits,
+                "nonce": header.nonce,
+                "daa_score": header.daa_score,
+                "blue_work": hex(header.blue_work),
+                "blue_score": header.blue_score,
+                "pruning_point": header.pruning_point.hex(),
+            },
+            "verbose": {
+                "status": self.consensus.storage.statuses.get(block_hash),
+                "is_chain_block": self.consensus.reachability.is_chain_ancestor_of(block_hash, self.consensus.sink()),
+            },
+        }
+        if include_transactions and self.consensus.storage.block_transactions.has(block_hash):
+            out["transactions"] = [self._tx_to_rpc(tx) for tx in self.consensus.storage.block_transactions.get(block_hash)]
+        return out
+
+    def get_blocks(self, low_hash: bytes | None = None, include_transactions: bool = False) -> list[dict]:
+        """Blocks in the future of `low_hash` (inclusive), or all blocks."""
+        hashes = list(self.consensus.storage.headers._headers)
+        if low_hash is not None:
+            if not self.consensus.storage.headers.has(low_hash):
+                raise RpcError(f"block {low_hash.hex()} not found")
+            hashes = [h for h in hashes if self.consensus.reachability.is_dag_ancestor_of(low_hash, h)]
+        return [self.get_block(h, include_transactions) for h in hashes]
+
+    def submit_block(self, block: Block) -> str:
+        try:
+            status = self.consensus.validate_and_insert_block(block)
+        except RuleError as e:
+            raise RpcError(f"block rejected: {e}") from e
+        self.mining.handle_new_block_transactions(block.transactions, self.consensus.get_virtual_daa_score())
+        return status
+
+    def get_block_template(self, pay_address: str, extra_data: bytes = b"") -> Block:
+        from kaspa_tpu.consensus.processes.coinbase import MinerData
+
+        addr = Address.from_string(pay_address)
+        spk = pay_to_address_script(addr)
+        return self.mining.get_block_template(MinerData(spk, extra_data))
+
+    # --- transactions ---
+
+    def submit_transaction(self, tx) -> bytes:
+        from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
+
+        try:
+            self.mining.validate_and_insert_transaction(tx)
+        except (MempoolError, TxRuleError) as e:
+            raise RpcError(f"transaction rejected: {e}") from e
+        return tx.id()
+
+    def get_mempool_entries(self) -> list[dict]:
+        return [
+            {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass}
+            for txid, e in self.mining.mempool.pool.items()
+        ]
+
+    def get_mempool_entry(self, txid: bytes) -> dict:
+        e = self.mining.mempool.get(txid)
+        if e is None:
+            raise RpcError(f"transaction {txid.hex()} not in mempool")
+        return {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass}
+
+    # --- utxos / balances (utxoindex-backed, rpc.rs get_utxos_by_addresses) ---
+
+    def get_utxos_by_addresses(self, addresses: list[str]) -> list[dict]:
+        out = []
+        for s in addresses:
+            addr = Address.from_string(s)
+            spk = pay_to_address_script(addr)
+            for outpoint, entry in self.utxoindex.get_utxos_by_script(spk.script).items():
+                out.append(
+                    {
+                        "address": s,
+                        "outpoint": {"transaction_id": outpoint.transaction_id.hex(), "index": outpoint.index},
+                        "utxo_entry": {
+                            "amount": entry.amount,
+                            "block_daa_score": entry.block_daa_score,
+                            "is_coinbase": entry.is_coinbase,
+                        },
+                    }
+                )
+        return out
+
+    def get_balance_by_address(self, address: str) -> int:
+        spk = pay_to_address_script(Address.from_string(address))
+        return self.utxoindex.get_balance_by_script(spk.script)
+
+    def get_coin_supply(self) -> dict:
+        return {"circulating_sompi": self.utxoindex.get_circulating_supply()}
+
+    # --- subscriptions (notify_* RPCs) ---
+
+    def register_listener(self, callback) -> int:
+        return self.notifier.register(callback)
+
+    def start_notify(self, listener_id: int, event_type: str, addresses: list[str] | None = None) -> None:
+        spks = None
+        if addresses is not None:
+            spks = {pay_to_address_script(Address.from_string(a)).script for a in addresses}
+        self.notifier.start_notify(listener_id, event_type, spks)
+
+    def stop_notify(self, listener_id: int, event_type: str) -> None:
+        self.notifier.stop_notify(listener_id, event_type)
+
+    # --- metrics (rpc.rs get_metrics -> metrics/core MetricsSnapshot) ---
+
+    def get_metrics(self) -> dict:
+        sc = self.consensus.transaction_validator.sig_cache
+        return {
+            "uptime_seconds": time.time() - self.start_time,
+            "block_count": len(self.consensus.storage.headers._headers) - 1,
+            "tip_count": len(self.consensus.tips),
+            "mempool_size": len(self.mining.mempool),
+            "virtual_daa_score": self.consensus.get_virtual_daa_score(),
+            "sig_cache_hits": sc.hits,
+            "sig_cache_misses": sc.misses,
+        }
+
+    # --- helpers ---
+
+    def _tx_to_rpc(self, tx) -> dict:
+        d = {
+            "transaction_id": tx.id().hex(),
+            "version": tx.version,
+            "lock_time": tx.lock_time,
+            "gas": tx.gas,
+            "payload": tx.payload.hex(),
+            "inputs": [
+                {
+                    "previous_outpoint": {
+                        "transaction_id": i.previous_outpoint.transaction_id.hex(),
+                        "index": i.previous_outpoint.index,
+                    },
+                    "signature_script": i.signature_script.hex(),
+                    "sequence": i.sequence,
+                }
+                for i in tx.inputs
+            ],
+            "outputs": [],
+        }
+        for o in tx.outputs:
+            entry = {"amount": o.value, "script_public_key": o.script_public_key.script.hex()}
+            try:
+                entry["address"] = extract_script_pub_key_address(o.script_public_key, self.address_prefix).to_string()
+            except Exception:
+                pass
+            d["outputs"].append(entry)
+        return d

@@ -1,0 +1,2 @@
+from kaspa_tpu.wallet.bip32 import ExtendedKey  # noqa: F401
+from kaspa_tpu.wallet.account import Account  # noqa: F401

@@ -1,0 +1,105 @@
+"""Wallet account: address derivation, UTXO tracking, spend building/signing.
+
+Reference: wallet/core (accounts over bip32 derivations, the UTXO
+processor/context tracking virtual UtxosChanged, and the tx generator).
+This round covers the single-signer P2PK account: derive receive addresses,
+track spendable UTXOs through the utxoindex, build + schnorr-sign spends,
+and submit via the mining manager / RPC service.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE, ComputeCommit
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.crypto.addresses import Address, VERSION_PUBKEY
+from kaspa_tpu.txscript import standard
+from kaspa_tpu.wallet.bip32 import ExtendedKey, kaspa_account_path
+
+
+class WalletError(Exception):
+    pass
+
+
+@dataclass
+class DerivedAddress:
+    index: int
+    key: ExtendedKey
+    address: Address
+
+    @property
+    def spk(self):
+        return standard.pay_to_pub_key(self.key.x_only_public_key())
+
+
+class Account:
+    def __init__(self, master: ExtendedKey, account_index: int = 0, prefix: str = "kaspasim"):
+        self.prefix = prefix
+        self.account_key = master.derive_path(kaspa_account_path(account_index))
+        self._external_chain = self.account_key.derive_child(0)  # receive chain node
+        self.receive_keys: list[DerivedAddress] = []
+        self.derive_receive_address()  # index 0
+
+    @staticmethod
+    def from_seed(seed: bytes, account_index: int = 0, prefix: str = "kaspasim") -> "Account":
+        return Account(ExtendedKey.from_seed(seed), account_index, prefix)
+
+    def derive_receive_address(self) -> DerivedAddress:
+        i = len(self.receive_keys)
+        key = self._external_chain.derive_child(i)
+        addr = Address(self.prefix, VERSION_PUBKEY, key.x_only_public_key())
+        derived = DerivedAddress(i, key, addr)
+        self.receive_keys.append(derived)
+        return derived
+
+    def addresses(self) -> list[str]:
+        return [d.address.to_string() for d in self.receive_keys]
+
+    # --- utxo scanning (wallet/core utxo processor, via the utxoindex) ---
+
+    def spendable_utxos(self, utxoindex, virtual_daa_score: int, coinbase_maturity: int):
+        out = []
+        for d in self.receive_keys:
+            for outpoint, entry in utxoindex.get_utxos_by_script(d.spk.script).items():
+                if entry.is_coinbase and entry.block_daa_score + coinbase_maturity > virtual_daa_score:
+                    continue
+                out.append((outpoint, entry, d))
+        return out
+
+    def balance(self, utxoindex) -> int:
+        return sum(utxoindex.get_balance_by_script(d.spk.script) for d in self.receive_keys)
+
+    # --- spend building + signing (wallet/core tx generator + sign.rs) ---
+
+    def build_send(self, utxoindex, to_address: str, amount: int, fee: int, virtual_daa_score: int, coinbase_maturity: int, aux=b"\x00" * 32) -> Transaction:
+        spendables = self.spendable_utxos(utxoindex, virtual_daa_score, coinbase_maturity)
+        spendables.sort(key=lambda t: -t[1].amount)
+        selected = []
+        total = 0
+        for outpoint, entry, d in spendables:
+            selected.append((outpoint, entry, d))
+            total += entry.amount
+            if total >= amount + fee:
+                break
+        if total < amount + fee:
+            raise WalletError(f"insufficient funds: have {total}, need {amount + fee}")
+
+        from kaspa_tpu.crypto.addresses import pay_to_address_script
+
+        outputs = [TransactionOutput(amount, pay_to_address_script(Address.from_string(to_address)))]
+        change = total - amount - fee
+        if change > 0:
+            outputs.append(TransactionOutput(change, self.receive_keys[0].spk))
+        inputs = [TransactionInput(op, b"", 0, ComputeCommit.sigops(1)) for op, _, _ in selected]
+        tx = Transaction(0, inputs, outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+
+        entries = [e for _, e, _ in selected]
+        reused = chash.SigHashReusedValues()
+        for i, (_, entry, derived) in enumerate(selected):
+            msg = chash.calc_schnorr_signature_hash(tx, entries, i, chash.SIG_HASH_ALL, reused)
+            sig = eclib.schnorr_sign(msg, derived.key.key, aux)
+            tx.inputs[i].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+        return tx

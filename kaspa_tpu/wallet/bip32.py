@@ -1,0 +1,82 @@
+"""BIP32 hierarchical deterministic keys over secp256k1.
+
+Reference: wallet/bip32 (the kaspa-bip32 crate).  Standard BIP32: master
+key from HMAC-SHA512("Bitcoin seed", seed), hardened/normal child key
+derivation, fingerprints.  Kaspa's derivation path is m/44'/111111'/a'/c/i
+(coin type 111111, wallet/core derivation defaults).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass
+
+from kaspa_tpu.crypto import eclib
+
+HARDENED = 0x80000000
+KASPA_COIN_TYPE = 111111
+
+
+def _ser256(i: int) -> bytes:
+    return i.to_bytes(32, "big")
+
+
+def _point_bytes(k: int) -> bytes:
+    x, y = eclib.point_mul(eclib.G, k)
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+@dataclass(frozen=True)
+class ExtendedKey:
+    key: int  # private scalar
+    chain_code: bytes
+    depth: int = 0
+    child_number: int = 0
+
+    @staticmethod
+    def from_seed(seed: bytes) -> "ExtendedKey":
+        digest = hmac.new(b"Bitcoin seed", seed, hashlib.sha512).digest()
+        key = int.from_bytes(digest[:32], "big")
+        if not (1 <= key < eclib.N):
+            raise ValueError("invalid master seed")
+        return ExtendedKey(key, digest[32:])
+
+    def public_key(self) -> bytes:
+        """Compressed public key (33 bytes)."""
+        return _point_bytes(self.key)
+
+    def x_only_public_key(self) -> bytes:
+        return eclib.schnorr_pubkey(self.key)
+
+    def fingerprint(self) -> bytes:
+        h = hashlib.new("ripemd160", hashlib.sha256(self.public_key()).digest()).digest()
+        return h[:4]
+
+    def derive_child(self, index: int) -> "ExtendedKey":
+        if index >= HARDENED:
+            data = b"\x00" + _ser256(self.key) + index.to_bytes(4, "big")
+        else:
+            data = self.public_key() + index.to_bytes(4, "big")
+        digest = hmac.new(self.chain_code, data, hashlib.sha512).digest()
+        tweak = int.from_bytes(digest[:32], "big")
+        child = (tweak + self.key) % eclib.N
+        if tweak >= eclib.N or child == 0:
+            # per BIP32: skip to the next index (probability ~2^-127)
+            return self.derive_child(index + 1)
+        return ExtendedKey(child, digest[32:], self.depth + 1, index)
+
+    def derive_path(self, path: str) -> "ExtendedKey":
+        """e.g. "m/44'/111111'/0'/0/5" """
+        node = self
+        for part in path.split("/"):
+            if part in ("m", ""):
+                continue
+            hardened = part.endswith("'") or part.endswith("h")
+            idx = int(part.rstrip("'h"))
+            node = node.derive_child(idx + (HARDENED if hardened else 0))
+        return node
+
+
+def kaspa_account_path(account: int = 0) -> str:
+    return f"m/44'/{KASPA_COIN_TYPE}'/{account}'"
