@@ -107,6 +107,9 @@ class Consensus:
         from kaspa_tpu.notify.notifier import ConsensusNotificationRoot
 
         self.notification_root = ConsensusNotificationRoot()
+        from kaspa_tpu.consensus.counters import ProcessingCounters
+
+        self.counters = ProcessingCounters()
 
         # virtual/UTXO state
         self.tips: set[bytes] = set()
@@ -170,8 +173,12 @@ class Consensus:
         existing = self.storage.statuses.get(block.hash)
         if existing is not None and existing != StatusesStore.STATUS_HEADER_ONLY:
             return existing  # duplicate submission: no reprocessing, no events
-        self._process_header(block.header)
+        self.counters.inc_blocks_submitted()
+        if self._process_header(block.header):
+            self.counters.inc_headers()
         self._process_body(block)
+        self.counters.inc_bodies()
+        self.counters.inc_txs(len(block.transactions))
         self.notification_root.notify_block_added(block)
         self._update_tips(block.hash)
         self._resolve_virtual()
@@ -188,10 +195,11 @@ class Consensus:
     # header stage (pipeline/header_processor/)
     # ------------------------------------------------------------------
 
-    def _process_header(self, header: Header) -> None:
+    def _process_header(self, header: Header) -> bool:
+        """Returns True if the header was newly processed (False if known)."""
         block_hash = header.hash
         if self.storage.headers.has(block_hash) and self.storage.statuses.get(block_hash) is not None:
-            return  # known
+            return False  # known
         parents = header.direct_parents()
 
         # in isolation (pre_ghostdag_validation.rs)
@@ -246,6 +254,7 @@ class Consensus:
         self.daa_excluded[block_hash] = daa_window.mergeset_non_daa
         self.window_manager.cache_block_window(block_hash, DIFFICULTY_WINDOW, daa_window.window)
         self.storage.statuses.set(block_hash, StatusesStore.STATUS_HEADER_ONLY)
+        return True
 
     # ------------------------------------------------------------------
     # body stage (pipeline/body_processor/)
@@ -388,6 +397,7 @@ class Consensus:
         for c in chain:
             if not self._verify_chain_block(c):
                 self.storage.statuses.set(c, StatusesStore.STATUS_DISQUALIFIED)
+                self.counters.inc_chain_disqualified()
                 return False
         return True
 
@@ -428,6 +438,7 @@ class Consensus:
         self._apply_chain_diff(ctx["mergeset_diff"])
         self.utxo_position = block
         self.storage.statuses.set(block, StatusesStore.STATUS_UTXO_VALID)
+        self.counters.inc_chain_blocks()
         return True
 
     def _apply_chain_diff(self, diff: UtxoDiff) -> None:

@@ -41,29 +41,60 @@ def schnorr_challenge(r32: bytes, px32: bytes, msg32: bytes) -> int:
     return int.from_bytes(h.digest(), "big") % eclib.N
 
 
+_ZERO32 = b"\x00" * 32
+
+
+def _be32_to_limbs(col, b):
+    """[N x 32-byte big-endian] -> [bucket, 16] int32 LE 16-bit limbs (vectorised)."""
+    out = np.zeros((b, W), np.int32)
+    if col:
+        arr = np.frombuffer(b"".join(col), dtype=np.uint8).reshape(len(col), 32)
+        out[: len(col)] = arr[:, ::-1].copy().view("<u2").astype(np.int32)
+    return out
+
+
+def _be32_to_digits(col, b):
+    """[N x 32-byte big-endian scalar] -> [bucket, 64] MSB-first 4-bit digits."""
+    out = np.zeros((b, pt.N_WINDOWS), np.int32)
+    if col:
+        arr = np.frombuffer(b"".join(col), dtype=np.uint8).reshape(len(col), 32)
+        digits = np.empty((len(col), 64), np.uint8)
+        digits[:, 0::2] = arr >> 4
+        digits[:, 1::2] = arr & 0x0F
+        out[: len(col)] = digits.astype(np.int32)
+    return out
+
+
 @dataclass
 class _Batch:
-    px: list = field(default_factory=list)
+    """Marshals verification jobs into the device batch layout.
+
+    The host-side "pinned buffer" packing is numpy-vectorised: 32-byte
+    big-endian field elements -> int32 limb / window-digit arrays without
+    per-item python loops (the host half of the FFI batch boundary).
+    """
+
+    px: list = field(default_factory=list)  # 32B BE x-coordinates
     py: list = field(default_factory=list)
-    rc: list = field(default_factory=list)  # canonical limbs target (r or r mod n)
-    d1: list = field(default_factory=list)  # s / u1 digits
-    d2: list = field(default_factory=list)  # e / u2 digits
+    rc: list = field(default_factory=list)  # canonical target (r or r mod n)
+    d1: list = field(default_factory=list)  # s / u1 scalars (32B BE)
+    d2: list = field(default_factory=list)  # e / u2 scalars (32B BE)
     ok: list = field(default_factory=list)
 
     def push_invalid(self):
-        self.px.append(0)
-        self.py.append(0)
-        self.rc.append(0)
-        self.d1.append(np.zeros(pt.N_WINDOWS, np.int32))
-        self.d2.append(np.zeros(pt.N_WINDOWS, np.int32))
+        self.px.append(_ZERO32)
+        self.py.append(_ZERO32)
+        self.rc.append(_ZERO32)
+        self.d1.append(_ZERO32)
+        self.d2.append(_ZERO32)
         self.ok.append(False)
 
-    def push(self, px, py, rc, d1, d2):
-        self.px.append(px)
-        self.py.append(py)
-        self.rc.append(rc)
-        self.d1.append(d1)
-        self.d2.append(d2)
+    def push(self, px: int, py: int, rc: int, s1: int, s2: int):
+        self.px.append(px.to_bytes(32, "big"))
+        self.py.append(py.to_bytes(32, "big"))
+        self.rc.append(rc.to_bytes(32, "big"))
+        self.d1.append(s1.to_bytes(32, "big"))
+        self.d2.append(s2.to_bytes(32, "big"))
         self.ok.append(True)
 
     def run(self, kernel):
@@ -71,19 +102,17 @@ class _Batch:
         if n == 0:
             return np.zeros(0, dtype=bool)
         b = _bucket(n)
-        px = np.zeros((b, W), np.int32)
-        py = np.zeros((b, W), np.int32)
-        rc = np.zeros((b, W), np.int32)
-        d1 = np.zeros((b, pt.N_WINDOWS), np.int32)
-        d2 = np.zeros((b, pt.N_WINDOWS), np.int32)
         ok = np.zeros(b, dtype=bool)
-        px[:n] = bi.ints_to_limbs(self.px, W)
-        py[:n] = bi.ints_to_limbs(self.py, W)
-        rc[:n] = bi.ints_to_limbs(self.rc, W)
-        d1[:n] = np.stack(self.d1)
-        d2[:n] = np.stack(self.d2)
         ok[:n] = self.ok
-        return np.asarray(kernel(px, py, rc, d1, d2, ok))[:n]
+        mask = kernel(
+            _be32_to_limbs(self.px, b),
+            _be32_to_limbs(self.py, b),
+            _be32_to_limbs(self.rc, b),
+            _be32_to_digits(self.d1, b),
+            _be32_to_digits(self.d2, b),
+            ok,
+        )
+        return np.asarray(mask)[:n]
 
 
 def schnorr_verify_batch(items) -> np.ndarray:
@@ -106,7 +135,7 @@ def schnorr_verify_batch(items) -> np.ndarray:
             batch.push_invalid()
             continue
         e = schnorr_challenge(sig[:32], pub, msg)
-        batch.push(pk[0], pk[1], r, pt.scalar_digits_msb(s), pt.scalar_digits_msb(e))
+        batch.push(pk[0], pk[1], r, s, e)
     return batch.run(schnorr_verify_kernel)
 
 
@@ -128,5 +157,5 @@ def ecdsa_verify_batch(items) -> np.ndarray:
         si = pow(s, -1, eclib.N)
         u1 = z * si % eclib.N
         u2 = r * si % eclib.N
-        batch.push(pk[0], pk[1], r, pt.scalar_digits_msb(u1), pt.scalar_digits_msb(u2))
+        batch.push(pk[0], pk[1], r, u1, u2)
     return batch.run(ecdsa_verify_kernel)

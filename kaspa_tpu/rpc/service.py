@@ -221,6 +221,8 @@ class RpcCoreService:
     # --- metrics (rpc.rs get_metrics -> metrics/core MetricsSnapshot) ---
 
     def get_metrics(self) -> dict:
+        from dataclasses import asdict
+
         sc = self.consensus.transaction_validator.sig_cache
         return {
             "uptime_seconds": time.time() - self.start_time,
@@ -230,6 +232,7 @@ class RpcCoreService:
             "virtual_daa_score": self.consensus.get_virtual_daa_score(),
             "sig_cache_hits": sc.hits,
             "sig_cache_misses": sc.misses,
+            "process_counters": asdict(self.consensus.counters.snapshot()),
         }
 
     # --- helpers ---
