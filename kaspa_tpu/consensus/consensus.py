@@ -29,6 +29,7 @@ from kaspa_tpu.consensus.model import (
     Transaction,
     TransactionOutpoint,
 )
+from kaspa_tpu.consensus.mass import BlockMassLimits
 from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.consensus.params import Params
 from kaspa_tpu.consensus.processes.coinbase import BlockRewardData, CoinbaseData, CoinbaseManager, MinerData
@@ -277,6 +278,22 @@ class Consensus:
         gd = self.storage.ghostdag.get(block.hash)
         if coinbase_data.blue_score != gd.blue_score:
             raise RuleError("coinbase blue score mismatch")
+        # per-dimension block mass limits (body_validation_in_isolation.rs
+        # check_block_mass): compute/transient from the calculator, storage
+        # from the miner commitments
+        limits = BlockMassLimits.with_shared_limit(self.params.max_block_mass)
+        total_compute = total_transient = total_storage = 0
+        for tx in txs:
+            nc = self.transaction_validator.mass_calculator.calc_non_contextual_masses(tx)
+            total_compute += nc.compute_mass
+            total_transient += nc.transient_mass
+            total_storage += tx.storage_mass
+            if total_compute > limits.compute:
+                raise RuleError(f"exceeds compute mass limit: {total_compute} > {limits.compute}")
+            if total_transient > limits.transient:
+                raise RuleError(f"exceeds transient mass limit: {total_transient} > {limits.transient}")
+            if total_storage > limits.storage:
+                raise RuleError(f"exceeds storage mass limit: {total_storage} > {limits.storage}")
         seen_ids = set()
         seen_outpoints = set()
         created_outpoints = set()

@@ -1,0 +1,165 @@
+"""Three-dimensional transaction mass (KIP-9): compute / transient / storage.
+
+Reference: consensus/core/src/mass/{mod.rs,units.rs}.  Storage mass is the
+harmonic/arithmetic plurality-generalized formula; compute mass combines
+serialized size, script-pubkey bytes and sigop/compute-budget grams;
+transient mass scales serialized size.  Block limits normalize all
+dimensions to the compute scale via cofactors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kaspa_tpu.consensus.model import Transaction
+
+HASH_SIZE = 32
+SUBNETWORK_ID_SIZE = 20
+TRANSIENT_BYTE_TO_MASS_FACTOR = 4  # constants.rs:36
+SOMPI_PER_KASPA = 100_000_000
+STORAGE_MASS_PARAMETER = SOMPI_PER_KASPA * 10_000  # constants.rs:31 (= 10^12)
+GRAMS_PER_COMPUTE_BUDGET_UNIT = 100  # units.rs:4
+GRAMS_PER_SIGOP_COUNT_UNIT = 1000  # units.rs:12 scale (grams per sigop)
+
+UTXO_CONST_STORAGE = 32 + 4 + 8 + 8 + 1 + 2 + 8  # mass/mod.rs utxo_plurality
+UTXO_UNIT_SIZE = 100
+
+
+def transaction_estimated_serialized_size(tx: Transaction) -> int:
+    size = 2 + 8  # version + input count
+    for inp in tx.inputs:
+        size += HASH_SIZE + 4  # outpoint
+        size += 8 + len(inp.signature_script)
+        size += 8  # sequence
+        if tx.version >= 1:
+            size += 2  # compute budget
+    size += 8  # output count
+    for out in tx.outputs:
+        size += 8 + 2 + 8 + len(out.script_public_key.script)
+        if out.covenant is not None:
+            size += 2 + HASH_SIZE
+    size += 8 + SUBNETWORK_ID_SIZE + 8 + HASH_SIZE  # lock time, subnet, gas, payload hash
+    size += 8 + len(tx.payload)
+    return size
+
+
+def utxo_plurality(spk, has_covenant: bool) -> int:
+    total = UTXO_CONST_STORAGE + len(spk.script) + (HASH_SIZE if has_covenant else 0)
+    return -(-total // UTXO_UNIT_SIZE)
+
+
+def _cell_of_entry(entry):
+    return (utxo_plurality(entry.script_public_key, entry.covenant_id is not None), entry.amount)
+
+
+def _cell_of_output(out):
+    return (utxo_plurality(out.script_public_key, out.covenant is not None), out.value)
+
+
+def calc_storage_mass(is_coinbase: bool, input_cells: list, output_cells: list, storm_param: int) -> int | None:
+    """KIP-9: max(0, C·(|O|/H(O) − |I|/A(I))), relaxed harmonic path when
+    |O| = 1 or |I| = 1 or |O| = |I| = 2 (plurality-generalized)."""
+    if is_coinbase:
+        return 0
+    outs_plurality = 0
+    harmonic_outs = 0
+    for plurality, amount in output_cells:
+        outs_plurality += plurality
+        term = storm_param * plurality * plurality
+        if term >= (1 << 64):  # mirrors checked_mul overflow -> incomputable
+            return None
+        harmonic_outs += term // amount
+        if harmonic_outs >= (1 << 64):
+            return None
+
+    if outs_plurality == 1:
+        relaxed = True
+    elif len(input_cells) > 2:
+        relaxed = False
+    else:
+        ins_plurality = sum(p for p, _ in input_cells)
+        relaxed = ins_plurality == 1 or (outs_plurality == 2 and ins_plurality == 2)
+
+    if relaxed:
+        harmonic_ins = 0
+        for plurality, amount in input_cells:
+            harmonic_ins = min(harmonic_ins + storm_param * plurality * plurality // amount, (1 << 64) - 1)
+        return max(0, harmonic_outs - harmonic_ins)
+
+    ins_plurality = sum(p for p, _ in input_cells)
+    sum_ins = sum(a for _, a in input_cells)
+    mean_ins = max(sum_ins // ins_plurality, 1)
+    arithmetic_ins = min(ins_plurality * (storm_param // mean_ins), (1 << 64) - 1)
+    return max(0, harmonic_outs - arithmetic_ins)
+
+
+@dataclass
+class NonContextualMasses:
+    compute_mass: int
+    transient_mass: int
+
+
+@dataclass
+class BlockMassLimits:
+    storage: int
+    compute: int
+    transient: int
+
+    @staticmethod
+    def with_shared_limit(limit: int) -> "BlockMassLimits":
+        return BlockMassLimits(limit, limit, limit)
+
+    def would_fit(self, totals: "NonContextualMasses", storage_total: int) -> bool:
+        """True if per-dimension totals are within the per-dimension limits."""
+        return (
+            totals.compute_mass <= self.compute
+            and totals.transient_mass <= self.transient
+            and storage_total <= self.storage
+        )
+
+
+class MassCalculator:
+    def __init__(
+        self,
+        mass_per_tx_byte: int = 1,
+        mass_per_script_pub_key_byte: int = 10,
+        storage_mass_parameter: int = STORAGE_MASS_PARAMETER,
+        mass_per_sig_op: int = GRAMS_PER_SIGOP_COUNT_UNIT,
+    ):
+        self.mass_per_tx_byte = mass_per_tx_byte
+        self.mass_per_script_pub_key_byte = mass_per_script_pub_key_byte
+        self.storage_mass_parameter = storage_mass_parameter
+        self.mass_per_sig_op = mass_per_sig_op
+
+    @staticmethod
+    def from_params(params) -> "MassCalculator":
+        return MassCalculator(
+            params.mass_per_tx_byte,
+            params.mass_per_script_pub_key_byte,
+            params.storage_mass_parameter,
+            params.mass_per_sig_op,
+        )
+
+    def calc_non_contextual_masses(self, tx: Transaction) -> NonContextualMasses:
+        if tx.is_coinbase():
+            return NonContextualMasses(0, 0)
+        size = transaction_estimated_serialized_size(tx)
+        compute_for_size = size * self.mass_per_tx_byte
+        spk_size = sum(2 + len(o.script_public_key.script) for o in tx.outputs)
+        spk_mass = spk_size * self.mass_per_script_pub_key_byte
+        if tx.version >= 1:
+            script_mass = GRAMS_PER_COMPUTE_BUDGET_UNIT * sum(
+                (i.compute_commit.compute_budget() or 0) for i in tx.inputs
+            )
+        else:
+            script_mass = self.mass_per_sig_op * sum((i.compute_commit.sig_op_count() or 0) for i in tx.inputs)
+        return NonContextualMasses(compute_for_size + spk_mass + script_mass, size * TRANSIENT_BYTE_TO_MASS_FACTOR)
+
+    def calc_contextual_masses(self, tx: Transaction, utxo_entries) -> int | None:
+        """Storage mass of a populated tx (None == incomputable/too high)."""
+        return calc_storage_mass(
+            tx.is_coinbase(),
+            [_cell_of_entry(e) for e in utxo_entries],
+            [_cell_of_output(o) for o in tx.outputs],
+            self.storage_mass_parameter,
+        )

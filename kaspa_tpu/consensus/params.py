@@ -131,6 +131,10 @@ class Params:
     max_difficulty_target: int = MAX_DIFFICULTY_TARGET
     timestamp_deviation_tolerance: int = TIMESTAMP_DEVIATION_TOLERANCE
     max_block_mass: int = 500_000
+    mass_per_tx_byte: int = 1
+    mass_per_script_pub_key_byte: int = 10
+    mass_per_sig_op: int = 1000
+    storage_mass_parameter: int = 100_000_000 * 10_000  # STORAGE_MASS_PARAMETER
     max_tx_inputs: int = 1_000
     max_tx_outputs: int = 1_000
     max_signature_script_len: int = 1_000

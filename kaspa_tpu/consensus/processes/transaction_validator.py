@@ -1,9 +1,9 @@
 """Transaction validation (reference: consensus/src/processes/transaction_validator/).
 
 - in-isolation checks (tx_validation_in_isolation.rs): counts, duplicate
-  outpoints, script length limits, value ranges.  NOTE: the KIP-9 mass
-  calculator (compute/transient/storage mass) is not implemented yet —
-  mass commitment checks and block mass limits land with that milestone
+  outpoints, script length limits, value ranges
+- KIP-9 mass commitment checks against the contextual mass calculator
+  (consensus/mass.py)
 - header-context checks (tx_validation_in_header_context.rs): lock time
 - UTXO-context checks (tx_validation_in_utxo_context.rs): maturity, input
   amounts, fee, sequence locks, script checks
@@ -15,6 +15,7 @@ rayon check_scripts_par_iter (the "TPU offload point", SURVEY.md §2.5).
 
 from __future__ import annotations
 
+from kaspa_tpu.consensus.mass import MassCalculator
 from kaspa_tpu.consensus.model import SUBNETWORK_ID_NATIVE, Transaction
 from kaspa_tpu.consensus.params import Params
 from kaspa_tpu.txscript.batch import BatchScriptChecker
@@ -40,6 +41,7 @@ class TransactionValidator:
         self.params = params
         self.coinbase_maturity = params.coinbase_maturity
         self.sig_cache = sig_cache if sig_cache is not None else SigCache()
+        self.mass_calculator = MassCalculator.from_params(params)
         if vm_fallback is None:
             # nonstandard scripts run through the host VM with the shared cache
             from kaspa_tpu.txscript import vm as _vm
@@ -115,11 +117,22 @@ class TransactionValidator:
         total_in = self._check_input_amounts(entries)
         total_out = self._check_output_values(tx, total_in)
         fee = total_in - total_out
+        if flags != FLAG_SKIP_MASS:
+            self._check_mass_commitment(tx, entries)
         self._check_sequence_lock(tx, entries, pov_daa_score)
         if flags in (FLAG_FULL, FLAG_SKIP_MASS):
             assert checker is not None and token is not None, "script checks need a batch checker"
             checker.collect_tx(token, tx, entries)
         return fee
+
+    def _check_mass_commitment(self, tx, entries):
+        """tx_validation_in_utxo_context.rs check_mass_commitment: the miner-
+        committed storage mass must equal the KIP-9 contextual mass."""
+        calculated = self.mass_calculator.calc_contextual_masses(tx, entries)
+        if calculated is None:
+            raise TxRuleError("mass incomputable")
+        if tx.storage_mass != calculated:
+            raise TxRuleError(f"wrong mass commitment: committed {tx.storage_mass}, calculated {calculated}")
 
     def _check_coinbase_maturity(self, tx, entries, pov_daa_score):
         for i, (inp, entry) in enumerate(zip(tx.inputs, entries)):

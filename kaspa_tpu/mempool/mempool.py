@@ -22,8 +22,13 @@ class MempoolError(Exception):
 class MempoolTx:
     tx: Transaction
     fee: int
-    mass: int
+    mass: int  # compute mass
     added_daa_score: int
+    transient_mass: int = 0
+
+    @property
+    def storage_mass(self) -> int:
+        return self.tx.storage_mass
 
     @property
     def feerate(self) -> float:
@@ -134,17 +139,28 @@ class Mempool:
 
     # --- selection (frontier.rs, selectors.rs) ---
 
-    def select_transactions(self, max_count: int = 300) -> list[MempoolTx]:
+    def select_transactions(self, max_count: int = 300, mass_limits=None) -> list[MempoolTx]:
         """Feerate-descending greedy selection (frontier sampling's greedy
-        limit case); in-pool dependency chains are excluded because consensus
-        forbids chained transactions within one block."""
+        limit case) bounded by the per-dimension block mass limits; in-pool
+        dependency chains are excluded because consensus forbids chained
+        transactions within one block."""
         chosen: list[MempoolTx] = []
         chosen_ids: set[bytes] = set()
+        compute = transient = storage = 0
         for txid, entry in sorted(self.pool.items(), key=lambda kv: kv[1].feerate, reverse=True):
             if len(chosen) >= max_count:
                 break
             if any(inp.previous_outpoint.transaction_id in chosen_ids for inp in entry.tx.inputs):
                 continue  # would chain onto an in-block parent
+            if mass_limits is not None and not (
+                compute + entry.mass <= mass_limits.compute
+                and transient + entry.transient_mass <= mass_limits.transient
+                and storage + entry.storage_mass <= mass_limits.storage
+            ):
+                continue  # would overflow a block mass dimension
+            compute += entry.mass
+            transient += entry.transient_mass
+            storage += entry.storage_mass
             chosen.append(entry)
             chosen_ids.add(txid)
         return chosen

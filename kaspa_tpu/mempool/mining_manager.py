@@ -68,7 +68,8 @@ class MiningManager:
                 break
             entries.append(entry)
         if missing:
-            entry = MempoolTx(tx, fee=0, mass=self._mass(tx), added_daa_score=virtual.daa_score)
+            nc = self._masses(tx)
+            entry = MempoolTx(tx, fee=0, mass=nc.compute_mass, added_daa_score=virtual.daa_score, transient_mass=nc.transient_mass)
             self.mempool.insert(entry, orphan=True)
             return []
 
@@ -79,16 +80,13 @@ class MiningManager:
         err = checker.dispatch().get(0)
         if err is not None:
             raise TxRuleError(str(err))
-        evicted = self.mempool.insert(MempoolTx(tx, fee, self._mass(tx), virtual.daa_score))
+        nc = self._masses(tx)
+        evicted = self.mempool.insert(MempoolTx(tx, fee, nc.compute_mass, virtual.daa_score, nc.transient_mass))
         self.template_cache.clear()
         return evicted
 
-    @staticmethod
-    def _mass(tx: Transaction) -> int:
-        """Serialized-size stand-in until the KIP-9 mass calculator lands."""
-        return 200 + sum(len(i.signature_script) + 100 for i in tx.inputs) + sum(
-            len(o.script_public_key.script) + 40 for o in tx.outputs
-        )
+    def _masses(self, tx: Transaction):
+        return self.consensus.transaction_validator.mass_calculator.calc_non_contextual_masses(tx)
 
     # --- block templates (manager.rs:94-215) ---
 
@@ -96,7 +94,10 @@ class MiningManager:
         cached = self.template_cache.get()
         if cached is not None:
             return cached
-        selected = self.mempool.select_transactions()
+        from kaspa_tpu.consensus.mass import BlockMassLimits
+
+        limits = BlockMassLimits.with_shared_limit(self.consensus.params.max_block_mass)
+        selected = self.mempool.select_transactions(mass_limits=limits)
         template = self.consensus.build_block_template(miner_data, [e.tx for e in selected], timestamp)
         self.template_cache.set(template)
         return template

@@ -56,7 +56,7 @@ class Miner:
         self.miner_data = MinerData(self.spk, extra_data=f"miner-{idx}".encode())
 
 
-def _make_tx(miner: Miner, outpoint, entry, rng: random.Random) -> Transaction:
+def _make_tx(miner: Miner, outpoint, entry, rng: random.Random, mass_calculator=None) -> Transaction:
     """Spend one UTXO back to the miner (split in two) with a real signature."""
     half = entry.amount // 2
     if half == 0:
@@ -64,6 +64,11 @@ def _make_tx(miner: Miner, outpoint, entry, rng: random.Random) -> Transaction:
     outputs = [TransactionOutput(half, miner.spk), TransactionOutput(entry.amount - half, miner.spk)]
     inp = TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))
     tx = Transaction(0, [inp], outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+    if mass_calculator is None:
+        from kaspa_tpu.consensus.mass import MassCalculator
+
+        mass_calculator = MassCalculator()
+    tx.storage_mass = mass_calculator.calc_contextual_masses(tx, [entry])
     reused = chash.SigHashReusedValues()
     msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
     sig = eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32))
@@ -123,7 +128,7 @@ def simulate(cfg: SimConfig) -> SimResult:
                     continue
                 if entry.is_coinbase and entry.block_daa_score + params.coinbase_maturity > pov_daa_score:
                     continue
-                tx = _make_tx(miner, outpoint, entry, rng)
+                tx = _make_tx(miner, outpoint, entry, rng, consensus.transaction_validator.mass_calculator)
                 if tx is not None:
                     txs.append(tx)
                     spent.add(outpoint)

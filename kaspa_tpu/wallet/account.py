@@ -74,7 +74,7 @@ class Account:
 
     # --- spend building + signing (wallet/core tx generator + sign.rs) ---
 
-    def build_send(self, utxoindex, to_address: str, amount: int, fee: int, virtual_daa_score: int, coinbase_maturity: int, aux=b"\x00" * 32) -> Transaction:
+    def build_send(self, utxoindex, to_address: str, amount: int, fee: int, virtual_daa_score: int, coinbase_maturity: int, aux=b"\x00" * 32, mass_calculator=None) -> Transaction:
         spendables = self.spendable_utxos(utxoindex, virtual_daa_score, coinbase_maturity)
         spendables.sort(key=lambda t: -t[1].amount)
         selected = []
@@ -97,6 +97,11 @@ class Account:
         tx = Transaction(0, inputs, outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
 
         entries = [e for _, e, _ in selected]
+        if mass_calculator is None:
+            from kaspa_tpu.consensus.mass import MassCalculator
+
+            mass_calculator = MassCalculator()
+        tx.storage_mass = mass_calculator.calc_contextual_masses(tx, entries)
         reused = chash.SigHashReusedValues()
         for i, (_, entry, derived) in enumerate(selected):
             msg = chash.calc_schnorr_signature_hash(tx, entries, i, chash.SIG_HASH_ALL, reused)

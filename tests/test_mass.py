@@ -1,0 +1,139 @@
+"""KIP-9 mass golden tests (vectors from consensus/core/src/mass/mod.rs tests)."""
+
+import pytest
+
+from kaspa_tpu.consensus.mass import (
+    SOMPI_PER_KASPA,
+    STORAGE_MASS_PARAMETER,
+    MassCalculator,
+    calc_storage_mass,
+    transaction_estimated_serialized_size,
+    utxo_plurality,
+)
+from kaspa_tpu.consensus.model import (
+    ComputeCommit,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE
+
+
+def _tx_from_amounts(ins, outs):
+    spk = ScriptPublicKey(0, b"")
+    tx = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(bytes([i]) * 32, 0), b"", 0, ComputeCommit.sigops(0)) for i in range(len(ins))],
+        [TransactionOutput(v, spk) for v in outs],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    entries = [UtxoEntry(v, spk, 0, False) for v in ins]
+    return tx, entries
+
+
+def test_storage_mass_golden():
+    """mass/mod.rs test_storage_mass vector-for-vector."""
+    C = 10**12
+
+    # 3:2 symmetric compound -> 0
+    tx, entries = _tx_from_amounts([100, 200, 300], [300, 300])
+    assert MassCalculator(0, 0, C).calc_contextual_masses(tx, entries) == 0
+
+    # asymmetric outputs
+    tx.outputs[0].value = 50
+    tx.outputs[1].value = 550
+    expected = C // 50 + C // 550 - 3 * (C // 200)
+    assert MassCalculator(0, 0, C).calc_contextual_masses(tx, entries) == expected
+
+    # more outs than ins at the C boundary
+    base = 10_000 * SOMPI_PER_KASPA
+    tx, entries = _tx_from_amounts([base, base, base * 2], [base] * 4)
+    assert MassCalculator(0, 0, STORAGE_MASS_PARAMETER).calc_contextual_masses(tx, entries) == 4
+
+    tx2, entries2 = _tx_from_amounts([base, base, base * 2], [10 * SOMPI_PER_KASPA, base, base, base])
+    assert MassCalculator(0, 0, STORAGE_MASS_PARAMETER).calc_contextual_masses(tx2, entries2) == 1003
+
+    # increase values over the limit -> 0
+    tx3, entries3 = _tx_from_amounts([base, base, base * 2 + 4], [base + 1] * 4)
+    assert MassCalculator(0, 0, STORAGE_MASS_PARAMETER).calc_contextual_masses(tx3, entries3) == 0
+
+    # 2:2 relaxed formula
+    tx, entries = _tx_from_amounts([100, 200], [50, 250])
+    assert MassCalculator(0, 0, C).calc_contextual_masses(tx, entries) == 9_000_000_000
+    tx.outputs[0].value = 100
+    tx.outputs[1].value = 200
+    assert MassCalculator(0, 0, C).calc_contextual_masses(tx, entries) == 0
+    # 2:1
+    tx.outputs.pop()
+    tx.outputs[0].value = 50
+    assert MassCalculator(0, 0, C).calc_contextual_masses(tx, entries) == 5_000_000_000
+
+
+def test_utxo_plurality_boundaries():
+    """mass/mod.rs verify_utxo_plurality_limits boundary asserts."""
+    assert utxo_plurality(ScriptPublicKey(0, b""), False) == 1
+    assert utxo_plurality(ScriptPublicKey(0, bytes(100 - 63)), False) == 1
+    assert utxo_plurality(ScriptPublicKey(0, bytes(100 - 63 + 1)), False) == 2
+    assert utxo_plurality(ScriptPublicKey(0, bytes(100 - 63)), True) == 2
+    assert utxo_plurality(ScriptPublicKey(0, bytes(200 - 63 - 32)), True) == 2
+
+
+def test_coinbase_mass_is_zero():
+    from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_COINBASE
+
+    cb = Transaction(0, [], [TransactionOutput(5, ScriptPublicKey(0, b"\x01"))], 0, SUBNETWORK_ID_COINBASE, 0, b"\x00" * 20)
+    mc = MassCalculator()
+    assert mc.calc_non_contextual_masses(cb).compute_mass == 0
+    assert mc.calc_contextual_masses(cb, []) == 0
+
+
+def test_compute_and_transient_mass_structure():
+    tx, entries = _tx_from_amounts([1000], [500])
+    tx.inputs[0] = TransactionInput(tx.inputs[0].previous_outpoint, b"\x00" * 65, 0, ComputeCommit.sigops(1))
+    mc = MassCalculator(1, 10, STORAGE_MASS_PARAMETER)
+    nc = mc.calc_non_contextual_masses(tx)
+    size = transaction_estimated_serialized_size(tx)
+    assert nc.transient_mass == size * 4
+    assert nc.compute_mass == size * 1 + (2 + 0) * 10 + 1 * 1000  # size + spk bytes + 1 sigop
+
+
+def test_wrong_mass_commitment_rejected_in_block():
+    """A tx with an incorrect storage-mass commitment must disqualify its block."""
+    import random
+
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.sim.simulator import SimConfig, simulate
+
+    res = simulate(SimConfig(bps=2, delay=0.5, num_miners=2, num_blocks=24, txs_per_block=2, seed=29))
+    tx_block = next(b for b in res.blocks if len(b.transactions) > 1)
+    assert any(t.storage_mass > 0 for t in tx_block.transactions[1:]), 'sim should commit nonzero storage mass'
+    # replay with one commitment tampered: merkle must change (hash commits to
+    # mass), so rebuild the merkle and expect chain disqualification
+    from dataclasses import replace
+
+    from kaspa_tpu.consensus.model.block import Block
+    from kaspa_tpu.crypto import merkle as mk
+
+    fresh = Consensus(res.params)
+    for b in res.blocks:
+        if b.hash == tx_block.hash:
+            break
+        fresh.validate_and_insert_block(b)
+    import copy
+
+    txs = copy.deepcopy(tx_block.transactions)
+    txs[1].storage_mass += 7
+    txs[1]._id_cache = None
+    hdr = replace(tx_block.header, hash_merkle_root=mk.calc_hash_merkle_root(txs))
+    hdr._hash_cache = None
+    status = fresh.validate_and_insert_block(Block(hdr, txs))
+    if status == "utxo_pending":
+        assert not fresh._ensure_chain_utxo_valid(hdr.hash)
+    else:
+        assert status == "disqualified"
