@@ -134,30 +134,37 @@ class Consensus:
 
     def _insert_genesis(self):
         g = self.params.genesis
-        header = Header(
-            version=g.version,
-            parents_by_level=[[]],
-            hash_merkle_root=b"\x00" * 32,
-            accepted_id_merkle_root=b"\x00" * 32,
-            utxo_commitment=MuHash().finalize(),
-            timestamp=g.timestamp,
-            bits=g.bits,
-            nonce=0,
-            daa_score=g.daa_score,
-            blue_work=0,
-            blue_score=0,
-            pruning_point=g.hash,
-        )
-        header._hash_cache = g.hash
+        override = self.params.genesis_override
+        if override is not None:
+            header = override.header
+            genesis_txs = list(override.transactions)
+        else:
+            header = Header(
+                version=g.version,
+                parents_by_level=[[]],
+                hash_merkle_root=b"\x00" * 32,
+                accepted_id_merkle_root=b"\x00" * 32,
+                utxo_commitment=MuHash().finalize(),
+                timestamp=g.timestamp,
+                bits=g.bits,
+                nonce=0,
+                daa_score=g.daa_score,
+                blue_work=0,
+                blue_score=0,
+                pruning_point=g.hash,
+            )
+            header._hash_cache = g.hash
+            genesis_txs = [
+                Transaction(
+                    0, [], [], 0, SUBNETWORK_ID_COINBASE, 0,
+                    self.coinbase_manager.serialize_coinbase_payload(CoinbaseData(0, 0, MinerData(ScriptPublicKey(0, b"")))),
+                )
+            ]
         self.storage.headers.insert(header)
         self.storage.relations.insert(g.hash, [ORIGIN])
         self.storage.ghostdag.insert(g.hash, self.ghostdag_manager.genesis_ghostdag_data())
         self.reachability.add_block(g.hash, [ORIGIN], ORIGIN)
-        genesis_coinbase = Transaction(
-            0, [], [], 0, SUBNETWORK_ID_COINBASE, 0,
-            self.coinbase_manager.serialize_coinbase_payload(CoinbaseData(0, 0, MinerData(ScriptPublicKey(0, b"")))),
-        )
-        self.storage.block_transactions.insert(g.hash, [genesis_coinbase])
+        self.storage.block_transactions.insert(g.hash, genesis_txs)
         self.storage.statuses.set(g.hash, StatusesStore.STATUS_UTXO_VALID)
         self.multisets[g.hash] = MuHash()
         self.utxo_diffs[g.hash] = UtxoDiff()

@@ -145,6 +145,7 @@ class Params:
     skip_proof_of_work: bool = False
     max_block_level: int = 225
     pruning_proof_m: int = PRUNING_PROOF_M
+    genesis_override: object = None  # full genesis Block (golden-DAG replay)
 
     @staticmethod
     def from_bps(name: str, bps: int, genesis: GenesisBlock, **overrides) -> "Params":
