@@ -9,9 +9,52 @@ TPU U3072 reduction.
 
 from __future__ import annotations
 
+import ctypes
+import os
+import subprocess
+import threading
+
 import numpy as np
 
 _CONSTANTS = np.array([0x61707865, 0x3320646E, 0x79622D32, 0x6B206574], dtype=np.uint32)
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "hostcrypto", "hostcrypto.cc")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "native", "hostcrypto", "libhostcrypto.so")
+_LOCK = threading.Lock()
+_LIB = None
+_LIB_FAILED = False
+
+
+def _native_lib():
+    """Build/load the native keystream library; None if unavailable."""
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        try:
+            if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+                # atomic temp+rename so concurrent processes never load a
+                # half-written .so
+                tmp = _LIB_PATH + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, _LIB_PATH)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.chacha20_keystream_batch.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+            ]
+            _LIB = lib
+        except Exception:
+            _LIB_FAILED = True
+    return _LIB
 
 
 def _rotl(x, n):
@@ -30,9 +73,21 @@ def _quarter(s, a, b, c, d):
 
 
 def keystream(keys: np.ndarray, n_bytes: int) -> np.ndarray:
-    """keys: [N, 32] uint8 -> [N, n_bytes] uint8 keystream (counter from 0)."""
+    """keys: [N, 32] uint8 -> [N, n_bytes] uint8 keystream (counter from 0).
+
+    Uses the native C path when available (the per-element host hot loop of
+    muhash element expansion); falls back to the vectorised numpy rounds.
+    """
     assert keys.ndim == 2 and keys.shape[1] == 32
     n = keys.shape[0]
+    lib = _native_lib()
+    if lib is not None and n > 0:
+        keys_u8 = np.ascontiguousarray(keys, dtype=np.uint8)
+        out = np.empty((n, n_bytes), dtype=np.uint8)
+        lib.chacha20_keystream_batch(
+            keys_u8.ctypes.data_as(ctypes.c_char_p), n, out.ctypes.data_as(ctypes.c_void_p), n_bytes
+        )
+        return out
     key_words = keys.view("<u4").reshape(n, 8).astype(np.uint32)
     n_blocks = (n_bytes + 63) // 64
     out = np.empty((n, n_blocks * 64), dtype=np.uint8)
@@ -56,5 +111,7 @@ def keystream(keys: np.ndarray, n_bytes: int) -> np.ndarray:
                 _quarter(s, 2, 7, 8, 13)
                 _quarter(s, 3, 4, 9, 14)
             s += init
-            out[:, blk * 64 : (blk + 1) * 64] = s.T.astype("<u4").view(np.uint8).reshape(n, 64)
+            out[:, blk * 64 : (blk + 1) * 64] = (
+                np.ascontiguousarray(s.T, dtype="<u4").view(np.uint8).reshape(n, 64)
+            )
     return out[:, :n_bytes]

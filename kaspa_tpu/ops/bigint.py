@@ -89,6 +89,18 @@ class FieldCtx:
         self.zero = np.zeros(self.W, dtype=np.int32)
         self.one = int_to_limbs(1, self.W)
 
+    @property
+    def m_limbs_dev(self):
+        if not hasattr(self, "_m_limbs_dev"):
+            self._m_limbs_dev = jnp.asarray(self.m_limbs)
+        return self._m_limbs_dev
+
+    @property
+    def c_limbs16_dev(self):
+        if not hasattr(self, "_c_limbs16_dev"):
+            self._c_limbs16_dev = jnp.asarray(self.c_limbs16)
+        return self._c_limbs16_dev
+
     def __repr__(self):
         return f"FieldCtx({self.name}, {self.bits}b)"
 
@@ -129,6 +141,22 @@ def _conv_matrix_np(k: int):
 
 
 @functools.lru_cache(maxsize=None)
+def _const(arr_factory_key):
+    """Memoized device constants: avoids re-running numpy->jax conversion for
+    the large one-hot matrices on every traced multiply (a dominant share of
+    trace/lowering time for fresh batch shapes)."""
+    kind, arg = arr_factory_key
+    if kind == "conv":
+        return jnp.asarray(_conv_matrix_np(arg))
+    if kind == "collect":
+        return jnp.asarray(_block_collect_np(arg))
+    if kind == "cmat":
+        c8, k = arg
+        return jnp.asarray(_c_matrix_np(c8, k))
+    raise KeyError(kind)
+
+
+@functools.lru_cache(maxsize=None)
 def _block_collect_np(nb: int):
     """[nb*nb, 2nb-1] one-hot: block pair (i,j) -> result block i+j."""
     m = np.zeros((nb * nb, 2 * nb - 1), np.int32)
@@ -156,7 +184,7 @@ def _poly_mul8(a8, b8):
     """
     k = a8.shape[-1]
     if k <= 64:
-        m = jnp.asarray(_conv_matrix_np(k))
+        m = _const(("conv", k))
         p = (a8[..., :, None] * b8[..., None, :]).reshape(*a8.shape[:-1], k * k)
         return jax.lax.dot_general(
             p, m, (((p.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.int32
@@ -167,13 +195,13 @@ def _poly_mul8(a8, b8):
     ab = a8.reshape(*lead, nb, _BLK)
     bb = b8.reshape(*lead, nb, _BLK)
     # all block-pair products through one 32-wide contraction
-    m = jnp.asarray(_conv_matrix_np(_BLK))  # [blk*blk, 2blk]
+    m = _const(("conv", _BLK))  # [blk*blk, 2blk]
     p = (ab[..., :, None, :, None] * bb[..., None, :, None, :]).reshape(*lead, nb * nb, _BLK * _BLK)
     c = jax.lax.dot_general(p, m, (((p.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
     # collect pair results into blocks k = i + j  (sums of <= nb products:
     # per-column bound nb * blk * 2**20 <= 2**31 for nb <= 16, blk = 32
     # ... tighter: blk*2**20 per pair, nb pairs -> nb*2**25; nb<=12 ok)
-    coll = jnp.asarray(_block_collect_np(nb))
+    coll = _const(("collect", nb))
     d = jax.lax.dot_general(
         c, coll, (((c.ndim - 2,), (0,)), ((), ())), preferred_element_type=jnp.int32
     )  # [..., 2blk, 2nb-1]
@@ -211,7 +239,7 @@ def _mul_by_c(ctx: FieldCtx, x):
     """x * c where c = 2**(16W) - m, via 8-bit digits of c. Input any width."""
     x8 = _split8(x)
     k = x8.shape[-1]
-    m = jnp.asarray(_c_matrix_np(ctx.c8, k))
+    m = _const(("cmat", (ctx.c8, k)))
     out = jax.lax.dot_general(
         x8, m, (((x8.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.int32
     )
@@ -280,7 +308,7 @@ def _scan_carry(x):
 
 def _cond_sub_m(ctx: FieldCtx, x):
     """x in [0, 2**16W) canonical -> subtract m once if x >= m."""
-    m = jnp.asarray(ctx.m_limbs)
+    m = ctx.m_limbs_dev
     d, top = _scan_carry(x - m)
     take = top >= 0  # no borrow => x >= m
     return jnp.where(take[..., None], d, x)
@@ -294,7 +322,7 @@ def canon(ctx: FieldCtx, x):
     three substitutions the top carry is provably zero; a final conditional
     subtract brings the value into [0, m).
     """
-    c16 = jnp.asarray(ctx.c_limbs16)
+    c16 = ctx.c_limbs16_dev
     nc = ctx.c_limbs16.shape[0]
     base, t = _scan_carry(x)  # |t| <= 4 given lazy-limb bounds
     for _ in range(3):

@@ -29,11 +29,13 @@ def _build_native():
     with _BUILD_LOCK:
         if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
             return _LIB_PATH
+        tmp = _LIB_PATH + f".tmp{os.getpid()}"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB_PATH, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
         )
+        os.replace(tmp, _LIB_PATH)
     return _LIB_PATH
 
 

@@ -1,0 +1,69 @@
+// Host crypto hot loops: ChaCha20 keystream expansion for muhash elements.
+//
+// The reference expands each muhash element with rand_chacha
+// (crypto/muhash/src/lib.rs:152-168) in native Rust; this provides the
+// equivalent native path for the framework's host side (djb variant:
+// 64-bit counter from 0, nonce 0), batched over N keys.
+//
+// C ABI for ctypes.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint32_t rotl(uint32_t x, int n) { return (x << n) | (x >> (32 - n)); }
+
+#define QR(a, b, c, d)                                                                                   \
+  a += b; d ^= a; d = rotl(d, 16);                                                                       \
+  c += d; b ^= c; b = rotl(b, 12);                                                                       \
+  a += b; d ^= a; d = rotl(d, 8);                                                                        \
+  c += d; b ^= c; b = rotl(b, 7);
+
+void chacha_block(const uint32_t key[8], uint64_t counter, uint8_t out[64]) {
+  uint32_t init[16] = {0x61707865u, 0x3320646eu, 0x79622d32u, 0x6b206574u,
+                       key[0], key[1], key[2], key[3], key[4], key[5], key[6], key[7],
+                       static_cast<uint32_t>(counter), static_cast<uint32_t>(counter >> 32), 0u, 0u};
+  uint32_t x[16];
+  memcpy(x, init, sizeof(x));
+  for (int i = 0; i < 10; i++) {
+    QR(x[0], x[4], x[8], x[12])
+    QR(x[1], x[5], x[9], x[13])
+    QR(x[2], x[6], x[10], x[14])
+    QR(x[3], x[7], x[11], x[15])
+    QR(x[0], x[5], x[10], x[15])
+    QR(x[1], x[6], x[11], x[12])
+    QR(x[2], x[7], x[8], x[13])
+    QR(x[3], x[4], x[9], x[14])
+  }
+  for (int i = 0; i < 16; i++) {
+    uint32_t v = x[i] + init[i];
+    out[4 * i + 0] = static_cast<uint8_t>(v);
+    out[4 * i + 1] = static_cast<uint8_t>(v >> 8);
+    out[4 * i + 2] = static_cast<uint8_t>(v >> 16);
+    out[4 * i + 3] = static_cast<uint8_t>(v >> 24);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// keys: n x 32 bytes (little-endian words); out: n x out_len bytes
+void chacha20_keystream_batch(const uint8_t* keys, uint64_t n, uint8_t* out, uint64_t out_len) {
+  uint64_t blocks = (out_len + 63) / 64;
+  uint8_t buf[64];
+  for (uint64_t i = 0; i < n; i++) {
+    uint32_t key[8];
+    memcpy(key, keys + i * 32, 32);
+    uint8_t* dst = out + i * out_len;
+    for (uint64_t b = 0; b < blocks; b++) {
+      chacha_block(key, b, buf);
+      uint64_t off = b * 64;
+      uint64_t take = out_len - off < 64 ? out_len - off : 64;
+      memcpy(dst + off, buf, take);
+    }
+  }
+}
+
+}  // extern "C"
