@@ -33,6 +33,7 @@ from kaspa_tpu.consensus.mass import BlockMassLimits
 from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.consensus.params import Params
 from kaspa_tpu.consensus.processes.coinbase import BlockRewardData, CoinbaseData, CoinbaseManager, MinerData
+from kaspa_tpu.consensus.processes.block_depth import BlockDepthManager
 from kaspa_tpu.consensus.processes.ghostdag import GhostdagManager
 from kaspa_tpu.consensus.processes.transaction_validator import (
     FLAG_FULL,
@@ -105,6 +106,9 @@ class Consensus:
             bps=params.bps,
         )
         self.transaction_validator = TransactionValidator(params)
+        self.depth_manager = BlockDepthManager(
+            params.merge_depth, params.finality_depth, params.genesis.hash, self.storage.ghostdag, self.reachability
+        )
         from kaspa_tpu.notify.notifier import ConsensusNotificationRoot
 
         self.notification_root = ConsensusNotificationRoot()
@@ -253,6 +257,12 @@ class Consensus:
             raise RuleError(f"blue score mismatch {header.blue_score} != {gd.blue_score}")
         if header.blue_work != gd.blue_work:
             raise RuleError(f"blue work mismatch {header.blue_work} != {gd.blue_work}")
+        # bounded merge depth (post_pow_validation.rs check_bounded_merge_depth);
+        # the pruning point is genesis until the pruning milestone
+        try:
+            mdr, fp = self.depth_manager.check_bounded_merge_depth(gd, self.params.genesis.hash)
+        except Exception as e:
+            raise RuleError(f"violating bounded merge depth: {e}") from e
 
         # commit (header_processor/processor.rs:361)
         self.storage.headers.insert(header)
@@ -260,6 +270,7 @@ class Consensus:
         self.storage.ghostdag.insert(block_hash, gd)
         self.reachability.add_block(block_hash, parents, gd.selected_parent)
         self.daa_excluded[block_hash] = daa_window.mergeset_non_daa
+        self.depth_manager.store(block_hash, mdr, fp)
         self.window_manager.cache_block_window(block_hash, DIFFICULTY_WINDOW, daa_window.window)
         self.storage.statuses.set(block_hash, StatusesStore.STATUS_HEADER_ONLY)
         return True

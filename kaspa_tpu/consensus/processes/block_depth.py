@@ -1,0 +1,89 @@
+"""Block depth: merge-depth roots, finality points, bounded-merge checking.
+
+Reference: consensus/src/processes/block_depth.rs (BlockDepthManager) and
+pipeline/header_processor/post_pow_validation.rs check_bounded_merge_depth:
+a block may not merge red blocks from beyond its merge-depth root unless a
+blue block in its mergeset "kosherizes" the red (has it in its past and has
+the merge-depth root on its selected chain) — the anti-deep-reorg rule.
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.consensus.reachability import ORIGIN
+
+
+class BlockDepthManager:
+    def __init__(self, merge_depth: int, finality_depth: int, genesis_hash: bytes, ghostdag_store, reachability):
+        self.merge_depth = merge_depth
+        self.finality_depth = finality_depth
+        self.genesis_hash = genesis_hash
+        self.gd = ghostdag_store
+        self.reachability = reachability
+        # per-block depth store (model/stores/depth.rs)
+        self._merge_depth_root: dict[bytes, bytes] = {}
+        self._finality_point: dict[bytes, bytes] = {}
+
+    def store(self, block: bytes, merge_depth_root: bytes, finality_point: bytes) -> None:
+        self._merge_depth_root[block] = merge_depth_root
+        self._finality_point[block] = finality_point
+
+    def merge_depth_root(self, block: bytes) -> bytes:
+        return self._merge_depth_root.get(block, ORIGIN)
+
+    def finality_point(self, block: bytes) -> bytes:
+        return self._finality_point.get(block, ORIGIN)
+
+    def calc_merge_depth_root(self, gd, pruning_point: bytes) -> bytes:
+        return self._calc_block_at_depth(gd, self.merge_depth, pruning_point, self._merge_depth_root)
+
+    def calc_finality_point(self, gd, pruning_point: bytes) -> bytes:
+        return self._calc_block_at_depth(gd, self.finality_depth, pruning_point, self._finality_point)
+
+    def _calc_block_at_depth(self, gd, depth: int, pruning_point: bytes, sp_store: dict) -> bytes:
+        if gd.selected_parent == ORIGIN:
+            return ORIGIN
+        if gd.blue_score < depth:
+            return self.genesis_hash
+        pp_bs = self.gd.get_blue_score(pruning_point)
+        if gd.blue_score < pp_bs + depth:
+            return ORIGIN
+        if not self.reachability.is_chain_ancestor_of(pruning_point, gd.selected_parent):
+            return ORIGIN
+        current = sp_store.get(gd.selected_parent, ORIGIN)
+        if current == ORIGIN:
+            current = pruning_point
+        required_blue_score = gd.blue_score - depth
+        # forward chain walk from `current` to selected parent (inclusive)
+        path = []
+        walker = gd.selected_parent
+        while walker != current:
+            path.append(walker)
+            walker = self.gd.get_selected_parent(walker)
+        for chain_block in reversed(path):
+            if self.gd.get_blue_score(chain_block) >= required_blue_score:
+                break
+            current = chain_block
+        return current
+
+    def kosherizing_blues(self, gd, merge_depth_root: bytes) -> list[bytes]:
+        return [b for b in gd.mergeset_blues if self.reachability.is_chain_ancestor_of(merge_depth_root, b)]
+
+    def check_bounded_merge_depth(self, gd, pruning_point: bytes) -> tuple[bytes, bytes]:
+        """Raises on violation; returns (merge_depth_root, finality_point)."""
+        merge_depth_root = self.calc_merge_depth_root(gd, pruning_point)
+        finality_point = self.calc_finality_point(gd, pruning_point)
+        kosherizing = None
+        for red in gd.mergeset_reds:
+            if self.reachability.is_dag_ancestor_of(merge_depth_root, red):
+                continue
+            if kosherizing is None:
+                kosherizing = self.kosherizing_blues(gd, merge_depth_root)
+            if not any(self.reachability.is_dag_ancestor_of(red, k) for k in kosherizing):
+                raise ViolatingBoundedMergeDepth(
+                    f"red block {red.hex()[:16]} beyond merge depth root with no kosherizing blue"
+                )
+        return merge_depth_root, finality_point
+
+
+class ViolatingBoundedMergeDepth(Exception):
+    pass

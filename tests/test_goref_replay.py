@@ -29,12 +29,22 @@ def test_goref_tx_dag_full_replay():
 
 
 @pytest.mark.skipif(not os.path.exists(NOTX_DAG), reason="reference testdata not mounted")
-def test_goref_notx_dag_prefix_replay():
-    """Prefix of the 5000-block header-stress DAG (full run is minutes; set
-    KASPA_TPU_GOREF_FULL=1 to replay everything)."""
-    limit = None if os.environ.get("KASPA_TPU_GOREF_FULL") else 700
-    consensus = replay_goref(NOTX_DAG, limit=limit)
-    assert consensus.get_virtual_daa_score() >= 700
+def test_goref_notx_dag_full_replay():
+    """All 5000 header-stress blocks (~13s with the native chacha path)."""
+    consensus = replay_goref(NOTX_DAG)
+    assert consensus.get_virtual_daa_score() == 5000
+
+
+PRUNING_DAG = os.path.join(DATA, "goref_custom_pruning_depth", "blocks.json.gz")
+
+
+@pytest.mark.skipif(not os.path.exists(PRUNING_DAG), reason="reference testdata not mounted")
+def test_goref_custom_pruning_depth_prefix():
+    """Prefix of the custom-pruning-depth DAG (tiny difficulty window: real
+    retargeting every block; txs appear from ~block 200).  The full 5000-block
+    file replays clean too but takes ~25 min of per-block CPU sig batches."""
+    consensus = replay_goref(PRUNING_DAG, limit=400)
+    assert consensus.get_virtual_daa_score() >= 380
 
 
 @pytest.mark.skipif(not os.path.exists(TX_DAG), reason="reference testdata not mounted")

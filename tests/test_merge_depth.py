@@ -1,0 +1,65 @@
+"""Bounded merge depth (post_pow_validation.rs check_bounded_merge_depth).
+
+A merged block is only *red* once its blue anticone exceeds k, and the
+bounded-merge rule only constrains reds: merging a fork that stayed stale
+for more than merge_depth blocks (and >k, so it is red) without a
+kosherizing blue must be rejected at the header stage.
+"""
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus, RuleError
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.txscript import standard
+
+
+def _miner_data(tag: bytes):
+    return MinerData(standard.pay_to_pub_key(bytes(31) + tag), extra_data=tag)
+
+
+def _grow(c, tip, n, md, t0=10_000):
+    for i in range(n):
+        blk = c.build_block_with_parents([tip], md, [], timestamp=t0 + i)
+        assert c.validate_and_insert_block(blk) in ("utxo_valid", "utxo_pending")
+        tip = blk.hash
+    return tip
+
+
+def test_deep_stale_fork_merge_rejected():
+    params = simnet_params(bps=2)
+    params.merge_depth = 5
+    c = Consensus(params)
+    md = _miner_data(b"\x01")
+
+    # stale fork block directly on genesis
+    stale = c.build_block_with_parents([params.genesis.hash], _miner_data(b"\x09"), [], timestamp=5_000)
+    assert c.validate_and_insert_block(stale) in ("utxo_valid", "utxo_pending")
+
+    # grow the main chain beyond both k (so the stale block becomes red when
+    # merged) and merge_depth, never merging the fork
+    tip = _grow(c, params.genesis.hash, params.ghostdag_k + 9, md)
+
+    # merging both now puts a red beyond the merge-depth root with no
+    # kosherizing blue -> bounded merge violation
+    bad = c.build_block_with_parents([tip, stale.hash], md, [], timestamp=99_000)
+    gd = c.ghostdag_manager.ghostdag([tip, stale.hash])
+    assert stale.hash in gd.mergeset_reds, "test setup: stale fork must be red"
+    with pytest.raises(RuleError, match="merge depth"):
+        c.validate_and_insert_block(bad)
+
+
+def test_recent_fork_merge_allowed():
+    params = simnet_params(bps=2)
+    params.merge_depth = 5
+    c = Consensus(params)
+    md = _miner_data(b"\x02")
+
+    tip = _grow(c, params.genesis.hash, 6, md)
+    # a shallow fork (within depth; blue anyway) merges fine
+    fork = c.build_block_with_parents(
+        [c.storage.ghostdag.get_selected_parent(tip)], _miner_data(b"\x03"), [], timestamp=50_000
+    )
+    assert c.validate_and_insert_block(fork) in ("utxo_valid", "utxo_pending")
+    merged = c.build_block_with_parents([tip, fork.hash], md, [], timestamp=60_000)
+    assert c.validate_and_insert_block(merged) in ("utxo_valid", "utxo_pending")
