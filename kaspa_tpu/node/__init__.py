@@ -1,0 +1,1 @@
+from kaspa_tpu.node.daemon import Daemon, DaemonArgs  # noqa: F401

@@ -1,0 +1,3 @@
+from kaspa_tpu.node.daemon import main
+
+main()

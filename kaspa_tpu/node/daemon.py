@@ -1,0 +1,169 @@
+"""Node assembly: the kaspad-equivalent daemon.
+
+Reference: kaspad/src/{main,daemon,args}.rs — parse args, assemble the
+service stack (consensus, mining manager, utxoindex, notification chain,
+RPC), and serve RPC on a socket.  The wire protocol here is line-delimited
+JSON-RPC over TCP (the gRPC/wRPC codec stacks bind to the same
+RpcCoreService in a later milestone); P2P connections use the in-process
+flow layer and can be bridged over sockets the same way.
+
+Run: ``python -m kaspa_tpu.node --appdir /tmp/kaspa --rpclisten 127.0.0.1:16110``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import socketserver
+import threading
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import Params, simnet_params
+from kaspa_tpu.index import UtxoIndex
+from kaspa_tpu.mempool import MiningManager
+from kaspa_tpu.p2p import Node
+from kaspa_tpu.rpc import RpcCoreService
+
+
+class DaemonArgs(argparse.Namespace):
+    pass
+
+
+def parse_args(argv=None) -> DaemonArgs:
+    """kaspad/src/args.rs equivalent (the subset meaningful this round)."""
+    p = argparse.ArgumentParser(prog="kaspa-tpu-node", description="kaspa-tpu full node")
+    p.add_argument("--appdir", default=os.path.expanduser("~/.kaspa-tpu"), help="data directory")
+    p.add_argument("--rpclisten", default="127.0.0.1:16110", help="host:port for JSON-RPC")
+    p.add_argument("--network", default="simnet", choices=["simnet"], help="network (mainnet params land with PoW sync)")
+    p.add_argument("--bps", type=int, default=2, help="simnet blocks per second")
+    p.add_argument("--utxoindex", action=argparse.BooleanOptionalAction, default=True, help="maintain the UTXO index")
+    p.add_argument("--address-prefix", default="kaspasim")
+    return p.parse_args(argv, namespace=DaemonArgs())
+
+
+class _RpcHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        daemon: Daemon = self.server.daemon  # type: ignore[attr-defined]
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            req_id = None
+            try:
+                req = json.loads(line)
+                req_id = req.get("id")
+                result = daemon.dispatch(req.get("method", ""), req.get("params", {}))
+                resp = {"id": req_id, "result": result}
+            except Exception as e:  # noqa: BLE001 - wire boundary
+                resp = {"id": req_id, "error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class Daemon:
+    """create_core_with_runtime equivalent: wire every service together."""
+
+    def __init__(self, args: DaemonArgs, params: Params | None = None):
+        self.args = args
+        os.makedirs(args.appdir, exist_ok=True)
+        self.params = params if params is not None else simnet_params(bps=args.bps)
+        self.consensus = Consensus(self.params)
+        self.node = Node(self.consensus, name="daemon")
+        self.mining = self.node.mining
+        self.utxoindex = UtxoIndex(self.consensus) if args.utxoindex else None
+        self.rpc = RpcCoreService(self.consensus, self.mining, self.utxoindex, args.address_prefix)
+        # consensus/mempool objects are single-writer: serialize RPC dispatch
+        # (the reference takes consensus sessions; an RW split can come later)
+        self._dispatch_lock = threading.Lock()
+        self._server: socketserver.ThreadingTCPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # --- rpc wire dispatch ---
+
+    _METHODS = {
+        "getServerInfo": lambda rpc, p: rpc.get_server_info().__dict__,
+        "getBlockDagInfo": lambda rpc, p: rpc.get_block_dag_info(),
+        "getBlock": lambda rpc, p: rpc.get_block(bytes.fromhex(p["hash"]), p.get("includeTransactions", True)),
+        "getSinkBlueScore": lambda rpc, p: rpc.get_sink_blue_score(),
+        "getVirtualChainFromBlock": lambda rpc, p: rpc.get_virtual_chain_from_block(bytes.fromhex(p["startHash"])),
+        "getMempoolEntries": lambda rpc, p: rpc.get_mempool_entries(),
+        "getUtxosByAddresses": lambda rpc, p: rpc.get_utxos_by_addresses(p["addresses"]),
+        "getBalanceByAddress": lambda rpc, p: rpc.get_balance_by_address(p["address"]),
+        "getCoinSupply": lambda rpc, p: rpc.get_coin_supply(),
+        "getMetrics": lambda rpc, p: rpc.get_metrics(),
+    }
+
+    def dispatch(self, method: str, params: dict):
+        with self._dispatch_lock:
+            return self._dispatch(method, params)
+
+    def _dispatch(self, method: str, params: dict):
+        if method == "getBlockTemplate":
+            block = self.rpc.get_block_template(params["payAddress"], bytes.fromhex(params.get("extraData", "")))
+            return {"block_hash": block.hash.hex(), "transactions": len(block.transactions)}
+        if method == "submitBlockByTemplateHash":
+            # in-process miner convenience: submit the cached template
+            cached = self.mining.template_cache.get()
+            if cached is None or cached.hash.hex() != params["hash"]:
+                raise ValueError("template not cached")
+            status = self.node.submit_block(cached)  # insert + unorphan + relay
+            return {"status": status}
+        fn = self._METHODS.get(method)
+        if fn is None:
+            raise ValueError(f"unknown method {method}")
+        return fn(self.rpc, params)
+
+    # --- lifecycle (core/src/core.rs run/shutdown shape) ---
+
+    def start(self) -> str:
+        host, port = self.args.rpclisten.rsplit(":", 1)
+        srv = socketserver.ThreadingTCPServer((host, int(port)), _RpcHandler, bind_and_activate=False)
+        srv.allow_reuse_address = True
+        srv.daemon_threads = True
+        srv.server_bind()
+        srv.server_activate()
+        srv.daemon = self  # type: ignore[attr-defined]
+        self._server = srv
+        self._thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        self._thread.start()
+        return f"{host}:{srv.server_address[1]}"
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def rpc_call(addr: str, method: str, params: dict | None = None, timeout: float = 30.0):
+    """Minimal line-JSON-RPC client (rpc/grpc/client equivalent)."""
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall((json.dumps({"id": 1, "method": method, "params": params or {}}) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError(f"connection closed mid-response ({len(buf)} bytes buffered)")
+            buf += chunk
+    resp = json.loads(buf)
+    if "error" in resp:
+        raise RuntimeError(resp["error"])
+    return resp["result"]
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    daemon = Daemon(args)
+    addr = daemon.start()
+    print(f"kaspa-tpu node listening on {addr} (network {daemon.params.name})")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        daemon.stop()
+
+
+if __name__ == "__main__":
+    main()

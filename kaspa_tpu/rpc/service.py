@@ -44,7 +44,8 @@ class RpcCoreService:
     def __init__(self, consensus: Consensus, mining: MiningManager, utxoindex: UtxoIndex | None = None, address_prefix: str = "kaspasim"):
         self.consensus = consensus
         self.mining = mining
-        self.utxoindex = utxoindex if utxoindex is not None else UtxoIndex(consensus)
+        # None => run without an index: address-based queries unavailable
+        self.utxoindex = utxoindex
         self.address_prefix = address_prefix
         # rpc-level notifier chained onto the consensus root (the reference's
         # consensus -> notify -> index -> rpc chain)
@@ -178,7 +179,13 @@ class RpcCoreService:
 
     # --- utxos / balances (utxoindex-backed, rpc.rs get_utxos_by_addresses) ---
 
+    def _require_index(self):
+        if self.utxoindex is None:
+            raise RpcError("method unavailable without --utxoindex")
+        return self.utxoindex
+
     def get_utxos_by_addresses(self, addresses: list[str]) -> list[dict]:
+        self._require_index()
         out = []
         for s in addresses:
             addr = Address.from_string(s)
@@ -199,10 +206,10 @@ class RpcCoreService:
 
     def get_balance_by_address(self, address: str) -> int:
         spk = pay_to_address_script(Address.from_string(address))
-        return self.utxoindex.get_balance_by_script(spk.script)
+        return self._require_index().get_balance_by_script(spk.script)
 
     def get_coin_supply(self) -> dict:
-        return {"circulating_sompi": self.utxoindex.get_circulating_supply()}
+        return {"circulating_sompi": self._require_index().get_circulating_supply()}
 
     # --- subscriptions (notify_* RPCs) ---
 

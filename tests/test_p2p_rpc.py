@@ -108,7 +108,9 @@ def test_fresh_node_ibd(network):
 def test_rpc_service_surface(network):
     a, b, c, miner, rng = network
     _mine(a, miner, 10)
-    rpc = RpcCoreService(a.consensus, a.mining, address_prefix="kaspasim")
+    from kaspa_tpu.index import UtxoIndex
+
+    rpc = RpcCoreService(a.consensus, a.mining, UtxoIndex(a.consensus), address_prefix="kaspasim")
 
     info = rpc.get_server_info()
     assert info.virtual_daa_score == a.consensus.get_virtual_daa_score()
