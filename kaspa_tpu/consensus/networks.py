@@ -1,0 +1,125 @@
+"""Real network presets: mainnet / testnet / simnet / devnet.
+
+Genesis constants mirrored from consensus/core/src/config/genesis.rs
+(network data, not code); parameter presets follow config/params.rs with
+the Bps<10> generator for post-Crescendo mainnet.  test_networks.py proves
+our header/merkle hashing reproduces each network's real genesis hash from
+these raw constants.
+"""
+
+from kaspa_tpu.consensus.model import SUBNETWORK_ID_COINBASE, Header, Transaction
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.consensus.params import GenesisBlock, Params
+
+GENESIS_DATA = {
+ "mainnet": {
+  "hash": "58c2d4199e21f910d1571d114969cecef48f09f934d42ccb6a281a15868f2999",
+  "version": 0,
+  "hash_merkle_root": "8ec898568c6801d13df4ee6e2a1b54b7e6236f671f20954f05306410518eeb32",
+  "utxo_commitment": "710f27df423e63aa6cdb72b89ea5a06cffa399d66f167704455b5af59def8e20",
+  "timestamp": 1637609671037,
+  "bits": 486722099,
+  "nonce": 211244,
+  "daa_score": 1312860,
+  "payload": "000000000000000000e1f5050000000000000100d795d79ed79420d793d79920d7a2d79cd799d79a20d795d7a2d79c20d790d797d799d79a20d799d799d798d79120d791d7a9d790d7a820d79bd7a1d7a4d79020d795d793d794d791d79420d79cd79ed7a2d791d79320d79bd7a8d7a2d795d7aa20d790d79cd794d79bd79d20d7aad7a2d791d793d795d79f0000000000000000000b1f8e1c17b0133d439174e52efbb0c41c3583a8aa66b00fca37ca667c2d550a6c4416dad9717e50927128c424fa4edbebc436ab13aeef"
+ },
+ "testnet": {
+  "hash": "f896a3034873be1739fc4359236899fd3d65d2bc94f9780df0d0da3eb1cc4370",
+  "version": 0,
+  "hash_merkle_root": "17341408a5724556504df4d6cf515cbfbb220430dc451c743c22d5e911720c2a",
+  "utxo_commitment": "544eb3142c000f0ad2c76ac41f4222abbababed830eeafee4b6dc56b52d5cac0",
+  "timestamp": 1633687894966,
+  "bits": 511705087,
+  "nonce": 83330,
+  "daa_score": 0,
+  "payload": "000000000000000000e1f50500000000000001006b617370612d746573746e6574"
+ },
+ "simnet": {
+  "hash": "411f8cd26f3d41aea39e78573927da24d23995705b579f30959b9127e96b79e3",
+  "version": 0,
+  "hash_merkle_root": "1946d629f7e922a7bced59190521c3771f73d352ddbbb686564ad7fd56857c1b",
+  "utxo_commitment": "544eb3142c000f0ad2c76ac41f4222abbababed830eeafee4b6dc56b52d5cac0",
+  "timestamp": 1633687894966,
+  "bits": 545259519,
+  "nonce": 2,
+  "daa_score": 0,
+  "payload": "000000000000000000e1f50500000000000001006b617370612d73696d6e6574"
+ },
+ "devnet": {
+  "hash": "4cb48d0b2073b802360145a15ad1abdc01d89b5c2fe4722630ab9b5fe9dfc4f2",
+  "version": 0,
+  "hash_merkle_root": "58abf20321d70716162b6bf8d9f589ca33ae6e32b3b19abb7fa65d1141a3f94d",
+  "utxo_commitment": "544eb3142c000f0ad2c76ac41f4222abbababed830eeafee4b6dc56b52d5cac0",
+  "timestamp": 1231006505000,
+  "bits": 505527324,
+  "nonce": 298590,
+  "daa_score": 0,
+  "payload": "000000000000000000e1f50500000000000001006b617370612d6465766e6574"
+ }
+}
+
+
+def _genesis_block(net: str) -> Block:
+    g = GENESIS_DATA[net]
+    header = Header(
+        version=g["version"],
+        parents_by_level=[],
+        hash_merkle_root=bytes.fromhex(g["hash_merkle_root"]),
+        accepted_id_merkle_root=b"\x00" * 32,
+        utxo_commitment=bytes.fromhex(g["utxo_commitment"]),
+        timestamp=g["timestamp"],
+        bits=g["bits"],
+        nonce=g["nonce"],
+        daa_score=g["daa_score"],
+        blue_work=0,
+        blue_score=0,
+        pruning_point=b"\x00" * 32,
+    )
+    coinbase = Transaction(0, [], [], 0, SUBNETWORK_ID_COINBASE, 0, bytes.fromhex(g["payload"]))
+    return Block(header, [coinbase])
+
+
+_DEFLATIONARY_PHASE_DAA_SCORE = 15778800 - 259200  # params.rs: ~6 months minus pre-mainnet period
+
+
+def _network_params(net: str, bps: int, prefix_name: str, **overrides) -> Params:
+    block = _genesis_block(net)
+    g = GENESIS_DATA[net]
+    params = Params.from_bps(
+        prefix_name,
+        bps,
+        GenesisBlock(
+            hash=bytes.fromhex(g["hash"]),
+            bits=g["bits"],
+            timestamp=g["timestamp"],
+            version=g["version"],
+            daa_score=g["daa_score"],
+        ),
+        genesis_override=block,
+        **overrides,
+    )
+    return params
+
+
+def mainnet_params() -> Params:
+    """Post-Crescendo mainnet (10 BPS, Bps<10> generated constants)."""
+    return _network_params(
+        "mainnet", 10, "kaspa-mainnet",
+        deflationary_phase_daa_score=_DEFLATIONARY_PHASE_DAA_SCORE,
+        pre_deflationary_phase_base_subsidy=50_000_000_000,
+    )
+
+
+def testnet_params() -> Params:
+    return _network_params(
+        "testnet", 10, "kaspa-testnet",
+        deflationary_phase_daa_score=_DEFLATIONARY_PHASE_DAA_SCORE,
+    )
+
+
+def simnet_network_params() -> Params:
+    return _network_params("simnet", 10, "kaspa-simnet", skip_proof_of_work=True)
+
+
+def devnet_params() -> Params:
+    return _network_params("devnet", 10, "kaspa-devnet")
