@@ -1,0 +1,51 @@
+"""simpa-equivalent CLI (reference: simpa/src/main.rs).
+
+Builds a virtual-time multi-miner DAG with signed transactions, then
+replays it into a fresh consensus and reports validation throughput:
+
+    python -m kaspa_tpu.sim --bps 2 --blocks 100 --miners 4 --tpb 4
+"""
+
+import argparse
+import json
+
+from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="kaspa-tpu-sim", description="DAG simulation + validation replay benchmark")
+    p.add_argument("--bps", type=int, default=2, help="target blocks per second")
+    p.add_argument("--delay", type=float, default=2.0, help="simulated propagation delay (seconds)")
+    p.add_argument("--miners", type=int, default=4, help="number of miners")
+    p.add_argument("--blocks", type=int, default=64, help="blocks to produce")
+    p.add_argument("--tpb", type=int, default=8, help="transactions per block")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--json", action="store_true", help="emit one JSON line")
+    args = p.parse_args()
+
+    cfg = SimConfig(
+        bps=args.bps, delay=args.delay, num_miners=args.miners,
+        num_blocks=args.blocks, txs_per_block=args.tpb, seed=args.seed,
+    )
+    res = simulate(cfg)
+    elapsed, _fresh = replay(res)
+    out = {
+        "blocks": len(res.blocks),
+        "txs": res.total_txs,
+        "build_seconds": round(res.build_seconds, 2),
+        "replay_seconds": round(elapsed, 2),
+        "replay_blocks_per_sec": round(len(res.blocks) / elapsed, 2),
+        "bps_target": args.bps,
+        "realtime_factor": round(len(res.blocks) / args.bps / elapsed, 2),
+    }
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"built {out['blocks']} blocks / {out['txs']} txs in {out['build_seconds']}s")
+        print(
+            f"replayed in {out['replay_seconds']}s = {out['replay_blocks_per_sec']} blocks/s "
+            f"({out['realtime_factor']}x the {args.bps}-BPS real-time rate)"
+        )
+
+
+main()
