@@ -1,0 +1,1 @@
+from kaspa_tpu.metrics.perf_monitor import PerfMonitor, ProcessMetrics  # noqa: F401

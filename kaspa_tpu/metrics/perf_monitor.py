@@ -1,0 +1,71 @@
+"""Process performance monitor: CPU / memory / IO sampling.
+
+Reference: metrics/perf_monitor/src/ — a service sampling process counters
+on a tick for operator dashboards, surfaced through get_metrics.  Reads
+/proc directly (no psutil dependency in the image).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class ProcessMetrics:
+    resident_set_size: int  # bytes
+    virtual_memory_size: int  # bytes
+    core_num: int
+    cpu_usage: float  # fraction of one core since the previous sample
+    fd_num: int
+    disk_io_read_bytes: int
+    disk_io_write_bytes: int
+
+
+def _read_proc_stat():
+    with open("/proc/self/stat") as f:
+        raw = f.read()
+    # comm may contain spaces/parens: index from the last ')'
+    parts = raw[raw.rindex(")") + 2 :].split()
+    # parts[0] == state (field 3); utime/stime are fields 14/15
+    utime, stime = int(parts[11]), int(parts[12])
+    vsize, rss_pages = int(parts[20]), int(parts[21])
+    return utime + stime, vsize, rss_pages * os.sysconf("SC_PAGE_SIZE")
+
+
+def _read_proc_io():
+    try:
+        with open("/proc/self/io") as f:
+            d = dict(line.strip().split(": ") for line in f if ": " in line)
+        return int(d.get("read_bytes", 0)), int(d.get("write_bytes", 0))
+    except OSError:
+        return 0, 0
+
+
+class PerfMonitor:
+    def __init__(self):
+        self._hz = os.sysconf("SC_CLK_TCK")
+        self._last_cpu_ticks, _, _ = _read_proc_stat()
+        self._last_time = time.monotonic()
+
+    def sample(self) -> ProcessMetrics:
+        now = time.monotonic()
+        cpu_ticks, vsize, rss = _read_proc_stat()
+        elapsed = max(now - self._last_time, 1e-9)
+        cpu_usage = (cpu_ticks - self._last_cpu_ticks) / self._hz / elapsed
+        self._last_cpu_ticks, self._last_time = cpu_ticks, now
+        reads, writes = _read_proc_io()
+        try:
+            fd_num = len(os.listdir("/proc/self/fd"))
+        except OSError:
+            fd_num = 0
+        return ProcessMetrics(
+            resident_set_size=rss,
+            virtual_memory_size=vsize,
+            core_num=os.cpu_count() or 0,
+            cpu_usage=cpu_usage,
+            fd_num=fd_num,
+            disk_io_read_bytes=reads,
+            disk_io_write_bytes=writes,
+        )

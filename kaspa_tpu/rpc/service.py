@@ -23,6 +23,7 @@ from kaspa_tpu.crypto.addresses import Address, extract_script_pub_key_address, 
 from kaspa_tpu.index import UtxoIndex
 from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.metrics import PerfMonitor
 from kaspa_tpu.notify.notifier import Notifier
 
 
@@ -50,6 +51,7 @@ class RpcCoreService:
         # rpc-level notifier chained onto the consensus root (the reference's
         # consensus -> notify -> index -> rpc chain)
         self.notifier = Notifier("rpc-core", parent=consensus.notification_root)
+        self.perf_monitor = PerfMonitor()
         self.start_time = time.time()
 
     # --- node / dag info ---
@@ -240,6 +242,7 @@ class RpcCoreService:
             "sig_cache_hits": sc.hits,
             "sig_cache_misses": sc.misses,
             "process_counters": asdict(self.consensus.counters.snapshot()),
+            "process_metrics": asdict(self.perf_monitor.sample()),
         }
 
     # --- helpers ---

@@ -1,0 +1,91 @@
+"""ScriptBuilder: canonical (minimal-push) script construction.
+
+Reference: crypto/txscript/src/script_builder.rs — emits the minimal
+encoding for every push (OP_0/OP_1..16/OP_1NEGATE/direct/pushdata) so
+built scripts always satisfy the engine's minimal-push rule, with the
+same size guards.
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.txscript.vm import MAX_SCRIPT_ELEMENT_SIZE, MAX_SCRIPTS_SIZE, serialize_i64
+
+OP_0 = 0x00
+OP_1NEGATE = 0x4F
+OP_1 = 0x51
+OP_PUSHDATA1, OP_PUSHDATA2, OP_PUSHDATA4 = 0x4C, 0x4D, 0x4E
+
+
+class ScriptBuilderError(Exception):
+    pass
+
+
+class ScriptBuilder:
+    def __init__(self):
+        self._script = bytearray()
+
+    def add_op(self, opcode: int) -> "ScriptBuilder":
+        if len(self._script) + 1 > MAX_SCRIPTS_SIZE:
+            raise ScriptBuilderError("script exceeds maximum size")
+        self._script.append(opcode)
+        return self
+
+    def add_ops(self, opcodes) -> "ScriptBuilder":
+        for op in opcodes:
+            self.add_op(op)
+        return self
+
+    def add_data(self, data: bytes) -> "ScriptBuilder":
+        """Minimal push of arbitrary data (script_builder.rs add_data).
+
+        Validates sizes *before* mutating: on error the builder is unchanged
+        (the reference's validate_data_push contract)."""
+        n = len(data)
+        if n > MAX_SCRIPT_ELEMENT_SIZE:
+            raise ScriptBuilderError(f"element size {n} above limit")
+        if n == 0:
+            return self.add_op(OP_0)
+        if n == 1 and 1 <= data[0] <= 16:
+            return self.add_op(OP_1 + data[0] - 1)
+        if n == 1 and data[0] == 0x81:
+            return self.add_op(OP_1NEGATE)
+        if n <= 75:
+            prefix = bytes([n])
+        elif n <= 255:
+            prefix = bytes([OP_PUSHDATA1, n])
+        elif n <= 65535:
+            prefix = bytes([OP_PUSHDATA2]) + n.to_bytes(2, "little")
+        else:
+            prefix = bytes([OP_PUSHDATA4]) + n.to_bytes(4, "little")
+        if len(self._script) + len(prefix) + n > MAX_SCRIPTS_SIZE:
+            raise ScriptBuilderError("script exceeds maximum size")
+        self._script += prefix + data
+        return self
+
+    def add_i64(self, value: int) -> "ScriptBuilder":
+        """Minimal numeric push (script_builder.rs add_i64)."""
+        if value == 0:
+            return self.add_op(OP_0)
+        if 1 <= value <= 16:
+            return self.add_op(OP_1 + value - 1)
+        if value == -1:
+            return self.add_op(OP_1NEGATE)
+        return self.add_data(serialize_i64(value))
+
+    def add_lock_time(self, lock_time: int) -> "ScriptBuilder":
+        return self._add_u64_fixed(lock_time)
+
+    def add_sequence(self, sequence: int) -> "ScriptBuilder":
+        return self._add_u64_fixed(sequence)
+
+    def _add_u64_fixed(self, v: int) -> "ScriptBuilder":
+        """8-byte LE push (CLTV/CSV operands; minimal rules don't apply)."""
+        return self.add_data(v.to_bytes(8, "little"))
+
+    def drain(self) -> bytes:
+        out = bytes(self._script)
+        self._script.clear()
+        return out
+
+    def script(self) -> bytes:
+        return bytes(self._script)
