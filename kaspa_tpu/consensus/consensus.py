@@ -35,6 +35,7 @@ from kaspa_tpu.consensus.params import Params
 from kaspa_tpu.consensus.processes.coinbase import BlockRewardData, CoinbaseData, CoinbaseManager, MinerData
 from kaspa_tpu.consensus.processes.block_depth import BlockDepthManager
 from kaspa_tpu.consensus.processes.ghostdag import GhostdagManager
+from kaspa_tpu.consensus.processes.pruning import PruningPointManager
 from kaspa_tpu.consensus.processes.transaction_validator import (
     FLAG_FULL,
     FLAG_SKIP_SCRIPTS,
@@ -108,6 +109,9 @@ class Consensus:
         self.transaction_validator = TransactionValidator(params)
         self.depth_manager = BlockDepthManager(
             params.merge_depth, params.finality_depth, params.genesis.hash, self.storage.ghostdag, self.reachability
+        )
+        self.pruning_point_manager = PruningPointManager(
+            params.pruning_depth, params.finality_depth, params.genesis.hash, self.storage.headers
         )
         from kaspa_tpu.notify.notifier import ConsensusNotificationRoot
 
@@ -454,11 +458,16 @@ class Consensus:
         )
         if expected_root != header.accepted_id_merkle_root:
             return False
-        # 3. coinbase
+        # 3. header pruning point (verify_header_pruning_point: chain rule)
+        reply = self.pruning_point_manager.expected_header_pruning_point(gd)
+        if reply.pruning_point != header.pruning_point:
+            return False
+        self.pruning_point_manager.store_pruning_sample(block, reply.pruning_sample)
+        # 4. coinbase
         txs = self.storage.block_transactions.get(block)
         if not self._verify_coinbase_transaction(txs[0], header.daa_score, gd, ctx["mergeset_rewards"], self.daa_excluded[block]):
             return False
-        # 4. own txs valid in own utxo view
+        # 5. own txs valid in own utxo view
         own_view = UtxoView(self.utxo_set, ctx["mergeset_diff"])
         validated = self._validate_transactions(
             txs, own_view, header.daa_score, FLAG_FULL
@@ -639,7 +648,7 @@ class Consensus:
             daa_score=daa_window.daa_score,
             blue_work=gd.blue_work,
             blue_score=gd.blue_score,
-            pruning_point=self.params.genesis.hash,
+            pruning_point=self.pruning_point_manager.expected_header_pruning_point(gd).pruning_point,
         )
         if header.timestamp <= pmt:
             header.timestamp = pmt + 1

@@ -1,0 +1,95 @@
+"""Pruning-point management: samples, expected header pruning points.
+
+Reference: consensus/src/processes/pruning.rs (PruningPointManager).  Chain
+blocks sample the selected chain every finality-score epoch; a block's
+expected pruning point is the most recent sample at pruning depth, clamped
+by the sample-step bound and the selected parent's pruning point for
+monotonicity.  Verified per chain block (verify_header_pruning_point in
+the virtual processor's chain-qualification path).
+
+The history-pruning executor (deleting pruned data, pruning-point UTXO set
+maintenance) builds on this in the pruning-processor milestone.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class PruningPointReply:
+    pruning_sample: bytes
+    pruning_point: bytes
+
+
+class PruningPointManager:
+    def __init__(self, pruning_depth: int, finality_depth: int, genesis_hash: bytes, headers_store):
+        self.pruning_depth = pruning_depth
+        self.finality_depth = finality_depth
+        self.genesis_hash = genesis_hash
+        self.headers = headers_store
+        self.pruning_samples_steps = -(-pruning_depth // finality_depth)
+        # pruning_sample_from_pov store (model/stores/pruning_samples.rs)
+        self._sample_from_pov: dict[bytes, bytes] = {}
+
+    def store_pruning_sample(self, block: bytes, sample: bytes) -> None:
+        self._sample_from_pov[block] = sample
+
+    def pruning_sample_from_pov(self, block: bytes) -> bytes:
+        return self._sample_from_pov[block]
+
+    def finality_score(self, blue_score: int) -> int:
+        return blue_score // self.finality_depth
+
+    def is_pruning_sample(self, self_blue_score: int, epoch_chain_ancestor_blue_score: int) -> bool:
+        """pruning.rs:165-172: own finality score exceeds the epoch ancestor's."""
+        return self.finality_score(epoch_chain_ancestor_blue_score) < self.finality_score(self_blue_score)
+
+    def expected_header_pruning_point(self, gd) -> PruningPointReply:
+        """pruning.rs:105-158 — gd needs selected_parent and blue_score."""
+        sp = gd.selected_parent
+        sp_blue_score = self.headers.get_blue_score(sp)
+
+        if sp == self.genesis_hash:
+            pruning_sample = self.genesis_hash
+        else:
+            sp_sample = self._sample_from_pov[sp]
+            sp_sample_blue_score = self.headers.get_blue_score(sp_sample)
+            if self.is_pruning_sample(sp_blue_score, sp_sample_blue_score):
+                pruning_sample = sp  # the selected parent is the most recent sample
+            else:
+                pruning_sample = sp_sample
+
+        is_self_sample = self.is_pruning_sample(gd.blue_score, sp_blue_score)
+        sp_pruning_point = self.headers.get(sp).pruning_point
+        steps = 1
+        current = pruning_sample
+        while True:
+            if current == self.genesis_hash:
+                break
+            if self.headers.get_blue_score(current) + self.pruning_depth <= gd.blue_score:
+                break  # most recent sample at pruning depth
+            if is_self_sample and steps == self.pruning_samples_steps:
+                break  # post-hardfork step clamp for samples
+            if current == sp_pruning_point:
+                break  # monotonicity clamp for non-samples
+            current = self._sample_from_pov[current]
+            steps += 1
+
+        return PruningPointReply(pruning_sample, current)
+
+    def next_pruning_points(self, sink_gd, current_pruning_point: bytes) -> list[bytes]:
+        """pruning.rs:174-203: samples between the current and expected PP."""
+        cur_bs = self.headers.get_blue_score(current_pruning_point)
+        if cur_bs + self.pruning_depth > sink_gd.blue_score:
+            return []
+        sink_pp = self.expected_header_pruning_point(sink_gd).pruning_point
+        if self.headers.get_blue_score(sink_pp) <= cur_bs:
+            return []
+        out = []
+        current = sink_pp
+        while current != current_pruning_point:
+            out.append(current)
+            current = self._sample_from_pov[current]
+        out.reverse()
+        return out
