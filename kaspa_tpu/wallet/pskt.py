@@ -1,0 +1,234 @@
+"""PSKT: partially-signed kaspa transactions (multisig signing flows).
+
+Reference: wallet/pskt (the kaspa-wallet-pskt crate) — a transaction
+passes through roles: Creator -> Constructor (add inputs/outputs) ->
+Updater (attach UTXO entries + redeem scripts) -> Signer (each party adds
+partial signatures) -> Combiner (merge partial sigs) -> Finalizer (build
+the final signature scripts) -> Extractor (a consensus-ready Transaction).
+
+This round covers the multisig-schnorr P2SH flow over OpCheckMultiSig
+(ordered-key matching, as the engine enforces).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import (
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE, ComputeCommit
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.txscript.script_builder import ScriptBuilder
+
+OP_CHECKMULTISIG = 0xAE
+
+
+class PsktError(Exception):
+    pass
+
+
+def multisig_redeem_script(m: int, pubkeys: list[bytes]) -> bytes:
+    """<m> <pk1>..<pkn> <n> OP_CHECKMULTISIG (standard/multisig.rs)."""
+    assert 1 <= m <= len(pubkeys) <= 20
+    b = ScriptBuilder().add_i64(m)
+    for pk in pubkeys:
+        b.add_data(pk)
+    return b.add_i64(len(pubkeys)).add_op(OP_CHECKMULTISIG).script()
+
+
+def parse_multisig_redeem_script(redeem: bytes) -> tuple[int, list[bytes]]:
+    """Inverse of multisig_redeem_script: (m, pubkeys in script order)."""
+    from kaspa_tpu.txscript.vm import parse_script
+
+    ops = list(parse_script(redeem))
+    if len(ops) < 4 or ops[-1][0] != OP_CHECKMULTISIG:
+        raise PsktError("not a multisig redeem script")
+    def _small_int(op, data):
+        if 0x51 <= op <= 0x60:
+            return op - 0x50
+        if data is not None and len(data) == 1:
+            return data[0]
+        raise PsktError("not a multisig redeem script")
+    m = _small_int(*ops[0])
+    n = _small_int(*ops[-2])
+    keys = [data for op, data in ops[1:-2] if data is not None]
+    if len(keys) != n or not 1 <= m <= n:
+        raise PsktError("malformed multisig redeem script")
+    return m, keys
+
+
+@dataclass
+class PsktInput:
+    outpoint: TransactionOutpoint
+    utxo_entry: UtxoEntry
+    redeem_script: bytes
+    sig_op_count: int
+    sequence: int = 0
+    partial_sigs: dict[bytes, bytes] = field(default_factory=dict)  # pubkey -> sig65
+
+
+@dataclass
+class Pskt:
+    """Role-based partially-signed transaction (wallet/pskt/src/pskt.rs)."""
+
+    version: int = 0
+    inputs: list[PsktInput] = field(default_factory=list)
+    outputs: list[TransactionOutput] = field(default_factory=list)
+    lock_time: int = 0
+
+    # --- constructor / updater roles ---
+
+    def add_input(self, outpoint, utxo_entry, redeem_script: bytes, sig_op_count: int) -> "Pskt":
+        self.inputs.append(PsktInput(outpoint, utxo_entry, redeem_script, sig_op_count))
+        return self
+
+    def add_output(self, output: TransactionOutput) -> "Pskt":
+        self.outputs.append(output)
+        return self
+
+    # --- common ---
+
+    def unsigned_tx(self, mass_calculator=None) -> Transaction:
+        tx = Transaction(
+            self.version,
+            [TransactionInput(i.outpoint, b"", i.sequence, ComputeCommit.sigops(i.sig_op_count)) for i in self.inputs],
+            list(self.outputs),
+            self.lock_time,
+            SUBNETWORK_ID_NATIVE,
+            0,
+            b"",
+        )
+        if mass_calculator is None:
+            from kaspa_tpu.consensus.mass import MassCalculator
+
+            mass_calculator = MassCalculator()
+        mass = mass_calculator.calc_contextual_masses(tx, [i.utxo_entry for i in self.inputs])
+        if mass is None:
+            raise PsktError("storage mass incomputable for this input/output set")
+        tx.storage_mass = mass
+        return tx
+
+    # --- signer role ---
+
+    def sign(self, seckey: int, aux: bytes = b"\x00" * 32, mass_calculator=None) -> "Pskt":
+        """Adds a partial signature on every input whose redeem script
+        includes this key (exact push-parsed membership)."""
+        pub = eclib.schnorr_pubkey(seckey)
+        tx = self.unsigned_tx(mass_calculator)
+        entries = [i.utxo_entry for i in self.inputs]
+        reused = chash.SigHashReusedValues()
+        for idx, inp in enumerate(self.inputs):
+            _m, keys = parse_multisig_redeem_script(inp.redeem_script)
+            if pub not in keys:
+                continue
+            msg = chash.calc_schnorr_signature_hash(tx, entries, idx, chash.SIG_HASH_ALL, reused)
+            sig = eclib.schnorr_sign(msg, seckey, aux) + bytes([chash.SIG_HASH_ALL])
+            inp.partial_sigs[pub] = sig
+        return self
+
+    # --- combiner role ---
+
+    def combine(self, other: "Pskt") -> "Pskt":
+        """Merges partial sigs; every sighash-relevant field must match, or
+        the merged sigs would cover different messages."""
+        if (
+            len(other.inputs) != len(self.inputs)
+            or other.version != self.version
+            or other.lock_time != self.lock_time
+            or [(o.value, o.script_public_key) for o in other.outputs]
+            != [(o.value, o.script_public_key) for o in self.outputs]
+        ):
+            raise PsktError("combining incompatible PSKTs")
+        for mine, theirs in zip(self.inputs, other.inputs):
+            if (
+                mine.outpoint != theirs.outpoint
+                or mine.sequence != theirs.sequence
+                or mine.redeem_script != theirs.redeem_script
+                or mine.utxo_entry != theirs.utxo_entry
+            ):
+                raise PsktError("combining PSKTs with different inputs")
+            mine.partial_sigs.update(theirs.partial_sigs)
+        return self
+
+    # --- finalizer / extractor roles ---
+
+    def extract_tx(self, mass_calculator=None) -> Transaction:
+        """Builds signature scripts (sigs in per-input redeem-script key
+        order) and returns the consensus-ready transaction."""
+        tx = self.unsigned_tx(mass_calculator)
+        for idx, inp in enumerate(self.inputs):
+            m, keys = parse_multisig_redeem_script(inp.redeem_script)
+            ordered = [inp.partial_sigs[pk] for pk in keys if pk in inp.partial_sigs]
+            if len(ordered) < m:
+                raise PsktError(f"input {idx} has {len(ordered)} of {m} required signatures")
+            b = ScriptBuilder()
+            for sig in ordered[:m]:
+                b.add_data(sig)
+            b.add_data(inp.redeem_script)
+            tx.inputs[idx].signature_script = b.script()
+        return tx
+
+    # --- serialization (wallet/pskt serde role-passing) ---
+
+    def to_json(self) -> str:
+        def spk(s):
+            return {"version": s.version, "script": s.script.hex()}
+
+        return json.dumps(
+            {
+                "version": self.version,
+                "lock_time": self.lock_time,
+                "inputs": [
+                    {
+                        "outpoint": {"txid": i.outpoint.transaction_id.hex(), "index": i.outpoint.index},
+                        "utxo": {
+                            "amount": i.utxo_entry.amount,
+                            "spk": spk(i.utxo_entry.script_public_key),
+                            "daa": i.utxo_entry.block_daa_score,
+                            "coinbase": i.utxo_entry.is_coinbase,
+                        },
+                        "redeem": i.redeem_script.hex(),
+                        "sig_ops": i.sig_op_count,
+                        "sequence": i.sequence,
+                        "sigs": {k.hex(): v.hex() for k, v in i.partial_sigs.items()},
+                    }
+                    for i in self.inputs
+                ],
+                "outputs": [{"value": o.value, "spk": spk(o.script_public_key)} for o in self.outputs],
+            }
+        )
+
+    @staticmethod
+    def from_json(data: str) -> "Pskt":
+        from kaspa_tpu.consensus.model import ScriptPublicKey
+
+        d = json.loads(data)
+        pskt = Pskt(version=d["version"], lock_time=d["lock_time"])
+        for i in d["inputs"]:
+            entry = UtxoEntry(
+                i["utxo"]["amount"],
+                ScriptPublicKey(i["utxo"]["spk"]["version"], bytes.fromhex(i["utxo"]["spk"]["script"])),
+                i["utxo"]["daa"],
+                i["utxo"]["coinbase"],
+            )
+            pin = PsktInput(
+                TransactionOutpoint(bytes.fromhex(i["outpoint"]["txid"]), i["outpoint"]["index"]),
+                entry,
+                bytes.fromhex(i["redeem"]),
+                i["sig_ops"],
+                i["sequence"],
+                {bytes.fromhex(k): bytes.fromhex(v) for k, v in i["sigs"].items()},
+            )
+            pskt.inputs.append(pin)
+        for o in d["outputs"]:
+            pskt.outputs.append(
+                TransactionOutput(o["value"], ScriptPublicKey(o["spk"]["version"], bytes.fromhex(o["spk"]["script"])))
+            )
+        return pskt

@@ -1,0 +1,92 @@
+"""PSKT multisig flow: create -> sign (two parties) -> combine -> extract,
+with the extracted tx mined into a valid block (2-of-3 P2SH multisig)."""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model import TransactionOutpoint, TransactionOutput
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.mempool import MiningManager
+from kaspa_tpu.sim.simulator import Miner
+from kaspa_tpu.txscript import standard
+from kaspa_tpu.wallet.pskt import Pskt, PsktError, multisig_redeem_script
+
+
+def test_pskt_2of3_multisig_roundtrip():
+    rng = random.Random(77)
+    params = simnet_params(bps=2)
+    c = Consensus(params)
+    mgr = MiningManager(c)
+    miner = Miner(0, rng)
+
+    # fund a 2-of-3 multisig P2SH address
+    keys = [rng.randrange(1, eclib.N) for _ in range(3)]
+    pubs = [eclib.schnorr_pubkey(k) for k in keys]
+    redeem = multisig_redeem_script(2, pubs)
+    p2sh = standard.pay_to_script_hash_script(redeem)
+
+    for _ in range(12):
+        blk = mgr.get_block_template(miner.miner_data)
+        c.validate_and_insert_block(blk)
+        mgr.handle_new_block_transactions(blk.transactions, c.get_virtual_daa_score())
+        mgr.template_cache.clear()
+
+    # miner sends funds into the multisig
+    from kaspa_tpu.consensus import hashing as chash
+    from kaspa_tpu.consensus.model import Transaction, TransactionInput
+    from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE, ComputeCommit
+
+    view = c.get_virtual_utxo_view()
+    pov = c.get_virtual_daa_score()
+    op, e = next(
+        (op, e) for op, e in c.utxo_set.items()
+        if view.get(op) is not None and e.script_public_key == miner.spk
+        and not (e.is_coinbase and e.block_daa_score + params.coinbase_maturity > pov)
+    )
+    fund = Transaction(0, [TransactionInput(op, b"", 0, ComputeCommit.sigops(1))],
+                       [TransactionOutput(e.amount - 1000, p2sh)], 0, SUBNETWORK_ID_NATIVE, 0, b"")
+    from kaspa_tpu.consensus.mass import MassCalculator
+
+    fund.storage_mass = MassCalculator().calc_contextual_masses(fund, [e])
+    msg = chash.calc_schnorr_signature_hash(fund, [e], 0, chash.SIG_HASH_ALL, chash.SigHashReusedValues())
+    sig = eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32))
+    fund.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+    mgr.validate_and_insert_transaction(fund)
+    blk = mgr.get_block_template(miner.miner_data)
+    c.validate_and_insert_block(blk)
+    mgr.handle_new_block_transactions(blk.transactions, c.get_virtual_daa_score())
+    c.validate_and_insert_block(mgr.get_block_template(miner.miner_data))  # merge it
+
+    ms_op = TransactionOutpoint(fund.id(), 0)
+    ms_entry = c.get_virtual_utxo_view().get(ms_op)
+    assert ms_entry is not None
+
+    # PSKT: construct -> two signers independently -> combine -> extract
+    base = Pskt().add_input(ms_op, ms_entry, redeem, 2).add_output(
+        TransactionOutput(ms_entry.amount - 2000, miner.spk)
+    )
+    wire = base.to_json()
+    signer_a = Pskt.from_json(wire).sign(keys[0], rng.randbytes(32))
+    signer_c = Pskt.from_json(wire).sign(keys[2], rng.randbytes(32))
+
+    # insufficient sigs -> extraction fails
+    with pytest.raises(PsktError, match="1 of 2"):
+        Pskt.from_json(signer_a.to_json()).extract_tx()
+
+    # tampered-output PSKT must not combine
+    tampered = Pskt.from_json(signer_c.to_json())
+    tampered.outputs[0].value -= 1
+    with pytest.raises(PsktError, match="incompatible"):
+        Pskt.from_json(signer_a.to_json()).combine(tampered)
+
+    combined = Pskt.from_json(signer_a.to_json()).combine(Pskt.from_json(signer_c.to_json()))
+    tx = combined.extract_tx()
+
+    # the extracted multisig spend mines into a valid block
+    mgr.validate_and_insert_transaction(tx)
+    blk2 = mgr.get_block_template(miner.miner_data)
+    assert any(t.id() == tx.id() for t in blk2.transactions[1:])
+    assert c.validate_and_insert_block(blk2) in ("utxo_valid", "utxo_pending")
