@@ -83,7 +83,7 @@ class Daemon:
     # --- rpc wire dispatch ---
 
     _METHODS = {
-        "getServerInfo": lambda rpc, p: rpc.get_server_info().__dict__,
+        "getServerInfo": lambda rpc, p: {**rpc.get_server_info().__dict__, "coinbase_maturity": rpc.consensus.params.coinbase_maturity},
         "getBlockDagInfo": lambda rpc, p: rpc.get_block_dag_info(),
         "getBlock": lambda rpc, p: rpc.get_block(bytes.fromhex(p["hash"]), p.get("includeTransactions", True)),
         "getSinkBlueScore": lambda rpc, p: rpc.get_sink_blue_score(),
@@ -110,6 +110,13 @@ class Daemon:
                 raise ValueError("template not cached")
             status = self.node.submit_block(cached)  # insert + unorphan + relay
             return {"status": status}
+        if method == "submitTransaction":
+            from kaspa_tpu.wallet.__main__ import wire_to_tx
+
+            tx = wire_to_tx(params["tx"])
+            txid = self.rpc.submit_transaction(tx)
+            self.node.broadcast_tx(tx)
+            return txid.hex()
         fn = self._METHODS.get(method)
         if fn is None:
             raise ValueError(f"unknown method {method}")

@@ -1,0 +1,144 @@
+"""Wallet CLI (reference: cli/ — the wallet terminal's core commands).
+
+Talks to a running node over the JSON-RPC wire:
+
+    python -m kaspa_tpu.wallet --rpc 127.0.0.1:16110 address --seed-file s.txt
+    python -m kaspa_tpu.wallet --rpc 127.0.0.1:16110 balance --seed-file s.txt
+    python -m kaspa_tpu.wallet --rpc 127.0.0.1:16110 send --seed-file s.txt \
+        --to kaspasim:... --amount 100000000 --fee 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kaspa_tpu.node.daemon import rpc_call
+from kaspa_tpu.wallet import Account
+
+
+def _account(args) -> Account:
+    with open(args.seed_file, "rb") as f:
+        seed = f.read().strip()
+    acct = Account.from_seed(seed, prefix=args.prefix)
+    for _ in range(args.addresses - 1):
+        acct.derive_receive_address()
+    return acct
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kaspa-tpu-wallet")
+    p.add_argument("--rpc", default="127.0.0.1:16110", help="node RPC address")
+    p.add_argument("--seed-file", required=True, help="file containing the wallet seed bytes")
+    p.add_argument("--prefix", default="kaspasim", help="address prefix")
+    p.add_argument("--addresses", type=int, default=1, help="number of receive addresses to derive")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("address", help="print receive addresses")
+    sub.add_parser("balance", help="total balance over derived addresses")
+    sp = sub.add_parser("send", help="build, sign and submit a spend")
+    sp.add_argument("--to", required=True)
+    sp.add_argument("--amount", type=int, required=True, help="sompi")
+    sp.add_argument("--fee", type=int, default=2000)
+    args = p.parse_args(argv)
+
+    acct = _account(args)
+    if args.cmd == "address":
+        for a in acct.addresses():
+            print(a)
+        return 0
+
+    if args.cmd == "balance":
+        total = 0
+        for a in acct.addresses():
+            total += rpc_call(args.rpc, "getBalanceByAddress", {"address": a})
+        print(f"{total} sompi ({total / 1e8:.8f} KAS)")
+        return 0
+
+    if args.cmd == "send":
+        # fetch spendable utxos via the node's index, then build/sign locally
+        info = rpc_call(args.rpc, "getServerInfo")
+        daa = info["virtual_daa_score"]
+
+        class _RemoteIndex:
+            """utxoindex facade backed by the node's RPC."""
+
+            def get_utxos_by_script(self, script: bytes):
+                from kaspa_tpu.consensus.model import ScriptPublicKey, TransactionOutpoint, UtxoEntry
+                from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+                addr = extract_script_pub_key_address(ScriptPublicKey(0, script), args.prefix).to_string()
+                out = {}
+                for u in rpc_call(args.rpc, "getUtxosByAddresses", {"addresses": [addr]}):
+                    op = TransactionOutpoint(bytes.fromhex(u["outpoint"]["transaction_id"]), u["outpoint"]["index"])
+                    out[op] = UtxoEntry(
+                        u["utxo_entry"]["amount"], ScriptPublicKey(0, script),
+                        u["utxo_entry"]["block_daa_score"], u["utxo_entry"]["is_coinbase"],
+                    )
+                return out
+
+            def get_balance_by_script(self, script: bytes) -> int:
+                return sum(e.amount for e in self.get_utxos_by_script(script).values())
+
+        tx = acct.build_send(_RemoteIndex(), args.to, args.amount, args.fee, daa, coinbase_maturity=rpc_call(args.rpc, "getServerInfo").get("coinbase_maturity", 200))
+        # first-use signature-kernel load in the node can take minutes
+        txid = rpc_call(args.rpc, "submitTransaction", {"tx": tx_to_wire(tx)}, timeout=600.0)
+        print(f"submitted {txid}")
+        return 0
+    return 1
+
+
+def tx_to_wire(tx) -> dict:
+    return {
+        "version": tx.version,
+        "inputs": [
+            {
+                "previousOutpoint": {"transactionId": i.previous_outpoint.transaction_id.hex(), "index": i.previous_outpoint.index},
+                "signatureScript": i.signature_script.hex(),
+                "sequence": i.sequence,
+                "sigOpCount": i.compute_commit.sig_op_count() or 0,
+            }
+            for i in tx.inputs
+        ],
+        "outputs": [
+            {"value": o.value, "scriptPublicKey": o.script_public_key.version.to_bytes(2, "little").hex() + o.script_public_key.script.hex()}
+            for o in tx.outputs
+        ],
+        "lockTime": tx.lock_time,
+        "subnetworkId": tx.subnetwork_id.hex(),
+        "gas": tx.gas,
+        "payload": tx.payload.hex(),
+        "mass": tx.storage_mass,
+    }
+
+
+def wire_to_tx(d: dict):
+    from kaspa_tpu.consensus.model import (
+        ComputeCommit,
+        ScriptPublicKey,
+        Transaction,
+        TransactionInput,
+        TransactionOutpoint,
+        TransactionOutput,
+    )
+
+    inputs = [
+        TransactionInput(
+            TransactionOutpoint(bytes.fromhex(i["previousOutpoint"]["transactionId"]), i["previousOutpoint"]["index"]),
+            bytes.fromhex(i["signatureScript"]),
+            i["sequence"],
+            ComputeCommit.sigops(i.get("sigOpCount", 0)),
+        )
+        for i in d["inputs"]
+    ]
+    outputs = []
+    for o in d["outputs"]:
+        raw = bytes.fromhex(o["scriptPublicKey"])
+        outputs.append(TransactionOutput(o["value"], ScriptPublicKey(int.from_bytes(raw[:2], "little"), raw[2:])))
+    return Transaction(
+        d["version"], inputs, outputs, d["lockTime"], bytes.fromhex(d["subnetworkId"]), d["gas"],
+        bytes.fromhex(d["payload"]), storage_mass=d.get("mass", 0),
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
