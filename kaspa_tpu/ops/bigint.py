@@ -92,13 +92,15 @@ class FieldCtx:
     @property
     def m_limbs_dev(self):
         if not hasattr(self, "_m_limbs_dev"):
-            self._m_limbs_dev = jnp.asarray(self.m_limbs)
+            with jax.ensure_compile_time_eval():
+                self._m_limbs_dev = jnp.asarray(self.m_limbs)
         return self._m_limbs_dev
 
     @property
     def c_limbs16_dev(self):
         if not hasattr(self, "_c_limbs16_dev"):
-            self._c_limbs16_dev = jnp.asarray(self.c_limbs16)
+            with jax.ensure_compile_time_eval():
+                self._c_limbs16_dev = jnp.asarray(self.c_limbs16)
         return self._c_limbs16_dev
 
     def __repr__(self):
@@ -144,15 +146,20 @@ def _conv_matrix_np(k: int):
 def _const(arr_factory_key):
     """Memoized device constants: avoids re-running numpy->jax conversion for
     the large one-hot matrices on every traced multiply (a dominant share of
-    trace/lowering time for fresh batch shapes)."""
+    trace/lowering time for fresh batch shapes).
+
+    ensure_compile_time_eval makes the conversion concrete even when the
+    first call happens inside a jit trace — caching a tracer would leak it
+    into later traces (UnexpectedTracerError)."""
     kind, arg = arr_factory_key
-    if kind == "conv":
-        return jnp.asarray(_conv_matrix_np(arg))
-    if kind == "collect":
-        return jnp.asarray(_block_collect_np(arg))
-    if kind == "cmat":
-        c8, k = arg
-        return jnp.asarray(_c_matrix_np(c8, k))
+    with jax.ensure_compile_time_eval():
+        if kind == "conv":
+            return jnp.asarray(_conv_matrix_np(arg))
+        if kind == "collect":
+            return jnp.asarray(_block_collect_np(arg))
+        if kind == "cmat":
+            c8, k = arg
+            return jnp.asarray(_c_matrix_np(c8, k))
     raise KeyError(kind)
 
 
