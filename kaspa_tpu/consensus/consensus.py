@@ -2,9 +2,10 @@
 
 Re-design of the reference's 4-stage pipeline (consensus/src/pipeline/) as
 explicit processing stages sharing a ConsensusStorage.  This module is the
-host-side control path; all batchable crypto (signature checks, muhash
-products) is dispatched to the TPU through the batch layers
-(txscript.batch, ops.muhash_ops).
+host-side control path; batchable crypto goes to the device through the
+batch layers — signature/script checks via txscript.batch (every chain
+block), muhash element products via MuHash.add_transactions_batch, which
+tree-reduces on device above its element-count threshold.
 
 Stage semantics follow the reference call stack (SURVEY.md §3.2):
 - header stage: in-isolation checks -> parent relations -> GHOSTDAG ->
@@ -22,6 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus import serde
 from kaspa_tpu.consensus.model import (
     SUBNETWORK_ID_COINBASE,
     Header,
@@ -44,7 +46,18 @@ from kaspa_tpu.consensus.processes.transaction_validator import (
 )
 from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, SampledWindowManager
 from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
-from kaspa_tpu.consensus.stores import ConsensusStorage, GhostdagData, StatusesStore
+from kaspa_tpu.consensus.stores import (
+    PREFIX_ACCEPTANCE,
+    PREFIX_DAA_EXCLUDED,
+    PREFIX_DEPTH,
+    PREFIX_MULTISETS,
+    PREFIX_PRUNING_SAMPLES,
+    PREFIX_UTXO_DIFFS,
+    PREFIX_UTXO_SET,
+    ConsensusStorage,
+    GhostdagData,
+    StatusesStore,
+)
 from kaspa_tpu.consensus.utxo import UtxoCollection, UtxoDiff, UtxoView, apply_diff, unapply_diff
 from kaspa_tpu.crypto import merkle
 from kaspa_tpu.crypto.muhash import MuHash
@@ -74,9 +87,13 @@ class VirtualState:
 
 
 class Consensus:
-    def __init__(self, params: Params):
+    def __init__(self, params: Params, db=None):
+        """``db``: optional storage.kv.KvStore — attaches crash-safe
+        persistence (write-through stores + atomic batch flush per block).
+        A non-empty DB restores the full consensus state (restart-resume);
+        an empty one is initialized with genesis."""
         self.params = params
-        self.storage = ConsensusStorage()
+        self.storage = ConsensusStorage(db)
         self.reachability = ReachabilityService()
         self.ghostdag_manager = GhostdagManager(
             params.genesis.hash,
@@ -134,7 +151,10 @@ class Consensus:
         self._acc_added: dict = {}
         self._acc_removed: dict = {}
 
-        self._insert_genesis()
+        if self.storage.is_initialized():
+            self._load_state()
+        else:
+            self._insert_genesis()
 
     # ------------------------------------------------------------------
     # genesis
@@ -174,11 +194,139 @@ class Consensus:
         self.reachability.add_block(g.hash, [ORIGIN], ORIGIN)
         self.storage.block_transactions.insert(g.hash, genesis_txs)
         self.storage.statuses.set(g.hash, StatusesStore.STATUS_UTXO_VALID)
-        self.multisets[g.hash] = MuHash()
-        self.utxo_diffs[g.hash] = UtxoDiff()
-        self.daa_excluded[g.hash] = set()
+        self._set_multiset(g.hash, MuHash())
+        self._set_utxo_diff(g.hash, UtxoDiff())
+        self._set_daa_excluded(g.hash, set())
         self.tips = {g.hash}
+        self._persist_tips()
+        self.storage.put_meta(b"init", b"1")
         self._resolve_virtual()
+        self.storage.flush()
+
+    # ------------------------------------------------------------------
+    # persistence (stage aux state alongside the write-through stores;
+    # reference: consensus/src/consensus/storage.rs + database/src/access.rs)
+    # ------------------------------------------------------------------
+
+    def _set_multiset(self, block: bytes, ms: MuHash) -> None:
+        self.multisets[block] = ms
+        if self.storage.db is not None:
+            self.storage.stage(PREFIX_MULTISETS + block, serde.encode_muhash(ms))
+
+    def _set_utxo_diff(self, block: bytes, diff: UtxoDiff) -> None:
+        self.utxo_diffs[block] = diff
+        if self.storage.db is not None:
+            self.storage.stage(PREFIX_UTXO_DIFFS + block, serde.encode_utxo_diff(diff))
+
+    def _set_acceptance(self, block: bytes, accepted_ids: list[bytes]) -> None:
+        self.acceptance_data[block] = accepted_ids
+        if self.storage.db is not None:
+            self.storage.stage(PREFIX_ACCEPTANCE + block, serde.encode_hash_list(accepted_ids))
+
+    def _set_daa_excluded(self, block: bytes, excluded: set) -> None:
+        self.daa_excluded[block] = excluded
+        if self.storage.db is not None:
+            self.storage.stage(PREFIX_DAA_EXCLUDED + block, serde.encode_hash_list(sorted(excluded)))
+
+    def _persist_depth(self, block: bytes, mdr: bytes, fp: bytes) -> None:
+        if self.storage.db is not None:
+            self.storage.stage(PREFIX_DEPTH + block, mdr + fp)
+
+    def _persist_pruning_sample(self, block: bytes, sample: bytes) -> None:
+        if self.storage.db is not None:
+            self.storage.stage(PREFIX_PRUNING_SAMPLES + block, sample)
+
+    def _persist_tips(self) -> None:
+        if self.storage.db is not None:
+            self.storage.put_meta(b"tips", serde.encode_hash_list(sorted(self.tips)))
+
+    def _persist_utxo_position(self) -> None:
+        if self.storage.db is not None:
+            self.storage.put_meta(b"utxo_position", self.utxo_position)
+
+    def _load_state(self) -> None:
+        """Restore the full consensus state from the attached DB.
+
+        Stores load directly; reachability (and lazily the window caches)
+        rebuild from the loaded relations/ghostdag in topological order —
+        cheaper to recompute than to persist, and backend-agnostic."""
+        from kaspa_tpu.consensus.stores import (
+            PREFIX_BLOCK_TXS,
+            PREFIX_GHOSTDAG,
+            PREFIX_HEADERS,
+            PREFIX_RELATIONS,
+            PREFIX_STATUSES,
+        )
+
+        grouped = self.storage.load_all()
+        self.storage.headers._headers = {
+            k: serde.decode_header(v) for k, v in grouped.get(PREFIX_HEADERS, {}).items()
+        }
+        self.storage.ghostdag._data = {
+            k: serde.decode_ghostdag(v) for k, v in grouped.get(PREFIX_GHOSTDAG, {}).items()
+        }
+        self.storage.statuses._status = {
+            k: v.decode() for k, v in grouped.get(PREFIX_STATUSES, {}).items()
+        }
+        self.storage.block_transactions._txs = {
+            k: serde.decode_txs(v) for k, v in grouped.get(PREFIX_BLOCK_TXS, {}).items()
+        }
+        parents_map = {
+            k: serde.decode_hash_list_bytes(v) for k, v in grouped.get(PREFIX_RELATIONS, {}).items()
+        }
+        self.multisets = {k: serde.decode_muhash(v) for k, v in grouped.get(PREFIX_MULTISETS, {}).items()}
+        self.utxo_diffs = {k: serde.decode_utxo_diff(v) for k, v in grouped.get(PREFIX_UTXO_DIFFS, {}).items()}
+        self.acceptance_data = {
+            k: serde.decode_hash_list_bytes(v) for k, v in grouped.get(PREFIX_ACCEPTANCE, {}).items()
+        }
+        self.daa_excluded = {
+            k: set(serde.decode_hash_list_bytes(v)) for k, v in grouped.get(PREFIX_DAA_EXCLUDED, {}).items()
+        }
+        for k, v in grouped.get(PREFIX_DEPTH, {}).items():
+            self.depth_manager.store(k, v[:32], v[32:64])
+        for k, v in grouped.get(PREFIX_PRUNING_SAMPLES, {}).items():
+            self.pruning_point_manager.store_pruning_sample(k, v)
+        self.utxo_set = UtxoCollection(
+            {serde.decode_outpoint(k): serde.decode_utxo_entry(v) for k, v in grouped.get(PREFIX_UTXO_SET, {}).items()}
+        )
+        self.utxo_position = self.storage.get_meta(b"utxo_position") or self.params.genesis.hash
+        self.tips = set(serde.decode_hash_list_bytes(self.storage.get_meta(b"tips")))
+
+        # rebuild relations (children derived) and reachability in topo order
+        indeg: dict[bytes, int] = {}
+        children: dict[bytes, list[bytes]] = {}
+        for blk, parents in parents_map.items():
+            indeg.setdefault(blk, 0)
+            for p in parents:
+                if p in parents_map:
+                    indeg[blk] = indeg.get(blk, 0) + 1
+                    children.setdefault(p, []).append(blk)
+        from collections import deque
+
+        queue = deque(sorted(b for b, d in indeg.items() if d == 0))
+        topo = []
+        while queue:
+            b = queue.popleft()
+            topo.append(b)
+            for c in sorted(children.get(b, [])):
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    queue.append(c)
+        assert len(topo) == len(parents_map), "relations cycle or missing parent"
+        g = self.params.genesis.hash
+        for blk in topo:
+            parents = parents_map[blk]
+            self.storage.relations._parents[blk] = list(parents)
+            self.storage.relations._children.setdefault(blk, [])
+            for p in parents:
+                self.storage.relations._children.setdefault(p, []).append(blk)
+            if blk == g:
+                self.reachability.add_block(blk, [ORIGIN], ORIGIN)
+            else:
+                self.reachability.add_block(blk, parents, self.storage.ghostdag.get_selected_parent(blk))
+        self._resolve_virtual()
+        # the load-time resolve may reposition the UTXO set; flush that
+        self.storage.flush()
 
     # ------------------------------------------------------------------
     # public API
@@ -199,6 +347,7 @@ class Consensus:
         self._update_tips(block.hash)
         self._resolve_virtual()
         status = self.storage.statuses.get(block.hash)
+        self.storage.flush()
         return status
 
     def sink(self) -> bytes:
@@ -273,8 +422,9 @@ class Consensus:
         self.storage.relations.insert(block_hash, parents)
         self.storage.ghostdag.insert(block_hash, gd)
         self.reachability.add_block(block_hash, parents, gd.selected_parent)
-        self.daa_excluded[block_hash] = daa_window.mergeset_non_daa
+        self._set_daa_excluded(block_hash, daa_window.mergeset_non_daa)
         self.depth_manager.store(block_hash, mdr, fp)
+        self._persist_depth(block_hash, mdr, fp)
         self.window_manager.cache_block_window(block_hash, DIFFICULTY_WINDOW, daa_window.window)
         self.storage.statuses.set(block_hash, StatusesStore.STATUS_HEADER_ONLY)
         return True
@@ -349,6 +499,7 @@ class Consensus:
     def _update_tips(self, new_block: bytes) -> None:
         parents = set(self.storage.relations.get_parents(new_block))
         self.tips = (self.tips - parents) | {new_block}
+        self._persist_tips()
 
     # ------------------------------------------------------------------
     # virtual stage (pipeline/virtual_processor/)
@@ -463,6 +614,7 @@ class Consensus:
         if reply.pruning_point != header.pruning_point:
             return False
         self.pruning_point_manager.store_pruning_sample(block, reply.pruning_sample)
+        self._persist_pruning_sample(block, reply.pruning_sample)
         # 4. coinbase
         txs = self.storage.block_transactions.get(block)
         if not self._verify_coinbase_transaction(txs[0], header.daa_score, gd, ctx["mergeset_rewards"], self.daa_excluded[block]):
@@ -476,17 +628,29 @@ class Consensus:
             return False
 
         # commit: store diff/multiset/acceptance, apply position
-        self.multisets[block] = multiset
-        self.utxo_diffs[block] = ctx["mergeset_diff"]
-        self.acceptance_data[block] = ctx["accepted_tx_ids"]
+        self._set_multiset(block, multiset)
+        self._set_utxo_diff(block, ctx["mergeset_diff"])
+        self._set_acceptance(block, ctx["accepted_tx_ids"])
         self._apply_chain_diff(ctx["mergeset_diff"])
         self.utxo_position = block
+        self._persist_utxo_position()
         self.storage.statuses.set(block, StatusesStore.STATUS_UTXO_VALID)
         self.counters.inc_chain_blocks()
         return True
 
+    def _stage_utxo_set_change(self, diff: UtxoDiff, reverse: bool) -> None:
+        """Mirror a materialized-UTXO-set mutation into the DB batch."""
+        if self.storage.db is None:
+            return
+        removed, added = (diff.add, diff.remove) if reverse else (diff.remove, diff.add)
+        for op in removed:
+            self.storage.stage(PREFIX_UTXO_SET + serde.encode_outpoint(op), None)
+        for op, entry in added.items():
+            self.storage.stage(PREFIX_UTXO_SET + serde.encode_outpoint(op), serde.encode_utxo_entry(entry))
+
     def _apply_chain_diff(self, diff: UtxoDiff) -> None:
         apply_diff(self.utxo_set, diff)
+        self._stage_utxo_set_change(diff, reverse=False)
         for op, entry in diff.remove.items():
             if op in self._acc_added:
                 del self._acc_added[op]
@@ -500,6 +664,7 @@ class Consensus:
 
     def _unapply_chain_diff(self, diff: UtxoDiff) -> None:
         unapply_diff(self.utxo_set, diff)
+        self._stage_utxo_set_change(diff, reverse=True)
         for op, entry in diff.add.items():
             if op in self._acc_added:
                 del self._acc_added[op]
@@ -531,8 +696,11 @@ class Consensus:
         coinbase = sp_txs[0]
         coinbase_entries: list = []
         mergeset_diff.add_transaction(coinbase, coinbase_entries, pov_daa_score)
-        multiset_add_tx(multiset, coinbase, coinbase_entries, pov_daa_score)
         accepted_tx_ids.append(coinbase.id())
+        # multiset updates accumulate across the whole mergeset and reduce in
+        # one batch below (the product is commutative) — this is what routes
+        # the muhash work through the device tree-product kernel
+        multiset_items: list = [(coinbase, coinbase_entries, pov_daa_score)]
 
         ordered = [(gd.selected_parent, sp_txs)] + [
             (b, self.storage.block_transactions.get(b)) for b in gd.ascending_mergeset_without_selected_parent(self.storage.ghostdag)
@@ -541,16 +709,16 @@ class Consensus:
             composed = UtxoView(self.utxo_set, mergeset_diff)
             is_selected_parent = i == 0
             flags = FLAG_SKIP_SCRIPTS if is_selected_parent else FLAG_FULL
-            block_daa = self.storage.headers.get_daa_score(merged_block)
             validated = self._validate_transactions(txs, composed, pov_daa_score, flags)
             block_fee = 0
             for tx, entries, fee in validated:
                 mergeset_diff.add_transaction(tx, entries, pov_daa_score)
-                multiset_add_tx(multiset, tx, entries, pov_daa_score)
+                multiset_items.append((tx, entries, pov_daa_score))
                 accepted_tx_ids.append(tx.id())
                 block_fee += fee
             cb_data = self.coinbase_manager.deserialize_coinbase_payload(txs[0].payload)
             mergeset_rewards[merged_block] = BlockRewardData(cb_data.subsidy, block_fee, cb_data.miner_data.script_public_key)
+        multiset.add_transactions_batch(multiset_items)
 
         return {
             "mergeset_diff": mergeset_diff,
@@ -685,7 +853,4 @@ class Consensus:
         for b in reversed(fwd_path):
             self._apply_chain_diff(self.utxo_diffs[b])
         self.utxo_position = target
-
-
-def multiset_add_tx(multiset: MuHash, tx, entries, block_daa_score: int) -> None:
-    multiset.add_transaction(tx, entries, block_daa_score)
+        self._persist_utxo_position()

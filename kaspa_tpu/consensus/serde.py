@@ -1,0 +1,397 @@
+"""Canonical binary codec for consensus data types.
+
+One compact binary form shared by the persistence layer (store values in the
+native KV engine) and the P2P/RPC wire framing — the role protobuf plays in
+the reference (`protocol/p2p/proto/messages.proto`, plus bincode for store
+values in `database/src/access.rs`).  Integers are unsigned LEB128 varints;
+hashes are fixed 32 bytes; optional fields carry a 1-byte presence tag.
+
+Round-trip exactness is what consensus persistence requires: `decode(encode
+(x)) == x` for every stored type (tested in tests/test_serde.py).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+
+from kaspa_tpu.consensus.model import (
+    ComputeCommit,
+    Covenant,
+    Header,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def _read_exact(r: io.BytesIO, n: int) -> bytes:
+    data = r.read(n)
+    if len(data) != n:
+        raise EOFError(f"truncated read: wanted {n}, got {len(data)}")
+    return data
+
+
+def write_varint(w: io.BytesIO, v: int) -> None:
+    if v < 0:
+        raise ValueError("varint must be non-negative")
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            w.write(bytes([b | 0x80]))
+        else:
+            w.write(bytes([b]))
+            return
+
+
+def read_varint(r: io.BytesIO) -> int:
+    shift = 0
+    out = 0
+    while True:
+        c = r.read(1)
+        if not c:
+            raise EOFError("truncated varint")
+        b = c[0]
+        out |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return out
+        shift += 7
+        if shift > 448:
+            raise ValueError("varint too long")
+
+
+def write_bytes(w: io.BytesIO, data: bytes) -> None:
+    write_varint(w, len(data))
+    w.write(data)
+
+
+def read_bytes(r: io.BytesIO) -> bytes:
+    return _read_exact(r, read_varint(r))
+
+
+def write_hash(w: io.BytesIO, h: bytes) -> None:
+    assert len(h) == 32, len(h)
+    w.write(h)
+
+
+def read_hash(r: io.BytesIO) -> bytes:
+    return _read_exact(r, 32)
+
+
+def write_bigint(w: io.BytesIO, v: int) -> None:
+    """Arbitrary-size non-negative int (blue_work is Uint192-range)."""
+    raw = v.to_bytes((v.bit_length() + 7) // 8, "little") if v else b""
+    write_bytes(w, raw)
+
+
+def read_bigint(r: io.BytesIO) -> int:
+    return int.from_bytes(read_bytes(r), "little")
+
+
+def write_option(w: io.BytesIO, v, writer) -> None:
+    if v is None:
+        w.write(b"\x00")
+    else:
+        w.write(b"\x01")
+        writer(w, v)
+
+
+def read_option(r: io.BytesIO, reader):
+    return reader(r) if _read_exact(r, 1)[0] else None
+
+
+# ---------------------------------------------------------------------------
+# consensus types
+# ---------------------------------------------------------------------------
+
+def write_outpoint(w: io.BytesIO, op: TransactionOutpoint) -> None:
+    write_hash(w, op.transaction_id)
+    write_varint(w, op.index)
+
+
+def read_outpoint(r: io.BytesIO) -> TransactionOutpoint:
+    return TransactionOutpoint(read_hash(r), read_varint(r))
+
+
+def write_spk(w: io.BytesIO, spk: ScriptPublicKey) -> None:
+    write_varint(w, spk.version)
+    write_bytes(w, spk.script)
+
+
+def read_spk(r: io.BytesIO) -> ScriptPublicKey:
+    return ScriptPublicKey(read_varint(r), read_bytes(r))
+
+
+def write_input(w: io.BytesIO, inp: TransactionInput) -> None:
+    write_outpoint(w, inp.previous_outpoint)
+    write_bytes(w, inp.signature_script)
+    write_varint(w, inp.sequence)
+    w.write(b"\x00" if inp.compute_commit.kind == "sigops" else b"\x01")
+    write_varint(w, inp.compute_commit.value)
+
+
+def read_input(r: io.BytesIO) -> TransactionInput:
+    op = read_outpoint(r)
+    script = read_bytes(r)
+    seq = read_varint(r)
+    kind = _read_exact(r, 1)[0]
+    value = read_varint(r)
+    cc = ComputeCommit.sigops(value) if kind == 0 else ComputeCommit.budget(value)
+    return TransactionInput(op, script, seq, cc)
+
+
+def write_covenant(w: io.BytesIO, cov: Covenant) -> None:
+    write_varint(w, cov.authorizing_input)
+    write_hash(w, cov.covenant_id)
+
+
+def read_covenant(r: io.BytesIO) -> Covenant:
+    return Covenant(read_varint(r), read_hash(r))
+
+
+def write_output(w: io.BytesIO, out: TransactionOutput) -> None:
+    write_varint(w, out.value)
+    write_spk(w, out.script_public_key)
+    write_option(w, out.covenant, write_covenant)
+
+
+def read_output(r: io.BytesIO) -> TransactionOutput:
+    return TransactionOutput(read_varint(r), read_spk(r), read_option(r, read_covenant))
+
+
+def write_tx(w: io.BytesIO, tx: Transaction) -> None:
+    assert len(tx.subnetwork_id) == 20, len(tx.subnetwork_id)
+    write_varint(w, tx.version)
+    write_varint(w, len(tx.inputs))
+    for inp in tx.inputs:
+        write_input(w, inp)
+    write_varint(w, len(tx.outputs))
+    for out in tx.outputs:
+        write_output(w, out)
+    write_varint(w, tx.lock_time)
+    w.write(tx.subnetwork_id)
+    write_varint(w, tx.gas)
+    write_bytes(w, tx.payload)
+    write_varint(w, tx.storage_mass)
+
+
+def read_tx(r: io.BytesIO) -> Transaction:
+    version = read_varint(r)
+    inputs = [read_input(r) for _ in range(read_varint(r))]
+    outputs = [read_output(r) for _ in range(read_varint(r))]
+    lock_time = read_varint(r)
+    subnetwork = _read_exact(r, 20)
+    gas = read_varint(r)
+    payload = read_bytes(r)
+    storage_mass = read_varint(r)
+    return Transaction(version, inputs, outputs, lock_time, subnetwork, gas, payload, storage_mass)
+
+
+def write_header(w: io.BytesIO, h: Header) -> None:
+    write_varint(w, h.version)
+    write_varint(w, len(h.parents_by_level))
+    for level in h.parents_by_level:
+        write_varint(w, len(level))
+        for p in level:
+            write_hash(w, p)
+    write_hash(w, h.hash_merkle_root)
+    write_hash(w, h.accepted_id_merkle_root)
+    write_hash(w, h.utxo_commitment)
+    write_varint(w, h.timestamp)
+    w.write(struct.pack("<I", h.bits))
+    write_varint(w, h.nonce)
+    write_varint(w, h.daa_score)
+    write_bigint(w, h.blue_work)
+    write_varint(w, h.blue_score)
+    write_hash(w, h.pruning_point)
+    # persisted headers carry their (validated) hash so loads skip rehashing
+    write_option(w, h._hash_cache, write_hash)
+
+
+def read_header(r: io.BytesIO) -> Header:
+    version = read_varint(r)
+    parents_by_level = []
+    for _ in range(read_varint(r)):
+        parents_by_level.append([read_hash(r) for _ in range(read_varint(r))])
+    hash_merkle_root = read_hash(r)
+    accepted_id_merkle_root = read_hash(r)
+    utxo_commitment = read_hash(r)
+    timestamp = read_varint(r)
+    bits = struct.unpack("<I", _read_exact(r, 4))[0]
+    nonce = read_varint(r)
+    daa_score = read_varint(r)
+    blue_work = read_bigint(r)
+    blue_score = read_varint(r)
+    pruning_point = read_hash(r)
+    h = Header(
+        version, parents_by_level, hash_merkle_root, accepted_id_merkle_root,
+        utxo_commitment, timestamp, bits, nonce, daa_score, blue_work,
+        blue_score, pruning_point,
+    )
+    h._hash_cache = read_option(r, read_hash)
+    return h
+
+
+def write_utxo_entry(w: io.BytesIO, e: UtxoEntry) -> None:
+    write_varint(w, e.amount)
+    write_spk(w, e.script_public_key)
+    write_varint(w, e.block_daa_score)
+    w.write(b"\x01" if e.is_coinbase else b"\x00")
+    write_option(w, e.covenant_id, write_hash)
+
+
+def read_utxo_entry(r: io.BytesIO) -> UtxoEntry:
+    return UtxoEntry(
+        read_varint(r), read_spk(r), read_varint(r), _read_exact(r, 1)[0] == 1,
+        read_option(r, read_hash),
+    )
+
+
+def write_hash_list(w: io.BytesIO, hs) -> None:
+    write_varint(w, len(hs))
+    for h in hs:
+        write_hash(w, h)
+
+
+def read_hash_list(r: io.BytesIO) -> list[bytes]:
+    return [read_hash(r) for _ in range(read_varint(r))]
+
+
+# ---------------------------------------------------------------------------
+# top-level helpers (bytes <-> object)
+# ---------------------------------------------------------------------------
+
+def _enc(writer, obj) -> bytes:
+    w = io.BytesIO()
+    writer(w, obj)
+    return w.getvalue()
+
+
+def _dec(reader, data: bytes):
+    return reader(io.BytesIO(data))
+
+
+def encode_header(h: Header) -> bytes:
+    return _enc(write_header, h)
+
+
+def decode_header(data: bytes) -> Header:
+    return _dec(read_header, data)
+
+
+def encode_tx(tx: Transaction) -> bytes:
+    return _enc(write_tx, tx)
+
+
+def decode_tx(data: bytes) -> Transaction:
+    return _dec(read_tx, data)
+
+
+def encode_txs(txs: list[Transaction]) -> bytes:
+    w = io.BytesIO()
+    write_varint(w, len(txs))
+    for tx in txs:
+        write_tx(w, tx)
+    return w.getvalue()
+
+
+def decode_txs(data: bytes) -> list[Transaction]:
+    r = io.BytesIO(data)
+    return [read_tx(r) for _ in range(read_varint(r))]
+
+
+def encode_hash_list(hs) -> bytes:
+    return _enc(write_hash_list, list(hs))
+
+
+def decode_hash_list_bytes(data: bytes) -> list[bytes]:
+    return _dec(read_hash_list, data)
+
+
+def encode_utxo_entry(e: UtxoEntry) -> bytes:
+    return _enc(write_utxo_entry, e)
+
+
+def decode_utxo_entry(data: bytes) -> UtxoEntry:
+    return _dec(read_utxo_entry, data)
+
+
+def encode_ghostdag(gd) -> bytes:
+    w = io.BytesIO()
+    write_varint(w, gd.blue_score)
+    write_bigint(w, gd.blue_work)
+    write_hash(w, gd.selected_parent)
+    write_hash_list(w, gd.mergeset_blues)
+    write_hash_list(w, gd.mergeset_reds)
+    write_varint(w, len(gd.blues_anticone_sizes))
+    for h, size in gd.blues_anticone_sizes.items():
+        write_hash(w, h)
+        write_varint(w, size)
+    return w.getvalue()
+
+
+def decode_ghostdag(data: bytes):
+    from kaspa_tpu.consensus.stores import GhostdagData
+
+    r = io.BytesIO(data)
+    blue_score = read_varint(r)
+    blue_work = read_bigint(r)
+    selected_parent = read_hash(r)
+    blues = read_hash_list(r)
+    reds = read_hash_list(r)
+    anticone = {}
+    for _ in range(read_varint(r)):
+        h = read_hash(r)
+        anticone[h] = read_varint(r)
+    return GhostdagData(blue_score, blue_work, selected_parent, blues, reds, anticone)
+
+
+def encode_utxo_diff(diff) -> bytes:
+    """UtxoDiff (consensus/utxo.py): two outpoint->entry maps."""
+    w = io.BytesIO()
+    for side in (diff.add, diff.remove):
+        write_varint(w, len(side))
+        for op, entry in side.items():
+            write_outpoint(w, op)
+            write_utxo_entry(w, entry)
+    return w.getvalue()
+
+
+def decode_utxo_diff(data: bytes):
+    from kaspa_tpu.consensus.utxo import UtxoDiff
+
+    r = io.BytesIO(data)
+    diff = UtxoDiff()
+    for side in (diff.add, diff.remove):
+        for _ in range(read_varint(r)):
+            op = read_outpoint(r)
+            side[op] = read_utxo_entry(r)
+    return diff
+
+
+def encode_outpoint(op: TransactionOutpoint) -> bytes:
+    return op.transaction_id + struct.pack("<I", op.index)
+
+
+def decode_outpoint(data: bytes) -> TransactionOutpoint:
+    return TransactionOutpoint(data[:32], struct.unpack("<I", data[32:36])[0])
+
+
+def encode_muhash(mh) -> bytes:
+    """Both accumulators (normalization is deferred in consensus use)."""
+    return mh.numerator.to_bytes(384, "little") + mh.denominator.to_bytes(384, "little")
+
+
+def decode_muhash(data: bytes):
+    from kaspa_tpu.crypto.muhash import MuHash
+
+    mh = MuHash(int.from_bytes(data[:384], "little"), int.from_bytes(data[384:768], "little"))
+    return mh

@@ -1,17 +1,37 @@
-"""In-memory consensus stores.
+"""Consensus stores: in-memory working set with optional KV write-through.
 
 Mirrors the reference's store registry (consensus/src/model/stores/, 20
 stores aggregated in ConsensusStorage, consensus/src/consensus/storage.rs)
-with a pluggable in-memory backend.  The persistent (RocksDB-style C++ KV)
-backend slots behind the same interfaces in a later milestone; the store
-*interfaces* are the contract the pipeline codes against.
+and its persistence discipline (database/src/access.rs CachedDbAccess:
+in-memory cache over a persistent column, mutations grouped into atomic
+write batches).  Here every store keeps its full working set in a dict (the
+cache) and, when a DB is attached, appends encoded write-through ops to the
+storage-wide pending buffer; ``ConsensusStorage.flush()`` commits the buffer
+as ONE atomic CRC-framed batch in the native engine (native/kvstore) at
+block-commit boundaries.  A crash between flushes loses at most the blocks
+since the last flush — the on-disk state is always a consistent prefix.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from kaspa_tpu.consensus.model import Header, Transaction
+
+# key prefixes (database/src/registry.rs DatabaseStorePrefixes shape)
+PREFIX_HEADERS = b"HD"
+PREFIX_RELATIONS = b"RL"
+PREFIX_GHOSTDAG = b"GD"
+PREFIX_STATUSES = b"ST"
+PREFIX_BLOCK_TXS = b"BT"
+PREFIX_UTXO_DIFFS = b"UD"
+PREFIX_MULTISETS = b"MS"
+PREFIX_ACCEPTANCE = b"AC"
+PREFIX_DAA_EXCLUDED = b"DX"
+PREFIX_UTXO_SET = b"US"
+PREFIX_DEPTH = b"MD"
+PREFIX_PRUNING_SAMPLES = b"PS"
+PREFIX_META = b"MT"
 
 
 @dataclass
@@ -48,11 +68,20 @@ class GhostdagData:
 
 
 class HeaderStore:
-    def __init__(self):
+    def __init__(self, storage: "ConsensusStorage"):
+        self._storage = storage
         self._headers: dict[bytes, Header] = {}
 
     def insert(self, header: Header) -> None:
         self._headers[header.hash] = header
+        if self._storage.db is not None:
+            from kaspa_tpu.consensus import serde
+
+            self._storage.stage(PREFIX_HEADERS + header.hash, serde.encode_header(header))
+
+    def delete(self, block: bytes) -> None:
+        self._headers.pop(block, None)
+        self._storage.stage(PREFIX_HEADERS + block, None)
 
     def get(self, block: bytes) -> Header:
         return self._headers[block]
@@ -76,7 +105,8 @@ class HeaderStore:
 class RelationsStore:
     """Parent/child relations (level 0; higher levels added with pruning proofs)."""
 
-    def __init__(self):
+    def __init__(self, storage: "ConsensusStorage"):
+        self._storage = storage
         self._parents: dict[bytes, list[bytes]] = {}
         self._children: dict[bytes, list[bytes]] = {}
 
@@ -85,6 +115,19 @@ class RelationsStore:
         self._children.setdefault(block, [])
         for p in parents:
             self._children.setdefault(p, []).append(block)
+        if self._storage.db is not None:
+            from kaspa_tpu.consensus import serde
+
+            self._storage.stage(PREFIX_RELATIONS + block, serde.encode_hash_list(parents))
+
+    def delete(self, block: bytes) -> None:
+        parents = self._parents.pop(block, [])
+        for p in parents:
+            ch = self._children.get(p)
+            if ch and block in ch:
+                ch.remove(block)
+        self._children.pop(block, None)
+        self._storage.stage(PREFIX_RELATIONS + block, None)
 
     def get_parents(self, block: bytes) -> list[bytes]:
         return self._parents[block]
@@ -97,11 +140,20 @@ class RelationsStore:
 
 
 class GhostdagStore:
-    def __init__(self):
+    def __init__(self, storage: "ConsensusStorage"):
+        self._storage = storage
         self._data: dict[bytes, GhostdagData] = {}
 
     def insert(self, block: bytes, data: GhostdagData) -> None:
         self._data[block] = data
+        if self._storage.db is not None:
+            from kaspa_tpu.consensus import serde
+
+            self._storage.stage(PREFIX_GHOSTDAG + block, serde.encode_ghostdag(data))
+
+    def delete(self, block: bytes) -> None:
+        self._data.pop(block, None)
+        self._storage.stage(PREFIX_GHOSTDAG + block, None)
 
     def get(self, block: bytes) -> GhostdagData:
         return self._data[block]
@@ -131,11 +183,17 @@ class StatusesStore:
     STATUS_DISQUALIFIED = "disqualified"
     STATUS_HEADER_ONLY = "header_only"
 
-    def __init__(self):
+    def __init__(self, storage: "ConsensusStorage"):
+        self._storage = storage
         self._status: dict[bytes, str] = {}
 
     def set(self, block: bytes, status: str) -> None:
         self._status[block] = status
+        self._storage.stage(PREFIX_STATUSES + block, status.encode())
+
+    def delete(self, block: bytes) -> None:
+        self._status.pop(block, None)
+        self._storage.stage(PREFIX_STATUSES + block, None)
 
     def get(self, block: bytes) -> str | None:
         return self._status.get(block)
@@ -145,11 +203,20 @@ class StatusesStore:
 
 
 class BlockTransactionsStore:
-    def __init__(self):
+    def __init__(self, storage: "ConsensusStorage"):
+        self._storage = storage
         self._txs: dict[bytes, list[Transaction]] = {}
 
     def insert(self, block: bytes, txs: list[Transaction]) -> None:
         self._txs[block] = txs
+        if self._storage.db is not None:
+            from kaspa_tpu.consensus import serde
+
+            self._storage.stage(PREFIX_BLOCK_TXS + block, serde.encode_txs(txs))
+
+    def delete(self, block: bytes) -> None:
+        self._txs.pop(block, None)
+        self._storage.stage(PREFIX_BLOCK_TXS + block, None)
 
     def get(self, block: bytes) -> list[Transaction]:
         return self._txs[block]
@@ -158,12 +225,55 @@ class BlockTransactionsStore:
         return block in self._txs
 
 
-@dataclass
 class ConsensusStorage:
-    """Aggregation of all stores (consensus/src/consensus/storage.rs:38-83)."""
+    """Aggregation of all stores (consensus/src/consensus/storage.rs:38-83).
 
-    headers: HeaderStore = field(default_factory=HeaderStore)
-    relations: RelationsStore = field(default_factory=RelationsStore)
-    ghostdag: GhostdagStore = field(default_factory=GhostdagStore)
-    statuses: StatusesStore = field(default_factory=StatusesStore)
-    block_transactions: BlockTransactionsStore = field(default_factory=BlockTransactionsStore)
+    With ``db`` attached (storage/kv.KvStore), mutations stage encoded ops
+    into ``pending`` and ``flush()`` commits them as one atomic batch.  The
+    mutation sites in the pipeline are exactly the reference's commit points,
+    so any prefix of flushed batches is a consistent consensus state.
+    """
+
+    def __init__(self, db=None):
+        self.db = db
+        self.pending: list[tuple[bytes, bytes | None]] = []
+        self.headers = HeaderStore(self)
+        self.relations = RelationsStore(self)
+        self.ghostdag = GhostdagStore(self)
+        self.statuses = StatusesStore(self)
+        self.block_transactions = BlockTransactionsStore(self)
+
+    def stage(self, key: bytes, value: bytes | None) -> None:
+        """Queue one write-through op (value None = delete)."""
+        if self.db is not None:
+            self.pending.append((key, value))
+
+    def put_meta(self, name: bytes, value: bytes) -> None:
+        self.stage(PREFIX_META + name, value)
+
+    def get_meta(self, name: bytes) -> bytes | None:
+        if self.db is None:
+            return None
+        return self.db.engine.get(PREFIX_META + name)
+
+    def flush(self) -> None:
+        if self.db is None or not self.pending:
+            return
+        with self.db.batch() as b:
+            for key, value in self.pending:
+                if value is None:
+                    b.delete(key)
+                else:
+                    b.put(key, value)
+        self.pending.clear()
+
+    def is_initialized(self) -> bool:
+        return self.get_meta(b"init") == b"1"
+
+    def load_all(self) -> dict[bytes, dict[bytes, bytes]]:
+        """Read the whole DB grouped by prefix: {prefix: {key: value}}."""
+        assert self.db is not None
+        grouped: dict[bytes, dict[bytes, bytes]] = {}
+        for k, v in self.db.engine.items():
+            grouped.setdefault(k[:2], {})[k[2:]] = v
+        return grouped

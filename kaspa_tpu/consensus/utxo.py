@@ -82,6 +82,14 @@ class UtxoView:
     def compose(self, diff: UtxoDiff) -> "UtxoView":
         return UtxoView(self, diff)
 
+    def iter_all(self):
+        """Yield every (outpoint, entry) visible through the view."""
+        base_items = self.base.iter_all() if isinstance(self.base, UtxoView) else self.base.items()
+        for op, entry in base_items:
+            if op not in self.diff.add and op not in self.diff.remove:
+                yield op, entry
+        yield from self.diff.add.items()
+
 
 def compose(base, diff: UtxoDiff) -> UtxoView:
     return UtxoView(base, diff)

@@ -9,9 +9,13 @@ u3072.rs) + the consensus extensions (consensus/core/src/muhash.rs):
 - finalize = normalize (denominator inverse) -> 384-byte LE ->
   Blake2b("MuHashFinalize")
 
-The host object keeps exact python-int accumulators (cheap at 3072 bits);
-bulk diffs route through the TPU tree-product kernel (ops/muhash_ops.py)
-whose result combines into the accumulator with one multiply.
+The host object keeps exact python-int accumulators (cheap at 3072 bits).
+Bulk diffs — ``add_transactions_batch``, the call the consensus virtual
+processor makes per mergeset — derive all element preimages at once
+(native-vectorised ChaCha20) and, above ``DEVICE_BATCH_THRESHOLD``
+elements, reduce the products through the device U3072 tree-product kernel
+(ops/muhash_ops.batch_product_ints); the two bulk products (numerator /
+denominator) each combine into the accumulator with one host multiply.
 """
 
 from __future__ import annotations
@@ -32,10 +36,51 @@ def element_hashes_to_ints(hashes: np.ndarray) -> list[int]:
 
 
 def data_to_element(data: bytes) -> int:
-    hasher = h.MuHashElementHash()
-    hasher.update(data)
-    digest = np.frombuffer(hasher.digest(), dtype=np.uint8).reshape(1, 32)
-    return element_hashes_to_ints(digest)[0]
+    return element_hashes_to_ints(_digests([data]))[0]
+
+
+def _digests(preimages: list[bytes]) -> np.ndarray:
+    """[N, 32] uint8 MuHashElement digests of the preimages."""
+    out = np.empty((len(preimages), 32), dtype=np.uint8)
+    for i, p in enumerate(preimages):
+        hasher = h.MuHashElementHash()
+        hasher.update(p)
+        out[i] = np.frombuffer(hasher.digest(), dtype=np.uint8)
+    return out
+
+
+# Bulk products with at least this many elements go through the device
+# tree-product kernel; smaller ones multiply on host (dispatch overhead of a
+# padded 64-wide bucket isn't worth it below this).
+DEVICE_BATCH_THRESHOLD = 32
+
+
+def elements_from_preimages(preimages: list[bytes]) -> list[int]:
+    """Batch preimage -> field-element derivation (vectorised keystream)."""
+    if not preimages:
+        return []
+    return element_hashes_to_ints(_digests(preimages))
+
+
+def bulk_element_product(preimages: list[bytes], use_device: bool = True) -> int:
+    """Product of the field elements of `preimages` mod PRIME.
+
+    Routes through the device tree-product kernel above the threshold.  The
+    device path views the raw keystream bytes as 16-bit limbs directly —
+    values in [PRIME, 2**3072) are legal lazy-limb inputs that the kernel's
+    final canon reduces — so no per-element host bigint conversion happens."""
+    if not preimages:
+        return 1
+    if use_device and len(preimages) >= DEVICE_BATCH_THRESHOLD:
+        from kaspa_tpu.ops import muhash_ops
+
+        ks = chacha.keystream(_digests(preimages), ELEMENT_BYTE_SIZE)
+        limbs = ks.view(np.dtype("<u2")).astype(np.int32)  # [N, 192]
+        return muhash_ops.batch_product_device(limbs)
+    acc = 1
+    for e in elements_from_preimages(preimages):
+        acc = acc * e % PRIME
+    return acc
 
 
 def serialize_utxo(outpoint, entry) -> bytes:
@@ -106,21 +151,52 @@ class MuHash:
 
     def add_transaction(self, tx, utxo_entries, block_daa_score: int) -> None:
         """Remove spent entries, add created outputs (muhash.rs:16-34)."""
-        from kaspa_tpu.consensus.model import TransactionOutpoint, UtxoEntry
+        adds, removes = _tx_element_preimages(tx, utxo_entries, block_daa_score)
+        for p in removes:
+            self.remove_element(p)
+        for p in adds:
+            self.add_element(p)
 
-        tx_id = tx.id()
-        for inp, entry in zip(tx.inputs, utxo_entries):
-            self.remove_element(serialize_utxo(inp.previous_outpoint, entry))
-        for i, output in enumerate(tx.outputs):
-            outpoint = TransactionOutpoint(tx_id, i)
-            entry = UtxoEntry(
-                output.value,
-                output.script_public_key,
-                block_daa_score,
-                tx.is_coinbase(),
-                output.covenant.covenant_id if output.covenant is not None else None,
-            )
-            self.add_element(serialize_utxo(outpoint, entry))
+    def add_transactions_batch(self, items, use_device: bool = True) -> None:
+        """Bulk `add_transaction` over ``[(tx, utxo_entries, daa_score)]``.
+
+        All element preimages of the batch are derived together and the two
+        monoid products (created outputs -> numerator, spent entries ->
+        denominator) reduce through the device kernel above the threshold.
+        Equivalent to calling add_transaction per item, in any order — the
+        multiset hash is commutative (reference rayon map-reduce:
+        consensus/src/pipeline/virtual_processor/utxo_validation.rs:334-363).
+        """
+        adds: list[bytes] = []
+        removes: list[bytes] = []
+        for tx, entries, daa in items:
+            a, r = _tx_element_preimages(tx, entries, daa)
+            adds += a
+            removes += r
+        if adds:
+            self.numerator = self.numerator * bulk_element_product(adds, use_device) % PRIME
+        if removes:
+            self.denominator = self.denominator * bulk_element_product(removes, use_device) % PRIME
+
+
+def _tx_element_preimages(tx, utxo_entries, block_daa_score: int):
+    """(added_preimages, removed_preimages) for one populated transaction."""
+    from kaspa_tpu.consensus.model import TransactionOutpoint, UtxoEntry
+
+    tx_id = tx.id()
+    removes = [serialize_utxo(inp.previous_outpoint, entry) for inp, entry in zip(tx.inputs, utxo_entries)]
+    adds = []
+    for i, output in enumerate(tx.outputs):
+        outpoint = TransactionOutpoint(tx_id, i)
+        entry = UtxoEntry(
+            output.value,
+            output.script_public_key,
+            block_daa_score,
+            tx.is_coinbase(),
+            output.covenant.covenant_id if output.covenant is not None else None,
+        )
+        adds.append(serialize_utxo(outpoint, entry))
+    return adds, removes
 
 
 EMPTY_MUHASH = MuHash().finalize()

@@ -40,6 +40,12 @@ def parse_args(argv=None) -> DaemonArgs:
     p.add_argument("--bps", type=int, default=2, help="simnet blocks per second")
     p.add_argument("--utxoindex", action=argparse.BooleanOptionalAction, default=True, help="maintain the UTXO index")
     p.add_argument("--address-prefix", default="kaspasim")
+    p.add_argument(
+        "--persist",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="crash-safe consensus persistence under <appdir>/consensus.db (restart resumes)",
+    )
     return p.parse_args(argv, namespace=DaemonArgs())
 
 
@@ -69,7 +75,12 @@ class Daemon:
         self.args = args
         os.makedirs(args.appdir, exist_ok=True)
         self.params = params if params is not None else simnet_params(bps=args.bps)
-        self.consensus = Consensus(self.params)
+        self.db = None
+        if getattr(args, "persist", False):
+            from kaspa_tpu.storage.kv import KvStore
+
+            self.db = KvStore(os.path.join(args.appdir, "consensus.db"))
+        self.consensus = Consensus(self.params, db=self.db)
         self.node = Node(self.consensus, name="daemon")
         self.mining = self.node.mining
         self.utxoindex = UtxoIndex(self.consensus) if args.utxoindex else None
@@ -142,6 +153,15 @@ class Daemon:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self.db is not None:
+            # quiesce dispatch before closing the native handle: an in-flight
+            # handler finishes under the lock; later ones see db == None and
+            # stage() no-ops (server is already down, nothing new arrives)
+            with self._dispatch_lock:
+                self.consensus.storage.flush()
+                self.consensus.storage.db = None
+                self.db.close()
+                self.db = None
 
 
 def rpc_call(addr: str, method: str, params: dict | None = None, timeout: float = 30.0):

@@ -39,7 +39,9 @@ def _build_native():
     return _LIB_PATH
 
 
-_ITER_CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_void_p)
+# keys/values are raw binary (embedded NULs are the norm for hashes), so the
+# callback must take void* — c_char_p would NUL-truncate before string_at
+_ITER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p)
 
 
 class _NativeEngine:

@@ -1,0 +1,165 @@
+"""Round-trip exactness of the canonical binary codec (consensus/serde.py)."""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import serde
+from kaspa_tpu.consensus.model import (
+    ComputeCommit,
+    Covenant,
+    Header,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.consensus.stores import GhostdagData
+from kaspa_tpu.consensus.utxo import UtxoDiff
+from kaspa_tpu.crypto.muhash import MuHash
+
+
+def _rand_hash(rng):
+    return rng.randbytes(32)
+
+
+def _rand_tx(rng, version=0):
+    inputs = [
+        TransactionInput(
+            TransactionOutpoint(_rand_hash(rng), rng.randrange(2**32)),
+            rng.randbytes(rng.randrange(0, 120)),
+            rng.randrange(2**64),
+            ComputeCommit.sigops(rng.randrange(256)) if version == 0 else ComputeCommit.budget(rng.randrange(2**16)),
+        )
+        for _ in range(rng.randrange(0, 5))
+    ]
+    outputs = [
+        TransactionOutput(
+            rng.randrange(2**63),
+            ScriptPublicKey(rng.randrange(2**16), rng.randbytes(rng.randrange(0, 40))),
+            Covenant(rng.randrange(2**16), _rand_hash(rng)) if rng.random() < 0.3 else None,
+        )
+        for _ in range(rng.randrange(0, 5))
+    ]
+    return Transaction(
+        version, inputs, outputs, rng.randrange(2**64), rng.randbytes(20),
+        rng.randrange(2**32), rng.randbytes(rng.randrange(0, 60)), rng.randrange(2**32),
+    )
+
+
+def _rand_header(rng):
+    h = Header(
+        version=rng.randrange(2**16),
+        parents_by_level=[[_rand_hash(rng) for _ in range(rng.randrange(1, 4))] for _ in range(rng.randrange(1, 4))],
+        hash_merkle_root=_rand_hash(rng),
+        accepted_id_merkle_root=_rand_hash(rng),
+        utxo_commitment=_rand_hash(rng),
+        timestamp=rng.randrange(2**48),
+        bits=rng.randrange(2**32),
+        nonce=rng.randrange(2**64),
+        daa_score=rng.randrange(2**48),
+        blue_work=rng.randrange(2**192),
+        blue_score=rng.randrange(2**48),
+        pruning_point=_rand_hash(rng),
+    )
+    if rng.random() < 0.5:
+        h._hash_cache = _rand_hash(rng)
+    return h
+
+
+def test_tx_roundtrip():
+    rng = random.Random(1)
+    for i in range(50):
+        tx = _rand_tx(rng, version=i % 2)
+        assert serde.decode_tx(serde.encode_tx(tx)) == tx
+    txs = [_rand_tx(rng) for _ in range(7)]
+    assert serde.decode_txs(serde.encode_txs(txs)) == txs
+
+
+def test_header_roundtrip():
+    rng = random.Random(2)
+    for _ in range(30):
+        h = _rand_header(rng)
+        h2 = serde.decode_header(serde.encode_header(h))
+        assert h2 == h
+        assert h2._hash_cache == h._hash_cache
+
+
+def test_ghostdag_roundtrip():
+    rng = random.Random(3)
+    for _ in range(20):
+        gd = GhostdagData(
+            rng.randrange(2**48),
+            rng.randrange(2**192),
+            _rand_hash(rng),
+            [_rand_hash(rng) for _ in range(rng.randrange(1, 5))],
+            [_rand_hash(rng) for _ in range(rng.randrange(0, 3))],
+            {_rand_hash(rng): rng.randrange(40) for _ in range(rng.randrange(0, 4))},
+        )
+        assert serde.decode_ghostdag(serde.encode_ghostdag(gd)) == gd
+
+
+def test_utxo_entry_and_diff_roundtrip():
+    rng = random.Random(4)
+    for _ in range(20):
+        e = UtxoEntry(
+            rng.randrange(2**63),
+            ScriptPublicKey(0, rng.randbytes(34)),
+            rng.randrange(2**48),
+            rng.random() < 0.5,
+            _rand_hash(rng) if rng.random() < 0.3 else None,
+        )
+        assert serde.decode_utxo_entry(serde.encode_utxo_entry(e)) == e
+    diff = UtxoDiff()
+    for _ in range(9):
+        op = TransactionOutpoint(_rand_hash(rng), rng.randrange(10))
+        e = UtxoEntry(5, ScriptPublicKey(0, b"\x51"), 3, False, None)
+        (diff.add if rng.random() < 0.5 else diff.remove)[op] = e
+    d2 = serde.decode_utxo_diff(serde.encode_utxo_diff(diff))
+    assert d2.add == diff.add and d2.remove == diff.remove
+
+
+def test_outpoint_muhash_roundtrip():
+    rng = random.Random(5)
+    op = TransactionOutpoint(_rand_hash(rng), 7)
+    assert serde.decode_outpoint(serde.encode_outpoint(op)) == op
+    mh = MuHash()
+    mh.add_element(b"x")
+    mh.remove_element(b"y")
+    mh2 = serde.decode_muhash(serde.encode_muhash(mh))
+    assert mh2.numerator == mh.numerator and mh2.denominator == mh.denominator
+    assert mh2.finalize() == mh.finalize()
+
+
+def test_truncation_raises_eof():
+    rng = random.Random(6)
+    tx = _rand_tx(rng)
+    data = serde.encode_tx(tx)
+    for cut in range(len(data)):
+        with pytest.raises(EOFError):
+            serde.decode_tx(data[:cut])
+    h = _rand_header(rng)
+    hdata = serde.encode_header(h)
+    for cut in range(0, len(hdata), 7):
+        with pytest.raises(EOFError):
+            serde.decode_header(hdata[:cut])
+
+
+def test_bad_subnetwork_length_rejected_at_encode():
+    rng = random.Random(7)
+    tx = _rand_tx(rng)
+    tx.subnetwork_id = b"\x00" * 19
+    with pytest.raises(AssertionError):
+        serde.encode_tx(tx)
+
+
+def test_varint_bounds():
+    import io
+
+    w = io.BytesIO()
+    serde.write_varint(w, 2**200)
+    assert serde.read_varint(io.BytesIO(w.getvalue())) == 2**200
+    with pytest.raises(ValueError):
+        serde.write_varint(io.BytesIO(), -1)
