@@ -130,6 +130,9 @@ class Consensus:
         self.pruning_point_manager = PruningPointManager(
             params.pruning_depth, params.finality_depth, params.genesis.hash, self.storage.headers
         )
+        from kaspa_tpu.consensus.processes.pruning_processor import PruningProcessor
+
+        self.pruning_processor = PruningProcessor(self, is_archival=getattr(params, "is_archival", False))
         from kaspa_tpu.notify.notifier import ConsensusNotificationRoot
 
         self.notification_root = ConsensusNotificationRoot()
@@ -150,6 +153,7 @@ class Consensus:
         # emitted as one UtxosChanged per resolve
         self._acc_added: dict = {}
         self._acc_removed: dict = {}
+        self.reach_mergesets: dict[bytes, list[bytes]] = {}
 
         if self.storage.is_initialized():
             self._load_state()
@@ -191,7 +195,8 @@ class Consensus:
         self.storage.headers.insert(header)
         self.storage.relations.insert(g.hash, [ORIGIN])
         self.storage.ghostdag.insert(g.hash, self.ghostdag_manager.genesis_ghostdag_data())
-        self.reachability.add_block(g.hash, [ORIGIN], ORIGIN)
+        self.reachability.add_block(g.hash, ORIGIN, [], [ORIGIN])
+        self._set_reach_mergeset(g.hash, [])
         self.storage.block_transactions.insert(g.hash, genesis_txs)
         self.storage.statuses.set(g.hash, StatusesStore.STATUS_UTXO_VALID)
         self._set_multiset(g.hash, MuHash())
@@ -227,6 +232,17 @@ class Consensus:
         self.daa_excluded[block] = excluded
         if self.storage.db is not None:
             self.storage.stage(PREFIX_DAA_EXCLUDED + block, serde.encode_hash_list(sorted(excluded)))
+
+    def _set_reach_mergeset(self, block: bytes, mergeset: list[bytes]) -> None:
+        """Persist the exact mergeset registered with reachability, so the
+        load-time rebuild replays identical FCS state even after pruning
+        filtered the ghostdag data (the blues[0]==sp invariant no longer
+        holds for blocks whose selected parent was pruned)."""
+        self.reach_mergesets[block] = mergeset
+        if self.storage.db is not None:
+            from kaspa_tpu.consensus.stores import PREFIX_REACH_MERGESET
+
+            self.storage.stage(PREFIX_REACH_MERGESET + block, serde.encode_hash_list(mergeset))
 
     def _persist_depth(self, block: bytes, mdr: bytes, fp: bytes) -> None:
         if self.storage.db is not None:
@@ -282,6 +298,11 @@ class Consensus:
         self.daa_excluded = {
             k: set(serde.decode_hash_list_bytes(v)) for k, v in grouped.get(PREFIX_DAA_EXCLUDED, {}).items()
         }
+        from kaspa_tpu.consensus.stores import PREFIX_REACH_MERGESET
+
+        self.reach_mergesets = {
+            k: serde.decode_hash_list_bytes(v) for k, v in grouped.get(PREFIX_REACH_MERGESET, {}).items()
+        }
         for k, v in grouped.get(PREFIX_DEPTH, {}).items():
             self.depth_manager.store(k, v[:32], v[32:64])
         for k, v in grouped.get(PREFIX_PRUNING_SAMPLES, {}).items():
@@ -291,28 +312,15 @@ class Consensus:
         )
         self.utxo_position = self.storage.get_meta(b"utxo_position") or self.params.genesis.hash
         self.tips = set(serde.decode_hash_list_bytes(self.storage.get_meta(b"tips")))
+        self.pruning_processor.load(grouped)
 
-        # rebuild relations (children derived) and reachability in topo order
-        indeg: dict[bytes, int] = {}
-        children: dict[bytes, list[bytes]] = {}
-        for blk, parents in parents_map.items():
-            indeg.setdefault(blk, 0)
-            for p in parents:
-                if p in parents_map:
-                    indeg[blk] = indeg.get(blk, 0) + 1
-                    children.setdefault(p, []).append(blk)
-        from collections import deque
-
-        queue = deque(sorted(b for b, d in indeg.items() if d == 0))
-        topo = []
-        while queue:
-            b = queue.popleft()
-            topo.append(b)
-            for c in sorted(children.get(b, [])):
-                indeg[c] -= 1
-                if indeg[c] == 0:
-                    queue.append(c)
-        assert len(topo) == len(parents_map), "relations cycle or missing parent"
+        # rebuild relations (children derived) and reachability.  Ascending
+        # (blue_work, hash) is a total topological order of the DAG — every
+        # ancestor has strictly smaller blue work — and unlike a Kahn walk
+        # over relations it stays valid when pruning removed intermediate
+        # blocks (a kept block's mergeset members always sort before it).
+        gd_store = self.storage.ghostdag
+        topo = sorted(parents_map, key=lambda h: (gd_store.get_blue_work(h), h))
         g = self.params.genesis.hash
         for blk in topo:
             parents = parents_map[blk]
@@ -321,9 +329,13 @@ class Consensus:
             for p in parents:
                 self.storage.relations._children.setdefault(p, []).append(blk)
             if blk == g:
-                self.reachability.add_block(blk, [ORIGIN], ORIGIN)
+                self.reachability.add_block(blk, ORIGIN, [], [ORIGIN])
             else:
-                self.reachability.add_block(blk, parents, self.storage.ghostdag.get_selected_parent(blk))
+                bgd = self.storage.ghostdag.get(blk)
+                live_parents = [p for p in parents if p in parents_map] or [bgd.selected_parent]
+                self.reachability.add_block(
+                    blk, bgd.selected_parent, self.reach_mergesets.get(blk, []), live_parents
+                )
         self._resolve_virtual()
         # the load-time resolve may reposition the UTXO set; flush that
         self.storage.flush()
@@ -410,10 +422,9 @@ class Consensus:
             raise RuleError(f"blue score mismatch {header.blue_score} != {gd.blue_score}")
         if header.blue_work != gd.blue_work:
             raise RuleError(f"blue work mismatch {header.blue_work} != {gd.blue_work}")
-        # bounded merge depth (post_pow_validation.rs check_bounded_merge_depth);
-        # the pruning point is genesis until the pruning milestone
+        # bounded merge depth (post_pow_validation.rs check_bounded_merge_depth)
         try:
-            mdr, fp = self.depth_manager.check_bounded_merge_depth(gd, self.params.genesis.hash)
+            mdr, fp = self.depth_manager.check_bounded_merge_depth(gd, self.pruning_processor.pruning_point)
         except Exception as e:
             raise RuleError(f"violating bounded merge depth: {e}") from e
 
@@ -421,7 +432,9 @@ class Consensus:
         self.storage.headers.insert(header)
         self.storage.relations.insert(block_hash, parents)
         self.storage.ghostdag.insert(block_hash, gd)
-        self.reachability.add_block(block_hash, parents, gd.selected_parent)
+        reach_mergeset = list(gd.unordered_mergeset_without_selected_parent())
+        self.reachability.add_block(block_hash, gd.selected_parent, reach_mergeset, parents)
+        self._set_reach_mergeset(block_hash, reach_mergeset)
         self._set_daa_excluded(block_hash, daa_window.mergeset_non_daa)
         self.depth_manager.store(block_hash, mdr, fp)
         self._persist_depth(block_hash, mdr, fp)
@@ -532,6 +545,9 @@ class Consensus:
                 if p != ORIGIN:
                     push(p)
         assert sink is not None, "no valid sink found"
+        # advance the reachability reindex root toward the agreed chain
+        # (inquirer.rs hint_virtual_selected_parent)
+        self.reachability.hint_virtual_selected_parent(sink)
 
         # virtual parents: bounded count of chain-qualified tips, sink first
         # (pick_virtual_parents, processor.rs:1013-1146; bounded-merge checks
@@ -572,6 +588,10 @@ class Consensus:
             )
         self._acc_added = {}
         self._acc_removed = {}
+        # pruning executor: advance the pruning point + delete stale history
+        # (pipeline/pruning_processor/processor.rs worker)
+        if prev_state is not None:
+            self.pruning_processor.advance_if_possible(self.storage.ghostdag.get(sink))
 
     def _ensure_chain_utxo_valid(self, block: bytes) -> bool:
         """Verify the selected chain up to `block` is UTXO valid; disqualify on failure."""

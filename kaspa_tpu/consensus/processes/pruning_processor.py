@@ -1,0 +1,223 @@
+"""History pruning executor: advance the pruning point, maintain its UTXO
+set, and delete data below the retention root.
+
+Reference: consensus/src/pipeline/pruning_processor/processor.rs (worker /
+advance_pruning_point_if_possible / advance_pruning_utxoset / prune).  The
+reference runs this on a dedicated thread gated by a session lock; here it
+runs synchronously after virtual resolution (the single-writer engine makes
+that safe) with the same phases:
+
+1. `next_pruning_points` (pruning.rs) yields the samples to advance through;
+   the past-pruning-points index and the retention root update first.
+2. The pruning-point UTXO set advances chain-block-by-chain-block using the
+   stored UTXO diffs, then (in tests) is asserted against the pruning point
+   header's utxo_commitment.
+3. `prune` computes the keep sets — the pruning point's anticone with full
+   data, its DAA/median windows and the past-pruning-points chain with
+   headers+ghostdag only — and deletes everything else outside
+   future(pruning_point): bodies, diffs, multisets, acceptance data,
+   reachability entries, relations, statuses.  GHOSTDAG data of surviving
+   blocks is filtered so mergesets never dangle (processor.rs:336-355).
+
+Archival nodes (`is_archival`) advance the pruning point but keep history.
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.consensus.reachability import ORIGIN
+from kaspa_tpu.consensus.stores import GhostdagData, PREFIX_REACH_MERGESET
+from kaspa_tpu.consensus.utxo import UtxoCollection, apply_diff
+from kaspa_tpu.crypto.muhash import MuHash
+
+
+class PruningProcessor:
+    def __init__(self, consensus, is_archival: bool = False):
+        self.c = consensus
+        self.is_archival = is_archival
+        g = consensus.params.genesis.hash
+        self.pruning_point: bytes = g
+        self.past_pruning_points: list[bytes] = [g]
+        self.retention_period_root: bytes = g
+        # the pruning point UTXO set (pruning_meta utxo_set in the reference)
+        self.pruning_utxo_set = UtxoCollection()
+        self.pruning_utxoset_position: bytes = g
+
+    # ------------------------------------------------------------------
+    # phase 1+2: pruning point movement and UTXO set advancement
+    # ------------------------------------------------------------------
+
+    def advance_if_possible(self, sink_gd: GhostdagData) -> bool:
+        """processor.rs advance_pruning_point_if_possible; returns True if
+        the pruning point moved."""
+        new_points = self.c.pruning_point_manager.next_pruning_points(sink_gd, self.pruning_point)
+        if not new_points:
+            return False
+        self.past_pruning_points.extend(new_points)
+        old_pp = self.pruning_point
+        self.pruning_point = new_points[-1]
+        if not self.is_archival:
+            self.retention_period_root = self.pruning_point
+        self._persist_meta()
+        self._advance_pruning_utxoset(self.pruning_point)
+        if not self.is_archival:
+            self.prune(self.pruning_point, self.retention_period_root)
+        return True
+
+    def _advance_pruning_utxoset(self, new_pp: bytes) -> None:
+        from kaspa_tpu.consensus import serde
+
+        for chain_block in self.c.reachability.forward_chain_iterator(self.pruning_utxoset_position, new_pp):
+            diff = self.c.utxo_diffs[chain_block]
+            apply_diff(self.pruning_utxo_set, diff)
+            if self.c.storage.db is not None:
+                for op in diff.remove:
+                    self.c.storage.stage(b"PU" + serde.encode_outpoint(op), None)
+                for op, entry in diff.add.items():
+                    self.c.storage.stage(b"PU" + serde.encode_outpoint(op), serde.encode_utxo_entry(entry))
+            self.pruning_utxoset_position = chain_block
+        self._persist_meta()
+
+    def check_pruning_utxo_commitment(self) -> bool:
+        """Sanity: the maintained PP UTXO set matches the header commitment
+        (processor.rs assert_utxo_commitment)."""
+        ms = MuHash()
+        for op, entry in self.pruning_utxo_set.items():
+            ms.add_utxo(op, entry)
+        return ms.finalize() == self.c.storage.headers.get(self.pruning_point).utxo_commitment
+
+    # ------------------------------------------------------------------
+    # phase 3: history deletion
+    # ------------------------------------------------------------------
+
+    def _window_keep_set(self, pp: bytes) -> set[bytes]:
+        """Blocks of the pruning point's DAA + median-time windows."""
+        from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, MEDIAN_TIME_WINDOW
+
+        keep: set[bytes] = set()
+        gd = self.c.storage.ghostdag.get(pp)
+        for wt in (DIFFICULTY_WINDOW, MEDIAN_TIME_WINDOW):
+            try:
+                for item in self.c.window_manager.build_block_window(gd, wt):
+                    keep.add(item[1])
+            except Exception:  # noqa: BLE001 - insufficient window near genesis
+                pass
+        return keep
+
+    def prune(self, new_pp: bytes, retention_root: bytes) -> None:
+        c = self.c
+        reach = c.reachability
+        # full-data keep: future(pp) (incl. pp itself) and pp's anticone
+        # header+ghostdag keep: pp windows and the past pruning points chain
+        keep_headers = self._window_keep_set(new_pp) | set(self.past_pruning_points)
+        all_blocks = list(c.storage.headers._headers.keys())
+        full_delete: list[bytes] = []
+        header_only: list[bytes] = []
+        for h in all_blocks:
+            if not reach.has(h) or reach.is_dag_ancestor_of(new_pp, h):
+                continue  # in future(pp) (or already gone): keep fully
+            if not reach.is_dag_ancestor_of(h, new_pp):
+                continue  # pp anticone: keep fully (may still be merged)
+            if h in keep_headers:
+                header_only.append(h)
+            else:
+                full_delete.append(h)
+
+        delete_set = set(full_delete)
+        # drop bodies/diffs/etc. for header-only keeps too
+        for h in header_only + full_delete:
+            c.storage.block_transactions.delete(h)
+            self._del_aux(h)
+        # delete all stores + reachability for fully-pruned blocks, oldest
+        # first (reachability splices children into parents transparently)
+        full_delete.sort(key=lambda h: (c.storage.ghostdag.get_blue_work(h), h))
+        for h in full_delete:
+            reach.delete_block(h)
+            c.storage.headers.delete(h)
+            c.storage.ghostdag.delete(h)
+            c.storage.relations.delete(h)
+            c.storage.statuses.delete(h)
+            if c.reach_mergesets.pop(h, None) is not None:
+                c.storage.stage(PREFIX_REACH_MERGESET + h, None)
+        # prune tips that can never be merged by virtual (not in future(pp))
+        pruned_tips = {t for t in c.tips if t in delete_set}
+        if pruned_tips:
+            c.tips -= pruned_tips
+            c._persist_tips()
+        # filter ghostdag data of surviving blocks so mergesets never dangle
+        for h, gd in list(c.storage.ghostdag._data.items()):
+            if any(m in delete_set for m in gd.unordered_mergeset()) or gd.selected_parent in delete_set:
+                filtered = GhostdagData(
+                    gd.blue_score,
+                    gd.blue_work,
+                    ORIGIN if gd.selected_parent in delete_set else gd.selected_parent,
+                    [b for b in gd.mergeset_blues if b not in delete_set],
+                    [b for b in gd.mergeset_reds if b not in delete_set],
+                    {k: v for k, v in gd.blues_anticone_sizes.items() if k not in delete_set},
+                )
+                c.storage.ghostdag.insert(h, filtered)
+        # filter the persisted reachability mergesets the same way (the
+        # load-time rebuild replays these verbatim)
+        for h, rm in list(c.reach_mergesets.items()):
+            if any(m in delete_set for m in rm):
+                c._set_reach_mergeset(h, [m for m in rm if m not in delete_set])
+        c.storage.flush()
+
+    def _del_aux(self, h: bytes) -> None:
+        """Delete virtual-stage per-block data (diff/multiset/acceptance/...)."""
+        from kaspa_tpu.consensus.stores import (
+            PREFIX_ACCEPTANCE,
+            PREFIX_DAA_EXCLUDED,
+            PREFIX_DEPTH,
+            PREFIX_MULTISETS,
+            PREFIX_PRUNING_SAMPLES,
+            PREFIX_UTXO_DIFFS,
+        )
+
+        c = self.c
+        if c.utxo_diffs.pop(h, None) is not None:
+            c.storage.stage(PREFIX_UTXO_DIFFS + h, None)
+        if c.multisets.pop(h, None) is not None:
+            c.storage.stage(PREFIX_MULTISETS + h, None)
+        if c.acceptance_data.pop(h, None) is not None:
+            c.storage.stage(PREFIX_ACCEPTANCE + h, None)
+        if c.daa_excluded.pop(h, None) is not None:
+            c.storage.stage(PREFIX_DAA_EXCLUDED + h, None)
+        if c.depth_manager._merge_depth_root.pop(h, None) is not None:
+            c.depth_manager._finality_point.pop(h, None)
+            c.storage.stage(PREFIX_DEPTH + h, None)
+        if c.pruning_point_manager._sample_from_pov.pop(h, None) is not None:
+            c.storage.stage(PREFIX_PRUNING_SAMPLES + h, None)
+        c.window_manager._difficulty_cache.pop(h, None)
+        c.window_manager._median_cache.pop(h, None)
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+
+    def _persist_meta(self) -> None:
+        from kaspa_tpu.consensus import serde
+
+        if self.c.storage.db is None:
+            return
+        self.c.storage.put_meta(b"pruning_point", self.pruning_point)
+        self.c.storage.put_meta(b"retention_root", self.retention_period_root)
+        self.c.storage.put_meta(b"pruning_utxoset_position", self.pruning_utxoset_position)
+        self.c.storage.put_meta(b"past_pruning_points", serde.encode_hash_list(self.past_pruning_points))
+
+    def load(self, grouped: dict) -> None:
+        """Restore pruning state from a loaded DB (consensus._load_state)."""
+        from kaspa_tpu.consensus import serde
+
+        meta = self.c.storage.get_meta
+        pp = meta(b"pruning_point")
+        if pp is None:
+            return
+        self.pruning_point = pp
+        self.retention_period_root = meta(b"retention_root") or pp
+        self.pruning_utxoset_position = meta(b"pruning_utxoset_position") or pp
+        raw = meta(b"past_pruning_points")
+        if raw:
+            self.past_pruning_points = serde.decode_hash_list_bytes(raw)
+        self.pruning_utxo_set = UtxoCollection(
+            {serde.decode_outpoint(k): serde.decode_utxo_entry(v) for k, v in grouped.get(b"PU", {}).items()}
+        )

@@ -1,63 +1,132 @@
-"""DAG reachability service.
+"""DAG reachability: interval labeling with dynamic reindexing.
 
-The reference achieves O(1) `is_dag_ancestor_of` through interval labeling
-of the selected-parent tree plus future-covering sets with dynamic
-reindexing (consensus/src/processes/reachability/, 1.6k LoC).  This module
-provides the same service interface with an interned-bitset backend:
-each block's past is one python int used as a bitmask over dense block
-indices — O(1) amortised queries, O(n/64 words) per insertion, exact for
-any DAG topology.  It is the correctness-first backend sized for
-simulation/test scale; the interval-tree backend is the planned upgrade for
-unbounded chains (tracked for a later round).
+O(1) chain queries and O(log |FCS|) DAG queries at O(n) total memory — the
+algorithmic design of the reference (consensus/src/processes/reachability/:
+inquirer.rs, tree.rs, reindex.rs, interval.rs), re-implemented natively over
+dict state:
 
-Semantics mirror reachability/inquirer.rs:
-- is_dag_ancestor_of(a, b): a ∈ past(b) ∪ {b}
-- is_chain_ancestor_of(a, b): a on the selected-parent chain of b (incl. b)
+- Every block is a node of the *selected-parent tree* and owns an interval
+  ``[start, end]`` strictly inside its parent's.  ``is_chain_ancestor_of``
+  is interval containment.
+- Every block keeps a *future covering set* (FCS): an interval-ordered list
+  of the blocks that merged it (it was in their mergeset).
+  ``is_dag_ancestor_of(a, b)`` = chain containment OR binary search of
+  ``b`` among a's FCS items.
+- Intervals are allocated by halving the parent's remaining capacity; on
+  exhaustion a *reindex* reallocates a subtree, splitting capacity
+  exponentially by subtree size (GHOSTDAG growth heuristic).  Below the
+  *reindex root* (a slowly advancing chain block ``reindex_depth`` behind
+  the sink), slack is reclaimed along the chain instead of reindexing the
+  whole tree.
+
+``add_block`` takes the block's ghostdag mergeset (not its DAG parents):
+FCS registration per merged block is exactly what makes DAG queries
+complete.  ``delete_block`` (inquirer.rs delete_block) supports the pruning
+executor: child intervals are spliced into the parent so all other queries
+are preserved.
 """
 
 from __future__ import annotations
 
+from collections import deque
+
 ORIGIN = b"\xfe" * 32
+
+_U64_MAX = (1 << 64) - 1
+
+DEFAULT_REINDEX_DEPTH = 100
+DEFAULT_REINDEX_SLACK = 1 << 12
+
+
+class _I:
+    """Interval helpers over (start, end) tuples; empty iff end == start-1."""
+
+    @staticmethod
+    def size(iv):
+        return iv[1] + 1 - iv[0]
+
+    @staticmethod
+    def contains(a, b):
+        return a[0] <= b[0] and b[1] <= a[1]
+
+    @staticmethod
+    def strictly_contains(a, b):
+        return a[0] <= b[0] and b[1] < a[1]
+
+    @staticmethod
+    def split_half_left(iv):
+        left = (_I.size(iv) + 1) // 2
+        return (iv[0], iv[0] + left - 1)
+
+    @staticmethod
+    def split_exact(iv, sizes):
+        assert sum(sizes) == _I.size(iv)
+        out = []
+        start = iv[0]
+        for s in sizes:
+            out.append((start, start + s - 1))
+            start += s
+        return out
+
+    @staticmethod
+    def split_exponential(iv, sizes):
+        """Allocate each part >= sizes[i]; bias the surplus exponentially by
+        subtree size (interval.rs split_exponential)."""
+        total = _I.size(iv)
+        ssum = sum(sizes)
+        assert total >= ssum and ssum > 0
+        if total == ssum:
+            return _I.split_exact(iv, sizes)
+        remaining = total - ssum
+        total_bias = float(remaining)
+        mx = max(sizes)
+        fracs = [1.0 / (2.0 ** float(mx - s)) for s in sizes]
+        fsum = sum(fracs)
+        fracs = [f / fsum for f in fracs]
+        biased = []
+        for i, f in enumerate(fracs):
+            bias = remaining if i == len(fracs) - 1 else min(remaining, round(total_bias * f))
+            biased.append(sizes[i] + bias)
+            remaining -= bias
+        return _I.split_exact(iv, biased)
 
 
 class ReachabilityService:
-    def __init__(self):
-        self._idx: dict[bytes, int] = {}
-        self._past: list[int] = []  # bitmask over indices
-        self._chain: list[int] = []  # bitmask over selected-parent chain
-        self._bit: list[int] = []
-        # ORIGIN is the virtual genesis: every block is in its future
-        self._add(ORIGIN, [], ORIGIN)
+    def __init__(self, reindex_depth: int = DEFAULT_REINDEX_DEPTH, reindex_slack: int = DEFAULT_REINDEX_SLACK):
+        self.reindex_depth = reindex_depth
+        self.reindex_slack = reindex_slack
+        self._interval: dict[bytes, tuple[int, int]] = {ORIGIN: (1, _U64_MAX - 1)}
+        self._parent: dict[bytes, bytes | None] = {ORIGIN: None}
+        self._children: dict[bytes, list[bytes]] = {ORIGIN: []}
+        self._fcs: dict[bytes, list[bytes]] = {ORIGIN: []}
+        self._height: dict[bytes, int] = {ORIGIN: 0}
+        self._reindex_root: bytes = ORIGIN
+        # the reachability-relations store (model/stores/relations.rs kept for
+        # reachability): DAG edges, rewired on delete so the current mergeset
+        # of any remaining block is recomputable (relations.rs:53-78)
+        self._dag_parents: dict[bytes, list[bytes]] = {ORIGIN: []}
+        self._dag_children: dict[bytes, list[bytes]] = {ORIGIN: []}
 
-    def _add(self, block: bytes, parents: list[bytes], selected_parent: bytes | None):
-        assert block not in self._idx, "block already added"
-        i = len(self._past)
-        self._idx[block] = i
-        bit = 1 << i
-        self._bit.append(bit)
-        past = 0
-        for p in parents:
-            pi = self._idx[p]
-            past |= self._past[pi] | self._bit[pi]
-        self._past.append(past)
-        if selected_parent is None or selected_parent == block:
-            self._chain.append(bit)
-        else:
-            si = self._idx[selected_parent]
-            self._chain.append(self._chain[si] | bit)
-
-    def add_block(self, block: bytes, parents: list[bytes], selected_parent: bytes) -> None:
-        """Insert a block; parents must already be present."""
-        self._add(block, parents, selected_parent)
+    # ------------------------------------------------------------------
+    # queries (inquirer.rs)
+    # ------------------------------------------------------------------
 
     def has(self, block: bytes) -> bool:
-        return block in self._idx
+        return block in self._interval
+
+    def is_chain_ancestor_of(self, this: bytes, queried: bytes) -> bool:
+        """this ∈ selected-parent chain(queried) ∪ {queried}."""
+        return _I.contains(self._interval[this], self._interval[queried])
+
+    def is_strict_chain_ancestor_of(self, this: bytes, queried: bytes) -> bool:
+        return _I.strictly_contains(self._interval[this], self._interval[queried])
 
     def is_dag_ancestor_of(self, this: bytes, queried: bytes) -> bool:
-        if this == queried:
+        """queried ∈ future(this) ∪ {this}."""
+        if self.is_chain_ancestor_of(this, queried):
             return True
-        ti = self._idx[this]
-        return bool(self._past[self._idx[queried]] & self._bit[ti])
+        found, _ = self._bsearch(self._fcs[this], queried)
+        return found
 
     def is_dag_ancestor_of_any(self, this: bytes, queried_iter) -> bool:
         return any(self.is_dag_ancestor_of(this, q) for q in queried_iter)
@@ -65,7 +134,374 @@ class ReachabilityService:
     def is_any_dag_ancestor_of(self, list_iter, queried: bytes) -> bool:
         return any(self.is_dag_ancestor_of(x, queried) for x in list_iter)
 
-    def is_chain_ancestor_of(self, this: bytes, queried: bytes) -> bool:
-        """this ∈ selected-parent chain(queried) (inclusive)."""
-        ti = self._idx[this]
-        return bool(self._chain[self._idx[queried]] & self._bit[ti])
+    def get_next_chain_ancestor(self, descendant: bytes, ancestor: bytes) -> bytes:
+        """The tree child of `ancestor` on the chain of `descendant`."""
+        found, i = self._bsearch(self._children[ancestor], descendant)
+        assert found, "descendant not in ancestor's subtree"
+        return self._children[ancestor][i]
+
+    def forward_chain_iterator(self, from_block: bytes, to_block: bytes):
+        """Chain blocks from `from_block` (exclusive) down to `to_block`."""
+        cur = from_block
+        while cur != to_block:
+            cur = self.get_next_chain_ancestor(to_block, cur)
+            yield cur
+
+    def _bsearch(self, ordered: list[bytes], descendant: bytes):
+        """Binary search an interval-ordered hash list for the item whose
+        subtree contains `descendant`; returns (found, index-or-insertion)."""
+        point = self._interval[descendant][1]
+        lo, hi = 0, len(ordered)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._interval[ordered[mid]][0] <= point:
+                lo = mid + 1
+            else:
+                hi = mid
+        # candidate is the last item whose start <= point
+        if lo > 0 and self.is_chain_ancestor_of(ordered[lo - 1], descendant):
+            return True, lo - 1
+        return False, lo
+
+    # ------------------------------------------------------------------
+    # insertion (tree.rs add_tree_block + inquirer.rs add_dag_block)
+    # ------------------------------------------------------------------
+
+    def add_block(self, block: bytes, selected_parent: bytes, mergeset, parents=None) -> None:
+        """Insert `block` under `selected_parent`; register it in the FCS of
+        every mergeset block.  `mergeset` must EXCLUDE the selected parent
+        (header_processor/processor.rs:393 passes
+        unordered_mergeset_without_selected_parent) — tree containment covers
+        the chain.  `parents` (DAG parents) feed the reachability-relations
+        store that supports deletion; defaults to [selected_parent]."""
+        assert block not in self._interval, "block already added"
+        self._add_tree_block(block, selected_parent)
+        for merged in mergeset:
+            self._insert_fcs(merged, block)
+        parents = list(parents) if parents is not None else [selected_parent]
+        self._dag_parents[block] = parents
+        self._dag_children[block] = []
+        for p in parents:
+            self._dag_children.setdefault(p, []).append(block)
+
+    def _add_tree_block(self, new: bytes, parent: bytes) -> None:
+        remaining = self._remaining_after(parent)
+        self._children[parent].append(new)
+        self._parent[new] = parent
+        self._children[new] = []
+        self._fcs[new] = []
+        self._height[new] = self._height[parent] + 1
+        if _I.size(remaining) <= 0:
+            # the empty interval at the exact end of capacity: reindex relies
+            # on this position
+            self._interval[new] = remaining
+            self._reindex_intervals(new)
+        else:
+            self._interval[new] = _I.split_half_left(remaining)
+
+    def _insert_fcs(self, merged: bytes, new: bytes) -> None:
+        found, i = self._bsearch(self._fcs[merged], new)
+        assert not found, "FCS inconsistency: chain relation within mergeset"
+        self._fcs[merged].insert(i, new)
+
+    def _children_capacity(self, block: bytes):
+        iv = self._interval[block]
+        return (iv[0], iv[1] - 1)  # strict containment: keep `end` exclusive
+
+    def _remaining_before(self, block: bytes):
+        cap = self._children_capacity(block)
+        ch = self._children[block]
+        if not ch:
+            return cap
+        return (cap[0], self._interval[ch[0]][0] - 1)
+
+    def _remaining_after(self, block: bytes):
+        cap = self._children_capacity(block)
+        ch = self._children[block]
+        if not ch:
+            return cap
+        return (self._interval[ch[-1]][1] + 1, cap[1])
+
+    # ------------------------------------------------------------------
+    # reindexing (reindex.rs)
+    # ------------------------------------------------------------------
+
+    def _count_subtrees(self, block: bytes, sizes: dict[bytes, int]) -> None:
+        """Iterative subtree-size count rooted at `block` (BFS + push-up)."""
+        if block in sizes:
+            return
+        queue = deque([block])
+        counts: dict[bytes, int] = {}
+        while queue:
+            current = queue.popleft()
+            children = self._children[current]
+            if not children:
+                sizes[current] = 1
+            elif current not in sizes:
+                queue.extend(children)
+                continue
+            while current != block:
+                current = self._parent[current]
+                counts[current] = counts.get(current, 0) + 1
+                children = self._children[current]
+                if counts[current] < len(children):
+                    break
+                sizes[current] = sum(sizes[c] for c in children) + 1
+
+    def _propagate_interval(self, block: bytes, sizes: dict[bytes, int]) -> None:
+        self._count_subtrees(block, sizes)
+        queue = deque([block])
+        while queue:
+            current = queue.popleft()
+            children = self._children[current]
+            if children:
+                ivs = _I.split_exponential(self._children_capacity(current), [sizes[c] for c in children])
+                for c, iv in zip(children, ivs):
+                    self._interval[c] = iv
+                queue.extend(children)
+
+    def _reindex_intervals(self, new_child: bytes) -> None:
+        sizes: dict[bytes, int] = {}
+        current = new_child
+        while True:
+            self._count_subtrees(current, sizes)
+            if _I.size(self._interval[current]) >= sizes[current]:
+                break
+            parent = self._parent[current]
+            assert parent is not None, "over 2^64 blocks?"
+            assert current != self._reindex_root, "reindex root out of capacity"
+            if self.is_strict_chain_ancestor_of(parent, self._reindex_root):
+                # don't reindex above the root's chain: reclaim chain slack
+                self._reclaim_earlier_than_root(current, parent, sizes[current], sizes)
+                return
+            current = parent
+        self._propagate_interval(current, sizes)
+
+    def _reclaim_earlier_than_root(
+        self, allocation_block: bytes, common_ancestor: bytes, required: int, sizes: dict[bytes, int]
+    ) -> None:
+        chosen = self.get_next_chain_ancestor(self._reindex_root, common_ancestor)
+        before = self._interval[allocation_block][0] < self._interval[chosen][0]
+        slack = self.reindex_slack
+
+        if before:
+            remaining_fn, grow_alloc, shift_sibling, shrink_chain = (
+                self._remaining_before,
+                lambda iv, d: (iv[0], iv[1] + d),      # increase_end
+                lambda iv, d: (iv[0] + d, iv[1] + d),  # increase
+                lambda iv, d: (iv[0] + d, iv[1]),      # increase_start
+            )
+        else:
+            remaining_fn, grow_alloc, shift_sibling, shrink_chain = (
+                self._remaining_after,
+                lambda iv, d: (iv[0] - d, iv[1]),      # decrease_start
+                lambda iv, d: (iv[0] - d, iv[1] - d),  # decrease
+                lambda iv, d: (iv[0], iv[1] - d),      # decrease_end
+            )
+
+        def offset_siblings(current: bytes, offset: int) -> None:
+            parent = self._parent[current]
+            children = self._children[parent]
+            idx = children.index(current)
+            siblings = reversed(children[:idx]) if before else children[idx + 1 :]
+            for sib in siblings:
+                if sib == allocation_block:
+                    self._interval[sib] = grow_alloc(self._interval[sib], offset)
+                    self._propagate_interval(sib, sizes)
+                    break
+                self._interval[sib] = shift_sibling(self._interval[sib], offset)
+                self._propagate_interval(sib, sizes)
+
+        slack_sum = 0
+        path_len = 0
+        path_slack_alloc = 0
+        current = chosen
+        while True:
+            if current == self._reindex_root:
+                # the (practically unbounded) root: allocate fresh slack for
+                # the whole traversed chain
+                offset = required + slack * path_len - slack_sum
+                self._interval[current] = shrink_chain(self._interval[current], offset)
+                self._propagate_interval(current, sizes)
+                offset_siblings(current, offset)
+                path_slack_alloc = slack
+                break
+            avail = _I.size(remaining_fn(current))
+            slack_sum += avail
+            if slack_sum >= required:
+                offset = avail - (slack_sum - required)
+                self._interval[current] = shrink_chain(self._interval[current], offset)
+                offset_siblings(current, offset)
+                break
+            current = self.get_next_chain_ancestor(self._reindex_root, current)
+            path_len += 1
+
+        # walk back down toward the common ancestor, reserving path slack
+        while True:
+            current = self._parent[current]
+            if current == common_ancestor:
+                break
+            avail = _I.size(remaining_fn(current))
+            offset = avail - path_slack_alloc
+            self._interval[current] = shrink_chain(self._interval[current], offset)
+            offset_siblings(current, offset)
+
+    # ------------------------------------------------------------------
+    # reindex root advancement (tree.rs try_advancing_reindex_root)
+    # ------------------------------------------------------------------
+
+    def hint_virtual_selected_parent(self, hint: bytes) -> None:
+        current = self._reindex_root
+        ancestor, nxt = self._find_next_reindex_root(current, hint)
+        if current == nxt:
+            return
+        while ancestor != nxt:
+            child = self.get_next_chain_ancestor(nxt, ancestor)
+            self._concentrate_interval(ancestor, child, child == nxt)
+            ancestor = child
+        self._reindex_root = nxt
+
+    def _find_next_reindex_root(self, current: bytes, hint: bytes):
+        if current == hint:
+            return current, current
+        ancestor = nxt = current
+        hint_height = self._height[hint]
+        if not self.is_chain_ancestor_of(current, hint):
+            # reorg: switch chains only after a reindex_slack height gap
+            cur_height = self._height[current]
+            if hint_height < cur_height or hint_height - cur_height < self.reindex_slack:
+                return current, current
+            common = hint
+            while not self.is_chain_ancestor_of(common, current):
+                common = self._parent[common]
+            ancestor = nxt = common
+        while True:
+            child = self.get_next_chain_ancestor(hint, nxt)
+            child_height = self._height[child]
+            assert hint_height >= child_height
+            if hint_height - child_height < self.reindex_depth:
+                break
+            nxt = child
+        return ancestor, nxt
+
+    def _concentrate_interval(self, parent: bytes, child: bytes, is_final: bool) -> None:
+        children = self._children[parent]
+        idx = children.index(child)
+        before, after = children[:idx], children[idx + 1 :]
+        sizes: dict[bytes, int] = {}
+        slack = self.reindex_slack
+        piv = self._interval[parent]
+
+        sum_before = 0
+        if before:
+            for c in before:
+                self._count_subtrees(c, sizes)
+            csizes = [sizes[c] for c in before]
+            sum_before = sum(csizes)
+            tight = (piv[0] + slack, piv[0] + slack + sum_before - 1)
+            for c, iv in zip(before, _I.split_exact(tight, csizes)):
+                self._interval[c] = iv
+                self._propagate_interval(c, sizes)
+
+        sum_after = 0
+        if after:
+            for c in after:
+                self._count_subtrees(c, sizes)
+            csizes = [sizes[c] for c in after]
+            sum_after = sum(csizes)
+            tight = (piv[1] - slack - sum_after, piv[1] - slack - 1)
+            for c, iv in zip(after, _I.split_exact(tight, csizes)):
+                self._interval[c] = iv
+                self._propagate_interval(c, sizes)
+
+        allocation = (piv[0] + sum_before + slack, piv[1] - sum_after - slack - 1)
+        current = self._interval[child]
+        if is_final and not _I.contains(allocation, current):
+            # keep slack off both sides so the next advance rarely propagates
+            self._interval[child] = (allocation[0] + slack, allocation[1] - slack)
+            self._propagate_interval(child, sizes)
+        self._interval[child] = allocation
+
+    # ------------------------------------------------------------------
+    # deletion (inquirer.rs delete_block) — the pruning executor's hook
+    # ------------------------------------------------------------------
+
+    def _current_mergeset_wo_sp(self, selected_parent: bytes, parents) -> list[bytes]:
+        """Mergeset over the CURRENT (rewired) reachability relations
+        (ghostdag/mergeset.rs unordered_mergeset_without_selected_parent)."""
+        queue = deque(p for p in parents if p != selected_parent)
+        mergeset = set(queue)
+        past: set[bytes] = set()
+        while queue:
+            current = queue.popleft()
+            for parent in self._dag_parents[current]:
+                if parent in mergeset or parent in past:
+                    continue
+                if self.is_dag_ancestor_of(parent, selected_parent):
+                    past.add(parent)
+                    continue
+                mergeset.add(parent)
+                queue.append(parent)
+        return list(mergeset)
+
+    def delete_block(self, block: bytes) -> None:
+        """Remove `block` while preserving all other pairwise queries
+        (inquirer.rs delete_block + relations.rs
+        delete_reachability_relations).  Every FCS list currently holding
+        `block` — exactly its mergeset over the rewired relations — gets it
+        replaced by its tree children; DAG children inherit the needed
+        grandparents."""
+        interval = self._interval[block]
+        parent = self._parent[block]
+        children = self._children[block]  # tree children
+        dag_parents = self._dag_parents[block]
+
+        # mergeset over current relations BEFORE rewiring anything
+        mergeset = self._current_mergeset_wo_sp(parent, dag_parents)
+
+        # rewire DAG relations: each child keeps only grandparents not
+        # covered by its other parents (relations.rs:63-75)
+        for child in self._dag_children[block]:
+            other = [p for p in self._dag_parents[child] if p != block]
+            needed = [
+                gp for gp in dag_parents
+                if gp not in other and not self.is_dag_ancestor_of_any(gp, other)
+            ]
+            newp = [p for p in self._dag_parents[child] if p != block] + needed
+            self._dag_parents[child] = newp
+            for gp in needed:
+                self._dag_children.setdefault(gp, []).append(child)
+        for p in dag_parents:
+            ch = self._dag_children.get(p)
+            if ch and block in ch:
+                ch.remove(block)
+
+        # tree splice
+        siblings = self._children[parent]
+        idx = siblings.index(block)
+        siblings[idx : idx + 1] = children
+        for c in children:
+            self._parent[c] = parent
+
+        # FCS surgery: replace `block` with its tree children
+        for merged in mergeset:
+            fcs = self._fcs[merged]
+            found, i = self._bsearch(fcs, block)
+            assert found and fcs[i] == block, "FCS inconsistency during delete"
+            fcs[i : i + 1] = children
+
+        if not children:
+            if idx > 0:
+                sib = siblings[idx - 1]
+                self._interval[sib] = (self._interval[sib][0], interval[1])
+        elif len(children) == 1:
+            self._interval[children[0]] = interval
+        else:
+            first, last = children[0], children[-1]
+            self._interval[first] = (interval[0], self._interval[first][1])
+            self._interval[last] = (self._interval[last][0], interval[1])
+
+        if self._reindex_root == block:
+            self._reindex_root = parent
+        del self._interval[block], self._parent[block], self._children[block], self._fcs[block], self._height[block]
+        del self._dag_parents[block], self._dag_children[block]

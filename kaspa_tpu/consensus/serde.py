@@ -385,6 +385,24 @@ def decode_outpoint(data: bytes) -> TransactionOutpoint:
     return TransactionOutpoint(data[:32], struct.unpack("<I", data[32:36])[0])
 
 
+def encode_block(block) -> bytes:
+    w = io.BytesIO()
+    write_header(w, block.header)
+    write_varint(w, len(block.transactions))
+    for tx in block.transactions:
+        write_tx(w, tx)
+    return w.getvalue()
+
+
+def decode_block(data: bytes):
+    from kaspa_tpu.consensus.model.block import Block
+
+    r = io.BytesIO(data)
+    header = read_header(r)
+    txs = [read_tx(r) for _ in range(read_varint(r))]
+    return Block(header, txs)
+
+
 def encode_muhash(mh) -> bytes:
     """Both accumulators (normalization is deferred in consensus use)."""
     return mh.numerator.to_bytes(384, "little") + mh.denominator.to_bytes(384, "little")

@@ -31,6 +31,7 @@ PREFIX_DAA_EXCLUDED = b"DX"
 PREFIX_UTXO_SET = b"US"
 PREFIX_DEPTH = b"MD"
 PREFIX_PRUNING_SAMPLES = b"PS"
+PREFIX_REACH_MERGESET = b"RM"
 PREFIX_META = b"MT"
 
 
@@ -121,12 +122,22 @@ class RelationsStore:
             self._storage.stage(PREFIX_RELATIONS + block, serde.encode_hash_list(parents))
 
     def delete(self, block: bytes) -> None:
+        """Remove the block AND scrub it from its children's parent lists —
+        surviving blocks must never reference pruned history (the live
+        ghostdag mergeset BFS walks these lists through reachability)."""
         parents = self._parents.pop(block, [])
         for p in parents:
             ch = self._children.get(p)
             if ch and block in ch:
                 ch.remove(block)
-        self._children.pop(block, None)
+        for c in self._children.pop(block, []):
+            plist = self._parents.get(c)
+            if plist and block in plist:
+                plist.remove(block)
+                if self._storage.db is not None:
+                    from kaspa_tpu.consensus import serde
+
+                    self._storage.stage(PREFIX_RELATIONS + c, serde.encode_hash_list(plist))
         self._storage.stage(PREFIX_RELATIONS + block, None)
 
     def get_parents(self, block: bytes) -> list[bytes]:

@@ -1,3 +1,7 @@
+from kaspa_tpu.utils import jax_setup
+
+jax_setup.setup()
+
 from kaspa_tpu.node.daemon import main
 
 main()

@@ -46,6 +46,8 @@ def parse_args(argv=None) -> DaemonArgs:
         default=True,
         help="crash-safe consensus persistence under <appdir>/consensus.db (restart resumes)",
     )
+    p.add_argument("--listen", default=None, help="host:port for the P2P wire (omit to disable inbound P2P)")
+    p.add_argument("--connect", action="append", default=[], help="peer host:port to dial (repeatable); IBD runs on connect")
     return p.parse_args(argv, namespace=DaemonArgs())
 
 
@@ -85,11 +87,13 @@ class Daemon:
         self.mining = self.node.mining
         self.utxoindex = UtxoIndex(self.consensus) if args.utxoindex else None
         self.rpc = RpcCoreService(self.consensus, self.mining, self.utxoindex, args.address_prefix)
-        # consensus/mempool objects are single-writer: serialize RPC dispatch
-        # (the reference takes consensus sessions; an RW split can come later)
-        self._dispatch_lock = threading.Lock()
+        # consensus/mempool objects are single-writer: RPC dispatch and P2P
+        # reader threads all serialize through the node lock (the reference
+        # takes consensus sessions; an RW split can come later)
+        self._dispatch_lock = self.node.lock
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
+        self.p2p_server = None
 
     # --- rpc wire dispatch ---
 
@@ -146,9 +150,32 @@ class Daemon:
         self._server = srv
         self._thread = threading.Thread(target=srv.serve_forever, daemon=True)
         self._thread.start()
+        if getattr(self.args, "listen", None):
+            from kaspa_tpu.p2p.transport import P2PServer
+
+            lhost, lport = self.args.listen.rsplit(":", 1)
+            self.p2p_server = P2PServer(self.node, lhost, int(lport))
+            self.p2p_server.start()
+        for peer_addr in getattr(self.args, "connect", []) or []:
+            self.connect_peer(peer_addr)
         return f"{host}:{srv.server_address[1]}"
 
+    def connect_peer(self, address: str):
+        """Dial a peer over the wire and catch up from it (IBD)."""
+        from kaspa_tpu.p2p.transport import connect_outbound
+
+        peer = connect_outbound(self.node, address)
+        with self.node.lock:
+            self.node.ibd_from(peer)
+        return peer
+
     def stop(self) -> None:
+        if self.p2p_server is not None:
+            self.p2p_server.stop()
+            self.p2p_server = None
+        for peer in list(self.node.peers):
+            if hasattr(peer, "close"):
+                peer.close()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

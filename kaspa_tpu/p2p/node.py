@@ -37,6 +37,10 @@ MSG_IBD_BLOCKS = "ibdblocks"
 PROTOCOL_VERSION = 7
 
 
+class ProtocolError(Exception):
+    """Peer misbehavior that warrants disconnect/ban (flows ProtocolError)."""
+
+
 @dataclass
 class Peer:
     """Router endpoint for one connection (p2p/src/core/router.rs)."""
@@ -58,11 +62,16 @@ class Node:
     """A full node instance: consensus + mempool + flow handlers + hub."""
 
     def __init__(self, consensus: Consensus, name: str = "node"):
+        import threading
+
         self.name = name
         self.consensus = consensus
         self.mining = MiningManager(consensus)
-        self.peers: list[Peer] = []  # the Hub (p2p/src/core/hub.rs)
+        self.peers: list = []  # the Hub (p2p/src/core/hub.rs)
         self.orphan_blocks: dict[bytes, Block] = {}  # flowcontext/orphans.rs
+        # single-writer discipline: wire reader threads and RPC dispatch all
+        # serialize consensus/mempool access through this lock
+        self.lock = threading.RLock()
 
     # --- hub / relay (flow_context.rs on_new_block -> broadcast) ---
 
@@ -98,9 +107,23 @@ class Node:
 
     def _handle(self, peer: Peer, msg_type: str, payload) -> None:
         if msg_type == MSG_VERSION:
+            # handshake.rs: version negotiation incl. network match
+            if isinstance(payload, dict) and payload.get("network", self.consensus.params.name) != self.consensus.params.name:
+                raise ProtocolError(f"network mismatch: {payload.get('network')}")
+            if not getattr(peer, "version_sent", True):
+                # inbound wire peer: reciprocate with our own version
+                peer.version_sent = True
+                peer.send(
+                    MSG_VERSION,
+                    {"protocol_version": PROTOCOL_VERSION, "network": self.consensus.params.name, "listen_port": 0},
+                )
             peer.send(MSG_VERACK, PROTOCOL_VERSION)
         elif msg_type == MSG_VERACK:
             peer.handshaken = True
+        elif msg_type == "ping":
+            peer.send("pong", payload)
+        elif msg_type == "pong":
+            pass
         elif msg_type == MSG_INV_BLOCK:
             # blockrelay/flow.rs: request unknown relay blocks
             if not self.consensus.storage.statuses.is_valid(payload) and payload not in self.orphan_blocks:
@@ -205,6 +228,6 @@ def connect(a: Node, b: Node) -> tuple[Peer, Peer]:
     pb.remote = pa
     a.peers.append(pa)
     b.peers.append(pb)
-    pa.send(MSG_VERSION, PROTOCOL_VERSION)  # a -> b
-    pb.send(MSG_VERSION, PROTOCOL_VERSION)  # b -> a
+    pa.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": a.consensus.params.name, "listen_port": 0})
+    pb.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": b.consensus.params.name, "listen_port": 0})
     return pa, pb

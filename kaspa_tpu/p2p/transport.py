@@ -1,0 +1,154 @@
+"""P2P socket transport: framed binary messages between OS processes.
+
+The reference's connection layer (protocol/p2p/src/core/connection_handler.rs
+over tonic gRPC streams + Router per peer) as a thread-per-connection TCP
+server speaking the frames of p2p/wire.py.  The flow logic stays in
+p2p/node.Node — a WirePeer exposes the same ``send(msg_type, payload)``
+surface as the in-process Peer, so every handler runs unchanged over the
+wire.
+
+Concurrency: each connection gets a reader thread; all flow handling is
+serialized through ``node.lock`` (the node objects are single-writer, the
+discipline the reference gets from consensus sessions + the tokio runtime).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from kaspa_tpu.p2p import wire
+from kaspa_tpu.p2p.node import MSG_VERSION, PROTOCOL_VERSION, Node, ProtocolError
+
+
+class WirePeer:
+    """Router endpoint over a socket (p2p/src/core/router.rs)."""
+
+    def __init__(self, node: Node, sock: socket.socket, outbound: bool):
+        self.node = node
+        self.sock = sock
+        self.outbound = outbound
+        self.version_sent = outbound  # inbound reciprocates on VERSION receipt
+        self.handshaken = False
+        self.known_blocks: set = set()
+        self.known_txs: set = set()
+        self.alive = True
+        self._send_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    def send(self, msg_type: str, payload) -> None:
+        if not self.alive:
+            return
+        frame = wire.encode_frame(msg_type, payload)
+        try:
+            with self._send_lock:
+                self.sock.sendall(frame)
+        except OSError:
+            self.close()
+
+    def _read_exactly(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _reader_loop(self) -> None:
+        try:
+            while self.alive:
+                msg_type, payload = wire.read_message(self._read_exactly)
+                with self.node.lock:
+                    self.node._handle(self, msg_type, payload)
+        except (ConnectionError, OSError):
+            pass
+        except Exception:  # noqa: BLE001 - wire boundary: malformed frames,
+            # codec decode errors, or consensus rejections from adversarial
+            # payloads all mean "drop the peer" (reference would score/ban)
+            pass
+        finally:
+            self.close()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._reader_loop, daemon=True, name="p2p-reader")
+        self._thread.start()
+
+    def close(self) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+        with self.node.lock:
+            if self in self.node.peers:
+                self.node.peers.remove(self)
+
+    def wait_handshaken(self, timeout: float = 10.0) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.handshaken:
+                return True
+            time.sleep(0.01)
+        return False
+
+
+class P2PServer:
+    """Listener accepting inbound peers (connection_handler.rs serve)."""
+
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.address = f"{host}:{self._sock.getsockname()[1]}"
+        self._accept_thread: threading.Thread | None = None
+        self._running = False
+
+    def start(self) -> None:
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True, name="p2p-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, _addr = self._sock.accept()
+            except OSError:
+                return
+            peer = WirePeer(self.node, sock, outbound=False)
+            with self.node.lock:
+                self.node.peers.append(peer)
+            peer.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect_outbound(node: Node, address: str, timeout: float = 10.0) -> WirePeer:
+    """Dial a peer, run the version/verack handshake, return the live peer."""
+    host, port = address.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.settimeout(None)
+    peer = WirePeer(node, sock, outbound=True)
+    with node.lock:
+        node.peers.append(peer)
+    peer.start()
+    peer.send(
+        MSG_VERSION,
+        {"protocol_version": PROTOCOL_VERSION, "network": node.consensus.params.name, "listen_port": 0},
+    )
+    if not peer.wait_handshaken(timeout):
+        peer.close()
+        raise ConnectionError(f"handshake with {address} timed out")
+    return peer

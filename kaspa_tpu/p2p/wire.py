@@ -1,0 +1,145 @@
+"""P2P wire codec: binary frames for every flow message.
+
+The role of `protocol/p2p/proto/{p2p,messages}.proto` + tonic framing in the
+reference, over the framework's canonical binary codec (consensus/serde.py)
+instead of protobuf.  Frame layout:
+
+    magic(2) | type(1) | payload_len(4, LE) | payload
+
+Payloads are serde-encoded.  The codec is pure (bytes in/out) so the flow
+layer and tests use it without sockets; transport.py does the socket IO.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+
+from kaspa_tpu.consensus import serde
+from kaspa_tpu.p2p.node import (
+    MSG_BLOCK,
+    MSG_IBD_BLOCKS,
+    MSG_INV_BLOCK,
+    MSG_INV_TXS,
+    MSG_REQUEST_BLOCK,
+    MSG_REQUEST_IBD_BLOCKS,
+    MSG_REQUEST_TXS,
+    MSG_TX,
+    MSG_VERACK,
+    MSG_VERSION,
+)
+
+MAGIC = b"\x4b\x54"  # "KT"
+MAX_FRAME = 1 << 30
+
+MSG_PING = "ping"
+MSG_PONG = "pong"
+
+# wire ids (stable protocol surface; gaps reserved for IBD messages)
+_TYPE_IDS = {
+    MSG_VERSION: 0,
+    MSG_VERACK: 1,
+    MSG_INV_BLOCK: 2,
+    MSG_REQUEST_BLOCK: 3,
+    MSG_BLOCK: 4,
+    MSG_INV_TXS: 5,
+    MSG_REQUEST_TXS: 6,
+    MSG_TX: 7,
+    MSG_REQUEST_IBD_BLOCKS: 8,
+    MSG_IBD_BLOCKS: 9,
+    MSG_PING: 10,
+    MSG_PONG: 11,
+}
+_TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
+
+
+def _enc_version(p) -> bytes:
+    """payload: {protocol_version, network, listen_port}"""
+    w = io.BytesIO()
+    serde.write_varint(w, p["protocol_version"])
+    serde.write_bytes(w, p["network"].encode())
+    serde.write_varint(w, p.get("listen_port", 0))
+    return w.getvalue()
+
+
+def _dec_version(data: bytes):
+    r = io.BytesIO(data)
+    return {
+        "protocol_version": serde.read_varint(r),
+        "network": serde.read_bytes(r).decode(),
+        "listen_port": serde.read_varint(r),
+    }
+
+
+def _enc_varint(v: int) -> bytes:
+    w = io.BytesIO()
+    serde.write_varint(w, v)
+    return w.getvalue()
+
+
+def _dec_varint(data: bytes) -> int:
+    return serde.read_varint(io.BytesIO(data))
+
+
+def _enc_blocks(blocks) -> bytes:
+    w = io.BytesIO()
+    serde.write_varint(w, len(blocks))
+    for b in blocks:
+        serde.write_bytes(w, serde.encode_block(b))
+    return w.getvalue()
+
+
+def _dec_blocks(data: bytes):
+    r = io.BytesIO(data)
+    return [serde.decode_block(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
+
+
+_CODECS = {
+    MSG_VERSION: (_enc_version, _dec_version),
+    MSG_VERACK: (_enc_varint, _dec_varint),
+    MSG_INV_BLOCK: (lambda h: h, lambda d: d),  # single 32-byte hash
+    MSG_REQUEST_BLOCK: (serde.encode_hash_list, serde.decode_hash_list_bytes),
+    MSG_BLOCK: (serde.encode_block, serde.decode_block),
+    MSG_INV_TXS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
+    MSG_REQUEST_TXS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
+    MSG_TX: (serde.encode_tx, serde.decode_tx),
+    MSG_REQUEST_IBD_BLOCKS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
+    MSG_IBD_BLOCKS: (_enc_blocks, _dec_blocks),
+    MSG_PING: (_enc_varint, _dec_varint),
+    MSG_PONG: (_enc_varint, _dec_varint),
+}
+
+
+class WireError(Exception):
+    pass
+
+
+def encode_frame(msg_type: str, payload) -> bytes:
+    enc, _ = _CODECS[msg_type]
+    body = enc(payload)
+    return MAGIC + bytes([_TYPE_IDS[msg_type]]) + struct.pack("<I", len(body)) + body
+
+
+def decode_frame(header: bytes) -> tuple[int, int]:
+    """7-byte frame header -> (type_id, payload_len)."""
+    if header[:2] != MAGIC:
+        raise WireError("bad magic")
+    type_id = header[2]
+    if type_id not in _TYPE_NAMES:
+        raise WireError(f"unknown message type {type_id}")
+    (plen,) = struct.unpack("<I", header[3:7])
+    if plen > MAX_FRAME:
+        raise WireError(f"oversized frame {plen}")
+    return type_id, plen
+
+
+def decode_payload(type_id: int, body: bytes):
+    name = _TYPE_NAMES[type_id]
+    _, dec = _CODECS[name]
+    return name, dec(body)
+
+
+def read_message(read_exactly) -> tuple[str, object]:
+    """Read one framed message via a `read_exactly(n) -> bytes` callable."""
+    type_id, plen = decode_frame(read_exactly(7))
+    return decode_payload(type_id, read_exactly(plen))

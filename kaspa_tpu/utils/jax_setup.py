@@ -19,6 +19,13 @@ def setup(cache_dir: str | None = None) -> None:
     _DONE = True
     import jax
 
+    # KASPA_TPU_PLATFORM=cpu forces the CPU backend even where a platform
+    # plugin self-registers at interpreter startup (the axon sitecustomize
+    # hook ignores JAX_PLATFORMS) — needed for subprocess test daemons
+    forced = os.environ.get("KASPA_TPU_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
     cache_dir = cache_dir or os.environ.get(
         "KASPA_TPU_JAX_CACHE", os.path.expanduser("~/.cache/kaspa_tpu_jax")
     )

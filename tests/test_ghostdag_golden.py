@@ -56,7 +56,7 @@ def test_ghostdag_golden(dag_file):
     storage.relations.insert(genesis, [ORIGIN])
     storage.headers.insert(_mk_header(genesis, [ORIGIN]))
     storage.ghostdag.insert(genesis, mgr.genesis_ghostdag_data())
-    reach.add_block(genesis, [ORIGIN], ORIGIN)
+    reach.add_block(genesis, ORIGIN, [], [ORIGIN])
 
     for block in test["Blocks"]:
         block_id = string_to_hash(block["ID"])
@@ -65,7 +65,7 @@ def test_ghostdag_golden(dag_file):
         storage.relations.insert(block_id, parents)
         storage.headers.insert(_mk_header(block_id, parents))
         storage.ghostdag.insert(block_id, data)
-        reach.add_block(block_id, parents, data.selected_parent)
+        reach.add_block(block_id, data.selected_parent, data.unordered_mergeset_without_selected_parent(), parents)
 
         ctx = f"{dag_file}:{block['ID']}"
         assert data.selected_parent == string_to_hash(block["ExpectedSelectedParent"]), ctx

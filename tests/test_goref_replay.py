@@ -39,12 +39,25 @@ PRUNING_DAG = os.path.join(DATA, "goref_custom_pruning_depth", "blocks.json.gz")
 
 
 @pytest.mark.skipif(not os.path.exists(PRUNING_DAG), reason="reference testdata not mounted")
-def test_goref_custom_pruning_depth_prefix():
-    """Prefix of the custom-pruning-depth DAG (tiny difficulty window: real
-    retargeting every block; txs appear from ~block 200).  The full 5000-block
-    file replays clean too but takes ~25 min of per-block CPU sig batches."""
-    consensus = replay_goref(PRUNING_DAG, limit=400)
-    assert consensus.get_virtual_daa_score() >= 380
+def test_goref_custom_pruning_depth_with_live_pruning():
+    """700-block prefix of the custom-pruning-depth DAG (pruning_depth=450,
+    finality=200): the pruning executor must advance the pruning point,
+    delete history below it, keep the PP UTXO set commitment-exact — while
+    the replay stays golden bit-for-bit.  (The full 5000-block file replays
+    clean too but takes ~25 min of per-block CPU sig batches.)"""
+    consensus = replay_goref(PRUNING_DAG, limit=700)
+    assert consensus.get_virtual_daa_score() >= 680
+    pp = consensus.pruning_processor
+    g = consensus.params.genesis.hash
+    # the pruning point moved and history was deleted
+    assert pp.pruning_point != g
+    assert len(pp.past_pruning_points) >= 2
+    assert len(consensus.storage.headers._headers) < 700
+    assert not consensus.storage.block_transactions.has(g)
+    # the maintained pruning-point UTXO set matches the header commitment
+    assert pp.check_pruning_utxo_commitment()
+    # virtual keeps working on top of the pruned DAG
+    assert consensus.storage.statuses.get(consensus.sink()) == "utxo_valid"
 
 
 @pytest.mark.skipif(not os.path.exists(TX_DAG), reason="reference testdata not mounted")

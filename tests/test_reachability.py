@@ -1,0 +1,166 @@
+"""Interval reachability vs an exact bitset oracle on randomized DAGs.
+
+Mirrors the reference's randomized DAG test strategy
+(consensus/src/processes/reachability/tests/gen.rs): generate DAGs with a
+GHOSTDAG-like selected-parent rule, insert with small reindex_depth/slack to
+force both reindex paths (subtree propagation and earlier-than-root slack
+reclamation) plus reindex-root advancement, then compare every pairwise
+chain/DAG query against the O(n^2/64) bitset oracle.
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
+
+
+class BitsetOracle:
+    """The exact (round-1) backend: past/chain bitmasks over dense indices."""
+
+    def __init__(self):
+        self._idx = {}
+        self._past = []
+        self._chain = []
+        self._add(ORIGIN, [], None)
+
+    def _add(self, block, parents, selected_parent):
+        i = len(self._past)
+        self._idx[block] = i
+        past = 0
+        for p in parents:
+            pi = self._idx[p]
+            past |= self._past[pi] | (1 << pi)
+        self._past.append(past)
+        if selected_parent is None:
+            self._chain.append(1 << i)
+        else:
+            self._chain.append(self._chain[self._idx[selected_parent]] | (1 << i))
+
+    def add_block(self, block, parents, selected_parent):
+        self._add(block, parents, selected_parent)
+
+    def is_dag_ancestor_of(self, a, b):
+        if a == b:
+            return True
+        return bool(self._past[self._idx[b]] & (1 << self._idx[a]))
+
+    def is_chain_ancestor_of(self, a, b):
+        return bool(self._chain[self._idx[b]] & (1 << self._idx[a]))
+
+
+def _mergeset(oracle: BitsetOracle, parents, selected_parent):
+    """The ghostdag mergeset WITHOUT the selected parent: blocks reachable
+    from parents but not in past(sp) ∪ {sp} (what add_block registers)."""
+    ia = oracle._idx
+    sp_i = ia[selected_parent]
+    past_sp = oracle._past[sp_i] | (1 << sp_i)
+    merged_mask = 0
+    for p in parents:
+        merged_mask |= oracle._past[ia[p]] | (1 << ia[p])
+    merged_mask &= ~past_sp
+    merged_mask &= ~(oracle._past[ia[ORIGIN]] | (1 << ia[ORIGIN]))
+    out = []
+    for blk, i in ia.items():
+        if merged_mask & (1 << i):
+            out.append(blk)
+    return out
+
+
+def _gen_dag(rng, n_blocks, max_parents=4, window=12):
+    """Random DAG: parents picked from a recent-tip window (gen.rs shape)."""
+    genesis = b"\xaa" * 32
+    blocks = [genesis]
+    parents_of = {genesis: []}
+    tips = [genesis]
+    for i in range(1, n_blocks):
+        h = i.to_bytes(32, "big")
+        k = min(len(tips), rng.randint(1, max_parents))
+        parents = rng.sample(tips, k)
+        parents_of[h] = parents
+        tips = [t for t in tips if t not in parents] + [h]
+        if len(tips) > window:
+            tips = tips[-window:]
+        blocks.append(h)
+    return blocks, parents_of
+
+
+@pytest.mark.parametrize("seed,n", [(1, 200), (2, 350), (3, 150)])
+def test_randomized_dag_matches_oracle(seed, n):
+    rng = random.Random(seed)
+    # tiny capacity parameters force frequent reindexing incl. the
+    # earlier-than-root path and root advancement
+    svc = ReachabilityService(reindex_depth=10, reindex_slack=8)
+    oracle = BitsetOracle()
+    blocks, parents_of = _gen_dag(rng, n)
+    genesis = blocks[0]
+    svc.add_block(genesis, ORIGIN, [], [ORIGIN])
+    oracle.add_block(genesis, [ORIGIN], None)
+
+    sink = genesis
+    for h in blocks[1:]:
+        parents = parents_of[h]
+        # selected parent: max "blue work" proxy = max chain length, by hash
+        sp = max(parents, key=lambda p: (oracle._past[oracle._idx[p]].bit_count(), p))
+        ms = _mergeset(oracle, parents, sp)
+        svc.add_block(h, sp, ms, parents)
+        oracle.add_block(h, parents, sp)
+        # advance the root with the heaviest tip (sink proxy)
+        if oracle._past[oracle._idx[h]].bit_count() >= oracle._past[oracle._idx[sink]].bit_count():
+            sink = h
+        svc.hint_virtual_selected_parent(sink)
+
+    # exhaustive pairwise equivalence
+    sample = blocks if len(blocks) <= 200 else rng.sample(blocks, 200)
+    for a in sample:
+        for b in sample:
+            assert svc.is_dag_ancestor_of(a, b) == oracle.is_dag_ancestor_of(a, b), (a.hex(), b.hex())
+            assert svc.is_chain_ancestor_of(a, b) == oracle.is_chain_ancestor_of(a, b), (a.hex(), b.hex())
+
+
+def test_chain_only_dag_deep():
+    """A 3000-long pure chain with tiny reindex params: interval memory must
+    stay O(n) and queries exact (the bitset backend was O(n^2) here)."""
+    svc = ReachabilityService(reindex_depth=25, reindex_slack=16)
+    prev = ORIGIN
+    chain = []
+    for i in range(1, 3000):
+        h = i.to_bytes(32, "little")
+        svc.add_block(h, prev, [], [prev])
+        svc.hint_virtual_selected_parent(h)
+        chain.append(h)
+        prev = h
+    assert svc.is_chain_ancestor_of(chain[0], chain[-1])
+    assert svc.is_chain_ancestor_of(chain[1500], chain[2500])
+    assert not svc.is_chain_ancestor_of(chain[-1], chain[0])
+    assert svc.is_dag_ancestor_of(chain[7], chain[2998])
+    # memory: every node stores one interval + empty-ish FCS
+    assert len(svc._interval) == 3000  # 2999 + ORIGIN
+
+
+def test_delete_block_preserves_queries():
+    rng = random.Random(9)
+    svc = ReachabilityService(reindex_depth=10, reindex_slack=8)
+    oracle = BitsetOracle()
+    blocks, parents_of = _gen_dag(rng, 120)
+    genesis = blocks[0]
+    svc.add_block(genesis, ORIGIN, [], [ORIGIN])
+    oracle.add_block(genesis, [ORIGIN], None)
+    for h in blocks[1:]:
+        parents = parents_of[h]
+        sp = max(parents, key=lambda p: (oracle._past[oracle._idx[p]].bit_count(), p))
+        ms = _mergeset(oracle, parents, sp)
+        svc.add_block(h, sp, ms, parents)
+        oracle.add_block(h, parents, sp)
+
+    # delete a prefix of early blocks (pruning deletes old history in
+    # ascending topological order); all queries among survivors must hold,
+    # including DAG queries that previously routed through deleted blocks
+    victims = sorted(blocks[1:25], key=lambda h: oracle._past[oracle._idx[h]].bit_count())
+    for victim in victims:
+        svc.delete_block(victim)
+    survivors = [b for b in blocks if b not in set(victims)]
+    for a in survivors:
+        for b in survivors:
+            assert svc.is_dag_ancestor_of(a, b) == oracle.is_dag_ancestor_of(a, b), (a.hex(), b.hex())
+            assert svc.is_chain_ancestor_of(a, b) == oracle.is_chain_ancestor_of(a, b), (a.hex(), b.hex())
