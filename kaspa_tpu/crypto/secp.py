@@ -19,7 +19,7 @@ import numpy as np
 from kaspa_tpu.crypto import eclib
 from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
-from kaspa_tpu.ops.secp256k1.verify import ecdsa_verify_kernel, schnorr_verify_kernel
+from kaspa_tpu.ops.secp256k1.verify import ecdsa_verify, schnorr_verify
 
 W = bi.FP.W
 _CHALLENGE_MID = hashlib.sha256(
@@ -136,7 +136,7 @@ def schnorr_verify_batch(items) -> np.ndarray:
             continue
         e = schnorr_challenge(sig[:32], pub, msg)
         batch.push(pk[0], pk[1], r, s, e)
-    return batch.run(schnorr_verify_kernel)
+    return batch.run(schnorr_verify)
 
 
 def ecdsa_verify_batch(items) -> np.ndarray:
@@ -158,4 +158,4 @@ def ecdsa_verify_batch(items) -> np.ndarray:
         u1 = z * si % eclib.N
         u2 = r * si % eclib.N
         batch.push(pk[0], pk[1], r, u1, u2)
-    return batch.run(ecdsa_verify_kernel)
+    return batch.run(ecdsa_verify)

@@ -1,0 +1,490 @@
+"""Fused Pallas TPU kernel for batched dual-scalar EC verification.
+
+The XLA formulation in ops/secp256k1/{points,verify}.py emits ~3.5k HLO ops
+per ladder window and materialises every intermediate in HBM — measured
+~0.44 ms per field mul at B=16k, entirely HBM-bound.  This kernel runs the
+WHOLE verification — P-table build, 64-window Shamir ladder, Fermat
+inversion, canonicalisation and the final affine checks — inside one
+`pallas_call`, so all limb state stays VMEM-resident across the windows
+(the round-1 handoff's top perf lever).
+
+Layout choices, dictated by TPU tiling:
+
+- Transposed limbs: device arrays are ``[limbs, batch]`` — the batch rides
+  the 128-wide lane dimension (every op vectorises across lanes), limbs sit
+  on sublanes where carry shifts are cheap static slices.
+- Radix 2**8, 32 limbs per 256-bit element (int32 carriers).  The smaller
+  radix removes the 8-bit split/recombine steps that the 2**16-radix XLA
+  path needs around every multiply: schoolbook columns bound by
+  64 * (2**9)**2 < 2**25 stay comfortably inside int32, and carry rounds
+  are plain shift/mask ops.
+- Complete Renes-Costello-Batina point formulas (same as points.py) — no
+  data-dependent branches, which is exactly what Mosaic wants.
+
+Replaces the hot loop of libsecp256k1 batch verification used by the
+reference's parallel script checks
+(consensus/src/processes/transaction_validator/tx_validation_in_utxo_context.rs:206-223,
+crypto/txscript/src/lib.rs:885-935) with a TPU-resident dataflow.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kaspa_tpu.ops import bigint as bi
+
+W8 = 32  # 8-bit limbs per 256-bit element
+BLK = 512  # batch lanes per grid step
+
+SECP_P = bi.SECP_P
+SECP_N = bi.SECP_N
+_C_P = (1 << 256) - SECP_P  # 2**32 + 977
+_C_N = (1 << 256) - SECP_N
+
+B3 = 21  # 3*b for y^2 = x^3 + 7
+
+
+def _c_digits(c: int) -> tuple[int, ...]:
+    out = []
+    while c:
+        out.append(c & 0xFF)
+        c >>= 8
+    return tuple(out)
+
+
+_C8_P = _c_digits(_C_P)
+_C8_N = _c_digits(_C_N)
+
+
+def int_to_limbs8(v: int) -> np.ndarray:
+    out = np.zeros(W8, dtype=np.int32)
+    for i in range(W8):
+        out[i] = v & 0xFF
+        v >>= 8
+    assert v == 0
+    return out
+
+
+def _m_limbs8(m: int) -> np.ndarray:
+    return int_to_limbs8(m).reshape(W8, 1)
+
+
+_MP8 = _m_limbs8(SECP_P)
+_MN8 = _m_limbs8(SECP_N)
+
+# G multiples table (1..15, entry 0 placeholder), transposed [W8, 16]
+def _gtab8():
+    from kaspa_tpu.crypto import eclib
+
+    pts = []
+    acc = None
+    for _ in range(15):
+        acc = eclib.point_add(acc, (eclib.GX, eclib.GY))
+        pts.append(acc)
+    pts = [pts[0]] + pts
+    gx = np.stack([int_to_limbs8(q[0]) for q in pts], axis=1)  # [W8, 16]
+    gy = np.stack([int_to_limbs8(q[1]) for q in pts], axis=1)
+    return gx, gy
+
+
+_GTAB8_X, _GTAB8_Y = _gtab8()
+
+# p-2 bits, MSB first (for Fermat inversion); first bit is 1
+_INV_BITS = np.array(
+    [(SECP_P - 2) >> (255 - i) & 1 for i in range(256)], dtype=np.int32
+).reshape(256, 1)
+
+
+# ---------------------------------------------------------------------------
+# transposed radix-2**8 field arithmetic on [..limbs.., lanes] int32 values
+# ---------------------------------------------------------------------------
+
+
+def _zrows(n, like):
+    return jnp.zeros((n, like.shape[-1]), dtype=jnp.int32)
+
+
+def _shift_rows(x, lo: int, hi: int):
+    """Pad x with `lo` zero rows before and `hi` after (pure concat: Mosaic
+    has no scatter, so shifted adds are built from concatenation)."""
+    parts = []
+    if lo:
+        parts.append(_zrows(lo, x))
+    parts.append(x)
+    if hi:
+        parts.append(_zrows(hi, x))
+    return jnp.concatenate(parts, axis=0) if len(parts) > 1 else x
+
+
+def _carry_round(x):
+    """One carry round; widens by one limb.  [K, L] -> [K+1, L]."""
+    limb = x & 0xFF
+    carry = x >> 8  # arithmetic shift: signed-safe
+    return _shift_rows(limb, 0, 1) + _shift_rows(carry, 1, 0)
+
+
+def _carry2(x):
+    return _carry_round(_carry_round(x))
+
+
+def _conv(a, b):
+    """Schoolbook product columns: [Ka, L] x [Kb, L] -> [Ka+Kb-1, L].
+
+    Unrolled shifted multiply-accumulate; all operands VMEM/register
+    resident inside the kernel, so the unroll is pure VPU work.
+    """
+    ka, kb = a.shape[0], b.shape[0]
+    out = jnp.zeros((ka + kb - 1, a.shape[1]), dtype=jnp.int32)
+    for i in range(ka):
+        out = out + _shift_rows(a[i : i + 1] * b, i, ka - 1 - i)
+    return out
+
+
+def _mul_c(c8: tuple, x):
+    """x * c for the special-form modulus complement c (few 8-bit digits)."""
+    k = x.shape[0]
+    nc = len(c8)
+    out = jnp.zeros((k + nc - 1, x.shape[1]), dtype=jnp.int32)
+    for j, d in enumerate(c8):
+        if d:
+            out = out + _shift_rows(x * d, j, nc - 1 - j)
+    return _carry2(out)
+
+
+def _fold(c8: tuple, x):
+    """Reduce any width to W8 limbs preserving value mod m."""
+    while x.shape[0] > W8:
+        lo, hi = x[:W8], x[W8:]
+        prod = _mul_c(c8, hi)
+        if prod.shape[0] <= W8:
+            x = lo + _shift_rows(prod, 0, W8 - prod.shape[0])
+        else:
+            x = jnp.concatenate([prod[:W8] + lo, prod[W8:]], axis=0)
+    return x
+
+
+def _tighten(c8: tuple, x):
+    return _fold(c8, _carry2(x))
+
+
+def _mul(a, b, c8=_C8_P):
+    x = _fold(c8, _carry2(_conv(a, b)))
+    return _fold(c8, _carry2(x))
+
+
+def _sqr(a, c8=_C8_P):
+    return _mul(a, a, c8)
+
+
+def _add(a, b, c8=_C8_P):
+    return _tighten(c8, a + b)
+
+
+def _sub(a, b, c8=_C8_P):
+    return _tighten(c8, a - b)
+
+
+def _mul_small(a, k: int, c8=_C8_P):
+    return _tighten(c8, a * k)
+
+
+def _neg(a, c8=_C8_P):
+    return _tighten(c8, -a)
+
+
+def _scan_carry(x):
+    """Exact carry: [W, L] lazy -> ([W, L] limbs in [0,256), [1, L] top)."""
+    carry = jnp.zeros_like(x[:1])
+    outs = []
+    for i in range(x.shape[0]):
+        v = x[i : i + 1] + carry
+        outs.append(v & 0xFF)
+        carry = v >> 8
+    return jnp.concatenate(outs, axis=0), carry
+
+
+def _cond_sub_m(m8, x):
+    d, top = _scan_carry(x - m8)
+    return jnp.where(top >= 0, d, x)
+
+
+def _canon(x, m8, c8=_C8_P):
+    """Full canonicalisation into [0, m); mirrors bigint.canon's rounds."""
+    base, t = _scan_carry(x)
+    nc = len(c8)
+    for _ in range(3):
+        corr = jnp.concatenate(
+            [t * d for d in c8] + [_zrows(W8 - nc, t)], axis=0
+        )
+        base, t = _scan_carry(base + corr)
+    out = _cond_sub_m(m8, base)
+    return _cond_sub_m(m8, out)
+
+
+def _inv(x, bits_ref):
+    """x**(p-2) via square-and-multiply over the supplied bit string."""
+
+    def body(i, acc):
+        acc = _sqr(acc)
+        withx = _mul(acc, x)
+        b = jnp.broadcast_to(bits_ref[pl.ds(i, 1), :], (1, x.shape[1]))
+        return jnp.where(b > 0, withx, acc)
+
+    return jax.lax.fori_loop(1, 256, body, x)
+
+
+# ---------------------------------------------------------------------------
+# complete projective point ops (Renes-Costello-Batina, a=0, b=7)
+# ---------------------------------------------------------------------------
+
+
+def _pt_identity(lanes):
+    zero = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    one = jnp.concatenate([jnp.ones((1, lanes), jnp.int32), zero[1:]], axis=0)
+    return (zero, one, zero)
+
+
+def _pt_double(p):
+    x, y, z = p
+    t0 = _sqr(y)
+    z3 = _mul_small(t0, 8)
+    t1 = _mul(y, z)
+    t2 = _mul_small(_sqr(z), B3)
+    x3 = _mul(t2, z3)
+    y3 = _add(t0, t2)
+    z3 = _mul(t1, z3)
+    t0 = _sub(t0, _mul_small(t2, 3))
+    y3 = _add(x3, _mul(t0, y3))
+    x3 = _mul_small(_mul(t0, _mul(x, y)), 2)
+    return (x3, y3, z3)
+
+
+def _pt_add(p, q):
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    t0 = _mul(x1, x2)
+    t1 = _mul(y1, y2)
+    t2 = _mul(z1, z2)
+    t3 = _mul(_add(x1, y1), _add(x2, y2))
+    t3 = _sub(t3, _add(t0, t1))
+    t4 = _mul(_add(y1, z1), _add(y2, z2))
+    t4 = _sub(t4, _add(t1, t2))
+    x3 = _mul(_add(x1, z1), _add(x2, z2))
+    y3 = _sub(x3, _add(t0, t2))
+    t0 = _mul_small(t0, 3)
+    t2 = _mul_small(t2, B3)
+    z3 = _add(t1, t2)
+    t1 = _sub(t1, t2)
+    y3 = _mul_small(y3, B3)
+    x3_out = _sub(_mul(t3, t1), _mul(t4, y3))
+    y3_out = _add(_mul(t1, z3), _mul(y3, t0))
+    z3_out = _add(_mul(z3, t4), _mul(t0, t3))
+    return (x3_out, y3_out, z3_out)
+
+
+def _pt_add_mixed(p, q_affine):
+    x1, y1, z1 = p
+    x2, y2 = q_affine
+    t0 = _mul(x1, x2)
+    t1 = _mul(y1, y2)
+    t3 = _mul(_add(x2, y2), _add(x1, y1))
+    t3 = _sub(t3, _add(t0, t1))
+    t4 = _add(_mul(y2, z1), y1)
+    y3 = _add(_mul(x2, z1), x1)
+    t0 = _mul_small(t0, 3)
+    t2 = _mul_small(z1, B3)
+    z3 = _add(t1, t2)
+    t1 = _sub(t1, t2)
+    y3 = _mul_small(y3, B3)
+    x3_out = _sub(_mul(t3, t1), _mul(t4, y3))
+    y3_out = _add(_mul(t1, z3), _mul(y3, t0))
+    z3_out = _add(_mul(z3, t4), _mul(t0, t3))
+    return (x3_out, y3_out, z3_out)
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _select_ptab(tabx, taby, tabz, digit):
+    """One-hot gather of per-lane table entries. digit: [1, L] int32."""
+    lanes = digit.shape[1]
+    gx = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    gy = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    gz = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    for e in range(16):
+        m = (digit == e).astype(jnp.int32)
+        gx = gx + tabx[e].reshape(W8, lanes) * m
+        gy = gy + taby[e].reshape(W8, lanes) * m
+        gz = gz + tabz[e].reshape(W8, lanes) * m
+    return gx, gy, gz
+
+
+def _select_gtab(gtx, gty, digit):
+    lanes = digit.shape[1]
+    gx = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    gy = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    for e in range(16):
+        m = (digit == e).astype(jnp.int32)  # [1, L]; sublane-broadcasts below
+        gx = gx + jnp.broadcast_to(gtx[:, e : e + 1], (W8, lanes)) * m
+        gy = gy + jnp.broadcast_to(gty[:, e : e + 1], (W8, lanes)) * m
+    return gx, gy
+
+
+def _verify_kernel(
+    ecdsa: bool, gtx_ref, gty_ref, mp_ref, mn_ref, bits_ref,
+    px_ref, py_ref, rc_ref, sd_ref, ed_ref, vin_ref, out_ref, tabx, taby, tabz,
+):
+    lanes = px_ref.shape[1]
+    px = px_ref[:]
+    py = py_ref[:]
+    if not ecdsa:
+        py = _neg(py)  # BIP340: R = s*G + e*(-P)
+
+    # P multiples table 0..15 (entry 0 = identity; complete adds handle it)
+    zero = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    one = jnp.concatenate([jnp.ones((1, lanes), jnp.int32), zero[1:]], axis=0)
+    tabx[0] = zero
+    taby[0] = one
+    tabz[0] = zero
+    tabx[1] = px
+    taby[1] = py
+    tabz[1] = one
+
+    def build(e, _):
+        prev = (
+            tabx[pl.ds(e - 1, 1)].reshape(W8, lanes),
+            taby[pl.ds(e - 1, 1)].reshape(W8, lanes),
+            tabz[pl.ds(e - 1, 1)].reshape(W8, lanes),
+        )
+        nx, ny, nz = _pt_add(prev, (px, py, one))
+        tabx[pl.ds(e, 1)] = nx.reshape(1, W8, lanes)
+        taby[pl.ds(e, 1)] = ny.reshape(1, W8, lanes)
+        tabz[pl.ds(e, 1)] = nz.reshape(1, W8, lanes)
+        return 0
+
+    jax.lax.fori_loop(2, 16, build, 0)
+
+    gtx = gtx_ref[:]
+    gty = gty_ref[:]
+
+    def window(w, r):
+        for _ in range(4):
+            r = _pt_double(r)
+        gd = sd_ref[pl.ds(w, 1), :]
+        gx, gy = _select_gtab(gtx, gty, gd)
+        ra = _pt_add_mixed(r, (gx, gy))
+        keep = (gd == 0).astype(jnp.int32)
+        r = tuple(a * keep + b * (1 - keep) for a, b in zip(r, ra))
+        pd = ed_ref[pl.ds(w, 1), :]
+        q = _select_ptab(tabx, taby, tabz, pd)
+        return _pt_add(r, q)
+
+    x, y, z = jax.lax.fori_loop(0, 64, window, _pt_identity(lanes))
+
+    mp = mp_ref[:]
+    zc = _canon(z, mp)
+    inf = jnp.all(zc == 0, axis=0, keepdims=True)
+    zi = _inv(z, bits_ref)
+    xa = _canon(_mul(x, zi), mp)
+    if ecdsa:
+        # x mod n: x < p < 2n, so a single conditional subtract suffices
+        xn = _cond_sub_m(mn_ref[:], xa)
+        ok = jnp.all(xn == rc_ref[:], axis=0, keepdims=True)
+    else:
+        ok = jnp.all(xa == rc_ref[:], axis=0, keepdims=True)
+        ya = _canon(_mul(y, zi), mp)
+        ok = ok & ((ya[0:1] & 1) == 0)
+    ok = ok & ~inf & (vin_ref[0:1] > 0)
+    out_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, lanes))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(n_padded: int, ecdsa: bool, interpret: bool):
+    grid = n_padded // BLK
+
+    def const_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+    limb_spec = pl.BlockSpec((W8, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    dig_spec = pl.BlockSpec((64, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    v_spec = pl.BlockSpec((8, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    call = pl.pallas_call(
+        functools.partial(_verify_kernel, ecdsa),
+        out_shape=jax.ShapeDtypeStruct((8, n_padded), jnp.int32),
+        grid=(grid,),
+        in_specs=[
+            const_spec((W8, 16)),
+            const_spec((W8, 16)),
+            const_spec((W8, 1)),
+            const_spec((W8, 1)),
+            const_spec((256, 1)),
+            limb_spec,
+            limb_spec,
+            limb_spec,
+            dig_spec,
+            dig_spec,
+            v_spec,
+        ],
+        out_specs=v_spec,
+        scratch_shapes=[
+            pltpu.VMEM((16, W8, BLK), jnp.int32),
+            pltpu.VMEM((16, W8, BLK), jnp.int32),
+            pltpu.VMEM((16, W8, BLK), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+    jitted = jax.jit(call)
+
+    def run(px8, py8, rc8, sd, ed, vin):
+        return jitted(
+            jnp.asarray(_GTAB8_X), jnp.asarray(_GTAB8_Y), jnp.asarray(_MP8),
+            jnp.asarray(_MN8), jnp.asarray(_INV_BITS), px8, py8, rc8, sd, ed, vin,
+        )
+
+    return run
+
+
+def _to_radix8_T(limbs16: np.ndarray) -> np.ndarray:
+    """Host: [B, 16] canonical 2**16-radix limbs -> [32, B] radix-2**8."""
+    a = np.asarray(limbs16, dtype=np.int32)
+    out = np.empty((W8, a.shape[0]), dtype=np.int32)
+    out[0::2] = (a & 0xFF).T
+    out[1::2] = (a >> 8).T
+    return out
+
+
+def _pad_lanes(x: np.ndarray, n: int) -> np.ndarray:
+    if x.shape[-1] == n:
+        return x
+    pad = np.zeros((*x.shape[:-1], n - x.shape[-1]), dtype=x.dtype)
+    return np.concatenate([x, pad], axis=-1)
+
+
+def verify_batch_pallas(px, py, r_canon, s_digits, e_digits, valid_in, *, ecdsa: bool, interpret: bool = False):
+    """Drop-in equivalent of the XLA verify kernels, Pallas-fused.
+
+    Host-side marshalling matches ops/secp256k1/verify.py: px/py/r_canon are
+    [B, 16] canonical 2**16-radix limb arrays, s_digits/e_digits [B, 64]
+    MSB-first 4-bit windows, valid_in [B] bool.  Returns np.ndarray [B] bool.
+    """
+    b = np.asarray(px).shape[0]
+    n = -(-b // BLK) * BLK
+    px8 = _pad_lanes(_to_radix8_T(px), n)
+    py8 = _pad_lanes(_to_radix8_T(py), n)
+    rc8 = _pad_lanes(_to_radix8_T(r_canon), n)
+    sd = _pad_lanes(np.asarray(s_digits, dtype=np.int32).T, n)
+    ed = _pad_lanes(np.asarray(e_digits, dtype=np.int32).T, n)
+    vin = _pad_lanes(
+        np.broadcast_to(np.asarray(valid_in, dtype=np.int32), (8, b)).copy(), n
+    )
+    call = _build_call(n, ecdsa, interpret)
+    out = np.asarray(call(px8, py8, rc8, sd, ed, vin))
+    return out[0, :b].astype(bool)

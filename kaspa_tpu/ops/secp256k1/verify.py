@@ -10,15 +10,43 @@ by the north star (BASELINE.json): triples in, bitmask out.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
 
 FP = bi.FP
 FN = bi.FN
+
+
+def _use_pallas() -> bool:
+    """The fused Mosaic ladder runs on real TPU backends; the XLA
+    formulation remains the portable path (CPU mesh tests, fallback)."""
+    if os.environ.get("KASPA_TPU_NO_PALLAS"):
+        return False
+    return jax.default_backend() != "cpu"
+
+
+def schnorr_verify(px, py, r_canon, s_digits, e_digits, valid_in) -> np.ndarray:
+    """Backend-dispatching batched Schnorr verify (host arrays in/out)."""
+    if _use_pallas():
+        from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
+
+        return verify_batch_pallas(px, py, r_canon, s_digits, e_digits, valid_in, ecdsa=False)
+    return np.asarray(schnorr_verify_kernel(px, py, r_canon, s_digits, e_digits, valid_in))
+
+
+def ecdsa_verify(px, py, r_n_canon, u1_digits, u2_digits, valid_in) -> np.ndarray:
+    """Backend-dispatching batched ECDSA verify (host arrays in/out)."""
+    if _use_pallas():
+        from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
+
+        return verify_batch_pallas(px, py, r_n_canon, u1_digits, u2_digits, valid_in, ecdsa=True)
+    return np.asarray(ecdsa_verify_kernel(px, py, r_n_canon, u1_digits, u2_digits, valid_in))
 
 
 @jax.jit

@@ -76,13 +76,14 @@ class Node:
     # --- hub / relay (flow_context.rs on_new_block -> broadcast) ---
 
     def broadcast_block(self, block: Block) -> None:
-        for peer in self.peers:
+        # snapshot: a failed send self-removes the peer from self.peers
+        for peer in list(self.peers):
             if block.hash not in peer.known_blocks:
                 peer.known_blocks.add(block.hash)
                 peer.send(MSG_INV_BLOCK, block.hash)
 
     def broadcast_tx(self, tx) -> None:
-        for peer in self.peers:
+        for peer in list(self.peers):
             if tx.id() not in peer.known_txs:
                 peer.known_txs.add(tx.id())
                 peer.send(MSG_INV_TXS, [tx.id()])

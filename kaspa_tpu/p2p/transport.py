@@ -7,15 +7,24 @@ p2p/node.Node — a WirePeer exposes the same ``send(msg_type, payload)``
 surface as the in-process Peer, so every handler runs unchanged over the
 wire.
 
-Concurrency: each connection gets a reader thread; all flow handling is
-serialized through ``node.lock`` (the node objects are single-writer, the
-discipline the reference gets from consensus sessions + the tokio runtime).
+Concurrency: each connection gets a reader thread and a writer thread; all
+flow handling is serialized through ``node.lock`` (the node objects are
+single-writer, the discipline the reference gets from consensus sessions +
+the tokio runtime).  Sends only *enqueue* — socket writes happen on the
+writer thread so a handler never blocks on peer backpressure while holding
+``node.lock`` (two nodes serving each other large IBD payloads would
+otherwise deadlock once both TCP buffers filled).  Mirrors the reference
+Router's bounded mpsc outgoing lane (p2p/src/core/router.rs); a peer whose
+queue overflows is dropped as too-slow.
 """
 
 from __future__ import annotations
 
+import queue
 import socket
 import threading
+
+_SEND_QUEUE_LIMIT = 4096  # frames; overflow => drop the peer (slow consumer)
 
 from kaspa_tpu.p2p import wire
 from kaspa_tpu.p2p.node import MSG_VERSION, PROTOCOL_VERSION, Node, ProtocolError
@@ -33,17 +42,29 @@ class WirePeer:
         self.known_blocks: set = set()
         self.known_txs: set = set()
         self.alive = True
-        self._send_lock = threading.Lock()
+        self._outq: queue.Queue = queue.Queue(maxsize=_SEND_QUEUE_LIMIT)
         self._thread: threading.Thread | None = None
+        self._writer: threading.Thread | None = None
 
     def send(self, msg_type: str, payload) -> None:
         if not self.alive:
             return
         frame = wire.encode_frame(msg_type, payload)
         try:
-            with self._send_lock:
+            self._outq.put_nowait(frame)
+        except queue.Full:
+            self.close()
+
+    def _writer_loop(self) -> None:
+        try:
+            while True:
+                frame = self._outq.get()
+                if frame is None:
+                    return
                 self.sock.sendall(frame)
         except OSError:
+            pass
+        finally:
             self.close()
 
     def _read_exactly(self, n: int) -> bytes:
@@ -73,11 +94,17 @@ class WirePeer:
     def start(self) -> None:
         self._thread = threading.Thread(target=self._reader_loop, daemon=True, name="p2p-reader")
         self._thread.start()
+        self._writer = threading.Thread(target=self._writer_loop, daemon=True, name="p2p-writer")
+        self._writer.start()
 
     def close(self) -> None:
         if not self.alive:
             return
         self.alive = False
+        try:
+            self._outq.put_nowait(None)  # unblock the writer thread
+        except queue.Full:
+            pass  # writer will hit the closed socket and exit
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Microbenchmarks for the secp kernel primitives on the real device.
+
+Usage: python tools/microbench.py [mul|double|add|ladder|full|int8]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from kaspa_tpu.utils import jax_setup
+
+jax_setup.setup()
+
+import jax
+import jax.numpy as jnp
+
+from kaspa_tpu.ops import bigint as bi
+from kaspa_tpu.ops.secp256k1 import points as pt
+
+FP = bi.FP
+B = 16384
+
+
+def bench(fn, args, iters=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def rand_limbs(rng, b=B):
+    # random 256-bit values (canonical-ish limbs)
+    return jnp.asarray(rng.integers(0, 1 << 16, size=(b, 16), dtype=np.int32))
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    rng = np.random.default_rng(0)
+    a = rand_limbs(rng)
+    b = rand_limbs(rng)
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    if which in ("mul", "all"):
+        f = jax.jit(lambda x, y: bi.mul(FP, x, y))
+        dt = bench(f, (a, b))
+        print(f"bi.mul        B={B}: {dt*1e3:8.3f} ms  ({B/dt/1e6:.1f} M muls/s)")
+
+    if which in ("double", "all"):
+        one = jnp.broadcast_to(jnp.asarray(FP.one), a.shape).astype(jnp.int32)
+        f = jax.jit(lambda x, y, z: pt.point_double((x, y, z)))
+        dt = bench(f, (a, b, one))
+        print(f"point_double  B={B}: {dt*1e3:8.3f} ms")
+
+    if which in ("add", "all"):
+        one = jnp.broadcast_to(jnp.asarray(FP.one), a.shape).astype(jnp.int32)
+        f = jax.jit(lambda x, y, z: pt.point_add((x, y, z), (y, x, one)))
+        dt = bench(f, (a, b, one))
+        print(f"point_add     B={B}: {dt*1e3:8.3f} ms")
+
+    if which in ("ladder", "all"):
+        dg = jnp.asarray(rng.integers(0, 16, size=(B, 64), dtype=np.int32))
+        f = jax.jit(pt.dual_scalar_mul_base)
+        t0 = time.perf_counter()
+        out = f(a, b, dg, dg)
+        jax.block_until_ready(out)
+        print(f"ladder compile+run: {time.perf_counter()-t0:.1f} s", file=sys.stderr)
+        dt = bench(f, (a, b, dg, dg), iters=3, warmup=1)
+        print(f"ladder        B={B}: {dt*1e3:8.3f} ms  ({B/dt:.0f}/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Probe: can the limb-product convolution ride the MXU?
+
+Times candidate formulations of the 256x256-bit schoolbook product at
+batch B to pick the mul engine for the secp kernel.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+from kaspa_tpu.utils import jax_setup
+
+jax_setup.setup()
+
+import jax
+import jax.numpy as jnp
+
+B = 16384
+
+
+def bench(f, args, iters=20, warmup=3):
+    g = jax.jit(f)
+    for _ in range(warmup):
+        out = g(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def onehot(k):
+    m = np.zeros((k * k, 2 * k), np.float32)
+    for i in range(k):
+        for j in range(k):
+            m[i * k + j, i + j] = 1
+    return m
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # current path: int32 8-bit split outer + onehot dot
+    a8 = jnp.asarray(rng.integers(0, 256, (B, 32), dtype=np.int32))
+    b8 = jnp.asarray(rng.integers(0, 256, (B, 32), dtype=np.int32))
+    m32 = jnp.asarray(onehot(32).astype(np.int32))
+
+    def cur(a, b, m):
+        p = (a[:, :, None] * b[:, None, :]).reshape(B, 32 * 32)
+        return jax.lax.dot_general(p, m, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+
+    print(f"int32 8bit outer+dot [B,1024]@[1024,64] : {bench(cur,(a8,b8,m32))*1e3:7.3f} ms")
+
+    # bf16 4-bit digits
+    a4 = jnp.asarray(rng.integers(0, 16, (B, 64)).astype(np.float32), dtype=jnp.bfloat16)
+    b4 = jnp.asarray(rng.integers(0, 16, (B, 64)).astype(np.float32), dtype=jnp.bfloat16)
+    m64 = jnp.asarray(onehot(64), dtype=jnp.bfloat16)
+
+    def v_bf16(a, b, m):
+        p = (a[:, :, None] * b[:, None, :]).reshape(B, 64 * 64)
+        return jax.lax.dot_general(p, m, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    print(f"bf16 4bit outer+dot [B,4096]@[4096,128] : {bench(v_bf16,(a4,b4,m64))*1e3:7.3f} ms")
+
+    # int8 4-bit signed digits
+    a4i = jnp.asarray(rng.integers(-8, 8, (B, 64), dtype=np.int8))
+    b4i = jnp.asarray(rng.integers(-8, 8, (B, 64), dtype=np.int8))
+    m64i = jnp.asarray(onehot(64).astype(np.int8))
+
+    def v_int8(a, b, m):
+        p = (a[:, :, None] * b[:, None, :]).reshape(B, 64 * 64)
+        return jax.lax.dot_general(p, m, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+
+    print(f"int8 4bit outer+dot [B,4096]@[4096,128] : {bench(v_int8,(a4i,b4i,m64i))*1e3:7.3f} ms")
+
+    # blocked int8: 4 blocks of 16 digits -> pair products through 16-wide onehot
+    m16 = jnp.asarray(onehot(16).astype(np.int8))
+
+    def v_int8_blk(a, b, m):
+        ab = a.reshape(B, 4, 16)
+        bb = b.reshape(B, 4, 16)
+        p = (ab[:, :, None, :, None] * bb[:, None, :, None, :]).reshape(B, 16, 256)
+        c = jax.lax.dot_general(p, m, (((2,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+        return c  # collection matmul omitted (cheap int32 [B,16,32]@... later)
+
+    print(f"int8 blocked [B,16,256]@[256,32]        : {bench(v_int8_blk,(a4i,b4i,m16))*1e3:7.3f} ms")
+
+    # pure outer product cost (bf16), no dot
+    def outer_only(a, b):
+        return (a[:, :, None] * b[:, None, :]).reshape(B, 64 * 64)
+
+    print(f"bf16 outer only [B,4096]                : {bench(outer_only,(a4,b4))*1e3:7.3f} ms")
+
+    # pure matmul cost (bf16) on pre-materialized p
+    p = jnp.asarray(rng.integers(0, 225, (B, 4096)).astype(np.float32), dtype=jnp.bfloat16)
+
+    def dot_only(p, m):
+        return jax.lax.dot_general(p, m, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    print(f"bf16 dot only [B,4096]@[4096,128]       : {bench(dot_only,(p,m64))*1e3:7.3f} ms")
+
+    # f32 variant of current (int32 values exact < 2^24 in f32): 8-bit digits
+    a8f = a8.astype(jnp.float32)
+    b8f = b8.astype(jnp.float32)
+    m32f = jnp.asarray(onehot(32))
+
+    def v_f32(a, b, m):
+        p = (a[:, :, None] * b[:, None, :]).reshape(B, 32 * 32)
+        return jax.lax.dot_general(p, m, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    print(f"f32 8bit outer+dot [B,1024]@[1024,64]   : {bench(v_f32,(a8f,b8f,m32f))*1e3:7.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
