@@ -130,6 +130,16 @@ class Consensus:
         self.pruning_point_manager = PruningPointManager(
             params.pruning_depth, params.finality_depth, params.genesis.hash, self.storage.headers
         )
+        from kaspa_tpu.consensus.processes.parents_builder import ParentsManager
+
+        self.storage.headers.max_block_level = params.max_block_level
+        self.parents_manager = ParentsManager(
+            params.max_block_level,
+            params.genesis.hash,
+            self.storage.headers,
+            self.reachability,
+            self.storage.relations,
+        )
         from kaspa_tpu.consensus.processes.pruning_processor import PruningProcessor
 
         self.pruning_processor = PruningProcessor(self, is_archival=getattr(params, "is_archival", False))
@@ -826,7 +836,9 @@ class Consensus:
         )
         header = Header(
             version=self.params.genesis.version,
-            parents_by_level=[list(parents)],
+            parents_by_level=self.parents_manager.calc_block_parents(
+                self.pruning_processor.pruning_point, list(parents)
+            ),
             hash_merkle_root=merkle.calc_hash_merkle_root(all_txs),
             accepted_id_merkle_root=accepted_root,
             utxo_commitment=ctx["multiset"].finalize(),

@@ -1,0 +1,110 @@
+"""Multi-level parents builder (consensus/src/processes/parents_builder.rs).
+
+Every block carries, per proof level 0..=max_block_level, the antichain
+frontier of that level's sub-DAG as seen from its direct parents.  These
+level-parents are what make headers-proof pruning possible: level-L headers
+form a sparse sub-DAG whose density halves per level, and the pruning proof
+ships only the top of each.
+
+Level of a block = max(0, max_block_level - pow_value_bits) (pow/src/lib.rs
+calc_level_from_pow): a hash that undershoots the target by k extra bits of
+zero promotes the block k levels.
+
+Algorithm (calc_block_parents): for each level, candidates are direct
+parents at that level plus the level-parents of direct parents below it;
+the kept set is the maximal antichain, computed incrementally with
+reachability queries exactly as the reference does (retain non-ancestors,
+insert if in the future of a dropped candidate or in the anticone of all).
+Candidates without reachability data (pruned history) participate with
+empty reference sets — exact for archival/non-pruned operation; the
+pruning-proof apply path supplies reachability for the proof sub-DAGs.
+"""
+
+from __future__ import annotations
+
+
+class ParentsManager:
+    def __init__(self, max_block_level: int, genesis_hash: bytes, headers_store, reachability, relations):
+        self.max_block_level = max_block_level
+        self.genesis_hash = genesis_hash
+        self.headers = headers_store
+        self.reachability = reachability
+        self.relations = relations
+
+    def parents_at_level(self, header, level: int) -> list[bytes]:
+        if not header.parents_by_level:
+            return []  # genesis
+        if level < len(header.parents_by_level):
+            return header.parents_by_level[level]
+        return [self.genesis_hash]
+
+    def calc_block_parents(self, pruning_point: bytes, direct_parents: list[bytes]) -> list[list[bytes]]:
+        headers = [self.headers.get(p) for p in direct_parents]
+        levels = [self.headers.get_block_level(p) for p in direct_parents]
+        # rotate a parent in the future of the pruning point to the front so
+        # pruned candidates are always in the past of the running candidates
+        first = next(
+            (
+                i
+                for i, p in enumerate(direct_parents)
+                if self.reachability.has(p) and self.reachability.is_dag_ancestor_of(pruning_point, p)
+            ),
+            0,
+        )
+        headers[0], headers[first] = headers[first], headers[0]
+        levels[0], levels[first] = levels[first], levels[0]
+
+        parents: list[list[bytes]] = []
+        for level in range(self.max_block_level + 1):
+            # direct parents occupying this level are mutual-anticone by
+            # validation; they are unconditional candidates
+            candidates: dict[bytes, list[bytes]] = {
+                h.hash: [h.hash] for h, lv in zip(headers, levels) if level <= lv
+            }
+            first_marker = 0
+            if not candidates:
+                # no direct parent reaches this level: the first parent's
+                # level-parents take precedence (inserted unconditionally)
+                grandparents: dict[bytes, None] = dict.fromkeys(self.parents_at_level(headers[0], level))
+                first_marker = len(grandparents)
+                for h in headers[1:]:
+                    for g in self.parents_at_level(h, level):
+                        grandparents.setdefault(g)
+            else:
+                grandparents = {}
+                for h, lv in zip(headers, levels):
+                    if level > lv:
+                        for g in self.parents_at_level(h, level):
+                            grandparents.setdefault(g)
+
+            if not candidates and first_marker == len(grandparents):
+                # all level-parents come from the single validated first
+                # parent: already an antichain, no queries needed
+                level_parents = list(grandparents)
+            else:
+                for i, parent in enumerate(grandparents):
+                    has_reach = self.reachability.has(parent)
+                    refs = [parent] if has_reach else []
+                    if i < first_marker:
+                        candidates[parent] = refs
+                        continue
+                    if not has_reach:
+                        continue
+                    before = len(candidates)
+                    candidates = {
+                        c: r
+                        for c, r in candidates.items()
+                        if not self.reachability.is_any_dag_ancestor_of(iter(r), parent)
+                    }
+                    displaced = len(candidates) < before
+                    if displaced or not any(
+                        self.reachability.is_dag_ancestor_of_any(parent, iter(r))
+                        for r in candidates.values()
+                    ):
+                        candidates[parent] = refs
+                level_parents = list(candidates)
+
+            if level > 0 and level_parents == [self.genesis_hash]:
+                break
+            parents.append(level_parents)
+        return parents

@@ -72,6 +72,8 @@ class HeaderStore:
     def __init__(self, storage: "ConsensusStorage"):
         self._storage = storage
         self._headers: dict[bytes, Header] = {}
+        self._levels: dict[bytes, int] = {}  # lazy pow-derived block levels
+        self.max_block_level = 225  # overwritten by Consensus from params
 
     def insert(self, header: Header) -> None:
         self._headers[header.hash] = header
@@ -82,6 +84,7 @@ class HeaderStore:
 
     def delete(self, block: bytes) -> None:
         self._headers.pop(block, None)
+        self._levels.pop(block, None)
         self._storage.stage(PREFIX_HEADERS + block, None)
 
     def get(self, block: bytes) -> Header:
@@ -101,6 +104,24 @@ class HeaderStore:
 
     def get_daa_score(self, block: bytes) -> int:
         return self._headers[block].daa_score
+
+    def get_block_level(self, block: bytes) -> int:
+        """Proof level from the PoW value (pow/src/lib.rs calc_block_level):
+        max(0, max_block_level - pow_bits); genesis gets the max level.
+        Lazily memoized — the heavy-hash is only paid when levels are needed
+        (parents building, proof building)."""
+        lvl = self._levels.get(block)
+        if lvl is None:
+            header = self._headers[block]
+            if not header.direct_parents():
+                lvl = self.max_block_level  # genesis carries the max level
+            else:
+                from kaspa_tpu.crypto.powhash import calc_block_pow_hash
+
+                pow_value = int.from_bytes(calc_block_pow_hash(header), "little")
+                lvl = max(0, self.max_block_level - pow_value.bit_length())
+            self._levels[block] = lvl
+        return lvl
 
 
 class RelationsStore:

@@ -53,18 +53,6 @@ def _be32_to_limbs(col, b):
     return out
 
 
-def _be32_to_digits(col, b):
-    """[N x 32-byte big-endian scalar] -> [bucket, 64] MSB-first 4-bit digits."""
-    out = np.zeros((b, pt.N_WINDOWS), np.int32)
-    if col:
-        arr = np.frombuffer(b"".join(col), dtype=np.uint8).reshape(len(col), 32)
-        digits = np.empty((len(col), 64), np.uint8)
-        digits[:, 0::2] = arr >> 4
-        digits[:, 1::2] = arr & 0x0F
-        out[: len(col)] = digits.astype(np.int32)
-    return out
-
-
 @dataclass
 class _Batch:
     """Marshals verification jobs into the device batch layout.
@@ -77,24 +65,24 @@ class _Batch:
     px: list = field(default_factory=list)  # 32B BE x-coordinates
     py: list = field(default_factory=list)
     rc: list = field(default_factory=list)  # canonical target (r or r mod n)
-    d1: list = field(default_factory=list)  # s / u1 scalars (32B BE)
-    d2: list = field(default_factory=list)  # e / u2 scalars (32B BE)
+    d1: list = field(default_factory=list)  # s / u1 scalars (python ints mod n)
+    d2: list = field(default_factory=list)  # e / u2 scalars (python ints mod n)
     ok: list = field(default_factory=list)
 
     def push_invalid(self):
         self.px.append(_ZERO32)
         self.py.append(_ZERO32)
         self.rc.append(_ZERO32)
-        self.d1.append(_ZERO32)
-        self.d2.append(_ZERO32)
+        self.d1.append(0)
+        self.d2.append(0)
         self.ok.append(False)
 
     def push(self, px: int, py: int, rc: int, s1: int, s2: int):
         self.px.append(px.to_bytes(32, "big"))
         self.py.append(py.to_bytes(32, "big"))
         self.rc.append(rc.to_bytes(32, "big"))
-        self.d1.append(s1.to_bytes(32, "big"))
-        self.d2.append(s2.to_bytes(32, "big"))
+        self.d1.append(s1)
+        self.d2.append(s2)
         self.ok.append(True)
 
     def run(self, kernel):
@@ -104,12 +92,13 @@ class _Batch:
         b = _bucket(n)
         ok = np.zeros(b, dtype=bool)
         ok[:n] = self.ok
+        pad = [0] * (b - n)
         mask = kernel(
             _be32_to_limbs(self.px, b),
             _be32_to_limbs(self.py, b),
             _be32_to_limbs(self.rc, b),
-            _be32_to_digits(self.d1, b),
-            _be32_to_digits(self.d2, b),
+            self.d1 + pad,
+            self.d2 + pad,
             ok,
         )
         return np.asarray(mask)[:n]

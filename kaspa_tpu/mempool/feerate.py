@@ -1,0 +1,96 @@
+"""Fee estimation from mempool frontier weight.
+
+Port of the reference's closed-form estimator (mining/src/feerate/mod.rs):
+the mempool is modeled as an M/D/1-style queue where a transaction paying
+feerate f waits `c1*c2/f^ALPHA + c1` seconds — c1 the amortized per-slot
+inclusion interval, c2 the total frontier weight Σ (fee/mass)^ALPHA.  The
+estimator inverts that curve at target waiting times (1 block / 1 min /
+30 min / 1 h) and samples quantiles of the integral area so clients can
+interpolate a full feerate-to-time function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+ALPHA = 3
+
+
+@dataclass(frozen=True)
+class FeerateBucket:
+    feerate: float
+    estimated_seconds: float
+
+
+@dataclass(frozen=True)
+class FeerateEstimations:
+    priority_bucket: FeerateBucket
+    normal_buckets: list[FeerateBucket]
+    low_buckets: list[FeerateBucket]
+
+    def ordered_buckets(self) -> list[FeerateBucket]:
+        return [self.priority_bucket, *self.normal_buckets, *self.low_buckets]
+
+
+@dataclass(frozen=True)
+class FeerateEstimatorArgs:
+    network_blocks_per_second: int
+    maximum_mass_per_block: int
+
+    def network_mass_per_second(self) -> int:
+        return self.network_blocks_per_second * self.maximum_mass_per_block
+
+
+class FeerateEstimator:
+    def __init__(self, total_weight: float, inclusion_interval: float, target_time_per_block_seconds: float):
+        assert total_weight >= 0.0
+        assert 0.0 <= inclusion_interval < 1.0
+        self.total_weight = total_weight
+        self.inclusion_interval = inclusion_interval
+        self.target_time_per_block_seconds = target_time_per_block_seconds
+
+    def feerate_to_time(self, feerate: float) -> float:
+        c1, c2 = self.inclusion_interval, self.total_weight
+        return c1 * c2 / feerate**ALPHA + c1
+
+    def time_to_feerate(self, time: float) -> float:
+        c1, c2 = self.inclusion_interval, self.total_weight
+        assert c1 < time
+        return ((c1 * c2 / time) / (1.0 - c1 / time)) ** (1.0 / ALPHA)
+
+    def _antiderivative(self, feerate: float) -> float:
+        c1, c2 = self.inclusion_interval, self.total_weight
+        return c1 * c2 / (-2.0 * feerate ** (ALPHA - 1))
+
+    def quantile(self, lower: float, upper: float, frac: float) -> float:
+        """Feerate where the integral area reaches `frac` of [lower, upper]."""
+        assert 0.0 <= frac <= 1.0
+        if lower == upper:
+            return lower
+        assert 0.0 < lower <= upper
+        c1, c2 = self.inclusion_interval, self.total_weight
+        if c1 == 0.0 or c2 == 0.0:
+            return lower
+        z1 = self._antiderivative(lower)
+        z2 = self._antiderivative(upper)
+        z = frac * z2 + (1.0 - frac) * z1
+        return ((c1 * c2) / (-2.0 * z)) ** (1.0 / (ALPHA - 1))
+
+    def calc_estimations(self, minimum_standard_feerate: float) -> FeerateEstimations:
+        minimum = minimum_standard_feerate
+        # `high`: expected next-block inclusion
+        high = max(self.time_to_feerate(self.target_time_per_block_seconds), minimum)
+        # `low`: sub-hour AND at least the 0.25 quantile
+        low = max(self.time_to_feerate(3600.0), self.quantile(minimum, high, 0.25))
+        # `normal`: sub-minute AND at least the 0.66 quantile between low and high
+        normal = max(self.time_to_feerate(60.0), self.quantile(low, high, 0.66))
+        # an additional interpolation point between normal and low
+        mid = max(self.time_to_feerate(1800.0), self.quantile(minimum, high, 0.5))
+        return FeerateEstimations(
+            priority_bucket=FeerateBucket(high, self.feerate_to_time(high)),
+            normal_buckets=[
+                FeerateBucket(normal, self.feerate_to_time(normal)),
+                FeerateBucket(mid, self.feerate_to_time(mid)),
+            ],
+            low_buckets=[FeerateBucket(low, self.feerate_to_time(low))],
+        )

@@ -1,0 +1,326 @@
+"""Mempool frontier: weight-augmented search tree + feerate-weighted sampling.
+
+The frontier is the set of pool transactions with no in-pool ancestors —
+the candidates for the next block template.  Port of the reference design
+(mining/src/mempool/model/frontier.rs, frontier/search_tree.rs,
+frontier/selectors.rs) with the search tree realised as a weight-augmented
+treap (the reference uses an augmented B+-tree; a treap gives the same
+O(log n) insert/remove/weighted-search/prefix-weight surface in a fraction
+of the code and is cache-friendly enough at python speed).
+
+Selection:
+- large frontiers (total mass > 4x block mass): weighted in-place sampling,
+  P(tx) ∝ (fee/mass)^ALPHA, with collision narrowing via prefix weights —
+  a template is a random sample skewed to high feerate, which spreads
+  inclusion fairly across equal-feerate txs under congestion;
+- small frontiers: exact greedy descending-feerate pack (the sampling
+  distribution's limit case; the reference's take-all/mutating-tree
+  selectors reduce to this outcome).
+
+KIP-21 subnetwork lanes are intentionally absent: the framework currently
+runs the pre-Toccata consensus ruleset (see ROADMAP), where every tx rides
+the native lane.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from kaspa_tpu.mempool.feerate import ALPHA, FeerateEstimator, FeerateEstimatorArgs
+
+COLLISION_FACTOR = 4
+MASS_LIMIT_FACTOR = 1.2
+TARGET_GAP_FACTOR = 0.05
+MAX_NULL_ATTEMPTS = 8
+INITIAL_AVG_MASS = 2036.0
+AVG_MASS_DECAY_FACTOR = 0.99999
+
+
+@dataclass(frozen=True)
+class FeerateKey:
+    """Sort key: feerate asc, txid tiebreak; weight = feerate**ALPHA."""
+
+    fee: int
+    mass: int
+    txid: bytes
+
+    @property
+    def feerate(self) -> float:
+        return self.fee / self.mass
+
+    @property
+    def weight(self) -> float:
+        return self.feerate**ALPHA
+
+    def sort_key(self) -> tuple:
+        return (self.feerate, self.txid)
+
+
+class _Node:
+    __slots__ = ("key", "prio", "left", "right", "subtree_weight", "subtree_count")
+
+    def __init__(self, key: FeerateKey, prio: float):
+        self.key = key
+        self.prio = prio
+        self.left: _Node | None = None
+        self.right: _Node | None = None
+        self.subtree_weight = key.weight
+        self.subtree_count = 1
+
+
+def _weight(n: _Node | None) -> float:
+    return n.subtree_weight if n else 0.0
+
+
+def _count(n: _Node | None) -> int:
+    return n.subtree_count if n else 0
+
+
+def _update(n: _Node) -> _Node:
+    n.subtree_weight = n.key.weight + _weight(n.left) + _weight(n.right)
+    n.subtree_count = 1 + _count(n.left) + _count(n.right)
+    return n
+
+
+class SearchTree:
+    """Weight-augmented treap over FeerateKeys (frontier/search_tree.rs)."""
+
+    def __init__(self, seed: int = 0xF0E7):
+        self._root: _Node | None = None
+        self._rng = random.Random(seed)
+        self._ids: set[bytes] = set()
+
+    def __len__(self) -> int:
+        return _count(self._root)
+
+    def __contains__(self, key: FeerateKey) -> bool:
+        return key.txid in self._ids
+
+    def total_weight(self) -> float:
+        return _weight(self._root)
+
+    # --- treap mechanics -------------------------------------------------
+
+    def _split(self, node: _Node | None, sk: tuple):
+        """(nodes with sort_key < sk, nodes with sort_key >= sk)."""
+        if node is None:
+            return None, None
+        if node.key.sort_key() < sk:
+            l, r = self._split(node.right, sk)
+            node.right = l
+            return _update(node), r
+        l, r = self._split(node.left, sk)
+        node.left = r
+        return l, _update(node)
+
+    def _merge(self, a: _Node | None, b: _Node | None) -> _Node | None:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if a.prio >= b.prio:
+            a.right = self._merge(a.right, b)
+            return _update(a)
+        b.left = self._merge(a, b.left)
+        return _update(b)
+
+    def insert(self, key: FeerateKey) -> bool:
+        if key.txid in self._ids:
+            return False
+        self._ids.add(key.txid)
+        node = _Node(key, self._rng.random())
+        l, r = self._split(self._root, key.sort_key())
+        self._root = self._merge(self._merge(l, node), r)
+        return True
+
+    def remove(self, key: FeerateKey) -> bool:
+        if key.txid not in self._ids:
+            return False
+        self._ids.discard(key.txid)
+        sk = key.sort_key()
+        l, rest = self._split(self._root, sk)
+        # rest's leftmost node is the key (sort keys are unique via txid)
+        mid, r = self._split(rest, (sk[0], sk[1] + b"\x00"))
+        assert mid is not None and mid.subtree_count == 1 and mid.key.txid == key.txid
+        self._root = self._merge(l, r)
+        return True
+
+    # --- queries ---------------------------------------------------------
+
+    def search(self, query: float) -> FeerateKey:
+        """Weighted search: the key at cumulative (ascending) weight `query`."""
+        node = self._root
+        assert node is not None
+        while True:
+            lw = _weight(node.left)
+            if query < lw and node.left is not None:
+                node = node.left
+            elif query < lw + node.key.weight or node.right is None:
+                return node.key
+            else:
+                query -= lw + node.key.weight
+                node = node.right
+
+    def prefix_weight(self, key: FeerateKey) -> float:
+        """Σ weight of keys with sort_key <= key's (log-depth exact walk)."""
+        sk = key.sort_key()
+        acc = 0.0
+        node = self._root
+        while node is not None:
+            if node.key.sort_key() <= sk:
+                acc += node.key.weight + _weight(node.left)
+                node = node.right
+            else:
+                node = node.left
+        return acc
+
+    def ascending(self):
+        stack, node = [], self._root
+        while stack or node:
+            while node:
+                stack.append(node)
+                node = node.left
+            node = stack.pop()
+            yield node.key
+            node = node.right
+
+    def descending(self):
+        stack, node = [], self._root
+        while stack or node:
+            while node:
+                stack.append(node)
+                node = node.right
+            node = stack.pop()
+            yield node.key
+            node = node.left
+
+
+class _SampleMassTracker:
+    """Stop condition for in-place sampling (frontier.rs SampleMassTracker)."""
+
+    def __init__(self, max_block_mass: int):
+        self.sampled = 0
+        self.gap = max_block_mass
+        self.desired = int(max_block_mass * MASS_LIMIT_FACTOR)
+        self.null_attempts = 0
+        self.target_gap = int(max_block_mass * TARGET_GAP_FACTOR)
+
+    def should_continue(self) -> bool:
+        return self.sampled <= self.desired or (
+            self.null_attempts < MAX_NULL_ATTEMPTS and self.gap > self.target_gap
+        )
+
+    def record(self, mass: int) -> None:
+        self.sampled += mass
+        if mass <= self.gap:
+            self.gap -= mass
+        else:
+            self.null_attempts += 1
+
+
+class Frontier:
+    """Ready-transaction frontier with weighted sampling + fee estimation."""
+
+    def __init__(self, target_time_per_block_seconds: float = 1.0):
+        self.tree = SearchTree()
+        self.total_mass = 0
+        self.average_transaction_mass = INITIAL_AVG_MASS
+        self.target_time_per_block_seconds = target_time_per_block_seconds
+
+    def __len__(self) -> int:
+        return len(self.tree)
+
+    def insert(self, key: FeerateKey) -> bool:
+        if self.tree.insert(key):
+            self.total_mass += key.mass
+            # decaying average: recent txs weigh more, history never vanishes
+            self.average_transaction_mass = (
+                self.average_transaction_mass * AVG_MASS_DECAY_FACTOR
+                + key.mass * (1.0 - AVG_MASS_DECAY_FACTOR)
+            )
+            return True
+        return False
+
+    def remove(self, key: FeerateKey) -> bool:
+        if self.tree.remove(key):
+            self.total_mass -= key.mass
+            return True
+        return False
+
+    # --- selection -------------------------------------------------------
+
+    def sample_inplace(self, rng: random.Random, max_block_mass: int) -> list[FeerateKey]:
+        """Weighted sample of ~1.2x block mass, P(tx) ∝ weight.
+
+        Collision narrowing: once the current top item has been sampled,
+        the sampling space shrinks below it via a prefix-weight bound, so
+        heavily biased weight distributions still converge in O(k log n).
+        """
+        assert len(self.tree) > 0
+        down = self.tree.descending()
+        top = next(down)
+        cache: set[bytes] = set()
+        sequence: list[FeerateKey] = []
+        tracker = _SampleMassTracker(max_block_mass)
+        space = self.tree.total_weight()
+        while len(cache) < len(self.tree) and tracker.should_continue():
+            query = rng.random() * space
+            item = self.tree.search(query)
+            exhausted = False
+            while item.txid in cache:
+                # narrow the space past any fully-sampled top run
+                if top.txid in cache:
+                    nxt = next(down, None)
+                    if nxt is None:
+                        exhausted = True
+                        break
+                    top = nxt
+                    space = self.tree.prefix_weight(top)
+                query = rng.random() * space
+                item = self.tree.search(query)
+            if exhausted:
+                break
+            cache.add(item.txid)
+            tracker.record(item.mass)
+            sequence.append(item)
+        return sequence
+
+    def select(self, rng: random.Random, max_block_mass: int) -> list[FeerateKey]:
+        """Selection order for template building (build_selector)."""
+        if len(self.tree) == 0:
+            return []
+        if self.total_mass > COLLISION_FACTOR * max_block_mass:
+            return self.sample_inplace(rng, max_block_mass)
+        return list(self.tree.descending())
+
+    # --- fee estimation --------------------------------------------------
+
+    def build_feerate_estimator(self, args: FeerateEstimatorArgs) -> FeerateEstimator:
+        """Best estimator over outlier-removal prefixes (frontier.rs:389)."""
+        avg_mass = self.average_transaction_mass
+        bps = float(args.network_blocks_per_second)
+        mass_per_block = float(args.maximum_mass_per_block)
+        inclusion_interval = avg_mass / (mass_per_block * bps)
+        estimator = FeerateEstimator(
+            self.tree.total_weight(), inclusion_interval, self.target_time_per_block_seconds
+        )
+        down = self.tree.descending()
+        current = next(down, None)
+        while current is not None:
+            # removing a top outlier consumes a block slot of its actual mass
+            mass_per_block -= current.mass
+            if mass_per_block <= avg_mass:
+                break
+            inclusion_interval = avg_mass / (mass_per_block * bps)
+            nxt = next(down, None)
+            prefix = self.tree.prefix_weight(nxt) if nxt is not None else 0.0
+            pending = FeerateEstimator(
+                prefix, inclusion_interval, self.target_time_per_block_seconds
+            )
+            if pending.feerate_to_time(1.0) < estimator.feerate_to_time(1.0):
+                estimator = pending
+            else:
+                break
+            current = nxt
+        return estimator

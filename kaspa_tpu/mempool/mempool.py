@@ -2,16 +2,21 @@
 
 Reference: mining/src/mempool/ (model/{pool,orphan_pool,frontier}.rs,
 validate_and_insert_transaction.rs, replace_by_fee.rs,
-handle_new_block_transactions.rs).  The weighted-feerate-sampling search
-tree (frontier/search_tree.rs) is modeled as a feerate-sorted greedy
-selector in this round.
+handle_new_block_transactions.rs).  Template selection and fee estimation
+ride the feerate frontier (mempool/frontier.py): ready transactions live in
+a weight-augmented search tree; large frontiers are weight-sampled, small
+ones greedily packed, and the closed-form feerate estimator is built from
+the tree's weight prefix sums.
 """
 
 from __future__ import annotations
 
+import random
 from dataclasses import dataclass, field
 
 from kaspa_tpu.consensus.model import Transaction, TransactionOutpoint
+from kaspa_tpu.mempool.feerate import FeerateEstimator, FeerateEstimatorArgs
+from kaspa_tpu.mempool.frontier import FeerateKey, Frontier
 
 
 class MempoolError(Exception):
@@ -45,12 +50,25 @@ class MempoolConfig:
 
 
 class Mempool:
-    def __init__(self, config: MempoolConfig | None = None):
+    def __init__(self, config: MempoolConfig | None = None, target_time_per_block_seconds: float = 1.0):
         self.config = config or MempoolConfig()
         self.pool: dict[bytes, MempoolTx] = {}  # txid -> entry
         self.outpoint_index: dict[TransactionOutpoint, bytes] = {}  # spent outpoint -> txid
         self.orphans: dict[bytes, MempoolTx] = {}
         self.accepted: dict[bytes, int] = {}  # txid -> daa score (LRU-ish)
+        self.frontier = Frontier(target_time_per_block_seconds)
+        self._children: dict[bytes, set[bytes]] = {}  # parent txid -> dependent txids
+        self._rng = random.Random(0xD1CE)
+
+    @staticmethod
+    def _fkey(entry: MempoolTx) -> FeerateKey:
+        return FeerateKey(entry.fee, max(entry.mass, 1), entry.tx.id())
+
+    def _is_ready(self, entry: MempoolTx) -> bool:
+        """Ready = no in-pool ancestor (frontier membership criterion)."""
+        return all(
+            inp.previous_outpoint.transaction_id not in self.pool for inp in entry.tx.inputs
+        )
 
     def __len__(self):
         return len(self.pool)
@@ -98,21 +116,43 @@ class Mempool:
         self.pool[txid] = entry
         for inp in entry.tx.inputs:
             self.outpoint_index[inp.previous_outpoint] = txid
+            parent = inp.previous_outpoint.transaction_id
+            if parent in self.pool:
+                self._children.setdefault(parent, set()).add(txid)
+        if self._is_ready(entry):
+            self.frontier.insert(self._fkey(entry))
         return evicted
 
-    def _remove(self, txid: bytes) -> None:
+    def _remove(self, txid: bytes, accepted: bool = False) -> None:
+        """Remove a tx.  If it was `accepted` its chained dependents become
+        ready (their inputs now live in the UTXO set) and join the frontier;
+        otherwise the dependents are unredeemable and are removed too
+        (remove_transaction with redeemers in the reference)."""
         entry = self.pool.pop(txid, None)
         if entry is None:
             return
+        self.frontier.remove(self._fkey(entry))
         for inp in entry.tx.inputs:
             if self.outpoint_index.get(inp.previous_outpoint) == txid:
                 del self.outpoint_index[inp.previous_outpoint]
+            kids = self._children.get(inp.previous_outpoint.transaction_id)
+            if kids is not None:
+                kids.discard(txid)
+        for child in list(self._children.pop(txid, ())):
+            centry = self.pool.get(child)
+            if centry is None:
+                continue
+            if accepted:
+                if self._is_ready(centry):
+                    self.frontier.insert(self._fkey(centry))
+            else:
+                self._remove(child, accepted=False)
 
     # --- new-block handling (handle_new_block_transactions.rs) ---
 
     def handle_accepted_transactions(self, accepted_txids: list[bytes], daa_score: int) -> None:
         for txid in accepted_txids:
-            self._remove(txid)
+            self._remove(txid, accepted=True)
             self.orphans.pop(txid, None)
             self.accepted[txid] = daa_score
         # bound the accepted cache
@@ -140,18 +180,20 @@ class Mempool:
     # --- selection (frontier.rs, selectors.rs) ---
 
     def select_transactions(self, max_count: int = 300, mass_limits=None) -> list[MempoolTx]:
-        """Feerate-descending greedy selection (frontier sampling's greedy
-        limit case) bounded by the per-dimension block mass limits; in-pool
-        dependency chains are excluded because consensus forbids chained
-        transactions within one block."""
+        """Frontier-driven template selection: weight-sampled under
+        congestion, exact greedy otherwise (frontier.select), then a
+        sequence pack bounded by the per-dimension block mass limits
+        (selectors.rs SequenceSelector).  Only frontier (ready) txs are
+        candidates, so no in-block chaining can occur."""
+        max_block_mass = mass_limits.compute if mass_limits is not None else 500_000
         chosen: list[MempoolTx] = []
-        chosen_ids: set[bytes] = set()
         compute = transient = storage = 0
-        for txid, entry in sorted(self.pool.items(), key=lambda kv: kv[1].feerate, reverse=True):
+        for key in self.frontier.select(self._rng, max_block_mass):
             if len(chosen) >= max_count:
                 break
-            if any(inp.previous_outpoint.transaction_id in chosen_ids for inp in entry.tx.inputs):
-                continue  # would chain onto an in-block parent
+            entry = self.pool.get(key.txid)
+            if entry is None:
+                continue
             if mass_limits is not None and not (
                 compute + entry.mass <= mass_limits.compute
                 and transient + entry.transient_mass <= mass_limits.transient
@@ -162,8 +204,11 @@ class Mempool:
             transient += entry.transient_mass
             storage += entry.storage_mass
             chosen.append(entry)
-            chosen_ids.add(txid)
         return chosen
+
+    def build_feerate_estimator(self, args: FeerateEstimatorArgs) -> FeerateEstimator:
+        """Fee estimator over the current frontier (get_fee_estimate RPC)."""
+        return self.frontier.build_feerate_estimator(args)
 
     # --- orphans (orphan_pool.rs) ---
 

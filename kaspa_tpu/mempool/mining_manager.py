@@ -44,8 +44,24 @@ class TemplateCache:
 class MiningManager:
     def __init__(self, consensus: Consensus, config: MempoolConfig | None = None):
         self.consensus = consensus
-        self.mempool = Mempool(config)
+        params = consensus.params
+        self.mempool = Mempool(
+            config, target_time_per_block_seconds=params.target_time_per_block / 1000.0
+        )
         self.template_cache = TemplateCache()
+
+    # --- fee estimation (manager.rs get_realtime_feerate_estimations) ---
+
+    def get_fee_estimate(self):
+        from kaspa_tpu.mempool.feerate import FeerateEstimatorArgs
+
+        params = self.consensus.params
+        args = FeerateEstimatorArgs(
+            network_blocks_per_second=max(1, round(1000 / params.target_time_per_block)),
+            maximum_mass_per_block=params.max_block_mass,
+        )
+        estimator = self.mempool.build_feerate_estimator(args)
+        return estimator.calc_estimations(minimum_standard_feerate=1.0)
 
     # --- tx intake (manager.rs:296-421) ---
 

@@ -86,7 +86,20 @@ class Daemon:
         self.node = Node(self.consensus, name="daemon")
         self.mining = self.node.mining
         self.utxoindex = UtxoIndex(self.consensus) if args.utxoindex else None
-        self.rpc = RpcCoreService(self.consensus, self.mining, self.utxoindex, args.address_prefix)
+        from kaspa_tpu.p2p.address_manager import AddressManager, ConnectionManager
+
+        self.address_manager = AddressManager()
+        self.connection_manager = ConnectionManager(self.node, self.address_manager)
+        self.rpc = RpcCoreService(
+            self.consensus,
+            self.mining,
+            self.utxoindex,
+            args.address_prefix,
+            p2p_node=self.node,
+            address_manager=self.address_manager,
+            connection_manager=self.connection_manager,
+            shutdown_fn=lambda: threading.Thread(target=self.stop, daemon=True).start(),
+        )
         # consensus/mempool objects are single-writer: RPC dispatch and P2P
         # reader threads all serialize through the node lock (the reference
         # takes consensus sessions; an RW split can come later)
@@ -108,6 +121,38 @@ class Daemon:
         "getBalanceByAddress": lambda rpc, p: rpc.get_balance_by_address(p["address"]),
         "getCoinSupply": lambda rpc, p: rpc.get_coin_supply(),
         "getMetrics": lambda rpc, p: rpc.get_metrics(),
+        "ping": lambda rpc, p: rpc.ping(),
+        "getCurrentNetwork": lambda rpc, p: rpc.get_current_network(),
+        "getInfo": lambda rpc, p: rpc.get_info(),
+        "getBlockCount": lambda rpc, p: rpc.get_block_count(),
+        "getSyncStatus": lambda rpc, p: rpc.get_sync_status(),
+        "getSystemInfo": lambda rpc, p: rpc.get_system_info(),
+        "getSink": lambda rpc, p: rpc.get_sink().hex(),
+        "getHeaders": lambda rpc, p: rpc.get_headers(
+            bytes.fromhex(p["startHash"]), p.get("limit", 100), p.get("isAscending", True)
+        ),
+        "getCurrentBlockColor": lambda rpc, p: rpc.get_current_block_color(bytes.fromhex(p["hash"])),
+        "getDaaScoreTimestampEstimate": lambda rpc, p: rpc.get_daa_score_timestamp_estimate(p["daaScores"]),
+        "estimateNetworkHashesPerSecond": lambda rpc, p: rpc.estimate_network_hashes_per_second(
+            p.get("windowSize", 1000),
+            bytes.fromhex(p["startHash"]) if p.get("startHash") else None,
+        ),
+        "getBlockRewardInfo": lambda rpc, p: rpc.get_block_reward_info(
+            bytes.fromhex(p["hash"]) if p.get("hash") else None
+        ),
+        "getFeeEstimate": lambda rpc, p: rpc.get_fee_estimate(),
+        "getFeeEstimateExperimental": lambda rpc, p: rpc.get_fee_estimate_experimental(p.get("verbose", False)),
+        "getBalancesByAddresses": lambda rpc, p: rpc.get_balances_by_addresses(p["addresses"]),
+        "getMempoolEntriesByAddresses": lambda rpc, p: rpc.get_mempool_entries_by_addresses(p["addresses"]),
+        "getConnections": lambda rpc, p: rpc.get_connections(),
+        "getConnectedPeerInfo": lambda rpc, p: rpc.get_connected_peer_info(),
+        "getPeerAddresses": lambda rpc, p: rpc.get_peer_addresses(),
+        "addPeer": lambda rpc, p: rpc.add_peer(p["address"], p.get("isPermanent", False)),
+        "ban": lambda rpc, p: rpc.ban(p["ip"]),
+        "unban": lambda rpc, p: rpc.unban(p["ip"]),
+        "getUtxoReturnAddress": lambda rpc, p: rpc.get_utxo_return_address(
+            bytes.fromhex(p["txid"]), p.get("acceptingBlockDaaScore", 0)
+        ),
     }
 
     def dispatch(self, method: str, params: dict):
@@ -154,7 +199,7 @@ class Daemon:
             from kaspa_tpu.p2p.transport import P2PServer
 
             lhost, lport = self.args.listen.rsplit(":", 1)
-            self.p2p_server = P2PServer(self.node, lhost, int(lport))
+            self.p2p_server = P2PServer(self.node, lhost, int(lport), address_manager=self.address_manager)
             self.p2p_server.start()
         for peer_addr in getattr(self.args, "connect", []) or []:
             self.connect_peer(peer_addr)

@@ -40,7 +40,8 @@ from jax.experimental.pallas import tpu as pltpu
 from kaspa_tpu.ops import bigint as bi
 
 W8 = 32  # 8-bit limbs per 256-bit element
-BLK = 512  # batch lanes per grid step
+BLK = 256  # batch lanes per grid step
+N_WIN = 33  # 4-bit windows per GLV half-scalar (|k1|,|k2| < 2**132)
 
 SECP_P = bi.SECP_P
 SECP_N = bi.SECP_N
@@ -78,10 +79,47 @@ def _m_limbs8(m: int) -> np.ndarray:
 _MP8 = _m_limbs8(SECP_P)
 _MN8 = _m_limbs8(SECP_N)
 
-# G multiples table (1..15, entry 0 placeholder), transposed [W8, 16]
+# --- GLV endomorphism -------------------------------------------------------
+# secp256k1 has an order-3 automorphism phi(x, y) = (beta*x, y) acting as
+# scalar multiplication by lambda; splitting each 256-bit scalar into two
+# signed ~128-bit halves over the reduced lattice below halves the shared
+# doubling chain (64 -> 33 windows).  The constants are validated here, not
+# trusted: lambda**3 == 1 (mod n), beta**3 == 1 (mod p), phi(G) == lambda*G.
+
+GLV_LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+GLV_BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+# Lagrange-Gauss reduced basis of {(x, y) : x + y*lambda == 0 (mod n)}
+_GLV_U = (64502973549206556628585045361533709077, -303414439467246543595250775667605759171)
+_GLV_V = (367917413016453100223835821029139468248, 64502973549206556628585045361533709077)
+_GLV_DET = _GLV_U[0] * _GLV_V[1] - _GLV_V[0] * _GLV_U[1]
+
+assert pow(GLV_LAMBDA, 3, SECP_N) == 1 and GLV_LAMBDA != 1
+assert pow(GLV_BETA, 3, SECP_P) == 1 and GLV_BETA != 1
+assert (_GLV_U[0] + _GLV_U[1] * GLV_LAMBDA) % SECP_N == 0
+assert (_GLV_V[0] + _GLV_V[1] * GLV_LAMBDA) % SECP_N == 0
+
+
+def _rdiv(a: int, b: int) -> int:
+    """Exact round(a/b) for ints (b > 0 after normalisation)."""
+    if b < 0:
+        a, b = -a, -b
+    return (2 * a + b) // (2 * b)
+
+
+def glv_split(k: int) -> tuple[int, int]:
+    """k -> (k1, k2), k1 + k2*lambda == k (mod n), |k1|,|k2| <~ 2**128."""
+    c1 = _rdiv(k * _GLV_V[1], _GLV_DET)
+    c2 = _rdiv(-k * _GLV_U[1], _GLV_DET)
+    k1 = k - c1 * _GLV_U[0] - c2 * _GLV_V[0]
+    k2 = -(c1 * _GLV_U[1] + c2 * _GLV_V[1])
+    return k1, k2
+
+
+# G / phi(G) multiples tables (1..15, entry 0 placeholder), transposed [W8, 16]
 def _gtab8():
     from kaspa_tpu.crypto import eclib
 
+    assert eclib.point_mul(eclib.G, GLV_LAMBDA) == ((GLV_BETA * eclib.GX) % SECP_P, eclib.GY)
     pts = []
     acc = None
     for _ in range(15):
@@ -89,11 +127,13 @@ def _gtab8():
         pts.append(acc)
     pts = [pts[0]] + pts
     gx = np.stack([int_to_limbs8(q[0]) for q in pts], axis=1)  # [W8, 16]
+    gxb = np.stack([int_to_limbs8(q[0] * GLV_BETA % SECP_P) for q in pts], axis=1)
     gy = np.stack([int_to_limbs8(q[1]) for q in pts], axis=1)
-    return gx, gy
+    return gx, gxb, gy
 
 
-_GTAB8_X, _GTAB8_Y = _gtab8()
+_GTAB8_X, _GTAB8_XB, _GTAB8_Y = _gtab8()
+_BETA8 = int_to_limbs8(GLV_BETA).reshape(W8, 1)
 
 # p-2 bits, MSB first (for Fermat inversion); first bit is 1
 _INV_BITS = np.array(
@@ -338,10 +378,25 @@ def _select_gtab(gtx, gty, digit):
     return gx, gy
 
 
+def _cond_negate(y, sign_mask):
+    """y -> -y mod p where sign_mask (int32 [1, L]) is 1."""
+    yn = _neg(y)
+    return yn * sign_mask + y * (1 - sign_mask)
+
+
 def _verify_kernel(
-    ecdsa: bool, gtx_ref, gty_ref, mp_ref, mn_ref, bits_ref,
-    px_ref, py_ref, rc_ref, sd_ref, ed_ref, vin_ref, out_ref, tabx, taby, tabz,
+    ecdsa: bool, gtx_ref, gtxb_ref, gty_ref, mp_ref, mn_ref, beta_ref, bits_ref,
+    px_ref, py_ref, rc_ref, g1_ref, g2_ref, p1_ref, p2_ref, sgn_ref, vin_ref,
+    out_ref, tabx, tabxb, taby, tabz,
 ):
+    """GLV quad-scalar ladder: R = (g1 + lam*g2)*G + (p1 + lam*p2)*P.
+
+    Four signed ~128-bit digit streams share one 33-window doubling chain:
+    G and phi(G) add mixed-affine from constant tables; P and phi(P) add
+    projective from the per-lane scratch tables (phi only rescales X by
+    beta, so the phi tables share Y/Z).  sgn_ref row 0 packs the four
+    half-scalar sign bits.
+    """
     lanes = px_ref.shape[1]
     px = px_ref[:]
     py = py_ref[:]
@@ -351,10 +406,13 @@ def _verify_kernel(
     # P multiples table 0..15 (entry 0 = identity; complete adds handle it)
     zero = jnp.zeros((W8, lanes), dtype=jnp.int32)
     one = jnp.concatenate([jnp.ones((1, lanes), jnp.int32), zero[1:]], axis=0)
+    beta = jnp.broadcast_to(beta_ref[:], (W8, lanes))
     tabx[0] = zero
+    tabxb[0] = zero
     taby[0] = one
     tabz[0] = zero
     tabx[1] = px
+    tabxb[1] = _mul(px, beta)
     taby[1] = py
     tabz[1] = one
 
@@ -366,6 +424,7 @@ def _verify_kernel(
         )
         nx, ny, nz = _pt_add(prev, (px, py, one))
         tabx[pl.ds(e, 1)] = nx.reshape(1, W8, lanes)
+        tabxb[pl.ds(e, 1)] = _mul(nx, beta).reshape(1, W8, lanes)
         taby[pl.ds(e, 1)] = ny.reshape(1, W8, lanes)
         tabz[pl.ds(e, 1)] = nz.reshape(1, W8, lanes)
         return 0
@@ -373,21 +432,30 @@ def _verify_kernel(
     jax.lax.fori_loop(2, 16, build, 0)
 
     gtx = gtx_ref[:]
+    gtxb = gtxb_ref[:]
     gty = gty_ref[:]
+    sgn = sgn_ref[0:1, :]
 
     def window(w, r):
         for _ in range(4):
             r = _pt_double(r)
-        gd = sd_ref[pl.ds(w, 1), :]
-        gx, gy = _select_gtab(gtx, gty, gd)
-        ra = _pt_add_mixed(r, (gx, gy))
-        keep = (gd == 0).astype(jnp.int32)
-        r = tuple(a * keep + b * (1 - keep) for a, b in zip(r, ra))
-        pd = ed_ref[pl.ds(w, 1), :]
-        q = _select_ptab(tabx, taby, tabz, pd)
-        return _pt_add(r, q)
+        # fixed-base streams: G (digits g1) and phi(G) (digits g2)
+        for dig_ref, xtab, bit in ((g1_ref, gtx, 0), (g2_ref, gtxb, 1)):
+            gd = dig_ref[pl.ds(w, 1), :]
+            gx, gy = _select_gtab(xtab, gty, gd)
+            gy = _cond_negate(gy, (sgn >> bit) & 1)
+            ra = _pt_add_mixed(r, (gx, gy))
+            keep = (gd == 0).astype(jnp.int32)
+            r = tuple(a * keep + b * (1 - keep) for a, b in zip(r, ra))
+        # per-lane streams: P (digits p1) and phi(P) (digits p2)
+        for dig_ref, xtab, bit in ((p1_ref, tabx, 2), (p2_ref, tabxb, 3)):
+            pd = dig_ref[pl.ds(w, 1), :]
+            qx, qy, qz = _select_ptab(xtab, taby, tabz, pd)
+            qy = _cond_negate(qy, (sgn >> bit) & 1)
+            r = _pt_add(r, (qx, qy, qz))
+        return r
 
-    x, y, z = jax.lax.fori_loop(0, 64, window, _pt_identity(lanes))
+    x, y, z = jax.lax.fori_loop(0, N_WIN, window, _pt_identity(lanes))
 
     mp = mp_ref[:]
     zc = _canon(z, mp)
@@ -414,39 +482,46 @@ def _build_call(n_padded: int, ecdsa: bool, interpret: bool):
         return pl.BlockSpec(shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
 
     limb_spec = pl.BlockSpec((W8, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
-    dig_spec = pl.BlockSpec((64, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    dig_spec = pl.BlockSpec((N_WIN, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
     v_spec = pl.BlockSpec((8, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
     call = pl.pallas_call(
         functools.partial(_verify_kernel, ecdsa),
         out_shape=jax.ShapeDtypeStruct((8, n_padded), jnp.int32),
         grid=(grid,),
         in_specs=[
-            const_spec((W8, 16)),
-            const_spec((W8, 16)),
-            const_spec((W8, 1)),
-            const_spec((W8, 1)),
-            const_spec((256, 1)),
-            limb_spec,
-            limb_spec,
-            limb_spec,
-            dig_spec,
-            dig_spec,
-            v_spec,
+            const_spec((W8, 16)),   # gtx
+            const_spec((W8, 16)),   # gtxb (beta-scaled)
+            const_spec((W8, 16)),   # gty
+            const_spec((W8, 1)),    # modulus p
+            const_spec((W8, 1)),    # modulus n
+            const_spec((W8, 1)),    # beta
+            const_spec((256, 1)),   # p-2 bits
+            limb_spec,              # px
+            limb_spec,              # py
+            limb_spec,              # rc
+            dig_spec,               # g1 digits
+            dig_spec,               # g2 digits
+            dig_spec,               # p1 digits
+            dig_spec,               # p2 digits
+            v_spec,                 # sign bits
+            v_spec,                 # valid_in
         ],
         out_specs=v_spec,
         scratch_shapes=[
-            pltpu.VMEM((16, W8, BLK), jnp.int32),
-            pltpu.VMEM((16, W8, BLK), jnp.int32),
-            pltpu.VMEM((16, W8, BLK), jnp.int32),
+            pltpu.VMEM((16, W8, BLK), jnp.int32),  # tabx
+            pltpu.VMEM((16, W8, BLK), jnp.int32),  # tabxb
+            pltpu.VMEM((16, W8, BLK), jnp.int32),  # taby
+            pltpu.VMEM((16, W8, BLK), jnp.int32),  # tabz
         ],
         interpret=interpret,
     )
     jitted = jax.jit(call)
 
-    def run(px8, py8, rc8, sd, ed, vin):
+    def run(px8, py8, rc8, g1, g2, p1, p2, sgn, vin):
         return jitted(
-            jnp.asarray(_GTAB8_X), jnp.asarray(_GTAB8_Y), jnp.asarray(_MP8),
-            jnp.asarray(_MN8), jnp.asarray(_INV_BITS), px8, py8, rc8, sd, ed, vin,
+            jnp.asarray(_GTAB8_X), jnp.asarray(_GTAB8_XB), jnp.asarray(_GTAB8_Y),
+            jnp.asarray(_MP8), jnp.asarray(_MN8), jnp.asarray(_BETA8),
+            jnp.asarray(_INV_BITS), px8, py8, rc8, g1, g2, p1, p2, sgn, vin,
         )
 
     return run
@@ -468,23 +543,48 @@ def _pad_lanes(x: np.ndarray, n: int) -> np.ndarray:
     return np.concatenate([x, pad], axis=-1)
 
 
-def verify_batch_pallas(px, py, r_canon, s_digits, e_digits, valid_in, *, ecdsa: bool, interpret: bool = False):
-    """Drop-in equivalent of the XLA verify kernels, Pallas-fused.
+def _glv_digits(scalars) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host: scalars (ints mod n) -> (d1, d2 [N_WIN, B] MSB-first 4-bit
+    digit arrays of |k1|, |k2|, sign bits [B] as (s1 | s2 << 1))."""
+    b = len(scalars)
+    halves = [glv_split(k % SECP_N) for k in scalars]
+    signs = np.fromiter(
+        ((k1 < 0) | ((k2 < 0) << 1) for k1, k2 in halves), dtype=np.int32, count=b
+    )
+    raw = b"".join(
+        abs(k1).to_bytes(17, "big") + abs(k2).to_bytes(17, "big") for k1, k2 in halves
+    )
+    arr = np.frombuffer(raw, dtype=np.uint8).reshape(b, 2, 17)
+    nib = np.empty((b, 2, 34), np.uint8)
+    nib[..., 0::2] = arr >> 4
+    nib[..., 1::2] = arr & 0x0F
+    digs = nib[..., 34 - N_WIN :].astype(np.int32)  # |k| < 2**(4*N_WIN)
+    return digs[:, 0].T.copy(), digs[:, 1].T.copy(), signs
 
-    Host-side marshalling matches ops/secp256k1/verify.py: px/py/r_canon are
-    [B, 16] canonical 2**16-radix limb arrays, s_digits/e_digits [B, 64]
-    MSB-first 4-bit windows, valid_in [B] bool.  Returns np.ndarray [B] bool.
+
+def verify_batch_pallas(px, py, r_canon, s_scalars, e_scalars, valid_in, *, ecdsa: bool, interpret: bool = False):
+    """Fused-Pallas batched verification (GLV quad-scalar ladder).
+
+    px/py/r_canon: [B, 16] canonical 2**16-radix limb arrays (same host
+    marshalling as the XLA kernels); s_scalars/e_scalars: python-int scalars
+    (s/e for Schnorr, u1/u2 for ECDSA); valid_in: [B] bool.  -> [B] bool.
     """
     b = np.asarray(px).shape[0]
     n = -(-b // BLK) * BLK
-    px8 = _pad_lanes(_to_radix8_T(px), n)
-    py8 = _pad_lanes(_to_radix8_T(py), n)
-    rc8 = _pad_lanes(_to_radix8_T(r_canon), n)
-    sd = _pad_lanes(np.asarray(s_digits, dtype=np.int32).T, n)
-    ed = _pad_lanes(np.asarray(e_digits, dtype=np.int32).T, n)
-    vin = _pad_lanes(
-        np.broadcast_to(np.asarray(valid_in, dtype=np.int32), (8, b)).copy(), n
+    g1, g2, gs = _glv_digits(s_scalars)
+    p1, p2, ps = _glv_digits(e_scalars)
+    sgn = np.broadcast_to((gs | (ps << 2)).astype(np.int32), (8, b)).copy()
+    out = np.asarray(
+        _build_call(n, ecdsa, interpret)(
+            _pad_lanes(_to_radix8_T(px), n),
+            _pad_lanes(_to_radix8_T(py), n),
+            _pad_lanes(_to_radix8_T(r_canon), n),
+            _pad_lanes(g1, n),
+            _pad_lanes(g2, n),
+            _pad_lanes(p1, n),
+            _pad_lanes(p2, n),
+            _pad_lanes(sgn, n),
+            _pad_lanes(np.broadcast_to(np.asarray(valid_in, dtype=np.int32), (8, b)).copy(), n),
+        )
     )
-    call = _build_call(n, ecdsa, interpret)
-    out = np.asarray(call(px8, py8, rc8, sd, ed, vin))
     return out[0, :b].astype(bool)

@@ -28,25 +28,50 @@ def _use_pallas() -> bool:
     formulation remains the portable path (CPU mesh tests, fallback)."""
     if os.environ.get("KASPA_TPU_NO_PALLAS"):
         return False
-    return jax.default_backend() != "cpu"
+    # "axon" is the tunneled-TPU plugin's platform name; any other backend
+    # (cpu/gpu/...) takes the portable XLA formulation
+    return jax.default_backend() in ("tpu", "axon")
 
 
-def schnorr_verify(px, py, r_canon, s_digits, e_digits, valid_in) -> np.ndarray:
-    """Backend-dispatching batched Schnorr verify (host arrays in/out)."""
+def _scalars_to_digits(ks, b: int) -> np.ndarray:
+    """Host: python-int scalars -> [b, 64] MSB-first 4-bit digits (padded)."""
+    raw = b"".join(int(k).to_bytes(32, "big") for k in ks)
+    out = np.zeros((b, 64), np.int32)
+    if ks:
+        arr = np.frombuffer(raw, dtype=np.uint8).reshape(len(ks), 32)
+        dig = np.empty((len(ks), 64), np.uint8)
+        dig[:, 0::2] = arr >> 4
+        dig[:, 1::2] = arr & 0x0F
+        out[: len(ks)] = dig
+    return out
+
+
+def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarray:
+    """Backend-dispatching batched Schnorr verify.
+
+    px/py/r_canon: [B, 16] limb arrays; s_scalars/e_scalars: python-int
+    scalar sequences (already reduced mod n); valid_in: [B] bool.
+    """
     if _use_pallas():
         from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
 
-        return verify_batch_pallas(px, py, r_canon, s_digits, e_digits, valid_in, ecdsa=False)
-    return np.asarray(schnorr_verify_kernel(px, py, r_canon, s_digits, e_digits, valid_in))
+        return verify_batch_pallas(px, py, r_canon, s_scalars, e_scalars, valid_in, ecdsa=False)
+    b = np.asarray(px).shape[0]
+    sd = _scalars_to_digits(s_scalars, b)
+    ed = _scalars_to_digits(e_scalars, b)
+    return np.asarray(schnorr_verify_kernel(px, py, r_canon, sd, ed, valid_in))
 
 
-def ecdsa_verify(px, py, r_n_canon, u1_digits, u2_digits, valid_in) -> np.ndarray:
-    """Backend-dispatching batched ECDSA verify (host arrays in/out)."""
+def ecdsa_verify(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in) -> np.ndarray:
+    """Backend-dispatching batched ECDSA verify (see schnorr_verify)."""
     if _use_pallas():
         from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
 
-        return verify_batch_pallas(px, py, r_n_canon, u1_digits, u2_digits, valid_in, ecdsa=True)
-    return np.asarray(ecdsa_verify_kernel(px, py, r_n_canon, u1_digits, u2_digits, valid_in))
+        return verify_batch_pallas(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in, ecdsa=True)
+    b = np.asarray(px).shape[0]
+    u1 = _scalars_to_digits(u1_scalars, b)
+    u2 = _scalars_to_digits(u2_scalars, b)
+    return np.asarray(ecdsa_verify_kernel(px, py, r_n_canon, u1, u2, valid_in))
 
 
 @jax.jit

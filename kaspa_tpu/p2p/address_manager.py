@@ -1,0 +1,217 @@
+"""AddressManager + ConnectionManager: peer bookkeeping, banning, outbound
+connection maintenance.
+
+Reference: components/addressmanager/src/lib.rs (address store with
+connection-failure prioritization, 24h IP bans, weighted random iteration)
+and components/connectionmanager/src/lib.rs (outbound target maintenance,
+permanent connection requests with retry backoff).  UPnP port mapping and
+DNS seeding are intentionally absent: this framework targets controlled
+simnet/testnet deployments (zero-egress environments), so peers come from
+--connect/add_peer; the seeding hook is a plain callable for future wiring.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+MAX_ADDRESSES = 4096
+MAX_CONNECTION_FAILED_COUNT = 3
+MAX_BANNED_TIME_MS = 24 * 60 * 60 * 1000
+
+
+@dataclass(frozen=True)
+class NetAddress:
+    ip: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    @classmethod
+    def parse(cls, s: str) -> "NetAddress":
+        host, port = s.rsplit(":", 1)
+        return cls(host, int(port))
+
+
+@dataclass
+class _Entry:
+    address: NetAddress
+    connection_failed_count: int = 0
+
+
+class AddressManager:
+    """Known-peer address book with failure-weighted sampling and bans."""
+
+    def __init__(self, now_ms=None):
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._store: dict[NetAddress, _Entry] = {}
+        self._banned: dict[str, int] = {}  # ip -> ban timestamp ms
+        self._lock = threading.RLock()
+        self._rng = random.Random(0xADD7)
+
+    def add_address(self, address: NetAddress) -> None:
+        with self._lock:
+            if self.is_banned(address.ip) or address in self._store:
+                return
+            if len(self._store) >= MAX_ADDRESSES:
+                # evict the most-failed address to make room
+                victim = max(self._store.values(), key=lambda e: e.connection_failed_count)
+                del self._store[victim.address]
+            self._store[address] = _Entry(address)
+
+    def remove(self, address: NetAddress) -> None:
+        with self._lock:
+            self._store.pop(address, None)
+
+    def mark_connection_failure(self, address: NetAddress) -> None:
+        with self._lock:
+            e = self._store.get(address)
+            if e is None:
+                return
+            e.connection_failed_count += 1
+            if e.connection_failed_count > MAX_CONNECTION_FAILED_COUNT:
+                del self._store[address]
+
+    def mark_connection_success(self, address: NetAddress) -> None:
+        with self._lock:
+            e = self._store.get(address)
+            if e is not None:
+                e.connection_failed_count = 0
+
+    def iterate_prioritized_random_addresses(self, exclude: set[NetAddress] = frozenset()):
+        """Weighted random order: weight 64^(3 - failures) (lib.rs:438)."""
+        with self._lock:
+            entries = [e for a, e in self._store.items() if a not in exclude]
+        weights = [64.0 ** (MAX_CONNECTION_FAILED_COUNT - min(e.connection_failed_count, 3)) for e in entries]
+        out = []
+        pool = list(zip(entries, weights))
+        while pool:
+            total = sum(w for _, w in pool)
+            pick = self._rng.random() * total
+            for i, (e, w) in enumerate(pool):
+                pick -= w
+                if pick <= 0:
+                    out.append(e.address)
+                    pool.pop(i)
+                    break
+            else:
+                out.append(pool.pop()[0].address)
+        return out
+
+    def get_all_addresses(self) -> list[NetAddress]:
+        with self._lock:
+            return list(self._store)
+
+    # --- banning ---------------------------------------------------------
+
+    def ban(self, ip: str) -> None:
+        with self._lock:
+            self._banned[ip] = self._now_ms()
+            for a in [a for a in self._store if a.ip == ip]:
+                del self._store[a]
+
+    def unban(self, ip: str) -> None:
+        with self._lock:
+            self._banned.pop(ip, None)
+
+    def is_banned(self, ip: str) -> bool:
+        with self._lock:
+            ts = self._banned.get(ip)
+            if ts is None:
+                return False
+            if self._now_ms() - ts >= MAX_BANNED_TIME_MS:
+                del self._banned[ip]
+                return False
+            return True
+
+    def get_all_banned_addresses(self) -> list[str]:
+        with self._lock:
+            return [ip for ip in list(self._banned) if self.is_banned(ip)]
+
+
+class ConnectionManager:
+    """Maintains outbound connections toward a target count.
+
+    connectionmanager/src/lib.rs: a periodic tick compares live outbound
+    peers to `outbound_target`, dials prioritized-random known addresses,
+    and retries `permanent` requests (--connect peers) with backoff.
+    """
+
+    def __init__(self, node, amgr: AddressManager, outbound_target: int = 8, tick_seconds: float = 30.0):
+        self.node = node  # kaspa_tpu.p2p.node.Node with .peers
+        self.amgr = amgr
+        self.outbound_target = outbound_target
+        self.tick_seconds = tick_seconds
+        self._permanent: dict[NetAddress, int] = {}  # address -> retry attempts
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.RLock()
+
+    def add_connection_request(self, address: NetAddress, is_permanent: bool = False) -> None:
+        with self._lock:
+            if is_permanent:
+                self._permanent.setdefault(address, 0)
+        self._tick()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="connmgr")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_seconds):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — keep the maintenance loop alive
+                pass
+
+    def _connected_addresses(self) -> set[NetAddress]:
+        out = set()
+        for peer in list(self.node.peers):
+            addr = getattr(peer, "peer_address", None)
+            if addr is not None:
+                out.add(addr)
+        return out
+
+    def _dial(self, address: NetAddress) -> bool:
+        from kaspa_tpu.p2p import transport
+
+        try:
+            peer = transport.connect_outbound(self.node, str(address))
+            peer.peer_address = address
+            self.amgr.mark_connection_success(address)
+            return True
+        except (OSError, ConnectionError):
+            self.amgr.mark_connection_failure(address)
+            return False
+
+    def _tick(self) -> None:
+        connected = self._connected_addresses()
+        # permanent requests first (exponential backoff by attempt count)
+        with self._lock:
+            pending = [a for a in self._permanent if a not in connected]
+        for addr in pending:
+            if self.amgr.is_banned(addr.ip):
+                continue
+            if self._dial(addr):
+                with self._lock:
+                    self._permanent[addr] = 0
+            else:
+                with self._lock:
+                    self._permanent[addr] += 1
+        # fill toward the outbound target from the address book
+        missing = self.outbound_target - len(self._connected_addresses())
+        if missing <= 0:
+            return
+        for addr in self.amgr.iterate_prioritized_random_addresses(exclude=connected):
+            if missing <= 0:
+                break
+            if self.amgr.is_banned(addr.ip):
+                continue
+            if self._dial(addr):
+                missing -= 1

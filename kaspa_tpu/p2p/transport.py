@@ -37,6 +37,13 @@ class WirePeer:
         self.node = node
         self.sock = sock
         self.outbound = outbound
+        try:
+            ip, port = sock.getpeername()[:2]
+            from kaspa_tpu.p2p.address_manager import NetAddress
+
+            self.peer_address = NetAddress(ip, port)
+        except OSError:
+            self.peer_address = None
         self.version_sent = outbound  # inbound reciprocates on VERSION receipt
         self.handshaken = False
         self.known_blocks: set = set()
@@ -128,8 +135,9 @@ class WirePeer:
 class P2PServer:
     """Listener accepting inbound peers (connection_handler.rs serve)."""
 
-    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 0, address_manager=None):
         self.node = node
+        self.address_manager = address_manager  # inbound ban enforcement
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -146,9 +154,12 @@ class P2PServer:
     def _accept_loop(self) -> None:
         while self._running:
             try:
-                sock, _addr = self._sock.accept()
+                sock, addr = self._sock.accept()
             except OSError:
                 return
+            if self.address_manager is not None and self.address_manager.is_banned(addr[0]):
+                sock.close()
+                continue
             peer = WirePeer(self.node, sock, outbound=False)
             with self.node.lock:
                 self.node.peers.append(peer)

@@ -42,12 +42,27 @@ class ServerInfo:
 
 
 class RpcCoreService:
-    def __init__(self, consensus: Consensus, mining: MiningManager, utxoindex: UtxoIndex | None = None, address_prefix: str = "kaspasim"):
+    def __init__(
+        self,
+        consensus: Consensus,
+        mining: MiningManager,
+        utxoindex: UtxoIndex | None = None,
+        address_prefix: str = "kaspasim",
+        p2p_node=None,
+        address_manager=None,
+        connection_manager=None,
+        shutdown_fn=None,
+    ):
         self.consensus = consensus
         self.mining = mining
         # None => run without an index: address-based queries unavailable
         self.utxoindex = utxoindex
         self.address_prefix = address_prefix
+        # p2p wiring (None => peer methods report unavailability)
+        self.p2p_node = p2p_node
+        self.address_manager = address_manager
+        self.connection_manager = connection_manager
+        self.shutdown_fn = shutdown_fn
         # rpc-level notifier chained onto the consensus root (the reference's
         # consensus -> notify -> index -> rpc chain)
         self.notifier = Notifier("rpc-core", parent=consensus.notification_root)
@@ -244,6 +259,376 @@ class RpcCoreService:
             "process_counters": asdict(self.consensus.counters.snapshot()),
             "process_metrics": asdict(self.perf_monitor.sample()),
         }
+
+    # --- node info / misc (rpc.rs ping/get_info/get_current_network/...) ---
+
+    def ping(self) -> dict:
+        return {}
+
+    def get_current_network(self) -> str:
+        return self.consensus.params.name
+
+    def get_info(self) -> dict:
+        return {
+            "p2p_id": self.consensus.params.name,
+            "mempool_size": len(self.mining.mempool),
+            "server_version": "kaspa-tpu/0.2",
+            "is_utxo_indexed": self.utxoindex is not None,
+            "is_synced": True,
+            "has_notify_command": True,
+            "has_message_id": True,
+        }
+
+    def get_block_count(self) -> dict:
+        n = len(self.consensus.storage.headers._headers) - 1
+        return {"header_count": n, "block_count": n}
+
+    def get_sync_status(self) -> bool:
+        return True
+
+    def get_system_info(self) -> dict:
+        import os
+
+        try:
+            import resource
+
+            fd_limit = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+        except Exception:
+            fd_limit = 0
+        mem_total = 0
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        mem_total = int(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        return {
+            "version": "kaspa-tpu/0.2",
+            "system_id": hex(abs(hash(self.consensus.params.name)) & 0xFFFFFFFF),
+            "cpu_physical_cores": os.cpu_count() or 0,
+            "total_memory": mem_total,
+            "fd_limit": fd_limit,
+        }
+
+    def shutdown(self) -> dict:
+        if self.shutdown_fn is None:
+            raise RpcError("shutdown is not wired on this node")
+        self.shutdown_fn()
+        return {}
+
+    def get_subnetwork(self, subnetwork_id: str) -> dict:
+        raise RpcError(f"subnetwork {subnetwork_id} not found")
+
+    def get_seq_commit_lane_proof(self, *_args) -> dict:
+        raise RpcError("seq-commit lanes are not active (pre-Toccata ruleset)")
+
+    # --- headers / chain queries ---
+
+    def get_headers(self, start_hash: bytes, limit: int = 100, is_ascending: bool = True) -> list[dict]:
+        if not self.consensus.storage.headers.has(start_hash):
+            raise RpcError(f"block {start_hash.hex()} not found")
+        out = []
+        cur = start_hash
+        gd = self.consensus.storage.ghostdag
+        if is_ascending:
+            # follow the selected chain toward the sink
+            sink = self.consensus.sink()
+            if not self.consensus.reachability.is_chain_ancestor_of(cur, sink):
+                raise RpcError("start hash is not on the selected chain")
+            while len(out) < limit:
+                out.append(self.get_block(cur, include_transactions=False)["header"] | {"hash": cur.hex()})
+                if cur == sink:
+                    break
+                cur = self.consensus.reachability.get_next_chain_ancestor(sink, cur)
+        else:
+            genesis = self.consensus.params.genesis.hash
+            while len(out) < limit:
+                out.append(self.get_block(cur, include_transactions=False)["header"] | {"hash": cur.hex()})
+                if cur == genesis:
+                    break
+                cur = gd.get_selected_parent(cur)
+        return out
+
+    def get_current_block_color(self, block_hash: bytes) -> dict:
+        """Blue/red of `block_hash` from the virtual's perspective: the color
+        assigned by the selected chain block that merges it (rpc.rs
+        get_current_block_color -> consensus get_current_block_color)."""
+        cons = self.consensus
+        if not cons.storage.headers.has(block_hash):
+            raise RpcError(f"block {block_hash.hex()} not found")
+        sink = cons.sink()
+        if block_hash == sink or cons.reachability.is_chain_ancestor_of(block_hash, sink):
+            return {"blue": True}
+        if not cons.reachability.is_dag_ancestor_of(block_hash, sink):
+            raise RpcError("block is not in the past of the virtual sink")
+        # the merging chain block is the lowest selected-chain block that is
+        # a DAG descendant of the target: descend selected parents while the
+        # parent still has the target in its past
+        merging = sink
+        genesis = cons.params.genesis.hash
+        while merging != genesis:
+            sp = cons.storage.ghostdag.get_selected_parent(merging)
+            if not cons.reachability.is_dag_ancestor_of(block_hash, sp):
+                break
+            merging = sp
+        gd = cons.storage.ghostdag.get(merging)
+        return {"blue": block_hash in gd.mergeset_blues}
+
+    def get_daa_score_timestamp_estimate(self, daa_scores: list[int]) -> list[int]:
+        """Timestamps of the selected-chain blocks nearest each DAA score."""
+        cons = self.consensus
+        chain = []
+        cur = cons.sink()
+        genesis = cons.params.genesis.hash
+        while True:
+            chain.append(cur)
+            if cur == genesis:
+                break
+            cur = cons.storage.ghostdag.get_selected_parent(cur)
+        chain.reverse()
+        scores = [cons.storage.headers.get_daa_score(h) for h in chain]
+        import bisect
+
+        out = []
+        for q in daa_scores:
+            i = min(bisect.bisect_left(scores, q), len(chain) - 1)
+            out.append(cons.storage.headers.get_timestamp(chain[i]))
+        return out
+
+    def estimate_network_hashes_per_second(self, window_size: int = 1000, start_hash: bytes | None = None) -> int:
+        """Σ chain-block work over the window / elapsed time (rpc.rs).
+
+        The oldest visited block bounds the timespan but its work is NOT
+        counted: N blocks of work were produced over N intervals, and we
+        only observe the interval span back to block N+1."""
+        from kaspa_tpu.consensus.difficulty import calc_work
+
+        cons = self.consensus
+        cur = start_hash if start_hash is not None else cons.sink()
+        if not cons.storage.headers.has(cur):
+            raise RpcError("start hash not found")
+        genesis = cons.params.genesis.hash
+        total_work = 0
+        last = cons.storage.headers.get_timestamp(cur)
+        first = last
+        for _ in range(window_size):
+            if cur == genesis:
+                break
+            total_work += calc_work(cons.storage.headers.get_bits(cur))
+            cur = cons.storage.ghostdag.get_selected_parent(cur)
+            first = cons.storage.headers.get_timestamp(cur)
+        elapsed_ms = max(last - first, 1)
+        return total_work * 1000 // elapsed_ms
+
+    def get_block_reward_info(self, block_hash: bytes | None = None) -> dict:
+        cons = self.consensus
+        h = block_hash if block_hash is not None else cons.sink()
+        if not cons.storage.headers.has(h):
+            raise RpcError(f"block {h.hex()} not found")
+        daa = cons.storage.headers.get_daa_score(h)
+        subsidy = cons.coinbase_manager.calc_block_subsidy(daa)
+        return {"block_hash": h.hex(), "daa_score": daa, "subsidy": subsidy}
+
+    def resolve_finality_conflict(self, finality_block_hash: bytes) -> dict:
+        raise RpcError("no active finality conflict to resolve")
+
+    _RETURN_ADDRESS_DAA_SLACK = 2_000  # search radius around the claimed score
+
+    def get_utxo_return_address(self, txid: bytes, accepting_block_daa_score: int) -> str:
+        """Source address of a tx's first input (rpc.rs get_utxo_return_address).
+
+        The accepting DAA score narrows the search to nearby accepting chain
+        blocks; the funding output is then resolved from bodies in the
+        accepting block's past within the same bounded window (the reference
+        resolves it via its tx-index; pruned or out-of-window history raises)."""
+        cons = self.consensus
+        lo = accepting_block_daa_score - self._RETURN_ADDRESS_DAA_SLACK
+        hi = accepting_block_daa_score + self._RETURN_ADDRESS_DAA_SLACK
+        src_tx = None
+        for bh, txids in cons.acceptance_data.items():
+            daa = cons.storage.headers.get_daa_score(bh)
+            if accepting_block_daa_score and not (lo <= daa <= hi):
+                continue
+            if txid not in txids:
+                continue
+            # scan the merged blocks' bodies for the tx
+            for cand in [bh, *cons.storage.ghostdag.get(bh).unordered_mergeset()]:
+                if not cons.storage.block_transactions.has(cand):
+                    continue
+                for tx in cons.storage.block_transactions.get(cand):
+                    if tx.id() == txid:
+                        src_tx = tx
+                        break
+            if src_tx is not None:
+                break
+        if src_tx is None:
+            raise RpcError("transaction not found in accepted history near the given DAA score")
+        if not src_tx.inputs:
+            raise RpcError("transaction is coinbase; no return address")
+        prev = src_tx.inputs[0].previous_outpoint
+        spk = self._find_output_script(prev, hi)
+        if spk is None:
+            raise RpcError("source output unavailable (pruned or beyond search window)")
+        return extract_script_pub_key_address(spk, self.address_prefix).to_string()
+
+    def _find_output_script(self, outpoint, max_daa: int):
+        """Bounded body search for a funding output: only blocks below the
+        acceptance window's upper DAA bound are scanned."""
+        cons = self.consensus
+        store = cons.storage.block_transactions
+        for bh in list(getattr(store, "_txs", {})):
+            if max_daa and cons.storage.headers.has(bh) and cons.storage.headers.get_daa_score(bh) > max_daa:
+                continue
+            for tx in store.get(bh):
+                if tx.id() == outpoint.transaction_id and outpoint.index < len(tx.outputs):
+                    return tx.outputs[outpoint.index].script_public_key
+        return None
+
+    # --- fees ---
+
+    def get_fee_estimate(self) -> dict:
+        est = self.mining.get_fee_estimate()
+        bucket = lambda b: {"feerate": b.feerate, "estimated_seconds": b.estimated_seconds}  # noqa: E731
+        return {
+            "priority_bucket": bucket(est.priority_bucket),
+            "normal_buckets": [bucket(b) for b in est.normal_buckets],
+            "low_buckets": [bucket(b) for b in est.low_buckets],
+        }
+
+    def get_fee_estimate_experimental(self, verbose: bool = False) -> dict:
+        out = {"estimate": self.get_fee_estimate()}
+        if verbose:
+            mp = self.mining.mempool
+            out["verbose"] = {
+                "mempool_ready_transactions_count": len(mp.frontier),
+                "mempool_ready_transactions_total_mass": mp.frontier.total_mass,
+                "network_mass_per_second": self.consensus.params.max_block_mass
+                * max(1, round(1000 / self.consensus.params.target_time_per_block)),
+            }
+        return out
+
+    def submit_transaction_replacement(self, tx) -> dict:
+        """RBF submission: returns the replaced txid (rpc.rs)."""
+        from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
+
+        try:
+            evicted = self.mining.validate_and_insert_transaction(tx)
+        except (MempoolError, TxRuleError) as e:
+            raise RpcError(f"transaction rejected: {e}") from e
+        return {
+            "transaction_id": tx.id().hex(),
+            "replaced_transaction_ids": [t.hex() for t in evicted],
+        }
+
+    # --- addresses / balances (plural + mempool-by-address) ---
+
+    def get_balances_by_addresses(self, addresses: list[str]) -> list[dict]:
+        return [
+            {"address": a, "balance": self.get_balance_by_address(a)} for a in addresses
+        ]
+
+    def get_mempool_entries_by_addresses(self, addresses: list[str]) -> list[dict]:
+        spk_to_addr = {
+            pay_to_address_script(Address.from_string(a)).script: a for a in addresses
+        }
+        out = {a: {"address": a, "sending": [], "receiving": []} for a in addresses}
+        pool = self.mining.mempool.pool
+        view = self.consensus.get_virtual_utxo_view()
+        for txid, e in pool.items():
+            for o in e.tx.outputs:
+                a = spk_to_addr.get(o.script_public_key.script)
+                if a is not None:
+                    out[a]["receiving"].append(txid.hex())
+            for inp in e.tx.inputs:
+                # resolve the spent output's script: virtual UTXO set first,
+                # then an in-pool parent's outputs (chained spend)
+                op = inp.previous_outpoint
+                entry = view.get(op)
+                if entry is not None:
+                    spk = entry.script_public_key.script
+                else:
+                    parent = pool.get(op.transaction_id)
+                    if parent is None or op.index >= len(parent.tx.outputs):
+                        continue
+                    spk = parent.tx.outputs[op.index].script_public_key.script
+                a = spk_to_addr.get(spk)
+                if a is not None:
+                    out[a]["sending"].append(txid.hex())
+        return list(out.values())
+
+    # --- peers (addressmanager/connectionmanager-backed) ---
+
+    def _require_p2p(self):
+        if self.p2p_node is None:
+            raise RpcError("p2p methods unavailable: node runs without a P2P stack")
+        return self.p2p_node
+
+    def add_peer(self, address: str, is_permanent: bool = False) -> dict:
+        self._require_p2p()
+        if self.connection_manager is None:
+            raise RpcError("connection manager not wired")
+        from kaspa_tpu.p2p.address_manager import NetAddress
+
+        na = NetAddress.parse(address)
+        if self.address_manager is not None:
+            self.address_manager.add_address(na)
+        self.connection_manager.add_connection_request(na, is_permanent)
+        return {}
+
+    def get_connected_peer_info(self) -> list[dict]:
+        node = self._require_p2p()
+        out = []
+        for peer in list(node.peers):
+            addr = getattr(peer, "peer_address", None)
+            out.append(
+                {
+                    "id": hex(id(peer) & 0xFFFFFFFF),
+                    "address": str(addr) if addr else "in-process",
+                    "is_outbound": getattr(peer, "outbound", False),
+                    "handshaken": getattr(peer, "handshaken", True),
+                }
+            )
+        return out
+
+    def get_connections(self) -> dict:
+        node = self._require_p2p()
+        peers = list(node.peers)
+        return {
+            "clients": 0,
+            "peers": len(peers),
+            "outbound": sum(1 for p in peers if getattr(p, "outbound", False)),
+        }
+
+    def get_peer_addresses(self) -> dict:
+        if self.address_manager is None:
+            raise RpcError("address manager not wired")
+        return {
+            "known_addresses": [str(a) for a in self.address_manager.get_all_addresses()],
+            "banned_addresses": self.address_manager.get_all_banned_addresses(),
+        }
+
+    def ban(self, ip: str) -> dict:
+        if self.address_manager is None:
+            raise RpcError("address manager not wired")
+        self.address_manager.ban(ip)
+        node = self.p2p_node
+        if node is not None:
+            for peer in list(node.peers):
+                addr = getattr(peer, "peer_address", None)
+                if addr is not None and addr.ip == ip and hasattr(peer, "close"):
+                    peer.close()
+        return {}
+
+    def unban(self, ip: str) -> dict:
+        if self.address_manager is None:
+            raise RpcError("address manager not wired")
+        self.address_manager.unban(ip)
+        return {}
+
+    def unregister_listener(self, listener_id: int) -> None:
+        self.notifier.unregister(listener_id)
 
     # --- helpers ---
 

@@ -1,0 +1,152 @@
+"""Frontier search tree, weighted sampling, and fee estimator tests.
+
+Mirrors the reference's test strategy (frontier.rs tests: tree vs brute
+force, sampling distribution, estimator bucket monotonicity —
+mining/src/feerate/mod.rs tests).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kaspa_tpu.mempool.feerate import FeerateEstimator, FeerateEstimatorArgs
+from kaspa_tpu.mempool.frontier import Frontier, FeerateKey, SearchTree
+
+
+def _key(i: int, fee: int, mass: int) -> FeerateKey:
+    return FeerateKey(fee, mass, i.to_bytes(8, "big"))
+
+
+def test_search_tree_vs_bruteforce():
+    rng = random.Random(1)
+    tree = SearchTree()
+    keys: dict[bytes, FeerateKey] = {}
+    for i in range(500):
+        k = _key(i, rng.randrange(1000, 5_000_000), rng.randrange(1000, 100_000))
+        assert tree.insert(k)
+        keys[k.txid] = k
+    # random removals
+    for txid in rng.sample(sorted(keys), 200):
+        assert tree.remove(keys.pop(txid))
+    assert len(tree) == len(keys)
+    ordered = sorted(keys.values(), key=lambda k: k.sort_key())
+    assert [k.txid for k in tree.ascending()] == [k.txid for k in ordered]
+    assert [k.txid for k in tree.descending()] == [k.txid for k in reversed(ordered)]
+    total = sum(k.weight for k in keys.values())
+    assert tree.total_weight() == pytest.approx(total, rel=1e-9)
+    # prefix weights
+    for k in rng.sample(ordered, 25):
+        brute = sum(q.weight for q in ordered if q.sort_key() <= k.sort_key())
+        assert tree.prefix_weight(k) == pytest.approx(brute, rel=1e-9)
+    # weighted search: cumulative ascending-order weight query lands on key
+    acc = 0.0
+    for k in ordered[:50]:
+        assert tree.search(acc + k.weight * 0.5).txid == k.txid
+        acc += k.weight
+
+
+def test_weighted_sampling_prefers_high_feerate():
+    rng = random.Random(7)
+    fr = Frontier()
+    # congested frontier: total mass >> 4x block mass
+    for i in range(4000):
+        fee = 2000 * (1 + (i % 10))  # feerates 1..10 per mass unit
+        fr.insert(_key(i, fee * 1000, 2000))
+    assert fr.total_mass == 4000 * 2000
+    counts = [0] * 11
+    for trial in range(50):
+        sample = fr.sample_inplace(rng, max_block_mass=50_000)
+        for k in sample:
+            counts[k.fee // 2_000_000] += 1
+    # weight ∝ feerate^3: feerate-10 txs should be sampled far more than feerate-1
+    assert counts[10] > 20 * max(counts[1], 1)
+    # sampled mass approximately the 1.2x target
+    assert 40_000 <= sum(k.mass for k in sample) <= 80_000
+
+
+def test_sampling_converges_on_biased_weights():
+    """A single huge-weight tx must not stall sampling (top-narrowing)."""
+    rng = random.Random(3)
+    fr = Frontier()
+    fr.insert(_key(0, 10**12, 2000))  # enormous feerate outlier
+    for i in range(1, 2000):
+        fr.insert(_key(i, 2000, 2000))
+    sample = fr.sample_inplace(rng, max_block_mass=500_000)
+    ids = {k.txid for k in sample}
+    assert _key(0, 10**12, 2000).txid in ids
+    assert len(ids) > 100  # narrowing let it escape the outlier
+
+
+def test_small_frontier_greedy_descending():
+    rng = random.Random(5)
+    fr = Frontier()
+    for i in range(10):
+        fr.insert(_key(i, (i + 1) * 1000, 1000))
+    sel = fr.select(rng, max_block_mass=500_000)
+    rates = [k.feerate for k in sel]
+    assert rates == sorted(rates, reverse=True)
+    assert len(sel) == 10
+
+
+def test_estimator_bucket_monotonicity():
+    for total_weight, interval in [(1002283.659, 0.004), (0.00659, 0.004), (0.0, 0.0), (0.0, 0.1), (0.1, 0.0)]:
+        est = FeerateEstimator(total_weight, interval, 1.0)
+        for min_feerate in (0.755, 1.0, 3.0):
+            b = est.calc_estimations(min_feerate).ordered_buckets()
+            assert b[-1].feerate >= min_feerate
+            for hi, lo in zip(b, b[1:]):
+                assert hi.feerate >= lo.feerate
+                assert hi.estimated_seconds <= lo.estimated_seconds
+
+
+def test_frontier_estimator_outlier_removal():
+    fr = Frontier()
+    for i in range(500):
+        fr.insert(_key(i, 2000, 2000))  # constant feerate 1.0
+    fr.insert(_key(999, 10**13, 2000))  # absurd outlier
+    args = FeerateEstimatorArgs(network_blocks_per_second=1, maximum_mass_per_block=500_000)
+    est = fr.build_feerate_estimator(args)
+    # outlier must be excluded from weight, else feerate-1 time estimate explodes
+    t = est.feerate_to_time(1.0)
+    assert t < 60.0, t
+    ests = est.calc_estimations(minimum_standard_feerate=0.01)
+    assert ests.priority_bucket.feerate < 100.0
+
+
+def test_mempool_frontier_integration():
+    from kaspa_tpu.consensus.model import (
+        Transaction, TransactionInput, TransactionOutpoint, TransactionOutput, ScriptPublicKey,
+    )
+    from kaspa_tpu.mempool.mempool import Mempool, MempoolTx
+
+    def mk_tx(seed: int, prev: bytes):
+        spk = ScriptPublicKey(0, b"\x20" + bytes(32) + b"\xac")
+        return Transaction(
+            version=0,
+            inputs=[TransactionInput(TransactionOutpoint(prev, 0), b"", 0, 1)],
+            outputs=[TransactionOutput(1000, spk)],
+            lock_time=0,
+            subnetwork_id=bytes(20),
+            gas=0,
+            payload=b"",
+        )
+
+    mp = Mempool()
+    parent = mk_tx(1, b"\x01" * 32)
+    pid = parent.id()
+    mp.insert(MempoolTx(parent, fee=5000, mass=2000, added_daa_score=0))
+    child = mk_tx(2, pid)
+    mp.insert(MempoolTx(child, fee=9000, mass=2000, added_daa_score=0))
+    # child chains on in-pool parent: not in frontier
+    assert len(mp.frontier) == 1
+    sel = mp.select_transactions()
+    assert [e.tx.id() for e in sel] == [pid]
+    # parent accepted -> child becomes ready
+    mp.handle_accepted_transactions([pid], daa_score=1)
+    assert len(mp.frontier) == 1
+    assert [e.tx.id() for e in mp.select_transactions()] == [child.id()]
+    # child expired -> frontier drains
+    mp.expire(current_daa_score=10**9)
+    assert len(mp.frontier) == 0 and len(mp.pool) == 0

@@ -50,10 +50,8 @@ def test_schnorr_pallas_interpret(keys):
     px = _limbs([p[0] for p in pks])
     py = _limbs([p[1] for p in pks])
     rc = _limbs([int.from_bytes(s[:32], "big") for s in sigs])
-    sd = np.stack([pt.scalar_digits_msb(int.from_bytes(s[32:], "big")) for s in sigs])
-    ed = np.stack(
-        [pt.scalar_digits_msb(schnorr_challenge(s[:32], pubs[i], msgs[i])) for i, s in enumerate(sigs)]
-    )
+    sd = [int.from_bytes(s[32:], "big") for s in sigs]
+    ed = [schnorr_challenge(s[:32], pubs[i], msgs[i]) for i, s in enumerate(sigs)]
     ok = np.ones(B, dtype=bool)
     ok[3] = False  # host-side encoding rejection must mask through
     expect[3] = False
@@ -86,9 +84,18 @@ def test_ecdsa_pallas_interpret(keys):
     px = _limbs([p[0] for p in pks])
     py = _limbs([p[1] for p in pks])
     rn = _limbs([r % eclib.N for r, _ in rs])
-    u1d = np.stack([pt.scalar_digits_msb(v) for v in u1])
-    u2d = np.stack([pt.scalar_digits_msb(v) for v in u2])
     ok = np.ones(B, dtype=bool)
 
-    mask = verify_batch_pallas(px, py, rn, u1d, u2d, ok, ecdsa=True, interpret=True)
+    mask = verify_batch_pallas(px, py, rn, u1, u2, ok, ecdsa=True, interpret=True)
     assert mask.tolist() == expect
+
+
+def test_glv_split_identity():
+    from kaspa_tpu.ops.secp256k1.ladder_pallas import GLV_LAMBDA, glv_split
+
+    random.seed(11)
+    for _ in range(500):
+        k = random.randrange(eclib.N)
+        k1, k2 = glv_split(k)
+        assert (k1 + k2 * GLV_LAMBDA) % eclib.N == k
+        assert abs(k1).bit_length() <= 132 and abs(k2).bit_length() <= 132

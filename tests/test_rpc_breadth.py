@@ -1,0 +1,132 @@
+"""Coverage tests for the extended RpcCoreService surface.
+
+Reference parity: rpc/core/src/api/rpc.rs (~45 RpcApi methods) — this file
+exercises the batch added in round 2 (info/network/headers/fees/peers/
+color/estimates) against a small mined DAG.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.p2p import Node
+from kaspa_tpu.p2p.address_manager import AddressManager, NetAddress
+from kaspa_tpu.rpc import RpcCoreService
+from kaspa_tpu.rpc.service import RpcError
+from kaspa_tpu.sim.simulator import Miner
+
+
+@pytest.fixture()
+def svc():
+    params = simnet_params(bps=2)
+    node = Node(Consensus(params), "rpc-test")
+    amgr = AddressManager()
+    service = RpcCoreService(
+        node.consensus, node.mining, address_prefix="kaspasim",
+        p2p_node=node, address_manager=amgr,
+    )
+    miner = Miner(0, random.Random(5))
+    for _ in range(12):
+        t = node.consensus.build_block_template(miner.miner_data, [])
+        node.submit_block(t)
+    return service, node
+
+
+def test_info_network_counts(svc):
+    service, node = svc
+    assert service.ping() == {}
+    assert service.get_current_network() == node.consensus.params.name
+    info = service.get_info()
+    assert info["is_synced"] and info["mempool_size"] == 0
+    counts = service.get_block_count()
+    assert counts["block_count"] == 12
+    assert service.get_sync_status() is True
+    sysinfo = service.get_system_info()
+    assert sysinfo["cpu_physical_cores"] > 0
+
+
+def test_headers_walk(svc):
+    service, node = svc
+    genesis = node.consensus.params.genesis.hash
+    up = service.get_headers(genesis, limit=5, is_ascending=True)
+    assert len(up) == 5
+    assert up[0]["hash"] == genesis.hex()
+    down = service.get_headers(node.consensus.sink(), limit=100, is_ascending=False)
+    assert down[-1]["hash"] == genesis.hex()
+    assert len(down) == 13  # 12 mined + genesis
+
+
+def test_block_color_and_daa_estimates(svc):
+    service, node = svc
+    sink = node.consensus.sink()
+    # every chain block is blue by definition
+    assert service.get_current_block_color(sink) == {"blue": True}
+    parent = node.consensus.storage.ghostdag.get_selected_parent(sink)
+    assert service.get_current_block_color(parent) == {"blue": True}
+    with pytest.raises(RpcError):
+        service.get_current_block_color(b"\xaa" * 32)
+    daa = node.consensus.get_virtual_daa_score()
+    est = service.get_daa_score_timestamp_estimate([0, daa])
+    assert len(est) == 2 and est[1] >= est[0]
+    nhps = service.estimate_network_hashes_per_second(window_size=8)
+    assert nhps > 0
+    reward = service.get_block_reward_info()
+    assert reward["subsidy"] > 0
+
+
+def test_fee_estimate_shape(svc):
+    service, _node = svc
+    est = service.get_fee_estimate()
+    assert est["priority_bucket"]["feerate"] >= 1.0
+    rates = [est["priority_bucket"]["feerate"]] + [b["feerate"] for b in est["normal_buckets"]] + [
+        b["feerate"] for b in est["low_buckets"]
+    ]
+    assert rates == sorted(rates, reverse=True)
+    verbose = service.get_fee_estimate_experimental(verbose=True)
+    assert verbose["verbose"]["mempool_ready_transactions_count"] == 0
+
+
+def test_peer_and_ban_methods(svc):
+    service, node = svc
+    # in-process peers appear in connected info
+    assert service.get_connections()["peers"] == len(node.peers)
+    amgr = service.address_manager
+    amgr.add_address(NetAddress("10.0.0.1", 16111))
+    addrs = service.get_peer_addresses()
+    assert "10.0.0.1:16111" in addrs["known_addresses"]
+    service.ban("10.0.0.1")
+    assert "10.0.0.1" in service.get_peer_addresses()["banned_addresses"]
+    # banned ip's addresses are dropped from the known book
+    assert "10.0.0.1:16111" not in service.get_peer_addresses()["known_addresses"]
+    service.unban("10.0.0.1")
+    assert service.get_peer_addresses()["banned_addresses"] == []
+    with pytest.raises(RpcError):
+        service.get_subnetwork("deadbeef")
+    with pytest.raises(RpcError):
+        service.resolve_finality_conflict(b"\x00" * 32)
+    with pytest.raises(RpcError):
+        service.get_seq_commit_lane_proof()
+
+
+def test_address_manager_failure_pruning():
+    amgr = AddressManager()
+    a = NetAddress("10.1.1.1", 16111)
+    amgr.add_address(a)
+    for _ in range(3):
+        amgr.mark_connection_failure(a)
+    assert a in amgr.get_all_addresses()
+    amgr.mark_connection_failure(a)  # exceeds MAX_CONNECTION_FAILED_COUNT
+    assert a not in amgr.get_all_addresses()
+
+
+def test_address_manager_ban_expiry():
+    clock = [0]
+    amgr = AddressManager(now_ms=lambda: clock[0])
+    amgr.ban("9.9.9.9")
+    assert amgr.is_banned("9.9.9.9")
+    clock[0] = 24 * 60 * 60 * 1000 + 1
+    assert not amgr.is_banned("9.9.9.9")
