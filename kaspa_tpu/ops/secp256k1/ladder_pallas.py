@@ -474,6 +474,134 @@ def _verify_kernel(
     out_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, lanes))
 
 
+def _verify_kernel_plain(
+    ecdsa: bool, gtx_ref, gty_ref, mp_ref, mn_ref, bits_ref,
+    px_ref, py_ref, rc_ref, sd_ref, ed_ref, vin_ref, out_ref, tabx, taby, tabz,
+):
+    """Non-GLV dual-scalar ladder (64 unsigned 4-bit windows).
+
+    The proven production path: the GLV quad-stream kernel above is ~25%
+    lighter arithmetically but its Mosaic compile has not yet been validated
+    on the tunneled device, so it stays opt-in (KASPA_TPU_GLV=1)."""
+    lanes = px_ref.shape[1]
+    px = px_ref[:]
+    py = py_ref[:]
+    if not ecdsa:
+        py = _neg(py)  # BIP340: R = s*G + e*(-P)
+
+    zero = jnp.zeros((W8, lanes), dtype=jnp.int32)
+    one = jnp.concatenate([jnp.ones((1, lanes), jnp.int32), zero[1:]], axis=0)
+    tabx[0] = zero
+    taby[0] = one
+    tabz[0] = zero
+    tabx[1] = px
+    taby[1] = py
+    tabz[1] = one
+
+    def build(e, _):
+        prev = (
+            tabx[pl.ds(e - 1, 1)].reshape(W8, lanes),
+            taby[pl.ds(e - 1, 1)].reshape(W8, lanes),
+            tabz[pl.ds(e - 1, 1)].reshape(W8, lanes),
+        )
+        nx, ny, nz = _pt_add(prev, (px, py, one))
+        tabx[pl.ds(e, 1)] = nx.reshape(1, W8, lanes)
+        taby[pl.ds(e, 1)] = ny.reshape(1, W8, lanes)
+        tabz[pl.ds(e, 1)] = nz.reshape(1, W8, lanes)
+        return 0
+
+    jax.lax.fori_loop(2, 16, build, 0)
+
+    gtx = gtx_ref[:]
+    gty = gty_ref[:]
+
+    def window(w, r):
+        for _ in range(4):
+            r = _pt_double(r)
+        gd = sd_ref[pl.ds(w, 1), :]
+        gx, gy = _select_gtab(gtx, gty, gd)
+        ra = _pt_add_mixed(r, (gx, gy))
+        keep = (gd == 0).astype(jnp.int32)
+        r = tuple(a * keep + b * (1 - keep) for a, b in zip(r, ra))
+        pd = ed_ref[pl.ds(w, 1), :]
+        q = _select_ptab(tabx, taby, tabz, pd)
+        return _pt_add(r, q)
+
+    x, y, z = jax.lax.fori_loop(0, 64, window, _pt_identity(lanes))
+
+    mp = mp_ref[:]
+    zc = _canon(z, mp)
+    inf = jnp.all(zc == 0, axis=0, keepdims=True)
+    zi = _inv(z, bits_ref)
+    xa = _canon(_mul(x, zi), mp)
+    if ecdsa:
+        xn = _cond_sub_m(mn_ref[:], xa)
+        ok = jnp.all(xn == rc_ref[:], axis=0, keepdims=True)
+    else:
+        ok = jnp.all(xa == rc_ref[:], axis=0, keepdims=True)
+        ya = _canon(_mul(y, zi), mp)
+        ok = ok & ((ya[0:1] & 1) == 0)
+    ok = ok & ~inf & (vin_ref[0:1] > 0)
+    out_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, lanes))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call_plain(n_padded: int, ecdsa: bool, interpret: bool):
+    grid = n_padded // BLK
+
+    def const_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+    limb_spec = pl.BlockSpec((W8, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    dig_spec = pl.BlockSpec((64, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    v_spec = pl.BlockSpec((8, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    call = pl.pallas_call(
+        functools.partial(_verify_kernel_plain, ecdsa),
+        out_shape=jax.ShapeDtypeStruct((8, n_padded), jnp.int32),
+        grid=(grid,),
+        in_specs=[
+            const_spec((W8, 16)),
+            const_spec((W8, 16)),
+            const_spec((W8, 1)),
+            const_spec((W8, 1)),
+            const_spec((256, 1)),
+            limb_spec,
+            limb_spec,
+            limb_spec,
+            dig_spec,
+            dig_spec,
+            v_spec,
+        ],
+        out_specs=v_spec,
+        scratch_shapes=[
+            pltpu.VMEM((16, W8, BLK), jnp.int32),
+            pltpu.VMEM((16, W8, BLK), jnp.int32),
+            pltpu.VMEM((16, W8, BLK), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+    jitted = jax.jit(call)
+
+    def run(px8, py8, rc8, sd, ed, vin):
+        return jitted(
+            jnp.asarray(_GTAB8_X), jnp.asarray(_GTAB8_Y), jnp.asarray(_MP8),
+            jnp.asarray(_MN8), jnp.asarray(_INV_BITS), px8, py8, rc8, sd, ed, vin,
+        )
+
+    return run
+
+
+def _full_digits(scalars) -> np.ndarray:
+    """Host: int scalars -> [64, B] MSB-first 4-bit digits (transposed)."""
+    b = len(scalars)
+    raw = b"".join(int(k).to_bytes(32, "big") for k in scalars)
+    arr = np.frombuffer(raw, dtype=np.uint8).reshape(b, 32)
+    dig = np.empty((b, 64), np.uint8)
+    dig[:, 0::2] = arr >> 4
+    dig[:, 1::2] = arr & 0x0F
+    return dig.astype(np.int32).T.copy()
+
+
 @functools.lru_cache(maxsize=None)
 def _build_call(n_padded: int, ecdsa: bool, interpret: bool):
     grid = n_padded // BLK
@@ -562,29 +690,41 @@ def _glv_digits(scalars) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     return digs[:, 0].T.copy(), digs[:, 1].T.copy(), signs
 
 
-def verify_batch_pallas(px, py, r_canon, s_scalars, e_scalars, valid_in, *, ecdsa: bool, interpret: bool = False):
-    """Fused-Pallas batched verification (GLV quad-scalar ladder).
+def verify_batch_pallas(px, py, r_canon, s_scalars, e_scalars, valid_in, *, ecdsa: bool, interpret: bool = False, glv: bool | None = None):
+    """Fused-Pallas batched verification.
 
     px/py/r_canon: [B, 16] canonical 2**16-radix limb arrays (same host
     marshalling as the XLA kernels); s_scalars/e_scalars: python-int scalars
     (s/e for Schnorr, u1/u2 for ECDSA); valid_in: [B] bool.  -> [B] bool.
+
+    Two kernels: the proven 64-window dual-scalar ladder (default) and the
+    GLV quad-stream 33-window ladder (opt-in via KASPA_TPU_GLV=1 or glv=True
+    until its Mosaic compile is validated on the tunneled device).
     """
+    import os
+
+    if glv is None:
+        glv = bool(os.environ.get("KASPA_TPU_GLV"))
     b = np.asarray(px).shape[0]
     n = -(-b // BLK) * BLK
-    g1, g2, gs = _glv_digits(s_scalars)
-    p1, p2, ps = _glv_digits(e_scalars)
-    sgn = np.broadcast_to((gs | (ps << 2)).astype(np.int32), (8, b)).copy()
-    out = np.asarray(
-        _build_call(n, ecdsa, interpret)(
-            _pad_lanes(_to_radix8_T(px), n),
-            _pad_lanes(_to_radix8_T(py), n),
-            _pad_lanes(_to_radix8_T(r_canon), n),
-            _pad_lanes(g1, n),
-            _pad_lanes(g2, n),
-            _pad_lanes(p1, n),
-            _pad_lanes(p2, n),
-            _pad_lanes(sgn, n),
-            _pad_lanes(np.broadcast_to(np.asarray(valid_in, dtype=np.int32), (8, b)).copy(), n),
+    px8 = _pad_lanes(_to_radix8_T(px), n)
+    py8 = _pad_lanes(_to_radix8_T(py), n)
+    rc8 = _pad_lanes(_to_radix8_T(r_canon), n)
+    vin = _pad_lanes(np.broadcast_to(np.asarray(valid_in, dtype=np.int32), (8, b)).copy(), n)
+    if glv:
+        g1, g2, gs = _glv_digits(s_scalars)
+        p1, p2, ps = _glv_digits(e_scalars)
+        sgn = np.broadcast_to((gs | (ps << 2)).astype(np.int32), (8, b)).copy()
+        out = np.asarray(
+            _build_call(n, ecdsa, interpret)(
+                px8, py8, rc8,
+                _pad_lanes(g1, n), _pad_lanes(g2, n),
+                _pad_lanes(p1, n), _pad_lanes(p2, n),
+                _pad_lanes(sgn, n), vin,
+            )
         )
-    )
+    else:
+        sd = _pad_lanes(_full_digits(s_scalars), n)
+        ed = _pad_lanes(_full_digits(e_scalars), n)
+        out = np.asarray(_build_call_plain(n, ecdsa, interpret)(px8, py8, rc8, sd, ed, vin))
     return out[0, :b].astype(bool)

@@ -33,7 +33,8 @@ def _limbs(vals):
     return np.stack([bi.int_to_limbs(v, 16) for v in vals]).astype(np.int32)
 
 
-def test_schnorr_pallas_interpret(keys):
+@pytest.mark.parametrize("glv", [False, True])
+def test_schnorr_pallas_interpret(keys, glv):
     sk = keys
     pubs = [eclib.schnorr_pubkey(k) for k in sk]
     pks = [eclib.lift_x(int.from_bytes(p, "big")) for p in pubs]
@@ -56,7 +57,7 @@ def test_schnorr_pallas_interpret(keys):
     ok[3] = False  # host-side encoding rejection must mask through
     expect[3] = False
 
-    mask = verify_batch_pallas(px, py, rc, sd, ed, ok, ecdsa=False, interpret=True)
+    mask = verify_batch_pallas(px, py, rc, sd, ed, ok, ecdsa=False, interpret=True, glv=glv)
     assert mask.tolist() == expect
 
     # oracle cross-check on the uncorrupted lanes
