@@ -143,6 +143,9 @@ class Consensus:
         from kaspa_tpu.consensus.processes.pruning_processor import PruningProcessor
 
         self.pruning_processor = PruningProcessor(self, is_archival=getattr(params, "is_archival", False))
+        from kaspa_tpu.consensus.processes.pruning_proof import PruningProofManager
+
+        self.pruning_proof_manager = PruningProofManager(self)
         from kaspa_tpu.notify.notifier import ConsensusNotificationRoot
 
         self.notification_root = ConsensusNotificationRoot()
@@ -371,6 +374,17 @@ class Consensus:
         status = self.storage.statuses.get(block.hash)
         self.storage.flush()
         return status
+
+    def validate_and_insert_header(self, header) -> str:
+        """Headers-first intake (IBD): header validation + commit without a
+        body; the block completes later via validate_and_insert_block."""
+        existing = self.storage.statuses.get(header.hash)
+        if existing is not None:
+            return existing
+        self._process_header(header)
+        self.counters.inc_headers()
+        self.storage.flush()
+        return self.storage.statuses.get(header.hash)
 
     def sink(self) -> bytes:
         return self.virtual_state.ghostdag_data.selected_parent

@@ -1,0 +1,75 @@
+"""ConsensusManager: active-consensus ownership with staging swap.
+
+Reference: components/consensusmanager/src/lib.rs — the manager owns the
+current consensus instance, hands out sessions, and supports creating a
+*staging* consensus that is populated off to the side (pruning-proof
+import) and atomically swapped in on commit.  In this framework the
+single-writer node lock plays the session role; the manager supplies the
+factory/swap machinery plus listener callbacks so dependents (mining,
+RPC, indexes) re-bind on swap.
+"""
+
+from __future__ import annotations
+
+
+class StagingConsensus:
+    """A consensus being populated for adoption (staging_consensus.rs)."""
+
+    def __init__(self, manager: "ConsensusManager", consensus):
+        self.manager = manager
+        self.consensus = consensus
+        self._done = False
+
+    def commit(self) -> None:
+        assert not self._done
+        self._done = True
+        self.manager._swap(self.consensus)
+
+    def cancel(self) -> None:
+        """Discard: close and delete the staging DB, if any."""
+        self._done = True
+        db = getattr(self.consensus.storage, "db", None)
+        if db is not None:
+            self.consensus.storage.db = None
+            path = getattr(db, "path", None)
+            try:
+                db.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if path:
+                import contextlib
+                import os
+
+                with contextlib.suppress(OSError):
+                    os.remove(path)
+
+
+class ConsensusManager:
+    def __init__(self, consensus, factory=None):
+        """`factory()` builds a fresh consensus for staging; defaults to a
+        memory-backed instance with the active params."""
+        self._consensus = consensus
+        self._factory = factory
+        self._listeners: list = []
+
+    @property
+    def consensus(self):
+        return self._consensus
+
+    def on_swap(self, fn) -> None:
+        """Register fn(new_consensus), called after a staging commit."""
+        self._listeners.append(fn)
+
+    def new_staging(self) -> StagingConsensus:
+        if self._factory is not None:
+            fresh = self._factory()
+        else:
+            from kaspa_tpu.consensus.consensus import Consensus
+
+            fresh = Consensus(self._consensus.params)
+        return StagingConsensus(self, fresh)
+
+    def _swap(self, new_consensus) -> None:
+        self._consensus = new_consensus
+        for fn in self._listeners:
+            fn(new_consensus)

@@ -41,6 +41,10 @@ class PruningProcessor:
         # the pruning point UTXO set (pruning_meta utxo_set in the reference)
         self.pruning_utxo_set = UtxoCollection()
         self.pruning_utxoset_position: bytes = g
+        # pp's sampled windows, snapshotted while its past is still intact
+        # (pruning deletes the blocks a cold rebuild would walk; trusted-data
+        # export and post-restart window seeding read this snapshot)
+        self.pp_windows: dict[str, list] = {}
 
     # ------------------------------------------------------------------
     # phase 1+2: pruning point movement and UTXO set advancement
@@ -57,6 +61,7 @@ class PruningProcessor:
         self.pruning_point = new_points[-1]
         if not self.is_archival:
             self.retention_period_root = self.pruning_point
+        self._snapshot_pp_windows()
         self._persist_meta()
         self._advance_pruning_utxoset(self.pruning_point)
         if not self.is_archival:
@@ -89,6 +94,22 @@ class PruningProcessor:
     # phase 3: history deletion
     # ------------------------------------------------------------------
 
+    def _snapshot_pp_windows(self) -> None:
+        """Capture the new pp's sampled windows before prune() deletes the
+        history a cold rebuild would need; seed the window caches too."""
+        from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, MEDIAN_TIME_WINDOW
+
+        wm = self.c.window_manager
+        gd = self.c.storage.ghostdag.get(self.pruning_point)
+        self.pp_windows = {}
+        for wt, cache in ((DIFFICULTY_WINDOW, wm._difficulty_cache), (MEDIAN_TIME_WINDOW, wm._median_cache)):
+            try:
+                win = list(wm.build_block_window(gd, wt))
+            except Exception:  # noqa: BLE001 - insufficient window near genesis
+                win = list(cache.get(self.pruning_point, []))
+            self.pp_windows[wt] = win
+            wm.cache_block_window(self.pruning_point, wt, list(win))
+
     def _window_keep_set(self, pp: bytes) -> set[bytes]:
         """Blocks of the pruning point's DAA + median-time windows."""
         from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, MEDIAN_TIME_WINDOW
@@ -107,8 +128,24 @@ class PruningProcessor:
         c = self.c
         reach = c.reachability
         # full-data keep: future(pp) (incl. pp itself) and pp's anticone
-        # header+ghostdag keep: pp windows and the past pruning points chain
+        # header+ghostdag keep: pp windows, the past pruning points chain,
+        # and the pruning proof slices for the new pp (the reference keeps
+        # dedicated per-level proof stores; we must stay able to serve and
+        # rebuild proofs after history deletion)
         keep_headers = self._window_keep_set(new_pp) | set(self.past_pruning_points)
+        for level_headers in c.pruning_proof_manager.build_proof():
+            keep_headers.update(h.hash for h in level_headers)
+        # the pruning-sample chain from pp to genesis: expected-pruning-point
+        # walks of post-pp headers read these samples and their blue scores
+        cur = new_pp
+        seen_samples = set()
+        while cur not in seen_samples:
+            seen_samples.add(cur)
+            keep_headers.add(cur)
+            nxt = c.pruning_point_manager._sample_from_pov.get(cur)
+            if nxt is None or cur == c.params.genesis.hash:
+                break
+            cur = nxt
         all_blocks = list(c.storage.headers._headers.keys())
         full_delete: list[bytes] = []
         header_only: list[bytes] = []
@@ -123,8 +160,12 @@ class PruningProcessor:
                 full_delete.append(h)
 
         delete_set = set(full_delete)
-        # drop bodies/diffs/etc. for header-only keeps too
-        for h in header_only + full_delete:
+        # drop bodies/diffs/etc. for header-only keeps too (their pruning
+        # samples survive: expected-pruning-point walks still read them)
+        for h in header_only:
+            c.storage.block_transactions.delete(h)
+            self._del_aux(h, keep_sample=True)
+        for h in full_delete:
             c.storage.block_transactions.delete(h)
             self._del_aux(h)
         # delete all stores + reachability for fully-pruned blocks, oldest
@@ -162,7 +203,7 @@ class PruningProcessor:
                 c._set_reach_mergeset(h, [m for m in rm if m not in delete_set])
         c.storage.flush()
 
-    def _del_aux(self, h: bytes) -> None:
+    def _del_aux(self, h: bytes, keep_sample: bool = False) -> None:
         """Delete virtual-stage per-block data (diff/multiset/acceptance/...)."""
         from kaspa_tpu.consensus.stores import (
             PREFIX_ACCEPTANCE,
@@ -185,7 +226,7 @@ class PruningProcessor:
         if c.depth_manager._merge_depth_root.pop(h, None) is not None:
             c.depth_manager._finality_point.pop(h, None)
             c.storage.stage(PREFIX_DEPTH + h, None)
-        if c.pruning_point_manager._sample_from_pov.pop(h, None) is not None:
+        if not keep_sample and c.pruning_point_manager._sample_from_pov.pop(h, None) is not None:
             c.storage.stage(PREFIX_PRUNING_SAMPLES + h, None)
         c.window_manager._difficulty_cache.pop(h, None)
         c.window_manager._median_cache.pop(h, None)
@@ -203,6 +244,18 @@ class PruningProcessor:
         self.c.storage.put_meta(b"retention_root", self.retention_period_root)
         self.c.storage.put_meta(b"pruning_utxoset_position", self.pruning_utxoset_position)
         self.c.storage.put_meta(b"past_pruning_points", serde.encode_hash_list(self.past_pruning_points))
+        import io
+
+        w = io.BytesIO()
+        serde.write_varint(w, len(self.pp_windows))
+        for wt in sorted(self.pp_windows):
+            serde.write_bytes(w, wt.encode())
+            win = self.pp_windows[wt]
+            serde.write_varint(w, len(win))
+            for work, h in win:
+                serde.write_varint(w, work)
+                w.write(h)
+        self.c.storage.put_meta(b"pp_windows", w.getvalue())
 
     def load(self, grouped: dict) -> None:
         """Restore pruning state from a loaded DB (consensus._load_state)."""
@@ -221,3 +274,16 @@ class PruningProcessor:
         self.pruning_utxo_set = UtxoCollection(
             {serde.decode_outpoint(k): serde.decode_utxo_entry(v) for k, v in grouped.get(b"PU", {}).items()}
         )
+        raw_win = meta(b"pp_windows")
+        if raw_win:
+            import io
+
+            r = io.BytesIO(raw_win)
+            self.pp_windows = {
+                serde.read_bytes(r).decode(): [
+                    (serde.read_varint(r), r.read(32)) for _ in range(serde.read_varint(r))
+                ]
+                for _ in range(serde.read_varint(r))
+            }
+            for wt, win in self.pp_windows.items():
+                self.c.window_manager.cache_block_window(self.pruning_point, wt, list(win))

@@ -1,0 +1,360 @@
+"""Pruning proof: build, validate, and trusted-state bootstrap.
+
+Reference: consensus/src/processes/pruning_proof/{build,validate,apply}.rs
+and the trusted-sync surface of consensus/core/src/api/mod.rs
+(get_pruning_point_proof / get_pruning_point_anticone_and_trusted_data /
+validate_and_insert_trusted_block / import_pruning_point_utxo_set).
+
+A pruning proof is, per proof level L, the top slice (by blue work) of the
+level-L header sub-DAG below the pruning point — headers whose PoW value
+promotes them to level >= L.  Because expected density halves per level,
+~2m headers per level commit to the chain's cumulative work all the way
+down without shipping the full history.  A syncing node validates:
+
+1. per-header PoW, and that each header's PoW value actually reaches the
+   level it is presented at;
+2. per-level parent closure + topological consistency;
+3. per-level depth (>= m headers unless the level bottoms out at genesis);
+4. that the proof's pruning point carries more blue work than the node's
+   current sink (the adopt-or-reject decision).
+
+The apply side here is a *trusted state snapshot*: exactly the data a
+pruned donor node itself retains (pruning point + anticone with full data,
+DAA/median windows and past pruning points with headers+ghostdag, the
+pruning-point UTXO set) — the same shape consensus._load_state restores
+after a local prune, so importing is loading a donor's post-prune state,
+gated by the proof and the UTXO-set muhash commitment.
+
+Deviations from the reference, by design: the donor serves proof levels
+from its retained keep-set (the reference maintains a dedicated per-level
+proof store); level ghostdag re-validation trusts header blue fields once
+per-level PoW membership is proven (the reference re-runs ghostdag per
+level).  Both tighten the trust boundary to headers whose PoW was checked,
+which is the same boundary the reference's m-depth argument rests on.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus.stores import GhostdagData
+from kaspa_tpu.consensus.utxo import UtxoCollection
+from kaspa_tpu.crypto.muhash import MuHash
+
+
+class ProofError(Exception):
+    pass
+
+
+@dataclass
+class TrustedData:
+    """Donor keep-set snapshot (PruningPointTrustedData + TrustedBlocks)."""
+
+    pruning_point: bytes
+    past_pruning_points: list[bytes]
+    headers: list = field(default_factory=list)  # kept headers, any order
+    ghostdag: dict = field(default_factory=dict)  # hash -> GhostdagData
+    statuses: dict = field(default_factory=dict)  # hash -> status str
+    reach_mergesets: dict = field(default_factory=dict)  # hash -> [hash]
+    bodies: dict = field(default_factory=dict)  # hash -> [Transaction] (pp + anticone)
+    daa_excluded: dict = field(default_factory=dict)  # hash -> set[hash]
+    depth: dict = field(default_factory=dict)  # hash -> (merge_depth_root, finality_point)
+    pruning_samples: dict = field(default_factory=dict)  # hash -> sample hash
+    # pp's computed sampled windows (the reference's daa_window_blocks):
+    # post-pp window builds chain off these caches instead of walking into
+    # pruned history.  window_type -> list of (sort_key, hash) items
+    pp_windows: dict = field(default_factory=dict)
+
+
+class PruningProofManager:
+    def __init__(self, consensus):
+        self.c = consensus
+        self.params = consensus.params
+
+    # ------------------------------------------------------------------
+    # build (donor)
+    # ------------------------------------------------------------------
+
+    def build_proof(self) -> list[list]:
+        """Per-level header lists, blue-work ascending (build.rs:149)."""
+        c = self.c
+        pp = c.pruning_processor.pruning_point
+        m = self.params.pruning_proof_m
+        pm = c.parents_manager
+        genesis = self.params.genesis.hash
+        levels: list[list] = []
+        for level in range(self.params.max_block_level + 1):
+            # max-heap BFS by blue work through level-L parents, top 2m
+            collected: dict[bytes, object] = {}
+            heap: list = []
+            seen: set[bytes] = set()
+
+            def push(h):
+                if h in seen or not c.storage.headers.has(h):
+                    return
+                seen.add(h)
+                hdr = c.storage.headers.get(h)
+                heapq.heappush(heap, (-hdr.blue_work, h, hdr))
+
+            push(pp)
+            while heap and len(collected) < 2 * m:
+                _, h, hdr = heapq.heappop(heap)
+                collected[h] = hdr
+                for parent in pm.parents_at_level(hdr, level):
+                    push(parent)
+            level_headers = sorted(collected.values(), key=lambda x: (x.blue_work, x.hash))
+            levels.append(level_headers)
+            if {h.hash for h in level_headers} <= {pp, genesis}:
+                break  # deeper levels are identical; validator extends
+        return levels
+
+    # ------------------------------------------------------------------
+    # validate (importer)
+    # ------------------------------------------------------------------
+
+    def proof_level_works(self, proof: list[list]) -> list[int]:
+        """Per-level Σ calc_work(bits) — work *derived* from the difficulty
+        targets of (PoW-checked) headers, never from claimed blue_work."""
+        from kaspa_tpu.consensus.difficulty import calc_work
+
+        return [sum(calc_work(h.bits) for h in level) for level in proof]
+
+    def validate_proof(self, proof: list[list], current_proof_works: list[int]):
+        """Structural + PoW validation and the adopt decision.
+
+        Adoption requires some level where the candidate proof's *derived*
+        work (Σ calc_work(bits) of headers whose PoW was individually
+        checked at that level) exceeds the node's own proof's derived work —
+        the validate.rs per-level comparison.  Claimed blue_work fields are
+        used for ordering only; they cannot buy adoption, so fabricating a
+        winning proof costs real level-qualified PoW.
+        Returns the proven pruning-point header or raises ProofError.
+        """
+        if not proof or not proof[0]:
+            raise ProofError("empty proof")
+        m = self.params.pruning_proof_m
+        genesis = self.params.genesis.hash
+        pp_header = max(proof[0], key=lambda h: (h.blue_work, h.hash))
+        pm = self.c.parents_manager
+        for level, headers in enumerate(proof):
+            if not headers:
+                raise ProofError(f"level {level} is empty")
+            index = {h.hash: h for h in headers}
+            in_level = set(index)
+            reaches_genesis = genesis in in_level
+            if not reaches_genesis and len(headers) < m:
+                raise ProofError(
+                    f"level {level} has {len(headers)} headers < m={m} and does not reach genesis"
+                )
+            prev_work = -1
+            for h in headers:
+                if h.blue_work < prev_work:
+                    raise ProofError(f"level {level} not blue-work sorted")
+                prev_work = h.blue_work
+                if h.hash == genesis and not h.direct_parents():
+                    continue
+                if not self.params.skip_proof_of_work:
+                    from kaspa_tpu.crypto.powhash import calc_block_pow_hash
+                    from kaspa_tpu.consensus.difficulty import compact_to_target
+
+                    pow_value = int.from_bytes(calc_block_pow_hash(h), "little")
+                    if pow_value > compact_to_target(h.bits):
+                        raise ProofError(f"level {level} header {h.hash.hex()} fails PoW")
+                    hdr_level = max(0, self.params.max_block_level - pow_value.bit_length())
+                    if hdr_level < level:
+                        raise ProofError(
+                            f"header {h.hash.hex()} presented at level {level} but PoW only reaches {hdr_level}"
+                        )
+                # parent closure: every in-proof level-parent must sort before us
+                for parent in pm.parents_at_level(h, level):
+                    ph = index.get(parent)
+                    if ph is not None and (ph.blue_work, ph.hash) >= (h.blue_work, h.hash):
+                        raise ProofError(f"level {level} parent ordering violated")
+        candidate_works = self.proof_level_works(proof)
+        if not any(
+            cand > (current_proof_works[i] if i < len(current_proof_works) else 0)
+            for i, cand in enumerate(candidate_works)
+        ):
+            raise ProofError("candidate proof does not exceed the current proof's derived work at any level")
+        return pp_header
+
+    # ------------------------------------------------------------------
+    # trusted data (donor)
+    # ------------------------------------------------------------------
+
+    def get_trusted_data(self) -> TrustedData:
+        """Snapshot the keep-set: everything outside strict future(pp)."""
+        c = self.c
+        pp = c.pruning_processor.pruning_point
+        reach = c.reachability
+        td = TrustedData(
+            pruning_point=pp,
+            past_pruning_points=list(c.pruning_processor.past_pruning_points),
+        )
+        kept: set[bytes] = set()
+        for h in list(c.storage.headers._headers):
+            if h != pp and reach.has(h) and reach.is_dag_ancestor_of(pp, h):
+                continue  # strict future of pp: synced via normal IBD
+            kept.add(h)
+        from kaspa_tpu.consensus.reachability import ORIGIN
+
+        for h in kept:
+            td.headers.append(c.storage.headers.get(h))
+            if c.storage.ghostdag.has(h):
+                gd = c.storage.ghostdag.get(h)
+                sp = gd.selected_parent
+                if sp != ORIGIN and sp not in kept:
+                    sp = ORIGIN  # boundary block: parent beyond the snapshot
+                td.ghostdag[h] = GhostdagData(
+                    gd.blue_score,
+                    gd.blue_work,
+                    sp,
+                    [b for b in gd.mergeset_blues if b in kept],
+                    [b for b in gd.mergeset_reds if b in kept],
+                    {k: v for k, v in gd.blues_anticone_sizes.items() if k in kept},
+                )
+            st = c.storage.statuses.get(h)
+            if st is not None:
+                td.statuses[h] = st
+            rm = c.reach_mergesets.get(h)
+            if rm is not None:
+                td.reach_mergesets[h] = [x for x in rm if x in kept]
+            if c.storage.block_transactions.has(h):
+                td.bodies[h] = c.storage.block_transactions.get(h)
+            if h in c.daa_excluded:
+                td.daa_excluded[h] = c.daa_excluded[h]
+            mdr = c.depth_manager._merge_depth_root.get(h)
+            if mdr is not None:
+                td.depth[h] = (mdr, c.depth_manager._finality_point.get(h, b"\x00" * 32))
+            ps = c.pruning_point_manager._sample_from_pov.get(h)
+            if ps is not None:
+                td.pruning_samples[h] = ps
+        from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, MEDIAN_TIME_WINDOW
+
+        wm = c.window_manager
+        prp = c.pruning_processor
+        for wt, cache in ((DIFFICULTY_WINDOW, wm._difficulty_cache), (MEDIAN_TIME_WINDOW, wm._median_cache)):
+            # priority: the prune-time snapshot (always coherent), then the
+            # warm cache, then a cold rebuild (archival donors)
+            win = prp.pp_windows.get(wt) if prp.pp_windows else None
+            if win is None:
+                win = cache.get(pp)
+            if win is None:
+                win = wm.build_block_window(c.storage.ghostdag.get(pp), wt)
+            td.pp_windows[wt] = list(win)
+        return td
+
+    def get_pruning_utxo_set(self) -> UtxoCollection:
+        return self.c.pruning_processor.pruning_utxo_set
+
+    # ------------------------------------------------------------------
+    # apply (importer)
+    # ------------------------------------------------------------------
+
+    def import_pruning_data(
+        self, proof: list[list], trusted: TrustedData, utxo_set: UtxoCollection,
+        current_proof_works: list[int] | None = None,
+    ) -> None:
+        """Bootstrap this (fresh) consensus from proof + trusted snapshot.
+
+        `current_proof_works`: the derived per-level works of the proof the
+        node currently holds (the ACTIVE consensus when importing into
+        staging) — the candidate must beat them at some level.  Defaults to
+        this consensus's own proof.
+
+        Mirrors consensus._load_state's rebuild discipline: stores seeded
+        from the snapshot, reachability re-derived in (blue_work, hash)
+        topological order, virtual positioned at the pruning point over the
+        commitment-checked UTXO set.  Raises ProofError without mutating
+        state on any validation failure.
+        """
+        c = self.c
+        pp = trusted.pruning_point
+        if current_proof_works is None:
+            current_proof_works = self.proof_level_works(self.build_proof())
+        pp_header = self.validate_proof(proof, current_proof_works)
+        if pp_header.hash != pp:
+            raise ProofError("trusted data pruning point does not match the proven header")
+        # UTXO commitment: muhash over the supplied set must equal the header's
+        ms = MuHash()
+        for op, entry in utxo_set.items():
+            ms.add_utxo(op, entry)
+        if ms.finalize() != pp_header.utxo_commitment:
+            raise ProofError("pruning point UTXO set does not match the header commitment")
+
+        by_hash = {h.hash: h for h in trusted.headers}
+        if pp not in by_hash or pp not in trusted.ghostdag:
+            raise ProofError("trusted data misses the pruning point itself")
+
+        # --- seed stores ------------------------------------------------
+        for hdr in trusted.headers:
+            c.storage.headers.insert(hdr)
+        # proof headers are retained (status header-only) so this node can
+        # serve proofs onward; only trusted headers join relations
+        for level in proof:
+            for hdr in level:
+                if hdr.hash not in by_hash and not c.storage.headers.has(hdr.hash):
+                    c.storage.headers.insert(hdr)
+                    c.storage.statuses.set(hdr.hash, c.storage.statuses.STATUS_HEADER_ONLY)
+        for h, gd in trusted.ghostdag.items():
+            c.storage.ghostdag.insert(h, gd)
+        for h, st in trusted.statuses.items():
+            c.storage.statuses.set(h, st)
+        for h, txs in trusted.bodies.items():
+            c.storage.block_transactions.insert(h, txs)
+        for h, rm in trusted.reach_mergesets.items():
+            c._set_reach_mergeset(h, rm)
+        c.daa_excluded.update(trusted.daa_excluded)
+        for h, (mdr, fp) in trusted.depth.items():
+            c.depth_manager.store(h, mdr, fp)
+        for h, s in trusted.pruning_samples.items():
+            c.pruning_point_manager.store_pruning_sample(h, s)
+        for wt, win in trusted.pp_windows.items():
+            c.window_manager.cache_block_window(pp, wt, list(win))
+
+        # --- relations + reachability (topological rebuild) -------------
+        kept = set(by_hash)
+        genesis = self.params.genesis.hash
+        topo = sorted(
+            (h for h in kept if h in trusted.ghostdag or h == genesis),
+            key=lambda h: (trusted.ghostdag[h].blue_work if h in trusted.ghostdag else -1, h),
+        )
+        from kaspa_tpu.consensus.reachability import ORIGIN
+
+        for blk in topo:
+            parents = [p for p in by_hash[blk].direct_parents() if p in kept]
+            c.storage.relations._parents[blk] = list(parents)
+            c.storage.relations._children.setdefault(blk, [])
+            for p in parents:
+                c.storage.relations._children.setdefault(p, []).append(blk)
+            if blk == genesis:
+                if not c.reachability.has(blk):
+                    c.reachability.add_block(blk, ORIGIN, [], [ORIGIN])
+                continue
+            gd = trusted.ghostdag[blk]
+            live_parents = parents or [gd.selected_parent]
+            c.reachability.add_block(
+                blk, gd.selected_parent, trusted.reach_mergesets.get(blk, []), live_parents
+            )
+
+        # --- pruning + virtual position ---------------------------------
+        prp = c.pruning_processor
+        prp.pruning_point = pp
+        prp.past_pruning_points = list(trusted.past_pruning_points)
+        prp.retention_period_root = pp
+        prp.pruning_utxo_set = UtxoCollection(dict(utxo_set))
+        prp.pruning_utxoset_position = pp
+        prp._persist_meta()
+
+        c.utxo_set = UtxoCollection(dict(utxo_set))
+        c.utxo_position = pp
+        c.multisets[pp] = ms
+        # virtual parents are constrained to future(pp) (the reference's
+        # pruning-point-on-virtual-chain invariant): anticone blocks stay
+        # mergeable by incoming post-pp blocks but are never initial tips
+        c.tips = {pp}
+        c._resolve_virtual()
+        c._persist_tips()
+        c._persist_utxo_position()
+        c.storage.flush()

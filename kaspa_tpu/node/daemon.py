@@ -48,7 +48,34 @@ def parse_args(argv=None) -> DaemonArgs:
     )
     p.add_argument("--listen", default=None, help="host:port for the P2P wire (omit to disable inbound P2P)")
     p.add_argument("--connect", action="append", default=[], help="peer host:port to dial (repeatable); IBD runs on connect")
+    # consensus-parameter overrides (kaspad exposes these for testnets;
+    # primarily for pruning/IBD integration tests at small scale)
+    p.add_argument("--override-pruning-depth", type=int, default=None)
+    p.add_argument("--override-finality-depth", type=int, default=None)
+    p.add_argument("--override-merge-depth", type=int, default=None)
+    p.add_argument("--override-proof-m", type=int, default=None)
+    p.add_argument("--override-window-scale", type=int, default=None,
+                   help="shrink difficulty/median windows to this sampled size")
     return p.parse_args(argv, namespace=DaemonArgs())
+
+
+def _apply_param_overrides(params: Params, args: DaemonArgs) -> Params:
+    if getattr(args, "override_pruning_depth", None):
+        params.pruning_depth = args.override_pruning_depth
+    if getattr(args, "override_finality_depth", None):
+        params.finality_depth = args.override_finality_depth
+    if getattr(args, "override_merge_depth", None):
+        params.merge_depth = args.override_merge_depth
+    if getattr(args, "override_proof_m", None):
+        params.pruning_proof_m = args.override_proof_m
+    ws = getattr(args, "override_window_scale", None)
+    if ws:
+        params.difficulty_window_size = ws
+        params.min_difficulty_window_size = min(5, ws)
+        params.difficulty_sample_rate = 2
+        params.past_median_time_window_size = ws
+        params.past_median_time_sample_rate = 2
+    return params
 
 
 class _RpcHandler(socketserver.StreamRequestHandler):
@@ -76,14 +103,35 @@ class Daemon:
     def __init__(self, args: DaemonArgs, params: Params | None = None):
         self.args = args
         os.makedirs(args.appdir, exist_ok=True)
-        self.params = params if params is not None else simnet_params(bps=args.bps)
+        self.params = _apply_param_overrides(
+            params if params is not None else simnet_params(bps=args.bps), args
+        )
         self.db = None
         if getattr(args, "persist", False):
             from kaspa_tpu.storage.kv import KvStore
 
-            self.db = KvStore(os.path.join(args.appdir, "consensus.db"))
+            # ACTIVE meta file points at the live db (staging swaps rotate it)
+            active = "consensus.db"
+            active_path = os.path.join(args.appdir, "ACTIVE")
+            if os.path.exists(active_path):
+                with open(active_path) as f:
+                    name = f.read().strip()
+                # a truncated pointer (crash mid-replace) must not silently
+                # reset to genesis: only honor names whose db file exists
+                if name and os.path.exists(os.path.join(args.appdir, name)):
+                    active = name
+            # retire staging leftovers from aborted swaps
+            for fn in os.listdir(args.appdir):
+                if fn.startswith("consensus-staging-") and fn != active:
+                    try:
+                        os.remove(os.path.join(args.appdir, fn))
+                    except OSError:
+                        pass
+            self.db = KvStore(os.path.join(args.appdir, active))
         self.consensus = Consensus(self.params, db=self.db)
         self.node = Node(self.consensus, name="daemon")
+        self.node.cmgr._factory = self._staging_factory
+        self.node.cmgr.on_swap(self._on_consensus_swap)
         self.mining = self.node.mining
         self.utxoindex = UtxoIndex(self.consensus) if args.utxoindex else None
         from kaspa_tpu.p2p.address_manager import AddressManager, ConnectionManager
@@ -107,6 +155,49 @@ class Daemon:
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
         self.p2p_server = None
+
+    # --- staging consensus (proof IBD) ---
+
+    def _staging_factory(self):
+        db = None
+        if getattr(self.args, "persist", False):
+            import time as _time
+
+            from kaspa_tpu.storage.kv import KvStore
+
+            self._staging_db_name = f"consensus-staging-{int(_time.time() * 1000)}.db"
+            db = KvStore(os.path.join(self.args.appdir, self._staging_db_name))
+        return Consensus(self.params, db=db)
+
+    def _on_consensus_swap(self, new_consensus) -> None:
+        """Rebind every consensus-holding service after a staging commit
+        (Node already rebuilt its MiningManager)."""
+        old_db = self.db
+        self.consensus = new_consensus
+        self.mining = self.node.mining
+        self.utxoindex = UtxoIndex(new_consensus) if self.args.utxoindex else None
+        self.rpc = RpcCoreService(
+            new_consensus,
+            self.mining,
+            self.utxoindex,
+            self.args.address_prefix,
+            p2p_node=self.node,
+            address_manager=self.address_manager,
+            connection_manager=self.connection_manager,
+            shutdown_fn=self.rpc.shutdown_fn,
+        )
+        if new_consensus.storage.db is not None:
+            # atomic pointer rotation: tmp + rename so a crash mid-write
+            # cannot leave a truncated ACTIVE behind
+            active_path = os.path.join(self.args.appdir, "ACTIVE")
+            with open(active_path + ".tmp", "w") as f:
+                f.write(self._staging_db_name)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(active_path + ".tmp", active_path)
+            self.db = new_consensus.storage.db
+        if old_db is not None and old_db is not self.db:
+            old_db.close()
 
     # --- rpc wire dispatch ---
 

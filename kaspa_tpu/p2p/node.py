@@ -33,6 +33,17 @@ MSG_REQUEST_TXS = "requesttransactions"
 MSG_TX = "transaction"
 MSG_REQUEST_IBD_BLOCKS = "requestibdblocks"
 MSG_IBD_BLOCKS = "ibdblocks"
+# proof-based IBD (flows/src/ibd/flow.rs negotiate + headers-proof path)
+MSG_REQUEST_IBD_CHAIN_INFO = "requestibdchaininfo"
+MSG_IBD_CHAIN_INFO = "ibdchaininfo"
+MSG_REQUEST_PRUNING_PROOF = "requestpruningpointproof"
+MSG_PRUNING_PROOF = "pruningpointproof"
+MSG_REQUEST_TRUSTED_DATA = "requestpruningpointtrusteddata"
+MSG_TRUSTED_DATA = "pruningpointtrusteddata"
+MSG_REQUEST_PP_UTXOS = "requestpruningpointutxoset"
+MSG_PP_UTXO_CHUNK = "pruningpointutxosetchunk"
+
+PP_UTXO_CHUNK_SIZE = 4096  # entries per chunk (ibd/flow.rs utxo chunking)
 
 PROTOCOL_VERSION = 7
 
@@ -64,14 +75,27 @@ class Node:
     def __init__(self, consensus: Consensus, name: str = "node"):
         import threading
 
+        from kaspa_tpu.consensus.manager import ConsensusManager
+
         self.name = name
-        self.consensus = consensus
+        self.cmgr = ConsensusManager(consensus)
         self.mining = MiningManager(consensus)
+        self.cmgr.on_swap(self._on_consensus_swap)
         self.peers: list = []  # the Hub (p2p/src/core/hub.rs)
         self.orphan_blocks: dict[bytes, Block] = {}  # flowcontext/orphans.rs
+        self._ibd: dict = {}  # proof-IBD state machine (one active sync)
         # single-writer discipline: wire reader threads and RPC dispatch all
         # serialize consensus/mempool access through this lock
         self.lock = threading.RLock()
+
+    @property
+    def consensus(self) -> Consensus:
+        return self.cmgr.consensus
+
+    def _on_consensus_swap(self, new_consensus) -> None:
+        """Staging commit: rebuild the mempool facade on the new consensus
+        (pending txs are dropped — they reference the stale DAG)."""
+        self.mining = MiningManager(new_consensus)
 
     # --- hub / relay (flow_context.rs on_new_block -> broadcast) ---
 
@@ -161,11 +185,62 @@ class Node:
             have = set(payload)
             peer.send(MSG_IBD_BLOCKS, [b for b in blocks if b.hash not in have])
         elif msg_type == MSG_IBD_BLOCKS:
+            staging = self._ibd.get("staging") if self._ibd.get("peer") is peer else None
+            target = staging.consensus if staging is not None else self.consensus
             for block in payload:
                 try:
-                    self.consensus.validate_and_insert_block(block)
+                    target.validate_and_insert_block(block)
                 except RuleError:
                     pass
+            if staging is not None:
+                self._finalize_proof_ibd(staging)
+        elif msg_type == MSG_REQUEST_IBD_CHAIN_INFO:
+            sink = self.consensus.sink()
+            peer.send(
+                MSG_IBD_CHAIN_INFO,
+                {
+                    "sink": sink,
+                    "sink_blue_work": self.consensus.storage.ghostdag.get_blue_work(sink),
+                    "pruning_point": self.consensus.pruning_processor.pruning_point,
+                },
+            )
+        elif msg_type == MSG_IBD_CHAIN_INFO:
+            self._on_chain_info(peer, payload)
+        elif msg_type == MSG_REQUEST_PRUNING_PROOF:
+            peer.send(MSG_PRUNING_PROOF, self.consensus.pruning_proof_manager.build_proof())
+        elif msg_type == MSG_PRUNING_PROOF:
+            if self._ibd.get("peer") is peer and self._ibd.get("phase") == "proof":
+                self._ibd["proof"] = payload
+                self._ibd["phase"] = "trusted"
+                peer.send(MSG_REQUEST_TRUSTED_DATA, {})
+        elif msg_type == MSG_REQUEST_TRUSTED_DATA:
+            peer.send(MSG_TRUSTED_DATA, self.consensus.pruning_proof_manager.get_trusted_data())
+        elif msg_type == MSG_TRUSTED_DATA:
+            if self._ibd.get("peer") is peer and self._ibd.get("phase") == "trusted":
+                self._ibd["trusted"] = payload
+                self._ibd["phase"] = "utxos"
+                self._ibd["utxo"] = {}
+                peer.send(MSG_REQUEST_PP_UTXOS, 0)
+        elif msg_type == MSG_REQUEST_PP_UTXOS:
+            # snapshot the sorted item list once per pruning point — chunk
+            # requests must not re-sort the whole set under the node lock
+            pp = self.consensus.pruning_processor.pruning_point
+            cached = getattr(self, "_pp_utxo_snapshot", None)
+            if cached is None or cached[0] != pp:
+                items = sorted(
+                    self.consensus.pruning_processor.pruning_utxo_set.items(),
+                    key=lambda kv: (kv[0].transaction_id, kv[0].index),
+                )
+                self._pp_utxo_snapshot = cached = (pp, items)
+            items = cached[1]
+            start = int(payload)
+            chunk = items[start : start + PP_UTXO_CHUNK_SIZE]
+            peer.send(
+                MSG_PP_UTXO_CHUNK,
+                {"offset": start, "pairs": chunk, "done": start + len(chunk) >= len(items)},
+            )
+        elif msg_type == MSG_PP_UTXO_CHUNK:
+            self._on_pp_utxo_chunk(peer, payload)
 
     def _on_relay_block(self, peer: Peer, block: Block) -> None:
         peer.known_blocks.add(block.hash)  # sender has it: don't echo the inv back
@@ -215,10 +290,83 @@ class Node:
         ]
 
     def ibd_from(self, peer: Peer) -> None:
-        """Naive full-sync IBD (ibd/flow.rs Sync path; proof-based sync is a
-        later milestone): request everything above what we have."""
-        have = [h for h in self.consensus.storage.headers._headers]
+        """IBD negotiation (ibd/flow.rs determine_ibd_type): ask for the
+        peer's chain info, then either relay-style catch-up (peer's pruning
+        point known locally) or a pruning-proof sync into a staging
+        consensus."""
+        peer.send(MSG_REQUEST_IBD_CHAIN_INFO, {})
+
+    def _on_chain_info(self, peer: Peer, info: dict) -> None:
+        peer_pp = info["pruning_point"]
+        sink = self.consensus.sink()
+        our_work = self.consensus.storage.ghostdag.get_blue_work(sink)
+        if info["sink_blue_work"] <= our_work:
+            return  # nothing to gain from this peer
+        if self._ibd:
+            return  # one sync at a time; don't abandon an in-flight staging
+        if (
+            self.consensus.reachability.has(peer_pp)
+            and (
+                self.consensus.reachability.is_dag_ancestor_of(
+                    self.consensus.pruning_processor.pruning_point, peer_pp
+                )
+                or peer_pp == self.consensus.pruning_processor.pruning_point
+            )
+        ):
+            # peer's pruning point is connected within our known history
+            # (header-only proof remnants without reachability do NOT count)
+            have = [h for h in self.consensus.storage.headers._headers]
+            peer.send(MSG_REQUEST_IBD_BLOCKS, have)
+            return
+        # too far behind: headers-proof sync (ibd/flow.rs IbdType::DownloadHeadersProof)
+        self._ibd = {"peer": peer, "phase": "proof"}
+        peer.send(MSG_REQUEST_PRUNING_PROOF, {})
+
+    def _on_pp_utxo_chunk(self, peer: Peer, payload: dict) -> None:
+        from kaspa_tpu.consensus.processes.pruning_proof import ProofError
+        from kaspa_tpu.consensus.utxo import UtxoCollection
+
+        if self._ibd.get("peer") is not peer or self._ibd.get("phase") != "utxos":
+            return
+        for op, entry in payload["pairs"]:
+            self._ibd["utxo"][op] = entry
+        if not payload["done"]:
+            if not payload["pairs"]:
+                self._ibd = {}
+                raise ProtocolError("peer sent an empty non-final UTXO chunk (no progress)")
+            peer.send(MSG_REQUEST_PP_UTXOS, payload["offset"] + len(payload["pairs"]))
+            return
+        # all trust material in hand: bootstrap a staging consensus and sync
+        # the post-pruning-point history into it; the swap happens only when
+        # the staging chain actually carries more blue work than the active
+        # one (staging_consensus.rs commit discipline)
+        staging = self.cmgr.new_staging()
+        try:
+            active_ppm = self.consensus.pruning_proof_manager
+            staging.consensus.pruning_proof_manager.import_pruning_data(
+                self._ibd["proof"],
+                self._ibd["trusted"],
+                UtxoCollection(self._ibd["utxo"]),
+                current_proof_works=active_ppm.proof_level_works(active_ppm.build_proof()),
+            )
+        except ProofError as e:
+            self._ibd = {}
+            staging.cancel()
+            raise ProtocolError(f"invalid pruning proof data from peer: {e}") from e
+        self._ibd = {"peer": peer, "phase": "blocks", "staging": staging}
+        have = [h for h in staging.consensus.storage.headers._headers]
         peer.send(MSG_REQUEST_IBD_BLOCKS, have)
+
+    def _finalize_proof_ibd(self, staging) -> None:
+        self._ibd = {}
+        new_sink = staging.consensus.sink()
+        new_work = staging.consensus.storage.ghostdag.get_blue_work(new_sink)
+        cur_work = self.consensus.storage.ghostdag.get_blue_work(self.consensus.sink())
+        if new_work > cur_work:
+            staging.commit()
+        else:
+            staging.cancel()
+            raise ProtocolError("proof-IBD peer failed to deliver the promised chain work")
 
 
 def connect(a: Node, b: Node) -> tuple[Peer, Peer]:

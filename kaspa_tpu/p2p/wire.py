@@ -19,11 +19,19 @@ from kaspa_tpu.consensus import serde
 from kaspa_tpu.p2p.node import (
     MSG_BLOCK,
     MSG_IBD_BLOCKS,
+    MSG_IBD_CHAIN_INFO,
     MSG_INV_BLOCK,
     MSG_INV_TXS,
+    MSG_PP_UTXO_CHUNK,
+    MSG_PRUNING_PROOF,
     MSG_REQUEST_BLOCK,
     MSG_REQUEST_IBD_BLOCKS,
+    MSG_REQUEST_IBD_CHAIN_INFO,
+    MSG_REQUEST_PP_UTXOS,
+    MSG_REQUEST_PRUNING_PROOF,
+    MSG_REQUEST_TRUSTED_DATA,
     MSG_REQUEST_TXS,
+    MSG_TRUSTED_DATA,
     MSG_TX,
     MSG_VERACK,
     MSG_VERSION,
@@ -49,6 +57,14 @@ _TYPE_IDS = {
     MSG_IBD_BLOCKS: 9,
     MSG_PING: 10,
     MSG_PONG: 11,
+    MSG_REQUEST_IBD_CHAIN_INFO: 12,
+    MSG_IBD_CHAIN_INFO: 13,
+    MSG_REQUEST_PRUNING_PROOF: 14,
+    MSG_PRUNING_PROOF: 15,
+    MSG_REQUEST_TRUSTED_DATA: 16,
+    MSG_TRUSTED_DATA: 17,
+    MSG_REQUEST_PP_UTXOS: 18,
+    MSG_PP_UTXO_CHUNK: 19,
 }
 _TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
 
@@ -94,6 +110,126 @@ def _dec_blocks(data: bytes):
     return [serde.decode_block(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
 
 
+def _enc_empty(_p) -> bytes:
+    return b""
+
+
+def _dec_empty(_d) -> dict:
+    return {}
+
+
+def _enc_chain_info(p) -> bytes:
+    w = io.BytesIO()
+    w.write(p["sink"])
+    serde.write_varint(w, p["sink_blue_work"])
+    w.write(p["pruning_point"])
+    return w.getvalue()
+
+
+def _dec_chain_info(data: bytes) -> dict:
+    r = io.BytesIO(data)
+    sink = r.read(32)
+    work = serde.read_varint(r)
+    return {"sink": sink, "sink_blue_work": work, "pruning_point": r.read(32)}
+
+
+def _enc_proof(levels) -> bytes:
+    w = io.BytesIO()
+    serde.write_varint(w, len(levels))
+    for level in levels:
+        serde.write_varint(w, len(level))
+        for hdr in level:
+            serde.write_bytes(w, serde.encode_header(hdr))
+    return w.getvalue()
+
+
+def _dec_proof(data: bytes):
+    r = io.BytesIO(data)
+    return [
+        [serde.decode_header(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
+        for _ in range(serde.read_varint(r))
+    ]
+
+
+def _write_hash_map(w, mapping, write_value) -> None:
+    serde.write_varint(w, len(mapping))
+    for h in sorted(mapping):
+        w.write(h)
+        write_value(w, mapping[h])
+
+
+def _read_hash_map(r, read_value) -> dict:
+    return {r.read(32): read_value(r) for _ in range(serde.read_varint(r))}
+
+
+def _enc_trusted(td) -> bytes:
+    w = io.BytesIO()
+    w.write(td.pruning_point)
+    w.write(serde.encode_hash_list(td.past_pruning_points))
+    serde.write_varint(w, len(td.headers))
+    for hdr in td.headers:
+        serde.write_bytes(w, serde.encode_header(hdr))
+    _write_hash_map(w, td.ghostdag, lambda w, gd: serde.write_bytes(w, serde.encode_ghostdag(gd)))
+    _write_hash_map(w, td.statuses, lambda w, s: serde.write_bytes(w, s.encode()))
+    _write_hash_map(w, td.reach_mergesets, lambda w, hs: w.write(serde.encode_hash_list(hs)))
+    _write_hash_map(w, td.bodies, lambda w, txs: serde.write_bytes(w, serde.encode_txs(txs)))
+    _write_hash_map(w, td.daa_excluded, lambda w, hs: w.write(serde.encode_hash_list(sorted(hs))))
+    _write_hash_map(w, td.depth, lambda w, v: (w.write(v[0]), w.write(v[1])))
+    _write_hash_map(w, td.pruning_samples, lambda w, s: w.write(s))
+    serde.write_varint(w, len(td.pp_windows))
+    for wt in sorted(td.pp_windows):
+        serde.write_bytes(w, wt.encode())
+        win = td.pp_windows[wt]
+        serde.write_varint(w, len(win))
+        for work, h in win:
+            serde.write_varint(w, work)
+            w.write(h)
+    return w.getvalue()
+
+
+def _dec_trusted(data: bytes):
+    from kaspa_tpu.consensus.processes.pruning_proof import TrustedData
+
+    r = io.BytesIO(data)
+    td = TrustedData(pruning_point=r.read(32), past_pruning_points=serde.read_hash_list(r))
+    td.headers = [serde.decode_header(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
+    td.ghostdag = _read_hash_map(r, lambda r: serde.decode_ghostdag(serde.read_bytes(r)))
+    td.statuses = _read_hash_map(r, lambda r: serde.read_bytes(r).decode())
+    td.reach_mergesets = _read_hash_map(r, serde.read_hash_list)
+    td.bodies = _read_hash_map(r, lambda r: serde.decode_txs(serde.read_bytes(r)))
+    td.daa_excluded = _read_hash_map(r, lambda r: set(serde.read_hash_list(r)))
+    td.depth = _read_hash_map(r, lambda r: (r.read(32), r.read(32)))
+    td.pruning_samples = _read_hash_map(r, lambda r: r.read(32))
+    td.pp_windows = {
+        serde.read_bytes(r).decode(): [
+            (serde.read_varint(r), r.read(32)) for _ in range(serde.read_varint(r))
+        ]
+        for _ in range(serde.read_varint(r))
+    }
+    return td
+
+
+def _enc_utxo_chunk(p) -> bytes:
+    w = io.BytesIO()
+    serde.write_varint(w, p["offset"])
+    serde.write_varint(w, len(p["pairs"]))
+    for op, entry in p["pairs"]:
+        w.write(serde.encode_outpoint(op))
+        serde.write_bytes(w, serde.encode_utxo_entry(entry))
+    w.write(b"\x01" if p["done"] else b"\x00")
+    return w.getvalue()
+
+
+def _dec_utxo_chunk(data: bytes) -> dict:
+    r = io.BytesIO(data)
+    offset = serde.read_varint(r)
+    pairs = [
+        (serde.decode_outpoint(r.read(36)), serde.decode_utxo_entry(serde.read_bytes(r)))
+        for _ in range(serde.read_varint(r))
+    ]
+    return {"offset": offset, "pairs": pairs, "done": r.read(1) == b"\x01"}
+
+
 _CODECS = {
     MSG_VERSION: (_enc_version, _dec_version),
     MSG_VERACK: (_enc_varint, _dec_varint),
@@ -107,6 +243,14 @@ _CODECS = {
     MSG_IBD_BLOCKS: (_enc_blocks, _dec_blocks),
     MSG_PING: (_enc_varint, _dec_varint),
     MSG_PONG: (_enc_varint, _dec_varint),
+    MSG_REQUEST_IBD_CHAIN_INFO: (_enc_empty, _dec_empty),
+    MSG_IBD_CHAIN_INFO: (_enc_chain_info, _dec_chain_info),
+    MSG_REQUEST_PRUNING_PROOF: (_enc_empty, _dec_empty),
+    MSG_PRUNING_PROOF: (_enc_proof, _dec_proof),
+    MSG_REQUEST_TRUSTED_DATA: (_enc_empty, _dec_empty),
+    MSG_TRUSTED_DATA: (_enc_trusted, _dec_trusted),
+    MSG_REQUEST_PP_UTXOS: (_enc_varint, _dec_varint),
+    MSG_PP_UTXO_CHUNK: (_enc_utxo_chunk, _dec_utxo_chunk),
 }
 
 

@@ -217,6 +217,7 @@ class KvStore:
     """Typed prefixed access (database/src/registry.rs + access.rs shape)."""
 
     def __init__(self, path: str, native: bool = True):
+        self.path = path
         self.engine = open_store(path, native)
 
     def prefixed(self, prefix: bytes) -> "PrefixedStore":

@@ -1,0 +1,155 @@
+"""Proof-based IBD over the flow layer (in-process transport).
+
+A fresh node joining a network whose pruning point moved past genesis
+cannot relay-sync (history below the donor's pruning point is gone); it
+must negotiate, download proof + trusted data + PP UTXO chunks, bootstrap
+a staging consensus, sync the remaining blocks into it, and atomically
+swap.  Mirrors flows/src/ibd/flow.rs IbdType::DownloadHeadersProof.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import GenesisBlock, Params
+from kaspa_tpu.p2p.node import Node, connect
+from kaspa_tpu.sim.simulator import Miner
+
+
+def _prune_params() -> Params:
+    genesis = GenesisBlock(hash=b"\x01" + b"\x00" * 31, bits=0x207FFFFF, timestamp=0)
+    return Params.from_bps(
+        "simnet-ibdproof",
+        2,
+        genesis,
+        skip_proof_of_work=True,
+        coinbase_maturity=8,
+        merge_depth=15,
+        finality_depth=30,
+        pruning_depth=60,
+        pruning_proof_m=10,
+        difficulty_window_size=15,
+        min_difficulty_window_size=5,
+        difficulty_sample_rate=2,
+        past_median_time_window_size=10,
+        past_median_time_sample_rate=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def donor_node():
+    params = _prune_params()
+    donor = Node(Consensus(params), "donor")
+    miner = Miner(0, random.Random(31))
+    for _ in range(160):
+        t = donor.consensus.build_block_template(miner.miner_data, [])
+        donor.submit_block(t)
+    assert donor.consensus.pruning_processor.pruning_point != params.genesis.hash
+    return params, donor
+
+
+def test_fresh_node_proof_syncs(donor_node):
+    params, donor = donor_node
+    g = params.genesis.hash
+    joiner = Node(Consensus(params), "joiner")
+    original_consensus = joiner.consensus
+    pj, pd = connect(joiner, donor)
+    joiner.ibd_from(pj)
+    # the staging consensus must have been swapped in
+    assert joiner.consensus is not original_consensus
+    assert joiner.consensus.sink() == donor.consensus.sink()
+    assert joiner.consensus.pruning_processor.pruning_point == donor.consensus.pruning_processor.pruning_point
+    assert dict(joiner.consensus.utxo_set) == dict(donor.consensus.utxo_set)
+    assert joiner.consensus.pruning_processor.check_pruning_utxo_commitment()
+    # the joiner never learned the pruned deep history
+    assert not joiner.consensus.storage.block_transactions.has(
+        donor.consensus.pruning_processor.past_pruning_points[0]
+    ) or donor.consensus.pruning_processor.past_pruning_points[0] == g
+    # and can mine on top + relay back to the donor
+    miner = Miner(1, random.Random(5))
+    t = joiner.consensus.build_block_template(miner.miner_data, [])
+    joiner.submit_block(t)
+    assert donor.consensus.sink() == joiner.consensus.sink()
+
+
+def test_wire_codec_roundtrip_ibd_messages(donor_node):
+    """The new IBD frames survive the binary wire codec bit-for-bit."""
+    from kaspa_tpu.p2p import wire
+    from kaspa_tpu.p2p.node import (
+        MSG_IBD_CHAIN_INFO,
+        MSG_PP_UTXO_CHUNK,
+        MSG_PRUNING_PROOF,
+        MSG_TRUSTED_DATA,
+    )
+
+    params, donor = donor_node
+    cons = donor.consensus
+    ppm = cons.pruning_proof_manager
+
+    def roundtrip(msg, payload):
+        frame = wire.encode_frame(msg, payload)
+        type_id, plen = wire.decode_frame(frame[:7])
+        name, decoded = wire.decode_payload(type_id, frame[7 : 7 + plen])
+        assert name == msg
+        return decoded
+
+    info = {
+        "sink": cons.sink(),
+        "sink_blue_work": cons.storage.ghostdag.get_blue_work(cons.sink()),
+        "pruning_point": cons.pruning_processor.pruning_point,
+    }
+    assert roundtrip(MSG_IBD_CHAIN_INFO, info) == info
+
+    proof = ppm.build_proof()
+    dec = roundtrip(MSG_PRUNING_PROOF, proof)
+    assert [[h.hash for h in lvl] for lvl in dec] == [[h.hash for h in lvl] for lvl in proof]
+
+    td = ppm.get_trusted_data()
+    dt = roundtrip(MSG_TRUSTED_DATA, td)
+    assert dt.pruning_point == td.pruning_point
+    assert dt.past_pruning_points == td.past_pruning_points
+    assert {h.hash for h in dt.headers} == {h.hash for h in td.headers}
+    assert dt.ghostdag.keys() == td.ghostdag.keys()
+    for h in td.ghostdag:
+        assert dt.ghostdag[h].blue_work == td.ghostdag[h].blue_work
+        assert dt.ghostdag[h].selected_parent == td.ghostdag[h].selected_parent
+    assert dt.statuses == td.statuses
+    assert dt.reach_mergesets == td.reach_mergesets
+    assert dt.bodies.keys() == td.bodies.keys()
+    assert dt.daa_excluded == td.daa_excluded
+    assert dt.depth == td.depth
+    assert dt.pruning_samples == td.pruning_samples
+    assert dt.pp_windows == {k: list(v) for k, v in td.pp_windows.items()}
+
+    items = sorted(
+        cons.pruning_processor.pruning_utxo_set.items(),
+        key=lambda kv: (kv[0].transaction_id, kv[0].index),
+    )[:5]
+    chunk = {"offset": 0, "pairs": items, "done": True}
+    got = roundtrip(MSG_PP_UTXO_CHUNK, chunk)
+    assert got["offset"] == 0 and got["done"] is True
+    assert got["pairs"] == items
+
+
+def test_synced_node_uses_plain_relay_catchup(donor_node):
+    """A node already holding the donor's pruning point takes the relay
+    path (no staging swap)."""
+    params, donor = donor_node
+    # clone the donor's state cheaply: proof-sync once, then fall behind
+    behind = Node(Consensus(params), "behind")
+    p1, _ = connect(behind, donor)
+    behind.ibd_from(p1)
+    assert behind.consensus.sink() == donor.consensus.sink()
+    # donor mines a few more; `behind` is now simply behind (same pp epoch)
+    miner = Miner(2, random.Random(6))
+    target = behind.consensus
+    for _ in range(5):
+        t = donor.consensus.build_block_template(miner.miner_data, [])
+        donor.consensus.validate_and_insert_block(t)
+    p2, _ = connect(behind, donor)
+    behind.ibd_from(p2)
+    assert behind.consensus is target  # no swap happened
+    assert behind.consensus.sink() == donor.consensus.sink()

@@ -1,0 +1,135 @@
+"""Pruning proof build/validate + trusted bootstrap tests.
+
+Strategy mirrors the reference's pruning-import integration tests
+(consensus/src/processes/pruning_proof/, testing/integration): a donor DAG
+long enough for the pruning point to move, a proof + trusted snapshot +
+pruning UTXO set exported, a fresh consensus bootstrapped from them, and
+the remaining post-pp history replayed to convergence.  Negative cases
+corrupt the UTXO set and the proof.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.consensus.params import GenesisBlock, Params
+from kaspa_tpu.consensus.processes.pruning_proof import ProofError
+from kaspa_tpu.consensus.utxo import UtxoCollection
+from kaspa_tpu.sim.simulator import Miner
+
+
+def _prune_params() -> Params:
+    genesis = GenesisBlock(hash=b"\x01" + b"\x00" * 31, bits=0x207FFFFF, timestamp=0)
+    # windows must be shallower than the pruning depth (the reference's
+    # params enforce this invariant; here the test scales both down)
+    return Params.from_bps(
+        "simnet-prunetest",
+        2,
+        genesis,
+        skip_proof_of_work=True,
+        coinbase_maturity=8,
+        merge_depth=15,
+        finality_depth=30,
+        pruning_depth=60,
+        pruning_proof_m=10,
+        difficulty_window_size=15,
+        min_difficulty_window_size=5,
+        difficulty_sample_rate=2,
+        past_median_time_window_size=10,
+        past_median_time_sample_rate=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def donor():
+    params = _prune_params()
+    cons = Consensus(params)
+    miner = Miner(0, random.Random(9))
+    blocks = []
+    for _ in range(160):
+        t = cons.build_block_template(miner.miner_data, [])
+        cons.validate_and_insert_block(t)
+        blocks.append(t)
+    assert cons.pruning_processor.pruning_point != params.genesis.hash, "pp never moved"
+    return params, cons, blocks
+
+
+def _export(cons):
+    ppm = cons.pruning_proof_manager
+    return ppm.build_proof(), ppm.get_trusted_data(), ppm.get_pruning_utxo_set()
+
+
+def test_proof_builds_and_validates(donor):
+    params, cons, _ = donor
+    proof, trusted, _utxo = _export(cons)
+    assert proof and proof[0]
+    pp_header = max(proof[0], key=lambda h: (h.blue_work, h.hash))
+    assert pp_header.hash == cons.pruning_processor.pruning_point
+    # validation against a fresh node's (genesis-only) proof accepts
+    fresh = Consensus(params)
+    fresh_works = fresh.pruning_proof_manager.proof_level_works(
+        fresh.pruning_proof_manager.build_proof()
+    )
+    hdr = fresh.pruning_proof_manager.validate_proof(proof, fresh_works)
+    assert hdr.hash == trusted.pruning_point
+    # validation against an equal proof (the donor's own) rejects: derived
+    # work exceeds at no level
+    own_works = cons.pruning_proof_manager.proof_level_works(proof)
+    with pytest.raises(ProofError):
+        cons.pruning_proof_manager.validate_proof(proof, own_works)
+
+
+def test_trusted_bootstrap_and_catchup(donor):
+    params, cons, _ = donor
+    proof, trusted, utxo = _export(cons)
+    imp = Consensus(params)
+    imp.pruning_proof_manager.import_pruning_data(proof, trusted, utxo)
+    pp = trusted.pruning_point
+    assert imp.sink() == pp
+    assert imp.pruning_processor.pruning_point == pp
+    assert imp.pruning_processor.check_pruning_utxo_commitment()
+
+    # replay the donor's post-pp history in topological order
+    reach = cons.reachability
+    post = [
+        h
+        for h in cons.storage.headers._headers
+        if h != pp and reach.has(h) and reach.is_dag_ancestor_of(pp, h)
+    ]
+    post.sort(key=lambda h: (cons.storage.ghostdag.get_blue_work(h), h))
+    for h in post:
+        blk = Block(cons.storage.headers.get(h), cons.storage.block_transactions.get(h))
+        status = imp.validate_and_insert_block(blk)
+        assert status in ("utxo_valid", "utxo_pending_verification"), (status, h.hex())
+    assert imp.sink() == cons.sink()
+    assert imp.get_virtual_daa_score() == cons.get_virtual_daa_score()
+    assert dict(imp.utxo_set) == dict(cons.utxo_set)
+    # the importer can now mine further blocks itself
+    miner = Miner(1, random.Random(77))
+    t = imp.build_block_template(miner.miner_data, [])
+    assert imp.validate_and_insert_block(t) in ("utxo_valid", "utxo_pending_verification")
+
+
+def test_corrupt_utxo_set_rejected(donor):
+    params, cons, _ = donor
+    proof, trusted, utxo = _export(cons)
+    bad = UtxoCollection(dict(utxo))
+    op = next(iter(bad))
+    del bad[op]
+    imp = Consensus(params)
+    with pytest.raises(ProofError, match="commitment"):
+        imp.pruning_proof_manager.import_pruning_data(proof, trusted, bad)
+
+
+def test_shallow_proof_rejected(donor):
+    params, cons, _ = donor
+    proof, trusted, utxo = _export(cons)
+    # strip level 0 below m without reaching genesis
+    shallow = [proof[0][-3:]] + proof[1:]
+    imp = Consensus(params)
+    with pytest.raises(ProofError):
+        imp.pruning_proof_manager.import_pruning_data(shallow, trusted, utxo)
