@@ -505,3 +505,26 @@ class ReachabilityService:
             self._reindex_root = parent
         del self._interval[block], self._parent[block], self._children[block], self._fcs[block], self._height[block]
         del self._dag_parents[block], self._dag_children[block]
+
+    def validate_intervals(self, root: bytes = ORIGIN) -> None:
+        """Debug invariant check (reachability/tests/mod.rs
+        validate_intervals): every tree block's children hold disjoint,
+        ascending intervals strictly contained in the parent's allocation,
+        and each FCS list is interval-sorted.  Raises AssertionError."""
+        stack = [root]
+        while stack:
+            parent = stack.pop()
+            p_iv = self._interval[parent]
+            assert p_iv[0] <= p_iv[1] + 1, f"malformed interval {p_iv}"
+            prev_end = p_iv[0] - 1
+            for child in self._children[parent]:
+                c_iv = self._interval[child]
+                assert c_iv[0] > prev_end, f"overlap/disorder under {parent.hex()}"
+                # strict: the parent's last slot is reserved (_children_capacity
+                # keeps `end` exclusive) so parent/child intervals never tie
+                assert c_iv[1] < p_iv[1], f"child {child.hex()} escapes parent allocation"
+                prev_end = c_iv[1]
+                stack.append(child)
+            fcs = self._fcs[parent]
+            starts = [self._interval[b][0] for b in fcs]
+            assert starts == sorted(starts), f"FCS of {parent.hex()} not interval-sorted"

@@ -1,0 +1,83 @@
+"""Block-task dependency manager: out-of-order intake for the pipeline.
+
+Same contract as the reference's BlockTaskDependencyManager
+(consensus/src/pipeline/deps_manager.rs:179): tasks are grouped per block
+hash; a worker that try_begins a task whose direct parent is still
+pending parks the task under that parent and moves on; completing a task
+releases its dependents (or the next queued duplicate of the same hash).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _TaskGroup:
+    tasks: deque = field(default_factory=deque)  # same-hash duplicates, FIFO
+    dependent_tasks: list = field(default_factory=list)  # hashes parked on us
+    taken: bool = False  # front task handed to a worker by try_begin
+
+
+class BlockTaskDependencyManager:
+    def __init__(self):
+        self._pending: dict[bytes, _TaskGroup] = {}
+        self._mu = threading.Lock()
+        self._idle = threading.Condition(self._mu)
+
+    def register(self, task_id: bytes, task) -> bool:
+        """Queue `task` under `task_id`.  Returns True if the id should be
+        scheduled to a worker now; False if an earlier task with the same
+        hash is already pending (the group absorbs the duplicate)."""
+        with self._mu:
+            group = self._pending.get(task_id)
+            if group is None:
+                g = _TaskGroup()
+                g.tasks.append(task)
+                self._pending[task_id] = g
+                return True
+            group.tasks.append(task)
+            return False
+
+    def try_begin(self, task_id: bytes, parents_of) -> object | None:
+        """Hand the front task of `task_id` to the calling worker, unless a
+        direct parent is itself pending — then park and return None.
+        ``parents_of(task)`` extracts the direct parents of the front task."""
+        with self._mu:
+            group = self._pending[task_id]
+            assert group.tasks and not group.taken, "try_begin expects an untaken task"
+            for parent in parents_of(group.tasks[0]):
+                parent_group = self._pending.get(parent)
+                if parent_group is not None and parent != task_id:
+                    parent_group.dependent_tasks.append(task_id)
+                    return None
+            group.taken = True
+            return group.tasks[0]
+
+    def end(self, task_id: bytes) -> list[bytes]:
+        """Mark the in-flight task of `task_id` complete.  Returns hashes to
+        reschedule: the same hash if duplicates remain queued, else every
+        task parked on this one."""
+        with self._mu:
+            group = self._pending[task_id]
+            assert group.taken, "end expects the task begun via try_begin"
+            group.tasks.popleft()
+            group.taken = False
+            if group.tasks:
+                return [task_id]
+            del self._pending[task_id]
+            if not self._pending:
+                self._idle.notify_all()
+            return group.dependent_tasks
+
+    def is_pending(self, task_id: bytes) -> bool:
+        with self._mu:
+            return task_id in self._pending
+
+    def wait_for_idle(self, timeout: float | None = None) -> bool:
+        with self._mu:
+            if self._pending:
+                return self._idle.wait(timeout)
+            return True

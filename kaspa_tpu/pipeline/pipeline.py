@@ -1,0 +1,217 @@
+"""Concurrent consensus pipeline: staged workers over one consensus core.
+
+Re-design of the reference's 4-processor pipeline (consensus/src/pipeline/:
+header/body/virtual processors connected by channels, backed by a block
+task dependency manager) for the Python+TPU runtime:
+
+- An intake that registers submissions with the dependency manager, so
+  blocks may arrive out of order and duplicates collapse into task groups
+  (deps_manager.rs semantics, ported in pipeline/deps_manager.py).
+- A pool of stage workers running header+body validation.  The
+  GIL-releasing parts — header/tx hashing (hashlib), batch marshalling
+  (numpy), device dispatch (XLA) — overlap across threads; the
+  pure-Python consensus math serializes under one ranked commit lock
+  (an honest mapping of the reference's rayon pools onto the Python
+  runtime; see utils/sync.py LockCtx for the deadlock-detection story).
+- A single virtual worker (the reference also serializes virtual state):
+  it *drains* its queue each cycle, updates tips for every completed
+  block, then resolves virtual once — so device signature batches under
+  chain verification draw from all in-flight blocks of the cycle instead
+  of dispatching per block (virtual_processor/processor.rs:267-271 task
+  batching).
+
+``submit`` returns a Future resolving to the block's status after the
+virtual stage absorbed it (the reference's virtual_state_task).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+from kaspa_tpu.consensus.stores import StatusesStore
+from kaspa_tpu.pipeline.deps_manager import BlockTaskDependencyManager
+from kaspa_tpu.utils.sync import Channel, Closed, LockCtx
+
+
+@dataclass
+class _Task:
+    block: object  # Block (or header-only Block with empty txs)
+    header_only: bool
+    future: Future
+
+
+class ConsensusPipeline:
+    def __init__(self, consensus, workers: int = 2):
+        self.consensus = consensus
+        self.deps = BlockTaskDependencyManager()
+        self._ready = Channel()
+        self._virtual_q = Channel()
+        self._lock = LockCtx("consensus-commit", rank=10)
+        self._inflight = 0
+        self._idle_mu = threading.Lock()
+        self._idle_cv = threading.Condition(self._idle_mu)
+        self._workers = [
+            threading.Thread(target=self._stage_worker, name=f"kaspa-stage-{i}", daemon=True)
+            for i in range(max(1, workers))
+        ]
+        self._virtual_worker_t = threading.Thread(
+            target=self._virtual_worker, name="kaspa-virtual", daemon=True
+        )
+        for t in self._workers:
+            t.start()
+        self._virtual_worker_t.start()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def submit(self, block, header_only: bool = False) -> Future:
+        """Queue a block for full processing; returns a Future[str status].
+
+        Out-of-order safe: if a direct parent is itself in flight, this
+        task parks until the parent completes.  Duplicate submissions of
+        the same hash are absorbed into one task group and each receives
+        its own result.
+        """
+        fut: Future = Future()
+        task = _Task(block, header_only, fut)
+        with self._idle_mu:
+            self._inflight += 1
+        fut.add_done_callback(self._on_done)
+        if self.deps.register(block.hash, task):
+            try:
+                self._ready.send(block.hash)
+            except Closed:
+                self._fail_group(block.hash, RuntimeError("pipeline shut down"))
+        return fut
+
+    def validate_and_insert_block(self, block) -> str:
+        """Synchronous submission (raises the pipeline error, if any)."""
+        return self.submit(block).result()
+
+    def wait_for_idle(self, timeout: float | None = 60.0) -> None:
+        with self._idle_mu:
+            self._idle_cv.wait_for(lambda: self._inflight == 0, timeout)
+
+    def shutdown(self) -> None:
+        self._ready.close()
+        for t in self._workers:
+            t.join(timeout=10)
+        self._virtual_q.close()
+        self._virtual_worker_t.join(timeout=10)
+
+    # ------------------------------------------------------------------
+    # stage workers: header + body
+    # ------------------------------------------------------------------
+
+    def _on_done(self, _fut) -> None:
+        with self._idle_mu:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle_cv.notify_all()
+
+    def _requeue(self, ids) -> None:
+        for dep in ids:
+            try:
+                self._ready.send(dep)
+            except Closed:
+                # shutdown with tasks in flight: fail the parked group so no
+                # caller hangs on an unresolved future
+                self._fail_group(dep, RuntimeError("pipeline shut down"))
+
+    def _fail_group(self, task_id: bytes, err: Exception) -> None:
+        with self.deps._mu:
+            group = self.deps._pending.pop(task_id, None)
+            if group is None:
+                return
+            tasks, dependents = list(group.tasks), list(group.dependent_tasks)
+            if not self.deps._pending:
+                self.deps._idle.notify_all()
+        for t in tasks:
+            if not t.future.done():
+                t.future.set_exception(err)
+        for dep in dependents:
+            self._fail_group(dep, err)
+
+    def _stage_worker(self) -> None:
+        consensus = self.consensus
+        for task_id in self._ready:
+            task = self.deps.try_begin(task_id, lambda t: t.block.header.direct_parents())
+            if task is None:
+                continue  # parked under a pending parent
+            duplicate_status = None
+            err = None
+            try:
+                # GIL-releasing precompute outside the commit lock: header
+                # hash + merkle leaves hash concurrently across workers
+                blk = task.block
+                _ = blk.hash
+                if not task.header_only:
+                    for tx in blk.transactions:
+                        tx.id()
+                with self._lock:
+                    existing = consensus.storage.statuses.get(blk.hash)
+                    if existing is not None and (
+                        task.header_only or existing != StatusesStore.STATUS_HEADER_ONLY
+                    ):
+                        duplicate_status = existing  # no reprocessing
+                    else:
+                        if consensus._process_header(blk.header):
+                            consensus.counters.inc_headers()
+                        if task.header_only:
+                            consensus.storage.flush()
+                        else:
+                            consensus.counters.inc_blocks_submitted()
+                            consensus._process_body(blk)
+                            consensus.counters.inc_bodies()
+                            consensus.counters.inc_txs(len(blk.transactions))
+            except Exception as e:
+                err = e
+            # on success, hand the task to the virtual queue BEFORE releasing
+            # dependents: a child finishing its stages can then never overtake
+            # its parent into tips/virtual resolution
+            if err is None and duplicate_status is None and not task.header_only:
+                try:
+                    self._virtual_q.send(task)
+                except Closed:
+                    err = RuntimeError("pipeline shut down")
+            self._requeue(self.deps.end(task_id))
+            if err is not None:
+                task.future.set_exception(err)
+            elif duplicate_status is not None:
+                task.future.set_result(duplicate_status)
+            elif task.header_only:
+                task.future.set_result(consensus.storage.statuses.get(blk.hash))
+
+    # ------------------------------------------------------------------
+    # virtual worker
+    # ------------------------------------------------------------------
+
+    def _virtual_worker(self) -> None:
+        consensus = self.consensus
+        while True:
+            try:
+                first = self._virtual_q.recv()
+            except Closed:
+                return
+            batch = [first] + self._virtual_q.drain()
+            with self._lock:
+                try:
+                    for task in batch:
+                        consensus.notification_root.notify_block_added(task.block)
+                        consensus._update_tips(task.block.hash)
+                    # one virtual resolution absorbs the whole cycle: chain
+                    # verification batches signatures across these blocks
+                    consensus._resolve_virtual()
+                    consensus.storage.flush()
+                except Exception as e:
+                    for task in batch:
+                        if not task.future.done():
+                            task.future.set_exception(e)
+                    continue
+                for task in batch:
+                    status = consensus.storage.statuses.get(task.block.hash)
+                    if not task.future.done():
+                        task.future.set_result(status)

@@ -1,0 +1,122 @@
+"""Concurrency primitives: channels, reader-writer lock, lock-order debug.
+
+The runtime counterpart of the reference's kaspa-utils sync layer
+(utils/src/channel.rs, utils/src/sync/rwlock.rs, utils/src/sync/
+semaphore.rs).  Python-runtime notes baked into the design:
+
+- Channels are closeable MPMC queues (async_channel semantics): `send`
+  after close raises, receivers drain remaining items then see `Closed`.
+- LockCtx is the race/deadlock *detection* strategy (SURVEY §5): with
+  KASPA_TPU_LOCK_DEBUG=1 every guarded acquisition records a per-thread
+  held-set and asserts a global partial order over lock ranks — a cycle
+  (deadlock candidate) fails loudly in tests instead of hanging a node.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+
+
+class Closed(Exception):
+    """Channel closed and drained."""
+
+
+class Channel:
+    """Closeable MPMC FIFO channel (utils/src/channel.rs semantics)."""
+
+    def __init__(self, maxsize: int = 0):
+        self._q: collections.deque = collections.deque()
+        self._maxsize = maxsize
+        self._mu = threading.Lock()
+        self._not_empty = threading.Condition(self._mu)
+        self._not_full = threading.Condition(self._mu)
+        self._closed = False
+
+    def send(self, item) -> None:
+        with self._mu:
+            if self._closed:
+                raise Closed("send on closed channel")
+            while self._maxsize and len(self._q) >= self._maxsize:
+                self._not_full.wait()
+                if self._closed:
+                    raise Closed("send on closed channel")
+            self._q.append(item)
+            self._not_empty.notify()
+
+    def recv(self, timeout: float | None = None):
+        with self._mu:
+            while not self._q:
+                if self._closed:
+                    raise Closed
+                if not self._not_empty.wait(timeout):
+                    raise TimeoutError
+            item = self._q.popleft()
+            self._not_full.notify()
+            return item
+
+    def drain(self) -> list:
+        """Atomically take everything currently queued."""
+        with self._mu:
+            items = list(self._q)
+            self._q.clear()
+            self._not_full.notify_all()
+            return items
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.recv()
+            except Closed:
+                return
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._q)
+
+
+# ----------------------------------------------------------------------
+# lock-order debugging (deadlock detection strategy)
+# ----------------------------------------------------------------------
+
+_LOCK_DEBUG = bool(os.environ.get("KASPA_TPU_LOCK_DEBUG"))
+_held = threading.local()
+
+
+class LockCtx:
+    """Ranked lock wrapper: acquiring a lock with rank <= any currently
+    held rank (on the same thread) is an ordering violation — the static
+    discipline that makes the pipeline deadlock-free.  Zero overhead
+    unless KASPA_TPU_LOCK_DEBUG is set."""
+
+    def __init__(self, name: str, rank: int, lock=None):
+        self.name = name
+        self.rank = rank
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def __enter__(self):
+        if _LOCK_DEBUG:
+            stack = getattr(_held, "stack", None)
+            if stack is None:
+                stack = _held.stack = []
+            if stack and stack[-1][1] >= self.rank and stack[-1][0] is not self:
+                raise AssertionError(
+                    f"lock-order violation: acquiring {self.name}(rank {self.rank}) "
+                    f"while holding {stack[-1][2]}(rank {stack[-1][1]})"
+                )
+            stack.append((self, self.rank, self.name))
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        if _LOCK_DEBUG:
+            _held.stack.pop()
+        return False

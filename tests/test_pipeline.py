@@ -1,0 +1,211 @@
+"""Concurrent pipeline tests: the port of the reference's
+consensus_pipeline_tests.rs (test_concurrent_pipeline /
+test_concurrent_pipeline_random) plus deps-manager unit coverage.
+
+Blocks are real (built against a scratch consensus), then submitted to a
+fresh pipelined consensus concurrently / out of order / in duplicate —
+results must match a sequential replay bit-for-bit and the reachability
+intervals must stay valid.
+"""
+
+import random
+import threading
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.consensus.model import ScriptPublicKey
+from kaspa_tpu.pipeline import BlockTaskDependencyManager, ConsensusPipeline
+
+MINER = MinerData(ScriptPublicKey(0, b"\x20" + b"\x07" * 32 + b"\xac"))
+
+
+def _build_dag(topology):
+    """topology: list of (name, [parent names]); returns (params, blocks in
+    topology order) built/validated on a scratch consensus."""
+    params = simnet_params()
+    scratch = Consensus(params)
+    by_name = {"G": params.genesis.hash}
+    blocks = []
+    for i, (name, parent_names) in enumerate(topology):
+        parents = [by_name[p] for p in parent_names]
+        blk = scratch.build_block_with_parents(parents, MINER)
+        blk.header.nonce = i + 1  # disambiguate same-parent siblings
+        blk.header.invalidate_cache()
+        scratch.validate_and_insert_block(blk)
+        by_name[name] = blk.hash
+        blocks.append(blk)
+    return params, blocks, by_name
+
+
+TOPOLOGY = [
+    ("2", ["G"]),
+    ("3", ["G"]),
+    ("4", ["2", "3"]),
+    ("5", ["4"]),
+    ("6", ["G"]),
+    ("7", ["5", "6"]),
+    ("8", ["G"]),
+    ("9", ["G"]),
+    ("10", ["7", "8", "9"]),
+    ("11", ["G"]),
+    ("12", ["11", "10"]),
+]
+
+
+def test_concurrent_pipeline():
+    """Reference: consensus_pipeline_tests.rs test_concurrent_pipeline —
+    every block submitted twice concurrently; reachability relations and
+    intervals must come out exact."""
+    params, blocks, names = _build_dag(TOPOLOGY)
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus, workers=3)
+    try:
+        for blk in blocks:
+            f1 = pipe.submit(blk)
+            f2 = pipe.submit(blk)  # duplicate: absorbed by the task group
+            assert f1.result(timeout=60) in ("utxo_valid", "utxo_pending")
+            assert f2.result(timeout=60) in ("utxo_valid", "utxo_pending")
+    finally:
+        pipe.shutdown()
+
+    reach = consensus.reachability
+    reach.validate_intervals()
+    g = params.genesis.hash
+    for name in [t[0] for t in TOPOLOGY]:
+        assert reach.is_dag_ancestor_of(g, names[name])
+
+    in_past = lambda a, b: reach.is_dag_ancestor_of(names[a], names[b]) and names[a] != names[b]
+    anticone = lambda a, b: not reach.is_dag_ancestor_of(names[a], names[b]) and not reach.is_dag_ancestor_of(
+        names[b], names[a]
+    )
+    assert in_past("2", "4") and in_past("2", "5") and in_past("2", "7")
+    assert in_past("5", "10") and in_past("6", "10")
+    assert in_past("10", "12") and in_past("11", "12")
+    assert anticone("2", "3") and anticone("2", "6") and anticone("3", "6")
+    assert anticone("5", "6") and anticone("3", "8")
+    assert anticone("11", "2") and anticone("11", "4") and anticone("11", "6") and anticone("11", "9")
+
+
+def test_concurrent_pipeline_random_waves():
+    """Reference: test_concurrent_pipeline_random — Poisson waves of
+    sibling blocks submitted concurrently without awaiting; the pipelined
+    result must equal a sequential replay."""
+    rng = random.Random(42)
+    params = simnet_params()
+    scratch = Consensus(params)
+    tips = [params.genesis.hash]
+    all_blocks = []
+    total = 120
+    while total > 0:
+        v = min(params.max_block_parents, max(1, int(rng.gauss(3, 1.5))))
+        v = min(v, total)
+        total -= v
+        new_tips = []
+        for _ in range(v):
+            blk = scratch.build_block_with_parents(list(tips), MINER)
+            blk.header.nonce = rng.getrandbits(48)
+            blk.header.invalidate_cache()
+            scratch.validate_and_insert_block(blk)
+            new_tips.append(blk.hash)
+            all_blocks.append(blk)
+        tips = new_tips
+
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus, workers=3)
+    try:
+        futures = [pipe.submit(b) for b in all_blocks]  # whole DAG in flight
+        for f in futures:
+            f.result(timeout=120)
+    finally:
+        pipe.shutdown()
+
+    consensus.reachability.validate_intervals()
+    assert consensus.sink() == scratch.sink()
+    assert consensus.get_virtual_daa_score() == scratch.get_virtual_daa_score()
+    for blk in all_blocks:
+        # consensus data must be bit-identical; statuses may differ only in
+        # that drained-batch resolution leaves side blocks utxo_pending
+        # (the reference's virtual processor batches the same way)
+        assert consensus.storage.ghostdag.get_blue_work(blk.hash) == scratch.storage.ghostdag.get_blue_work(blk.hash)
+        assert consensus.storage.ghostdag.get(blk.hash).mergeset_blues == scratch.storage.ghostdag.get(blk.hash).mergeset_blues
+        status = consensus.storage.statuses.get(blk.hash)
+        ref_status = scratch.storage.statuses.get(blk.hash)
+        assert status == ref_status or (status == "utxo_pending" and ref_status == "utxo_valid")
+    # every selected-chain ancestor of the sink is fully UTXO-verified
+    cur = consensus.sink()
+    while cur != params.genesis.hash:
+        assert consensus.storage.statuses.get(cur) == "utxo_valid"
+        cur = consensus.storage.ghostdag.get_selected_parent(cur)
+
+
+def test_pipeline_out_of_order_chain():
+    """A linear chain submitted all at once: children park on pending
+    parents in the deps manager and complete once released."""
+    topo = [(str(i), [str(i - 1)] if i > 2 else ["G"]) for i in range(2, 22)]
+    params, blocks, _ = _build_dag(topo)
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus, workers=2)
+    try:
+        futures = [pipe.submit(b) for b in blocks]
+        statuses = [f.result(timeout=120) for f in futures]
+    finally:
+        pipe.shutdown()
+    assert statuses[-1] == "utxo_valid"
+    assert consensus.sink() == blocks[-1].hash
+
+
+def test_pipeline_missing_parent_errors():
+    params, blocks, _ = _build_dag([("2", ["G"]), ("3", ["2"])])
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus)
+    try:
+        fut = pipe.submit(blocks[1])  # parent never submitted nor known
+        with pytest.raises(Exception, match="missing parent"):
+            fut.result(timeout=30)
+    finally:
+        pipe.shutdown()
+
+
+def test_deps_manager_parking_and_groups():
+    dm = BlockTaskDependencyManager()
+
+    class T:
+        def __init__(self, h, parents):
+            self.h, self.parents = h, parents
+
+    a, b = b"\xaa" * 32, b"\xbb" * 32
+    ta, tb = T(a, []), T(b, [a])
+    assert dm.register(a, ta) is True
+    assert dm.register(b, tb) is True
+    assert dm.register(b, tb) is False  # duplicate absorbed
+
+    parents_of = lambda t: t.parents
+    # b parks under pending a
+    assert dm.try_begin(b, parents_of) is None
+    assert dm.try_begin(a, parents_of) is ta
+    released = dm.end(a)
+    assert released == [b]
+    assert dm.try_begin(b, parents_of) is tb
+    # first b ends -> same hash requeued for the duplicate
+    assert dm.end(b) == [b]
+    assert dm.try_begin(b, parents_of) is tb
+    assert dm.end(b) == []
+    assert dm.wait_for_idle(1.0)
+
+
+def test_pipeline_wait_for_idle_and_counters():
+    topo = [(str(i), [str(i - 1)] if i > 2 else ["G"]) for i in range(2, 8)]
+    params, blocks, _ = _build_dag(topo)
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus)
+    try:
+        for b in blocks:
+            pipe.submit(b)
+        pipe.wait_for_idle()
+        snap = consensus.counters.snapshot()
+        assert snap.body_counts == len(blocks)
+    finally:
+        pipe.shutdown()
