@@ -27,6 +27,7 @@ FINALITY_DURATION = 43_200
 PRUNING_DURATION = 108_000
 MERGE_DEPTH_DURATION = 3600
 PRUNING_PROOF_M = 1000
+NEVER_ACTIVATION = (1 << 64) - 1  # ForkActivation::never()
 COINBASE_MATURITY_SECONDS = 100
 
 FORK_ALWAYS = 0
@@ -146,6 +147,13 @@ class Params:
     max_block_level: int = 225
     pruning_proof_m: int = PRUNING_PROOF_M
     genesis_override: object = None  # full genesis Block (golden-DAG replay)
+    # ForkActivation (config/params.rs:30): DAA score at which the Toccata
+    # consensus surface (covenants, introspection breadth, ZK precompiles,
+    # script-unit metering) activates; NEVER on all current networks.
+    toccata_activation: int = NEVER_ACTIVATION
+
+    def toccata_active(self, daa_score: int) -> bool:
+        return daa_score >= self.toccata_activation
 
     @staticmethod
     def from_bps(name: str, bps: int, genesis: GenesisBlock, **overrides) -> "Params":

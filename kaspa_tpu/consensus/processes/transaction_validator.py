@@ -43,11 +43,27 @@ class TransactionValidator:
         self.sig_cache = sig_cache if sig_cache is not None else SigCache()
         self.mass_calculator = MassCalculator.from_params(params)
         if vm_fallback is None:
-            # nonstandard scripts run through the host VM with the shared cache
+            # nonstandard scripts run through the host VM with the shared
+            # cache; Toccata activation (by the block's DAA score) selects
+            # the engine flags + metering regime
+            # (tx_validation_in_utxo_context.rs:171-172)
             from kaspa_tpu.txscript import vm as _vm
+            from kaspa_tpu.txscript.resource_meter import RuntimeScriptUnitMeter, RuntimeSigOpCounter
 
-            def vm_fallback(tx, entries, idx, reused, _cache=self.sig_cache):
-                _vm.vm_fallback(tx, entries, idx, reused, _cache)
+            def vm_fallback(tx, entries, idx, reused, pov_daa_score=None, _cache=self.sig_cache):
+                active = pov_daa_score is not None and params.toccata_active(pov_daa_score)
+                flags = _vm.EngineFlags(covenants_enabled=active)
+                commit = tx.inputs[idx].compute_commit
+                if active:
+                    sigop_units = params.mass_per_sig_op * 100  # Gram -> script units
+                    budget = commit.compute_budget() or 0
+                    meter = RuntimeScriptUnitMeter(sigop_units, budget * 10_000)  # SCRIPT_UNITS_PER_COMPUTE_BUDGET_UNIT
+                else:
+                    # pre-Toccata regime (lib.rs:545): executed sig ops may
+                    # not exceed the input's committed sig-op count
+                    meter = RuntimeSigOpCounter(commit.sig_op_count() or 0)
+                engine = _vm.TxScriptEngine(tx, entries, idx, reused, _cache, flags=flags, meter=meter)
+                engine.execute()
 
         self.vm_fallback = vm_fallback
 
@@ -122,7 +138,7 @@ class TransactionValidator:
         self._check_sequence_lock(tx, entries, pov_daa_score)
         if flags in (FLAG_FULL, FLAG_SKIP_MASS):
             assert checker is not None and token is not None, "script checks need a batch checker"
-            checker.collect_tx(token, tx, entries)
+            checker.collect_tx(token, tx, entries, pov_daa_score=pov_daa_score)
         return fee
 
     def _check_mass_commitment(self, tx, entries):

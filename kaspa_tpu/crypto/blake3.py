@@ -100,6 +100,26 @@ def blake3_keyed(key32: bytes, data: bytes) -> bytes:
     return struct.pack("<8I", *root)
 
 
+def blake3(data: bytes) -> bytes:
+    """Plain (unkeyed) BLAKE3, 32-byte output: the standard IV as the key
+    words and no KEYED_HASH flag (used by OpBlake3, opcodes/mod.rs:1656)."""
+    chunks = [data[i : i + _CHUNK_LEN] for i in range(0, len(data), _CHUNK_LEN)] or [b""]
+    if len(chunks) == 1:
+        cv = _chunk_cv(_IV, chunks[0], 0, 0, is_root=True)
+        return struct.pack("<8I", *cv)
+    cvs = [_chunk_cv(_IV, c, i, 0, is_root=False) for i, c in enumerate(chunks)]
+    while len(cvs) > 2:
+        nxt = [
+            _compress(_IV, tuple(cvs[i] + cvs[i + 1]), 0, _BLOCK_LEN, PARENT)[:8]
+            for i in range(0, len(cvs) - 1, 2)
+        ]
+        if len(cvs) % 2:
+            nxt.append(cvs[-1])
+        cvs = nxt
+    root = _compress(_IV, tuple(cvs[0] + cvs[1]), 0, _BLOCK_LEN, PARENT | ROOT)[:8]
+    return struct.pack("<8I", *root)
+
+
 def domain_key(domain: bytes) -> bytes:
     assert len(domain) <= 32
     return domain.ljust(32, b"\x00")

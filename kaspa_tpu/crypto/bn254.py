@@ -1,0 +1,618 @@
+"""BN254 (alt_bn128) pairing curve: fields, groups, optimal-ate pairing.
+
+Host-side exact arithmetic backing the Groth16 ZK precompile
+(reference: crypto/txscript/src/zk_precompiles/groth16/mod.rs, which
+delegates to arkworks ark-bn254).  Python integers give exact field math;
+the tower is the standard one:
+
+    Fq2  = Fq[u]  / (u^2 + 1)
+    Fq6  = Fq2[v] / (v^3 - xi),  xi = 9 + u
+    Fq12 = Fq6[w] / (w^2 - v)
+
+Serialization matches ark-serialize compressed mode bit-for-bit:
+little-endian base-field limbs with SW flags in the two most significant
+bits of the final byte (bit 7: y-is-negative, bit 6: point-at-infinity);
+G2/Fq2 x-coordinates serialize c0 || c1 with flags on c1's top byte.
+
+Consensus scripts budget ~10ms per verification on the reference; this
+implementation is exact rather than fast — the precompile is metered, so
+throughput is bounded by script-units, not by this code.
+"""
+
+from __future__ import annotations
+
+# Base field and scalar field moduli
+P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+R = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+
+# BN parameter x: p(x), r(x) per Barreto-Naehrig; 6x+2 drives the Miller loop
+BN_X = 4965661367192848881
+ATE_LOOP_COUNT = 6 * BN_X + 2  # 29793968203157093288
+
+
+# ----------------------------------------------------------------------
+# field towers (elements are ints / tuples of ints; functions are pure)
+# ----------------------------------------------------------------------
+
+
+def f1_inv(a: int) -> int:
+    return pow(a, -1, P)
+
+
+# Fq2: (c0, c1) = c0 + c1*u, u^2 = -1
+def f2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def f2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def f2_neg(a):
+    return (-a[0] % P, -a[1] % P)
+
+
+def f2_mul(a, b):
+    t0 = a[0] * b[0] % P
+    t1 = a[1] * b[1] % P
+    return ((t0 - t1) % P, ((a[0] + a[1]) * (b[0] + b[1]) - t0 - t1) % P)
+
+
+def f2_sqr(a):
+    # (c0+c1 u)^2 = (c0+c1)(c0-c1) + 2 c0 c1 u
+    return ((a[0] + a[1]) * (a[0] - a[1]) % P, 2 * a[0] * a[1] % P)
+
+
+def f2_scalar(a, k: int):
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def f2_inv(a):
+    norm = (a[0] * a[0] + a[1] * a[1]) % P
+    ninv = f1_inv(norm)
+    return (a[0] * ninv % P, -a[1] * ninv % P)
+
+
+def f2_conj(a):
+    return (a[0], -a[1] % P)
+
+
+F2_ZERO = (0, 0)
+F2_ONE = (1, 0)
+XI = (9, 1)  # the Fq6 non-residue
+
+
+# Fq6: (a0, a1, a2) over Fq2, v^3 = XI
+def f6_add(a, b):
+    return (f2_add(a[0], b[0]), f2_add(a[1], b[1]), f2_add(a[2], b[2]))
+
+
+def f6_sub(a, b):
+    return (f2_sub(a[0], b[0]), f2_sub(a[1], b[1]), f2_sub(a[2], b[2]))
+
+
+def f6_neg(a):
+    return (f2_neg(a[0]), f2_neg(a[1]), f2_neg(a[2]))
+
+
+def f6_mul(a, b):
+    v0 = f2_mul(a[0], b[0])
+    v1 = f2_mul(a[1], b[1])
+    v2 = f2_mul(a[2], b[2])
+    c0 = f2_add(v0, f2_mul(XI, f2_sub(f2_mul(f2_add(a[1], a[2]), f2_add(b[1], b[2])), f2_add(v1, v2))))
+    c1 = f2_add(f2_sub(f2_mul(f2_add(a[0], a[1]), f2_add(b[0], b[1])), f2_add(v0, v1)), f2_mul(XI, v2))
+    c2 = f2_add(f2_sub(f2_mul(f2_add(a[0], a[2]), f2_add(b[0], b[2])), f2_add(v0, v2)), v1)
+    return (c0, c1, c2)
+
+
+def f6_sqr(a):
+    return f6_mul(a, a)
+
+
+def f6_mul_by_xi(a):
+    # multiply by v: (a0,a1,a2) -> (xi*a2, a0, a1)
+    return (f2_mul(XI, a[2]), a[0], a[1])
+
+
+def f6_inv(a):
+    c0 = f2_sub(f2_sqr(a[0]), f2_mul(XI, f2_mul(a[1], a[2])))
+    c1 = f2_sub(f2_mul(XI, f2_sqr(a[2])), f2_mul(a[0], a[1]))
+    c2 = f2_sub(f2_sqr(a[1]), f2_mul(a[0], a[2]))
+    t = f2_inv(
+        f2_add(f2_mul(a[0], c0), f2_mul(XI, f2_add(f2_mul(a[2], c1), f2_mul(a[1], c2))))
+    )
+    return (f2_mul(c0, t), f2_mul(c1, t), f2_mul(c2, t))
+
+
+F6_ZERO = (F2_ZERO, F2_ZERO, F2_ZERO)
+F6_ONE = (F2_ONE, F2_ZERO, F2_ZERO)
+
+
+# Fq12: (a0, a1) over Fq6, w^2 = v
+def f12_mul(a, b):
+    v0 = f6_mul(a[0], b[0])
+    v1 = f6_mul(a[1], b[1])
+    return (
+        f6_add(v0, f6_mul_by_xi(v1)),
+        f6_sub(f6_sub(f6_mul(f6_add(a[0], a[1]), f6_add(b[0], b[1])), v0), v1),
+    )
+
+
+def f12_sqr(a):
+    return f12_mul(a, a)
+
+
+def f12_conj(a):
+    return (a[0], f6_neg(a[1]))
+
+
+def f12_inv(a):
+    t = f6_inv(f6_sub(f6_sqr(a[0]), f6_mul_by_xi(f6_sqr(a[1]))))
+    return (f6_mul(a[0], t), f6_neg(f6_mul(a[1], t)))
+
+
+def f12_pow(a, e: int):
+    result = F12_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = f12_mul(result, base)
+        base = f12_sqr(base)
+        e >>= 1
+    return result
+
+
+F12_ONE = (F6_ONE, F6_ZERO)
+
+
+# Frobenius coefficients: gamma_1[i] = xi^((p-1)*i/6) in Fq2
+def _frob_coeffs():
+    exp = (P - 1) // 6
+    c = []
+    for i in range(6):
+        # xi^(exp*i) computed in Fq2
+        acc = F2_ONE
+        base = XI
+        e = exp * i
+        while e:
+            if e & 1:
+                acc = f2_mul(acc, base)
+            base = f2_sqr(base)
+            e >>= 1
+        c.append(acc)
+    return c
+
+
+_G1COEF = _frob_coeffs()
+
+
+def f2_frob(a):
+    return f2_conj(a)
+
+
+def f6_frob(a):
+    return (
+        f2_conj(a[0]),
+        f2_mul(f2_conj(a[1]), _G1COEF[2]),
+        f2_mul(f2_conj(a[2]), _G1COEF[4]),
+    )
+
+
+def f12_frob(a):
+    # (b0 + b1 w)^p = frob6(b0) + frob6(b1) * w^(p-1) * w, with
+    # w^(p-1) = xi^((p-1)/6) an Fq2 scalar applied to every coefficient
+    c0 = f6_frob(a[0])
+    t = f6_frob(a[1])
+    c1 = tuple(f2_mul(ti, _G1COEF[1]) for ti in t)
+    return (c0, c1)
+
+
+# ----------------------------------------------------------------------
+# groups (affine tuples; None = infinity)
+# ----------------------------------------------------------------------
+
+G1_GEN = (1, 2)
+G2_GEN = (
+    (
+        10857046999023057135944570762232829481370756359578518086990519993285655852781,
+        11559732032986387107991004021392285783925812861821192530917403151452391805634,
+    ),
+    (
+        8495653923123431417604973247489272438418190587263600148770280649306958101930,
+        4082367875863433681332203403145435568316851327593401208105741076214120093531,
+    ),
+)
+
+B1 = 3
+# b2 = 3 / xi
+B2 = f2_mul((3, 0), f2_inv(XI))
+
+
+def g1_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - x * x * x - B1) % P == 0
+
+
+def g2_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return f2_sub(f2_sqr(y), f2_add(f2_mul(x, f2_sqr(x)), B2)) == F2_ZERO
+
+
+def g1_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a[0] == b[0]:
+        if (a[1] + b[1]) % P == 0:
+            return None
+        lam = (3 * a[0] * a[0]) * f1_inv(2 * a[1]) % P
+    else:
+        lam = (b[1] - a[1]) * f1_inv(b[0] - a[0]) % P
+    x = (lam * lam - a[0] - b[0]) % P
+    return (x, (lam * (a[0] - x) - a[1]) % P)
+
+
+def g1_neg(a):
+    return None if a is None else (a[0], -a[1] % P)
+
+
+def g1_mul(a, k: int):
+    k %= R
+    result = None
+    addend = a
+    while k:
+        if k & 1:
+            result = g1_add(result, addend)
+        addend = g1_add(addend, addend)
+        k >>= 1
+    return result
+
+
+def g2_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a[0] == b[0]:
+        if f2_add(a[1], b[1]) == F2_ZERO:
+            return None
+        lam = f2_mul(f2_scalar(f2_sqr(a[0]), 3), f2_inv(f2_scalar(a[1], 2)))
+    else:
+        lam = f2_mul(f2_sub(b[1], a[1]), f2_inv(f2_sub(b[0], a[0])))
+    x = f2_sub(f2_sub(f2_sqr(lam), a[0]), b[0])
+    return (x, f2_sub(f2_mul(lam, f2_sub(a[0], x)), a[1]))
+
+
+def g2_neg(a):
+    return None if a is None else (a[0], f2_neg(a[1]))
+
+
+def g2_mul(a, k: int):
+    k %= R
+    result = None
+    addend = a
+    while k:
+        if k & 1:
+            result = g2_add(result, addend)
+        addend = g2_add(addend, addend)
+        k >>= 1
+    return result
+
+
+def g2_frobenius(pt):
+    """pi(x, y) = (x^p * gamma_1_2, y^p * gamma_1_3) — the untwist-Frobenius-
+    twist endomorphism on the twisted curve."""
+    if pt is None:
+        return None
+    x, y = pt
+    return (f2_mul(f2_conj(x), _G12), f2_mul(f2_conj(y), _G13))
+
+
+# gamma coefficients for the twist Frobenius: xi^((p-1)/3), xi^((p-1)/2)
+def _f2_pow(a, e):
+    acc = F2_ONE
+    base = a
+    while e:
+        if e & 1:
+            acc = f2_mul(acc, base)
+        base = f2_sqr(base)
+        e >>= 1
+    return acc
+
+
+_G12 = _f2_pow(XI, (P - 1) // 3)
+_G13 = _f2_pow(XI, (P - 1) // 2)
+
+
+def g2_in_subgroup(pt) -> bool:
+    """G2 subgroup membership: psi(P) == [6x^2]P (Scott's criterion for BN
+    curves) — equivalent to (and much faster than) [r]P == O."""
+    if pt is None:
+        return True
+    if not g2_is_on_curve(pt):
+        return False
+    return g2_frobenius(pt) == g2_mul(pt, 6 * BN_X * BN_X)
+
+
+# ----------------------------------------------------------------------
+# optimal ate pairing
+# ----------------------------------------------------------------------
+
+
+# Twist embedding: map G2 (on E'/Fq2) into E(Fq12):
+#   (x, y) -> (x * w^2, y * w^3)
+# where w^2 = v (Fq6 basis) — x*w^2 has Fq6 coords (0, x, 0) at position 0,
+# y*w^3 = y*v*w has Fq6 coords (0, y, 0) at position 1.
+
+
+def _twist(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return ((F2_ZERO, x, F2_ZERO), F6_ZERO), ((F2_ZERO, y, F2_ZERO),)
+
+
+def _f12_from_f2_at(c, six_pos: int, w_pos: int):
+    f6 = [F2_ZERO, F2_ZERO, F2_ZERO]
+    f6[six_pos] = c
+    f6 = tuple(f6)
+    return (f6, F6_ZERO) if w_pos == 0 else (F6_ZERO, f6)
+
+
+def _embed_g2(pt):
+    """G2 point -> coordinates in Fq12 via the twist map."""
+    x, y = pt
+    return (_f12_from_f2_at(x, 1, 0), _f12_from_f2_at(y, 1, 1))
+
+
+def _embed_g1(pt):
+    x, y = pt
+    return (((x, 0), F2_ZERO, F2_ZERO), F6_ZERO), (((y, 0), F2_ZERO, F2_ZERO), F6_ZERO)
+
+
+def f12_sub(a, b):
+    return (f6_sub(a[0], b[0]), f6_sub(a[1], b[1]))
+
+
+def f12_add(a, b):
+    return (f6_add(a[0], b[0]), f6_add(a[1], b[1]))
+
+
+def f12_scalarF6(a, s):
+    return (f6_mul(a[0], s[0] if False else s), f6_mul(a[1], s))
+
+
+def _is_zero12(a):
+    return a == (F6_ZERO, F6_ZERO)
+
+
+def _line_eval(q1, q2, p):
+    """Line through embedded points q1, q2 evaluated at embedded p (all in
+    E(Fq12) affine coords).  Returns the Fq12 line value."""
+    x1, y1 = q1
+    x2, y2 = q2
+    xp, yp = p
+    if x1 == x2:
+        if f12_add(y1, y2) == (F6_ZERO, F6_ZERO):
+            # vertical: x_p - x1
+            return f12_sub(xp, x1)
+        lam = f12_mul(
+            f12_scalar_int(f12_sqr(x1), 3), f12_inv(f12_scalar_int(y1, 2))
+        )
+    else:
+        lam = f12_mul(f12_sub(y2, y1), f12_inv(f12_sub(x2, x1)))
+    # l(P) = (y_p - y1) - lam (x_p - x1)
+    return f12_sub(f12_sub(yp, y1), f12_mul(lam, f12_sub(xp, x1)))
+
+
+def f12_scalar_int(a, k: int):
+    return (
+        tuple(f2_scalar(c, k) for c in a[0]),
+        tuple(f2_scalar(c, k) for c in a[1]),
+    )
+
+
+def miller_loop(q, p):
+    """Optimal ate Miller loop f_{6x+2,Q}(P) * l_{[6x+2]Q,piQ}(P) *
+    l_{[6x+2]Q+piQ, -pi2Q}(P) for q in G2, p in G1 (affine, not infinity)."""
+    if q is None or p is None:
+        return F12_ONE
+    eq = _embed_g2(q)
+    ep = _embed_g1(p)
+    t = q  # running point on the twist (cheaper arithmetic)
+    f = F12_ONE
+    for bit in bin(ATE_LOOP_COUNT)[3:]:
+        f = f12_mul(f12_sqr(f), _line_eval(_embed_g2(t), _embed_g2(t), ep))
+        t = g2_add(t, t)
+        if bit == "1":
+            f = f12_mul(f, _line_eval(_embed_g2(t), eq, ep))
+            t = g2_add(t, q)
+    # the two final lines with Frobenius images
+    q1 = g2_frobenius(q)
+    q2 = g2_neg(g2_frobenius(q1))
+    f = f12_mul(f, _line_eval(_embed_g2(t), _embed_g2(q1), ep))
+    t = g2_add(t, q1)
+    f = f12_mul(f, _line_eval(_embed_g2(t), _embed_g2(q2), ep))
+    return f
+
+
+def final_exponentiation(f):
+    """f^((p^12-1)/r): easy part (p^6-1)(p^2+1) then hard part by plain
+    exponentiation of the cofactor (exact, if not the fastest route)."""
+    # easy part
+    f1 = f12_conj(f)  # f^(p^6)
+    f2i = f12_inv(f)
+    f = f12_mul(f1, f2i)  # f^(p^6 - 1)
+    f = f12_mul(f12_frob(f12_frob(f)), f)  # ^(p^2+1)
+    # hard part: (p^4 - p^2 + 1)/r
+    e = (P**4 - P**2 + 1) // R
+    return f12_pow(f, e)
+
+
+def pairing(q, p):
+    """e(p, q) for p in G1, q in G2 (note the conventional argument order
+    e: G1 x G2 -> GT)."""
+    if p is None or q is None:
+        return F12_ONE
+    return final_exponentiation(miller_loop(q, p))
+
+
+def multi_pairing(pairs) -> bool:
+    """prod e(p_i, q_i) == 1?  One shared final exponentiation."""
+    f = F12_ONE
+    for p, q in pairs:
+        if p is None or q is None:
+            continue
+        f = f12_mul(f, miller_loop(q, p))
+    return final_exponentiation(f) == F12_ONE
+
+
+# ----------------------------------------------------------------------
+# ark-serialize compressed encoding
+# ----------------------------------------------------------------------
+
+FLAG_INF = 1 << 6
+FLAG_NEG = 1 << 7
+
+
+class DeserializeError(Exception):
+    pass
+
+
+def _fq_from_le(b: bytes) -> int:
+    v = int.from_bytes(b, "little")
+    if v >= P:
+        raise DeserializeError("base field element not canonical")
+    return v
+
+
+def _fq2_gt_half(y) -> bool:
+    """arkworks 'is negative': y > -y under the Fq2 lexicographic order
+    (c1 first, then c0)."""
+    ny = f2_neg(y)
+    return (y[1], y[0]) > (ny[1], ny[0])
+
+
+def _fq_gt_half(y: int) -> bool:
+    return y > P - y
+
+
+def g1_deserialize_compressed(b: bytes, validate: bool = True):
+    if len(b) != 32:
+        raise DeserializeError(f"invalid G1 length {len(b)}")
+    flags = b[31] & 0xC0
+    data = bytes(b[:31]) + bytes([b[31] & 0x3F])
+    if flags & FLAG_INF:
+        if any(data):
+            raise DeserializeError("non-zero infinity encoding")
+        return None
+    x = _fq_from_le(data)
+    rhs = (x * x * x + B1) % P
+    y = pow(rhs, (P + 1) // 4, P)
+    if y * y % P != rhs:
+        raise DeserializeError("x not on curve")
+    if bool(flags & FLAG_NEG) != _fq_gt_half(y):
+        y = P - y
+    pt = (x, y)
+    if validate and not g1_is_on_curve(pt):
+        raise DeserializeError("G1 point not on curve")
+    return pt
+
+
+def g1_serialize_compressed(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 31 + bytes([FLAG_INF])
+    x, y = pt
+    b = bytearray(x.to_bytes(32, "little"))
+    if _fq_gt_half(y):
+        b[31] |= FLAG_NEG
+    return bytes(b)
+
+
+def _f2_sqrt(a):
+    """Square root in Fq2 (p = 3 mod 4 route via the norm)."""
+    if a == F2_ZERO:
+        return F2_ZERO
+    # Tonelli-like: candidate = a^((q+7)/16)? For Fq2 with q = p^2,
+    # q = 1 mod 4 — use the complex method: sqrt(a) via norm.
+    c0, c1 = a
+    if c1 == 0:
+        # sqrt of base-field element inside Fq2
+        s = pow(c0, (P + 1) // 4, P)
+        if s * s % P == c0:
+            return (s, 0)
+        # sqrt(c0) = s'*u with s'^2 = -c0
+        s = pow((-c0) % P, (P + 1) // 4, P)
+        if s * s % P == (-c0) % P:
+            return (0, s)
+        return None
+    # norm = c0^2 + c1^2; alpha = sqrt(norm) in Fq
+    norm = (c0 * c0 + c1 * c1) % P
+    alpha = pow(norm, (P + 1) // 4, P)
+    if alpha * alpha % P != norm:
+        return None
+    # delta = (c0 + alpha)/2
+    inv2 = f1_inv(2)
+    delta = (c0 + alpha) * inv2 % P
+    x0 = pow(delta, (P + 1) // 4, P)
+    if x0 * x0 % P != delta:
+        delta = (c0 - alpha) * inv2 % P
+        x0 = pow(delta, (P + 1) // 4, P)
+        if x0 * x0 % P != delta:
+            return None
+    x1 = c1 * inv2 % P * f1_inv(x0) % P
+    cand = (x0, x1)
+    return cand if f2_sqr(cand) == a else None
+
+
+def g2_deserialize_compressed(b: bytes, validate: bool = True):
+    if len(b) != 64:
+        raise DeserializeError(f"invalid G2 length {len(b)}")
+    flags = b[63] & 0xC0
+    c0 = _fq_from_le(b[:32])
+    data1 = bytes(b[32:63]) + bytes([b[63] & 0x3F])
+    c1 = _fq_from_le(data1)
+    if flags & FLAG_INF:
+        if c0 or c1:
+            raise DeserializeError("non-zero infinity encoding")
+        return None
+    x = (c0, c1)
+    rhs = f2_add(f2_mul(x, f2_sqr(x)), B2)
+    y = _f2_sqrt(rhs)
+    if y is None:
+        raise DeserializeError("x not on twist curve")
+    if bool(flags & FLAG_NEG) != _fq2_gt_half(y):
+        y = f2_neg(y)
+    pt = (x, y)
+    if validate and not g2_in_subgroup(pt):
+        raise DeserializeError("G2 point not in subgroup")
+    return pt
+
+
+def g2_serialize_compressed(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 63 + bytes([FLAG_INF])
+    x, y = pt
+    b = bytearray(x[0].to_bytes(32, "little") + x[1].to_bytes(32, "little"))
+    if _fq2_gt_half(y):
+        b[63] |= FLAG_NEG
+    return bytes(b)
+
+
+def fr_deserialize(b: bytes) -> int:
+    """ark Fr 'uncompressed' canonical: 32 LE bytes, must be < r."""
+    if len(b) != 32:
+        raise DeserializeError(f"Invalid Fr length {len(b)}")
+    v = int.from_bytes(b, "little")
+    if v >= R:
+        raise DeserializeError("scalar not canonical")
+    return v
+
+
+def fr_serialize(v: int) -> bytes:
+    return (v % R).to_bytes(32, "little")

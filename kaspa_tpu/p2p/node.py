@@ -96,6 +96,13 @@ class Node:
         """Staging commit: rebuild the mempool facade on the new consensus
         (pending txs are dropped — they reference the stale DAG)."""
         self.mining = MiningManager(new_consensus)
+        self._drop_ibd_pipeline()
+
+    def _drop_ibd_pipeline(self) -> None:
+        cached = getattr(self, "_ibd_pipeline", None)
+        if cached is not None:
+            self._ibd_pipeline = None
+            cached[1].shutdown()
 
     # --- hub / relay (flow_context.rs on_new_block -> broadcast) ---
 
@@ -187,11 +194,7 @@ class Node:
         elif msg_type == MSG_IBD_BLOCKS:
             staging = self._ibd.get("staging") if self._ibd.get("peer") is peer else None
             target = staging.consensus if staging is not None else self.consensus
-            for block in payload:
-                try:
-                    target.validate_and_insert_block(block)
-                except RuleError:
-                    pass
+            self._insert_ibd_batch(target, payload)
             if staging is not None:
                 self._finalize_proof_ibd(staging)
         elif msg_type == MSG_REQUEST_IBD_CHAIN_INFO:
@@ -241,6 +244,32 @@ class Node:
             )
         elif msg_type == MSG_PP_UTXO_CHUNK:
             self._on_pp_utxo_chunk(peer, payload)
+
+    def _insert_ibd_batch(self, target: Consensus, blocks) -> None:
+        """Bulk intake through the concurrent pipeline: the whole batch goes
+        in flight at once (children park on pending parents in the deps
+        manager), stage workers overlap hashing/device dispatch, and the
+        virtual worker drains multiple blocks per resolution — the IBD
+        analog of the reference's pipelined block processing
+        (flows/src/ibd/flow.rs feeding consensus's pipeline).  The wire
+        reader holds the node lock throughout, so no RPC reader observes
+        intermediate virtual state.  One pipeline is kept per sync target
+        (not per message) so a chunked IBD doesn't churn threads."""
+        from kaspa_tpu.pipeline import ConsensusPipeline
+
+        cached = getattr(self, "_ibd_pipeline", None)
+        if cached is None or cached[0] is not target:
+            if cached is not None:
+                cached[1].shutdown()
+            cached = (target, ConsensusPipeline(target, workers=2))
+            self._ibd_pipeline = cached
+        pipe = cached[1]
+        futures = [pipe.submit(b) for b in blocks]
+        for f in futures:
+            try:
+                f.result(timeout=600)
+            except RuleError:
+                pass  # invalid blocks within an IBD batch are skipped
 
     def _on_relay_block(self, peer: Peer, block: Block) -> None:
         peer.known_blocks.add(block.hash)  # sender has it: don't echo the inv back
@@ -359,6 +388,7 @@ class Node:
 
     def _finalize_proof_ibd(self, staging) -> None:
         self._ibd = {}
+        self._drop_ibd_pipeline()
         new_sink = staging.consensus.sink()
         new_work = staging.consensus.storage.ghostdag.get_blue_work(new_sink)
         cur_work = self.consensus.storage.ghostdag.get_blue_work(self.consensus.sink())

@@ -45,18 +45,21 @@ class BatchScriptChecker:
 
     def __init__(self, sig_cache: SigCache | None = None, vm_fallback=None):
         self.sig_cache = sig_cache if sig_cache is not None else SigCache()
-        self.vm_fallback = vm_fallback  # fn(tx, entries, input_index) -> None | raise
+        # contract: fn(tx, entries, input_index, reused, pov_daa_score) — the
+        # daa score drives fork-activation gating inside the engine
+        self.vm_fallback = vm_fallback
         self._jobs: list[_Job] = []
         self._results: dict[int, Exception | None] = {}
 
-    def collect_tx(self, token: int, tx, utxo_entries, reused=None) -> None:
-        """Queue all input script checks of `tx`; result under `token`."""
+    def collect_tx(self, token: int, tx, utxo_entries, reused=None, pov_daa_score=None) -> None:
+        """Queue all input script checks of `tx`; result under `token`.
+        ``pov_daa_score`` feeds fork-activation gating in the VM fallback."""
         if reused is None:
             reused = chash.SigHashReusedValues()
         self._results.setdefault(token, None)
         for i, (inp, entry) in enumerate(zip(tx.inputs, utxo_entries)):
             try:
-                self._collect_input(token, tx, utxo_entries, i, inp, entry, reused)
+                self._collect_input(token, tx, utxo_entries, i, inp, entry, reused, pov_daa_score)
             except ScriptCheckError as e:
                 self._fail(token, e)
 
@@ -64,8 +67,14 @@ class BatchScriptChecker:
         if self._results.get(token) is None:
             self._results[token] = err
 
-    def _collect_input(self, token, tx, utxo_entries, i, inp, entry, reused):
+    def _collect_input(self, token, tx, utxo_entries, i, inp, entry, reused, pov_daa_score=None):
         cls = standard.classify_script(entry.script_public_key)
+        if cls in (standard.ScriptClass.PUB_KEY, standard.ScriptClass.PUB_KEY_ECDSA):
+            # runtime sig-op parity with the engine path (lib.rs:545 + :898):
+            # the single CheckSig consumes one committed sig op
+            commit = inp.compute_commit
+            if commit.sig_op_count() is not None and commit.sig_op_count() < 1:
+                raise ScriptCheckError("exceeded sig op limit of 0", i)
         if cls == standard.ScriptClass.PUB_KEY:
             data = standard.parse_single_push(inp.signature_script)
             if data is None or len(data) == 0:
@@ -95,7 +104,7 @@ class BatchScriptChecker:
             if self.vm_fallback is None:
                 raise ScriptCheckError(f"unsupported script class {cls.value} (VM fallback not wired)", i)
             try:
-                self.vm_fallback(tx, utxo_entries, i, reused)
+                self.vm_fallback(tx, utxo_entries, i, reused, pov_daa_score)
             except Exception as e:  # VM raises on invalid script
                 raise ScriptCheckError(str(e), i) from e
 

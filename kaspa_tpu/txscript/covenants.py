@@ -1,0 +1,127 @@
+"""Covenant execution contexts + covenant-id derivation (Toccata).
+
+Reference: crypto/txscript/src/covenants.rs and
+consensus/core/src/hashing/covenant_id.rs.  A covenant id is born in a
+"genesis" transaction (derived from the authorizing input's outpoint and
+the authorized outputs) and then *continues* through outputs whose
+authorizing input already carries the same id.  The script engine's
+introspection opcodes (OpAuthOutputCount/Idx, OpCovInput*/OpCovOutput*)
+read the pre-computed contexts built here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kaspa_tpu.crypto.hashing import CovenantID as _covenant_hasher
+
+
+class CovenantsError(Exception):
+    pass
+
+
+def covenant_id(outpoint, auth_outputs) -> bytes:
+    """hashing/covenant_id.rs: id = H(outpoint || len || (index, value,
+    spk)...) — the binding excludes the outputs' own covenant fields to
+    avoid self-reference."""
+    auth_outputs = list(auth_outputs)
+    h = _covenant_hasher()
+    h.update(outpoint.transaction_id)
+    h.update(outpoint.index.to_bytes(4, "little"))
+    h.update(len(auth_outputs).to_bytes(8, "little"))
+    for index, output in auth_outputs:
+        h.update(int(index).to_bytes(4, "little"))
+        h.update(output.value.to_bytes(8, "little"))
+        h.update(output.script_public_key.version.to_bytes(2, "little"))
+        h.update(len(output.script_public_key.script).to_bytes(8, "little"))
+        h.update(output.script_public_key.script)
+    return h.digest()
+
+
+@dataclass
+class CovenantInputContext:
+    auth_outputs: list[int] = field(default_factory=list)
+
+
+@dataclass
+class CovenantSharedContext:
+    input_indices: list[int] = field(default_factory=list)
+    output_indices: list[int] = field(default_factory=list)
+
+
+@dataclass
+class CovenantsContext:
+    input_ctxs: dict = field(default_factory=dict)  # input idx -> CovenantInputContext
+    shared_ctxs: dict = field(default_factory=dict)  # covenant id -> CovenantSharedContext
+
+    # --- opcode accessors (covenants.rs:66-94) ---
+
+    def auth_output_index(self, input_idx: int, k: int) -> int:
+        ctx = self.input_ctxs.get(input_idx)
+        auth = ctx.auth_outputs if ctx else []
+        if k >= len(auth):
+            raise CovenantsError(
+                f"auth output index {k} for input {input_idx} out of bounds ({len(auth)})"
+            )
+        return auth[k]
+
+    def num_auth_outputs(self, input_idx: int) -> int:
+        ctx = self.input_ctxs.get(input_idx)
+        return len(ctx.auth_outputs) if ctx else 0
+
+    def num_covenant_inputs(self, cov_id: bytes) -> int:
+        ctx = self.shared_ctxs.get(cov_id)
+        return len(ctx.input_indices) if ctx else 0
+
+    def covenant_input_index(self, cov_id: bytes, k: int) -> int:
+        ctx = self.shared_ctxs.get(cov_id)
+        indices = ctx.input_indices if ctx else []
+        if k >= len(indices):
+            raise CovenantsError(f"covenant input index {k} out of bounds for {cov_id.hex()}")
+        return indices[k]
+
+    def num_covenant_outputs(self, cov_id: bytes) -> int:
+        ctx = self.shared_ctxs.get(cov_id)
+        return len(ctx.output_indices) if ctx else 0
+
+    def covenant_output_index(self, cov_id: bytes, k: int) -> int:
+        ctx = self.shared_ctxs.get(cov_id)
+        indices = ctx.output_indices if ctx else []
+        if k >= len(indices):
+            raise CovenantsError(f"covenant output index {k} out of bounds for {cov_id.hex()}")
+        return indices[k]
+
+    @classmethod
+    def from_tx(cls, tx, utxo_entries) -> "CovenantsContext":
+        """covenants.rs from_tx: collect continuation relations into the
+        engine contexts and validate genesis groups by recomputing their
+        covenant ids; genesis outputs do NOT populate contexts."""
+        ctx = cls()
+        genesis_groups: dict = {}  # (auth input idx, covenant id) -> [output idx]
+
+        for i, entry in enumerate(utxo_entries):
+            if entry.covenant_id is not None:
+                ctx.shared_ctxs.setdefault(entry.covenant_id, CovenantSharedContext()).input_indices.append(i)
+
+        for i, output in enumerate(tx.outputs):
+            binding = output.covenant
+            if binding is None:
+                continue
+            auth_idx = binding.authorizing_input
+            if auth_idx >= len(utxo_entries):
+                raise CovenantsError(f"output {i} authorizing input {auth_idx} out of bounds")
+            entry = utxo_entries[auth_idx]
+            if entry.covenant_id is not None and entry.covenant_id == binding.covenant_id:
+                # continuation
+                ctx.input_ctxs.setdefault(auth_idx, CovenantInputContext()).auth_outputs.append(i)
+                ctx.shared_ctxs[binding.covenant_id].output_indices.append(i)
+            else:
+                # genesis (absent or different id on the authorizing input)
+                genesis_groups.setdefault((auth_idx, binding.covenant_id), []).append(i)
+
+        for (auth_idx, cov_id), output_indices in genesis_groups.items():
+            outpoint = tx.inputs[auth_idx].previous_outpoint
+            expected = covenant_id(outpoint, ((j, tx.outputs[j]) for j in output_indices))
+            if expected != cov_id:
+                raise CovenantsError(f"wrong genesis covenant id on input {auth_idx}")
+        return ctx

@@ -1,0 +1,77 @@
+"""Runtime resource metering for script execution.
+
+Reference: crypto/txscript/src/runtime_resource_meter.rs — two regimes:
+the legacy sig-op counter (pre-Toccata: each input commits to a sig-op
+count, executed sig ops may not exceed it) and the Toccata script-units
+meter (sig ops cost `sigop_script_units` each, newly pushed bytes cost
+1:1, ZK precompiles charge their tag cost; the total is bounded by the
+input's committed budget).
+"""
+
+from __future__ import annotations
+
+
+class MeterError(Exception):
+    """ExceededSigOpLimit / ExceededCommittedScriptUnits."""
+
+
+class RuntimeSigOpCounter:
+    """Pre-Toccata regime: count executed sig ops against the input limit
+    (runtime_resource_meter.rs:9-71)."""
+
+    def __init__(self, sig_op_limit: int):
+        self.sig_op_limit = sig_op_limit
+        self.sig_op_remaining = sig_op_limit
+
+    def consume_sig_ops(self, count: int = 1) -> None:
+        if self.sig_op_remaining < count:
+            raise MeterError(f"exceeded sig op limit of {self.sig_op_limit}")
+        self.sig_op_remaining -= count
+
+    @property
+    def used_sig_ops(self) -> int:
+        return self.sig_op_limit - self.sig_op_remaining
+
+    # script-unit charges are a no-op in this regime
+    def consume_script_units(self, units: int) -> None:
+        pass
+
+    def charge_newly_pushed_bytes(self, n: int) -> None:
+        pass
+
+    @property
+    def used_script_units(self) -> int:
+        return 0
+
+
+class RuntimeScriptUnitMeter:
+    """Toccata regime: everything priced in script units against the
+    committed budget (runtime_resource_meter.rs:74-121).  `used` reported
+    in the over-budget error saturates, mirroring the reference's
+    saturating_add diagnostics."""
+
+    def __init__(self, sigop_script_units: int, script_units_limit: int):
+        self.used_sig_ops = 0
+        self.sigop_script_units = sigop_script_units
+        self.script_units_limit = script_units_limit
+        self.remaining_script_units = script_units_limit
+
+    @property
+    def used_script_units(self) -> int:
+        return self.script_units_limit - self.remaining_script_units
+
+    def consume_script_units(self, units: int) -> None:
+        if units > self.remaining_script_units:
+            overflow = units - self.remaining_script_units
+            used = min(self.script_units_limit + overflow, (1 << 64) - 1)
+            raise MeterError(
+                f"exceeded committed script units: used {used}, limit {self.script_units_limit}"
+            )
+        self.remaining_script_units -= units
+
+    def consume_sig_ops(self, count: int = 1) -> None:
+        self.consume_script_units(count * self.sigop_script_units)
+        self.used_sig_ops = min(self.used_sig_ops + count, (1 << 16) - 1)
+
+    def charge_newly_pushed_bytes(self, n: int) -> None:
+        self.consume_script_units(n)  # pushed bytes are charged 1:1

@@ -5,9 +5,12 @@ Faithful re-implementation of the reference's TxScriptEngine
 opcode set: data pushes, flow control, stack/splice ops, comparison and
 arithmetic (8-byte minimally-encoded numbers), crypto opcodes
 (Blake2b/SHA256/CheckSig/CheckMultiSig families) and lock-time/sequence
-verification, plus P2SH evaluation.  Post-Toccata extensions (covenants,
-introspection, ZK precompiles, runtime resource metering) are flag-gated
-exactly like the reference and land in a later milestone.
+verification, plus P2SH evaluation.  The post-Toccata surface — covenant
+introspection (0xb2-0xd8), ZK precompiles (OpZkPrecompile 0xa6), blake3
+ops, CheckSigFromStack, splice/bitwise/arithmetic re-enables, runtime
+script-unit metering and the relaxed limits — is implemented behind
+EngineFlags(covenants_enabled), gated exactly like the reference; the
+KIP-10 introspection subset (0xb3/b4/b9/be/bf/c2/c3) is ungated.
 
 This is the fall-back path behind the TPU batch fast-path
 (txscript/batch.py): nonstandard scripts route here; standard P2PK spends
@@ -22,6 +25,7 @@ Limits (lib.rs:76-87): stack 244 combined, element 520 bytes, script
 from __future__ import annotations
 
 import hashlib
+from dataclasses import dataclass as _dataclass
 
 from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.txscript.caches import SigCache
@@ -192,23 +196,87 @@ def check_minimal_data_push(op: int, data: bytes) -> None:
 _COND_TRUE, _COND_FALSE, _COND_SKIP = 1, 0, -1
 
 
+@_dataclass
+class EngineFlags:
+    """Fork-dependent engine behavior (lib.rs EngineFlags).  The Toccata
+    master switch enables covenants, introspection breadth, ZK precompiles,
+    splice/bitwise/arithmetic re-enables and the post-Toccata limits."""
+
+    covenants_enabled: bool = False
+
+
+# post-Toccata limits (lib.rs:78-82)
+MAX_SCRIPTS_SIZE_POST_TOCCATA = 1_000_000
+MAX_SCRIPT_ELEMENT_SIZE_POST_TOCCATA = 1_000_000
+MAX_OPS_PER_SCRIPT_POST_TOCCATA = 1_000_000
+
+
 class TxScriptEngine:
     """Executes (signature_script, script_public_key[, p2sh]) for one input."""
 
-    def __init__(self, tx=None, utxo_entries=None, input_index: int = 0, reused=None, sig_cache: SigCache | None = None):
+    def __init__(
+        self,
+        tx=None,
+        utxo_entries=None,
+        input_index: int = 0,
+        reused=None,
+        sig_cache: SigCache | None = None,
+        flags: EngineFlags | None = None,
+        covenants_ctx=None,
+        meter=None,
+        seq_commit_accessor=None,
+    ):
         self.tx = tx
         self.utxo_entries = utxo_entries
         self.input_index = input_index
         self.reused = reused if reused is not None else chash.SigHashReusedValues()
         self.sig_cache = sig_cache if sig_cache is not None else SigCache()
+        self.flags = flags if flags is not None else EngineFlags()
+        self.covenants_ctx = covenants_ctx  # built lazily when needed
+        self.meter = meter  # RuntimeResourceMeter; None = uncharged regime
+        self.seq_commit_accessor = seq_commit_accessor  # KIP-21 lanes
         self.dstack: list[bytes] = []
         self.astack: list[bytes] = []
         self.cond_stack: list[int] = []
         self.num_ops = 0
+        self._pushed_bytes = 0  # per-opcode data-stack push accounting
+
+    # --- flag-dependent limits (lib.rs:136-147) ---
+
+    @property
+    def max_scripts_size(self) -> int:
+        return MAX_SCRIPTS_SIZE_POST_TOCCATA if self.flags.covenants_enabled else MAX_SCRIPTS_SIZE
+
+    @property
+    def max_element_size(self) -> int:
+        return MAX_SCRIPT_ELEMENT_SIZE_POST_TOCCATA if self.flags.covenants_enabled else MAX_SCRIPT_ELEMENT_SIZE
+
+    @property
+    def max_ops(self) -> int:
+        return MAX_OPS_PER_SCRIPT_POST_TOCCATA if self.flags.covenants_enabled else MAX_OPS_PER_SCRIPT
+
+    def consume_script_units(self, units: int) -> None:
+        if self.meter is not None:
+            from kaspa_tpu.txscript.resource_meter import MeterError
+
+            try:
+                self.meter.consume_script_units(units)
+            except MeterError as e:
+                raise TxScriptError(str(e)) from e
+
+    def consume_sig_op_cost(self, count: int = 1) -> None:
+        if self.meter is not None:
+            from kaspa_tpu.txscript.resource_meter import MeterError
+
+            try:
+                self.meter.consume_sig_ops(count)
+            except MeterError as e:
+                raise TxScriptError(str(e)) from e
 
     # --- stack helpers ---
 
     def _push(self, item: bytes):
+        self._pushed_bytes += len(item)
         self.dstack.append(item)
 
     def _pop(self) -> bytes:
@@ -258,7 +326,7 @@ class TxScriptEngine:
         if not any(scripts):
             raise TxScriptError("false stack entry at end of script execution")
         for s in scripts:
-            if len(s) > MAX_SCRIPTS_SIZE:
+            if len(s) > self.max_scripts_size:
                 raise TxScriptError(f"script size {len(s)} above limit")
 
         saved_stack = None
@@ -279,7 +347,7 @@ class TxScriptEngine:
 
     def execute_standalone(self, script: bytes) -> None:
         """StandAloneScripts source (tests / script-builder checks)."""
-        if len(script) > MAX_SCRIPTS_SIZE:
+        if len(script) > self.max_scripts_size:
             raise TxScriptError("script too large")
         if not script:
             raise TxScriptError("no scripts to execute")
@@ -299,7 +367,7 @@ class TxScriptEngine:
 
     def execute_script(self, script: bytes, verify_only_push: bool) -> None:
         for op, data in parse_script(script):
-            if op in _DISABLED or op in _PRE_TOCCATA_DISABLED:
+            if op in _DISABLED or (op in _PRE_TOCCATA_DISABLED and not self.flags.covenants_enabled):
                 raise TxScriptError(f"attempt to execute disabled opcode {op:#x}")
             if op in _ALWAYS_ILLEGAL:
                 raise TxScriptError(f"attempt to execute reserved opcode {op:#x}")
@@ -316,9 +384,9 @@ class TxScriptEngine:
     def _execute_opcode(self, op: int, data: bytes | None) -> None:
         if not is_push_opcode(op):
             self.num_ops += 1
-            if self.num_ops > MAX_OPS_PER_SCRIPT:
-                raise TxScriptError(f"exceeded max operation limit of {MAX_OPS_PER_SCRIPT}")
-        elif data is not None and len(data) > MAX_SCRIPT_ELEMENT_SIZE:
+            if self.num_ops > self.max_ops:
+                raise TxScriptError(f"exceeded max operation limit of {self.max_ops}")
+        elif data is not None and len(data) > self.max_element_size:
             raise TxScriptError(f"element size {len(data)} above limit")
 
         executing = self.is_executing()
@@ -327,11 +395,27 @@ class TxScriptEngine:
 
         if data is not None:  # push opcodes with payload
             if executing:
-                check_minimal_data_push(op, data)
+                # post-Toccata drops minimal-push enforcement (lib.rs:623)
+                if not self.flags.covenants_enabled:
+                    check_minimal_data_push(op, data)
                 self._push(data)
+                self._charge_pushed_bytes()
             return
 
         self._OPS[op](self)
+        self._charge_pushed_bytes()
+
+    def _charge_pushed_bytes(self) -> None:
+        """Script-unit charge for bytes this opcode pushed (lib.rs:632);
+        a no-op under the sig-op metering regime."""
+        pushed, self._pushed_bytes = self._pushed_bytes, 0
+        if pushed and self.meter is not None:
+            from kaspa_tpu.txscript.resource_meter import MeterError
+
+            try:
+                self.meter.charge_newly_pushed_bytes(pushed)
+            except MeterError as e:
+                raise TxScriptError(str(e)) from e
 
     # --- opcode implementations ---
 
@@ -583,10 +667,14 @@ class TxScriptEngine:
         self._push_num(1 if mn <= x < mx else 0)
 
     def _op_sha256(self):
-        self._push(hashlib.sha256(self._pop()).digest())
+        data = self._pop()
+        self.consume_script_units(len(data))  # HashOpcodePricing::Sha256
+        self._push(hashlib.sha256(data).digest())
 
     def _op_blake2b(self):
-        self._push(hashlib.blake2b(self._pop(), digest_size=32).digest())
+        data = self._pop()
+        self.consume_script_units(2 * len(data))  # HashOpcodePricing::Blake2b
+        self._push(hashlib.blake2b(data, digest_size=32).digest())
 
     # --- signature checks (lib.rs:885-942 semantics via the batch backend) ---
 
@@ -598,6 +686,7 @@ class TxScriptEngine:
         from kaspa_tpu.crypto import eclib
 
         self._require_tx()
+        self.consume_sig_op_cost(1)  # lib.rs:898: charged before the check
         if len(key) != 32:
             raise TxScriptError("invalid public key encoding")
         if eclib.lift_x(int.from_bytes(key, "big")) is None:
@@ -616,6 +705,7 @@ class TxScriptEngine:
         from kaspa_tpu.crypto import eclib
 
         self._require_tx()
+        self.consume_sig_op_cost(1)  # lib.rs:927
         if len(key) != 33 or key[0] not in (2, 3):
             raise TxScriptError("invalid public key encoding")
         if eclib.parse_compressed(key) is None:
@@ -663,7 +753,7 @@ class TxScriptEngine:
         if num_keys > MAX_PUB_KEYS_PER_MULTISIG:
             raise TxScriptError(f"too many pubkeys {num_keys} > {MAX_PUB_KEYS_PER_MULTISIG}")
         self.num_ops += num_keys
-        if self.num_ops > MAX_OPS_PER_SCRIPT:
+        if self.num_ops > self.max_ops:
             raise TxScriptError("exceeded max operation limit")
         if len(self.dstack) < num_keys:
             raise TxScriptError("invalid stack operation")
@@ -747,6 +837,455 @@ class TxScriptEngine:
     def _op_invalid(self):
         raise TxScriptError("attempt to execute invalid opcode")
 
+    # ------------------------------------------------------------------
+    # Toccata surface: splice/bitwise/arithmetic re-enables, introspection,
+    # covenants, ZK precompiles, blake3, CheckSigFromStack
+    # (opcodes/mod.rs 0x7e-0x97 gated bodies and 0xa6-0xda)
+    # ------------------------------------------------------------------
+
+    def _require_covenants(self):
+        if not self.flags.covenants_enabled:
+            raise TxScriptError("attempt to execute reserved opcode (covenants disabled)")
+
+    def _pop_usize(self) -> int:
+        v = self._pop_i32()
+        if v < 0:
+            raise TxScriptError(f"negative index {v}")
+        return v
+
+    def _pop_hash(self) -> bytes:
+        v = self._pop()
+        if len(v) != 32:
+            raise TxScriptError(f"invalid hash length {len(v)}")
+        return v
+
+    def _substring(self, data: bytes, start: int, end: int) -> bytes:
+        if end < start:
+            raise TxScriptError(f"invalid range {start}..{end}")
+        if end - start > MAX_SCRIPT_ELEMENT_SIZE_POST_TOCCATA:
+            raise TxScriptError("substring too big")
+        if end > len(data):
+            raise TxScriptError(f"out of bounds substring {start}..{end} of {len(data)}")
+        return data[start:end]
+
+    def _op_cat(self):
+        self._require_covenants()
+        b = self._pop()
+        a = self._pop()
+        self._push(a + b)
+
+    def _op_substr(self):
+        self._require_covenants()
+        end = self._pop_usize()
+        start = self._pop_usize()
+        data = self._pop()
+        self._push(self._substring(data, start, end))
+
+    def _op_invert(self):
+        self._require_covenants()
+        self._push(bytes(~b & 0xFF for b in self._pop()))
+
+    def _bitwise(self, fn):
+        self._require_covenants()
+        b = self._pop()
+        a = self._pop()
+        if len(a) != len(b):
+            raise TxScriptError("bitwise operands must be of equal length")
+        self._push(bytes(fn(x, y) for x, y in zip(a, b)))
+
+    def _op_and(self):
+        self._bitwise(lambda x, y: x & y)
+
+    def _op_or(self):
+        self._bitwise(lambda x, y: x | y)
+
+    def _op_xor(self):
+        self._bitwise(lambda x, y: x ^ y)
+
+    def _op_mul(self):
+        self._require_covenants()
+        b, a = self._pop_num(), self._pop_num()
+        self._push_num(self._checked(a * b))
+
+    def _op_div(self):
+        self._require_covenants()
+        b, a = self._pop_num(), self._pop_num()
+        if b == 0 or (a == I64_MIN and b == -1):
+            raise TxScriptError("quotient overflow or division by zero")
+        q = abs(a) // abs(b)
+        self._push_num(q if (a < 0) == (b < 0) else -q)  # trunc toward zero
+
+    def _op_mod(self):
+        self._require_covenants()
+        b, a = self._pop_num(), self._pop_num()
+        if b == 0:
+            raise TxScriptError("illegal modulo by zero")
+        q = abs(a) // abs(b)
+        if (a < 0) != (b < 0):
+            q = -q
+        self._push_num(self._checked(a - q * b))  # sign follows dividend
+
+    def _op_zk_precompile(self):
+        self._require_covenants()
+        from kaspa_tpu.txscript import zk_precompiles as zkp
+        from kaspa_tpu.txscript.resource_meter import MeterError, RuntimeScriptUnitMeter
+
+        try:
+            tag = zkp.parse_tag(self._pop())
+        except zkp.ZkError as e:
+            raise TxScriptError(f"zk integrity: {e}") from e
+        self.consume_script_units(zkp.TAG_COSTS[tag])
+        meter = self.meter if self.meter is not None else RuntimeScriptUnitMeter(0, (1 << 64) - 1)
+        try:
+            zkp.verify_zk(tag, self.dstack, meter)
+        except (zkp.ZkError, zkp.R0Error) as e:
+            raise TxScriptError(f"zk integrity: {e}") from e
+        except MeterError as e:
+            raise TxScriptError(str(e)) from e
+        self._push_bool(True)
+
+    def _op_blake2b_keyed(self):
+        self._require_covenants()
+        key = self._pop()
+        data = self._pop()
+        if len(key) > 64:
+            raise TxScriptError(f"blake2b key too big ({len(key)} > 64)")
+        self.consume_script_units(2 * len(data))
+        self._push(hashlib.blake2b(data, digest_size=32, key=key).digest())
+
+    def _op_blake3(self):
+        self._require_covenants()
+        from kaspa_tpu.crypto.blake3 import blake3
+
+        data = self._pop()
+        self.consume_script_units(len(data))
+        self._push(blake3(data))
+
+    def _op_blake3_keyed(self):
+        self._require_covenants()
+        from kaspa_tpu.crypto.blake3 import blake3_keyed
+
+        key = self._pop()
+        data = self._pop()
+        if len(key) != 32:
+            raise TxScriptError(f"blake3 key must be 32 bytes, got {len(key)}")
+        self.consume_script_units(len(data))
+        self._push(blake3_keyed(key, data))
+
+    # --- transaction introspection (KIP-10 ops are ungated; the rest are
+    # covenant-gated exactly per opcodes/mod.rs) ---
+
+    def _op_tx_version(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(self.tx.version)
+
+    def _op_tx_input_count(self):
+        self._require_tx()
+        self._push_num(len(self.tx.inputs))
+
+    def _op_tx_output_count(self):
+        self._require_tx()
+        self._push_num(len(self.tx.outputs))
+
+    def _op_tx_lock_time(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(self._checked(self.tx.lock_time))
+
+    def _op_tx_subnet_id(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push(self.tx.subnetwork_id)
+
+    def _op_tx_gas(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(self._checked(self.tx.gas))
+
+    def _op_tx_payload_substr(self):
+        self._require_covenants()
+        self._require_tx()
+        end = self._pop_usize()
+        start = self._pop_usize()
+        self._push(self._substring(self.tx.payload, start, end))
+
+    def _op_tx_input_index(self):
+        self._require_tx()
+        self._push_num(self.input_index)
+
+    def _get_input(self, idx: int):
+        if idx >= len(self.tx.inputs):
+            raise TxScriptError(f"invalid input index {idx} (tx has {len(self.tx.inputs)})")
+        return self.tx.inputs[idx]
+
+    def _get_utxo(self, idx: int):
+        if idx >= len(self.utxo_entries):
+            raise TxScriptError(f"invalid input index {idx} (tx has {len(self.tx.inputs)})")
+        return self.utxo_entries[idx]
+
+    def _get_output(self, idx: int):
+        if idx >= len(self.tx.outputs):
+            raise TxScriptError(f"invalid output index {idx}")
+        return self.tx.outputs[idx]
+
+    def _op_outpoint_tx_id(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push(self._get_input(self._pop_usize()).previous_outpoint.transaction_id)
+
+    def _op_outpoint_index(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(self._get_input(self._pop_usize()).previous_outpoint.index)
+
+    def _op_tx_input_script_sig_substr(self):
+        self._require_covenants()
+        self._require_tx()
+        end = self._pop_usize()
+        start = self._pop_usize()
+        inp = self._get_input(self._pop_usize())
+        self._push(self._substring(inp.signature_script, start, end))
+
+    def _op_tx_input_seq(self):
+        self._require_covenants()
+        self._require_tx()
+        # sequence is a bitflag field: raw 8-byte LE push, not a number
+        self._push(self._get_input(self._pop_usize()).sequence.to_bytes(8, "little"))
+
+    def _op_tx_input_amount(self):
+        self._require_tx()
+        self._push_num(self._checked(self._get_utxo(self._pop_usize()).amount))
+
+    @staticmethod
+    def _spk_bytes(spk) -> bytes:
+        # SpkEncoding (lib.rs:950): big-endian version + script
+        return spk.version.to_bytes(2, "big") + spk.script
+
+    def _op_tx_input_spk(self):
+        self._require_tx()
+        self._push(self._spk_bytes(self._get_utxo(self._pop_usize()).script_public_key))
+
+    def _op_tx_input_daa_score(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(self._checked(self._get_utxo(self._pop_usize()).block_daa_score))
+
+    def _op_tx_input_is_coinbase(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(1 if self._get_utxo(self._pop_usize()).is_coinbase else 0)
+
+    def _op_tx_output_amount(self):
+        self._require_tx()
+        self._push_num(self._checked(self._get_output(self._pop_usize()).value))
+
+    def _op_tx_output_spk(self):
+        self._require_tx()
+        self._push(self._spk_bytes(self._get_output(self._pop_usize()).script_public_key))
+
+    def _op_tx_payload_len(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(len(self.tx.payload))
+
+    def _op_tx_input_spk_len(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(len(self._spk_bytes(self._get_utxo(self._pop_usize()).script_public_key)))
+
+    def _op_tx_input_spk_substr(self):
+        self._require_covenants()
+        self._require_tx()
+        end = self._pop_usize()
+        start = self._pop_usize()
+        spk = self._spk_bytes(self._get_utxo(self._pop_usize()).script_public_key)
+        self._push(self._substring(spk, start, end))
+
+    def _op_tx_output_spk_len(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(len(self._spk_bytes(self._get_output(self._pop_usize()).script_public_key)))
+
+    def _op_tx_output_spk_substr(self):
+        self._require_covenants()
+        self._require_tx()
+        end = self._pop_usize()
+        start = self._pop_usize()
+        spk = self._spk_bytes(self._get_output(self._pop_usize()).script_public_key)
+        self._push(self._substring(spk, start, end))
+
+    def _op_tx_input_script_sig_len(self):
+        self._require_covenants()
+        self._require_tx()
+        self._push_num(len(self._get_input(self._pop_usize()).signature_script))
+
+    # --- covenants (contexts pre-built by covenants.CovenantsContext) ---
+
+    def _cov_ctx(self):
+        if self.covenants_ctx is None:
+            from kaspa_tpu.txscript.covenants import CovenantsContext
+
+            self.covenants_ctx = CovenantsContext.from_tx(self.tx, self.utxo_entries)
+        return self.covenants_ctx
+
+    def _op_auth_output_count(self):
+        self._require_covenants()
+        self._require_tx()
+        idx = self._pop_usize()
+        if idx >= len(self.tx.inputs):
+            raise TxScriptError(f"invalid input index {idx}")
+        self._push_num(self._cov_ctx().num_auth_outputs(idx))
+
+    def _op_auth_output_idx(self):
+        from kaspa_tpu.txscript.covenants import CovenantsError
+
+        self._require_covenants()
+        self._require_tx()
+        k = self._pop_usize()
+        idx = self._pop_usize()
+        if idx >= len(self.tx.inputs):
+            raise TxScriptError(f"invalid input index {idx}")
+        try:
+            self._push_num(self._cov_ctx().auth_output_index(idx, k))
+        except CovenantsError as e:
+            raise TxScriptError(str(e)) from e
+
+    def _op_num2bin(self):
+        self._require_covenants()
+        size = self._pop_usize()
+        if size > 8:
+            raise TxScriptError(f"NUM2BIN target size {size} exceeds 8 bytes")
+        num = self._pop_num()
+        # data_stack.rs serialize_i64(num, Some(size)): LE magnitude bytes
+        # (plus a spill byte when the top magnitude bit is set), zero-pad to
+        # size, then set the sign bit on the final byte
+        out = bytearray()
+        positive = abs(num)
+        while positive:
+            out.append(positive & 0xFF)
+            positive >>= 8
+        if out and out[-1] & 0x80:
+            out.append(0)
+        if len(out) > size:
+            raise TxScriptError(f"cannot encode {num} in {size} bytes")
+        out.extend(b"\x00" * (size - len(out)))
+        if num < 0:
+            out[-1] |= 0x80
+        self._push(bytes(out))
+
+    def _op_bin2num(self):
+        self._require_covenants()
+        # deserialize unrestricted (non-minimal allowed), re-push minimal
+        self._push_num(deserialize_i64(self._pop(), enforce_minimal=False))
+
+    def _op_input_covenant_id(self):
+        self._require_covenants()
+        self._require_tx()
+        entry = self._get_utxo(self._pop_usize())
+        self._push(entry.covenant_id if entry.covenant_id is not None else b"\x00" * 32)
+
+    def _op_cov_input_count(self):
+        self._require_covenants()
+        self._require_tx()
+        cov_id = self._pop_hash()
+        self._push_num(self._cov_ctx().num_covenant_inputs(cov_id))
+
+    def _op_cov_input_idx(self):
+        from kaspa_tpu.txscript.covenants import CovenantsError
+
+        self._require_covenants()
+        self._require_tx()
+        k = self._pop_usize()
+        cov_id = self._pop_hash()
+        try:
+            self._push_num(self._cov_ctx().covenant_input_index(cov_id, k))
+        except CovenantsError as e:
+            raise TxScriptError(str(e)) from e
+
+    def _op_cov_output_count(self):
+        self._require_covenants()
+        self._require_tx()
+        cov_id = self._pop_hash()
+        self._push_num(self._cov_ctx().num_covenant_outputs(cov_id))
+
+    def _op_cov_output_idx(self):
+        from kaspa_tpu.txscript.covenants import CovenantsError
+
+        self._require_covenants()
+        self._require_tx()
+        k = self._pop_usize()
+        cov_id = self._pop_hash()
+        try:
+            self._push_num(self._cov_ctx().covenant_output_index(cov_id, k))
+        except CovenantsError as e:
+            raise TxScriptError(str(e)) from e
+
+    def _op_chainblock_seq_commit(self):
+        # gated by accessor presence, NOT by covenants_enabled — matching
+        # opcodes/mod.rs:1581 ("seq_commit_access is none only if the opcode
+        # is not enabled"): the KIP-21 wiring only injects an accessor when
+        # the seq-commit feature is consensus-active
+        if self.seq_commit_accessor is None:
+            raise TxScriptError("attempt to execute invalid opcode (seq commit unavailable)")
+        block = self._pop_hash()
+        anc = self.seq_commit_accessor.is_chain_ancestor_from_pov(block)
+        if anc is None:
+            raise TxScriptError(f"block {block.hex()} already pruned")
+        if not anc:
+            raise TxScriptError(f"block {block.hex()} not on the selected chain")
+        commitment = self.seq_commit_accessor.seq_commitment_within_depth(block)
+        if commitment is None:
+            raise TxScriptError(f"block {block.hex()} is too deep")
+        self._push(commitment)
+
+    def _op_output_covenant_id(self):
+        self._require_covenants()
+        self._require_tx()
+        out = self._get_output(self._pop_usize())
+        self._push(out.covenant.covenant_id if out.covenant is not None else b"\x00" * 32)
+
+    def _op_output_authorizing_input(self):
+        self._require_covenants()
+        self._require_tx()
+        out = self._get_output(self._pop_usize())
+        self._push_num(out.covenant.authorizing_input if out.covenant is not None else -1)
+
+    def _op_checksig_from_stack(self, ecdsa: bool = False):
+        from kaspa_tpu.crypto import eclib
+
+        self._require_covenants()
+        pubkey = self._pop()
+        msg_hash = self._pop()
+        signature = self._pop()
+        if len(msg_hash) != 32:
+            raise TxScriptError("message hash must be 32 bytes")
+        self.consume_sig_op_cost(1)
+        if ecdsa:
+            if len(pubkey) != 33 or eclib.parse_compressed(pubkey) is None:
+                raise TxScriptError("invalid public key")
+            if len(signature) != 64:
+                raise TxScriptError("invalid signature length")
+            cache_key = ("ecdsa", bytes(signature), msg_hash, bytes(pubkey))
+            valid = self.sig_cache.get(cache_key)
+            if valid is None:
+                valid = eclib.ecdsa_verify(pubkey, msg_hash, signature)
+                self.sig_cache.insert(cache_key, valid)
+        else:
+            if len(pubkey) != 32 or eclib.lift_x(int.from_bytes(pubkey, "big")) is None:
+                raise TxScriptError("invalid public key")
+            if len(signature) != 64:
+                raise TxScriptError("invalid signature length")
+            cache_key = ("schnorr", bytes(signature), msg_hash, bytes(pubkey))
+            valid = self.sig_cache.get(cache_key)
+            if valid is None:
+                valid = eclib.schnorr_verify(pubkey, msg_hash, signature)
+                self.sig_cache.insert(cache_key, valid)
+        self._push_bool(bool(valid))
+
+    def _op_checksig_from_stack_ecdsa(self):
+        self._op_checksig_from_stack(ecdsa=True)
+
     # opcode dispatch table
     _OPS = {}
 
@@ -815,6 +1354,60 @@ def _build_ops():
         0xAF: e._op_checkmultisigverify,
         0xB0: e._op_checklocktimeverify,
         0xB1: e._op_checksequenceverify,
+        # Toccata: splice/bitwise/arithmetic re-enables (flag-checked in the
+        # bodies; execute_script rejects them pre-fork before dispatch)
+        0x7E: e._op_cat,
+        0x7F: e._op_substr,
+        0x83: e._op_invert,
+        0x84: e._op_and,
+        0x85: e._op_or,
+        0x86: e._op_xor,
+        0x95: e._op_mul,
+        0x96: e._op_div,
+        0x97: e._op_mod,
+        0xA6: e._op_zk_precompile,
+        0xA7: e._op_blake2b_keyed,
+        # introspection (0xb3/b4/b9/be/bf/c2/c3 are ungated KIP-10 ops)
+        0xB2: e._op_tx_version,
+        0xB3: e._op_tx_input_count,
+        0xB4: e._op_tx_output_count,
+        0xB5: e._op_tx_lock_time,
+        0xB6: e._op_tx_subnet_id,
+        0xB7: e._op_tx_gas,
+        0xB8: e._op_tx_payload_substr,
+        0xB9: e._op_tx_input_index,
+        0xBA: e._op_outpoint_tx_id,
+        0xBB: e._op_outpoint_index,
+        0xBC: e._op_tx_input_script_sig_substr,
+        0xBD: e._op_tx_input_seq,
+        0xBE: e._op_tx_input_amount,
+        0xBF: e._op_tx_input_spk,
+        0xC0: e._op_tx_input_daa_score,
+        0xC1: e._op_tx_input_is_coinbase,
+        0xC2: e._op_tx_output_amount,
+        0xC3: e._op_tx_output_spk,
+        0xC4: e._op_tx_payload_len,
+        0xC5: e._op_tx_input_spk_len,
+        0xC6: e._op_tx_input_spk_substr,
+        0xC7: e._op_tx_output_spk_len,
+        0xC8: e._op_tx_output_spk_substr,
+        0xC9: e._op_tx_input_script_sig_len,
+        0xCB: e._op_auth_output_count,
+        0xCC: e._op_auth_output_idx,
+        0xCD: e._op_num2bin,
+        0xCE: e._op_bin2num,
+        0xCF: e._op_input_covenant_id,
+        0xD0: e._op_cov_input_count,
+        0xD1: e._op_cov_input_idx,
+        0xD2: e._op_cov_output_count,
+        0xD3: e._op_cov_output_idx,
+        0xD4: e._op_chainblock_seq_commit,
+        0xD5: e._op_output_covenant_id,
+        0xD6: e._op_output_authorizing_input,
+        0xD7: e._op_checksig_from_stack,
+        0xD8: e._op_checksig_from_stack_ecdsa,
+        0xD9: e._op_blake3,
+        0xDA: e._op_blake3_keyed,
     }
     for n in range(1, 17):  # Op1..Op16
         ops[0x50 + n] = (lambda n: lambda self: self._op_n(n))(n)
@@ -829,7 +1422,7 @@ def _build_ops():
 TxScriptEngine._OPS = _build_ops()
 
 
-def vm_fallback(tx, utxo_entries, input_index, reused, sig_cache: SigCache | None = None):
+def vm_fallback(tx, utxo_entries, input_index, reused, sig_cache: SigCache | None = None, flags: EngineFlags | None = None, meter=None):
     """Adapter used by txscript.batch.BatchScriptChecker for nonstandard scripts."""
-    engine = TxScriptEngine(tx, utxo_entries, input_index, reused, sig_cache)
+    engine = TxScriptEngine(tx, utxo_entries, input_index, reused, sig_cache, flags=flags, meter=meter)
     engine.execute()

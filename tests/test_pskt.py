@@ -64,8 +64,11 @@ def test_pskt_2of3_multisig_roundtrip():
     ms_entry = c.get_virtual_utxo_view().get(ms_op)
     assert ms_entry is not None
 
-    # PSKT: construct -> two signers independently -> combine -> extract
-    base = Pskt().add_input(ms_op, ms_entry, redeem, 2).add_output(
+    # PSKT: construct -> two signers independently -> combine -> extract.
+    # Commit 3 sig ops: the runtime counter (lib.rs:898 via the multisig
+    # loop) charges one per ATTEMPTED key check, and a 2-of-3 where the
+    # second signer holds key[2] attempts keys 0,1,2.
+    base = Pskt().add_input(ms_op, ms_entry, redeem, 3).add_output(
         TransactionOutput(ms_entry.amount - 2000, miner.spk)
     )
     wire = base.to_json()

@@ -1,0 +1,582 @@
+"""Toccata surface tests: ZK precompiles, covenants, introspection opcodes,
+runtime resource metering, fork gating.
+
+Mirrors the reference's test layout: runtime_resource_meter.rs tests,
+covenants.rs tests, zk_precompiles tests (incl. the succinct.* golden
+fixtures for the claim-binding chain), opcode-level introspection tests.
+"""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+import kaspa_tpu.crypto.bn254 as bn254
+from kaspa_tpu.consensus.model import (
+    Covenant,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.crypto.blake3 import blake3, blake3_keyed
+from kaspa_tpu.txscript import zk_precompiles as zk
+from kaspa_tpu.txscript.covenants import CovenantsContext, CovenantsError, covenant_id
+from kaspa_tpu.txscript.resource_meter import (
+    MeterError,
+    RuntimeScriptUnitMeter,
+    RuntimeSigOpCounter,
+)
+from kaspa_tpu.txscript.vm import EngineFlags, TxScriptError, TxScriptEngine, serialize_i64
+
+TOCCATA = EngineFlags(covenants_enabled=True)
+R0_DATA = "/root/reference/crypto/txscript/src/zk_precompiles/tests/data"
+
+
+# ----------------------------------------------------------------------
+# resource meter (runtime_resource_meter.rs tests)
+# ----------------------------------------------------------------------
+
+
+def test_sigops_meter_enforces_sigop_limit():
+    m = RuntimeSigOpCounter(2)
+    m.consume_sig_ops()
+    m.consume_sig_ops()
+    assert m.used_sig_ops == 2
+    with pytest.raises(MeterError, match="sig op limit"):
+        m.consume_sig_ops()
+
+
+def test_script_units_meter_charges_sigops():
+    m = RuntimeScriptUnitMeter(100, 250)
+    m.consume_sig_ops(2)
+    assert m.used_sig_ops == 2
+    assert m.used_script_units == 200
+    with pytest.raises(MeterError, match="used 300, limit 250"):
+        m.consume_sig_ops(1)
+    assert m.used_sig_ops == 2 and m.used_script_units == 200
+
+
+def test_script_units_meter_charges_pushed_bytes():
+    m = RuntimeScriptUnitMeter(0, 20)
+    m.charge_newly_pushed_bytes(7)
+    m.charge_newly_pushed_bytes(0)
+    m.charge_newly_pushed_bytes(9)
+    assert m.used_script_units == 16
+    with pytest.raises(MeterError):
+        m.charge_newly_pushed_bytes(5)
+
+
+def test_sigops_meter_ignores_script_unit_charges():
+    m = RuntimeSigOpCounter(1)
+    m.consume_script_units(50)
+    m.charge_newly_pushed_bytes(50)
+    assert m.used_script_units == 0 and m.used_sig_ops == 0
+
+
+# ----------------------------------------------------------------------
+# BN254 / Groth16
+# ----------------------------------------------------------------------
+
+
+def test_bn254_pairing_bilinearity():
+    e1 = bn254.pairing(bn254.G2_GEN, bn254.G1_GEN)
+    assert e1 != bn254.F12_ONE
+    lhs = bn254.pairing(bn254.g2_mul(bn254.G2_GEN, 13), bn254.g1_mul(bn254.G1_GEN, 7))
+    assert lhs == bn254.f12_pow(e1, 91)
+    assert bn254.f12_pow(e1, bn254.R) == bn254.F12_ONE
+
+
+def test_bn254_compressed_serde_roundtrip():
+    for k in (1, 2, 12345, bn254.R - 1):
+        p1 = bn254.g1_mul(bn254.G1_GEN, k)
+        assert bn254.g1_deserialize_compressed(bn254.g1_serialize_compressed(p1)) == p1
+        p2 = bn254.g2_mul(bn254.G2_GEN, k)
+        assert bn254.g2_deserialize_compressed(bn254.g2_serialize_compressed(p2)) == p2
+    assert bn254.g1_deserialize_compressed(bn254.g1_serialize_compressed(None)) is None
+    # ark vector: G1 generator = 1 || zeros (flags 00: y=2 is "positive")
+    assert bn254.g1_serialize_compressed(bn254.G1_GEN) == b"\x01" + b"\x00" * 31
+    with pytest.raises(bn254.DeserializeError):
+        bn254.g1_deserialize_compressed(b"\xff" * 32)  # non-canonical x
+
+
+def _forged_groth16(n_inputs=2, seed=5):
+    """Valid-by-construction Groth16 instance: pick all dlogs, solve for C
+    so that e(A,B) = e(alpha,beta) e(L,gamma) e(C,delta)."""
+    rng = random.Random(seed)
+    R = bn254.R
+    a_, b_, g_, d_ = [rng.randrange(1, R) for _ in range(4)]
+    r_, s_ = rng.randrange(1, R), rng.randrange(1, R)
+    ls = [rng.randrange(1, R) for _ in range(n_inputs + 1)]
+    xs = [rng.randrange(1, R) for _ in range(n_inputs)]
+    l_total = (ls[0] + sum(x * l for x, l in zip(xs, ls[1:]))) % R
+    c_ = (r_ * s_ - a_ * b_ - l_total * g_) * pow(d_, -1, R) % R
+    vk = (
+        bn254.g1_serialize_compressed(bn254.g1_mul(bn254.G1_GEN, a_))
+        + bn254.g2_serialize_compressed(bn254.g2_mul(bn254.G2_GEN, b_))
+        + bn254.g2_serialize_compressed(bn254.g2_mul(bn254.G2_GEN, g_))
+        + bn254.g2_serialize_compressed(bn254.g2_mul(bn254.G2_GEN, d_))
+        + (n_inputs + 1).to_bytes(8, "little")
+        + b"".join(bn254.g1_serialize_compressed(bn254.g1_mul(bn254.G1_GEN, l)) for l in ls)
+    )
+    proof = (
+        bn254.g1_serialize_compressed(bn254.g1_mul(bn254.G1_GEN, r_))
+        + bn254.g2_serialize_compressed(bn254.g2_mul(bn254.G2_GEN, s_))
+        + bn254.g1_serialize_compressed(bn254.g1_mul(bn254.G1_GEN, c_))
+    )
+    return vk, proof, xs
+
+
+def _groth_stack(vk, proof, xs):
+    st = [bn254.fr_serialize(x) for x in reversed(xs)]
+    st.append(serialize_i64(len(xs)))
+    st.append(proof)
+    st.append(vk)
+    return st
+
+
+def test_groth16_accepts_valid_proof_and_meters():
+    vk, proof, xs = _forged_groth16()
+    m = RuntimeScriptUnitMeter(0, 10**12)
+    zk.groth16_verify(_groth_stack(vk, proof, xs), m)
+    assert m.used_script_units == 3 * zk.GROTH16_GAMMA_ABC_G1_ELEMENT_SCRIPT_UNITS
+
+
+def test_groth16_rejects_tampering():
+    vk, proof, xs = _forged_groth16()
+    bad_proof = bytes([proof[0] ^ 1]) + proof[1:]
+    with pytest.raises(zk.ZkError, match="verification failed|invalid proof"):
+        zk.groth16_verify(_groth_stack(vk, bad_proof, xs), RuntimeScriptUnitMeter(0, 10**12))
+    with pytest.raises(zk.ZkError, match="verification failed"):
+        zk.groth16_verify(
+            _groth_stack(vk, proof, [xs[0], (xs[1] + 1) % bn254.R]), RuntimeScriptUnitMeter(0, 10**12)
+        )
+
+
+def test_groth16_arity_mismatch_rejected_before_charge():
+    vk, proof, xs = _forged_groth16()
+    m = RuntimeScriptUnitMeter(0, 0)  # zero budget: any charge would error
+    with pytest.raises(zk.ZkError, match="arity mismatch"):
+        zk.groth16_verify(_groth_stack(vk, proof, xs[:1]), m)
+    assert m.used_script_units == 0
+
+
+def test_groth16_over_budget_vk_rejected_via_meter():
+    vk, proof, xs = _forged_groth16()
+    with pytest.raises(MeterError):
+        zk.groth16_verify(_groth_stack(vk, proof, xs), RuntimeScriptUnitMeter(0, 200_000))
+
+
+def test_groth16_trailing_bytes_rejected():
+    vk, proof, xs = _forged_groth16()
+    with pytest.raises(zk.ZkError, match="trailing verifying key"):
+        zk.groth16_verify(_groth_stack(vk + b"\xab", proof, xs), RuntimeScriptUnitMeter(0, 10**12))
+    with pytest.raises(zk.ZkError, match="trailing proof"):
+        zk.groth16_verify(_groth_stack(vk, proof + b"\xcd", xs), RuntimeScriptUnitMeter(0, 10**12))
+
+
+def test_groth16_oversized_fr_rejected():
+    vk, proof, xs = _forged_groth16()
+    st = _groth_stack(vk, proof, xs)
+    st[0] = b"\x00" * 64  # 64-byte public input push
+    with pytest.raises(zk.ZkError, match="Invalid Fr length"):
+        zk.groth16_verify(st, RuntimeScriptUnitMeter(0, 10**12))
+
+
+def test_zk_tag_parsing_and_costs():
+    assert zk.parse_tag(b"\x20") == zk.TAG_GROTH16
+    assert zk.parse_tag(b"\x21") == zk.TAG_R0_SUCCINCT
+    with pytest.raises(zk.ZkError, match="missing"):
+        zk.parse_tag(b"")
+    with pytest.raises(zk.ZkError, match="length 2"):
+        zk.parse_tag(b"\x20\x20")
+    with pytest.raises(zk.ZkError, match="Unknown"):
+        zk.parse_tag(b"\x42")
+    assert zk.compute_zk_cost(0x20) == 14_000_000
+    assert zk.compute_zk_cost(0x21) == 25_000_000
+    assert zk.compute_zk_cost(0x99) == zk.MAX_TAG_COST  # unknown -> max
+
+
+# ----------------------------------------------------------------------
+# RISC0 succinct: structural + golden claim binding
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not os.path.exists(R0_DATA), reason="reference fixtures not mounted")
+def test_r0_claim_binding_matches_reference_fixtures():
+    read = lambda n: bytes.fromhex(open(f"{R0_DATA}/succinct.{n}.hex").read().strip())
+    zk.compute_assert_claim(read("claim"), read("image"), read("journal"))
+    # any perturbation must break the binding
+    with pytest.raises(zk.R0Error):
+        zk.compute_assert_claim(read("claim"), read("journal"), read("image"))
+    bad = bytes([read("image")[0] ^ 1]) + read("image")[1:]
+    with pytest.raises(zk.R0Error):
+        zk.compute_assert_claim(read("claim"), bad, read("journal"))
+
+
+def test_r0_operand_parsing():
+    with pytest.raises(zk.R0Error, match="digest length"):
+        zk.parse_digest(b"\x00" * 31)
+    with pytest.raises(zk.R0Error, match="seal length"):
+        zk.parse_seal(b"\x00" * 5)
+    assert zk.parse_seal(b"\x01\x00\x00\x00\x02\x00\x00\x00") == [1, 2]
+    with pytest.raises(zk.R0Error, match="hashfn"):
+        zk.parse_hashfn(b"\x07")
+    with pytest.raises(zk.R0Error, match="merkle index"):
+        zk.parse_merkle_index(b"\x00")
+    assert len(zk.parse_digest_list(b"\x00" * 64)) == 2
+
+
+def test_r0_merkle_proof_path_folding():
+    h = lambda a, b: hashlib.sha256(a + b).digest()
+    leaves = [hashlib.sha256(bytes([i])).digest() for i in range(4)]
+    l2 = [h(leaves[0], leaves[1]), h(leaves[2], leaves[3])]
+    root = h(l2[0], l2[1])
+    proof = zk.MerkleProof(index=2, digests=[leaves[3], l2[0]])
+    assert proof.root(leaves[2], h) == root
+    assert zk.MerkleProof(index=1, digests=[leaves[0], l2[1]]).root(leaves[1], h) == root
+
+
+@pytest.mark.skipif(not os.path.exists(R0_DATA), reason="reference fixtures not mounted")
+def test_r0_succinct_fails_closed_on_seal():
+    read = lambda n: bytes.fromhex(open(f"{R0_DATA}/succinct.{n}.hex").read().strip())
+    # stack bottom..top: claim, control_index, control_digests, seal,
+    # journal, image, control_id, hashfn
+    st = [
+        read("claim"),
+        bytes.fromhex(open(f"{R0_DATA}/succinct.control_index.hex").read().strip() or "00000000"),
+        b"",
+        b"\x00" * 8,
+        read("journal"),
+        read("image"),
+        read("control_id"),
+        b"\x01",  # poseidon2
+    ]
+    with pytest.raises(zk.R0Error, match="seal verification unavailable"):
+        zk.r0_succinct_verify(st, RuntimeScriptUnitMeter(0, 10**12))
+    # unsupported hashfn short-circuits earlier
+    st2 = [read("claim"), b"\x00" * 4, b"", b"", read("journal"), read("image"), read("control_id"), b"\x02"]
+    with pytest.raises(zk.R0Error, match="unsupported hashfn"):
+        zk.r0_succinct_verify(st2, RuntimeScriptUnitMeter(0, 10**12))
+
+
+# ----------------------------------------------------------------------
+# covenants (covenants.rs tests)
+# ----------------------------------------------------------------------
+
+SPK = ScriptPublicKey(0, b"")
+
+
+def _cov_tx(input_cov_ids, outputs, correct_ids=True):
+    """outputs: list of (value, authorizing_input, covenant_group)."""
+    inputs = [
+        TransactionInput(TransactionOutpoint(bytes([i]) * 32, 0), b"", 0, 0)
+        for i in range(len(input_cov_ids))
+    ]
+    entries = [
+        UtxoEntry(1000, SPK, 0, False, covenant_id=(None if c is None else bytes([c]) * 32))
+        for c in input_cov_ids
+    ]
+    outs = [
+        TransactionOutput(v, SPK, covenant=Covenant(auth, bytes([grp]) * 32))
+        for (v, auth, grp) in outputs
+    ]
+    tx = Transaction(0, inputs, outs, 0, b"\x00" * 20, 0, b"")
+    if correct_ids:
+        groups = {}
+        for i, (v, auth, grp) in enumerate(outputs):
+            in_cov = input_cov_ids[auth] if auth < len(input_cov_ids) else None
+            if in_cov != grp:
+                groups.setdefault((auth, grp), []).append(i)
+        for (auth, grp), idxs in groups.items():
+            cid = covenant_id(tx.inputs[auth].previous_outpoint, ((j, tx.outputs[j]) for j in idxs))
+            for j in idxs:
+                tx.outputs[j] = TransactionOutput(
+                    tx.outputs[j].value, SPK, covenant=Covenant(auth, cid)
+                )
+    return tx, entries
+
+
+def test_covenants_genesis_outputs_do_not_populate_contexts():
+    tx, entries = _cov_tx([None], [(100, 0, 1), (100, 0, 1)])
+    ctx = CovenantsContext.from_tx(tx, entries)
+    assert not ctx.input_ctxs and not ctx.shared_ctxs
+
+
+def test_covenants_wrong_genesis_id_rejected():
+    tx, entries = _cov_tx([None], [(100, 0, 1)], correct_ids=False)
+    with pytest.raises(CovenantsError, match="wrong genesis covenant id"):
+        CovenantsContext.from_tx(tx, entries)
+
+
+def test_covenants_continuation_with_genesis():
+    # input carries covenant 42; output 0 continues it, outputs 1-3 are genesis
+    tx, entries = _cov_tx([42], [(100, 0, 42), (100, 0, 100), (100, 0, 200), (100, 0, 100)])
+    ctx = CovenantsContext.from_tx(tx, entries)
+    cov42 = bytes([42]) * 32
+    assert ctx.input_ctxs[0].auth_outputs == [0]
+    assert ctx.shared_ctxs[cov42].input_indices == [0]
+    assert ctx.shared_ctxs[cov42].output_indices == [0]
+    assert ctx.num_auth_outputs(0) == 1 and ctx.auth_output_index(0, 0) == 0
+    assert ctx.num_covenant_inputs(cov42) == 1 and ctx.covenant_input_index(cov42, 0) == 0
+    with pytest.raises(CovenantsError):
+        ctx.auth_output_index(0, 1)
+
+
+def test_covenants_auth_input_out_of_bounds():
+    tx, entries = _cov_tx([None], [(100, 1, 1)], correct_ids=False)
+    with pytest.raises(CovenantsError, match="out of bounds"):
+        CovenantsContext.from_tx(tx, entries)
+
+
+def test_covenants_input_without_outputs_keeps_shared_ctx():
+    inputs = [TransactionInput(TransactionOutpoint(b"\x01" * 32, 0), b"", 0, 0)]
+    entries = [UtxoEntry(1000, SPK, 0, False, covenant_id=bytes([42]) * 32)]
+    tx = Transaction(0, inputs, [TransactionOutput(100, SPK), TransactionOutput(200, SPK)], 0, b"\x00" * 20, 0, b"")
+    ctx = CovenantsContext.from_tx(tx, entries)
+    cov42 = bytes([42]) * 32
+    assert not ctx.input_ctxs
+    assert ctx.shared_ctxs[cov42].input_indices == [0]
+    assert ctx.shared_ctxs[cov42].output_indices == []
+
+
+# ----------------------------------------------------------------------
+# VM: Toccata opcodes
+# ----------------------------------------------------------------------
+
+
+def _engine(script=None, tx=None, entries=None, flags=TOCCATA, meter=None):
+    e = TxScriptEngine(tx=tx, utxo_entries=entries, input_index=0, flags=flags, meter=meter)
+    if script is not None:
+        e.execute_standalone(script)
+    return e
+
+
+def _push(data: bytes) -> bytes:
+    assert len(data) <= 75
+    return bytes([len(data)]) + data
+
+
+def _intro_tx():
+    inputs = [
+        TransactionInput(TransactionOutpoint(b"\xaa" * 32, 7), b"\x51\x52", 5, 1),
+        TransactionInput(TransactionOutpoint(b"\xbb" * 32, 1), b"", 0, 1),
+    ]
+    outs = [
+        TransactionOutput(1500, ScriptPublicKey(0, b"\xac")),
+        TransactionOutput(2500, ScriptPublicKey(1, b"\x51\x51")),
+    ]
+    entries = [
+        UtxoEntry(1000, ScriptPublicKey(0, b"\x51"), 77, True),
+        UtxoEntry(3000, ScriptPublicKey(0, b"\x52\x53"), 99, False),
+    ]
+    tx = Transaction(1, inputs, outs, 1234, b"\x07" * 20, 42, b"payload-bytes")
+    return tx, entries
+
+
+def _run_ops(tx, entries, script, flags=TOCCATA):
+    e = TxScriptEngine(tx=tx, utxo_entries=entries, input_index=0, flags=flags)
+    e.execute_script(script, verify_only_push=False)
+    return e.dstack
+
+
+def test_introspection_kip10_ungated():
+    tx, entries = _intro_tx()
+    flags = EngineFlags()  # pre-Toccata
+    assert _run_ops(tx, entries, bytes([0xB3]), flags) == [b"\x02"]  # input count
+    assert _run_ops(tx, entries, bytes([0xB4]), flags) == [b"\x02"]  # output count
+    assert _run_ops(tx, entries, bytes([0xB9]), flags) == [b""]  # input index 0
+    assert _run_ops(tx, entries, bytes([0x51]) + bytes([0xBE]), flags) == [serialize_i64(3000)]
+    assert _run_ops(tx, entries, bytes([0x51]) + bytes([0xC2]), flags) == [serialize_i64(2500)]
+    # spk serialization: BE version + script
+    assert _run_ops(tx, entries, b"\x00" + bytes([0xBF]), flags) == [b"\x00\x00\x51"]
+    assert _run_ops(tx, entries, bytes([0x51]) + bytes([0xC3]), flags) == [b"\x00\x01\x51\x51"]
+
+
+def test_introspection_gated_ops():
+    tx, entries = _intro_tx()
+    assert _run_ops(tx, entries, bytes([0xB2])) == [serialize_i64(1)]  # version
+    assert _run_ops(tx, entries, bytes([0xB5])) == [serialize_i64(1234)]  # locktime
+    assert _run_ops(tx, entries, bytes([0xB6])) == [b"\x07" * 20]  # subnet
+    assert _run_ops(tx, entries, bytes([0xB7])) == [serialize_i64(42)]  # gas
+    assert _run_ops(tx, entries, bytes([0xC4])) == [serialize_i64(13)]  # payload len
+    assert _run_ops(tx, entries, b"\x00" + bytes([0xBA])) == [b"\xaa" * 32]
+    assert _run_ops(tx, entries, b"\x00" + bytes([0xBB])) == [serialize_i64(7)]
+    assert _run_ops(tx, entries, b"\x00" + bytes([0xBD])) == [(5).to_bytes(8, "little")]
+    assert _run_ops(tx, entries, b"\x00" + bytes([0xC0])) == [serialize_i64(77)]
+    assert _run_ops(tx, entries, b"\x00" + bytes([0xC1])) == [serialize_i64(1)]
+    assert _run_ops(tx, entries, b"\x00" + bytes([0xC9])) == [serialize_i64(2)]
+    # payload substring [0, 7)
+    assert _run_ops(tx, entries, b"\x00" + bytes([0x57]) + bytes([0xB8])) == [b"payload"]
+    # gated op without the flag -> reserved error
+    with pytest.raises(TxScriptError, match="reserved|invalid"):
+        _run_ops(tx, entries, bytes([0xB2]), EngineFlags())
+
+
+def test_splice_bitwise_arith_ops():
+    tx, entries = _intro_tx()
+    run = lambda s: _run_ops(tx, entries, s)
+    assert run(_push(b"ab") + _push(b"cd") + bytes([0x7E])) == [b"abcd"]  # cat
+    assert run(_push(b"abcdef") + bytes([0x51]) + bytes([0x54]) + bytes([0x7F])) == [b"bcd"]
+    assert run(_push(b"\x0f\xf0") + bytes([0x83])) == [b"\xf0\x0f"]  # invert
+    assert run(_push(b"\x0f\x0f") + _push(b"\x33\x33") + bytes([0x84])) == [b"\x03\x03"]
+    assert run(_push(b"\x0f\x0f") + _push(b"\x33\x33") + bytes([0x85])) == [b"\x3f\x3f"]
+    assert run(_push(b"\x0f\x0f") + _push(b"\x33\x33") + bytes([0x86])) == [b"\x3c\x3c"]
+    assert run(bytes([0x56]) + bytes([0x57]) + bytes([0x95])) == [serialize_i64(42)]  # mul
+    assert run(_push(b"\x2a") + bytes([0x57]) + bytes([0x96])) == [serialize_i64(6)]  # div
+    # trunc-toward-zero semantics: -7 / 2 == -3, -7 % 2 == -1
+    assert run(_push(b"\x87") + bytes([0x52]) + bytes([0x96])) == [serialize_i64(-3)]
+    assert run(_push(b"\x87") + bytes([0x52]) + bytes([0x97])) == [serialize_i64(-1)]
+    with pytest.raises(TxScriptError, match="division by zero"):
+        run(bytes([0x51]) + b"\x00" + bytes([0x96]))
+    # bitwise length mismatch
+    with pytest.raises(TxScriptError, match="equal length"):
+        run(bytes([0x51]) + _push(b"\x01\x02") + bytes([0x84]))
+    # pre-Toccata these are disabled at the execute level
+    with pytest.raises(TxScriptError, match="disabled"):
+        _run_ops(tx, entries, _push(b"a") + _push(b"b") + bytes([0x7E]), EngineFlags())
+
+
+def test_num2bin_bin2num():
+    tx, entries = _intro_tx()
+    run = lambda s: _run_ops(tx, entries, s)
+    assert run(_push(b"\x2a") + bytes([0x54]) + bytes([0xCD])) == [b"\x2a\x00\x00\x00"]
+    assert run(_push(b"\x87") + bytes([0x54]) + bytes([0xCD])) == [b"\x07\x00\x00\x80"]  # -7
+    with pytest.raises(TxScriptError, match="cannot encode"):
+        run(_push(serialize_i64(2**20)) + bytes([0x51]) + bytes([0xCD]))
+    with pytest.raises(TxScriptError, match="exceeds 8"):
+        run(bytes([0x51]) + bytes([0x59]) + bytes([0xCD]))
+    # bin2num: non-minimal input re-encodes minimally
+    assert run(_push(b"\x2a\x00\x00\x00") + bytes([0xCE])) == [b"\x2a"]
+    assert run(_push(b"\x07\x00\x00\x80") + bytes([0xCE])) == [serialize_i64(-7)]
+
+
+def test_blake3_opcodes():
+    tx, entries = _intro_tx()
+    run = lambda s: _run_ops(tx, entries, s)
+    assert run(_push(b"abc") + bytes([0xD9])) == [blake3(b"abc")]
+    key = bytes(range(32))
+    assert run(_push(b"abc") + _push(key) + bytes([0xDA])) == [blake3_keyed(key, b"abc")]
+    with pytest.raises(TxScriptError, match="32 bytes"):
+        run(_push(b"abc") + _push(b"short") + bytes([0xDA]))
+    # blake2b keyed
+    import hashlib as h
+
+    assert run(_push(b"abc") + _push(b"k" * 8) + bytes([0xA7])) == [
+        h.blake2b(b"abc", digest_size=32, key=b"k" * 8).digest()
+    ]
+
+
+def test_checksig_from_stack():
+    tx, entries = _intro_tx()
+    sk = 0x1234567890ABCDEF
+    pub = eclib.schnorr_pubkey(sk)
+    msg = hashlib.sha256(b"csfs").digest()
+    sig = eclib.schnorr_sign(msg, sk, b"\x05" * 32)
+    script = _push(sig[:64])[:0]  # placate linters
+    ok = _run_ops(tx, entries, _push(sig) + _push(msg) + _push(pub) + bytes([0xD7]))
+    assert ok == [b"\x01"]
+    bad_sig = bytes([sig[0] ^ 1]) + sig[1:]
+    assert _run_ops(tx, entries, _push(bad_sig) + _push(msg) + _push(pub) + bytes([0xD7])) == [b""]
+    # ecdsa variant
+    epub = eclib.ecdsa_pubkey(sk)
+    esig = eclib.ecdsa_sign(msg, sk, 777)
+    assert _run_ops(tx, entries, _push(esig) + _push(msg) + _push(epub) + bytes([0xD8])) == [b"\x01"]
+
+
+def test_covenant_opcodes():
+    tx, entries = _cov_tx([42], [(100, 0, 42), (100, 0, 100)])
+    cov42 = bytes([42]) * 32
+    run = lambda s: _run_ops(tx, entries, s)
+    assert run(b"\x00" + bytes([0xCB])) == [b"\x01"]  # auth output count
+    assert run(b"\x00" + b"\x00" + bytes([0xCC])) == [b""]  # auth output idx 0
+    assert run(b"\x00" + bytes([0xCF])) == [cov42]  # input covenant id
+    assert run(_push(cov42) + bytes([0xD0])) == [b"\x01"]  # cov input count
+    assert run(_push(cov42) + b"\x00" + bytes([0xD1])) == [b""]  # cov input idx
+    assert run(_push(cov42) + bytes([0xD2])) == [b"\x01"]  # cov output count
+    assert run(_push(cov42) + b"\x00" + bytes([0xD3])) == [b""]
+    assert run(b"\x00" + bytes([0xD5])) == [cov42]  # output covenant id
+    assert run(b"\x00" + bytes([0xD6])) == [b""]  # authorizing input 0
+    assert run(bytes([0x51]) + bytes([0xD6])) == [b""]  # genesis output: auth 0 too
+    # unbound output -> zero hash / -1
+    tx2, entries2 = _intro_tx()
+    assert _run_ops(tx2, entries2, b"\x00" + bytes([0xD5])) == [b"\x00" * 32]
+    assert _run_ops(tx2, entries2, b"\x00" + bytes([0xD6])) == [serialize_i64(-1)]
+
+
+def test_zk_precompile_opcode_end_to_end():
+    tx, entries = _intro_tx()
+    vk, proof, xs = _forged_groth16(n_inputs=1, seed=9)
+    e = TxScriptEngine(tx=tx, utxo_entries=entries, input_index=0, flags=TOCCATA,
+                       meter=RuntimeScriptUnitMeter(0, 10**12))
+    # stack built directly (operands exceed 75-byte push for vk)
+    e.dstack = _groth_stack(vk, proof, xs)
+    e.dstack.append(b"\x20")  # tag
+    e._op_zk_precompile()
+    assert e.dstack == [b"\x01"]
+    assert e.meter.used_script_units == 14_000_000 + 2 * zk.GROTH16_GAMMA_ABC_G1_ELEMENT_SCRIPT_UNITS
+    # gated off
+    e2 = TxScriptEngine(tx=tx, utxo_entries=entries, input_index=0)
+    e2.dstack = [b"\x20"]
+    with pytest.raises(TxScriptError, match="reserved"):
+        e2._op_zk_precompile()
+
+
+def test_toccata_limits_relaxed():
+    e = TxScriptEngine(flags=TOCCATA)
+    assert e.max_scripts_size == 1_000_000
+    assert e.max_element_size == 1_000_000
+    assert e.max_ops == 1_000_000
+    e2 = TxScriptEngine()
+    assert e2.max_scripts_size == 10_000
+    assert e2.max_element_size == 520
+    assert e2.max_ops == 201
+
+
+def test_fork_activation_params():
+    from kaspa_tpu.consensus.params import NEVER_ACTIVATION, simnet_params
+
+    p = simnet_params()
+    assert p.toccata_activation == NEVER_ACTIVATION
+    assert not p.toccata_active(10**18)
+    p.toccata_activation = 100
+    assert not p.toccata_active(99) and p.toccata_active(100)
+
+
+def test_runtime_sigop_counter_enforced_pre_toccata():
+    """lib.rs:545: pre-Toccata the engine meters executed sig ops against
+    the input's committed sig-op count — more checksigs than committed must
+    fail, enough must pass."""
+    from kaspa_tpu.txscript.resource_meter import RuntimeSigOpCounter
+
+    tx, entries = _intro_tx()
+    sk = 424242
+    pub = eclib.schnorr_pubkey(sk)
+    msg = hashlib.sha256(b"m").digest()
+    sig = eclib.schnorr_sign(msg, sk, b"\x02" * 32)
+    # CSFS twice under a budget of 1 (use Toccata flags for the opcode, with
+    # the sig-op regime meter to isolate the counting behavior)
+    script = (_push(sig) + _push(msg) + _push(pub) + bytes([0xD7, 0x75])) * 2 + b"\x51"
+    e = TxScriptEngine(tx=tx, utxo_entries=entries, input_index=0, flags=TOCCATA,
+                       meter=RuntimeSigOpCounter(1))
+    with pytest.raises(TxScriptError, match="sig op limit"):
+        e.execute_script(script, verify_only_push=False)
+    e2 = TxScriptEngine(tx=tx, utxo_entries=entries, input_index=0, flags=TOCCATA,
+                        meter=RuntimeSigOpCounter(2))
+    e2.execute_script(script, verify_only_push=False)  # exactly enough
+
+
+def test_pushed_bytes_charged_under_script_unit_meter():
+    """lib.rs:632: every byte an opcode pushes costs one script unit, so
+    element-doubling (DUP CAT) exhausts the budget instead of ballooning."""
+    tx, entries = _intro_tx()
+    grow = _push(b"\x41" * 64) + bytes([0x76, 0x7E]) * 12  # 64B doubling 12x
+    m = RuntimeScriptUnitMeter(0, 10_000)
+    e = TxScriptEngine(tx=tx, utxo_entries=entries, input_index=0, flags=TOCCATA, meter=m)
+    with pytest.raises(TxScriptError, match="exceeded committed script units"):
+        e.execute_script(grow, verify_only_push=False)
+    assert m.used_script_units <= 10_000
