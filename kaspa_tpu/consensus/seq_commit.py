@@ -1,0 +1,245 @@
+"""Lane-based sequencing commitments (KIP-21 seq-commit).
+
+Reference: consensus/seq-commit/src/{hashing,types,verify}.rs.  The
+commitment tree:
+
+    SeqCommit(B) = H_seq(parent_seq_commit || state_root)
+    state_root   = H_seq(activity_root || payload_and_ctx_digest)
+    activity_root = H_activity_root(inactivity_shortcut || lanes_root)
+    lanes_root   = SMT root over active lanes (crypto/smt.py,
+                   SeqCommitActiveNode/CollapsedNode domains)
+    payload_and_ctx_digest = H_seq(context_hash || payload_root)
+
+All hashers are keyed BLAKE3 with zero-padded domain keys
+(crypto/hashes/src/hashers.rs blake3_hasher! block); golden vectors from
+the reference's own unit tests pin the exact bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kaspa_tpu.crypto.blake3 import keyed_hash
+from kaspa_tpu.crypto.merkle import calc_merkle_root
+from kaspa_tpu.crypto.smt import SEQ_COMMIT_ACTIVE, SmtProof, SparseMerkleTree
+
+ZERO_HASH = b"\x00" * 32
+
+_D = {
+    "merkle": b"SeqCommitmentMerkleBranchHash",
+    "payload": b"PayloadDigest",
+    "lane_key": b"SeqCommitLaneKey",
+    "lane_tip": b"SeqCommitLaneTip",
+    "activity_leaf": b"SeqCommitActivityLeaf",
+    "mergeset_context": b"SeqCommitMergesetContext",
+    "miner_payload_leaf": b"SeqCommitMinerPayloadLeaf",
+    "activity_root": b"SeqCommitActivityRoot",
+    "active_leaf": b"SeqCommitActiveLeaf",
+}
+
+
+def _h(domain: str, data: bytes) -> bytes:
+    return keyed_hash(_D[domain], data)
+
+
+class _SeqMerkleHasher:
+    """Blake3 H_seq as a merkle hasher_factory."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def update(self, data: bytes):
+        self._buf += data
+        return self
+
+    def digest(self) -> bytes:
+        return _h("merkle", bytes(self._buf))
+
+
+def lane_key(lane_id: bytes) -> bytes:
+    """H_lane_key(lane_id) — lane_id is the 20-byte subnetwork id."""
+    assert len(lane_id) == 20
+    return _h("lane_key", lane_id)
+
+
+def activity_leaf(tx_id: bytes, version: int, merge_idx: int) -> bytes:
+    return _h("activity_leaf", tx_id + version.to_bytes(2, "little") + merge_idx.to_bytes(4, "little"))
+
+
+def activity_digest_lane(leaves: list) -> bytes:
+    """Merkle root over activity leaves with H_seq; single leaf = itself."""
+    return calc_merkle_root(list(leaves), hasher_factory=_SeqMerkleHasher)
+
+
+def lane_tip_next(parent_ref: bytes, lane_key_: bytes, activity_digest: bytes, context_hash: bytes) -> bytes:
+    return _h("lane_tip", parent_ref + lane_key_ + activity_digest + context_hash)
+
+
+@dataclass(frozen=True)
+class MergesetContext:
+    timestamp: int
+    daa_score: int
+    blue_score: int
+
+
+def mergeset_context_hash(ctx: MergesetContext) -> bytes:
+    return _h(
+        "mergeset_context",
+        ctx.timestamp.to_bytes(8, "little")
+        + ctx.daa_score.to_bytes(8, "little")
+        + ctx.blue_score.to_bytes(8, "little"),
+    )
+
+
+def activity_root_hash(inactivity_shortcut: bytes, lanes_root: bytes) -> bytes:
+    return _h("activity_root", inactivity_shortcut + lanes_root)
+
+
+def miner_payload_hash(payload: bytes) -> bytes:
+    return _h("payload", payload)
+
+
+def miner_payload_leaf(block_hash: bytes, blue_work: int, payload: bytes) -> bytes:
+    """H_miner_payload_leaf(block_hash || blue_work || H_payload(payload));
+    blue_work encoded as write_blue_work: le_u64(len) || stripped BE bytes."""
+    stripped = blue_work.to_bytes((blue_work.bit_length() + 7) // 8, "big") if blue_work else b""
+    return _h(
+        "miner_payload_leaf",
+        block_hash + len(stripped).to_bytes(8, "little") + stripped + miner_payload_hash(payload),
+    )
+
+
+def miner_payload_root(leaves: list) -> bytes:
+    return calc_merkle_root(list(leaves), hasher_factory=_SeqMerkleHasher)
+
+
+def smt_leaf_hash(lane_tip: bytes, blue_score: int) -> bytes:
+    """H_active_leaf(lane_tip || le_u64(blue_score)) — lane_key is omitted
+    because both the SMT key path and the lane tip already commit to it."""
+    return _h("active_leaf", lane_tip + blue_score.to_bytes(8, "little"))
+
+
+def payload_and_context_digest(context_hash: bytes, payload_root: bytes) -> bytes:
+    return _h("merkle", context_hash + payload_root)
+
+
+def seq_state_root(activity_root: bytes, payload_and_ctx_digest: bytes) -> bytes:
+    return _h("merkle", activity_root + payload_and_ctx_digest)
+
+
+def seq_commit(parent_seq_commit: bytes, state_root: bytes) -> bytes:
+    return _h("merkle", parent_seq_commit + state_root)
+
+
+COINBASE_LANE_KEY = lane_key(b"\x01" + b"\x00" * 19)
+
+
+# ----------------------------------------------------------------------
+# lane state tracking + IBD verification (verify.rs + smt-store role)
+# ----------------------------------------------------------------------
+
+
+class SmtVerifyError(Exception):
+    pass
+
+
+@dataclass
+class SmtMetadata:
+    lanes_root: bytes
+    payload_and_ctx_digest: bytes
+    parent_seq_commit: bytes
+
+
+def verify_smt_metadata(
+    metadata: SmtMetadata,
+    inactivity_shortcut: bytes,
+    expected_seq_commit: bytes,
+    expected_parent_seq_commit: bytes,
+) -> None:
+    """verify.rs:38 — check IBD-transferred lane metadata against the
+    header's sequencing commitment before accepting any lane entries."""
+    if metadata.parent_seq_commit != expected_parent_seq_commit:
+        raise SmtVerifyError(
+            f"parent_seq_commit mismatch: expected {expected_parent_seq_commit.hex()}, got {metadata.parent_seq_commit.hex()}"
+        )
+    activity_root = activity_root_hash(inactivity_shortcut, metadata.lanes_root)
+    state_root = seq_state_root(activity_root, metadata.payload_and_ctx_digest)
+    computed = seq_commit(metadata.parent_seq_commit, state_root)
+    if computed != expected_seq_commit:
+        raise SmtVerifyError(
+            f"seq_commit mismatch: expected {expected_seq_commit.hex()}, computed {computed.hex()}"
+        )
+
+
+class LaneState:
+    """Versioned active-lane tracking — the role of consensus/smt-store:
+    the current SMT over active lanes plus per-chain-block version history
+    so reorgs roll lanes back to the fork point (lane_version_store.rs /
+    reverse_blue_score.rs semantics, in-memory)."""
+
+    def __init__(self):
+        self.tree = SparseMerkleTree(SEQ_COMMIT_ACTIVE)
+        self.lane_tips: dict[bytes, tuple[bytes, int]] = {}  # lane_key -> (tip, blue_score)
+        self._versions: list[tuple[bytes, dict]] = []  # (chain block, {lane_key: prev or None})
+
+    def advance(self, chain_block: bytes, updates: dict[bytes, tuple[bytes, int]]) -> bytes:
+        """Apply lane-tip updates for one chain block; returns the new
+        lanes root.  ``updates``: lane_key -> (lane_tip, blue_score)."""
+        undo: dict[bytes, tuple | None] = {}
+        for lk, (tip, blue_score) in updates.items():
+            undo[lk] = self.lane_tips.get(lk)
+            self.lane_tips[lk] = (tip, blue_score)
+            self.tree.insert(lk, smt_leaf_hash(tip, blue_score))
+        self._versions.append((chain_block, undo))
+        return self.tree.root()
+
+    def rollback(self, to_chain_block: bytes | None) -> bytes:
+        """Unwind versions until the top of history is `to_chain_block`
+        (None = genesis state); returns the restored lanes root.  An
+        unknown target raises rather than silently wiping lane state."""
+        if to_chain_block is not None and all(b != to_chain_block for b, _ in self._versions):
+            raise SmtVerifyError(f"rollback target {to_chain_block.hex()} not in lane version history")
+        while self._versions and (to_chain_block is None or self._versions[-1][0] != to_chain_block):
+            _, undo = self._versions.pop()
+            for lk, prev in undo.items():
+                if prev is None:
+                    self.lane_tips.pop(lk, None)
+                    self.tree.delete(lk)
+                else:
+                    self.lane_tips[lk] = prev
+                    self.tree.insert(lk, smt_leaf_hash(prev[0], prev[1]))
+        return self.tree.root()
+
+    def lanes_root(self) -> bytes:
+        return self.tree.root()
+
+    def prove_lane(self, lane_key_: bytes) -> SmtProof:
+        return self.tree.prove(lane_key_)
+
+
+class SeqCommitAccessor:
+    """What OpChainblockSeqCommit (0xd4) queries (crypto/txscript/src/
+    seq_commit_accessor.rs): resolves a chain block's sequencing commitment
+    from the PoV of the validating context.  Wired into the engine only
+    when KIP-21 is consensus-active; its absence keeps the opcode invalid.
+
+    ``commitments``: chain block -> seq commit; ``chain_blocks``: the
+    selected chain from the PoV, most recent last; ``max_depth``: how far
+    back commitments may be requested."""
+
+    def __init__(self, commitments: dict, chain_blocks: list, max_depth: int):
+        self._commitments = commitments
+        self._chain_index = {b: i for i, b in enumerate(chain_blocks)}
+        self._tip_index = len(chain_blocks) - 1
+        self._max_depth = max_depth
+
+    def is_chain_ancestor_from_pov(self, block: bytes):
+        if block not in self._commitments and block not in self._chain_index:
+            return None  # unknown/pruned
+        return block in self._chain_index
+
+    def seq_commitment_within_depth(self, block: bytes):
+        idx = self._chain_index.get(block)
+        if idx is None or self._tip_index - idx > self._max_depth:
+            return None
+        return self._commitments.get(block)

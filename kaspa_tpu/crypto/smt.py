@@ -1,0 +1,245 @@
+"""256-bit Sparse Merkle Tree with collapsed single-leaf subtrees and
+bitmap-compressed proofs (KIP-21).
+
+Reference: crypto/smt/src/{lib,tree,proof}.rs.  Semantics:
+
+- Keys are 32-byte hashes; bit 0 = MSB of byte 0 (root split), bit 255 =
+  LSB of byte 31 (leaf split).
+- A subtree holding exactly one leaf is *collapsed* to a single node with
+  hash ``CollapsedHasher(key || leaf_hash)`` — domain-separated from the
+  internal ``NodeHasher(left || right)`` to kill branch/collapsed second
+  preimages.  An empty subtree at height i hashes to EMPTY_HASHES[i]
+  (EMPTY_HASHES[0] = ZERO_HASH).
+- Proofs carry a 256-bit bitmap marking which siblings along the
+  root->terminal path are non-empty, only the non-empty sibling hashes,
+  and a terminal describing where traversal stopped: the queried leaf, a
+  collapsed subtree containing the queried key, a collapsed subtree owned
+  by a *different* key (non-inclusion witness), or an empty subtree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kaspa_tpu.crypto.blake3 import blake3_keyed, domain_key
+
+DEPTH = 256
+ZERO_HASH = b"\x00" * 32
+
+
+class SmtError(Exception):
+    pass
+
+
+def bit_at(key: bytes, d: int) -> bool:
+    """Big-endian bit order (lib.rs:59): True = right branch."""
+    return key[d >> 3] & (0x80 >> (d & 7)) != 0
+
+
+class SmtHasher:
+    """A node/collapsed hasher pair with the per-level empty-hash table."""
+
+    def __init__(self, node_domain: bytes, collapsed_domain: bytes):
+        self._node_key = domain_key(node_domain)
+        self._collapsed_key = domain_key(collapsed_domain)
+        table = [ZERO_HASH]
+        for _ in range(DEPTH):
+            table.append(self.hash_node(table[-1], table[-1]))
+        self.empty_hashes = table  # [height] -> hash of an empty subtree
+
+    def hash_node(self, left: bytes, right: bytes) -> bytes:
+        return blake3_keyed(self._node_key, left + right)
+
+    def hash_collapsed(self, key: bytes, leaf_hash: bytes) -> bytes:
+        return blake3_keyed(self._collapsed_key, key + leaf_hash)
+
+    def empty_root(self) -> bytes:
+        return self.empty_hashes[DEPTH]
+
+
+# the KIP-21 active-lanes tree hasher (hashers.rs SeqCommitActiveNode /
+# SeqCommitActiveCollapsedNode)
+SEQ_COMMIT_ACTIVE = SmtHasher(b"SeqCommitActiveNode", b"SeqCommitActiveCollapsedNode")
+
+
+@dataclass
+class SmtProof:
+    """Bitmap-compressed membership/non-membership proof.
+
+    ``bitmap`` bit d (big-endian, like key bits) is set iff the sibling at
+    depth d is non-empty; ``siblings`` lists those hashes root-first.
+    ``terminal`` is one of:
+      ("leaf",)                      — path descended all 256 levels
+      ("collapsed", depth)           — stopped at a collapsed node owning
+                                        the queried key
+      ("collapsed_other", depth, foreign_key, foreign_leaf)
+                                     — a different key owns the subtree
+      ("empty", depth)               — the subtree at `depth` is empty
+    """
+
+    bitmap: bytes  # 32 bytes
+    siblings: list
+    terminal: tuple
+
+    def terminal_depth(self) -> int:
+        kind = self.terminal[0]
+        if kind == "leaf":
+            return DEPTH
+        return self.terminal[1]
+
+    def compute_root(self, hasher: SmtHasher, key: bytes, leaf_hash) -> bytes:
+        """Fold the path back to a root.  ``leaf_hash`` of None means the
+        caller asserts non-membership (terminal must be empty or owned by a
+        foreign key).  Structurally malformed proofs raise SmtError; the
+        encoding is canonical (bits at or beyond the terminal depth must be
+        clear) so byte-distinct proofs cannot verify for the same fact."""
+        if len(self.bitmap) != 32:
+            raise SmtError(f"bitmap must be 32 bytes, got {len(self.bitmap)}")
+        kind = self.terminal[0] if self.terminal else None
+        expected_arity = {"leaf": 1, "collapsed": 2, "collapsed_other": 4, "empty": 2}.get(kind)
+        if expected_arity is None or len(self.terminal) != expected_arity:
+            raise SmtError(f"malformed terminal {self.terminal!r}")
+        if kind == "collapsed_other" and (
+            len(self.terminal[2]) != 32 or len(self.terminal[3]) != 32
+        ):
+            raise SmtError("malformed foreign terminal")
+        depth = self.terminal_depth()
+        if not (0 <= depth <= DEPTH):
+            raise SmtError(f"terminal depth {depth} out of range")
+        for d in range(depth, DEPTH):
+            if self.bitmap[d >> 3] & (0x80 >> (d & 7)):
+                raise SmtError("non-canonical bitmap: bit set beyond terminal depth")
+        if kind == "leaf":
+            if leaf_hash is None:
+                raise SmtError("membership proof requires a leaf hash")
+            cur = leaf_hash
+        elif kind == "collapsed":
+            if leaf_hash is None:
+                raise SmtError("membership proof requires a leaf hash")
+            cur = hasher.hash_collapsed(key, leaf_hash)
+        elif kind == "collapsed_other":
+            foreign_key, foreign_leaf = self.terminal[2], self.terminal[3]
+            if leaf_hash is not None:
+                raise SmtError("non-membership terminal with a leaf hash")
+            if foreign_key == key:
+                raise SmtError("foreign terminal claims the queried key")
+            # the foreign key must actually live in this subtree
+            for d in range(depth):
+                if bit_at(foreign_key, d) != bit_at(key, d):
+                    raise SmtError("foreign key outside the terminal subtree")
+            cur = hasher.hash_collapsed(foreign_key, foreign_leaf)
+        elif kind == "empty":
+            if leaf_hash is not None:
+                raise SmtError("non-membership terminal with a leaf hash")
+            cur = hasher.empty_hashes[DEPTH - depth]
+        else:
+            raise SmtError(f"unknown terminal {kind}")
+
+        sib_iter = iter(reversed(self.siblings))
+        expected_non_empty = sum(
+            1 for d in range(depth) if self.bitmap[d >> 3] & (0x80 >> (d & 7))
+        )
+        if expected_non_empty != len(self.siblings):
+            raise SmtError("sibling count does not match bitmap")
+        for d in range(depth - 1, -1, -1):
+            non_empty = self.bitmap[d >> 3] & (0x80 >> (d & 7))
+            sibling = next(sib_iter) if non_empty else hasher.empty_hashes[DEPTH - d - 1]
+            if bit_at(key, d):
+                cur = hasher.hash_node(sibling, cur)
+            else:
+                cur = hasher.hash_node(cur, sibling)
+        return cur
+
+    def verify(self, hasher: SmtHasher, key: bytes, leaf_hash, root: bytes) -> bool:
+        try:
+            return self.compute_root(hasher, key, leaf_hash) == root
+        except (SmtError, IndexError, TypeError):
+            return False  # malformed peer-supplied proofs reject, never raise
+
+
+class SparseMerkleTree:
+    """In-memory SMT (tree.rs SparseMerkleTree): a sorted-leaf functional
+    core — roots and proofs are computed by recursive key-bit splits over
+    the sorted leaf list, with single-leaf subtrees collapsing."""
+
+    def __init__(self, hasher: SmtHasher = SEQ_COMMIT_ACTIVE):
+        self.hasher = hasher
+        self._leaves: dict[bytes, bytes] = {}
+
+    def insert(self, key: bytes, leaf_hash: bytes) -> None:
+        assert len(key) == 32 and len(leaf_hash) == 32
+        self._leaves[key] = leaf_hash
+
+    def delete(self, key: bytes) -> None:
+        self._leaves.pop(key, None)
+
+    def get(self, key: bytes):
+        return self._leaves.get(key)
+
+    def __len__(self) -> int:
+        return len(self._leaves)
+
+    def root(self) -> bytes:
+        items = sorted(self._leaves.items())
+        return self._subtree_hash(items, 0)
+
+    def _subtree_hash(self, items, depth: int) -> bytes:
+        if not items:
+            return self.hasher.empty_hashes[DEPTH - depth]
+        if len(items) == 1:
+            key, leaf = items[0]
+            # at full key depth the node IS the leaf (proof.rs Leaf
+            # terminal seeds with the raw leaf hash); above it, a
+            # single-leaf subtree collapses
+            return leaf if depth == DEPTH else self.hasher.hash_collapsed(key, leaf)
+        if depth == DEPTH:
+            raise SmtError("duplicate key at leaf depth")
+        split = self._split(items, depth)
+        return self.hasher.hash_node(
+            self._subtree_hash(items[:split], depth + 1),
+            self._subtree_hash(items[split:], depth + 1),
+        )
+
+    @staticmethod
+    def _split(items, depth: int) -> int:
+        """First index whose key has bit `depth` set (items sorted, so the
+        bit partitions them contiguously)."""
+        lo, hi = 0, len(items)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if bit_at(items[mid][0], depth):
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    def prove(self, key: bytes) -> SmtProof:
+        """Membership proof if `key` is present, else a non-membership
+        proof (empty or foreign-collapsed terminal)."""
+        items = sorted(self._leaves.items())
+        bitmap = bytearray(32)
+        siblings: list[bytes] = []
+        depth = 0
+        while True:
+            if not items:
+                return SmtProof(bytes(bitmap), siblings, ("empty", depth))
+            if len(items) == 1:
+                k, leaf = items[0]
+                if k == key:
+                    term = ("leaf",) if depth == DEPTH else ("collapsed", depth)
+                    return SmtProof(bytes(bitmap), siblings, term)
+                if depth == DEPTH:
+                    raise SmtError("distinct keys cannot share all 256 bits")
+                return SmtProof(bytes(bitmap), siblings, ("collapsed_other", depth, k, leaf))
+            if depth == DEPTH:
+                raise SmtError("duplicate key at leaf depth")
+            split = self._split(items, depth)
+            left, right = items[:split], items[split:]
+            if bit_at(key, depth):
+                sibling_items, items = left, right
+            else:
+                sibling_items, items = right, left
+            if sibling_items:
+                bitmap[depth >> 3] |= 0x80 >> (depth & 7)
+                siblings.append(self._subtree_hash(sibling_items, depth + 1))
+            depth += 1

@@ -1,0 +1,329 @@
+"""KIP-21 SMT + sequencing-commitment tests.
+
+Golden vectors come from the reference's own unit tests
+(consensus/seq-commit/src/hashing.rs tests, crypto/smt/src/lib.rs tests);
+tree/proof behavior mirrors crypto/smt/src/{tree,proof}.rs.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import seq_commit as sc
+from kaspa_tpu.crypto.smt import (
+    DEPTH,
+    SEQ_COMMIT_ACTIVE,
+    ZERO_HASH,
+    SmtError,
+    SmtProof,
+    SparseMerkleTree,
+    bit_at,
+)
+
+
+def h(b: int) -> bytes:
+    return bytes([b]) + b"\x00" * 31
+
+
+# ----------------------------------------------------------------------
+# bit extraction + empty-hash table (lib.rs tests)
+# ----------------------------------------------------------------------
+
+
+def test_key_bit_extraction():
+    assert not any(bit_at(b"\x00" * 32, d) for d in range(256))
+    assert all(bit_at(b"\xff" * 32, d) for d in range(256))
+    key = b"\x80" + b"\x00" * 31
+    assert bit_at(key, 0) and not bit_at(key, 1) and not bit_at(key, 7)
+    key = b"\x00" * 31 + b"\x01"
+    assert not bit_at(key, 254) and bit_at(key, 255)
+    key = b"\xa5" + b"\x00" * 31  # 10100101
+    assert [bit_at(key, d) for d in range(8)] == [True, False, True, False, False, True, False, True]
+
+
+def test_empty_hashes_table():
+    t = SEQ_COMMIT_ACTIVE.empty_hashes
+    assert t[0] == ZERO_HASH
+    assert t[1] == SEQ_COMMIT_ACTIVE.hash_node(ZERO_HASH, ZERO_HASH)
+    assert t[2] == SEQ_COMMIT_ACTIVE.hash_node(t[1], t[1])
+    assert t[DEPTH] == SEQ_COMMIT_ACTIVE.empty_root() != ZERO_HASH
+    assert len(set(t)) == DEPTH + 1  # all levels distinct
+
+
+# ----------------------------------------------------------------------
+# tree semantics (tree.rs)
+# ----------------------------------------------------------------------
+
+
+def test_empty_tree_root():
+    assert SparseMerkleTree().root() == SEQ_COMMIT_ACTIVE.empty_root()
+
+
+def test_single_leaf_collapses_to_root():
+    t = SparseMerkleTree()
+    key, leaf = hashlib.sha256(b"k").digest(), hashlib.sha256(b"v").digest()
+    t.insert(key, leaf)
+    assert t.root() == SEQ_COMMIT_ACTIVE.hash_collapsed(key, leaf)
+
+
+def test_two_leaves_split_at_first_differing_bit():
+    t = SparseMerkleTree()
+    k_left = b"\x00" * 32  # bit 0 clear
+    k_right = b"\x80" + b"\x00" * 31  # bit 0 set
+    l1, l2 = h(1), h(2)
+    t.insert(k_left, l1)
+    t.insert(k_right, l2)
+    H = SEQ_COMMIT_ACTIVE
+    assert t.root() == H.hash_node(H.hash_collapsed(k_left, l1), H.hash_collapsed(k_right, l2))
+
+
+def test_insert_update_delete_roundtrip():
+    t = SparseMerkleTree()
+    rng = random.Random(3)
+    keys = [rng.randbytes(32) for _ in range(40)]
+    for i, k in enumerate(keys):
+        t.insert(k, h(i % 250 + 1))
+    root_full = t.root()
+    # update changes the root, reverting restores it
+    t.insert(keys[7], h(200))
+    assert t.root() != root_full
+    t.insert(keys[7], h(8))
+    assert t.root() == root_full
+    # deletion down to one leaf collapses
+    for k in keys[1:]:
+        t.delete(k)
+    assert t.root() == SEQ_COMMIT_ACTIVE.hash_collapsed(keys[0], h(1))
+    t.delete(keys[0])
+    assert t.root() == SEQ_COMMIT_ACTIVE.empty_root()
+
+
+def test_root_is_insertion_order_independent():
+    rng = random.Random(9)
+    entries = [(rng.randbytes(32), rng.randbytes(32)) for _ in range(25)]
+    t1, t2 = SparseMerkleTree(), SparseMerkleTree()
+    for k, v in entries:
+        t1.insert(k, v)
+    for k, v in reversed(entries):
+        t2.insert(k, v)
+    assert t1.root() == t2.root()
+
+
+# ----------------------------------------------------------------------
+# proofs (proof.rs)
+# ----------------------------------------------------------------------
+
+
+def test_membership_proofs_verify_and_reject():
+    t = SparseMerkleTree()
+    rng = random.Random(5)
+    entries = {rng.randbytes(32): rng.randbytes(32) for _ in range(30)}
+    for k, v in entries.items():
+        t.insert(k, v)
+    root = t.root()
+    for k, v in list(entries.items())[:10]:
+        proof = t.prove(k)
+        assert proof.verify(SEQ_COMMIT_ACTIVE, k, v, root)
+        assert not proof.verify(SEQ_COMMIT_ACTIVE, k, h(99), root)  # wrong leaf
+        assert not proof.verify(SEQ_COMMIT_ACTIVE, k, v, h(1))  # wrong root
+    # proofs are compressed: far fewer than 256 siblings
+    assert all(len(t.prove(k).siblings) < 16 for k in entries)
+
+
+def test_non_membership_proofs():
+    t = SparseMerkleTree()
+    rng = random.Random(6)
+    for _ in range(20):
+        t.insert(rng.randbytes(32), rng.randbytes(32))
+    root = t.root()
+    absent = rng.randbytes(32)
+    proof = t.prove(absent)
+    assert proof.terminal[0] in ("empty", "collapsed_other")
+    assert proof.verify(SEQ_COMMIT_ACTIVE, absent, None, root)
+    # a non-membership proof cannot claim membership
+    assert not proof.verify(SEQ_COMMIT_ACTIVE, absent, h(1), root)
+    # empty tree: trivial non-membership
+    empty = SparseMerkleTree()
+    p0 = empty.prove(absent)
+    assert p0.terminal == ("empty", 0)
+    assert p0.verify(SEQ_COMMIT_ACTIVE, absent, None, empty.root())
+
+
+def test_forged_foreign_terminal_rejected():
+    t = SparseMerkleTree()
+    key_in = b"\x00" * 32
+    t.insert(key_in, h(1))
+    t.insert(b"\xff" * 32, h(2))
+    root = t.root()
+    # try to prove non-membership of a key that IS present by presenting a
+    # foreign collapsed terminal with a key outside the subtree
+    proof = t.prove(b"\x01" + b"\x00" * 31)  # shares bit-0 subtree with key_in
+    assert proof.terminal[0] == "collapsed_other"
+    bad = SmtProof(proof.bitmap, proof.siblings, ("collapsed_other", proof.terminal[1], b"\xff" * 32, h(2)))
+    assert not bad.verify(SEQ_COMMIT_ACTIVE, b"\x01" + b"\x00" * 31, None, root)
+
+
+# ----------------------------------------------------------------------
+# seq-commit hashing goldens (hashing.rs tests)
+# ----------------------------------------------------------------------
+
+
+def test_lane_key_golden():
+    expected = bytes(
+        [0x57, 0xC7, 0xE5, 0x2C, 0x76, 0x02, 0xB3, 0x66, 0xB3, 0xF6, 0x62, 0xAD, 0xDC, 0x36, 0x12, 0x96,
+         0x77, 0xD4, 0x84, 0x4B, 0x84, 0x04, 0x68, 0xCC, 0xAA, 0x96, 0x31, 0x10, 0x6B, 0xEA, 0x88, 0x97]
+    )
+    assert sc.lane_key(b"\x42" * 20) == expected
+    assert sc.lane_key(b"\x01" * 20) != sc.lane_key(b"\x02" * 20)
+
+
+def test_coinbase_lane_key_constant_golden():
+    expected = bytes(
+        [0x8A, 0xA7, 0x80, 0x27, 0xDB, 0x66, 0xA1, 0x6C, 0xB6, 0x96, 0x92, 0xEE, 0x0A, 0xF5, 0xCB, 0x76,
+         0x73, 0x8E, 0xF8, 0x0A, 0xD1, 0x4C, 0x9D, 0x13, 0x92, 0x0D, 0x7F, 0xA3, 0xCC, 0x40, 0xB9, 0xE4]
+    )
+    assert sc.COINBASE_LANE_KEY == expected
+
+
+def test_activity_leaf_golden():
+    expected = bytes(
+        [0x4E, 0xF4, 0x3F, 0x31, 0x6E, 0xCF, 0x61, 0x6C, 0x69, 0x34, 0xB5, 0x66, 0xAE, 0x41, 0x05, 0x5E,
+         0x97, 0x12, 0xF1, 0x08, 0x9B, 0x91, 0x4F, 0x33, 0x18, 0x6C, 0xDC, 0x9D, 0x55, 0x19, 0x11, 0x21]
+    )
+    assert sc.activity_leaf(h(1), 0, 0) == expected
+    assert sc.activity_leaf(h(1), 0, 0) != sc.activity_leaf(h(1), 0, 1)
+
+
+def test_activity_digest_single_leaf_is_identity():
+    assert sc.activity_digest_lane([h(5)]) == h(5)
+    assert sc.activity_digest_lane([]) == ZERO_HASH
+    two = sc.activity_digest_lane([h(1), h(2)])
+    assert two not in (h(1), h(2))
+
+
+def test_blue_work_encoding_strips_leading_zeros():
+    # blue_work 0 -> empty stripped bytes, len 0
+    a = sc.miner_payload_leaf(h(1), 0, b"p")
+    b = sc.miner_payload_leaf(h(1), 1, b"p")
+    c = sc.miner_payload_leaf(h(1), 0x0100, b"p")
+    assert len({a, b, c}) == 3
+
+
+def test_seq_commit_chain_and_metadata_verify():
+    lanes_root = h(1)
+    pd = h(3)
+    parent = h(4)
+    shortcut = bytes([7]) * 32
+    ar = sc.activity_root_hash(shortcut, lanes_root)
+    sr = sc.seq_state_root(ar, pd)
+    commit = sc.seq_commit(parent, sr)
+    md = sc.SmtMetadata(lanes_root, pd, parent)
+    sc.verify_smt_metadata(md, shortcut, commit, parent)  # ok
+    with pytest.raises(sc.SmtVerifyError, match="parent_seq_commit"):
+        sc.verify_smt_metadata(md, shortcut, ZERO_HASH, bytes([99]) * 32)
+    with pytest.raises(sc.SmtVerifyError, match="seq_commit mismatch"):
+        sc.verify_smt_metadata(md, shortcut, bytes([99]) * 32, parent)
+    with pytest.raises(sc.SmtVerifyError, match="seq_commit mismatch"):
+        sc.verify_smt_metadata(md, bytes([0xAB]) * 32, commit, parent)  # bad shortcut
+
+
+def test_lane_state_advance_rollback_and_proofs():
+    st = sc.LaneState()
+    empty_root = st.lanes_root()
+    lk1, lk2 = sc.lane_key(b"\x01" * 20), sc.lane_key(b"\x02" * 20)
+
+    r1 = st.advance(h(10), {lk1: (h(100), 5)})
+    r2 = st.advance(h(11), {lk2: (h(101), 6), lk1: (h(102), 6)})
+    assert len({empty_root, r1, r2}) == 3
+
+    # proofs against the live root
+    p = st.prove_lane(lk1)
+    assert p.verify(SEQ_COMMIT_ACTIVE, lk1, sc.smt_leaf_hash(h(102), 6), r2)
+    absent = sc.lane_key(b"\x03" * 20)
+    assert st.prove_lane(absent).verify(SEQ_COMMIT_ACTIVE, absent, None, r2)
+
+    # reorg: roll back to the first chain block, then to genesis
+    assert st.rollback(h(10)) == r1
+    assert st.lane_tips[lk1] == (h(100), 5) and lk2 not in st.lane_tips
+    assert st.rollback(None) == empty_root
+
+
+def test_chainblock_seq_commit_opcode():
+    from kaspa_tpu.txscript.vm import EngineFlags, TxScriptEngine, TxScriptError
+
+    chain = [h(10), h(11), h(12)]
+    commits = {b: sc.seq_commit(b, h(42)) for b in chain}
+    acc = sc.SeqCommitAccessor(commits, chain, max_depth=1)
+    e = TxScriptEngine(flags=EngineFlags(covenants_enabled=True), seq_commit_accessor=acc)
+    e.dstack = [chain[2]]
+    e._op_chainblock_seq_commit()
+    assert e.dstack == [commits[chain[2]]]
+    # too deep
+    e.dstack = [chain[0]]
+    with pytest.raises(TxScriptError, match="too deep"):
+        e._op_chainblock_seq_commit()
+    # not on the selected chain
+    e.dstack = [h(99)]
+    with pytest.raises(TxScriptError, match="pruned"):
+        e._op_chainblock_seq_commit()
+    commits_off = dict(commits); commits_off[h(77)] = h(1)
+    acc2 = sc.SeqCommitAccessor(commits_off, chain, max_depth=5)
+    e2 = TxScriptEngine(flags=EngineFlags(covenants_enabled=True), seq_commit_accessor=acc2)
+    e2.dstack = [h(77)]
+    with pytest.raises(TxScriptError, match="not on the selected chain"):
+        e2._op_chainblock_seq_commit()
+    # no accessor -> invalid opcode
+    e3 = TxScriptEngine(flags=EngineFlags(covenants_enabled=True))
+    e3.dstack = [chain[2]]
+    with pytest.raises(TxScriptError, match="invalid opcode"):
+        e3._op_chainblock_seq_commit()
+
+
+def test_last_bit_sibling_keys_prove_at_leaf_depth():
+    """Keys differing only in bit 255: depth-256 nodes are raw leaf hashes
+    (proof.rs Leaf terminal), and membership proofs verify for both."""
+    t = SparseMerkleTree()
+    k0, k1 = b"\x00" * 32, b"\x00" * 31 + b"\x01"
+    t.insert(k0, h(1))
+    t.insert(k1, h(2))
+    root = t.root()
+    for k, leaf in ((k0, h(1)), (k1, h(2))):
+        p = t.prove(k)
+        assert p.terminal == ("leaf",)
+        assert p.verify(SEQ_COMMIT_ACTIVE, k, leaf, root)
+    assert not t.prove(k0).verify(SEQ_COMMIT_ACTIVE, k0, h(2), root)
+
+
+def test_malformed_proofs_reject_instead_of_raising():
+    t = SparseMerkleTree()
+    t.insert(h(1), h(2))
+    root = t.root()
+    assert not SmtProof(b"", [], ("empty", 8)).verify(SEQ_COMMIT_ACTIVE, h(1), None, root)
+    assert not SmtProof(b"\x00" * 32, [], ("collapsed",)).verify(SEQ_COMMIT_ACTIVE, h(1), h(2), root)
+    assert not SmtProof(b"\x00" * 32, [], ("bogus", 1)).verify(SEQ_COMMIT_ACTIVE, h(1), h(2), root)
+    assert not SmtProof(b"\x00" * 32, [], ("empty", 999)).verify(SEQ_COMMIT_ACTIVE, h(1), None, root)
+
+
+def test_proof_encoding_is_canonical():
+    """Flipping a bitmap bit beyond the terminal depth must invalidate the
+    proof (no proof malleability)."""
+    t = SparseMerkleTree()
+    rng = random.Random(11)
+    for _ in range(8):
+        t.insert(rng.randbytes(32), rng.randbytes(32))
+    k = next(iter(t._leaves))
+    root = t.root()
+    p = t.prove(k)
+    assert p.verify(SEQ_COMMIT_ACTIVE, k, t.get(k), root)
+    bm = bytearray(p.bitmap)
+    bm[31] |= 0x01  # bit 255, far beyond any terminal depth here
+    assert not SmtProof(bytes(bm), p.siblings, p.terminal).verify(SEQ_COMMIT_ACTIVE, k, t.get(k), root)
+
+
+def test_lane_state_rollback_unknown_target_raises():
+    st = sc.LaneState()
+    st.advance(h(10), {sc.lane_key(b"\x01" * 20): (h(100), 5)})
+    with pytest.raises(sc.SmtVerifyError, match="not in lane version history"):
+        st.rollback(h(99))
+    # state untouched by the failed rollback
+    assert len(st.lane_tips) == 1
