@@ -19,7 +19,9 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from kaspa_tpu.crypto.blake3 import keyed_hash
+import functools
+
+from kaspa_tpu.crypto.blake3 import Blake3Keyed, keyed_hash
 from kaspa_tpu.crypto.merkle import calc_merkle_root
 from kaspa_tpu.crypto.smt import SEQ_COMMIT_ACTIVE, SmtProof, SparseMerkleTree
 
@@ -42,18 +44,9 @@ def _h(domain: str, data: bytes) -> bytes:
     return keyed_hash(_D[domain], data)
 
 
-class _SeqMerkleHasher:
-    """Blake3 H_seq as a merkle hasher_factory."""
-
-    def __init__(self):
-        self._buf = bytearray()
-
-    def update(self, data: bytes):
-        self._buf += data
-        return self
-
-    def digest(self) -> bytes:
-        return _h("merkle", bytes(self._buf))
+# Blake3 H_seq as a merkle hasher_factory (Blake3Keyed has the same
+# incremental update()/digest() interface the merkle builder expects)
+_SeqMerkleHasher = functools.partial(Blake3Keyed, _D["merkle"])
 
 
 def lane_key(lane_id: bytes) -> bytes:

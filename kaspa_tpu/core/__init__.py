@@ -1,0 +1,2 @@
+from kaspa_tpu.core.service import Core, Service
+from kaspa_tpu.core.tick import TickService

@@ -1,0 +1,134 @@
+"""Core/Service runtime: ordered service lifecycle for the node assembly.
+
+Reference: core/src/{core.rs,service.rs,signals.rs} — services register
+with a Core, which starts them in bind order (each returning its worker
+threads), joins them, and shuts them down in reverse order.  SIGINT/
+SIGTERM trip the shutdown path exactly once.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from kaspa_tpu.core.log import get_logger
+
+log = get_logger("core")
+
+
+class Service:
+    """Service trait (service.rs): subclass or duck-type.
+
+    - ``ident()``  — stable name for lookup/logging
+    - ``start(core)`` — begin work; return a list of threads the core joins
+    - ``stop()``  — signal termination; must be idempotent
+    """
+
+    def ident(self) -> str:
+        return type(self).__name__
+
+    def start(self, core: "Core") -> list[threading.Thread]:
+        return []
+
+    def stop(self) -> None:
+        pass
+
+
+class CallbackService(Service):
+    """Adapter for wiring existing objects into the Core without
+    inheritance (most of our subsystems predate the runtime)."""
+
+    def __init__(self, ident: str, on_start=None, on_stop=None):
+        self._ident = ident
+        self._on_start = on_start
+        self._on_stop = on_stop
+
+    def ident(self) -> str:
+        return self._ident
+
+    def start(self, core: "Core") -> list[threading.Thread]:
+        if self._on_start is not None:
+            return self._on_start(core) or []
+        return []
+
+    def stop(self) -> None:
+        if self._on_stop is not None:
+            self._on_stop()
+
+
+class Core:
+    """core.rs Core: bind -> start -> join; shutdown stops services in
+    reverse bind order (dependents before dependencies)."""
+
+    def __init__(self):
+        self.keep_running = threading.Event()
+        self.keep_running.set()
+        self._services: list[Service] = []
+        self._workers: list[threading.Thread] = []
+        self._mu = threading.Lock()
+        self._shutdown_once = threading.Event()
+
+    def bind(self, service: Service) -> None:
+        with self._mu:
+            self._services.append(service)
+
+    def find(self, ident: str) -> Service | None:
+        with self._mu:
+            for s in self._services:
+                if s.ident() == ident:
+                    return s
+        return None
+
+    def start(self) -> list[threading.Thread]:
+        with self._mu:
+            services = list(self._services)
+        workers: list[threading.Thread] = []
+        for service in services:
+            ws = service.start(self)
+            log.debug("service %s started (%d workers)", service.ident(), len(ws))
+            workers.extend(ws)
+        self._workers = workers
+        log.info("core started %d services, %d workers", len(services), len(workers))
+        return workers
+
+    def join(self, workers: list[threading.Thread] | None = None, timeout: float | None = None) -> None:
+        for w in workers if workers is not None else self._workers:
+            w.join(timeout)
+
+    def run(self) -> None:
+        """start + block until shutdown() trips, then stop everything."""
+        self.start()
+        self.keep_running.wait()
+        self._stop_services()
+
+    def shutdown(self) -> None:
+        """Idempotent: stops services in reverse bind order once."""
+        if self._shutdown_once.is_set():
+            return
+        self._shutdown_once.set()
+        self.keep_running.clear()
+        self._stop_services()
+
+    def _stop_services(self) -> None:
+        with self._mu:
+            services = list(reversed(self._services))
+        for service in services:
+            try:
+                service.stop()
+                log.debug("service %s stopped", service.ident())
+            except Exception:  # one failing stop must not strand the rest
+                log.exception("service %s failed to stop", service.ident())
+
+    def install_signal_handlers(self) -> None:
+        """signals.rs Signals::init: first signal begins shutdown; a second
+        forces exit (only callable from the main thread)."""
+
+        def handler(signum, _frame):
+            if self._shutdown_once.is_set():
+                log.warn("second signal %s: forcing exit", signum)
+                raise SystemExit(1)
+            log.info("signal %s: shutting down", signum)
+            threading.Thread(target=self.shutdown, daemon=True).start()
+
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)

@@ -109,6 +109,10 @@ class SmtProof:
         for d in range(depth, DEPTH):
             if self.bitmap[d >> 3] & (0x80 >> (d & 7)):
                 raise SmtError("non-canonical bitmap: bit set beyond terminal depth")
+        if kind == "empty" and depth > 0 and not (self.bitmap[(depth - 1) >> 3] & (0x80 >> ((depth - 1) & 7))):
+            # an empty terminal under an empty sibling re-encodes one level
+            # shallower; pin the depth to the shallowest empty subtree
+            raise SmtError("non-canonical empty terminal: parent sibling also empty")
         if kind == "leaf":
             if leaf_hash is None:
                 raise SmtError("membership proof requires a leaf hash")
@@ -143,7 +147,14 @@ class SmtProof:
             raise SmtError("sibling count does not match bitmap")
         for d in range(depth - 1, -1, -1):
             non_empty = self.bitmap[d >> 3] & (0x80 >> (d & 7))
-            sibling = next(sib_iter) if non_empty else hasher.empty_hashes[DEPTH - d - 1]
+            if non_empty:
+                sibling = next(sib_iter)
+                if sibling == hasher.empty_hashes[DEPTH - d - 1]:
+                    # explicit empty-hash siblings would make the encoding
+                    # malleable against the bitmap's implicit form
+                    raise SmtError("non-canonical proof: explicit empty sibling")
+            else:
+                sibling = hasher.empty_hashes[DEPTH - d - 1]
             if bit_at(key, d):
                 cur = hasher.hash_node(sibling, cur)
             else:

@@ -327,3 +327,41 @@ def test_lane_state_rollback_unknown_target_raises():
         st.rollback(h(99))
     # state untouched by the failed rollback
     assert len(st.lane_tips) == 1
+
+
+def test_proof_not_malleable_via_explicit_empty_sibling():
+    """Setting a cleared bitmap bit and supplying the level's empty hash as
+    an explicit sibling must NOT produce a second verifying encoding."""
+    t = SparseMerkleTree()
+    k = b"\x00" * 32
+    t.insert(k, h(1))
+    t.insert(b"\x00" * 31 + b"\x01", h(2))  # leaf-depth proof: bits 0..254 cleared
+    root = t.root()
+    p = t.prove(k)
+    assert p.verify(SEQ_COMMIT_ACTIVE, k, t.get(k), root)
+    depth = p.terminal_depth()
+    cleared = [d for d in range(depth) if not (p.bitmap[d >> 3] & (0x80 >> (d & 7)))]
+    assert cleared
+    d = cleared[0]
+    bm = bytearray(p.bitmap)
+    bm[d >> 3] |= 0x80 >> (d & 7)
+    insert_at = sum(1 for x in range(d) if p.bitmap[x >> 3] & (0x80 >> (x & 7)))
+    sibs = list(p.siblings)
+    sibs.insert(insert_at, SEQ_COMMIT_ACTIVE.empty_hashes[DEPTH - d - 1])
+    forged = SmtProof(bytes(bm), sibs, p.terminal)
+    assert not forged.verify(SEQ_COMMIT_ACTIVE, k, t.get(k), root)
+
+
+def test_empty_terminal_depth_is_pinned():
+    """('empty', d) under an empty parent sibling re-encodes as
+    ('empty', d-1); only the shallowest encoding verifies."""
+    t = SparseMerkleTree()
+    t.insert(b"\x00" * 32, h(1))  # left half occupied, right half empty
+    t.insert(b"\x40" + b"\x00" * 31, h(2))
+    root = t.root()
+    absent = b"\x80" + b"\xee" * 31  # right half: empty at depth 1
+    p = t.prove(absent)
+    assert p.terminal[0] == "empty"
+    assert p.verify(SEQ_COMMIT_ACTIVE, absent, None, root)
+    deeper = SmtProof(p.bitmap, p.siblings, ("empty", p.terminal[1] + 1))
+    assert not deeper.verify(SEQ_COMMIT_ACTIVE, absent, None, root)
