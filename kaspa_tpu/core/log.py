@@ -1,0 +1,74 @@
+"""Structured logging for the node (core/src/log/{logger,appender}.rs).
+
+The reference layers env_logger-style filtering with per-subsystem
+targets, console + rotating file appenders.  Here: thin wrappers over the
+stdlib logging module with the same shape — `kaspa.<subsystem>` logger
+tree, one console handler, optional file appender, and an env filter
+(KASPA_TPU_LOG, e.g. "info" or "debug,consensus=trace")."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "off": logging.CRITICAL + 10,
+    "error": logging.ERROR,
+    "warn": logging.WARNING,
+    "info": logging.INFO,
+    "debug": logging.DEBUG,
+    "trace": 5,
+}
+logging.addLevelName(5, "TRACE")
+
+_FORMAT = "%(asctime)s [%(levelname)-5s] %(name)s: %(message)s"
+_root = logging.getLogger("kaspa")
+_configured = False
+
+
+class _KaspaLogger(logging.LoggerAdapter):
+    def trace(self, msg, *args, **kwargs):
+        self.log(5, msg, *args, **kwargs)
+
+    def warn(self, msg, *args, **kwargs):  # reference naming
+        self.warning(msg, *args, **kwargs)
+
+    def exception(self, msg, *args, **kwargs):
+        self.logger.exception(msg, *args, **kwargs)
+
+
+def init_logger(spec: str | None = None, log_file: str | None = None) -> None:
+    """Configure once from a filter spec: "<default>[,<subsystem>=<level>...]".
+
+    Mirrors the reference's logger::init_logger(filters) semantics."""
+    global _configured
+    spec = spec if spec is not None else os.environ.get("KASPA_TPU_LOG", "info")
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    default = "info"
+    per_target: dict[str, str] = {}
+    for p in parts:
+        if "=" in p:
+            target, lvl = p.split("=", 1)
+            per_target[target.strip()] = lvl.strip()
+        else:
+            default = p
+    _root.setLevel(_LEVELS.get(default, logging.INFO))
+    for target, lvl in per_target.items():
+        logging.getLogger(f"kaspa.{target}").setLevel(_LEVELS.get(lvl, logging.INFO))
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        _root.addHandler(handler)
+        _root.propagate = False
+        _configured = True
+    if log_file:
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(logging.Formatter(_FORMAT))
+        _root.addHandler(fh)
+
+
+def get_logger(subsystem: str) -> _KaspaLogger:
+    if not _configured:
+        init_logger()
+    return _KaspaLogger(logging.getLogger(f"kaspa.{subsystem}"), {})

@@ -67,6 +67,7 @@ class Core:
         self._workers: list[threading.Thread] = []
         self._mu = threading.Lock()
         self._shutdown_once = threading.Event()
+        self._shutdown_mu = threading.Lock()
 
     def bind(self, service: Service) -> None:
         with self._mu:
@@ -95,19 +96,25 @@ class Core:
         for w in workers if workers is not None else self._workers:
             w.join(timeout)
 
+    def wait_for_shutdown(self, timeout: float | None = None) -> bool:
+        """Block until shutdown() trips (the inverse of keep_running)."""
+        return self._shutdown_once.wait(timeout)
+
     def run(self) -> None:
-        """start + block until shutdown() trips, then stop everything."""
+        """start + block until shutdown() trips (services stop there)."""
         self.start()
-        self.keep_running.wait()
-        self._stop_services()
+        self.wait_for_shutdown()
 
     def shutdown(self) -> None:
-        """Idempotent: stops services in reverse bind order once."""
-        if self._shutdown_once.is_set():
-            return
-        self._shutdown_once.set()
-        self.keep_running.clear()
-        self._stop_services()
+        """Idempotent: stops services in reverse bind order exactly once.
+        Late callers block until the in-flight stop completes, so code
+        sequenced after shutdown() can rely on services being down."""
+        with self._shutdown_mu:
+            if self._shutdown_once.is_set():
+                return
+            self.keep_running.clear()
+            self._stop_services()
+            self._shutdown_once.set()
 
     def _stop_services(self) -> None:
         with self._mu:

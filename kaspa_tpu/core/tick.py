@@ -1,0 +1,43 @@
+"""Tick service: cancellable periodic tasks (core/src/task/tick.rs).
+
+Periodic workers (perf monitor sampling, mempool expiry scans, template
+rebuilds) register a callback + interval; shutdown wakes every sleeper
+immediately instead of waiting out the interval."""
+
+from __future__ import annotations
+
+import threading
+
+from kaspa_tpu.core.service import Service
+
+
+class TickService(Service):
+    def __init__(self):
+        self._stop = threading.Event()
+        self._tasks: list[tuple[float, object]] = []
+
+    def ident(self) -> str:
+        return "tick-service"
+
+    def register(self, interval_s: float, callback) -> None:
+        self._tasks.append((interval_s, callback))
+
+    def start(self, core) -> list[threading.Thread]:
+        threads = []
+        for interval, callback in self._tasks:
+            t = threading.Thread(target=self._loop, args=(interval, callback), daemon=True)
+            t.start()
+            threads.append(t)
+        return threads
+
+    def _loop(self, interval: float, callback) -> None:
+        while not self._stop.wait(interval):
+            try:
+                callback()
+            except Exception:
+                from kaspa_tpu.core.log import get_logger
+
+                get_logger("tick").exception("periodic task failed")
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,136 @@
+"""Metrics core: snapshots + per-second rates (metrics/core/src/data.rs).
+
+The reference's MetricsSnapshot holds grouped gauges (System / Storage /
+Bandwidth / Connections / Network); a Metrics poller samples the node on
+a tick and derives `*PerSecond` rates from consecutive snapshot deltas.
+Here the snapshot is a flat dict keyed by the same metric names, the
+groups index into it, and `MetricsData.rates()` computes the deltas."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+METRIC_GROUPS: dict[str, list[str]] = {
+    "system": [
+        "node_cpu_usage",
+        "node_resident_set_size_bytes",
+        "node_virtual_memory_size_bytes",
+        "node_file_handles_count",
+    ],
+    "storage": [
+        "node_disk_io_read_bytes",
+        "node_disk_io_read_per_sec",
+        "node_disk_io_write_bytes",
+        "node_disk_io_write_per_sec",
+        "node_storage_size_bytes",
+    ],
+    "bandwidth": [
+        "node_total_bytes_tx",
+        "node_total_bytes_tx_per_second",
+        "node_total_bytes_rx",
+        "node_total_bytes_rx_per_second",
+    ],
+    "connections": [
+        "node_active_peers",
+        "node_borsh_live_connections",
+        "node_json_live_connections",
+    ],
+    "network": [
+        "node_blocks_submitted_count",
+        "node_headers_processed_count",
+        "node_dependencies_processed_count",
+        "node_bodies_processed_count",
+        "node_txs_processed_count",
+        "node_chain_blocks_processed_count",
+        "node_mass_processed_count",
+        "node_database_blocks_count",
+        "node_database_headers_count",
+        "network_mempool_size",
+        "network_tip_hashes_count",
+        "network_difficulty",
+        "network_past_median_time",
+        "network_virtual_parent_hashes_count",
+        "network_virtual_daa_score",
+    ],
+}
+
+_RATE_SOURCES = {
+    "node_disk_io_read_per_sec": "node_disk_io_read_bytes",
+    "node_disk_io_write_per_sec": "node_disk_io_write_bytes",
+    "node_total_bytes_tx_per_second": "node_total_bytes_tx",
+    "node_total_bytes_rx_per_second": "node_total_bytes_rx",
+}
+
+
+@dataclass
+class MetricsSnapshot:
+    unixtime_millis: float
+    values: dict = field(default_factory=dict)
+
+    def get(self, name: str, default=0):
+        return self.values.get(name, default)
+
+    def group(self, name: str) -> dict:
+        return {m: self.values.get(m) for m in METRIC_GROUPS.get(name, [])}
+
+
+class MetricsData:
+    """Rolling pair of snapshots; rates derive from the last delta
+    (data.rs MetricsData duration-normalized counters)."""
+
+    def __init__(self):
+        self._prev: MetricsSnapshot | None = None
+        self.last: MetricsSnapshot | None = None
+
+    def push(self, snapshot: MetricsSnapshot) -> MetricsSnapshot:
+        self._prev, self.last = self.last, snapshot
+        for rate_name, source in _RATE_SOURCES.items():
+            snapshot.values[rate_name] = self._rate(source)
+        return snapshot
+
+    def _rate(self, name: str) -> float:
+        if self._prev is None or self.last is None:
+            return 0.0
+        dt = (self.last.unixtime_millis - self._prev.unixtime_millis) / 1000.0
+        if dt <= 0:
+            return 0.0
+        return max(0.0, (self.last.get(name) - self._prev.get(name)) / dt)
+
+
+def collect_snapshot(consensus, mining, perf_monitor, p2p_node=None, wire_stats=None) -> MetricsSnapshot:
+    """Sample every subsystem into one snapshot (the Metrics service's
+    task body in metrics/core/src/lib.rs:25-60)."""
+    pm = perf_monitor.sample()
+    counters = consensus.counters.snapshot()
+    snap = MetricsSnapshot(unixtime_millis=time.time() * 1000)
+    v = snap.values
+    v["node_cpu_usage"] = pm.cpu_usage
+    v["node_resident_set_size_bytes"] = pm.resident_set_size
+    v["node_virtual_memory_size_bytes"] = pm.virtual_memory_size
+    v["node_file_handles_count"] = pm.fd_num
+    v["node_disk_io_read_bytes"] = pm.disk_io_read_bytes
+    v["node_disk_io_write_bytes"] = pm.disk_io_write_bytes
+    db = consensus.storage.db
+    v["node_storage_size_bytes"] = db.size_on_disk() if db is not None and hasattr(db, "size_on_disk") else 0
+    if wire_stats is not None:
+        v["node_total_bytes_tx"] = wire_stats.bytes_tx
+        v["node_total_bytes_rx"] = wire_stats.bytes_rx
+    v["node_active_peers"] = len(p2p_node.peers) if p2p_node is not None else 0
+    v["node_blocks_submitted_count"] = counters.blocks_submitted
+    v["node_headers_processed_count"] = counters.header_counts
+    v["node_dependencies_processed_count"] = counters.dep_counts
+    v["node_bodies_processed_count"] = counters.body_counts
+    v["node_txs_processed_count"] = counters.txs_counts
+    v["node_chain_blocks_processed_count"] = counters.chain_block_counts
+    v["node_mass_processed_count"] = counters.mass_counts
+    v["node_database_blocks_count"] = len(consensus.storage.block_transactions._txs)
+    v["node_database_headers_count"] = len(consensus.storage.headers._headers)
+    v["network_mempool_size"] = len(mining.mempool)
+    v["network_tip_hashes_count"] = len(consensus.tips)
+    v["network_virtual_daa_score"] = consensus.get_virtual_daa_score()
+    vs = consensus.virtual_state
+    v["network_virtual_parent_hashes_count"] = len(vs.parents) if vs else 0
+    v["network_difficulty"] = float(vs.bits) if vs else 0.0
+    v["network_past_median_time"] = vs.past_median_time if vs else 0
+    return snap

@@ -156,6 +156,32 @@ class Daemon:
         self._thread: threading.Thread | None = None
         self.p2p_server = None
 
+        # service runtime (core/src/core.rs): ordered start, reverse-order
+        # stop, periodic metrics sampling on the tick service
+        from kaspa_tpu.core import Core, TickService
+        from kaspa_tpu.core.log import get_logger
+        from kaspa_tpu.core.service import CallbackService
+        from kaspa_tpu.metrics.core import MetricsData, collect_snapshot
+        from kaspa_tpu.metrics.perf_monitor import PerfMonitor
+
+        self.log = get_logger("daemon")
+        self.core = Core()
+        self.perf_monitor = PerfMonitor()
+        self.metrics_data = MetricsData()
+        self.tick = TickService()
+
+        def sample_metrics():
+            with self._dispatch_lock:
+                self.metrics_data.push(
+                    collect_snapshot(self.consensus, self.mining, self.perf_monitor, p2p_node=self.node)
+                )
+
+        self.tick.register(10.0, sample_metrics)
+        self.rpc.metrics_provider = lambda: self.metrics_data.last
+        self.core.bind(self.tick)
+        self.core.bind(CallbackService("rpc-server", on_start=self._start_rpc_service, on_stop=self._stop_rpc_service))
+        self.core.bind(CallbackService("p2p-server", on_start=self._start_p2p_service, on_stop=self._stop_p2p_service))
+
     # --- staging consensus (proof IBD) ---
 
     def _staging_factory(self):
@@ -186,6 +212,7 @@ class Daemon:
             connection_manager=self.connection_manager,
             shutdown_fn=self.rpc.shutdown_fn,
         )
+        self.rpc.metrics_provider = lambda: self.metrics_data.last
         if new_consensus.storage.db is not None:
             # atomic pointer rotation: tmp + rename so a crash mid-write
             # cannot leave a truncated ACTIVE behind
@@ -275,7 +302,7 @@ class Daemon:
 
     # --- lifecycle (core/src/core.rs run/shutdown shape) ---
 
-    def start(self) -> str:
+    def _start_rpc_service(self, _core) -> list:
         host, port = self.args.rpclisten.rsplit(":", 1)
         srv = socketserver.ThreadingTCPServer((host, int(port)), _RpcHandler, bind_and_activate=False)
         srv.allow_reuse_address = True
@@ -286,15 +313,39 @@ class Daemon:
         self._server = srv
         self._thread = threading.Thread(target=srv.serve_forever, daemon=True)
         self._thread.start()
+        self._rpc_addr = f"{host}:{srv.server_address[1]}"
+        self.log.info("RPC listening on %s", self._rpc_addr)
+        return [self._thread]
+
+    def _stop_rpc_service(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def _start_p2p_service(self, _core) -> list:
         if getattr(self.args, "listen", None):
             from kaspa_tpu.p2p.transport import P2PServer
 
             lhost, lport = self.args.listen.rsplit(":", 1)
             self.p2p_server = P2PServer(self.node, lhost, int(lport), address_manager=self.address_manager)
             self.p2p_server.start()
+            self.log.info("P2P listening on %s:%s", lhost, lport)
+        return []
+
+    def _stop_p2p_service(self) -> None:
+        if self.p2p_server is not None:
+            self.p2p_server.stop()
+            self.p2p_server = None
+        for peer in list(self.node.peers):
+            if hasattr(peer, "close"):
+                peer.close()
+
+    def start(self) -> str:
+        self.core.start()
         for peer_addr in getattr(self.args, "connect", []) or []:
             self.connect_peer(peer_addr)
-        return f"{host}:{srv.server_address[1]}"
+        return self._rpc_addr
 
     def connect_peer(self, address: str):
         """Dial a peer over the wire and catch up from it (IBD)."""
@@ -306,21 +357,15 @@ class Daemon:
         return peer
 
     def stop(self) -> None:
-        if self.p2p_server is not None:
-            self.p2p_server.stop()
-            self.p2p_server = None
-        for peer in list(self.node.peers):
-            if hasattr(peer, "close"):
-                peer.close()
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
-        if self.db is not None:
-            # quiesce dispatch before closing the native handle: an in-flight
-            # handler finishes under the lock; later ones see db == None and
-            # stage() no-ops (server is already down, nothing new arrives)
-            with self._dispatch_lock:
+        self.core.shutdown()  # reverse bind order: p2p, rpc, tick (blocks
+        # until services are down, even when another thread began the stop)
+        # quiesce dispatch before closing the native handle: an in-flight
+        # handler finishes under the lock; later ones see db == None and
+        # stage() no-ops (server is already down, nothing new arrives).
+        # db re-checked under the lock: stop() may race itself (shutdown
+        # RPC thread vs main's wait_for_shutdown path).
+        with self._dispatch_lock:
+            if self.db is not None:
                 self.consensus.storage.flush()
                 self.consensus.storage.db = None
                 self.db.close()
@@ -345,14 +390,17 @@ def rpc_call(addr: str, method: str, params: dict | None = None, timeout: float 
 
 
 def main(argv=None) -> None:
+    from kaspa_tpu.core.log import init_logger
+
     args = parse_args(argv)
+    os.makedirs(args.appdir, exist_ok=True)
+    init_logger(log_file=os.path.join(args.appdir, "kaspad.log"))
     daemon = Daemon(args)
+    daemon.core.install_signal_handlers()  # SIGINT/SIGTERM -> ordered stop
     addr = daemon.start()
     print(f"kaspa-tpu node listening on {addr} (network {daemon.params.name})")
-    try:
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        daemon.stop()
+    daemon.core.wait_for_shutdown()
+    daemon.stop()
 
 
 if __name__ == "__main__":

@@ -63,6 +63,8 @@ class RpcCoreService:
         self.address_manager = address_manager
         self.connection_manager = connection_manager
         self.shutdown_fn = shutdown_fn
+        # daemon-installed: () -> metrics.core.MetricsSnapshot | None
+        self.metrics_provider = None
         # rpc-level notifier chained onto the consensus root (the reference's
         # consensus -> notify -> index -> rpc chain)
         self.notifier = Notifier("rpc-core", parent=consensus.notification_root)
@@ -258,6 +260,13 @@ class RpcCoreService:
             "sig_cache_misses": sc.misses,
             "process_counters": asdict(self.consensus.counters.snapshot()),
             "process_metrics": asdict(self.perf_monitor.sample()),
+            # grouped snapshot with derived rates (metrics/core/src/data.rs),
+            # sampled by the daemon's tick service
+            "snapshot": (
+                {"unixtime_millis": snap.unixtime_millis, **snap.values}
+                if self.metrics_provider is not None and (snap := self.metrics_provider()) is not None
+                else None
+            ),
         }
 
     # --- node info / misc (rpc.rs ping/get_info/get_current_network/...) ---
@@ -287,30 +296,9 @@ class RpcCoreService:
         return True
 
     def get_system_info(self) -> dict:
-        import os
+        from kaspa_tpu.utils.sysinfo import system_info
 
-        try:
-            import resource
-
-            fd_limit = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
-        except Exception:
-            fd_limit = 0
-        mem_total = 0
-        try:
-            with open("/proc/meminfo") as f:
-                for line in f:
-                    if line.startswith("MemTotal:"):
-                        mem_total = int(line.split()[1]) * 1024
-                        break
-        except OSError:
-            pass
-        return {
-            "version": "kaspa-tpu/0.2",
-            "system_id": hex(abs(hash(self.consensus.params.name)) & 0xFFFFFFFF),
-            "cpu_physical_cores": os.cpu_count() or 0,
-            "total_memory": mem_total,
-            "fd_limit": fd_limit,
-        }
+        return system_info()
 
     def shutdown(self) -> dict:
         if self.shutdown_fn is None:

@@ -1,0 +1,133 @@
+"""DB admin tooling: inspect / verify / compact a node's KV stores.
+
+Reference: database/rocknroll — offline RocksDB tooling over a kaspad
+datadir (open the active consensus DB, scan/prune/report).  Here the
+store is our CRC-framed append-only engine (native/kvstore); the tool
+resolves the ACTIVE pointer like the daemon does and speaks the same
+prefix registry as consensus/stores.py.
+
+    python -m kaspa_tpu.storage stats   --appdir ~/.kaspa-tpu
+    python -m kaspa_tpu.storage verify  --appdir ~/.kaspa-tpu
+    python -m kaspa_tpu.storage compact --appdir ~/.kaspa-tpu
+    python -m kaspa_tpu.storage get     --appdir ... --prefix HD --key <hex>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from kaspa_tpu.consensus import stores as st
+from kaspa_tpu.storage.kv import KvStore
+
+PREFIX_NAMES = {
+    st.PREFIX_HEADERS: "headers",
+    st.PREFIX_RELATIONS: "relations",
+    st.PREFIX_GHOSTDAG: "ghostdag",
+    st.PREFIX_STATUSES: "statuses",
+    st.PREFIX_BLOCK_TXS: "block-transactions",
+    st.PREFIX_UTXO_DIFFS: "utxo-diffs",
+    st.PREFIX_MULTISETS: "multisets",
+    st.PREFIX_ACCEPTANCE: "acceptance-data",
+    st.PREFIX_DAA_EXCLUDED: "daa-excluded",
+    st.PREFIX_UTXO_SET: "utxo-set",
+    st.PREFIX_DEPTH: "merge-depth",
+    st.PREFIX_PRUNING_SAMPLES: "pruning-samples",
+    st.PREFIX_REACH_MERGESET: "reachability-mergesets",
+    st.PREFIX_META: "metadata",
+}
+
+
+def resolve_active_db(appdir: str) -> str:
+    """Same ACTIVE-pointer discipline as the daemon (node/daemon.py)."""
+    active = "consensus.db"
+    pointer = os.path.join(appdir, "ACTIVE")
+    if os.path.exists(pointer):
+        with open(pointer) as f:
+            name = f.read().strip()
+        if name and os.path.exists(os.path.join(appdir, name)):
+            active = name
+    path = os.path.join(appdir, active)
+    if not os.path.exists(path):
+        raise SystemExit(f"no consensus DB at {path}")
+    return path
+
+
+def cmd_stats(store: KvStore) -> int:
+    per_prefix: dict[bytes, list] = {}
+    total_keys = 0
+    total_bytes = 0
+    for k, v in store.engine.items():
+        total_keys += 1
+        total_bytes += len(k) + len(v)
+        bucket = per_prefix.setdefault(k[:2], [0, 0])
+        bucket[0] += 1
+        bucket[1] += len(k) + len(v)
+    print(f"{'store':<24}{'keys':>10}{'bytes':>14}")
+    for prefix, (n, size) in sorted(per_prefix.items(), key=lambda kv: -kv[1][1]):
+        name = PREFIX_NAMES.get(prefix, f"?{prefix!r}")
+        print(f"{name:<24}{n:>10}{size:>14}")
+    print(f"{'TOTAL':<24}{total_keys:>10}{total_bytes:>14}")
+    print(f"log size on disk: {store.size_on_disk()} bytes")
+    return 0
+
+
+def cmd_verify(store: KvStore) -> int:
+    """The open itself replays the CRC-framed log; surviving it means every
+    frame checksummed clean.  Cross-check the live index for shape."""
+    n = 0
+    bad = 0
+    for k, _v in store.engine.items():
+        n += 1
+        if k[:2] not in PREFIX_NAMES:
+            bad += 1
+    print(f"replayed clean: {n} live keys, {bad} outside the prefix registry")
+    return 1 if bad else 0
+
+
+def cmd_compact(store: KvStore) -> int:
+    before = store.size_on_disk()
+    store.engine.compact()
+    after = store.size_on_disk()
+    print(f"compacted: {before} -> {after} bytes ({before - after} reclaimed)")
+    return 0
+
+
+def cmd_get(store: KvStore, prefix: str, key_hex: str) -> int:
+    value = store.engine.get(prefix.encode() + bytes.fromhex(key_hex))
+    if value is None:
+        print("not found", file=sys.stderr)
+        return 1
+    print(value.hex())
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kaspa-tpu-db", description="KV store admin tooling")
+    p.add_argument("command", choices=["stats", "verify", "compact", "get"])
+    p.add_argument("--appdir", default=os.path.expanduser("~/.kaspa-tpu"))
+    p.add_argument("--db", default=None, help="explicit db path (bypasses the ACTIVE pointer)")
+    p.add_argument("--prefix", default=None, help="2-char store prefix for `get`")
+    p.add_argument("--key", default=None, help="hex key for `get`")
+    args = p.parse_args(argv)
+    path = args.db if args.db else resolve_active_db(args.appdir)
+    store = KvStore(path)
+    try:
+        if args.command == "stats":
+            return cmd_stats(store)
+        if args.command == "verify":
+            return cmd_verify(store)
+        if args.command == "compact":
+            return cmd_compact(store)
+        if args.command == "get":
+            if not args.prefix or not args.key:
+                p.error("get requires --prefix and --key")
+            return cmd_get(store, args.prefix, args.key)
+        return 2
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

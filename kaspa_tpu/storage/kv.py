@@ -46,6 +46,7 @@ _ITER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint32, ctypes.c_voi
 
 class _NativeEngine:
     def __init__(self, path: str):
+        self.path = path
         lib = ctypes.CDLL(_build_native())
         lib.kv_open.restype = ctypes.c_void_p
         lib.kv_open.argtypes = [ctypes.c_char_p]
@@ -225,6 +226,12 @@ class KvStore:
 
     def batch(self):
         return _Batch(self.engine)
+
+    def size_on_disk(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
 
     def close(self):
         self.engine.close()

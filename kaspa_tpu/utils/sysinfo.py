@@ -1,0 +1,76 @@
+"""System + build information (reference: utils/src/sysinfo.rs and the
+kaspad build-info plumbing surfaced through GetSystemInfo RPC)."""
+
+from __future__ import annotations
+
+import functools
+import os
+import platform
+import subprocess
+import uuid
+
+VERSION = "0.2.0"  # framework version (round 2)
+
+
+@functools.lru_cache(maxsize=1)
+def build_info() -> dict:
+    """Version + git state baked at query time (the reference embeds these
+    at compile time via vergen; we read the live repo once per process)."""
+    commit = None
+    try:
+        commit = (
+            subprocess.run(
+                ["git", "rev-parse", "--short=12", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ).stdout.strip()
+            or None
+        )
+    except Exception:
+        pass
+    return {"version": VERSION, "git_hash": commit}
+
+
+def _meminfo_kb(field: str) -> int | None:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith(field + ":"):
+                    return int(line.split()[1])
+    except OSError:
+        return None
+    return None
+
+
+@functools.lru_cache(maxsize=1)
+def system_id() -> str:
+    """Stable anonymous node id (sysinfo.rs system_id: machine-derived)."""
+    return uuid.uuid5(uuid.NAMESPACE_DNS, f"{platform.node()}-{os.getuid()}").hex
+
+
+def system_info() -> dict:
+    total_kb = _meminfo_kb("MemTotal")
+    fd_count = None
+    try:
+        fd_count = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    info = {
+        "system_id": system_id(),
+        "cpu_physical_cores": os.cpu_count() or 0,
+        "total_memory": (total_kb or 0) * 1024,
+        "fd_limit": _fd_limit(),
+        "fd_count": fd_count,
+        "proxy_socket_limit_per_cpu_core": None,
+        **build_info(),
+    }
+    return info
+
+
+def _fd_limit() -> int:
+    try:
+        import resource
+
+        return resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    except Exception:
+        return 0
