@@ -1,0 +1,210 @@
+"""Stratum bridge: miner-facing job server over block templates.
+
+Reference: bridge/src/stratum_server.rs + client_handler.rs +
+mining_state.rs (the rk-stratum bridge): accepts stratum JSON-line
+connections from miners, serves jobs derived from node block templates
+(pre-PoW hash + timestamp), tracks a bounded job ring, validates
+submitted nonces against the share and network targets, and forwards
+solved blocks to the node.
+
+Protocol (line-delimited JSON, the kaspa-stratum dialect):
+  -> {"id", "method": "mining.subscribe", "params": [agent]}
+  <- result [subscription id, extranonce]
+  -> {"id", "method": "mining.authorize", "params": [worker, _]}
+  <- result true; then notifications:
+  <- {"method": "set_extranonce"| "mining.set_difficulty", ...}
+  <- {"method": "mining.notify", "params": [job_id, pre_pow_hash_hex, timestamp]}
+  -> {"id", "method": "mining.submit", "params": [worker, job_id, nonce_hex]}
+  <- result true | error (stale/low-difficulty/duplicate share)
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import socketserver
+import threading
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.difficulty import compact_to_target
+from kaspa_tpu.core.log import get_logger
+from kaspa_tpu.crypto.powhash import pow_hash
+
+log = get_logger("stratum")
+
+MAX_JOBS = 256
+
+
+class StratumError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class MiningState:
+    """Job ring + share bookkeeping (mining_state.rs)."""
+
+    def __init__(self):
+        self._jobs: dict[int, object] = {}
+        self._next = 0
+        self._seen_shares: set = set()
+        self._mu = threading.Lock()
+        self.shares_accepted = 0
+        self.shares_stale = 0
+        self.blocks_found = 0
+
+    def add_job(self, block) -> int:
+        with self._mu:
+            job_id = self._next
+            self._next += 1
+            self._jobs[job_id % MAX_JOBS] = (job_id, block)
+            return job_id
+
+    def get_job(self, job_id: int):
+        with self._mu:
+            slot = self._jobs.get(job_id % MAX_JOBS)
+            if slot is None or slot[0] != job_id:
+                return None
+            return slot[1]
+
+    def register_share(self, job_id: int, nonce: int) -> bool:
+        """False if this (job, nonce) was already submitted (dup share)."""
+        with self._mu:
+            key = (job_id, nonce)
+            if key in self._seen_shares:
+                return False
+            self._seen_shares.add(key)
+            if len(self._seen_shares) > 1 << 16:
+                self._seen_shares.clear()
+            return True
+
+
+class StratumBridge:
+    """The bridge core, transport-independent for testability.
+
+    ``template_source() -> Block`` and ``submit_block(block) -> status``
+    bind it to a node (in-process or RPC)."""
+
+    def __init__(self, template_source, submit_block, share_difficulty_shift: int = 8):
+        self.template_source = template_source
+        self.submit_block = submit_block
+        self.state = MiningState()
+        # share target = network target << shift (easier shares for vardiff
+        # accounting; the reference runs a full vardiff loop)
+        self.share_difficulty_shift = share_difficulty_shift
+
+    # --- jobs ---
+
+    def new_job(self):
+        """Fetch a template and publish a job: (job_id, pre_pow_hash, ts)."""
+        block = self.template_source()
+        job_id = self.state.add_job(block)
+        pre_pow = chash.header_hash_override_nonce_time(block.header, 0, 0)
+        return job_id, pre_pow, block.header.timestamp
+
+    def notify_params(self):
+        job_id, pre_pow, ts = self.new_job()
+        return [f"{job_id:08x}", pre_pow.hex(), ts]
+
+    # --- shares ---
+
+    def submit(self, job_id: int, nonce: int) -> bool:
+        """Returns True when the share also solved a block."""
+        block = self.state.get_job(job_id)
+        if block is None:
+            self.state.shares_stale += 1
+            raise StratumError(21, "Job not found")  # stale share
+        if not self.state.register_share(job_id, nonce):
+            raise StratumError(22, "Duplicate share")
+        pre_pow = chash.header_hash_override_nonce_time(block.header, 0, 0)
+        value = int.from_bytes(pow_hash(pre_pow, block.header.timestamp, nonce), "little")
+        network_target = compact_to_target(block.header.bits)
+        share_target = min(network_target << self.share_difficulty_shift, (1 << 256) - 1)
+        if value > share_target:
+            raise StratumError(20, "Low difficulty share")
+        self.state.shares_accepted += 1
+        if value <= network_target:
+            # block found: graft the nonce and hand it to the node
+            block.header.nonce = nonce
+            block.header.invalidate_cache()
+            self.submit_block(block)
+            self.state.blocks_found += 1
+            return True
+        return False
+
+
+class _StratumHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        bridge: StratumBridge = self.server.bridge  # type: ignore[attr-defined]
+        extranonce = secrets.token_hex(2)
+        authorized = False
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except ValueError:
+                break
+            rid = req.get("id")
+            method = req.get("method", "")
+            params = req.get("params", [])
+            try:
+                if method == "mining.subscribe":
+                    self._reply(rid, [["kaspa/1.0", extranonce], extranonce])
+                elif method == "mining.authorize":
+                    authorized = True
+                    self._reply(rid, True)
+                    self._notify("set_extranonce", [extranonce])
+                    self._notify("mining.set_difficulty", [1.0])
+                    self._notify("mining.notify", bridge.notify_params())
+                elif method == "mining.submit":
+                    if not authorized:
+                        raise StratumError(24, "Unauthorized")
+                    _worker, job_hex, nonce_hex = params[:3]
+                    solved = bridge.submit(int(job_hex, 16), int(nonce_hex, 16))
+                    self._reply(rid, True)
+                    if solved:
+                        self._notify("mining.notify", bridge.notify_params())
+                elif method == "mining.get_job":
+                    # convenience poll for miners without notify support
+                    self._reply(rid, bridge.notify_params())
+                else:
+                    self._reply(rid, None, error=[20, f"unknown method {method}", None])
+            except StratumError as e:
+                self._reply(rid, None, error=[e.code, str(e), None])
+            except Exception as e:  # noqa: BLE001 - wire boundary
+                self._reply(rid, None, error=[20, str(e), None])
+
+    def _reply(self, rid, result, error=None):
+        self.wfile.write((json.dumps({"id": rid, "result": result, "error": error}) + "\n").encode())
+        self.wfile.flush()
+
+    def _notify(self, method: str, params) -> None:
+        self.wfile.write((json.dumps({"id": None, "method": method, "params": params}) + "\n").encode())
+        self.wfile.flush()
+
+
+class StratumServer:
+    """TCP front end (stratum_listener.rs)."""
+
+    def __init__(self, bridge: StratumBridge, host: str = "127.0.0.1", port: int = 5555):
+        self.bridge = bridge
+        srv = socketserver.ThreadingTCPServer((host, port), _StratumHandler, bind_and_activate=False)
+        srv.allow_reuse_address = True
+        srv.daemon_threads = True
+        srv.server_bind()
+        srv.server_activate()
+        srv.bridge = bridge  # type: ignore[attr-defined]
+        self._srv = srv
+        self.address = f"{host}:{srv.server_address[1]}"
+        self._thread = threading.Thread(target=srv.serve_forever, daemon=True)
+
+    def start(self) -> str:
+        self._thread.start()
+        log.info("stratum bridge listening on %s", self.address)
+        return self.address
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -1,0 +1,146 @@
+"""rothschild: the transaction load generator.
+
+Reference: rothschild/src/main.rs — a self-spending tx spammer for load
+testing: derives a keypair, tracks its UTXOs via the node, and submits
+transactions at a target TPS, maintaining enough UTXO fan-out to sustain
+the rate (recommended <= 50-100 TPS per node, docs/testnet10-transition.md:69).
+
+Run against a live daemon wire:
+    python -m kaspa_tpu.tools.rothschild --rpcserver 127.0.0.1:16110 \
+        --seed <hex> --tps 20 --duration 30
+
+The same engine drives in-process for tests (Rothschild.run_against).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import (
+    SUBNETWORK_ID_NATIVE,
+    ComputeCommit,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.consensus.mass import MassCalculator
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.txscript import standard
+from kaspa_tpu.wallet.account import Account
+
+
+class Rothschild:
+    """Tx spammer engine: split-then-spam.
+
+    Keeps a local view of its own spendable outpoints (seeded from the
+    node, extended by its own tx outputs) so it can chain spends without
+    waiting for confirmations — the reference tracks pending outpoints
+    the same way."""
+
+    def __init__(self, account: Account, mass_calculator: MassCalculator | None = None, fee: int = 5000):
+        self.account = account
+        self.spk = account.receive_keys[0].spk
+        self.key = account.receive_keys[0].key.key
+        self.mc = mass_calculator if mass_calculator is not None else MassCalculator()
+        self.fee = fee
+        self.available: list = []  # (outpoint, amount)
+        self.stats = {"submitted": 0, "rejected": 0}
+
+    def seed_utxos(self, utxos) -> None:
+        """[(outpoint, UtxoEntry)] — mature spendables owned by our key."""
+        self.available = [(op, e.amount) for op, e in utxos]
+        self.available.sort(key=lambda t: -t[1])
+
+    def _build_self_spend(self, fan_out: int = 2) -> Transaction | None:
+        """Spend one outpoint into `fan_out` outputs back to ourselves."""
+        while self.available:
+            op, amount = self.available.pop()
+            if amount > self.fee + fan_out:
+                break
+        else:
+            return None
+        per_out = (amount - self.fee) // fan_out
+        outs = [TransactionOutput(per_out, self.spk) for _ in range(fan_out - 1)]
+        outs.append(TransactionOutput(amount - self.fee - per_out * (fan_out - 1), self.spk))
+        tx = Transaction(
+            0,
+            [TransactionInput(op, b"", 0, ComputeCommit.sigops(1))],
+            outs,
+            0,
+            SUBNETWORK_ID_NATIVE,
+            0,
+            b"",
+        )
+        entry = UtxoEntry(amount, self.spk, 0, False)
+        tx.storage_mass = self.mc.calc_contextual_masses(tx, [entry]) or 0
+        msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, chash.SigHashReusedValues())
+        sig = eclib.schnorr_sign(msg, self.key, b"\x00" * 32)
+        tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+        tx._id_cache = None
+        # our own outputs become immediately spendable (mempool chaining)
+        for i, out in enumerate(tx.outputs):
+            self.available.insert(0, (TransactionOutpoint(tx.id(), i), out.value))
+        return tx
+
+    def run_against(self, submit, tps: float, duration: float, clock=time.monotonic, sleep=time.sleep) -> dict:
+        """Pump txs through `submit(tx) -> None | raise` at the target rate."""
+        interval = 1.0 / tps if tps > 0 else 0.0
+        deadline = clock() + duration
+        next_fire = clock()
+        while clock() < deadline:
+            tx = self._build_self_spend()
+            if tx is None:
+                break  # fan-out exhausted
+            try:
+                submit(tx)
+                self.stats["submitted"] += 1
+            except Exception:
+                self.stats["rejected"] += 1
+            next_fire += interval
+            delay = next_fire - clock()
+            if delay > 0:
+                sleep(delay)
+        return dict(self.stats)
+
+
+def main(argv=None) -> None:
+    from kaspa_tpu.node.daemon import rpc_call
+    from kaspa_tpu.wallet.__main__ import tx_to_wire
+
+    p = argparse.ArgumentParser(prog="rothschild", description="kaspa-tpu tx load generator")
+    p.add_argument("--rpcserver", default="127.0.0.1:16110")
+    p.add_argument("--seed", required=True, help="hex seed for the spam wallet")
+    p.add_argument("--tps", type=float, default=10.0)
+    p.add_argument("--duration", type=float, default=60.0)
+    p.add_argument("--prefix", default="kaspasim")
+    args = p.parse_args(argv)
+
+    account = Account.from_seed(bytes.fromhex(args.seed), prefix=args.prefix)
+    addr = account.addresses()[0]
+    spam = Rothschild(account)
+    utxos = rpc_call(args.rpcserver, "getUtxosByAddresses", {"addresses": [addr]})
+    spk = account.receive_keys[0].spk
+    spam.seed_utxos(
+        (
+            TransactionOutpoint(bytes.fromhex(u["outpoint"]["transaction_id"]), u["outpoint"]["index"]),
+            UtxoEntry(
+                u["utxo_entry"]["amount"], spk, u["utxo_entry"]["block_daa_score"], u["utxo_entry"]["is_coinbase"]
+            ),
+        )
+        for u in utxos
+    )
+    print(f"rothschild: {len(spam.available)} spendable outpoints on {addr}")
+
+    def submit(tx):
+        rpc_call(args.rpcserver, "submitTransaction", {"tx": tx_to_wire(tx)})
+
+    stats = spam.run_against(submit, args.tps, args.duration)
+    print(f"rothschild: {stats}")
+
+
+if __name__ == "__main__":
+    main()

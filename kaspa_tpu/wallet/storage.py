@@ -1,0 +1,154 @@
+"""Encrypted persistent wallet storage.
+
+Reference: wallet/core/src/storage/local — a versioned, password-encrypted
+wallet document holding key data, accounts, address derivation state and
+metadata.  Scheme here: scrypt KDF (per-save random salt) -> 64 bytes
+split into a ChaCha20 stream key and an HMAC-SHA256 key;
+encrypt-then-MAC over the JSON payload.  Tampering (any byte of salt,
+ciphertext or tag) and wrong passwords fail closed before parsing.
+
+File layout (all raw bytes, little-endian lengths):
+    magic "KTWL" | version u16 | salt(16) | nonce-counter u64 |
+    ciphertext len u32 | ciphertext | hmac-sha256(32)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import struct
+
+import numpy as np
+
+from kaspa_tpu.crypto import chacha
+
+MAGIC = b"KTWL"
+VERSION = 1
+_SCRYPT_N, _SCRYPT_R, _SCRYPT_P = 1 << 14, 8, 1
+
+
+class WalletStorageError(Exception):
+    pass
+
+
+def _derive_keys(password: str, salt: bytes) -> tuple[bytes, bytes]:
+    material = hashlib.scrypt(
+        password.encode(), salt=salt, n=_SCRYPT_N, r=_SCRYPT_R, p=_SCRYPT_P, maxmem=64 * 1024 * 1024, dklen=64
+    )
+    return material[:32], material[32:]
+
+
+def _keystream(key32: bytes, n: int) -> bytes:
+    ks = chacha.keystream(np.frombuffer(key32, dtype=np.uint8).reshape(1, 32), n)
+    return ks.tobytes()[:n]
+
+
+def encrypt_payload(password: str, payload: bytes) -> bytes:
+    salt = secrets.token_bytes(16)
+    enc_key, mac_key = _derive_keys(password, salt)
+    ct = bytes(a ^ b for a, b in zip(payload, _keystream(enc_key, len(payload))))
+    head = MAGIC + struct.pack("<H", VERSION) + salt + struct.pack("<QI", 0, len(ct))
+    tag = hmac.new(mac_key, head + ct, hashlib.sha256).digest()
+    return head + ct + tag
+
+
+def decrypt_payload(password: str, blob: bytes) -> bytes:
+    if len(blob) < 4 + 2 + 16 + 12 + 32 or blob[:4] != MAGIC:
+        raise WalletStorageError("not a wallet file")
+    (version,) = struct.unpack_from("<H", blob, 4)
+    if version != VERSION:
+        raise WalletStorageError(f"unsupported wallet version {version}")
+    salt = blob[6:22]
+    (_, ct_len) = struct.unpack_from("<QI", blob, 22)
+    ct_start = 34
+    ct = blob[ct_start : ct_start + ct_len]
+    tag = blob[ct_start + ct_len : ct_start + ct_len + 32]
+    if len(ct) != ct_len or len(tag) != 32:
+        raise WalletStorageError("truncated wallet file")
+    enc_key, mac_key = _derive_keys(password, salt)
+    expect = hmac.new(mac_key, blob[:ct_start] + ct, hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, expect):
+        raise WalletStorageError("wrong password or corrupted wallet file")
+    return bytes(a ^ b for a, b in zip(ct, _keystream(enc_key, len(ct))))
+
+
+class WalletStorage:
+    """The wallet document: key data + accounts + derivation state.
+
+    ``document`` shape (storage/local/wallet.rs equivalent):
+      {"keydata": [{"id", "seed_hex"}],
+       "accounts": [{"keydata_id", "account_index", "prefix",
+                     "receive_index", "change_index", "name"}],
+       "metadata": {...}}
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.document: dict = {"keydata": [], "accounts": [], "metadata": {}}
+
+    # --- lifecycle ---
+
+    @classmethod
+    def create(cls, path: str, password: str, seed: bytes, account_name: str = "default", prefix: str = "kaspasim") -> "WalletStorage":
+        if os.path.exists(path):
+            raise WalletStorageError(f"wallet file already exists: {path}")
+        ws = cls(path)
+        kd_id = hashlib.sha256(seed).hexdigest()[:16]
+        ws.document["keydata"].append({"id": kd_id, "seed_hex": seed.hex()})
+        ws.document["accounts"].append(
+            {
+                "keydata_id": kd_id,
+                "account_index": 0,
+                "prefix": prefix,
+                "receive_index": 1,
+                "change_index": 0,
+                "name": account_name,
+            }
+        )
+        ws.save(password)
+        return ws
+
+    @classmethod
+    def open(cls, path: str, password: str) -> "WalletStorage":
+        ws = cls(path)
+        with open(path, "rb") as f:
+            blob = f.read()
+        ws.document = json.loads(decrypt_payload(password, blob))
+        return ws
+
+    def save(self, password: str) -> None:
+        blob = encrypt_payload(password, json.dumps(self.document).encode())
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # --- account access ---
+
+    def seed_for(self, account: dict) -> bytes:
+        for kd in self.document["keydata"]:
+            if kd["id"] == account["keydata_id"]:
+                return bytes.fromhex(kd["seed_hex"])
+        raise WalletStorageError(f"keydata {account['keydata_id']} missing")
+
+    def accounts(self) -> list[dict]:
+        return self.document["accounts"]
+
+    def load_account(self, index: int = 0):
+        """Materialize an Account, restoring its derivation watermark."""
+        from kaspa_tpu.wallet.account import Account
+
+        meta = self.document["accounts"][index]
+        acct = Account.from_seed(self.seed_for(meta), meta["account_index"], meta["prefix"])
+        while len(acct.receive_keys) < meta["receive_index"]:
+            acct.derive_receive_address()
+        return acct
+
+    def bump_receive_index(self, index: int, password: str) -> None:
+        self.document["accounts"][index]["receive_index"] += 1
+        self.save(password)

@@ -1,0 +1,112 @@
+"""Wallet UTXO processor: notification-fed balance tracking + events.
+
+Reference: wallet/core/src/utxo/processor.rs + context.rs — the wallet
+side of the notify pipeline.  Subscribes to utxos-changed for the
+account's addresses, maintains mature/pending partitions (coinbase
+maturity by DAA score), and emits typed events (balance / pending /
+maturity / discovery) to registered listeners — the reference's
+multiplexer stream, as plain callbacks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+
+class WalletEventType(Enum):
+    BALANCE = "balance"
+    PENDING = "pending"
+    MATURITY = "maturity"
+    DISCOVERY = "discovery"
+
+
+@dataclass
+class WalletEvent:
+    type: WalletEventType
+    data: dict
+
+
+@dataclass
+class Balance:
+    mature: int = 0
+    pending: int = 0  # immature coinbase value
+
+    @property
+    def total(self) -> int:
+        return self.mature + self.pending
+
+
+class UtxoProcessor:
+    def __init__(self, account, coinbase_maturity: int):
+        self.account = account
+        self.coinbase_maturity = coinbase_maturity
+        self._scripts = {d.spk.script for d in account.receive_keys}
+        self._mature: dict = {}  # outpoint -> entry
+        self._pending: dict = {}  # immature coinbase
+        self._listeners: list = []
+        self._virtual_daa = 0
+
+    # --- wiring ---
+
+    def add_listener(self, cb) -> None:
+        self._listeners.append(cb)
+
+    def _emit(self, etype: WalletEventType, **data) -> None:
+        ev = WalletEvent(etype, data)
+        for cb in self._listeners:
+            cb(ev)
+
+    def track_new_address(self, derived) -> None:
+        self._scripts.add(derived.spk.script)
+
+    # --- feed (notify/notifier.py listener signature) ---
+
+    def on_utxos_changed(self, added, removed, virtual_daa_score: int) -> None:
+        """added/removed: [(outpoint, entry)]; the UtxosChanged payload."""
+        self._virtual_daa = virtual_daa_score
+        changed = False
+        for op, entry in removed:
+            if self._mature.pop(op, None) is not None or self._pending.pop(op, None) is not None:
+                changed = True
+        for op, entry in added:
+            if entry.script_public_key.script not in self._scripts:
+                continue
+            changed = True
+            if entry.is_coinbase and entry.block_daa_score + self.coinbase_maturity > virtual_daa_score:
+                self._pending[op] = entry
+                self._emit(WalletEventType.PENDING, outpoint=op, amount=entry.amount)
+            else:
+                self._mature[op] = entry
+                self._emit(WalletEventType.DISCOVERY, outpoint=op, amount=entry.amount)
+        self._revalidate_maturity()
+        if changed:
+            self._emit(WalletEventType.BALANCE, balance=self.balance())
+
+    def on_virtual_daa_score_changed(self, virtual_daa_score: int) -> None:
+        self._virtual_daa = virtual_daa_score
+        if self._revalidate_maturity():
+            self._emit(WalletEventType.BALANCE, balance=self.balance())
+
+    def _revalidate_maturity(self) -> bool:
+        matured = [
+            op
+            for op, e in self._pending.items()
+            if e.block_daa_score + self.coinbase_maturity <= self._virtual_daa
+        ]
+        for op in matured:
+            entry = self._pending.pop(op)
+            self._mature[op] = entry
+            self._emit(WalletEventType.MATURITY, outpoint=op, amount=entry.amount)
+        return bool(matured)
+
+    # --- queries ---
+
+    def balance(self) -> Balance:
+        return Balance(
+            mature=sum(e.amount for e in self._mature.values()),
+            pending=sum(e.amount for e in self._pending.values()),
+        )
+
+    def mature_utxos(self) -> dict:
+        return dict(self._mature)

@@ -1,0 +1,209 @@
+"""Wallet core: encrypted storage, tx generator with chaining, UTXO
+processor events.
+
+Reference shapes: wallet/core/src/storage/local (encrypted document),
+tx/generator (mass-aware aggregation + batch chaining + summary),
+utxo/processor.rs (event stream with maturity tracking).
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model import ScriptPublicKey, TransactionOutpoint, UtxoEntry
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.index import UtxoIndex
+from kaspa_tpu.wallet.account import Account
+from kaspa_tpu.wallet.generator import Generator, GeneratorError, estimate
+from kaspa_tpu.wallet.storage import WalletStorage, WalletStorageError, decrypt_payload, encrypt_payload
+from kaspa_tpu.wallet.utxo_processor import Balance, UtxoProcessor, WalletEventType
+
+
+# ----------------------------------------------------------------------
+# encrypted storage
+# ----------------------------------------------------------------------
+
+
+def test_storage_roundtrip_and_wrong_password(tmp_path):
+    path = str(tmp_path / "wallet.kaspa")
+    seed = bytes(range(32))
+    ws = WalletStorage.create(path, "hunter2", seed, account_name="main")
+    ws2 = WalletStorage.open(path, "hunter2")
+    assert ws2.document == ws.document
+    assert ws2.seed_for(ws2.accounts()[0]) == seed
+    with pytest.raises(WalletStorageError, match="wrong password|corrupted"):
+        WalletStorage.open(path, "hunter3")
+    with pytest.raises(WalletStorageError, match="already exists"):
+        WalletStorage.create(path, "x", seed)
+
+
+def test_storage_tamper_detection(tmp_path):
+    blob = encrypt_payload("pw", b'{"keydata": []}')
+    assert decrypt_payload("pw", blob) == b'{"keydata": []}'
+    for pos in (5, 10, 40, len(blob) - 1):  # version, salt, ciphertext, tag
+        bad = bytearray(blob)
+        bad[pos] ^= 0x01
+        with pytest.raises(WalletStorageError):
+            decrypt_payload("pw", bytes(bad))
+
+
+def test_storage_account_watermark_restores_addresses(tmp_path):
+    path = str(tmp_path / "wallet.kaspa")
+    seed = bytes(range(32, 64))
+    ws = WalletStorage.create(path, "pw", seed)
+    a1 = ws.load_account()
+    a1_addrs = a1.addresses()
+    # derive one more, persist the watermark
+    ws.load_account()  # no-op sanity
+    acct = ws.load_account()
+    acct.derive_receive_address()
+    ws.bump_receive_index(0, "pw")
+    reopened = WalletStorage.open(path, "pw").load_account()
+    assert reopened.addresses()[: len(a1_addrs)] == a1_addrs
+    assert len(reopened.addresses()) == len(a1_addrs) + 1
+
+
+# ----------------------------------------------------------------------
+# generator
+# ----------------------------------------------------------------------
+
+
+def _funded_chain(n_blocks=14):
+    params = simnet_params()
+    c = Consensus(params)
+    index = UtxoIndex(c)
+    acct = Account.from_seed(b"\x11" * 32)
+    miner = MinerData(acct.receive_keys[0].spk)
+    for i in range(n_blocks):
+        b = c.build_block_with_parents(list(c.tips), miner)
+        b.header.nonce = i + 1
+        b.header.invalidate_cache()
+        c.validate_and_insert_block(b)
+    return params, c, index, acct, miner
+
+
+def test_generator_single_stage_spend_accepted_by_consensus():
+    params, c, index, acct, miner = _funded_chain()
+    spendables = acct.spendable_utxos(index, c.get_virtual_daa_score(), params.coinbase_maturity)
+    assert spendables
+    dest = ScriptPublicKey(0, b"\x20" + b"\x99" * 32 + b"\xac")
+    from kaspa_tpu.consensus.mass import MassCalculator
+
+    gen = Generator(
+        spendables,
+        acct.receive_keys[0].spk,
+        [(dest, 10_000_000)],
+        mass_calculator=MassCalculator.from_params(params),
+    )
+    txs = [p.sign() for p in gen.generate()]
+    assert len(txs) == 1
+    s = gen.summary
+    assert s.number_of_generated_transactions == 1
+    assert s.final_transaction_amount == 10_000_000
+    assert s.aggregated_fees > 0
+    # the block pipeline accepts the generated tx
+    blk = c.build_block_with_parents(list(c.tips), miner, txs=txs)
+    blk.header.nonce = 777
+    blk.header.invalidate_cache()
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    assert c.get_virtual_utxo_view().get(TransactionOutpoint(txs[0].id(), 0)) is not None
+
+
+def test_generator_chains_batches_over_input_limit():
+    params, c, index, acct, miner = _funded_chain(18)
+    spendables = acct.spendable_utxos(index, c.get_virtual_daa_score(), params.coinbase_maturity)
+    assert len(spendables) >= 6
+    total = sum(e.amount for _, e, _ in spendables)
+    dest = ScriptPublicKey(0, b"\x20" + b"\x99" * 32 + b"\xac")
+    from kaspa_tpu.consensus.mass import MassCalculator
+
+    gen = Generator(
+        spendables,
+        acct.receive_keys[0].spk,
+        [(dest, total - 100_000_000)],  # nearly a full sweep: needs all inputs
+        mass_calculator=MassCalculator.from_params(params),
+    )
+    gen.MAX_INPUTS_PER_STAGE = 4  # force chaining
+    pendings = list(gen.generate())
+    assert len(pendings) >= 2, "expected batch stage(s) + final"
+    assert all(not p.is_final for p in pendings[:-1]) and pendings[-1].is_final
+    # chained stages spend the prior stage's swept output
+    batch_txid = pendings[0].tx.id()
+    later_inputs = {inp.previous_outpoint for p in pendings[1:] for inp in p.tx.inputs}
+    assert TransactionOutpoint(batch_txid, 0) in later_inputs
+    # sign everything and replay the whole chain through consensus in order
+    txs = [p.sign() for p in pendings]
+    blk = c.build_block_with_parents(list(c.tips), miner, txs=txs[:1])
+    blk.header.nonce = 801
+    blk.header.invalidate_cache()
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    for j, tx in enumerate(txs[1:], start=1):
+        blk = c.build_block_with_parents(list(c.tips), miner, txs=[tx])
+        blk.header.nonce = 801 + j
+        blk.header.invalidate_cache()
+        assert c.validate_and_insert_block(blk) == "utxo_valid"
+    assert gen.summary.number_of_generated_transactions == len(txs)
+
+
+def test_generator_insufficient_funds():
+    params, c, index, acct, miner = _funded_chain()
+    spendables = acct.spendable_utxos(index, c.get_virtual_daa_score(), params.coinbase_maturity)
+    total = sum(e.amount for _, e, _ in spendables)
+    dest = ScriptPublicKey(0, b"\x20" + b"\x99" * 32 + b"\xac")
+    gen = Generator(spendables, acct.receive_keys[0].spk, [(dest, total * 2)])
+    with pytest.raises(GeneratorError, match="insufficient funds"):
+        list(gen.generate())
+
+
+def test_estimate_matches_generation():
+    params, c, index, acct, miner = _funded_chain()
+    spendables = acct.spendable_utxos(index, c.get_virtual_daa_score(), params.coinbase_maturity)
+    dest = ScriptPublicKey(0, b"\x20" + b"\x99" * 32 + b"\xac")
+    s = estimate(spendables, acct.receive_keys[0].spk, [(dest, 5_000_000)])
+    assert s.number_of_generated_transactions >= 1
+    assert s.final_transaction_amount == 5_000_000
+    assert s.aggregated_fees > 0 and s.aggregated_mass > 0
+
+
+# ----------------------------------------------------------------------
+# utxo processor events
+# ----------------------------------------------------------------------
+
+
+def test_utxo_processor_maturity_and_balance_events():
+    acct = Account.from_seed(b"\x22" * 32)
+    spk = acct.receive_keys[0].spk
+    up = UtxoProcessor(acct, coinbase_maturity=10)
+    events = []
+    up.add_listener(events.append)
+
+    op1 = TransactionOutpoint(b"\x01" * 32, 0)
+    op2 = TransactionOutpoint(b"\x02" * 32, 0)
+    foreign = TransactionOutpoint(b"\x03" * 32, 0)
+    up.on_utxos_changed(
+        added=[
+            (op1, UtxoEntry(500, spk, 100, True)),  # immature coinbase
+            (op2, UtxoEntry(300, spk, 0, False)),  # plain mature
+            (foreign, UtxoEntry(900, ScriptPublicKey(0, b"\xff"), 0, False)),  # not ours
+        ],
+        removed=[],
+        virtual_daa_score=105,
+    )
+    assert up.balance() == Balance(mature=300, pending=500)
+    kinds = [e.type for e in events]
+    assert WalletEventType.PENDING in kinds and WalletEventType.DISCOVERY in kinds
+    assert WalletEventType.BALANCE in kinds
+
+    # maturity crossing emits MATURITY + BALANCE
+    events.clear()
+    up.on_virtual_daa_score_changed(110)
+    assert [e.type for e in events] == [WalletEventType.MATURITY, WalletEventType.BALANCE]
+    assert up.balance() == Balance(mature=800, pending=0)
+
+    # spend removes
+    events.clear()
+    up.on_utxos_changed(added=[], removed=[(op2, None)], virtual_daa_score=111)
+    assert up.balance() == Balance(mature=500, pending=0)
+    assert [e.type for e in events] == [WalletEventType.BALANCE]
