@@ -168,6 +168,13 @@ class Consensus:
         self._acc_removed: dict = {}
         self.reach_mergesets: dict[bytes, list[bytes]] = {}
 
+        # KIP-21: materialized lane state + selected-chain index, both moved
+        # in lock-step with utxo_position (smt-store / selected_chain_store)
+        from kaspa_tpu.consensus.smt_processor import LaneTracker
+
+        self.lane_tracker = LaneTracker(self.storage, params.finality_depth, params.genesis.hash)
+        self.selected_chain: list[tuple[int, bytes]] = [(0, params.genesis.hash)]
+
         if self.storage.is_initialized():
             self._load_state()
         else:
@@ -349,6 +356,17 @@ class Consensus:
                 self.reachability.add_block(
                     blk, bgd.selected_parent, self.reach_mergesets.get(blk, []), live_parents
                 )
+        # KIP-21: lane state snapshot + selected-chain index at utxo_position
+        self.lane_tracker.load()
+        chain = []
+        cur = self.utxo_position
+        while self.storage.ghostdag.has(cur):
+            chain.append((self.storage.ghostdag.get_blue_score(cur), cur))
+            if cur == g:
+                break
+            cur = self.storage.ghostdag.get_selected_parent(cur)
+        self.selected_chain = chain[::-1]
+
         self._resolve_virtual()
         # the load-time resolve may reposition the UTXO set; flush that
         self.storage.flush()
@@ -646,11 +664,30 @@ class Consensus:
         multiset = ctx["multiset"]
         if multiset.finalize() != header.utxo_commitment:
             return False
-        # 2. accepted id merkle root (KIP-15 two-level)
-        sp_header = self.storage.headers.get(gd.selected_parent)
-        expected_root = merkle.merkle_hash(
-            sp_header.accepted_id_merkle_root, merkle.calc_merkle_root(ctx["accepted_tx_ids"])
-        )
+        # 2. accepted id merkle root: KIP-15 two-level pre-Toccata, the
+        # KIP-21 sequencing commitment after activation
+        # (utxo_validation.rs:211-217)
+        toccata = self.params.toccata_active(header.daa_score)
+        build = None
+        if toccata:
+            # chain-qualification rule: first parent must be the selected
+            # parent (utxo_validation.rs:219-238)
+            if header.parents_by_level[0][0] != gd.selected_parent:
+                return False
+            build = self.lane_tracker.compute(
+                gd,
+                header.daa_score,
+                ctx["mergeset_acceptance"],
+                self.storage.headers,
+                self.params.toccata_active,
+                self._selected_chain_block_at,
+            )
+            expected_root = build.seq_commit
+        else:
+            sp_header = self.storage.headers.get(gd.selected_parent)
+            expected_root = merkle.merkle_hash(
+                sp_header.accepted_id_merkle_root, merkle.calc_merkle_root(ctx["accepted_tx_ids"])
+            )
         if expected_root != header.accepted_id_merkle_root:
             return False
         # 3. header pruning point (verify_header_pruning_point: chain rule)
@@ -676,6 +713,9 @@ class Consensus:
         self._set_utxo_diff(block, ctx["mergeset_diff"])
         self._set_acceptance(block, ctx["accepted_tx_ids"])
         self._apply_chain_diff(ctx["mergeset_diff"])
+        if build is not None:
+            self.lane_tracker.commit(block, build)
+        self.selected_chain.append((gd.blue_score, block))
         self.utxo_position = block
         self._persist_utxo_position()
         self.storage.statuses.set(block, StatusesStore.STATUS_UTXO_VALID)
@@ -720,6 +760,14 @@ class Consensus:
             else:
                 self._acc_added[op] = entry
 
+    def _selected_chain_block_at(self, target_bs: int) -> bytes:
+        """Highest selected-chain block (<= utxo_position) with
+        blue_score <= target_bs (processor.rs:790 shortcut anchor)."""
+        import bisect
+
+        i = bisect.bisect_right(self.selected_chain, (target_bs, b"\xff" * 32)) - 1
+        return self.selected_chain[max(i, 0)][1]
+
     def _verify_coinbase_transaction(self, coinbase, daa_score, gd, mergeset_rewards, non_daa) -> bool:
         miner_data = self.coinbase_manager.deserialize_coinbase_payload(coinbase.payload).miner_data
         expected = self.coinbase_manager.expected_coinbase_transaction(
@@ -745,6 +793,9 @@ class Consensus:
         # one batch below (the product is commutative) — this is what routes
         # the muhash work through the device tree-product kernel
         multiset_items: list = [(coinbase, coinbase_entries, pov_daa_score)]
+        # per-merged-block acceptance (KIP-21 lane activity source):
+        # (merged_block, coinbase payload, [accepted txs in block order])
+        mergeset_acceptance: list = []
 
         ordered = [(gd.selected_parent, sp_txs)] + [
             (b, self.storage.block_transactions.get(b)) for b in gd.ascending_mergeset_without_selected_parent(self.storage.ghostdag)
@@ -755,13 +806,16 @@ class Consensus:
             flags = FLAG_SKIP_SCRIPTS if is_selected_parent else FLAG_FULL
             validated = self._validate_transactions(txs, composed, pov_daa_score, flags)
             block_fee = 0
+            accepted_here = [coinbase] if is_selected_parent else []
             for tx, entries, fee in validated:
                 mergeset_diff.add_transaction(tx, entries, pov_daa_score)
                 multiset_items.append((tx, entries, pov_daa_score))
                 accepted_tx_ids.append(tx.id())
+                accepted_here.append(tx)
                 block_fee += fee
             cb_data = self.coinbase_manager.deserialize_coinbase_payload(txs[0].payload)
             mergeset_rewards[merged_block] = BlockRewardData(cb_data.subsidy, block_fee, cb_data.miner_data.script_public_key)
+            mergeset_acceptance.append((merged_block, txs[0].payload, accepted_here))
         multiset.add_transactions_batch(multiset_items)
 
         return {
@@ -769,12 +823,24 @@ class Consensus:
             "multiset": multiset,
             "accepted_tx_ids": accepted_tx_ids,
             "mergeset_rewards": mergeset_rewards,
+            "mergeset_acceptance": mergeset_acceptance,
         }
 
     def _validate_transactions(self, txs, utxo_view, pov_daa_score, flags):
         """validate_transactions_in_parallel: returns [(tx, entries, fee)] of
         valid non-coinbase txs; script checks batched on device."""
         checker = self.transaction_validator.new_checker()
+        accessor = None
+        if self.params.toccata_active(pov_daa_score):
+            from kaspa_tpu.consensus.smt_processor import ConsensusSeqCommitAccessor
+
+            accessor = ConsensusSeqCommitAccessor(
+                self.utxo_position,
+                self.reachability,
+                self.storage.headers,
+                self.params.toccata_active,
+                self.params.finality_depth,
+            )
         staged = []
         for i, tx in enumerate(txs):
             if i == 0:
@@ -791,7 +857,8 @@ class Consensus:
                 continue
             try:
                 fee = self.transaction_validator.validate_populated_transaction_and_get_fee(
-                    tx, entries, pov_daa_score, flags, checker=checker, token=i
+                    tx, entries, pov_daa_score, flags, checker=checker, token=i,
+                    seq_commit_accessor=accessor,
                 )
             except TxRuleError:
                 continue
@@ -828,6 +895,9 @@ class Consensus:
         if not self._ensure_chain_utxo_valid(gd.selected_parent):
             raise RuleError("selected parent chain is disqualified")
         daa_window = self.window_manager.block_daa_window(gd)
+        if self.params.toccata_active(daa_window.daa_score):
+            # KIP-21 chain rule: the selected parent leads the parent list
+            parents = [gd.selected_parent] + [p for p in parents if p != gd.selected_parent]
         bits = self.window_manager.calculate_difficulty_bits(gd, daa_window)
         pmt, _ = self.window_manager.calc_past_median_time(gd)
         self._move_utxo_position(gd.selected_parent)
@@ -845,11 +915,21 @@ class Consensus:
         all_txs = [coinbase] + list(txs)
 
         sp_header = self.storage.headers.get(gd.selected_parent)
-        accepted_root = merkle.merkle_hash(
-            sp_header.accepted_id_merkle_root, merkle.calc_merkle_root(ctx["accepted_tx_ids"])
-        )
+        if self.params.toccata_active(daa_window.daa_score):
+            accepted_root = self.lane_tracker.compute(
+                gd,
+                daa_window.daa_score,
+                ctx["mergeset_acceptance"],
+                self.storage.headers,
+                self.params.toccata_active,
+                self._selected_chain_block_at,
+            ).seq_commit
+        else:
+            accepted_root = merkle.merkle_hash(
+                sp_header.accepted_id_merkle_root, merkle.calc_merkle_root(ctx["accepted_tx_ids"])
+            )
         header = Header(
-            version=self.params.genesis.version,
+            version=self.params.block_version(daa_window.daa_score),
             parents_by_level=self.parents_manager.calc_block_parents(
                 self.pruning_processor.pruning_point, list(parents)
             ),
@@ -896,7 +976,12 @@ class Consensus:
             t = self.storage.ghostdag.get_selected_parent(t)
         for b in back_path:
             self._unapply_chain_diff(self.utxo_diffs[b])
+            self.lane_tracker.retreat(b)
+            assert self.selected_chain[-1][1] == b
+            self.selected_chain.pop()
         for b in reversed(fwd_path):
             self._apply_chain_diff(self.utxo_diffs[b])
+            self.lane_tracker.advance(b)
+            self.selected_chain.append((self.storage.ghostdag.get_blue_score(b), b))
         self.utxo_position = target
         self._persist_utxo_position()

@@ -107,6 +107,8 @@ def mainnet_params() -> Params:
         "mainnet", 10, "kaspa-mainnet",
         deflationary_phase_daa_score=_DEFLATIONARY_PHASE_DAA_SCORE,
         pre_deflationary_phase_base_subsidy=50_000_000_000,
+        # roughly 2026-06-30 16:15 UTC (params.rs:724)
+        toccata_activation=474_165_565,
     )
 
 
@@ -114,11 +116,13 @@ def testnet_params() -> Params:
     return _network_params(
         "testnet", 10, "kaspa-testnet",
         deflationary_phase_daa_score=_DEFLATIONARY_PHASE_DAA_SCORE,
+        toccata_activation=467_579_632,  # params.rs:785
     )
 
 
 def simnet_network_params() -> Params:
-    return _network_params("simnet", 10, "kaspa-simnet", skip_proof_of_work=True)
+    # simnet activates Toccata from genesis (params.rs:830 ForkActivation::always)
+    return _network_params("simnet", 10, "kaspa-simnet", skip_proof_of_work=True, toccata_activation=0)
 
 
 def devnet_params() -> Params:

@@ -155,6 +155,11 @@ class Params:
     def toccata_active(self, daa_score: int) -> bool:
         return daa_score >= self.toccata_activation
 
+    def block_version(self, daa_score: int) -> int:
+        """Forked block version (constants.rs BLOCK_VERSION=1 /
+        TOCCATA_BLOCK_VERSION=2, params.rs:535)."""
+        return 2 if self.toccata_active(daa_score) else self.genesis.version
+
     @staticmethod
     def from_bps(name: str, bps: int, genesis: GenesisBlock, **overrides) -> "Params":
         g = Bps(bps)

@@ -184,6 +184,12 @@ class PruningProcessor:
         if pruned_tips:
             c.tips -= pruned_tips
             c._persist_tips()
+        # drop KIP-21 lane build records + selected-chain entries of pruned
+        # blocks (their SMT effect is already folded into the live tip state)
+        for h in header_only + full_delete:
+            c.lane_tracker.prune(h)
+        if delete_set:
+            c.selected_chain = [e for e in c.selected_chain if e[1] not in delete_set]
         # filter ghostdag data of surviving blocks so mergesets never dangle
         for h, gd in list(c.storage.ghostdag._data.items()):
             if any(m in delete_set for m in gd.unordered_mergeset()) or gd.selected_parent in delete_set:

@@ -50,7 +50,7 @@ class TransactionValidator:
             from kaspa_tpu.txscript import vm as _vm
             from kaspa_tpu.txscript.resource_meter import RuntimeScriptUnitMeter, RuntimeSigOpCounter
 
-            def vm_fallback(tx, entries, idx, reused, pov_daa_score=None, _cache=self.sig_cache):
+            def vm_fallback(tx, entries, idx, reused, pov_daa_score=None, seq_commit_accessor=None, _cache=self.sig_cache):
                 active = pov_daa_score is not None and params.toccata_active(pov_daa_score)
                 flags = _vm.EngineFlags(covenants_enabled=active)
                 commit = tx.inputs[idx].compute_commit
@@ -62,7 +62,10 @@ class TransactionValidator:
                     # pre-Toccata regime (lib.rs:545): executed sig ops may
                     # not exceed the input's committed sig-op count
                     meter = RuntimeSigOpCounter(commit.sig_op_count() or 0)
-                engine = _vm.TxScriptEngine(tx, entries, idx, reused, _cache, flags=flags, meter=meter)
+                engine = _vm.TxScriptEngine(
+                    tx, entries, idx, reused, _cache, flags=flags, meter=meter,
+                    seq_commit_accessor=seq_commit_accessor if active else None,
+                )
                 engine.execute()
 
         self.vm_fallback = vm_fallback
@@ -128,6 +131,7 @@ class TransactionValidator:
         flags: str = FLAG_FULL,
         checker: BatchScriptChecker | None = None,
         token: int | None = None,
+        seq_commit_accessor=None,
     ) -> int:
         self._check_coinbase_maturity(tx, entries, pov_daa_score)
         total_in = self._check_input_amounts(entries)
@@ -138,7 +142,7 @@ class TransactionValidator:
         self._check_sequence_lock(tx, entries, pov_daa_score)
         if flags in (FLAG_FULL, FLAG_SKIP_MASS):
             assert checker is not None and token is not None, "script checks need a batch checker"
-            checker.collect_tx(token, tx, entries, pov_daa_score=pov_daa_score)
+            checker.collect_tx(token, tx, entries, pov_daa_score=pov_daa_score, seq_commit_accessor=seq_commit_accessor)
         return fee
 
     def _check_mass_commitment(self, tx, entries):

@@ -1,0 +1,373 @@
+"""KIP-21 lane-state processor: the consensus-side SMT over active lanes.
+
+Plays the combined role of the reference's `kaspa-smt-store` crate and the
+virtual processor's seq-commit helpers
+(consensus/smt-store/src/processor.rs, consensus/src/pipeline/
+virtual_processor/utxo_validation.rs:497-684, processor.rs:790-906):
+
+- materialized lane tips + SMT for the current UTXO position, moved in
+  lock-step with the consensus engine's materialized UTXO set (advance on
+  chain extension, retreat on reorg) — where the reference filters stale DB
+  versions via `is_smt_canonical`, we keep the materialized state canonical
+  by construction and version it with per-chain-block undo records
+  (lane_version_store.rs semantics);
+- the inactivity window: lanes untouched for `finality_depth` blue scores
+  expire from the active set (SeqCommitBounds, bounds.rs);
+- the inactivity shortcut block: highest chain block at
+  ``bs <= current_bs - F - 1`` (processor.rs:790-853);
+- per-chain-block metadata (lanes root, active-lane count, shortcut,
+  payload digest) for parent lookups and IBD export (smt_metadata.rs).
+
+Persistence piggybacks on the consensus storage batch: per-block build
+records under ``SM``, materialized lane tips as deltas under ``SL`` — a
+restart reloads the tip snapshot and rebuilds the tree (O(active lanes)).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from kaspa_tpu.consensus import seq_commit as sc
+from kaspa_tpu.crypto.smt import SEQ_COMMIT_ACTIVE, SparseMerkleTree
+
+ZERO_HASH = b"\x00" * 32
+
+PREFIX_SMT_BUILD = b"SM"
+PREFIX_SMT_LANE = b"SL"
+
+
+@dataclass
+class SmtBuild:
+    """Result of computing one chain block's sequencing state (SmtBuild of
+    smt-store/src/processor.rs plus the undo data our versioning needs)."""
+
+    seq_commit: bytes
+    lanes_root: bytes
+    payload_ctx_digest: bytes
+    active_lanes_count: int
+    shortcut_block: bytes
+    updates: dict[bytes, tuple[bytes, int]]  # lane_key -> (tip, blue_score)
+    expired: tuple[bytes, ...]  # lane keys removed by the inactivity window
+    undo: dict[bytes, tuple[bytes, int] | None] = field(default_factory=dict)
+
+
+def _encode_build(b: SmtBuild) -> bytes:
+    out = [b.seq_commit, b.lanes_root, b.payload_ctx_digest,
+           struct.pack("<QI I I", b.active_lanes_count, len(b.updates), len(b.expired), len(b.undo)),
+           b.shortcut_block]
+    for lk, (tip, bs) in sorted(b.updates.items()):
+        out.append(lk + tip + struct.pack("<Q", bs))
+    for lk in sorted(b.expired):
+        out.append(lk)
+    for lk, prev in sorted(b.undo.items()):
+        if prev is None:
+            out.append(lk + b"\x00")
+        else:
+            out.append(lk + b"\x01" + prev[0] + struct.pack("<Q", prev[1]))
+    return b"".join(out)
+
+
+def _decode_build(raw: bytes) -> SmtBuild:
+    seq, lanes_root, pcd = raw[:32], raw[32:64], raw[64:96]
+    count, n_up, n_exp, n_undo = struct.unpack_from("<QI I I", raw, 96)
+    off = 96 + 20
+    shortcut = raw[116:148]
+    off = 148
+    updates = {}
+    for _ in range(n_up):
+        lk = raw[off : off + 32]
+        tip = raw[off + 32 : off + 64]
+        (bs,) = struct.unpack_from("<Q", raw, off + 64)
+        updates[lk] = (tip, bs)
+        off += 72
+    expired = []
+    for _ in range(n_exp):
+        expired.append(raw[off : off + 32])
+        off += 32
+    undo: dict[bytes, tuple[bytes, int] | None] = {}
+    for _ in range(n_undo):
+        lk = raw[off : off + 32]
+        off += 32
+        if raw[off] == 0:
+            undo[lk] = None
+            off += 1
+        else:
+            tip = raw[off + 1 : off + 33]
+            (bs,) = struct.unpack_from("<Q", raw, off + 33)
+            undo[lk] = (tip, bs)
+            off += 41
+    return SmtBuild(seq, lanes_root, pcd, count, shortcut, updates, tuple(expired), undo)
+
+
+@dataclass
+class MergesetSeqData:
+    lane_activities: list  # [(lane_id20, [activity_leaf, ...])] sorted by lane_id
+    miner_payload_leaves: list
+
+
+def collect_mergeset_seq_data(mergeset_acceptance, headers_store) -> MergesetSeqData:
+    """utxo_validation.rs:497 — per-lane activity leaves + miner payload
+    leaves from the mergeset acceptance data (selected parent first).
+
+    ``mergeset_acceptance``: [(merged_block, coinbase_payload, [accepted tx])]
+    in mergeset order; accepted txs include the selected parent's coinbase.
+    """
+    lane_activities: dict[bytes, list[bytes]] = {}
+    miner_payload_leaves = []
+    global_merge_idx = 0
+    for merged_block, coinbase_payload, accepted_txs in mergeset_acceptance:
+        blue_work = headers_store.get(merged_block).blue_work
+        miner_payload_leaves.append(sc.miner_payload_leaf(merged_block, blue_work, coinbase_payload))
+        for tx in accepted_txs:
+            lane_id = bytes(tx.subnetwork_id)
+            al = sc.activity_leaf(tx.id(), tx.version, global_merge_idx)
+            lane_activities.setdefault(lane_id, []).append(al)
+            global_merge_idx += 1
+    return MergesetSeqData(sorted(lane_activities.items()), miner_payload_leaves)
+
+
+class ConsensusSeqCommitAccessor:
+    """Live SeqCommitAccessor over consensus state (model/services/
+    seq_commit_accessor.rs): what OpChainblockSeqCommit (0xd4) queries."""
+
+    def __init__(self, selected_parent, reachability, headers_store, toccata_active_fn, threshold: int):
+        self.selected_parent = selected_parent
+        self.reachability = reachability
+        self.headers = headers_store
+        self.toccata_active = toccata_active_fn
+        self.threshold = threshold
+
+    def is_chain_ancestor_from_pov(self, block: bytes):
+        if not self.headers.has(block):
+            return None
+        try:
+            return bool(self.reachability.is_chain_ancestor_of(block, self.selected_parent))
+        except KeyError:
+            return None  # reachability pruned: outside the retention future
+
+    def seq_commitment_within_depth(self, block: bytes):
+        if not self.headers.has(block):
+            return None
+        header = self.headers.get(block)
+        if not self.toccata_active(header.daa_score):
+            return None
+        sp_bs = self.headers.get_blue_score(self.selected_parent)
+        # seq_commit_within_threshold: low + threshold > high
+        if header.blue_score + self.threshold > sp_bs:
+            return header.accepted_id_merkle_root
+        return None
+
+
+class LaneTracker:
+    """Materialized KIP-21 lane state at the consensus UTXO position."""
+
+    def __init__(self, storage, finality_depth: int, genesis_hash: bytes):
+        self.storage = storage
+        self.finality_depth = finality_depth
+        self.genesis_hash = genesis_hash
+        self.tree = SparseMerkleTree(SEQ_COMMIT_ACTIVE)
+        self.lane_tips: dict[bytes, tuple[bytes, int]] = {}
+        self.score_index: dict[int, set[bytes]] = {}
+        self.builds: dict[bytes, SmtBuild] = {}  # chain block -> build
+
+    # -- persistence -----------------------------------------------------
+
+    def load(self) -> None:
+        """Rebuild materialized state from the SL lane-tip snapshot and the
+        SM build records (called once at startup, after stores load)."""
+        if self.storage.db is None:
+            return
+        # single pass over the engine: both prefixes in one scan
+        for key, raw in self.storage.db.engine.items():
+            if key.startswith(PREFIX_SMT_LANE):
+                lk = key[len(PREFIX_SMT_LANE) :]
+                tip, (bs,) = raw[:32], struct.unpack_from("<Q", raw, 32)
+                self._set_tip(lk, (tip, bs))
+            elif key.startswith(PREFIX_SMT_BUILD):
+                self.builds[key[len(PREFIX_SMT_BUILD) :]] = _decode_build(raw)
+
+    def _stage_tip(self, lk: bytes, val: tuple[bytes, int] | None) -> None:
+        if self.storage.db is None:
+            return
+        if val is None:
+            self.storage.stage(PREFIX_SMT_LANE + lk, None)
+        else:
+            self.storage.stage(PREFIX_SMT_LANE + lk, val[0] + struct.pack("<Q", val[1]))
+
+    # -- materialized-state primitives ----------------------------------
+
+    def _set_tip(self, lk: bytes, val: tuple[bytes, int]) -> None:
+        prev = self.lane_tips.get(lk)
+        if prev is not None:
+            s = self.score_index.get(prev[1])
+            if s is not None:
+                s.discard(lk)
+                if not s:
+                    del self.score_index[prev[1]]
+        self.lane_tips[lk] = val
+        self.score_index.setdefault(val[1], set()).add(lk)
+        self.tree.insert(lk, sc.smt_leaf_hash(val[0], val[1]))
+
+    def _del_tip(self, lk: bytes) -> None:
+        prev = self.lane_tips.pop(lk, None)
+        if prev is not None:
+            s = self.score_index.get(prev[1])
+            if s is not None:
+                s.discard(lk)
+                if not s:
+                    del self.score_index[prev[1]]
+            self.tree.delete(lk)
+
+    # -- compute (verification / template path) -------------------------
+
+    def compute(
+        self,
+        gd,
+        header_daa_score: int,
+        mergeset_acceptance,
+        headers_store,
+        toccata_active_fn,
+        selected_chain_index,
+    ) -> SmtBuild:
+        """recompute_seq_commit (utxo_validation.rs:634): compute the
+        expected sequencing commitment for a chain block whose selected
+        parent is the current materialized position.
+
+        ``selected_chain_index(target_bs) -> bytes`` returns the highest
+        selected-chain block (ancestor-or-equal of the selected parent)
+        with blue_score <= target_bs, or the genesis hash.
+        """
+        sp = gd.selected_parent
+        parent_header = headers_store.get(sp)
+        current_bs = gd.blue_score
+
+        # inactivity shortcut (processor.rs:790-865)
+        if current_bs < self.finality_depth + 1:
+            shortcut_block = self.genesis_hash
+        else:
+            shortcut_block = selected_chain_index(current_bs - self.finality_depth - 1)
+        sc_header = headers_store.get(shortcut_block)
+        inactivity_shortcut = (
+            sc_header.accepted_id_merkle_root if toccata_active_fn(sc_header.daa_score) else ZERO_HASH
+        )
+
+        context_hash = sc.mergeset_context_hash(
+            sc.MergesetContext(
+                timestamp=parent_header.timestamp,
+                daa_score=header_daa_score,
+                blue_score=current_bs,
+            )
+        )
+        parent_seq_commit = parent_header.accepted_id_merkle_root
+        data = collect_mergeset_seq_data(mergeset_acceptance, headers_store)
+
+        active_min = max(current_bs - self.finality_depth, 0)
+        parent_build = self.builds.get(sp)
+        parent_active = parent_build.active_lanes_count if parent_build else 0
+
+        # expiry scan: canonical lanes whose latest touch falls below the
+        # active window (SeqCommitBounds.newly_expired_range)
+        parent_min = max(parent_header.blue_score - self.finality_depth, 0)
+        expired = []
+        undo: dict[bytes, tuple[bytes, int] | None] = {}
+        for bs in [b for b in self.score_index if parent_min <= b < active_min]:
+            for lk in list(self.score_index.get(bs, ())):
+                expired.append(lk)
+
+        # lane updates (utxo_validation.rs:532): a tip below the active
+        # window is invisible — the lane re-activates on parent_seq_commit
+        updates: dict[bytes, tuple[bytes, int]] = {}
+        new_count = 0
+        for lane_id, leaves in data.lane_activities:
+            lk = sc.lane_key(lane_id)
+            ad = sc.activity_digest_lane(leaves)
+            existing = self.lane_tips.get(lk)
+            if existing is not None and existing[1] < active_min:
+                existing = None
+            if existing is None:
+                new_count += 1
+                parent_ref = parent_seq_commit
+            else:
+                parent_ref = existing[0]
+            updates[lk] = (sc.lane_tip_next(parent_ref, lk, ad, context_hash), current_bs)
+
+        # apply to a scratch view to compute the root without committing.
+        # A boundary lane both expires and re-activates in the same block:
+        # it stays out of the tree ops (the update overwrites) but both
+        # count operations stand and cancel (+1 new, +1 expired), matching
+        # processor.rs's BTreeMap-overwrite + count arithmetic.
+        expired_count = len(expired)
+        touched = set(expired) | set(updates)
+        for lk in touched:
+            undo[lk] = self.lane_tips.get(lk)
+        expired = tuple(lk for lk in expired if lk not in updates)
+        for lk in expired:
+            self.tree.delete(lk)
+        for lk, (tip, bs) in updates.items():
+            self.tree.insert(lk, sc.smt_leaf_hash(tip, bs))
+        lanes_root = self.tree.root()
+        # roll the scratch mutation back; advance() re-applies on commit
+        for lk in touched:
+            prev = undo[lk]
+            if prev is None:
+                self.tree.delete(lk)
+            else:
+                self.tree.insert(lk, sc.smt_leaf_hash(prev[0], prev[1]))
+
+        payload_root = sc.miner_payload_root(data.miner_payload_leaves)
+        pcd = sc.payload_and_context_digest(context_hash, payload_root)
+        activity_root = sc.activity_root_hash(inactivity_shortcut, lanes_root)
+        state_root = sc.seq_state_root(activity_root, pcd)
+        commit = sc.seq_commit(parent_seq_commit, state_root)
+
+        return SmtBuild(
+            seq_commit=commit,
+            lanes_root=lanes_root,
+            payload_ctx_digest=pcd,
+            active_lanes_count=parent_active + new_count - expired_count,
+            shortcut_block=shortcut_block,
+            updates=updates,
+            expired=expired,
+            undo=undo,
+        )
+
+    # -- position movement ----------------------------------------------
+
+    def commit(self, block: bytes, build: SmtBuild) -> None:
+        """Record a verified chain block's build and advance onto it."""
+        self.builds[block] = build
+        if self.storage.db is not None:
+            self.storage.stage(PREFIX_SMT_BUILD + block, _encode_build(build))
+        self._apply(build)
+
+    def advance(self, block: bytes) -> None:
+        """Re-apply a previously recorded build (forward chain walk)."""
+        build = self.builds.get(block)
+        if build is not None:
+            self._apply(build)
+
+    def retreat(self, block: bytes) -> None:
+        """Unwind a recorded build (reorg backward walk)."""
+        build = self.builds.get(block)
+        if build is not None:
+            for lk, prev in build.undo.items():
+                if prev is None:
+                    self._del_tip(lk)
+                    self._stage_tip(lk, None)
+                else:
+                    self._set_tip(lk, prev)
+                    self._stage_tip(lk, prev)
+
+    def _apply(self, build: SmtBuild) -> None:
+        for lk in build.expired:
+            self._del_tip(lk)
+            self._stage_tip(lk, None)
+        for lk, val in build.updates.items():
+            self._set_tip(lk, val)
+            self._stage_tip(lk, val)
+
+    def prune(self, block: bytes) -> None:
+        """Drop the build record of a pruned chain block."""
+        if self.builds.pop(block, None) is not None and self.storage.db is not None:
+            self.storage.stage(PREFIX_SMT_BUILD + block, None)

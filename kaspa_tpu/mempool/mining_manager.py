@@ -90,8 +90,21 @@ class MiningManager:
             return []
 
         checker = validator.new_checker()
+        accessor = None
+        if self.consensus.params.toccata_active(virtual.daa_score):
+            # mempool/consensus acceptance parity for OpChainblockSeqCommit
+            # (validate_block_template_transaction passes the same accessor)
+            from kaspa_tpu.consensus.smt_processor import ConsensusSeqCommitAccessor
+
+            accessor = ConsensusSeqCommitAccessor(
+                self.consensus.sink(),
+                self.consensus.reachability,
+                self.consensus.storage.headers,
+                self.consensus.params.toccata_active,
+                self.consensus.params.finality_depth,
+            )
         fee = validator.validate_populated_transaction_and_get_fee(
-            tx, entries, virtual.daa_score, checker=checker, token=0
+            tx, entries, virtual.daa_score, checker=checker, token=0, seq_commit_accessor=accessor
         )
         err = checker.dispatch().get(0)
         if err is not None:

@@ -135,10 +135,6 @@ def _gtab8():
 _GTAB8_X, _GTAB8_XB, _GTAB8_Y = _gtab8()
 _BETA8 = int_to_limbs8(GLV_BETA).reshape(W8, 1)
 
-# p-2 bits, MSB first (for Fermat inversion); first bit is 1
-_INV_BITS = np.array(
-    [(SECP_P - 2) >> (255 - i) & 1 for i in range(256)], dtype=np.int32
-).reshape(256, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -186,6 +182,22 @@ def _conv(a, b):
     return out
 
 
+def _conv_sqr(a):
+    """Squaring columns via symmetry: a_i*a_j pairs (i<j) counted once and
+    doubled, so ~half the MACs of `_conv(a, a)`.  [K, L] -> [2K-1, L].
+
+    Row i contributes a_i * [a_i, 2a_{i+1}, .., 2a_{K-1}] at offset 2i;
+    bound: 255 * 510 * K < 2**23 per lazy column — far inside int32.
+    """
+    ka = a.shape[0]
+    a2 = a * 2
+    out = jnp.zeros((2 * ka - 1, a.shape[1]), dtype=jnp.int32)
+    for i in range(ka):
+        v = a[i : i + 1] if i + 1 == ka else jnp.concatenate([a[i : i + 1], a2[i + 1 :]], axis=0)
+        out = out + _shift_rows(a[i : i + 1] * v, 2 * i, ka - 1 - i)
+    return out
+
+
 def _mul_c(c8: tuple, x):
     """x * c for the special-form modulus complement c (few 8-bit digits)."""
     k = x.shape[0]
@@ -219,7 +231,8 @@ def _mul(a, b, c8=_C8_P):
 
 
 def _sqr(a, c8=_C8_P):
-    return _mul(a, a, c8)
+    x = _fold(c8, _carry2(_conv_sqr(a)))
+    return _fold(c8, _carry2(x))
 
 
 def _add(a, b, c8=_C8_P):
@@ -267,16 +280,61 @@ def _canon(x, m8, c8=_C8_P):
     return _cond_sub_m(m8, out)
 
 
-def _inv(x, bits_ref):
-    """x**(p-2) via square-and-multiply over the supplied bit string."""
+# Fermat inversion addition chain: (steps of (squarings, multiplicand)).
+# 255 squarings + 15 multiplies instead of square-and-multiply's ~495 ops
+# (p-2 is mostly 1-bits).  Same chain shape libsecp256k1 uses for its
+# field inverse; verified symbolically below by replaying the chain on
+# exponents and checking the result equals p-2 exactly.
+_INV_CHAIN = (
+    (1, "x"),      # x2  = x^3
+    (1, "x"),      # x3  = x^7
+    (3, "x3"),     # x6
+    (3, "x3"),     # x9
+    (2, "x2"),     # x11
+    (11, "x11"),   # x22
+    (22, "x22"),   # x44
+    (44, "x44"),   # x88
+    (88, "x88"),   # x176
+    (44, "x44"),   # x220
+    (3, "x3"),     # x223
+    (23, "x22"),
+    (5, "x"),
+    (3, "x2"),
+    (2, "x"),
+)
+_INV_NAMES = ("x2", "x3", "x6", "x9", "x11", "x22", "x44", "x88", "x176", "x220", "x223")
 
-    def body(i, acc):
-        acc = _sqr(acc)
-        withx = _mul(acc, x)
-        b = jnp.broadcast_to(bits_ref[pl.ds(i, 1), :], (1, x.shape[1]))
-        return jnp.where(b > 0, withx, acc)
 
-    return jax.lax.fori_loop(1, 256, body, x)
+def _chain_exponent() -> int:
+    exps = {"x": 1}
+    e = 1
+    for step, (n, name) in enumerate(_INV_CHAIN):
+        e = (e << n) + exps[name]
+        if step < len(_INV_NAMES):
+            exps[_INV_NAMES[step]] = e
+    return e
+
+
+assert _chain_exponent() == SECP_P - 2
+
+
+def _inv(x):
+    """x**(p-2) via the fixed addition chain (255 S + 15 M)."""
+
+    def pw(v, n):
+        if n <= 4:
+            for _ in range(n):
+                v = _sqr(v)
+            return v
+        return jax.lax.fori_loop(0, n, lambda _i, a: _sqr(a), v)
+
+    vals = {"x": x}
+    acc = x
+    for step, (n, name) in enumerate(_INV_CHAIN):
+        acc = _mul(pw(acc, n), vals[name])
+        if step < len(_INV_NAMES):
+            vals[_INV_NAMES[step]] = acc
+    return acc
 
 
 # ---------------------------------------------------------------------------
@@ -385,7 +443,7 @@ def _cond_negate(y, sign_mask):
 
 
 def _verify_kernel(
-    ecdsa: bool, gtx_ref, gtxb_ref, gty_ref, mp_ref, mn_ref, beta_ref, bits_ref,
+    ecdsa: bool, gtx_ref, gtxb_ref, gty_ref, mp_ref, mn_ref, beta_ref,
     px_ref, py_ref, rc_ref, g1_ref, g2_ref, p1_ref, p2_ref, sgn_ref, vin_ref,
     out_ref, tabx, tabxb, taby, tabz,
 ):
@@ -460,7 +518,7 @@ def _verify_kernel(
     mp = mp_ref[:]
     zc = _canon(z, mp)
     inf = jnp.all(zc == 0, axis=0, keepdims=True)
-    zi = _inv(z, bits_ref)
+    zi = _inv(z)
     xa = _canon(_mul(x, zi), mp)
     if ecdsa:
         # x mod n: x < p < 2n, so a single conditional subtract suffices
@@ -475,7 +533,7 @@ def _verify_kernel(
 
 
 def _verify_kernel_plain(
-    ecdsa: bool, gtx_ref, gty_ref, mp_ref, mn_ref, bits_ref,
+    ecdsa: bool, gtx_ref, gty_ref, mp_ref, mn_ref,
     px_ref, py_ref, rc_ref, sd_ref, ed_ref, vin_ref, out_ref, tabx, taby, tabz,
 ):
     """Non-GLV dual-scalar ladder (64 unsigned 4-bit windows).
@@ -532,7 +590,7 @@ def _verify_kernel_plain(
     mp = mp_ref[:]
     zc = _canon(z, mp)
     inf = jnp.all(zc == 0, axis=0, keepdims=True)
-    zi = _inv(z, bits_ref)
+    zi = _inv(z)
     xa = _canon(_mul(x, zi), mp)
     if ecdsa:
         xn = _cond_sub_m(mn_ref[:], xa)
@@ -564,7 +622,6 @@ def _build_call_plain(n_padded: int, ecdsa: bool, interpret: bool):
             const_spec((W8, 16)),
             const_spec((W8, 1)),
             const_spec((W8, 1)),
-            const_spec((256, 1)),
             limb_spec,
             limb_spec,
             limb_spec,
@@ -585,7 +642,7 @@ def _build_call_plain(n_padded: int, ecdsa: bool, interpret: bool):
     def run(px8, py8, rc8, sd, ed, vin):
         return jitted(
             jnp.asarray(_GTAB8_X), jnp.asarray(_GTAB8_Y), jnp.asarray(_MP8),
-            jnp.asarray(_MN8), jnp.asarray(_INV_BITS), px8, py8, rc8, sd, ed, vin,
+            jnp.asarray(_MN8), px8, py8, rc8, sd, ed, vin,
         )
 
     return run
@@ -623,7 +680,6 @@ def _build_call(n_padded: int, ecdsa: bool, interpret: bool):
             const_spec((W8, 1)),    # modulus p
             const_spec((W8, 1)),    # modulus n
             const_spec((W8, 1)),    # beta
-            const_spec((256, 1)),   # p-2 bits
             limb_spec,              # px
             limb_spec,              # py
             limb_spec,              # rc
@@ -649,7 +705,7 @@ def _build_call(n_padded: int, ecdsa: bool, interpret: bool):
         return jitted(
             jnp.asarray(_GTAB8_X), jnp.asarray(_GTAB8_XB), jnp.asarray(_GTAB8_Y),
             jnp.asarray(_MP8), jnp.asarray(_MN8), jnp.asarray(_BETA8),
-            jnp.asarray(_INV_BITS), px8, py8, rc8, g1, g2, p1, p2, sgn, vin,
+            px8, py8, rc8, g1, g2, p1, p2, sgn, vin,
         )
 
     return run

@@ -9,6 +9,10 @@ replays it into a fresh consensus and reports validation throughput:
 import argparse
 import json
 
+from kaspa_tpu.utils import jax_setup
+
+jax_setup.setup()
+
 from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
 
 

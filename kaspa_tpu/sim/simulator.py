@@ -20,6 +20,7 @@ from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.consensus.consensus import Consensus
 from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
 from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.consensus.mass import BlockMassLimits, NonContextualMasses
 from kaspa_tpu.consensus.model.tx import ComputeCommit, SUBNETWORK_ID_NATIVE
 from kaspa_tpu.consensus.params import Params, simnet_params
 from kaspa_tpu.consensus.processes.coinbase import MinerData
@@ -107,6 +108,9 @@ def simulate(cfg: SimConfig) -> SimResult:
         parents = tips[: params.max_block_parents]
 
         def tx_selector(view, pov_daa_score, miner=miner):
+            mass_calc = consensus.transaction_validator.mass_calculator
+            limits = BlockMassLimits.with_shared_limit(params.max_block_mass)
+            used_compute = used_transient = used_storage = 0
             txs = []
             spent = set()
             base_items = list(view.diff.add.items())
@@ -128,10 +132,21 @@ def simulate(cfg: SimConfig) -> SimResult:
                     continue
                 if entry.is_coinbase and entry.block_daa_score + params.coinbase_maturity > pov_daa_score:
                     continue
-                tx = _make_tx(miner, outpoint, entry, rng, consensus.transaction_validator.mass_calculator)
-                if tx is not None:
-                    txs.append(tx)
-                    spent.add(outpoint)
+                tx = _make_tx(miner, outpoint, entry, rng, mass_calc)
+                if tx is None:
+                    continue
+                # template-builder discipline: stop at the per-dimension
+                # block mass limits (the validator enforces the same caps)
+                nc = mass_calc.calc_non_contextual_masses(tx)
+                totals = NonContextualMasses(
+                    used_compute + nc.compute_mass, used_transient + nc.transient_mass
+                )
+                if not limits.would_fit(totals, used_storage + tx.storage_mass):
+                    break
+                used_compute, used_transient = totals.compute_mass, totals.transient_mass
+                used_storage += tx.storage_mass
+                txs.append(tx)
+                spent.add(outpoint)
             return txs
 
         block = consensus.build_block_with_parents(

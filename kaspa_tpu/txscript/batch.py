@@ -51,15 +51,16 @@ class BatchScriptChecker:
         self._jobs: list[_Job] = []
         self._results: dict[int, Exception | None] = {}
 
-    def collect_tx(self, token: int, tx, utxo_entries, reused=None, pov_daa_score=None) -> None:
+    def collect_tx(self, token: int, tx, utxo_entries, reused=None, pov_daa_score=None, seq_commit_accessor=None) -> None:
         """Queue all input script checks of `tx`; result under `token`.
-        ``pov_daa_score`` feeds fork-activation gating in the VM fallback."""
+        ``pov_daa_score`` feeds fork-activation gating in the VM fallback;
+        ``seq_commit_accessor`` backs OpChainblockSeqCommit post-Toccata."""
         if reused is None:
             reused = chash.SigHashReusedValues()
         self._results.setdefault(token, None)
         for i, (inp, entry) in enumerate(zip(tx.inputs, utxo_entries)):
             try:
-                self._collect_input(token, tx, utxo_entries, i, inp, entry, reused, pov_daa_score)
+                self._collect_input(token, tx, utxo_entries, i, inp, entry, reused, pov_daa_score, seq_commit_accessor)
             except ScriptCheckError as e:
                 self._fail(token, e)
 
@@ -67,7 +68,7 @@ class BatchScriptChecker:
         if self._results.get(token) is None:
             self._results[token] = err
 
-    def _collect_input(self, token, tx, utxo_entries, i, inp, entry, reused, pov_daa_score=None):
+    def _collect_input(self, token, tx, utxo_entries, i, inp, entry, reused, pov_daa_score=None, seq_commit_accessor=None):
         cls = standard.classify_script(entry.script_public_key)
         if cls in (standard.ScriptClass.PUB_KEY, standard.ScriptClass.PUB_KEY_ECDSA):
             # runtime sig-op parity with the engine path (lib.rs:545 + :898):
@@ -104,7 +105,7 @@ class BatchScriptChecker:
             if self.vm_fallback is None:
                 raise ScriptCheckError(f"unsupported script class {cls.value} (VM fallback not wired)", i)
             try:
-                self.vm_fallback(tx, utxo_entries, i, reused, pov_daa_score)
+                self.vm_fallback(tx, utxo_entries, i, reused, pov_daa_score, seq_commit_accessor=seq_commit_accessor)
             except Exception as e:  # VM raises on invalid script
                 raise ScriptCheckError(str(e), i) from e
 

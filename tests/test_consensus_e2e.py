@@ -14,6 +14,8 @@ from kaspa_tpu.consensus.processes.coinbase import MinerData
 from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
 from kaspa_tpu.txscript import standard
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def sim_result():

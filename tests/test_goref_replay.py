@@ -14,6 +14,8 @@ import pytest
 
 from kaspa_tpu.sim.goref import load_goref, replay_goref
 
+pytestmark = pytest.mark.slow
+
 DATA = "/root/reference/testing/integration/testdata/dags_for_json_tests"
 TX_DAG = os.path.join(DATA, "goref-1060-tx-265-blocks", "blocks.json.gz")
 NOTX_DAG = os.path.join(DATA, "goref-notx-5000-blocks", "blocks.json.gz")

@@ -21,6 +21,8 @@ from kaspa_tpu.ops.secp256k1 import points as pt
 from kaspa_tpu.ops.secp256k1.verify import schnorr_verify_kernel
 from kaspa_tpu.sim.goref import replay_goref
 
+pytestmark = pytest.mark.slow
+
 TX_DAG = (
     "/root/reference/testing/integration/testdata/dags_for_json_tests/"
     "goref-1060-tx-265-blocks/blocks.json.gz"

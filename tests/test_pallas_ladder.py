@@ -19,6 +19,8 @@ from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
 from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
 
+pytestmark = pytest.mark.slow
+
 B = 8
 
 

@@ -13,6 +13,8 @@ import pytest
 
 from kaspa_tpu.crypto import eclib, secp
 
+pytestmark = pytest.mark.slow
+
 
 def _schnorr_cases(n=16, seed=11):
     rng = random.Random(seed)

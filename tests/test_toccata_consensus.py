@@ -1,0 +1,313 @@
+"""KIP-21/Toccata enforcement inside the consensus engine.
+
+The reference verifies sequencing commitments during chain-block UTXO
+verification (pipeline/virtual_processor/utxo_validation.rs:197-278) and
+switches rulesets at the fork's DAA score (config/params.rs:724).  These
+tests drive the same behavior end-to-end: activation divergence at the
+exact score, lane evolution + inactivity expiry, reorg rollback of lane
+state, restart-resume of the SMT, and the first-parent chain rule.
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus import seq_commit as sc
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model.tx import (
+    ComputeCommit,
+    SUBNETWORK_ID_NATIVE,
+    Transaction,
+    TransactionInput,
+    TransactionOutput,
+)
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.crypto import eclib, merkle
+from kaspa_tpu.txscript import standard
+
+SEC = 7
+PUB = eclib.schnorr_pubkey(SEC)
+SPK = standard.pay_to_pub_key(PUB)
+MD = MinerData(SPK, extra_data=b"toccata")
+
+
+def _params(activation: int, **overrides):
+    p = simnet_params(bps=2)
+    p.toccata_activation = activation
+    for k, v in overrides.items():
+        setattr(p, k, v)
+    return p
+
+
+def _grow(c, tip, n, t0=10_000, txs=None):
+    out = []
+    for i in range(n):
+        blk = c.build_block_with_parents([tip], MD, txs if i == 0 else [], timestamp=t0 + 600 * i)
+        assert c.validate_and_insert_block(blk) == "utxo_valid"
+        tip = blk.hash
+        out.append(blk)
+    return tip, out
+
+
+def _signed_spend(consensus, rng, fee=100_000):
+    view = consensus.get_virtual_utxo_view()
+    pov = consensus.get_virtual_daa_score()
+    maturity = consensus.params.coinbase_maturity
+    for outpoint, entry in sorted(consensus.utxo_set.items(), key=lambda kv: (kv[0].transaction_id, kv[0].index)):
+        if view.get(outpoint) is None or entry.script_public_key != SPK:
+            continue
+        if entry.is_coinbase and entry.block_daa_score + maturity > pov:
+            continue
+        tx = Transaction(
+            0,
+            [TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))],
+            [TransactionOutput(entry.amount - fee, SPK)],
+            0,
+            SUBNETWORK_ID_NATIVE,
+            0,
+            b"",
+        )
+        reused = chash.SigHashReusedValues()
+        msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+        sig = eclib.schnorr_sign(msg, SEC, rng.randbytes(32))
+        tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+        return tx
+    raise AssertionError("no mature utxo")
+
+
+def test_activation_divergence_at_exact_daa_score():
+    """Pre-fork blocks carry the KIP-15 root and version 1; from the exact
+    activation score on, headers commit the KIP-21 seq-commit, version 2."""
+    activation = 4
+    c = Consensus(_params(activation))
+    tip, blocks = _grow(c, c.params.genesis.hash, 8)
+
+    for blk in blocks:
+        h = blk.header
+        gd = c.storage.ghostdag.get(blk.hash)
+        sp_header = c.storage.headers.get(gd.selected_parent)
+        kip15 = merkle.merkle_hash(
+            sp_header.accepted_id_merkle_root,
+            merkle.calc_merkle_root(c.acceptance_data[blk.hash]),
+        )
+        if h.daa_score < activation:
+            assert h.version == c.params.genesis.version
+            assert h.accepted_id_merkle_root == kip15
+        else:
+            assert h.version == 2
+            # the sequencing commitment chains differently from KIP-15
+            assert h.accepted_id_merkle_root != kip15
+            build = c.lane_tracker.builds[blk.hash]
+            assert build.seq_commit == h.accepted_id_merkle_root
+            # coinbase lane is touched by every chain block
+            assert sc.COINBASE_LANE_KEY in build.updates
+
+
+def test_kip15_root_rejected_after_activation():
+    """A post-activation block carrying the (otherwise correct) KIP-15 root
+    must be disqualified: the fork switches the commitment rule."""
+    c = Consensus(_params(3))
+    tip, _ = _grow(c, c.params.genesis.hash, 5)
+
+    blk = c.build_block_with_parents([tip], MD, [], timestamp=99_000)
+    gd = c.ghostdag_manager.ghostdag([tip])
+    sp_header = c.storage.headers.get(gd.selected_parent)
+    # recompute what the acceptance ids will be: single-parent chain block
+    # accepts only the selected parent's coinbase
+    sp_txs = c.storage.block_transactions.get(gd.selected_parent)
+    kip15 = merkle.merkle_hash(
+        sp_header.accepted_id_merkle_root, merkle.calc_merkle_root([sp_txs[0].id()])
+    )
+    assert blk.header.accepted_id_merkle_root != kip15
+    blk.header.accepted_id_merkle_root = kip15
+    blk.header.invalidate_cache()
+    assert c.validate_and_insert_block(blk) == "disqualified"
+
+
+def test_lane_touch_and_inactivity_expiry():
+    """A native-lane touch activates the lane; staying idle for more than
+    finality_depth blue scores expires it (SeqCommitBounds window)."""
+    f = 4
+    c = Consensus(_params(0, finality_depth=f, coinbase_maturity=2))
+    rng = random.Random(5)
+    tip, _ = _grow(c, c.params.genesis.hash, 4)
+
+    tx = _signed_spend(c, rng)
+    native_lk = sc.lane_key(bytes(SUBNETWORK_ID_NATIVE))
+    blk = c.build_block_with_parents([tip], MD, [tx], timestamp=50_000)
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    # the tx is accepted by the NEXT chain block (which merges blk)
+    tip, _ = _grow(c, blk.hash, 1, t0=60_000)
+    assert native_lk in c.lane_tracker.lane_tips
+    count_with_lane = c.lane_tracker.builds[tip].active_lanes_count
+    assert count_with_lane == 2  # coinbase + native
+
+    # idle for > finality_depth chain blocks: the native lane expires
+    tip, _ = _grow(c, tip, f + 2, t0=70_000)
+    assert native_lk not in c.lane_tracker.lane_tips
+    assert sc.COINBASE_LANE_KEY in c.lane_tracker.lane_tips
+    assert c.lane_tracker.builds[tip].active_lanes_count == 1
+
+
+def test_reorg_rolls_lane_state_back():
+    """Lane state must follow the UTXO position across reorgs: after any
+    virtual movement the materialized SMT root equals the recorded
+    lanes_root of the position block."""
+    c = Consensus(_params(0, coinbase_maturity=2))
+    rng = random.Random(9)
+    g = c.params.genesis.hash
+
+    a_tip, _ = _grow(c, g, 4, t0=10_000)
+    tx = _signed_spend(c, rng)
+    blk = c.build_block_with_parents([a_tip], MD, [tx], timestamp=40_000)
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    a_tip, _ = _grow(c, blk.hash, 1, t0=41_000)
+    assert c.sink() == a_tip
+
+    # longer competing chain from genesis (heavier -> reorg)
+    b_tip = g
+    for i in range(9):
+        b = c.build_block_with_parents([b_tip], MD, [], timestamp=20_000 + 600 * i)
+        c.validate_and_insert_block(b)
+        b_tip = b.hash
+    assert c.sink() == b_tip
+
+    pos = c.utxo_position
+    build = c.lane_tracker.builds.get(pos)
+    assert build is not None and c.lane_tracker.tree.root() == build.lanes_root
+    # the reorged-away chain's lane touch is gone from the materialized state
+    assert sc.lane_key(bytes(SUBNETWORK_ID_NATIVE)) not in c.lane_tracker.lane_tips
+
+    # reorg back: extend the original chain past the B chain
+    a2, _ = _grow(c, a_tip, 7, t0=60_000)
+    assert c.sink() == a2
+    build = c.lane_tracker.builds[c.utxo_position]
+    assert c.lane_tracker.tree.root() == build.lanes_root
+    assert sc.lane_key(bytes(SUBNETWORK_ID_NATIVE)) in c.lane_tracker.lane_tips
+
+
+def test_restart_resumes_lane_state(tmp_path):
+    from kaspa_tpu.storage.kv import KvStore
+
+    path = str(tmp_path / "db")
+    params = _params(0, coinbase_maturity=2)
+    db = KvStore(path)
+    c = Consensus(params, db)
+    rng = random.Random(11)
+    tip, _ = _grow(c, params.genesis.hash, 4)
+    tx = _signed_spend(c, rng)
+    blk = c.build_block_with_parents([tip], MD, [tx], timestamp=50_000)
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    tip, _ = _grow(c, blk.hash, 2, t0=60_000)
+    root = c.lane_tracker.tree.root()
+    tips = dict(c.lane_tracker.lane_tips)
+    db.close()
+
+    db2 = KvStore(path)
+    c2 = Consensus(params, db2)
+    assert c2.lane_tracker.tree.root() == root
+    assert c2.lane_tracker.lane_tips == tips
+    # and the reloaded node keeps building/validating chain blocks
+    tip2, _ = _grow(c2, c2.sink(), 2, t0=90_000)
+    assert c2.storage.statuses.get(tip2) == "utxo_valid"
+    db2.close()
+
+
+def test_seq_commit_opcode_end_to_end():
+    """OpChainblockSeqCommit (0xd4) reads a chain block's sequencing
+    commitment through the live consensus accessor: a covenant-style output
+    gated on the commitment of an ancestor chain block is spendable."""
+    c = Consensus(_params(0, coinbase_maturity=2))
+    rng = random.Random(13)
+    tip, blocks = _grow(c, c.params.genesis.hash, 4)
+
+    target = blocks[1].hash  # early chain block
+    expected = c.storage.headers.get(target).accepted_id_merkle_root
+    # script: <target> OpChainblockSeqCommit <expected> OpEqual
+    covenant_spk = standard.ScriptPublicKey(
+        0, bytes([32]) + target + bytes([0xD4]) + bytes([32]) + expected + bytes([0x87])
+    )
+
+    # fund the covenant output
+    fund = _signed_spend(c, rng)
+    fund.outputs[0] = TransactionOutput(fund.outputs[0].value, covenant_spk)
+    # re-commit the KIP-9 storage mass and re-sign after the output edit
+    entry = c.get_virtual_utxo_view().get(fund.inputs[0].previous_outpoint)
+    fund.storage_mass = c.transaction_validator.mass_calculator.calc_contextual_masses(fund, [entry])
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(fund, [entry], 0, chash.SIG_HASH_ALL, reused)
+    fund.inputs[0].signature_script = standard.schnorr_signature_script(
+        eclib.schnorr_sign(msg, SEC, rng.randbytes(32)), chash.SIG_HASH_ALL
+    )
+    blk = c.build_block_with_parents([tip], MD, [fund], timestamp=50_000)
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    tip, _ = _grow(c, blk.hash, 1, t0=60_000)
+
+    # spend it: empty signature script, the spk script proves the commitment
+    from kaspa_tpu.consensus.model.tx import TransactionOutpoint
+
+    spend = Transaction(
+        1,
+        [TransactionInput(TransactionOutpoint(fund.id(), 0), b"", 0, ComputeCommit.budget(100))],
+        [TransactionOutput(fund.outputs[0].value - 100_000, SPK)],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    blk2 = c.build_block_with_parents([tip], MD, [spend], timestamp=70_000)
+    assert c.validate_and_insert_block(blk2) == "utxo_valid"
+    tip, _ = _grow(c, blk2.hash, 1, t0=80_000)
+    assert spend.id() in c.acceptance_data[tip]
+
+
+def test_boundary_lane_retouch_nets_zero_count():
+    """A lane expiring and re-activating in the same chain block must leave
+    active_lanes_count unchanged (+1 new, +1 expired cancel)."""
+    f = 3
+    c = Consensus(_params(0, finality_depth=f, coinbase_maturity=2))
+    rng = random.Random(21)
+    tip, _ = _grow(c, c.params.genesis.hash, 4)
+    native_lk = sc.lane_key(bytes(SUBNETWORK_ID_NATIVE))
+
+    # touch the native lane
+    tx = _signed_spend(c, rng)
+    blk = c.build_block_with_parents([tip], MD, [tx], timestamp=50_000)
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    tip, _ = _grow(c, blk.hash, 1, t0=60_000)
+    touch_bs = c.lane_tracker.lane_tips[native_lk][1]
+    assert c.lane_tracker.builds[tip].active_lanes_count == 2
+
+    # idle until the lane sits exactly at the expiry boundary, then
+    # re-touch it in the very block that would expire it
+    while True:
+        cur_bs = c.storage.ghostdag.get_blue_score(c.sink())
+        if cur_bs + 1 - f > touch_bs:
+            break
+        tip, _ = _grow(c, tip, 1, t0=61_000 + cur_bs * 600)
+    tx2 = _signed_spend(c, rng)
+    blk2 = c.build_block_with_parents([tip], MD, [tx2], timestamp=90_000)
+    assert c.validate_and_insert_block(blk2) == "utxo_valid"
+    tip, _ = _grow(c, blk2.hash, 1, t0=95_000)
+    assert native_lk in c.lane_tracker.lane_tips
+    assert c.lane_tracker.builds[tip].active_lanes_count == 2
+
+
+def test_first_parent_must_be_selected_parent():
+    """Post-Toccata chain rule (utxo_validation.rs:219-238): a chain block
+    whose first parent is not its selected parent is disqualified."""
+    c = Consensus(_params(0))
+    g = c.params.genesis.hash
+    a, _ = _grow(c, g, 3, t0=10_000)
+    side = c.build_block_with_parents([g], MD, [], timestamp=25_000)
+    assert c.validate_and_insert_block(side) in ("utxo_valid", "utxo_pending")
+
+    blk = c.build_block_with_parents([a, side.hash], MD, [], timestamp=40_000)
+    gd = c.ghostdag_manager.ghostdag([a, side.hash])
+    assert blk.header.parents_by_level[0][0] == gd.selected_parent
+    # swap the direct-parent order; everything else stays intact
+    blk.header.parents_by_level[0] = list(reversed(blk.header.parents_by_level[0]))
+    blk.header.invalidate_cache()
+    assert c.validate_and_insert_block(blk) == "disqualified"
