@@ -47,18 +47,11 @@ from kaspa_tpu.consensus.processes.transaction_validator import (
 from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, SampledWindowManager
 from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
 from kaspa_tpu.consensus.stores import (
-    PREFIX_ACCEPTANCE,
-    PREFIX_DAA_EXCLUDED,
-    PREFIX_DEPTH,
-    PREFIX_MULTISETS,
-    PREFIX_PRUNING_SAMPLES,
-    PREFIX_UTXO_DIFFS,
-    PREFIX_UTXO_SET,
     ConsensusStorage,
     GhostdagData,
     StatusesStore,
 )
-from kaspa_tpu.consensus.utxo import UtxoCollection, UtxoDiff, UtxoView, apply_diff, unapply_diff
+from kaspa_tpu.consensus.utxo import UtxoDiff, UtxoView, apply_diff, unapply_diff
 from kaspa_tpu.crypto import merkle
 from kaspa_tpu.crypto.muhash import MuHash
 
@@ -87,13 +80,15 @@ class VirtualState:
 
 
 class Consensus:
-    def __init__(self, params: Params, db=None):
+    def __init__(self, params: Params, db=None, cache_policy=None):
         """``db``: optional storage.kv.KvStore — attaches crash-safe
-        persistence (write-through stores + atomic batch flush per block).
-        A non-empty DB restores the full consensus state (restart-resume);
-        an empty one is initialized with genesis."""
+        persistence (bounded read-through caches + atomic batch flush per
+        block).  A non-empty DB restores the consensus state (restart-resume)
+        with O(tips + caches) work; an empty one is initialized with genesis.
+        ``cache_policy``: stores.CachePolicy bounding per-store decode caches
+        (defaults applied when a DB is attached)."""
         self.params = params
-        self.storage = ConsensusStorage(db)
+        self.storage = ConsensusStorage(db, cache_policy)
         self.reachability = ReachabilityService()
         self.ghostdag_manager = GhostdagManager(
             params.genesis.hash,
@@ -125,10 +120,12 @@ class Consensus:
         )
         self.transaction_validator = TransactionValidator(params)
         self.depth_manager = BlockDepthManager(
-            params.merge_depth, params.finality_depth, params.genesis.hash, self.storage.ghostdag, self.reachability
+            params.merge_depth, params.finality_depth, params.genesis.hash, self.storage.ghostdag,
+            self.reachability, self.storage.depth,
         )
         self.pruning_point_manager = PruningPointManager(
-            params.pruning_depth, params.finality_depth, params.genesis.hash, self.storage.headers
+            params.pruning_depth, params.finality_depth, params.genesis.hash, self.storage.headers,
+            self.storage.pruning_samples,
         )
         from kaspa_tpu.consensus.processes.parents_builder import ParentsManager
 
@@ -153,20 +150,22 @@ class Consensus:
 
         self.counters = ProcessingCounters()
 
-        # virtual/UTXO state
+        # virtual/UTXO state.  The per-block columns live in ConsensusStorage
+        # as bounded read-through caches (CachedDbAccess); these attributes
+        # alias them so processing code reads naturally.
         self.tips: set[bytes] = set()
-        self.utxo_set = UtxoCollection()  # positioned at self.utxo_position
+        self.utxo_set = self.storage.utxo_set  # positioned at self.utxo_position
         self.utxo_position: bytes = params.genesis.hash
-        self.utxo_diffs: dict[bytes, UtxoDiff] = {}  # chain-validated block -> diff vs selected parent position
-        self.multisets: dict[bytes, MuHash] = {}
-        self.acceptance_data: dict[bytes, list] = {}
+        self.utxo_diffs = self.storage.utxo_diffs  # chain-validated block -> diff vs selected parent position
+        self.multisets = self.storage.multisets
+        self.acceptance_data = self.storage.acceptance
         self.virtual_state: VirtualState | None = None
-        self.daa_excluded: dict[bytes, set[bytes]] = {}
+        self.daa_excluded = self.storage.daa_excluded
         # net UTXO delta accumulated between virtual resolutions (reorg-safe):
         # emitted as one UtxosChanged per resolve
         self._acc_added: dict = {}
         self._acc_removed: dict = {}
-        self.reach_mergesets: dict[bytes, list[bytes]] = {}
+        self.reach_mergesets = self.storage.reach_mergesets
 
         # KIP-21: materialized lane state + selected-chain index, both moved
         # in lock-step with utxo_position (smt-store / selected_chain_store)
@@ -235,23 +234,15 @@ class Consensus:
 
     def _set_multiset(self, block: bytes, ms: MuHash) -> None:
         self.multisets[block] = ms
-        if self.storage.db is not None:
-            self.storage.stage(PREFIX_MULTISETS + block, serde.encode_muhash(ms))
 
     def _set_utxo_diff(self, block: bytes, diff: UtxoDiff) -> None:
         self.utxo_diffs[block] = diff
-        if self.storage.db is not None:
-            self.storage.stage(PREFIX_UTXO_DIFFS + block, serde.encode_utxo_diff(diff))
 
     def _set_acceptance(self, block: bytes, accepted_ids: list[bytes]) -> None:
         self.acceptance_data[block] = accepted_ids
-        if self.storage.db is not None:
-            self.storage.stage(PREFIX_ACCEPTANCE + block, serde.encode_hash_list(accepted_ids))
 
     def _set_daa_excluded(self, block: bytes, excluded: set) -> None:
         self.daa_excluded[block] = excluded
-        if self.storage.db is not None:
-            self.storage.stage(PREFIX_DAA_EXCLUDED + block, serde.encode_hash_list(sorted(excluded)))
 
     def _set_reach_mergeset(self, block: bytes, mergeset: list[bytes]) -> None:
         """Persist the exact mergeset registered with reachability, so the
@@ -259,18 +250,6 @@ class Consensus:
         filtered the ghostdag data (the blues[0]==sp invariant no longer
         holds for blocks whose selected parent was pruned)."""
         self.reach_mergesets[block] = mergeset
-        if self.storage.db is not None:
-            from kaspa_tpu.consensus.stores import PREFIX_REACH_MERGESET
-
-            self.storage.stage(PREFIX_REACH_MERGESET + block, serde.encode_hash_list(mergeset))
-
-    def _persist_depth(self, block: bytes, mdr: bytes, fp: bytes) -> None:
-        if self.storage.db is not None:
-            self.storage.stage(PREFIX_DEPTH + block, mdr + fp)
-
-    def _persist_pruning_sample(self, block: bytes, sample: bytes) -> None:
-        if self.storage.db is not None:
-            self.storage.stage(PREFIX_PRUNING_SAMPLES + block, sample)
 
     def _persist_tips(self) -> None:
         if self.storage.db is not None:
@@ -281,86 +260,53 @@ class Consensus:
             self.storage.put_meta(b"utxo_position", self.utxo_position)
 
     def _load_state(self) -> None:
-        """Restore the full consensus state from the attached DB.
+        """Restore consensus state from the attached DB.
 
-        Stores load directly; reachability (and lazily the window caches)
-        rebuild from the loaded relations/ghostdag in topological order —
-        cheaper to recompute than to persist, and backend-agnostic."""
-        from kaspa_tpu.consensus.stores import (
-            PREFIX_BLOCK_TXS,
-            PREFIX_GHOSTDAG,
-            PREFIX_HEADERS,
-            PREFIX_RELATIONS,
-            PREFIX_STATUSES,
-        )
+        Every store column is read-through (nothing is bulk-decoded at
+        startup); the only O(retained-history) work is rebuilding the
+        in-memory reachability index — a keys-only relations scan plus one
+        transient ghostdag decode per block for the topological order.
+        Ascending (blue_work, hash) is a total topological order of the DAG
+        — every ancestor has strictly smaller blue work — and unlike a Kahn
+        walk over relations it stays valid when pruning removed intermediate
+        blocks (a kept block's mergeset members always sort before it)."""
+        from kaspa_tpu.consensus.stores import PREFIX_GHOSTDAG, PREFIX_RELATIONS
 
-        grouped = self.storage.load_all()
-        self.storage.headers._headers = {
-            k: serde.decode_header(v) for k, v in grouped.get(PREFIX_HEADERS, {}).items()
-        }
-        self.storage.ghostdag._data = {
-            k: serde.decode_ghostdag(v) for k, v in grouped.get(PREFIX_GHOSTDAG, {}).items()
-        }
-        self.storage.statuses._status = {
-            k: v.decode() for k, v in grouped.get(PREFIX_STATUSES, {}).items()
-        }
-        self.storage.block_transactions._txs = {
-            k: serde.decode_txs(v) for k, v in grouped.get(PREFIX_BLOCK_TXS, {}).items()
-        }
-        parents_map = {
-            k: serde.decode_hash_list_bytes(v) for k, v in grouped.get(PREFIX_RELATIONS, {}).items()
-        }
-        self.multisets = {k: serde.decode_muhash(v) for k, v in grouped.get(PREFIX_MULTISETS, {}).items()}
-        self.utxo_diffs = {k: serde.decode_utxo_diff(v) for k, v in grouped.get(PREFIX_UTXO_DIFFS, {}).items()}
-        self.acceptance_data = {
-            k: serde.decode_hash_list_bytes(v) for k, v in grouped.get(PREFIX_ACCEPTANCE, {}).items()
-        }
-        self.daa_excluded = {
-            k: set(serde.decode_hash_list_bytes(v)) for k, v in grouped.get(PREFIX_DAA_EXCLUDED, {}).items()
-        }
-        from kaspa_tpu.consensus.stores import PREFIX_REACH_MERGESET
-
-        self.reach_mergesets = {
-            k: serde.decode_hash_list_bytes(v) for k, v in grouped.get(PREFIX_REACH_MERGESET, {}).items()
-        }
-        for k, v in grouped.get(PREFIX_DEPTH, {}).items():
-            self.depth_manager.store(k, v[:32], v[32:64])
-        for k, v in grouped.get(PREFIX_PRUNING_SAMPLES, {}).items():
-            self.pruning_point_manager.store_pruning_sample(k, v)
-        self.utxo_set = UtxoCollection(
-            {serde.decode_outpoint(k): serde.decode_utxo_entry(v) for k, v in grouped.get(PREFIX_UTXO_SET, {}).items()}
-        )
         self.utxo_position = self.storage.get_meta(b"utxo_position") or self.params.genesis.hash
         self.tips = set(serde.decode_hash_list_bytes(self.storage.get_meta(b"tips")))
-        self.pruning_processor.load(grouped)
+        self.pruning_processor.load()
 
-        # rebuild relations (children derived) and reachability.  Ascending
-        # (blue_work, hash) is a total topological order of the DAG — every
-        # ancestor has strictly smaller blue work — and unlike a Kahn walk
-        # over relations it stays valid when pruning removed intermediate
-        # blocks (a kept block's mergeset members always sort before it).
-        gd_store = self.storage.ghostdag
-        topo = sorted(parents_map, key=lambda h: (gd_store.get_blue_work(h), h))
+        engine = self.storage.db.engine
         g = self.params.genesis.hash
-        for blk in topo:
-            parents = parents_map[blk]
-            self.storage.relations._parents[blk] = list(parents)
-            self.storage.relations._children.setdefault(blk, [])
-            for p in parents:
-                self.storage.relations._children.setdefault(p, []).append(blk)
+        # transient (blue_work, hash, selected_parent) triples: one ghostdag
+        # decode per block total — the walk below needs only selected_parent
+        order = []
+        for blk in engine.keys_prefix(PREFIX_RELATIONS):
+            raw = engine.get(PREFIX_GHOSTDAG + blk)
+            if raw:
+                gd = serde.decode_ghostdag(raw)
+                order.append((gd.blue_work, blk, gd.selected_parent))
+            else:
+                order.append((0, blk, ORIGIN))
+        order.sort()
+        live = {blk for _, blk, _sp in order}
+        for _, blk, sp in order:
             if blk == g:
                 self.reachability.add_block(blk, ORIGIN, [], [ORIGIN])
             else:
-                bgd = self.storage.ghostdag.get(blk)
-                live_parents = [p for p in parents if p in parents_map] or [bgd.selected_parent]
+                parents = self.storage.relations.get_parents(blk)
+                live_parents = [p for p in parents if p in live] or [sp]
                 self.reachability.add_block(
-                    blk, bgd.selected_parent, self.reach_mergesets.get(blk, []), live_parents
+                    blk, sp, self.reach_mergesets.get(blk, []), live_parents
                 )
-        # KIP-21: lane state snapshot + selected-chain index at utxo_position
+        # KIP-21 lane state resumes lazily from its persisted snapshot
         self.lane_tracker.load()
+        # selected-chain index: only the finality window is ever queried
+        # (inactivity-shortcut anchors reach back finality_depth+1 at most)
         chain = []
         cur = self.utxo_position
-        while self.storage.ghostdag.has(cur):
+        limit = self.params.finality_depth + 1025
+        while self.storage.ghostdag.has(cur) and len(chain) <= limit:
             chain.append((self.storage.ghostdag.get_blue_score(cur), cur))
             if cur == g:
                 break
@@ -446,6 +392,12 @@ class Consensus:
             raise RuleError(f"unexpected difficulty bits {header.bits:#x} != {expected_bits:#x}")
         if header.daa_score != daa_window.daa_score:
             raise RuleError(f"unexpected daa score {header.daa_score} != {daa_window.daa_score}")
+        # header version in context (post_pow_validation.rs:105-111 WrongBlockVersion):
+        # the expected version is fork-activation-dependent, so it's checked against
+        # the contextually-validated daa score rather than in isolation
+        expected_version = self.params.block_version(header.daa_score)
+        if header.version != expected_version:
+            raise RuleError(f"wrong block version {header.version} != {expected_version}")
 
         # PoW (consensus/pow): gated by skip_proof_of_work (test/sim configs)
         if not self.params.skip_proof_of_work:
@@ -479,7 +431,6 @@ class Consensus:
         self._set_reach_mergeset(block_hash, reach_mergeset)
         self._set_daa_excluded(block_hash, daa_window.mergeset_non_daa)
         self.depth_manager.store(block_hash, mdr, fp)
-        self._persist_depth(block_hash, mdr, fp)
         self.window_manager.cache_block_window(block_hash, DIFFICULTY_WINDOW, daa_window.window)
         self.storage.statuses.set(block_hash, StatusesStore.STATUS_HEADER_ONLY)
         return True
@@ -695,7 +646,6 @@ class Consensus:
         if reply.pruning_point != header.pruning_point:
             return False
         self.pruning_point_manager.store_pruning_sample(block, reply.pruning_sample)
-        self._persist_pruning_sample(block, reply.pruning_sample)
         # 4. coinbase
         txs = self.storage.block_transactions.get(block)
         if not self._verify_coinbase_transaction(txs[0], header.daa_score, gd, ctx["mergeset_rewards"], self.daa_excluded[block]):
@@ -716,25 +666,20 @@ class Consensus:
         if build is not None:
             self.lane_tracker.commit(block, build)
         self.selected_chain.append((gd.blue_score, block))
+        # bound the in-RAM chain index to the queried window (finality+margin;
+        # _selected_chain_block_at raises loudly if this ever proves too tight)
+        limit = self.params.finality_depth + 1025
+        if len(self.selected_chain) > limit + 256:
+            del self.selected_chain[: len(self.selected_chain) - limit]
         self.utxo_position = block
         self._persist_utxo_position()
         self.storage.statuses.set(block, StatusesStore.STATUS_UTXO_VALID)
         self.counters.inc_chain_blocks()
         return True
 
-    def _stage_utxo_set_change(self, diff: UtxoDiff, reverse: bool) -> None:
-        """Mirror a materialized-UTXO-set mutation into the DB batch."""
-        if self.storage.db is None:
-            return
-        removed, added = (diff.add, diff.remove) if reverse else (diff.remove, diff.add)
-        for op in removed:
-            self.storage.stage(PREFIX_UTXO_SET + serde.encode_outpoint(op), None)
-        for op, entry in added.items():
-            self.storage.stage(PREFIX_UTXO_SET + serde.encode_outpoint(op), serde.encode_utxo_entry(entry))
-
     def _apply_chain_diff(self, diff: UtxoDiff) -> None:
+        # the UtxoSetStore stages its own write-through ops per mutation
         apply_diff(self.utxo_set, diff)
-        self._stage_utxo_set_change(diff, reverse=False)
         for op, entry in diff.remove.items():
             if op in self._acc_added:
                 del self._acc_added[op]
@@ -748,7 +693,6 @@ class Consensus:
 
     def _unapply_chain_diff(self, diff: UtxoDiff) -> None:
         unapply_diff(self.utxo_set, diff)
-        self._stage_utxo_set_change(diff, reverse=True)
         for op, entry in diff.add.items():
             if op in self._acc_added:
                 del self._acc_added[op]
@@ -766,7 +710,14 @@ class Consensus:
         import bisect
 
         i = bisect.bisect_right(self.selected_chain, (target_bs, b"\xff" * 32)) - 1
-        return self.selected_chain[max(i, 0)][1]
+        if i < 0:
+            # selected_chain retention must reach finality_depth+1 below the
+            # tip; a miss here means pruning trimmed too close — fail loudly
+            # rather than return a wrong inactivity-shortcut anchor
+            raise RuleError(
+                f"selected-chain retention violated: no entry with blue_score <= {target_bs}"
+            )
+        return self.selected_chain[i][1]
 
     def _verify_coinbase_transaction(self, coinbase, daa_score, gd, mergeset_rewards, non_daa) -> bool:
         miner_data = self.coinbase_manager.deserialize_coinbase_payload(coinbase.payload).miner_data

@@ -157,8 +157,10 @@ class Params:
 
     def block_version(self, daa_score: int) -> int:
         """Forked block version (constants.rs BLOCK_VERSION=1 /
-        TOCCATA_BLOCK_VERSION=2, params.rs:535)."""
-        return 2 if self.toccata_active(daa_score) else self.genesis.version
+        TOCCATA_BLOCK_VERSION=2, params.rs:535).  Headers are checked
+        against this in context (post_pow_validation.rs:105-111); genesis
+        itself is exempt (inserted, never validated)."""
+        return 2 if self.toccata_active(daa_score) else 1
 
     @staticmethod
     def from_bps(name: str, bps: int, genesis: GenesisBlock, **overrides) -> "Params":

@@ -13,33 +13,34 @@ from kaspa_tpu.consensus.reachability import ORIGIN
 
 
 class BlockDepthManager:
-    def __init__(self, merge_depth: int, finality_depth: int, genesis_hash: bytes, ghostdag_store, reachability):
+    def __init__(self, merge_depth: int, finality_depth: int, genesis_hash: bytes, ghostdag_store, reachability, depth_store):
         self.merge_depth = merge_depth
         self.finality_depth = finality_depth
         self.genesis_hash = genesis_hash
         self.gd = ghostdag_store
         self.reachability = reachability
-        # per-block depth store (model/stores/depth.rs)
-        self._merge_depth_root: dict[bytes, bytes] = {}
-        self._finality_point: dict[bytes, bytes] = {}
+        # per-block depth store (model/stores/depth.rs): bounded read-through
+        # CachedDbAccess of (merge_depth_root, finality_point) pairs
+        self.depth = depth_store
 
     def store(self, block: bytes, merge_depth_root: bytes, finality_point: bytes) -> None:
-        self._merge_depth_root[block] = merge_depth_root
-        self._finality_point[block] = finality_point
+        self.depth[block] = (merge_depth_root, finality_point)
 
     def merge_depth_root(self, block: bytes) -> bytes:
-        return self._merge_depth_root.get(block, ORIGIN)
+        pair = self.depth.try_get(block)
+        return pair[0] if pair else ORIGIN
 
     def finality_point(self, block: bytes) -> bytes:
-        return self._finality_point.get(block, ORIGIN)
+        pair = self.depth.try_get(block)
+        return pair[1] if pair else ORIGIN
 
     def calc_merge_depth_root(self, gd, pruning_point: bytes) -> bytes:
-        return self._calc_block_at_depth(gd, self.merge_depth, pruning_point, self._merge_depth_root)
+        return self._calc_block_at_depth(gd, self.merge_depth, pruning_point, 0)
 
     def calc_finality_point(self, gd, pruning_point: bytes) -> bytes:
-        return self._calc_block_at_depth(gd, self.finality_depth, pruning_point, self._finality_point)
+        return self._calc_block_at_depth(gd, self.finality_depth, pruning_point, 1)
 
-    def _calc_block_at_depth(self, gd, depth: int, pruning_point: bytes, sp_store: dict) -> bytes:
+    def _calc_block_at_depth(self, gd, depth: int, pruning_point: bytes, pair_idx: int) -> bytes:
         if gd.selected_parent == ORIGIN:
             return ORIGIN
         if gd.blue_score < depth:
@@ -49,7 +50,8 @@ class BlockDepthManager:
             return ORIGIN
         if not self.reachability.is_chain_ancestor_of(pruning_point, gd.selected_parent):
             return ORIGIN
-        current = sp_store.get(gd.selected_parent, ORIGIN)
+        pair = self.depth.try_get(gd.selected_parent)
+        current = pair[pair_idx] if pair else ORIGIN
         if current == ORIGIN:
             current = pruning_point
         required_blue_score = gd.blue_score - depth

@@ -23,20 +23,21 @@ class PruningPointReply:
 
 
 class PruningPointManager:
-    def __init__(self, pruning_depth: int, finality_depth: int, genesis_hash: bytes, headers_store):
+    def __init__(self, pruning_depth: int, finality_depth: int, genesis_hash: bytes, headers_store, samples_store):
         self.pruning_depth = pruning_depth
         self.finality_depth = finality_depth
         self.genesis_hash = genesis_hash
         self.headers = headers_store
         self.pruning_samples_steps = -(-pruning_depth // finality_depth)
-        # pruning_sample_from_pov store (model/stores/pruning_samples.rs)
-        self._sample_from_pov: dict[bytes, bytes] = {}
+        # pruning_sample_from_pov store (model/stores/pruning_samples.rs):
+        # bounded read-through CachedDbAccess of 32-byte sample hashes
+        self.samples = samples_store
 
     def store_pruning_sample(self, block: bytes, sample: bytes) -> None:
-        self._sample_from_pov[block] = sample
+        self.samples[block] = sample
 
     def pruning_sample_from_pov(self, block: bytes) -> bytes:
-        return self._sample_from_pov[block]
+        return self.samples[block]
 
     def finality_score(self, blue_score: int) -> int:
         return blue_score // self.finality_depth
@@ -53,7 +54,7 @@ class PruningPointManager:
         if sp == self.genesis_hash:
             pruning_sample = self.genesis_hash
         else:
-            sp_sample = self._sample_from_pov[sp]
+            sp_sample = self.samples[sp]
             sp_sample_blue_score = self.headers.get_blue_score(sp_sample)
             if self.is_pruning_sample(sp_blue_score, sp_sample_blue_score):
                 pruning_sample = sp  # the selected parent is the most recent sample
@@ -73,7 +74,7 @@ class PruningPointManager:
                 break  # post-hardfork step clamp for samples
             if current == sp_pruning_point:
                 break  # monotonicity clamp for non-samples
-            current = self._sample_from_pov[current]
+            current = self.samples[current]
             steps += 1
 
         return PruningPointReply(pruning_sample, current)
@@ -90,6 +91,6 @@ class PruningPointManager:
         current = sink_pp
         while current != current_pruning_point:
             out.append(current)
-            current = self._sample_from_pov[current]
+            current = self.samples[current]
         out.reverse()
         return out

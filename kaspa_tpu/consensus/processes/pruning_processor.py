@@ -25,8 +25,8 @@ Archival nodes (`is_archival`) advance the pruning point but keep history.
 from __future__ import annotations
 
 from kaspa_tpu.consensus.reachability import ORIGIN
-from kaspa_tpu.consensus.stores import GhostdagData, PREFIX_REACH_MERGESET
-from kaspa_tpu.consensus.utxo import UtxoCollection, apply_diff
+from kaspa_tpu.consensus.stores import GhostdagData
+from kaspa_tpu.consensus.utxo import apply_diff
 from kaspa_tpu.crypto.muhash import MuHash
 
 
@@ -38,8 +38,9 @@ class PruningProcessor:
         self.pruning_point: bytes = g
         self.past_pruning_points: list[bytes] = [g]
         self.retention_period_root: bytes = g
-        # the pruning point UTXO set (pruning_meta utxo_set in the reference)
-        self.pruning_utxo_set = UtxoCollection()
+        # the pruning point UTXO set (pruning_meta utxo_set in the reference):
+        # a bounded-cache store column (PREFIX_PRUNING_UTXO), disk-resident
+        self.pruning_utxo_set = consensus.storage.pruning_utxo_set
         self.pruning_utxoset_position: bytes = g
         # pp's sampled windows, snapshotted while its past is still intact
         # (pruning deletes the blocks a cold rebuild would walk; trusted-data
@@ -69,16 +70,9 @@ class PruningProcessor:
         return True
 
     def _advance_pruning_utxoset(self, new_pp: bytes) -> None:
-        from kaspa_tpu.consensus import serde
-
+        # the UtxoSetStore stages its own write-through ops per mutation
         for chain_block in self.c.reachability.forward_chain_iterator(self.pruning_utxoset_position, new_pp):
-            diff = self.c.utxo_diffs[chain_block]
-            apply_diff(self.pruning_utxo_set, diff)
-            if self.c.storage.db is not None:
-                for op in diff.remove:
-                    self.c.storage.stage(b"PU" + serde.encode_outpoint(op), None)
-                for op, entry in diff.add.items():
-                    self.c.storage.stage(b"PU" + serde.encode_outpoint(op), serde.encode_utxo_entry(entry))
+            apply_diff(self.pruning_utxo_set, self.c.utxo_diffs[chain_block])
             self.pruning_utxoset_position = chain_block
         self._persist_meta()
 
@@ -142,11 +136,11 @@ class PruningProcessor:
         while cur not in seen_samples:
             seen_samples.add(cur)
             keep_headers.add(cur)
-            nxt = c.pruning_point_manager._sample_from_pov.get(cur)
+            nxt = c.storage.pruning_samples.try_get(cur)
             if nxt is None or cur == c.params.genesis.hash:
                 break
             cur = nxt
-        all_blocks = list(c.storage.headers._headers.keys())
+        all_blocks = list(c.storage.headers.keys())
         full_delete: list[bytes] = []
         header_only: list[bytes] = []
         for h in all_blocks:
@@ -177,8 +171,7 @@ class PruningProcessor:
             c.storage.ghostdag.delete(h)
             c.storage.relations.delete(h)
             c.storage.statuses.delete(h)
-            if c.reach_mergesets.pop(h, None) is not None:
-                c.storage.stage(PREFIX_REACH_MERGESET + h, None)
+            c.reach_mergesets.delete(h)
         # prune tips that can never be merged by virtual (not in future(pp))
         pruned_tips = {t for t in c.tips if t in delete_set}
         if pruned_tips:
@@ -191,7 +184,8 @@ class PruningProcessor:
         if delete_set:
             c.selected_chain = [e for e in c.selected_chain if e[1] not in delete_set]
         # filter ghostdag data of surviving blocks so mergesets never dangle
-        for h, gd in list(c.storage.ghostdag._data.items()):
+        for h in list(c.storage.ghostdag.keys()):
+            gd = c.storage.ghostdag.get(h)
             if any(m in delete_set for m in gd.unordered_mergeset()) or gd.selected_parent in delete_set:
                 filtered = GhostdagData(
                     gd.blue_score,
@@ -211,29 +205,14 @@ class PruningProcessor:
 
     def _del_aux(self, h: bytes, keep_sample: bool = False) -> None:
         """Delete virtual-stage per-block data (diff/multiset/acceptance/...)."""
-        from kaspa_tpu.consensus.stores import (
-            PREFIX_ACCEPTANCE,
-            PREFIX_DAA_EXCLUDED,
-            PREFIX_DEPTH,
-            PREFIX_MULTISETS,
-            PREFIX_PRUNING_SAMPLES,
-            PREFIX_UTXO_DIFFS,
-        )
-
         c = self.c
-        if c.utxo_diffs.pop(h, None) is not None:
-            c.storage.stage(PREFIX_UTXO_DIFFS + h, None)
-        if c.multisets.pop(h, None) is not None:
-            c.storage.stage(PREFIX_MULTISETS + h, None)
-        if c.acceptance_data.pop(h, None) is not None:
-            c.storage.stage(PREFIX_ACCEPTANCE + h, None)
-        if c.daa_excluded.pop(h, None) is not None:
-            c.storage.stage(PREFIX_DAA_EXCLUDED + h, None)
-        if c.depth_manager._merge_depth_root.pop(h, None) is not None:
-            c.depth_manager._finality_point.pop(h, None)
-            c.storage.stage(PREFIX_DEPTH + h, None)
-        if not keep_sample and c.pruning_point_manager._sample_from_pov.pop(h, None) is not None:
-            c.storage.stage(PREFIX_PRUNING_SAMPLES + h, None)
+        c.utxo_diffs.delete(h)
+        c.multisets.delete(h)
+        c.acceptance_data.delete(h)
+        c.daa_excluded.delete(h)
+        c.storage.depth.delete(h)
+        if not keep_sample:
+            c.storage.pruning_samples.delete(h)
         c.window_manager._difficulty_cache.pop(h, None)
         c.window_manager._median_cache.pop(h, None)
 
@@ -263,8 +242,9 @@ class PruningProcessor:
                 w.write(h)
         self.c.storage.put_meta(b"pp_windows", w.getvalue())
 
-    def load(self, grouped: dict) -> None:
-        """Restore pruning state from a loaded DB (consensus._load_state)."""
+    def load(self) -> None:
+        """Restore pruning state from the attached DB (consensus._load_state).
+        The PP UTXO set column needs no loading — it is read-through."""
         from kaspa_tpu.consensus import serde
 
         meta = self.c.storage.get_meta
@@ -277,9 +257,6 @@ class PruningProcessor:
         raw = meta(b"past_pruning_points")
         if raw:
             self.past_pruning_points = serde.decode_hash_list_bytes(raw)
-        self.pruning_utxo_set = UtxoCollection(
-            {serde.decode_outpoint(k): serde.decode_utxo_entry(v) for k, v in grouped.get(b"PU", {}).items()}
-        )
         raw_win = meta(b"pp_windows")
         if raw_win:
             import io

@@ -193,7 +193,7 @@ class PruningProofManager:
             past_pruning_points=list(c.pruning_processor.past_pruning_points),
         )
         kept: set[bytes] = set()
-        for h in list(c.storage.headers._headers):
+        for h in list(c.storage.headers.keys()):
             if h != pp and reach.has(h) and reach.is_dag_ancestor_of(pp, h):
                 continue  # strict future of pp: synced via normal IBD
             kept.add(h)
@@ -224,10 +224,10 @@ class PruningProofManager:
                 td.bodies[h] = c.storage.block_transactions.get(h)
             if h in c.daa_excluded:
                 td.daa_excluded[h] = c.daa_excluded[h]
-            mdr = c.depth_manager._merge_depth_root.get(h)
-            if mdr is not None:
-                td.depth[h] = (mdr, c.depth_manager._finality_point.get(h, b"\x00" * 32))
-            ps = c.pruning_point_manager._sample_from_pov.get(h)
+            pair = c.storage.depth.try_get(h)
+            if pair is not None:
+                td.depth[h] = pair
+            ps = c.storage.pruning_samples.try_get(h)
             if ps is not None:
                 td.pruning_samples[h] = ps
         from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, MEDIAN_TIME_WINDOW
@@ -245,7 +245,7 @@ class PruningProofManager:
             td.pp_windows[wt] = list(win)
         return td
 
-    def get_pruning_utxo_set(self) -> UtxoCollection:
+    def get_pruning_utxo_set(self):
         return self.c.pruning_processor.pruning_utxo_set
 
     # ------------------------------------------------------------------
@@ -324,10 +324,7 @@ class PruningProofManager:
 
         for blk in topo:
             parents = [p for p in by_hash[blk].direct_parents() if p in kept]
-            c.storage.relations._parents[blk] = list(parents)
-            c.storage.relations._children.setdefault(blk, [])
-            for p in parents:
-                c.storage.relations._children.setdefault(p, []).append(blk)
+            c.storage.relations.insert(blk, parents)
             if blk == genesis:
                 if not c.reachability.has(blk):
                     c.reachability.add_block(blk, ORIGIN, [], [ORIGIN])
@@ -343,11 +340,11 @@ class PruningProofManager:
         prp.pruning_point = pp
         prp.past_pruning_points = list(trusted.past_pruning_points)
         prp.retention_period_root = pp
-        prp.pruning_utxo_set = UtxoCollection(dict(utxo_set))
+        prp.pruning_utxo_set.replace_all(utxo_set)
         prp.pruning_utxoset_position = pp
         prp._persist_meta()
 
-        c.utxo_set = UtxoCollection(dict(utxo_set))
+        c.utxo_set.replace_all(utxo_set)
         c.utxo_position = pp
         c.multisets[pp] = ms
         # virtual parents are constrained to future(pp) (the reference's

@@ -29,6 +29,36 @@ DIFFICULTY_WINDOW = "difficulty"
 MEDIAN_TIME_WINDOW = "median_time"
 
 
+class _LruWindowCache(dict):
+    """Bounded LRU over per-block window lists (block_window_cache.rs is a
+    CachePolicy-bounded store in the reference for the same reason: windows
+    grow with history but only the recent tips are ever re-read)."""
+
+    def __init__(self, bound: int = 8192):
+        super().__init__()
+        self._bound = bound
+
+    def __setitem__(self, key, value):
+        if key in self:
+            del self[key]
+        super().__setitem__(key, value)
+        while len(self) > self._bound:
+            del self[next(iter(self))]
+
+    def __getitem__(self, key):
+        # refresh recency (dict preserves insertion order)
+        value = super().__getitem__(key)
+        super().__delitem__(key)
+        super().__setitem__(key, value)
+        return value
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+
 class BoundedBlockHeap:
     """Keeps the `bound` blocks with highest (blue_work, hash).
 
@@ -104,9 +134,11 @@ class SampledWindowManager:
         self.difficulty_sample_rate = difficulty_sample_rate
         self.past_median_time_window_size = past_median_time_window_size
         self.past_median_time_sample_rate = past_median_time_sample_rate
-        # block_window_cache stores (consensus/src/model/stores/block_window_cache.rs)
-        self._difficulty_cache: dict[bytes, list] = {}
-        self._median_cache: dict[bytes, list] = {}
+        # block_window_cache stores (consensus/src/model/stores/block_window_cache.rs):
+        # bounded LRU — windows are derivable from headers, so eviction only
+        # costs a rebuild, never correctness
+        self._difficulty_cache: dict[bytes, list] = _LruWindowCache()
+        self._median_cache: dict[bytes, list] = _LruWindowCache()
 
     # --- sizes / rates ---
 

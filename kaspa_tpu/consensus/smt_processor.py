@@ -163,29 +163,32 @@ class LaneTracker:
     """Materialized KIP-21 lane state at the consensus UTXO position."""
 
     def __init__(self, storage, finality_depth: int, genesis_hash: bytes):
+        from kaspa_tpu.consensus.stores import CachedDbAccess
+
         self.storage = storage
         self.finality_depth = finality_depth
         self.genesis_hash = genesis_hash
         self.tree = SparseMerkleTree(SEQ_COMMIT_ACTIVE)
+        # lane_tips/score_index/tree are the active-lane working set —
+        # bounded by the inactivity window, kept resident by design
         self.lane_tips: dict[bytes, tuple[bytes, int]] = {}
         self.score_index: dict[int, set[bytes]] = {}
-        self.builds: dict[bytes, SmtBuild] = {}  # chain block -> build
+        # per-chain-block build records: bounded read-through column (the
+        # reference's smt_metadata store) — NOT a whole-history RAM map
+        self.builds = CachedDbAccess(
+            storage, PREFIX_SMT_BUILD, _encode_build, _decode_build, storage.policy.acceptance
+        )
 
     # -- persistence -----------------------------------------------------
 
     def load(self) -> None:
-        """Rebuild materialized state from the SL lane-tip snapshot and the
-        SM build records (called once at startup, after stores load)."""
+        """Rebuild the materialized lane state from the SL tip snapshot —
+        O(active lanes); build records stay on disk and read through."""
         if self.storage.db is None:
             return
-        # single pass over the engine: both prefixes in one scan
-        for key, raw in self.storage.db.engine.items():
-            if key.startswith(PREFIX_SMT_LANE):
-                lk = key[len(PREFIX_SMT_LANE) :]
-                tip, (bs,) = raw[:32], struct.unpack_from("<Q", raw, 32)
-                self._set_tip(lk, (tip, bs))
-            elif key.startswith(PREFIX_SMT_BUILD):
-                self.builds[key[len(PREFIX_SMT_BUILD) :]] = _decode_build(raw)
+        for lk, raw in self.storage.db.engine.items_prefix(PREFIX_SMT_LANE):
+            tip, (bs,) = raw[:32], struct.unpack_from("<Q", raw, 32)
+            self._set_tip(lk, (tip, bs))
 
     def _stage_tip(self, lk: bytes, val: tuple[bytes, int] | None) -> None:
         if self.storage.db is None:
@@ -302,18 +305,22 @@ class LaneTracker:
         for lk in touched:
             undo[lk] = self.lane_tips.get(lk)
         expired = tuple(lk for lk in expired if lk not in updates)
-        for lk in expired:
-            self.tree.delete(lk)
-        for lk, (tip, bs) in updates.items():
-            self.tree.insert(lk, sc.smt_leaf_hash(tip, bs))
-        lanes_root = self.tree.root()
-        # roll the scratch mutation back; advance() re-applies on commit
-        for lk in touched:
-            prev = undo[lk]
-            if prev is None:
+        # the rollback must run even if a hashing helper raises mid-scratch,
+        # else the live tree diverges from lane_tips with no recovery
+        try:
+            for lk in expired:
                 self.tree.delete(lk)
-            else:
-                self.tree.insert(lk, sc.smt_leaf_hash(prev[0], prev[1]))
+            for lk, (tip, bs) in updates.items():
+                self.tree.insert(lk, sc.smt_leaf_hash(tip, bs))
+            lanes_root = self.tree.root()
+        finally:
+            # roll the scratch mutation back; advance() re-applies on commit
+            for lk in touched:
+                prev = undo[lk]
+                if prev is None:
+                    self.tree.delete(lk)
+                else:
+                    self.tree.insert(lk, sc.smt_leaf_hash(prev[0], prev[1]))
 
         payload_root = sc.miner_payload_root(data.miner_payload_leaves)
         pcd = sc.payload_and_context_digest(context_hash, payload_root)
@@ -336,20 +343,18 @@ class LaneTracker:
 
     def commit(self, block: bytes, build: SmtBuild) -> None:
         """Record a verified chain block's build and advance onto it."""
-        self.builds[block] = build
-        if self.storage.db is not None:
-            self.storage.stage(PREFIX_SMT_BUILD + block, _encode_build(build))
+        self.builds[block] = build  # CachedDbAccess stages the write-through
         self._apply(build)
 
     def advance(self, block: bytes) -> None:
         """Re-apply a previously recorded build (forward chain walk)."""
-        build = self.builds.get(block)
+        build = self.builds.try_get(block)
         if build is not None:
             self._apply(build)
 
     def retreat(self, block: bytes) -> None:
         """Unwind a recorded build (reorg backward walk)."""
-        build = self.builds.get(block)
+        build = self.builds.try_get(block)
         if build is not None:
             for lk, prev in build.undo.items():
                 if prev is None:
@@ -369,5 +374,4 @@ class LaneTracker:
 
     def prune(self, block: bytes) -> None:
         """Drop the build record of a pruned chain block."""
-        if self.builds.pop(block, None) is not None and self.storage.db is not None:
-            self.storage.stage(PREFIX_SMT_BUILD + block, None)
+        self.builds.delete(block)

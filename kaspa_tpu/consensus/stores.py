@@ -1,26 +1,34 @@
-"""Consensus stores: in-memory working set with optional KV write-through.
+"""Consensus stores: bounded decode caches over the persistent KV engine.
 
 Mirrors the reference's store registry (consensus/src/model/stores/, 20
 stores aggregated in ConsensusStorage, consensus/src/consensus/storage.rs)
-and its persistence discipline (database/src/access.rs CachedDbAccess:
-in-memory cache over a persistent column, mutations grouped into atomic
-write batches).  Here every store keeps its full working set in a dict (the
-cache) and, when a DB is attached, appends encoded write-through ops to the
-storage-wide pending buffer; ``ConsensusStorage.flush()`` commits the buffer
-as ONE atomic CRC-framed batch in the native engine (native/kvstore) at
-block-commit boundaries.  A crash between flushes loses at most the blocks
-since the last flush — the on-disk state is always a consistent prefix.
+and its memory discipline (database/src/access.rs CachedDbAccess: a BOUNDED
+in-memory cache of decoded values over a persistent column, with read-through
+misses, plus consensus/src/consensus/cache_policy_builder.rs sizing the
+per-store budgets).  Two modes:
+
+- **in-memory** (no DB attached): caches are unbounded plain dicts — the
+  whole working set lives in RAM, nothing persists (simulation mode).
+- **persistent** (DB attached): each store caches at most ``budget`` decoded
+  entries (LRU), reads through to the native engine on miss, and stages
+  mutations into the storage-wide pending buffer; ``ConsensusStorage.flush()``
+  commits the buffer as ONE atomic CRC-framed batch (native/kvstore) at
+  block-commit boundaries.  Entries with staged-but-unflushed writes are
+  pinned (never evicted) so reads are always consistent; a crash between
+  flushes loses at most the blocks since the last flush — the on-disk state
+  is always a consistent prefix.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-
+from collections import OrderedDict
+from dataclasses import dataclass, fields
 from kaspa_tpu.consensus.model import Header, Transaction
 
 # key prefixes (database/src/registry.rs DatabaseStorePrefixes shape)
 PREFIX_HEADERS = b"HD"
 PREFIX_RELATIONS = b"RL"
+PREFIX_CHILDREN = b"RC"
 PREFIX_GHOSTDAG = b"GD"
 PREFIX_STATUSES = b"ST"
 PREFIX_BLOCK_TXS = b"BT"
@@ -29,10 +37,201 @@ PREFIX_MULTISETS = b"MS"
 PREFIX_ACCEPTANCE = b"AC"
 PREFIX_DAA_EXCLUDED = b"DX"
 PREFIX_UTXO_SET = b"US"
+PREFIX_PRUNING_UTXO = b"PU"
 PREFIX_DEPTH = b"MD"
 PREFIX_PRUNING_SAMPLES = b"PS"
 PREFIX_REACH_MERGESET = b"RM"
+PREFIX_BLOCK_LEVELS = b"LV"
 PREFIX_META = b"MT"
+
+
+@dataclass
+class CachePolicy:
+    """Per-store decoded-entry budgets (cache_policy_builder.rs shape).
+
+    Budgets are entry counts; ``scaled`` multiplies every budget by a
+    ram-scale factor the way the reference's --ram-scale flag scales its
+    cache policies (kaspad/src/args.rs).  ``None`` disables bounding for
+    that store (used by the in-memory mode).
+    """
+
+    headers: int = 40_000
+    relations: int = 80_000
+    children: int = 80_000
+    ghostdag: int = 40_000
+    statuses: int = 200_000
+    block_txs: int = 2_000
+    utxo_diffs: int = 2_000
+    multisets: int = 2_000
+    acceptance: int = 2_000
+    daa_excluded: int = 10_000
+    reach_mergesets: int = 80_000
+    depth: int = 40_000
+    pruning_samples: int = 40_000
+    utxo_set: int = 100_000
+    pruning_utxo: int = 10_000
+    levels: int = 40_000
+
+    def scaled(self, ram_scale: float) -> "CachePolicy":
+        kw = {f.name: max(16, int(getattr(self, f.name) * ram_scale)) for f in fields(self)}
+        return CachePolicy(**kw)
+
+
+class CachedDbAccess:
+    """Bounded LRU decode cache over one DB prefix (database/src/access.rs).
+
+    Mapping-style interface so consensus call sites read naturally.  With no
+    DB the cache is authoritative and unbounded.  With a DB, mutations are
+    cached AND staged into the storage pending buffer; dirty (staged but
+    unflushed) entries are pinned until the next flush so read-your-writes
+    holds across the whole batch window.
+    """
+
+    def __init__(self, storage: "ConsensusStorage", prefix: bytes, encode, decode, budget: int | None):
+        self._storage = storage
+        self._prefix = prefix
+        self._encode = encode
+        self._decode = decode
+        self._budget = budget if storage.db is not None else None
+        self._cache: OrderedDict = OrderedDict()
+        self._dirty: set = set()        # staged writes not yet flushed (pinned)
+        self._pending_del: set = set()  # staged deletes not yet flushed
+        if storage.db is not None:
+            self._count = storage.db.engine.count_prefix(prefix)
+            storage.register(self)
+        else:
+            self._count = 0
+
+    # -- internal ------------------------------------------------------
+
+    def _db_raw(self, key: bytes):
+        if self._storage.db is None or key in self._pending_del:
+            return None
+        return self._storage.db.engine.get(self._prefix + key)
+
+    def _evict(self) -> None:
+        if self._budget is None:
+            return
+        while len(self._cache) > self._budget:
+            for k in self._cache:
+                if k not in self._dirty:
+                    del self._cache[k]
+                    break
+            else:
+                return  # everything pinned; evict after next flush
+
+    def on_flush(self) -> None:
+        self._dirty.clear()
+        self._pending_del.clear()
+        self._evict()
+
+    # -- reads ---------------------------------------------------------
+
+    def try_get(self, key: bytes):
+        if key in self._cache:
+            self._cache.move_to_end(key)
+            return self._cache[key]
+        raw = self._db_raw(key)
+        if raw is None:
+            return None
+        obj = self._decode(raw)
+        self._cache[key] = obj
+        self._evict()
+        return obj
+
+    def get(self, key: bytes, default=None):
+        v = self.try_get(key)
+        return default if v is None else v
+
+    def __getitem__(self, key: bytes):
+        v = self.try_get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __contains__(self, key: bytes) -> bool:
+        if key in self._cache:
+            return True
+        if self._storage.db is None or key in self._pending_del:
+            return False
+        return self._storage.db.engine.has(self._prefix + key)
+
+    has = __contains__
+
+    def __len__(self) -> int:
+        return self._count if self._storage.db is not None else len(self._cache)
+
+    def keys(self):
+        """All live keys.  DB mode: engine prefix scan (ordered, no disk
+        value reads) merged with unflushed staged writes."""
+        if self._storage.db is None:
+            return list(self._cache.keys())
+        ks = self._storage.db.engine.keys_prefix(self._prefix)
+        if self._pending_del:
+            ks = [k for k in ks if k not in self._pending_del]
+        if self._dirty:
+            on_disk = set(ks)
+            ks.extend(k for k in self._dirty if k not in on_disk)
+        return ks
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def items(self):
+        """Decoded (key, value) pairs — a full scan; use sparingly."""
+        if self._storage.db is None:
+            return list(self._cache.items())
+        return [(k, self[k]) for k in self.keys()]
+
+    def values(self):
+        return [v for _, v in self.items()]
+
+    # -- writes --------------------------------------------------------
+
+    def write(self, key: bytes, obj) -> None:
+        if self._storage.db is not None:
+            if key not in self:
+                self._count += 1
+            self._pending_del.discard(key)
+            self._dirty.add(key)
+            self._storage.stage(self._prefix + key, self._encode(obj))
+        self._cache[key] = obj
+        self._cache.move_to_end(key)
+        self._evict()
+
+    __setitem__ = write
+
+    def delete(self, key: bytes) -> None:
+        existed = key in self
+        self._cache.pop(key, None)
+        self._dirty.discard(key)
+        if self._storage.db is not None and existed:
+            self._count -= 1
+            self._pending_del.add(key)
+            self._storage.stage(self._prefix + key, None)
+
+    def pop(self, key: bytes, default=None):
+        v = self.try_get(key)
+        if v is None:
+            return default
+        self.delete(key)
+        return v
+
+    def __delitem__(self, key: bytes) -> None:
+        if key not in self:
+            raise KeyError(key)
+        self.delete(key)
+
+    def update(self, mapping) -> None:
+        items = mapping.items() if hasattr(mapping, "items") else mapping
+        for k, v in items:
+            self.write(k, v)
+
+    def clear_cache(self) -> None:
+        """Drop clean cached entries (dirty stay pinned)."""
+        for k in list(self._cache):
+            if k not in self._dirty:
+                del self._cache[k]
 
 
 @dataclass
@@ -68,51 +267,67 @@ class GhostdagData:
         return [self.selected_parent] + self.ascending_mergeset_without_selected_parent(gd_store)
 
 
+def _enc_header(h):
+    from kaspa_tpu.consensus import serde
+
+    return serde.encode_header(h)
+
+
+def _dec_header(b):
+    from kaspa_tpu.consensus import serde
+
+    return serde.decode_header(b)
+
+
 class HeaderStore:
     def __init__(self, storage: "ConsensusStorage"):
         self._storage = storage
-        self._headers: dict[bytes, Header] = {}
-        self._levels: dict[bytes, int] = {}  # lazy pow-derived block levels
+        self._access = CachedDbAccess(storage, PREFIX_HEADERS, _enc_header, _dec_header, storage.policy.headers)
+        # pow-derived block levels: tiny persisted values, lazily computed
+        self._levels = CachedDbAccess(
+            storage, PREFIX_BLOCK_LEVELS, lambda v: bytes([v]), lambda b: b[0], storage.policy.levels
+        )
         self.max_block_level = 225  # overwritten by Consensus from params
 
     def insert(self, header: Header) -> None:
-        self._headers[header.hash] = header
-        if self._storage.db is not None:
-            from kaspa_tpu.consensus import serde
-
-            self._storage.stage(PREFIX_HEADERS + header.hash, serde.encode_header(header))
+        self._access.write(header.hash, header)
 
     def delete(self, block: bytes) -> None:
-        self._headers.pop(block, None)
-        self._levels.pop(block, None)
-        self._storage.stage(PREFIX_HEADERS + block, None)
+        self._access.delete(block)
+        self._levels.delete(block)
 
     def get(self, block: bytes) -> Header:
-        return self._headers[block]
+        return self._access[block]
 
     def has(self, block: bytes) -> bool:
-        return block in self._headers
+        return block in self._access
+
+    def keys(self):
+        return self._access.keys()
+
+    def __len__(self) -> int:
+        return len(self._access)
 
     def get_bits(self, block: bytes) -> int:
-        return self._headers[block].bits
+        return self._access[block].bits
 
     def get_timestamp(self, block: bytes) -> int:
-        return self._headers[block].timestamp
+        return self._access[block].timestamp
 
     def get_blue_score(self, block: bytes) -> int:
-        return self._headers[block].blue_score
+        return self._access[block].blue_score
 
     def get_daa_score(self, block: bytes) -> int:
-        return self._headers[block].daa_score
+        return self._access[block].daa_score
 
     def get_block_level(self, block: bytes) -> int:
         """Proof level from the PoW value (pow/src/lib.rs calc_block_level):
         max(0, max_block_level - pow_bits); genesis gets the max level.
-        Lazily memoized — the heavy-hash is only paid when levels are needed
-        (parents building, proof building)."""
-        lvl = self._levels.get(block)
+        Lazily memoized and persisted — the heavy-hash is only paid once per
+        block across restarts."""
+        lvl = self._levels.try_get(block)
         if lvl is None:
-            header = self._headers[block]
+            header = self._access[block]
             if not header.direct_parents():
                 lvl = self.max_block_level  # genesis carries the max level
             else:
@@ -120,27 +335,41 @@ class HeaderStore:
 
                 pow_value = int.from_bytes(calc_block_pow_hash(header), "little")
                 lvl = max(0, self.max_block_level - pow_value.bit_length())
-            self._levels[block] = lvl
+            self._levels.write(block, lvl)
         return lvl
 
 
+def _enc_hashes(hs):
+    from kaspa_tpu.consensus import serde
+
+    return serde.encode_hash_list(list(hs))
+
+
+def _dec_hashes(b):
+    from kaspa_tpu.consensus import serde
+
+    return serde.decode_hash_list_bytes(b)
+
+
 class RelationsStore:
-    """Parent/child relations (level 0; higher levels added with pruning proofs)."""
+    """Parent/child relations (level 0; higher levels added with pruning
+    proofs).  Children lists are persisted under their own prefix (the
+    reference's DbRelationsStore keeps a children column for the same
+    reason: read-through must not require scanning all parents)."""
 
     def __init__(self, storage: "ConsensusStorage"):
         self._storage = storage
-        self._parents: dict[bytes, list[bytes]] = {}
-        self._children: dict[bytes, list[bytes]] = {}
+        self._parents = CachedDbAccess(storage, PREFIX_RELATIONS, _enc_hashes, _dec_hashes, storage.policy.relations)
+        self._children = CachedDbAccess(storage, PREFIX_CHILDREN, _enc_hashes, _dec_hashes, storage.policy.children)
 
     def insert(self, block: bytes, parents: list[bytes]) -> None:
-        self._parents[block] = list(parents)
-        self._children.setdefault(block, [])
+        self._parents.write(block, list(parents))
+        if block not in self._children:
+            self._children.write(block, [])
         for p in parents:
-            self._children.setdefault(p, []).append(block)
-        if self._storage.db is not None:
-            from kaspa_tpu.consensus import serde
-
-            self._storage.stage(PREFIX_RELATIONS + block, serde.encode_hash_list(parents))
+            ch = self._children.get(p, [])
+            if block not in ch:
+                self._children.write(p, ch + [block])
 
     def delete(self, block: bytes) -> None:
         """Remove the block AND scrub it from its children's parent lists —
@@ -150,16 +379,11 @@ class RelationsStore:
         for p in parents:
             ch = self._children.get(p)
             if ch and block in ch:
-                ch.remove(block)
+                self._children.write(p, [c for c in ch if c != block])
         for c in self._children.pop(block, []):
             plist = self._parents.get(c)
             if plist and block in plist:
-                plist.remove(block)
-                if self._storage.db is not None:
-                    from kaspa_tpu.consensus import serde
-
-                    self._storage.stage(PREFIX_RELATIONS + c, serde.encode_hash_list(plist))
-        self._storage.stage(PREFIX_RELATIONS + block, None)
+                self._parents.write(c, [x for x in plist if x != block])
 
     def get_parents(self, block: bytes) -> list[bytes]:
         return self._parents[block]
@@ -170,40 +394,55 @@ class RelationsStore:
     def has(self, block: bytes) -> bool:
         return block in self._parents
 
+    def keys(self):
+        return self._parents.keys()
+
+
+def _enc_gd(gd):
+    from kaspa_tpu.consensus import serde
+
+    return serde.encode_ghostdag(gd)
+
+
+def _dec_gd(b):
+    from kaspa_tpu.consensus import serde
+
+    return serde.decode_ghostdag(b)
+
 
 class GhostdagStore:
     def __init__(self, storage: "ConsensusStorage"):
-        self._storage = storage
-        self._data: dict[bytes, GhostdagData] = {}
+        self._access = CachedDbAccess(storage, PREFIX_GHOSTDAG, _enc_gd, _dec_gd, storage.policy.ghostdag)
 
     def insert(self, block: bytes, data: GhostdagData) -> None:
-        self._data[block] = data
-        if self._storage.db is not None:
-            from kaspa_tpu.consensus import serde
-
-            self._storage.stage(PREFIX_GHOSTDAG + block, serde.encode_ghostdag(data))
+        self._access.write(block, data)
 
     def delete(self, block: bytes) -> None:
-        self._data.pop(block, None)
-        self._storage.stage(PREFIX_GHOSTDAG + block, None)
+        self._access.delete(block)
 
     def get(self, block: bytes) -> GhostdagData:
-        return self._data[block]
+        return self._access[block]
 
     def has(self, block: bytes) -> bool:
-        return block in self._data
+        return block in self._access
+
+    def keys(self):
+        return self._access.keys()
+
+    def items(self):
+        return self._access.items()
 
     def get_blue_work(self, block: bytes) -> int:
-        return self._data[block].blue_work
+        return self._access[block].blue_work
 
     def get_blue_score(self, block: bytes) -> int:
-        return self._data[block].blue_score
+        return self._access[block].blue_score
 
     def get_selected_parent(self, block: bytes) -> bytes:
-        return self._data[block].selected_parent
+        return self._access[block].selected_parent
 
     def get_blues_anticone_sizes(self, block: bytes) -> dict[bytes, int]:
-        return self._data[block].blues_anticone_sizes
+        return self._access[block].blues_anticone_sizes
 
 
 class StatusesStore:
@@ -216,45 +455,125 @@ class StatusesStore:
     STATUS_HEADER_ONLY = "header_only"
 
     def __init__(self, storage: "ConsensusStorage"):
-        self._storage = storage
-        self._status: dict[bytes, str] = {}
+        self._access = CachedDbAccess(
+            storage, PREFIX_STATUSES, lambda s: s.encode(), lambda b: b.decode(), storage.policy.statuses
+        )
 
     def set(self, block: bytes, status: str) -> None:
-        self._status[block] = status
-        self._storage.stage(PREFIX_STATUSES + block, status.encode())
+        self._access.write(block, status)
 
     def delete(self, block: bytes) -> None:
-        self._status.pop(block, None)
-        self._storage.stage(PREFIX_STATUSES + block, None)
+        self._access.delete(block)
 
     def get(self, block: bytes) -> str | None:
-        return self._status.get(block)
+        return self._access.try_get(block)
 
     def is_valid(self, block: bytes) -> bool:
-        return self._status.get(block) in (self.STATUS_UTXO_VALID, self.STATUS_UTXO_PENDING_VERIFICATION, self.STATUS_HEADER_ONLY)
+        return self._access.try_get(block) in (
+            self.STATUS_UTXO_VALID,
+            self.STATUS_UTXO_PENDING_VERIFICATION,
+            self.STATUS_HEADER_ONLY,
+        )
+
+
+def _enc_txs(txs):
+    from kaspa_tpu.consensus import serde
+
+    return serde.encode_txs(txs)
+
+
+def _dec_txs(b):
+    from kaspa_tpu.consensus import serde
+
+    return serde.decode_txs(b)
 
 
 class BlockTransactionsStore:
     def __init__(self, storage: "ConsensusStorage"):
-        self._storage = storage
-        self._txs: dict[bytes, list[Transaction]] = {}
+        self._access = CachedDbAccess(storage, PREFIX_BLOCK_TXS, _enc_txs, _dec_txs, storage.policy.block_txs)
 
     def insert(self, block: bytes, txs: list[Transaction]) -> None:
-        self._txs[block] = txs
-        if self._storage.db is not None:
-            from kaspa_tpu.consensus import serde
-
-            self._storage.stage(PREFIX_BLOCK_TXS + block, serde.encode_txs(txs))
+        self._access.write(block, txs)
 
     def delete(self, block: bytes) -> None:
-        self._txs.pop(block, None)
-        self._storage.stage(PREFIX_BLOCK_TXS + block, None)
+        self._access.delete(block)
 
     def get(self, block: bytes) -> list[Transaction]:
-        return self._txs[block]
+        return self._access[block]
 
     def has(self, block: bytes) -> bool:
-        return block in self._txs
+        return block in self._access
+
+    def __len__(self) -> int:
+        return len(self._access)
+
+
+class UtxoSetStore:
+    """A UTXO collection over a DB prefix with outpoint-object keys.
+
+    Bounded cache over the encoded column; point lookups miss through to
+    the engine, full iteration streams from disk (model/stores/utxo_set.rs
+    over CachedDbAccess with UtxoKey columns)."""
+
+    def __init__(self, storage: "ConsensusStorage", prefix: bytes, budget: int | None):
+        from kaspa_tpu.consensus import serde
+
+        self._serde = serde
+        self._access = CachedDbAccess(
+            storage, prefix, serde.encode_utxo_entry, serde.decode_utxo_entry, budget
+        )
+
+    def _k(self, outpoint) -> bytes:
+        return self._serde.encode_outpoint(outpoint)
+
+    def get(self, outpoint, default=None):
+        return self._access.get(self._k(outpoint), default)
+
+    def __getitem__(self, outpoint):
+        return self._access[self._k(outpoint)]
+
+    def __setitem__(self, outpoint, entry) -> None:
+        self._access.write(self._k(outpoint), entry)
+
+    def __delitem__(self, outpoint) -> None:
+        del self._access[self._k(outpoint)]
+
+    def __contains__(self, outpoint) -> bool:
+        return self._k(outpoint) in self._access
+
+    def __len__(self) -> int:
+        return len(self._access)
+
+    def items(self):
+        for k, v in self._access.items():
+            yield self._serde.decode_outpoint(k), v
+
+    def keys(self):
+        return [self._serde.decode_outpoint(k) for k in self._access.keys()]
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def replace_all(self, mapping) -> None:
+        """Swap contents (pruning-point UTXO import).  Identical entries are
+        left untouched, and the staged batch is flushed in chunks so a
+        multi-million-entry import never pins the whole set in the pending
+        buffer (import runs on a fresh/staging DB, so partial flushes are
+        invisible until the final commit marks the state complete)."""
+        new_keys = {self._k(op): entry for op, entry in mapping.items()}
+        ops = 0
+        for k in list(self._access.keys()):
+            if k not in new_keys:
+                self._access.delete(k)
+                ops += 1
+                if ops % 50_000 == 0:
+                    self._access._storage.flush()
+        for k, entry in new_keys.items():
+            if self._access.try_get(k) != entry:
+                self._access.write(k, entry)
+                ops += 1
+                if ops % 50_000 == 0:
+                    self._access._storage.flush()
 
 
 class ConsensusStorage:
@@ -264,16 +583,55 @@ class ConsensusStorage:
     into ``pending`` and ``flush()`` commits them as one atomic batch.  The
     mutation sites in the pipeline are exactly the reference's commit points,
     so any prefix of flushed batches is a consistent consensus state.
+    ``policy`` bounds each store's decoded cache (CachePolicy); flush()
+    unpins dirty entries and evicts over-budget ones.
     """
 
-    def __init__(self, db=None):
+    def __init__(self, db=None, policy: CachePolicy | None = None):
         self.db = db
+        self.policy = policy or CachePolicy()
         self.pending: list[tuple[bytes, bytes | None]] = []
+        self._registered: list[CachedDbAccess] = []
         self.headers = HeaderStore(self)
         self.relations = RelationsStore(self)
         self.ghostdag = GhostdagStore(self)
         self.statuses = StatusesStore(self)
         self.block_transactions = BlockTransactionsStore(self)
+        # virtual-stage per-block columns (model/stores/{utxo_diffs,
+        # utxo_multisets,acceptance_data,daa,depth,pruning_samples}.rs)
+        from kaspa_tpu.consensus import serde
+
+        self.utxo_diffs = CachedDbAccess(
+            self, PREFIX_UTXO_DIFFS, serde.encode_utxo_diff, serde.decode_utxo_diff, self.policy.utxo_diffs
+        )
+        self.multisets = CachedDbAccess(
+            self, PREFIX_MULTISETS, serde.encode_muhash, serde.decode_muhash, self.policy.multisets
+        )
+        self.acceptance = CachedDbAccess(
+            self, PREFIX_ACCEPTANCE, _enc_hashes, _dec_hashes, self.policy.acceptance
+        )
+        self.daa_excluded = CachedDbAccess(
+            self,
+            PREFIX_DAA_EXCLUDED,
+            lambda s: _enc_hashes(sorted(s)),
+            lambda b: set(_dec_hashes(b)),
+            self.policy.daa_excluded,
+        )
+        self.reach_mergesets = CachedDbAccess(
+            self, PREFIX_REACH_MERGESET, _enc_hashes, _dec_hashes, self.policy.reach_mergesets
+        )
+        # depth store: (merge_depth_root, finality_point) packed as 64 bytes
+        self.depth = CachedDbAccess(
+            self, PREFIX_DEPTH, lambda t: t[0] + t[1], lambda b: (b[:32], b[32:64]), self.policy.depth
+        )
+        self.pruning_samples = CachedDbAccess(
+            self, PREFIX_PRUNING_SAMPLES, lambda v: v, lambda b: b, self.policy.pruning_samples
+        )
+        self.utxo_set = UtxoSetStore(self, PREFIX_UTXO_SET, self.policy.utxo_set)
+        self.pruning_utxo_set = UtxoSetStore(self, PREFIX_PRUNING_UTXO, self.policy.pruning_utxo)
+
+    def register(self, access: CachedDbAccess) -> None:
+        self._registered.append(access)
 
     def stage(self, key: bytes, value: bytes | None) -> None:
         """Queue one write-through op (value None = delete)."""
@@ -286,6 +644,10 @@ class ConsensusStorage:
     def get_meta(self, name: bytes) -> bytes | None:
         if self.db is None:
             return None
+        # unflushed meta staged this batch wins over the engine copy
+        for key, value in reversed(self.pending):
+            if key == PREFIX_META + name:
+                return value
         return self.db.engine.get(PREFIX_META + name)
 
     def flush(self) -> None:
@@ -298,14 +660,8 @@ class ConsensusStorage:
                 else:
                     b.put(key, value)
         self.pending.clear()
+        for access in self._registered:
+            access.on_flush()
 
     def is_initialized(self) -> bool:
         return self.get_meta(b"init") == b"1"
-
-    def load_all(self) -> dict[bytes, dict[bytes, bytes]]:
-        """Read the whole DB grouped by prefix: {prefix: {key: value}}."""
-        assert self.db is not None
-        grouped: dict[bytes, dict[bytes, bytes]] = {}
-        for k, v in self.db.engine.items():
-            grouped.setdefault(k[:2], {})[k[2:]] = v
-        return grouped

@@ -124,8 +124,8 @@ def collect_snapshot(consensus, mining, perf_monitor, p2p_node=None, wire_stats=
     v["node_txs_processed_count"] = counters.txs_counts
     v["node_chain_blocks_processed_count"] = counters.chain_block_counts
     v["node_mass_processed_count"] = counters.mass_counts
-    v["node_database_blocks_count"] = len(consensus.storage.block_transactions._txs)
-    v["node_database_headers_count"] = len(consensus.storage.headers._headers)
+    v["node_database_blocks_count"] = len(consensus.storage.block_transactions)
+    v["node_database_headers_count"] = len(consensus.storage.headers)
     v["network_mempool_size"] = len(mining.mempool)
     v["network_tip_hashes_count"] = len(consensus.tips)
     v["network_virtual_daa_score"] = consensus.get_virtual_daa_score()

@@ -76,6 +76,7 @@ class Node:
         import threading
 
         from kaspa_tpu.consensus.manager import ConsensusManager
+        from kaspa_tpu.pipeline import ConsensusPipeline
 
         self.name = name
         self.cmgr = ConsensusManager(consensus)
@@ -87,6 +88,11 @@ class Node:
         # single-writer discipline: wire reader threads and RPC dispatch all
         # serialize consensus/mempool access through this lock
         self.lock = threading.RLock()
+        # the concurrent pipeline IS the block intake — relay, RPC submit and
+        # IBD all flow through it (the reference runs its 4-processor
+        # pipeline always, consensus/src/consensus/mod.rs:369-401; there is
+        # no synchronous alternative path)
+        self.pipeline = ConsensusPipeline(consensus, workers=2)
 
     @property
     def consensus(self) -> Consensus:
@@ -95,8 +101,13 @@ class Node:
     def _on_consensus_swap(self, new_consensus) -> None:
         """Staging commit: rebuild the mempool facade on the new consensus
         (pending txs are dropped — they reference the stale DAG)."""
+        from kaspa_tpu.pipeline import ConsensusPipeline
+
         self.mining = MiningManager(new_consensus)
         self._drop_ibd_pipeline()
+        old = self.pipeline
+        self.pipeline = ConsensusPipeline(new_consensus, workers=2)
+        old.shutdown()
 
     def _drop_ibd_pipeline(self) -> None:
         cached = getattr(self, "_ibd_pipeline", None)
@@ -120,7 +131,7 @@ class Node:
                 peer.send(MSG_INV_TXS, [tx.id()])
 
     def submit_block(self, block: Block) -> str:
-        status = self.consensus.validate_and_insert_block(block)
+        status = self.pipeline.validate_and_insert_block(block)
         self.mining.handle_new_block_transactions(block.transactions, self.consensus.get_virtual_daa_score())
         self._try_unorphan(block.hash)
         self.broadcast_block(block)
@@ -257,13 +268,16 @@ class Node:
         (not per message) so a chunked IBD doesn't churn threads."""
         from kaspa_tpu.pipeline import ConsensusPipeline
 
-        cached = getattr(self, "_ibd_pipeline", None)
-        if cached is None or cached[0] is not target:
-            if cached is not None:
-                cached[1].shutdown()
-            cached = (target, ConsensusPipeline(target, workers=2))
-            self._ibd_pipeline = cached
-        pipe = cached[1]
+        if target is self.consensus:
+            pipe = self.pipeline  # plain IBD rides the steady-state pipeline
+        else:
+            cached = getattr(self, "_ibd_pipeline", None)
+            if cached is None or cached[0] is not target:
+                if cached is not None:
+                    cached[1].shutdown()
+                cached = (target, ConsensusPipeline(target, workers=2))
+                self._ibd_pipeline = cached
+            pipe = cached[1]
         futures = [pipe.submit(b) for b in blocks]
         for f in futures:
             try:
@@ -274,14 +288,22 @@ class Node:
     def _on_relay_block(self, peer: Peer, block: Block) -> None:
         peer.known_blocks.add(block.hash)  # sender has it: don't echo the inv back
         parents = block.header.direct_parents()
-        missing = [p for p in parents if not self.consensus.storage.headers.has(p)]
+        # a parent already in flight inside the pipeline counts as present:
+        # the deps manager parks the child until the parent commits (the
+        # reference's out-of-order intake, deps_manager.rs) — only parents
+        # neither stored nor in flight make this an orphan
+        missing = [
+            p
+            for p in parents
+            if not self.consensus.storage.headers.has(p) and not self.pipeline.deps.is_pending(p)
+        ]
         if missing:
             # orphan: request missing ancestors (orphan resolution, flow.rs)
             self.orphan_blocks[block.hash] = block
             peer.send(MSG_REQUEST_BLOCK, missing)
             return
         try:
-            self.consensus.validate_and_insert_block(block)
+            self.pipeline.validate_and_insert_block(block)
         except RuleError:
             return  # invalid relay: reference would score/ban the peer
         self.mining.handle_new_block_transactions(block.transactions, self.consensus.get_virtual_daa_score())
@@ -289,19 +311,29 @@ class Node:
         self.broadcast_block(block)
 
     def _try_unorphan(self, new_hash: bytes) -> None:
-        """revalidate_orphans: process orphans whose parents arrived."""
+        """revalidate_orphans: process orphans whose parents arrived.
+
+        Each round submits EVERY ready orphan to the pipeline at once —
+        siblings overlap their header/body stages — then collects results."""
         progress = True
         while progress:
             progress = False
-            for h, block in list(self.orphan_blocks.items()):
-                if all(self.consensus.storage.headers.has(p) for p in block.header.direct_parents()):
-                    del self.orphan_blocks[h]
-                    try:
-                        self.consensus.validate_and_insert_block(block)
-                        self.broadcast_block(block)
-                        progress = True
-                    except RuleError:
-                        pass
+            ready = [
+                (h, block)
+                for h, block in list(self.orphan_blocks.items())
+                if all(self.consensus.storage.headers.has(p) for p in block.header.direct_parents())
+            ]
+            futures = []
+            for h, block in ready:
+                del self.orphan_blocks[h]
+                futures.append((block, self.pipeline.submit(block)))
+            for block, fut in futures:
+                try:
+                    fut.result()
+                    self.broadcast_block(block)
+                    progress = True
+                except RuleError:
+                    pass
 
     def _blocks_in_topological_order(self) -> list[Block]:
         """All block bodies sorted by (blue_work, hash) — a topological order
@@ -309,7 +341,7 @@ class Node:
         gd = self.consensus.storage.ghostdag
         hashes = [
             h
-            for h in self.consensus.storage.headers._headers
+            for h in self.consensus.storage.headers.keys()
             if h != self.consensus.params.genesis.hash and self.consensus.storage.block_transactions.has(h)
         ]
         hashes.sort(key=lambda h: (gd.get_blue_work(h), h))
@@ -344,7 +376,7 @@ class Node:
         ):
             # peer's pruning point is connected within our known history
             # (header-only proof remnants without reachability do NOT count)
-            have = [h for h in self.consensus.storage.headers._headers]
+            have = [h for h in self.consensus.storage.headers.keys()]
             peer.send(MSG_REQUEST_IBD_BLOCKS, have)
             return
         # too far behind: headers-proof sync (ibd/flow.rs IbdType::DownloadHeadersProof)
@@ -383,7 +415,7 @@ class Node:
             staging.cancel()
             raise ProtocolError(f"invalid pruning proof data from peer: {e}") from e
         self._ibd = {"peer": peer, "phase": "blocks", "staging": staging}
-        have = [h for h in staging.consensus.storage.headers._headers]
+        have = list(staging.consensus.storage.headers.keys())
         peer.send(MSG_REQUEST_IBD_BLOCKS, have)
 
     def _finalize_proof_ibd(self, staging) -> None:

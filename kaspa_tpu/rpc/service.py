@@ -83,7 +83,7 @@ class RpcCoreService:
         vs = self.consensus.virtual_state
         return {
             "network": self.consensus.params.name,
-            "block_count": len(self.consensus.storage.headers._headers) - 1,
+            "block_count": len(self.consensus.storage.headers) - 1,
             "tip_hashes": sorted(h.hex() for h in self.consensus.tips),
             "virtual_parent_hashes": [h.hex() for h in vs.parents],
             "difficulty_bits": vs.bits,
@@ -151,7 +151,7 @@ class RpcCoreService:
 
     def get_blocks(self, low_hash: bytes | None = None, include_transactions: bool = False) -> list[dict]:
         """Blocks in the future of `low_hash` (inclusive), or all blocks."""
-        hashes = list(self.consensus.storage.headers._headers)
+        hashes = list(self.consensus.storage.headers.keys())
         if low_hash is not None:
             if not self.consensus.storage.headers.has(low_hash):
                 raise RpcError(f"block {low_hash.hex()} not found")
@@ -160,6 +160,9 @@ class RpcCoreService:
 
     def submit_block(self, block: Block) -> str:
         try:
+            if self.p2p_node is not None:
+                # the node path runs the concurrent pipeline + orphan/relay
+                return self.p2p_node.submit_block(block)
             status = self.consensus.validate_and_insert_block(block)
         except RuleError as e:
             raise RpcError(f"block rejected: {e}") from e
@@ -252,7 +255,7 @@ class RpcCoreService:
         sc = self.consensus.transaction_validator.sig_cache
         return {
             "uptime_seconds": time.time() - self.start_time,
-            "block_count": len(self.consensus.storage.headers._headers) - 1,
+            "block_count": len(self.consensus.storage.headers) - 1,
             "tip_count": len(self.consensus.tips),
             "mempool_size": len(self.mining.mempool),
             "virtual_daa_score": self.consensus.get_virtual_daa_score(),
@@ -289,7 +292,7 @@ class RpcCoreService:
         }
 
     def get_block_count(self) -> dict:
-        n = len(self.consensus.storage.headers._headers) - 1
+        n = len(self.consensus.storage.headers) - 1
         return {"header_count": n, "block_count": n}
 
     def get_sync_status(self) -> bool:

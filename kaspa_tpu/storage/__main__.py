@@ -32,10 +32,15 @@ PREFIX_NAMES = {
     st.PREFIX_ACCEPTANCE: "acceptance-data",
     st.PREFIX_DAA_EXCLUDED: "daa-excluded",
     st.PREFIX_UTXO_SET: "utxo-set",
+    st.PREFIX_PRUNING_UTXO: "pruning-utxo-set",
     st.PREFIX_DEPTH: "merge-depth",
     st.PREFIX_PRUNING_SAMPLES: "pruning-samples",
     st.PREFIX_REACH_MERGESET: "reachability-mergesets",
+    st.PREFIX_CHILDREN: "relations-children",
+    st.PREFIX_BLOCK_LEVELS: "block-levels",
     st.PREFIX_META: "metadata",
+    b"SM": "smt-builds",
+    b"SL": "smt-lane-tips",
 }
 
 

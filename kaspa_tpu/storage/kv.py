@@ -60,6 +60,11 @@ class _NativeEngine:
         lib.kv_len.restype = ctypes.c_uint64
         lib.kv_len.argtypes = [ctypes.c_void_p]
         lib.kv_iterate.argtypes = [ctypes.c_void_p, _ITER_CB, ctypes.c_void_p]
+        lib.kv_iterate_prefix.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int, _ITER_CB, ctypes.c_void_p,
+        ]
+        lib.kv_count_prefix.restype = ctypes.c_uint64
+        lib.kv_count_prefix.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
         lib.kv_compact.argtypes = [ctypes.c_void_p]
         self._lib = lib
         self._h = lib.kv_open(path.encode())
@@ -76,8 +81,16 @@ class _NativeEngine:
         if n < 0:
             return None
         buf = ctypes.create_string_buffer(n)
-        self._lib.kv_get(self._h, key, len(key), buf, n)
+        rc = self._lib.kv_get(self._h, key, len(key), buf, n)
+        if rc < 0:
+            # values live on disk now: a failed pread must raise, never
+            # hand zero-filled bytes to a consensus decoder
+            raise IOError(f"kv_get read failed: {rc}")
         return buf.raw
+
+    def has(self, key: bytes) -> bool:
+        # length-probe only: no disk read
+        return self._lib.kv_get(self._h, key, len(key), None, 0) >= 0
 
     def delete(self, key: bytes):
         self._lib.kv_delete(self._h, key, len(key))
@@ -103,6 +116,31 @@ class _NativeEngine:
 
         self._lib.kv_iterate(self._h, _ITER_CB(cb), None)
         return out
+
+    def items_prefix(self, prefix: bytes):
+        """Ordered (key-without-prefix, value) pairs under ``prefix``."""
+        n = len(prefix)
+        out = []
+
+        def cb(k, klen, v, vlen, _ctx):
+            out.append((ctypes.string_at(k, klen)[n:], ctypes.string_at(v, vlen) if vlen else b""))
+
+        self._lib.kv_iterate_prefix(self._h, prefix, n, 1, _ITER_CB(cb), None)
+        return out
+
+    def keys_prefix(self, prefix: bytes):
+        """Ordered keys (without the prefix) under ``prefix`` — no disk reads."""
+        n = len(prefix)
+        out = []
+
+        def cb(k, klen, _v, _vlen, _ctx):
+            out.append(ctypes.string_at(k, klen)[n:])
+
+        self._lib.kv_iterate_prefix(self._h, prefix, n, 0, _ITER_CB(cb), None)
+        return out
+
+    def count_prefix(self, prefix: bytes) -> int:
+        return self._lib.kv_count_prefix(self._h, prefix, len(prefix))
 
     def compact(self):
         rc = self._lib.kv_compact(self._h)
@@ -184,6 +222,9 @@ class _PythonEngine:
     def get(self, key):
         return self.index.get(key)
 
+    def has(self, key: bytes) -> bool:
+        return key in self.index
+
     def batch_begin(self):
         self._batch = True
 
@@ -196,6 +237,17 @@ class _PythonEngine:
 
     def items(self):
         return list(self.index.items())
+
+    def items_prefix(self, prefix: bytes):
+        n = len(prefix)
+        return sorted((k[n:], v) for k, v in self.index.items() if k.startswith(prefix))
+
+    def keys_prefix(self, prefix: bytes):
+        n = len(prefix)
+        return sorted(k[n:] for k in self.index if k.startswith(prefix))
+
+    def count_prefix(self, prefix: bytes) -> int:
+        return sum(1 for k in self.index if k.startswith(prefix))
 
     def compact(self):
         pass
@@ -252,8 +304,13 @@ class PrefixedStore:
         self.engine.delete(self.prefix + key)
 
     def items(self):
-        n = len(self.prefix)
-        return [(k[n:], v) for k, v in self.engine.items() if k.startswith(self.prefix)]
+        return self.engine.items_prefix(self.prefix)
+
+    def keys(self):
+        return self.engine.keys_prefix(self.prefix)
+
+    def count(self) -> int:
+        return self.engine.count_prefix(self.prefix)
 
 
 class _Batch:

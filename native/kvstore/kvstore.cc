@@ -3,19 +3,28 @@
 // The TPU-native counterpart of the reference's RocksDB-backed store layer
 // (database/src/: ConnBuilder/DB/CachedDbAccess/BatchDbWriter).  Design:
 // a crash-consistent append-only log with CRC-framed record batches plus an
-// in-memory hash index, compacted on demand.  Write batches are atomic: a
-// batch frame is only honored on recovery if its trailer CRC matches —
-// mirroring the WriteBatch atomicity the reference's crash-consistency
-// story depends on (SURVEY.md §5 failure detection/recovery).
+// in-memory ORDERED index of key -> (file offset, length); values live on
+// disk and are pread() on demand, so resident memory is O(keys), not
+// O(history bytes) — the engine-level half of the reference's
+// memory-bounded storage story (database/src/access.rs CachedDbAccess
+// caches bounded decodes over a disk-resident column).  The ordered index
+// additionally serves prefix scans (RocksDB prefix-iterator equivalent,
+// database/src/registry.rs prefixed columns).
+//
+// Write batches are atomic: a batch frame is only honored on recovery if
+// its trailer CRC matches — mirroring the WriteBatch atomicity the
+// reference's crash-consistency story depends on (SURVEY.md §5 failure
+// detection/recovery).
 //
 // C ABI for ctypes; all functions return 0 on success, negative on error.
 
 #include <cstdint>
 #include <unistd.h>
+#include <fcntl.h>
 #include <cstdio>
 #include <cstring>
 #include <string>
-#include <unordered_map>
+#include <map>
 #include <vector>
 
 namespace {
@@ -36,19 +45,23 @@ uint32_t crc32(const uint8_t* data, size_t len) {
   return c ^ 0xFFFFFFFFu;
 }
 
-struct Slice {
-  std::string data;
-};
-
 // log record: u8 op (0=put, 1=del), u32 klen, u32 vlen, key, value
 // batch frame: magic "KBAT", u32 payload_len, payload, u32 crc(payload)
 constexpr char kMagic[4] = {'K', 'B', 'A', 'T'};
 
+struct ValueRef {
+  uint64_t off;   // file offset of the value bytes (or offset into pending)
+  uint32_t len;
+  bool pending;   // true: value not yet flushed, read from Store::pending
+};
+
 struct Store {
   std::string path;
   FILE* log = nullptr;
-  std::unordered_map<std::string, std::string> index;
-  std::string pending;  // current batch payload under construction
+  int read_fd = -1;            // separate fd for pread (no seek races with appends)
+  uint64_t file_end = 0;       // durable end of log (next batch frame starts here)
+  std::map<std::string, ValueRef> index;
+  std::string pending;         // current batch payload under construction
   bool in_batch = false;
 
   int replay() {
@@ -56,6 +69,7 @@ struct Store {
     if (!f) return 0;  // fresh store
     std::vector<uint8_t> buf;
     char magic[4];
+    long frame_start = 0;
     long valid_end = 0;
     while (fread(magic, 1, 4, f) == 4) {
       if (memcmp(magic, kMagic, 4) != 0) break;
@@ -66,7 +80,8 @@ struct Store {
       uint32_t crc_stored;
       if (fread(&crc_stored, 4, 1, f) != 1) break;
       if (crc32(buf.data(), plen) != crc_stored) break;  // torn batch: stop
-      // apply payload
+      // apply payload; record value offsets relative to the frame payload
+      uint64_t payload_base = static_cast<uint64_t>(frame_start) + 8;
       size_t off = 0;
       bool ok = true;
       while (off < plen) {
@@ -80,7 +95,7 @@ struct Store {
         std::string key(reinterpret_cast<char*>(&buf[off]), klen);
         off += klen;
         if (op == 0) {
-          index[key] = std::string(reinterpret_cast<char*>(&buf[off]), vlen);
+          index[key] = ValueRef{payload_base + off, vlen, false};
         } else {
           index.erase(key);
         }
@@ -88,6 +103,7 @@ struct Store {
       }
       if (!ok) break;
       valid_end = ftell(f);
+      frame_start = valid_end;
     }
     fclose(f);
     // truncate any torn tail so the next append starts clean
@@ -102,9 +118,12 @@ struct Store {
         fclose(t);
       }
     }
+    file_end = static_cast<uint64_t>(valid_end);
     return 0;
   }
 
+  // appends one record to the pending payload and indexes its value as
+  // pending (readable from the buffer until flush converts it to a file ref)
   void append_record(uint8_t op, const char* key, uint32_t klen, const char* val, uint32_t vlen) {
     size_t base = pending.size();
     pending.resize(base + 9 + klen + vlen);
@@ -114,6 +133,12 @@ struct Store {
     memcpy(p + 5, &vlen, 4);
     memcpy(p + 9, key, klen);
     if (vlen) memcpy(p + 9 + klen, val, vlen);
+    std::string k(key, klen);
+    if (op == 0) {
+      index[k] = ValueRef{base + 9 + klen, vlen, true};
+    } else {
+      index.erase(k);
+    }
   }
 
   int flush_batch() {
@@ -125,8 +150,42 @@ struct Store {
     if (fwrite(pending.data(), 1, plen, log) != plen) return -10;
     if (fwrite(&crc, 4, 1, log) != 1) return -10;
     if (fflush(log) != 0) return -10;
+    // pending value refs become file refs: payload starts at file_end + 8
+    uint64_t payload_base = file_end + 8;
+    size_t off = 0;
+    while (off < plen) {
+      uint8_t op = static_cast<uint8_t>(pending[off]);
+      uint32_t klen, vlen;
+      memcpy(&klen, &pending[off + 1], 4);
+      memcpy(&vlen, &pending[off + 5], 4);
+      off += 9;
+      std::string key(&pending[off], klen);
+      off += klen;
+      if (op == 0) {
+        auto it = index.find(key);
+        // only rebind if this record is the one the index points at
+        // (a later record in the same batch wins; deletes already erased)
+        if (it != index.end() && it->second.pending && it->second.off == off) {
+          it->second = ValueRef{payload_base + off, vlen, false};
+        }
+      }
+      off += vlen;
+    }
+    file_end += 8ull + plen + 4ull;
     pending.clear();
     return 0;
+  }
+
+  // reads a value (flushed: pread from log; pending: from the buffer)
+  bool read_value(const ValueRef& ref, char* out, uint32_t cap) const {
+    uint32_t n = ref.len < cap ? ref.len : cap;
+    if (!n) return true;
+    if (ref.pending) {
+      memcpy(out, pending.data() + ref.off, n);
+      return true;
+    }
+    ssize_t got = pread(read_fd, out, n, static_cast<off_t>(ref.off));
+    return got == static_cast<ssize_t>(n);
   }
 };
 
@@ -146,19 +205,25 @@ void* kv_open(const char* path) {
     delete s;
     return nullptr;
   }
+  s->read_fd = open(path, O_RDONLY);
+  if (s->read_fd < 0) {
+    fclose(s->log);
+    delete s;
+    return nullptr;
+  }
   return s;
 }
 
 void kv_close(void* h) {
   Store* s = static_cast<Store*>(h);
   if (s->log) fclose(s->log);
+  if (s->read_fd >= 0) close(s->read_fd);
   delete s;
 }
 
 int kv_put(void* h, const char* key, uint32_t klen, const char* val, uint32_t vlen) {
   Store* s = static_cast<Store*>(h);
   s->append_record(0, key, klen, val, vlen);
-  s->index[std::string(key, klen)] = std::string(val, vlen);
   if (!s->in_batch) return s->flush_batch();
   return 0;
 }
@@ -166,7 +231,6 @@ int kv_put(void* h, const char* key, uint32_t klen, const char* val, uint32_t vl
 int kv_delete(void* h, const char* key, uint32_t klen) {
   Store* s = static_cast<Store*>(h);
   s->append_record(1, key, klen, nullptr, 0);
-  s->index.erase(std::string(key, klen));
   if (!s->in_batch) return s->flush_batch();
   return 0;
 }
@@ -176,9 +240,10 @@ int64_t kv_get(void* h, const char* key, uint32_t klen, char* out, uint32_t cap)
   Store* s = static_cast<Store*>(h);
   auto it = s->index.find(std::string(key, klen));
   if (it == s->index.end()) return -1;
-  uint32_t n = static_cast<uint32_t>(it->second.size());
-  if (out && cap) memcpy(out, it->second.data(), n < cap ? n : cap);
-  return n;
+  if (out && cap) {
+    if (!s->read_value(it->second, out, cap)) return -2;
+  }
+  return it->second.len;
 }
 
 int kv_batch_begin(void* h) {
@@ -197,15 +262,48 @@ int kv_batch_commit(void* h) {
 
 uint64_t kv_len(void* h) { return static_cast<Store*>(h)->index.size(); }
 
-// iteration: caller provides a callback
+// iteration: caller provides a callback; values are read from disk per entry
 typedef void (*kv_iter_cb)(const char* key, uint32_t klen, const char* val, uint32_t vlen, void* ctx);
 
 void kv_iterate(void* h, kv_iter_cb cb, void* ctx) {
   Store* s = static_cast<Store*>(h);
+  std::string buf;
   for (const auto& kv : s->index) {
-    cb(kv.first.data(), static_cast<uint32_t>(kv.first.size()), kv.second.data(),
-       static_cast<uint32_t>(kv.second.size()), ctx);
+    buf.resize(kv.second.len);
+    if (kv.second.len && !s->read_value(kv.second, &buf[0], kv.second.len)) continue;
+    cb(kv.first.data(), static_cast<uint32_t>(kv.first.size()), buf.data(), kv.second.len, ctx);
   }
+}
+
+// ordered prefix scan over [prefix, prefix+1): the engine-side primitive
+// behind prefixed-store iteration.  want_values=0 passes vlen but a null
+// value pointer — a keys-only scan touches no disk at all.
+void kv_iterate_prefix(void* h, const char* prefix, uint32_t plen, int want_values, kv_iter_cb cb,
+                       void* ctx) {
+  Store* s = static_cast<Store*>(h);
+  std::string pfx(prefix, plen);
+  std::string buf;
+  for (auto it = s->index.lower_bound(pfx); it != s->index.end(); ++it) {
+    if (it->first.compare(0, plen, pfx) != 0) break;
+    if (want_values) {
+      buf.resize(it->second.len);
+      if (it->second.len && !s->read_value(it->second, &buf[0], it->second.len)) continue;
+      cb(it->first.data(), static_cast<uint32_t>(it->first.size()), buf.data(), it->second.len, ctx);
+    } else {
+      cb(it->first.data(), static_cast<uint32_t>(it->first.size()), nullptr, it->second.len, ctx);
+    }
+  }
+}
+
+uint64_t kv_count_prefix(void* h, const char* prefix, uint32_t plen) {
+  Store* s = static_cast<Store*>(h);
+  std::string pfx(prefix, plen);
+  uint64_t n = 0;
+  for (auto it = s->index.lower_bound(pfx); it != s->index.end(); ++it) {
+    if (it->first.compare(0, plen, pfx) != 0) break;
+    n++;
+  }
+  return n;
 }
 
 // compaction: rewrite the log with only live records (one atomic batch)
@@ -213,14 +311,20 @@ int kv_compact(void* h) {
   Store* s = static_cast<Store*>(h);
   if (s->in_batch) return -22;
   std::string tmp = s->path + ".compact";
-  FILE* old = s->log;
   FILE* nf = fopen(tmp.c_str(), "wb");
   if (!nf) return -30;
   Store out;
   out.log = nf;
+  std::string buf;
   for (const auto& kv : s->index) {
-    out.append_record(0, kv.first.data(), static_cast<uint32_t>(kv.first.size()), kv.second.data(),
-                      static_cast<uint32_t>(kv.second.size()));
+    buf.resize(kv.second.len);
+    if (kv.second.len && !s->read_value(kv.second, &buf[0], kv.second.len)) {
+      fclose(nf);
+      remove(tmp.c_str());
+      return -34;
+    }
+    out.append_record(0, kv.first.data(), static_cast<uint32_t>(kv.first.size()), buf.data(),
+                      kv.second.len);
   }
   if (out.flush_batch() != 0) {
     fclose(nf);
@@ -228,10 +332,31 @@ int kv_compact(void* h) {
     return -31;
   }
   fclose(nf);
-  fclose(old);
-  if (rename(tmp.c_str(), s->path.c_str()) != 0) return -32;
-  s->log = fopen(s->path.c_str(), "ab");
-  return s->log ? 0 : -33;
+  // open the compacted file's handles FIRST: the store's live handles are
+  // only swapped once every step succeeded, so any failure leaves the store
+  // fully usable on the old log
+  FILE* new_log = fopen(tmp.c_str(), "ab");
+  int new_fd = open(tmp.c_str(), O_RDONLY);
+  if (!new_log || new_fd < 0) {
+    if (new_log) fclose(new_log);
+    if (new_fd >= 0) close(new_fd);
+    remove(tmp.c_str());
+    return -33;
+  }
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+    fclose(new_log);
+    close(new_fd);
+    remove(tmp.c_str());
+    return -32;
+  }
+  fclose(s->log);
+  close(s->read_fd);
+  s->log = new_log;
+  s->read_fd = new_fd;
+  // rebind index to the compacted file's offsets
+  s->index = std::move(out.index);
+  s->file_end = out.file_end;
+  return 0;
 }
 
 }  // extern "C"

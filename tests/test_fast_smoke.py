@@ -1,0 +1,117 @@
+"""Fast-lane smoke slices of the slow suites (kernel bit-exactness + golden
+replay prefixes).
+
+The exhaustive sweeps stay slow-marked (test_pallas_ladder, test_secp_verify,
+test_goref_replay); this module keeps the default `-m "not slow"` lane
+executing at least one assertion from each risk area so a kernel regression
+— e.g. in the addition-chain inverse or the symmetric squaring convolution —
+can never ship invisibly.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+
+from kaspa_tpu.ops.secp256k1 import ladder_pallas as lp
+
+W8 = lp.W8
+P = lp.SECP_P
+
+
+def _pack(vals):
+    return np.stack([lp.int_to_limbs8(v) for v in vals], axis=1).astype(np.int32)
+
+
+def _unpack(arr):
+    out = []
+    a = np.asarray(arr)
+    for j in range(a.shape[1]):
+        out.append(sum(int(a[i, j]) << (8 * i) for i in range(a.shape[0])))
+    return out
+
+
+def test_field_mul_and_sqr_match_oracle():
+    """_conv/_conv_sqr + fold/canon against python bigints (8 lanes)."""
+    rng = random.Random(42)
+    xs = [rng.randrange(P) for _ in range(8)]
+    ys = [rng.randrange(P) for _ in range(8)]
+    xs[0], ys[0] = P - 1, P - 1  # boundary
+    xa, ya = _pack(xs), _pack(ys)
+    m8 = lp._m_limbs8(P)
+    mul = lambda a, b: lp._canon(lp._mul(a, b), m8)
+    sqr = lambda a: lp._canon(lp._sqr(a), m8)
+    assert _unpack(mul(xa, ya)) == [(x * y) % P for x, y in zip(xs, ys)]
+    assert _unpack(sqr(xa)) == [(x * x) % P for x in xs]
+    # _conv_sqr must agree with the generic convolution it replaces
+    cs = lambda a: lp._canon(lp._fold(lp._C8_P, lp._carry2(lp._conv_sqr(a))), m8)
+    cc = lambda a: lp._canon(lp._fold(lp._C8_P, lp._carry2(lp._conv(a, a))), m8)
+    assert _unpack(cs(xa)) == _unpack(cc(xa))
+
+
+def test_field_inverse_addition_chain_matches_oracle():
+    """The 255S+15M Fermat chain (`_inv`) bit-for-bit vs pow(x, p-2, p)."""
+    rng = random.Random(7)
+    xs = [rng.randrange(1, P) for _ in range(8)]
+    xs[0] = 1
+    xs[1] = P - 1
+    xa = _pack(xs)
+    m8 = lp._m_limbs8(P)
+    inv = lambda a: lp._canon(lp._inv(a), m8)
+    got = _unpack(inv(xa))
+    assert got == [pow(x, P - 2, P) for x in xs]
+    for x, g in zip(xs, got):
+        assert (x * g) % P == 1
+
+
+def test_point_ops_match_oracle():
+    """Projective double/add (Renes-Costello-Batina) vs eclib on 4 lanes."""
+    from kaspa_tpu.crypto import eclib
+
+    rng = random.Random(13)
+    pts = [eclib.point_mul(eclib.G, rng.randrange(1, eclib.N)) for _ in range(4)]
+    qts = [eclib.point_mul(eclib.G, rng.randrange(1, eclib.N)) for _ in range(4)]
+    m8 = lp._m_limbs8(P)
+
+    def aff(p3):
+        x, y, z = p3
+        zi = lp._inv(z)
+        return lp._canon(lp._mul(x, zi), m8), lp._canon(lp._mul(y, zi), m8)
+
+    px, py = _pack([p[0] for p in pts]), _pack([p[1] for p in pts])
+    qx, qy = _pack([q[0] for q in qts]), _pack([q[1] for q in qts])
+    one = _pack([1] * 4)
+
+    dbl = lambda x, y, z: aff(lp._pt_double((x, y, z)))
+    add = lambda x, y, z, qxx, qyy: aff(lp._pt_add_mixed((x, y, z), (qxx, qyy)))
+
+    gx, gy = dbl(px, py, one)
+    expect = [eclib.point_add(p, p) for p in pts]
+    assert _unpack(gx) == [e[0] for e in expect]
+    assert _unpack(gy) == [e[1] for e in expect]
+
+    gx, gy = add(px, py, one, qx, qy)
+    expect = [eclib.point_add(p, q) for p, q in zip(pts, qts)]
+    assert _unpack(gx) == [e[0] for e in expect]
+    assert _unpack(gy) == [e[1] for e in expect]
+
+
+DATA = "/root/reference/testing/integration/testdata/dags_for_json_tests"
+TX_DAG = os.path.join(DATA, "goref-1060-tx-265-blocks", "blocks.json.gz")
+
+
+def test_goref_prefix_replay_smoke():
+    """40-block golden prefix with real transactions: header hashes, GHOSTDAG,
+    difficulty, merkle, muhash, signature checks all bit-exact (the full 265
+    replay stays in the slow lane)."""
+    import pytest
+
+    if not os.path.exists(TX_DAG):
+        pytest.skip("reference testdata not mounted")
+    from kaspa_tpu.sim.goref import replay_goref
+
+    consensus = replay_goref(TX_DAG, limit=40)
+    assert consensus.get_virtual_daa_score() == 40
+    assert consensus.storage.statuses.get(consensus.sink()) == "utxo_valid"

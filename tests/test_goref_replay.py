@@ -54,7 +54,7 @@ def test_goref_custom_pruning_depth_with_live_pruning():
     # the pruning point moved and history was deleted
     assert pp.pruning_point != g
     assert len(pp.past_pruning_points) >= 2
-    assert len(consensus.storage.headers._headers) < 700
+    assert len(consensus.storage.headers) < 700
     assert not consensus.storage.block_transactions.has(g)
     # the maintained pruning-point UTXO set matches the header commitment
     assert pp.check_pruning_utxo_commitment()

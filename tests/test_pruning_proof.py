@@ -97,7 +97,7 @@ def test_trusted_bootstrap_and_catchup(donor):
     reach = cons.reachability
     post = [
         h
-        for h in cons.storage.headers._headers
+        for h in cons.storage.headers.keys()
         if h != pp and reach.has(h) and reach.is_dag_ancestor_of(pp, h)
     ]
     post.sort(key=lambda h: (cons.storage.ghostdag.get_blue_work(h), h))

@@ -93,7 +93,7 @@ def test_activation_divergence_at_exact_daa_score():
             merkle.calc_merkle_root(c.acceptance_data[blk.hash]),
         )
         if h.daa_score < activation:
-            assert h.version == c.params.genesis.version
+            assert h.version == 1  # constants.rs BLOCK_VERSION pre-fork
             assert h.accepted_id_merkle_root == kip15
         else:
             assert h.version == 2
