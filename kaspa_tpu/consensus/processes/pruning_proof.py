@@ -25,12 +25,20 @@ pruning-point UTXO set) — the same shape consensus._load_state restores
 after a local prune, so importing is loading a donor's post-prune state,
 gated by the proof and the UTXO-set muhash commitment.
 
-Deviations from the reference, by design: the donor serves proof levels
+Validation re-runs GHOSTDAG per level: every level's sub-DAG is recolored
+from scratch over scratch stores (validate.rs ProofContext::from_proof),
+selected tips are derived from RECOMPUTED blue works, and the adopt
+decision compares recomputed work beyond the challenger/defender common
+ancestor (validate.rs compare_proofs_inner) — claimed header blue fields
+only order the level lists and are cross-checked for monotonicity, so
+forged blue fields cannot buy adoption.
+
+Deviation from the reference, by design: the donor serves proof levels
 from its retained keep-set (the reference maintains a dedicated per-level
-proof store); level ghostdag re-validation trusts header blue fields once
-per-level PoW membership is proven (the reference re-runs ghostdag per
-level).  Both tighten the trust boundary to headers whose PoW was checked,
-which is the same boundary the reference's m-depth argument rests on.
+proof store), and pruning-period relay work is not folded into the
+compare (the in-flight relay block's blue work is verified after sync
+here, so both sides contribute zero at compare time — ties keep favoring
+the defender exactly as in compare_proofs_inner).
 """
 
 from __future__ import annotations
@@ -41,6 +49,75 @@ from dataclasses import dataclass, field
 from kaspa_tpu.consensus.stores import GhostdagData
 from kaspa_tpu.consensus.utxo import UtxoCollection
 from kaspa_tpu.crypto.muhash import MuHash
+
+
+class _MapGd:
+    """Scratch per-level ghostdag store (validate.rs temp DbGhostdagStore)."""
+
+    def __init__(self):
+        self.d: dict[bytes, GhostdagData] = {}
+
+    def insert(self, h, gd):
+        self.d[h] = gd
+
+    def get(self, h):
+        return self.d[h]
+
+    def has(self, h):
+        return h in self.d
+
+    def get_blue_work(self, h):
+        return self.d[h].blue_work
+
+    def get_blue_score(self, h):
+        return self.d[h].blue_score
+
+    def get_selected_parent(self, h):
+        return self.d[h].selected_parent
+
+    def get_blues_anticone_sizes(self, h):
+        return self.d[h].blues_anticone_sizes
+
+    def block_at_depth(self, high: bytes, depth: int) -> bytes:
+        """pruning_proof/mod.rs:438 GhostdagReaderExt::block_at_depth."""
+        from kaspa_tpu.consensus.reachability import ORIGIN
+
+        high_bs = self.get_blue_score(high)
+        current = high
+        while self.get_blue_score(current) + depth >= high_bs:
+            sp = self.get_selected_parent(current)
+            if sp == ORIGIN:
+                break
+            current = sp
+        return current
+
+
+class _MapRelations:
+    def __init__(self):
+        self.d: dict[bytes, list[bytes]] = {}
+
+    def insert(self, h, parents):
+        self.d[h] = list(parents)
+
+    def get_parents(self, h):
+        return self.d[h]
+
+    def has(self, h):
+        return h in self.d
+
+
+class _MapHeaders:
+    def __init__(self):
+        self.d: dict[bytes, object] = {}
+
+    def insert(self, hdr):
+        self.d[hdr.hash] = hdr
+
+    def get(self, h):
+        return self.d[h]
+
+    def get_bits(self, h):
+        return self.d[h].bits
 
 
 class ProofError(Exception):
@@ -67,6 +144,45 @@ class TrustedData:
     pp_windows: dict = field(default_factory=dict)
 
 
+@dataclass
+class _ProofLevelContext:
+    """validate.rs ProofLevelContext: one level's recomputed view."""
+
+    gd: _MapGd
+    selected_tip: bytes
+
+    def blue_score(self) -> int:
+        return self.gd.get_blue_score(self.selected_tip)
+
+    def blue_work_diff(self, ancestor: bytes) -> int:
+        return max(0, self.gd.get_blue_work(self.selected_tip) - self.gd.get_blue_work(ancestor))
+
+    @staticmethod
+    def find_common_ancestor(challenger: "_ProofLevelContext", defender: "_ProofLevelContext"):
+        from kaspa_tpu.consensus.reachability import ORIGIN
+
+        current = challenger.selected_tip
+        while True:
+            if defender.gd.has(current) and current != ORIGIN:
+                return current
+            current = challenger.gd.get_selected_parent(current)
+            if current == ORIGIN:
+                return None
+
+
+@dataclass
+class _ProofContext:
+    """validate.rs ProofContext: recomputed per-level ghostdag + tips."""
+
+    pp_header: object
+    pp_level: int
+    gd_by_level: dict = field(default_factory=dict)
+    tip_by_level: dict = field(default_factory=dict)
+
+    def level(self, level: int) -> _ProofLevelContext:
+        return _ProofLevelContext(self.gd_by_level[level], self.tip_by_level[level])
+
+
 class PruningProofManager:
     def __init__(self, consensus):
         self.c = consensus
@@ -83,6 +199,8 @@ class PruningProofManager:
         m = self.params.pruning_proof_m
         pm = c.parents_manager
         genesis = self.params.genesis.hash
+        pp_header = c.storage.headers.get(pp)
+        pp_level = c.storage.headers.get_block_level(pp)  # memoized + persisted
         levels: list[list] = []
         for level in range(self.params.max_block_level + 1):
             # max-heap BFS by blue work through level-L parents, top 2m
@@ -97,12 +215,34 @@ class PruningProofManager:
                 hdr = c.storage.headers.get(h)
                 heapq.heappush(heap, (-hdr.blue_work, h, hdr))
 
-            push(pp)
-            while heap and len(collected) < 2 * m:
-                _, h, hdr = heapq.heappop(heap)
-                collected[h] = hdr
-                for parent in pm.parents_at_level(hdr, level):
+            # the pp belongs to levels up to its own PoW level; above that
+            # the level sub-DAG hangs off its level parents (the validator
+            # requires the level tip to BE pp at levels <= pp_level and to
+            # be a level parent of pp above, validate.rs:266-276)
+            if level <= pp_level:
+                push(pp)
+            else:
+                for parent in pm.parents_at_level(pp_header, level):
                     push(parent)
+            # collect until the RECOMPUTED level blue depth reaches 2m (or
+            # the level bottoms out): build.rs:410 gates root candidacy on
+            # current_level_score >= 2m, not on a raw header count — a
+            # count-based slice can fall short when the level sub-DAG is
+            # chain-like (score = count - 1) or carries reds
+            target = 2 * m
+            while heap:
+                while heap and len(collected) < target:
+                    _, h, hdr = heapq.heappop(heap)
+                    collected[h] = hdr
+                    for parent in pm.parents_at_level(hdr, level):
+                        push(parent)
+                level_sorted = sorted(collected.values(), key=lambda x: (x.blue_work, x.hash))
+                if genesis in collected:
+                    break
+                _gd, tip = self._recolor_level(level_sorted, level)
+                if tip is not None and _gd.get_blue_score(tip) >= 2 * m:
+                    break
+                target += m  # extend the slice and re-measure
             level_headers = sorted(collected.values(), key=lambda x: (x.blue_work, x.hash))
             levels.append(level_headers)
             if {h.hash for h in level_headers} <= {pp, genesis}:
@@ -113,71 +253,238 @@ class PruningProofManager:
     # validate (importer)
     # ------------------------------------------------------------------
 
-    def proof_level_works(self, proof: list[list]) -> list[int]:
-        """Per-level Σ calc_work(bits) — work *derived* from the difficulty
-        targets of (PoW-checked) headers, never from claimed blue_work."""
-        from kaspa_tpu.consensus.difficulty import calc_work
+    def _recolor_level(self, headers_sorted: list, level: int):
+        """Non-strict per-level GHOSTDAG recompute over a blue-work-ascending
+        header list; returns (gd_store, recomputed_selected_tip).  Used by
+        the builder to measure realized level blue depth (build.rs
+        populate_level_proof_ghostdag_data) — the validator runs its own
+        strict variant with full rejection semantics."""
+        from kaspa_tpu.consensus.difficulty import level_work
+        from kaspa_tpu.consensus.processes.ghostdag import GhostdagManager
+        from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
 
-        return [sum(calc_work(h.bits) for h in level) for level in proof]
+        params = self.params
+        pm = self.c.parents_manager
+        gd_store = _MapGd()
+        relations = _MapRelations()
+        hstore = _MapHeaders()
+        reach = ReachabilityService()
+        manager = GhostdagManager(
+            params.genesis.hash, params.ghostdag_k, gd_store, relations, hstore, reach,
+            level_work=level_work(level, params.max_block_level),
+        )
+        gd_store.insert(ORIGIN, manager.genesis_ghostdag_data())
+        relations.insert(ORIGIN, [])
+        tip = None
+        for h in headers_sorted:
+            hstore.insert(h)
+            parents = [p for p in pm.parents_at_level(h, level) if gd_store.has(p) and p != ORIGIN]
+            parents = parents or [ORIGIN]
+            relations.insert(h.hash, parents)
+            gd = manager.ghostdag(parents)
+            gd_store.insert(h.hash, gd)
+            reach.add_block(
+                h.hash,
+                gd.selected_parent,
+                [x for x in gd.unordered_mergeset_without_selected_parent() if reach.has(x)],
+                parents,
+            )
+            tip = h.hash if tip is None else manager.find_selected_parent([tip, h.hash])
+        return gd_store, tip
 
-    def validate_proof(self, proof: list[list], current_proof_works: list[int]):
-        """Structural + PoW validation and the adopt decision.
+    def build_proof_context(self, proof: list[list]) -> "_ProofContext":
+        """Re-run GHOSTDAG over every proof level (validate.rs from_proof).
 
-        Adoption requires some level where the candidate proof's *derived*
-        work (Σ calc_work(bits) of headers whose PoW was individually
-        checked at that level) exceeds the node's own proof's derived work —
-        the validate.rs per-level comparison.  Claimed blue_work fields are
-        used for ordering only; they cannot buy adoption, so fabricating a
-        winning proof costs real level-qualified PoW.
-        Returns the proven pruning-point header or raises ProofError.
+        For each level, descending: scratch relations/ghostdag/reachability
+        stores are populated header by header (blue-work-ascending), the
+        coloring is recomputed from the level sub-DAG alone, and the level
+        selected tip is derived from RECOMPUTED blue works.  Structural
+        rejections mirror the reference error-for-error: wrong block level,
+        PoW failure, unknown parents beyond the first root, claimed-blue-work
+        inconsistency with parents, duplicate header at level, missing
+        block-at-depth-m link from the next level, tip not anchored to the
+        pruning point, tip not last in the level list, tip blue score below
+        2m on a level that does not reach genesis.
         """
+        from kaspa_tpu.consensus.difficulty import compact_to_target, level_work
+        from kaspa_tpu.consensus.processes.ghostdag import GhostdagManager
+        from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
+
         if not proof or not proof[0]:
             raise ProofError("empty proof")
-        m = self.params.pruning_proof_m
-        genesis = self.params.genesis.hash
-        pp_header = max(proof[0], key=lambda h: (h.blue_work, h.hash))
+        params = self.params
+        m = params.pruning_proof_m
+        genesis = params.genesis.hash
         pm = self.c.parents_manager
-        for level, headers in enumerate(proof):
+        max_level = params.max_block_level
+        level_memo: dict[bytes, int] = {}
+
+        # our build_proof truncates once a level bottoms out at {pp, genesis};
+        # extend virtually: deeper levels reuse the last list filtered to
+        # headers whose PoW actually reaches that level ("validator extends")
+        def level_list(level: int) -> list:
+            if level < len(proof):
+                return proof[level]
+            last = proof[-1]
+            return [h for h in last if self._header_level(h, level_memo) >= level]
+
+        pp_header = proof[0][-1]  # sortedness is enforced below
+        pp_level = self._header_level(pp_header, level_memo)
+        pp_level_parents = {
+            level: set(pm.parents_at_level(pp_header, level)) for level in range(max_level + 1)
+        }
+
+        ctx = _ProofContext(pp_header=pp_header, pp_level=pp_level)
+        selected_tip_by_level: dict[int, bytes] = {}
+        for level in range(max_level, -1, -1):
+            headers = level_list(level)
             if not headers:
                 raise ProofError(f"level {level} is empty")
-            index = {h.hash: h for h in headers}
-            in_level = set(index)
-            reaches_genesis = genesis in in_level
-            if not reaches_genesis and len(headers) < m:
-                raise ProofError(
-                    f"level {level} has {len(headers)} headers < m={m} and does not reach genesis"
-                )
-            prev_work = -1
-            for h in headers:
-                if h.blue_work < prev_work:
-                    raise ProofError(f"level {level} not blue-work sorted")
-                prev_work = h.blue_work
-                if h.hash == genesis and not h.direct_parents():
-                    continue
-                if not self.params.skip_proof_of_work:
-                    from kaspa_tpu.crypto.powhash import calc_block_pow_hash
-                    from kaspa_tpu.consensus.difficulty import compact_to_target
+            gd_store = _MapGd()
+            relations = _MapRelations()
+            hstore = _MapHeaders()
+            reach = ReachabilityService()
+            manager = GhostdagManager(
+                genesis, params.ghostdag_k, gd_store, relations, hstore, reach,
+                level_work=level_work(level, max_level),
+            )
+            gd_store.insert(ORIGIN, manager.genesis_ghostdag_data())
+            relations.insert(ORIGIN, [])
 
-                    pow_value = int.from_bytes(calc_block_pow_hash(h), "little")
-                    if pow_value > compact_to_target(h.bits):
-                        raise ProofError(f"level {level} header {h.hash.hex()} fails PoW")
-                    hdr_level = max(0, self.params.max_block_level - pow_value.bit_length())
-                    if hdr_level < level:
+            selected_tip = headers[0].hash
+            prev_work = (-1, b"")
+            for i, h in enumerate(headers):
+                if (h.blue_work, h.hash) < prev_work:
+                    raise ProofError(f"level {level} not blue-work sorted")
+                prev_work = (h.blue_work, h.hash)
+                if not params.skip_proof_of_work and not (h.hash == genesis and not h.direct_parents()):
+                    if h.hash not in level_memo:
+                        from kaspa_tpu.crypto.powhash import calc_block_pow_hash
+
+                        pow_value = int.from_bytes(calc_block_pow_hash(h), "little")
+                        if pow_value > compact_to_target(h.bits):
+                            raise ProofError(f"level {level} header {h.hash.hex()} fails PoW")
+                        level_memo[h.hash] = max(0, max_level - pow_value.bit_length())
+                    if level_memo[h.hash] < level:
                         raise ProofError(
-                            f"header {h.hash.hex()} presented at level {level} but PoW only reaches {hdr_level}"
+                            f"header {h.hash.hex()} presented at level {level} but PoW does not reach it"
                         )
-                # parent closure: every in-proof level-parent must sort before us
-                for parent in pm.parents_at_level(h, level):
-                    ph = index.get(parent)
-                    if ph is not None and (ph.blue_work, ph.hash) >= (h.blue_work, h.hash):
-                        raise ProofError(f"level {level} parent ordering violated")
-        candidate_works = self.proof_level_works(proof)
-        if not any(
-            cand > (current_proof_works[i] if i < len(current_proof_works) else 0)
-            for i, cand in enumerate(candidate_works)
-        ):
-            raise ProofError("candidate proof does not exceed the current proof's derived work at any level")
-        return pp_header
+                if relations.has(h.hash):
+                    raise ProofError(f"duplicate header {h.hash.hex()} at level {level}")
+                hstore.insert(h)
+                # parents filtered to those already processed at this level
+                parents = [p for p in pm.parents_at_level(h, level) if gd_store.has(p) and p != ORIGIN]
+                if not parents and i != 0:
+                    raise ProofError(f"level {level} header {h.hash.hex()} has no known parents")
+                for p in parents:
+                    if hstore.get(p).blue_work >= h.blue_work:
+                        raise ProofError(f"level {level} claimed blue work inconsistent at {h.hash.hex()}")
+                parents = parents or [ORIGIN]
+                relations.insert(h.hash, parents)
+                gd = manager.ghostdag(parents)
+                gd_store.insert(h.hash, gd)
+                reach_mergeset = [
+                    x for x in gd.unordered_mergeset_without_selected_parent() if reach.has(x)
+                ]
+                reach.add_block(h.hash, gd.selected_parent, reach_mergeset, parents)
+                selected_tip = manager.find_selected_parent([selected_tip, h.hash])
+
+            # cross-level link: block at depth m from the next level's tip
+            # must appear in this level (validate.rs:256-263).  When the next
+            # level bottoms out at its own root (tiny DAGs / keep-set-served
+            # levels), the walk degenerates to that root, which legitimately
+            # predates this level's 2m window — only a non-root anchor
+            # missing from this level indicates detached levels.
+            if level < max_level:
+                next_headers = level_list(level + 1)
+                anchor = ctx.gd_by_level[level + 1].block_at_depth(selected_tip_by_level[level + 1], m)
+                if (
+                    anchor != ORIGIN
+                    and next_headers
+                    and anchor != next_headers[0].hash
+                    and not relations.has(anchor)
+                ):
+                    raise ProofError(f"level {level} misses block at depth m from level {level + 1}")
+            # tip anchoring to the pruning point (validate.rs:266-276)
+            if level <= pp_level:
+                if selected_tip != pp_header.hash:
+                    raise ProofError(f"level {level} selected tip is not the pruning point")
+            elif selected_tip not in pp_level_parents[level]:
+                raise ProofError(f"level {level} selected tip is not a level parent of the pruning point")
+            if headers[-1].hash != selected_tip:
+                raise ProofError(f"level {level} claimed tip is not the recomputed selected tip")
+            tip_bs = gd_store.get_blue_score(selected_tip)
+            if headers[0].hash != genesis and tip_bs < 2 * m:
+                raise ProofError(f"level {level} tip blue score {tip_bs} < 2m")
+
+            selected_tip_by_level[level] = selected_tip
+            ctx.gd_by_level[level] = gd_store
+            ctx.tip_by_level[level] = selected_tip
+        return ctx
+
+    def _header_level(self, h, memo: dict | None = None) -> int:
+        """pow/src/lib.rs calc_block_level — real even under skip-PoW (only
+        the difficulty-threshold check is waived, not the level geometry).
+        ``memo`` caches by hash: the heavy-hash is milliseconds of pure
+        python and proof validation touches each header at many levels."""
+        if memo is not None and h.hash in memo:
+            return memo[h.hash]
+        if not h.direct_parents():
+            lvl = self.params.max_block_level
+        else:
+            from kaspa_tpu.crypto.powhash import calc_block_pow_hash
+
+            pow_value = int.from_bytes(calc_block_pow_hash(h), "little")
+            lvl = max(0, self.params.max_block_level - pow_value.bit_length())
+        if memo is not None:
+            memo[h.hash] = lvl
+        return lvl
+
+    def validate_proof(self, proof: list[list], defender_proof: list[list] | None = None):
+        """Full per-level GHOSTDAG validation + the adopt decision.
+
+        Builds challenger and defender contexts with recomputed coloring and
+        compares them level-by-level beyond their common ancestor
+        (validate.rs compare_proofs_inner): the challenger wins only if, at
+        some ≥2m level with a common ancestor, its recomputed blue-work gain
+        beyond that ancestor strictly exceeds the defender's; with no shared
+        blocks anywhere, only if it fills a ≥2m level the defender lacks (or
+        the defender still sits at genesis).  Ties favor the defender.
+        Returns the proven pruning-point header or raises ProofError.
+        """
+        challenger = self.build_proof_context(proof)
+        if defender_proof is None:
+            defender_proof = self.build_proof()
+        m = self.params.pruning_proof_m
+        genesis = self.params.genesis.hash
+        defender_trivial = (
+            len(defender_proof) == 1 and {h.hash for h in defender_proof[0]} <= {genesis}
+        )
+        if defender_trivial:
+            return challenger.pp_header  # fresh node: any valid proof adopts
+        defender = self.build_proof_context(defender_proof)
+
+        for level in range(self.params.max_block_level + 1):
+            ch = challenger.level(level)
+            de = defender.level(level)
+            if ch.blue_score() < 2 * m:
+                continue
+            ancestor = _ProofLevelContext.find_common_ancestor(ch, de)
+            if ancestor is not None:
+                if de.blue_work_diff(ancestor) >= ch.blue_work_diff(ancestor):
+                    raise ProofError("candidate proof does not exceed the current proof's recomputed work")
+                return challenger.pp_header
+
+        if defender.pp_header.hash == genesis:
+            return challenger.pp_header
+        # no shared blocks at any level: the challenger must fill a >=2m
+        # level the defender does not (validate.rs:409-419)
+        for level in range(self.params.max_block_level, -1, -1):
+            if challenger.level(level).blue_score() < 2 * m:
+                continue
+            if defender.level(level).blue_score() < 2 * m:
+                return challenger.pp_header
+        raise ProofError("candidate proof shares no blocks with ours and fills no level we lack")
 
     # ------------------------------------------------------------------
     # trusted data (donor)
@@ -254,14 +561,14 @@ class PruningProofManager:
 
     def import_pruning_data(
         self, proof: list[list], trusted: TrustedData, utxo_set: UtxoCollection,
-        current_proof_works: list[int] | None = None,
+        defender_proof: list[list] | None = None,
     ) -> None:
         """Bootstrap this (fresh) consensus from proof + trusted snapshot.
 
-        `current_proof_works`: the derived per-level works of the proof the
-        node currently holds (the ACTIVE consensus when importing into
-        staging) — the candidate must beat them at some level.  Defaults to
-        this consensus's own proof.
+        `defender_proof`: the proof the node currently holds (the ACTIVE
+        consensus when importing into staging) — the candidate must beat
+        its recomputed work (see validate_proof).  Defaults to this
+        consensus's own proof.
 
         Mirrors consensus._load_state's rebuild discipline: stores seeded
         from the snapshot, reachability re-derived in (blue_work, hash)
@@ -271,9 +578,7 @@ class PruningProofManager:
         """
         c = self.c
         pp = trusted.pruning_point
-        if current_proof_works is None:
-            current_proof_works = self.proof_level_works(self.build_proof())
-        pp_header = self.validate_proof(proof, current_proof_works)
+        pp_header = self.validate_proof(proof, defender_proof)
         if pp_header.hash != pp:
             raise ProofError("trusted data pruning point does not match the proven header")
         # UTXO commitment: muhash over the supplied set must equal the header's

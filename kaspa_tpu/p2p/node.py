@@ -408,7 +408,7 @@ class Node:
                 self._ibd["proof"],
                 self._ibd["trusted"],
                 UtxoCollection(self._ibd["utxo"]),
-                current_proof_works=active_ppm.proof_level_works(active_ppm.build_proof()),
+                defender_proof=active_ppm.build_proof(),
             )
         except ProofError as e:
             self._ibd = {}

@@ -135,11 +135,12 @@ def load_goref(path: str):
     return params, blocks
 
 
-def replay_goref(path: str, limit: int | None = None) -> Consensus:
+def replay_goref(path: str, limit: int | None = None, db=None, cache_policy=None) -> Consensus:
     """Replay blocks[1:] (genesis inserted by construction); raises on any
-    consensus divergence from the golden data."""
+    consensus divergence from the golden data.  ``db``/``cache_policy``
+    attach persistence with bounded store caches (memory-bounded replay)."""
     params, blocks = load_goref(path)
-    consensus = Consensus(params)
+    consensus = Consensus(params, db=db, cache_policy=cache_policy)
     for i, block in enumerate(blocks[1:], start=1):
         if limit is not None and i > limit:
             break

@@ -115,3 +115,41 @@ def test_goref_prefix_replay_smoke():
     consensus = replay_goref(TX_DAG, limit=40)
     assert consensus.get_virtual_daa_score() == 40
     assert consensus.storage.statuses.get(consensus.sink()) == "utxo_valid"
+
+
+def test_goref_replay_bounded_caches_and_resume(tmp_path):
+    """Memory-bounded replay: a DB-backed golden replay whose history far
+    exceeds the cache budgets keeps every decode cache at/under budget, and
+    a restart resumes from the DB with O(tips) loading to the same sink
+    (access.rs/cache_policy_builder.rs discipline)."""
+    import pytest
+
+    if not os.path.exists(TX_DAG):
+        pytest.skip("reference testdata not mounted")
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.consensus.stores import CachePolicy
+    from kaspa_tpu.sim.goref import load_goref, replay_goref
+    from kaspa_tpu.storage.kv import KvStore
+
+    # budgets far below the 120-block replay: every store must evict
+    policy = CachePolicy().scaled(0)  # floor of 16 entries per store
+    db = KvStore(str(tmp_path / "goref.db"))
+    consensus = replay_goref(TX_DAG, limit=120, db=db, cache_policy=policy)
+    sink = consensus.sink()
+    assert consensus.get_virtual_daa_score() == 120
+    for access in consensus.storage._registered:
+        assert access._budget is not None
+        # dirty entries are pinned only between flushes; after the final
+        # flush the cache must sit at/under its budget
+        assert len(access._cache) <= access._budget, access._prefix
+    db.close()
+
+    # restart: read-through resume, same sink, still fully operational
+    db2 = KvStore(str(tmp_path / "goref.db"))
+    params, blocks = load_goref(TX_DAG)
+    resumed = Consensus(params, db=db2, cache_policy=policy)
+    assert resumed.sink() == sink
+    assert resumed.get_virtual_daa_score() == 120
+    status = resumed.validate_and_insert_block(blocks[121])
+    assert status in ("utxo_valid", "utxo_pending")
+    db2.close()

@@ -71,16 +71,14 @@ def test_proof_builds_and_validates(donor):
     assert pp_header.hash == cons.pruning_processor.pruning_point
     # validation against a fresh node's (genesis-only) proof accepts
     fresh = Consensus(params)
-    fresh_works = fresh.pruning_proof_manager.proof_level_works(
-        fresh.pruning_proof_manager.build_proof()
+    hdr = fresh.pruning_proof_manager.validate_proof(
+        proof, fresh.pruning_proof_manager.build_proof()
     )
-    hdr = fresh.pruning_proof_manager.validate_proof(proof, fresh_works)
     assert hdr.hash == trusted.pruning_point
-    # validation against an equal proof (the donor's own) rejects: derived
-    # work exceeds at no level
-    own_works = cons.pruning_proof_manager.proof_level_works(proof)
+    # validation against an equal proof (the donor's own) rejects: ties
+    # favor the defender (compare_proofs_inner discipline)
     with pytest.raises(ProofError):
-        cons.pruning_proof_manager.validate_proof(proof, own_works)
+        cons.pruning_proof_manager.validate_proof(proof, proof)
 
 
 def test_trusted_bootstrap_and_catchup(donor):
@@ -133,3 +131,83 @@ def test_shallow_proof_rejected(donor):
     imp = Consensus(params)
     with pytest.raises(ProofError):
         imp.pruning_proof_manager.import_pruning_data(shallow, trusted, utxo)
+
+
+def test_forged_blue_fields_rejected(donor):
+    """Self-consistent structure but forged blue fields: inflating a
+    non-tip header's claimed blue_work above the tip re-sorts it to the
+    end of the level list, yet the RECOMPUTED per-level GHOSTDAG still
+    selects the true tip — the proof is rejected (validate.rs recompute
+    discipline; claimed fields cannot buy the tip)."""
+    import dataclasses
+
+    params, cons, _ = donor
+    proof, _trusted, _utxo = _export(cons)
+    forged_levels = [list(level) for level in proof]
+    level0 = forged_levels[0]
+    assert len(level0) >= 3
+    victim = level0[len(level0) // 2]
+    forged = dataclasses.replace(victim)
+    forged.blue_work = level0[-1].blue_work + 1_000_000
+    if hasattr(forged, "_hash_cache"):
+        forged._hash_cache = None  # the forgery must re-hash
+    level0[len(level0) // 2] = forged
+    forged_levels[0] = sorted(level0, key=lambda h: (h.blue_work, h.hash))
+    fresh = Consensus(params)
+    with pytest.raises(ProofError):
+        fresh.pruning_proof_manager.validate_proof(
+            forged_levels, fresh.pruning_proof_manager.build_proof()
+        )
+
+
+def test_shallower_real_proof_loses():
+    """A genuinely valid but shorter-history proof must not displace a
+    deeper defender: recomputed blue-work beyond the common ancestor
+    decides (compare_proofs_inner).  m is sized so the two proofs' level
+    slices overlap across one finality-sample pruning-point gap, as real
+    mainnet m windows do."""
+    genesis = GenesisBlock(hash=b"\x01" + b"\x00" * 31, bits=0x207FFFFF, timestamp=0)
+    params = Params.from_bps(
+        "simnet-prooffight", 2, genesis, skip_proof_of_work=True, coinbase_maturity=8,
+        merge_depth=15, finality_depth=30, pruning_depth=60, pruning_proof_m=20,
+        difficulty_window_size=15, min_difficulty_window_size=5, difficulty_sample_rate=2,
+        past_median_time_window_size=10, past_median_time_sample_rate=2,
+    )
+
+    def build(n):
+        c = Consensus(params)
+        m = Miner(0, random.Random(9))
+        for _ in range(n):
+            t = c.build_block_template(m.miner_data, [])
+            c.validate_and_insert_block(t)
+        return c
+
+    deep, short = build(220), build(190)
+    assert deep.pruning_processor.pruning_point != short.pruning_processor.pruning_point
+    deep_proof = deep.pruning_proof_manager.build_proof()
+    short_proof = short.pruning_proof_manager.build_proof()
+
+    # the deep node rejects the shallow proof ...
+    with pytest.raises(ProofError):
+        deep.pruning_proof_manager.validate_proof(short_proof, deep_proof)
+    # ... while the shallow node adopts the deep one
+    hdr = short.pruning_proof_manager.validate_proof(deep_proof, short_proof)
+    assert hdr.hash == deep.pruning_processor.pruning_point
+
+
+def test_imported_node_serves_acceptable_proof(donor):
+    """apply.rs parity: a proof-bootstrapped node can itself act as a proof
+    donor without a cold rebuild — the proof it builds from retained proof
+    headers is accepted by a third (fresh) node."""
+    params, cons, _ = donor
+    proof, trusted, utxo = _export(cons)
+    imp = Consensus(params)
+    imp.pruning_proof_manager.import_pruning_data(proof, trusted, utxo)
+
+    served = imp.pruning_proof_manager.build_proof()
+    assert served and served[0]
+    third = Consensus(params)
+    hdr = third.pruning_proof_manager.validate_proof(
+        served, third.pruning_proof_manager.build_proof()
+    )
+    assert hdr.hash == trusted.pruning_point
