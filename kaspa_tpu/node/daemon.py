@@ -78,23 +78,110 @@ def _apply_param_overrides(params: Params, args: DaemonArgs) -> Params:
     return params
 
 
+def _serialize_notification(n) -> dict:
+    """Wire shapes for streamed notifications (rpc/grpc/server's
+    notification message bodies, JSON-ified)."""
+    if n.event_type == "block-added":
+        blk = n.data["block"]
+        return {
+            "hash": blk.hash.hex(),
+            "daa_score": blk.header.daa_score,
+            "blue_score": blk.header.blue_score,
+            "timestamp": blk.header.timestamp,
+            "tx_count": len(blk.transactions),
+        }
+    if n.event_type == "utxos-changed":
+        def pairs(key):
+            return [
+                {
+                    "outpoint": {"transaction_id": op.transaction_id.hex(), "index": op.index},
+                    "utxo_entry": {
+                        "amount": e.amount,
+                        "block_daa_score": e.block_daa_score,
+                        "is_coinbase": e.is_coinbase,
+                        "script_public_key": {
+                            "version": e.script_public_key.version,
+                            "script": e.script_public_key.script.hex(),
+                        },
+                    },
+                }
+                for op, e in n.data.get(key, [])
+            ]
+
+        return {"added": pairs("added"), "removed": pairs("removed")}
+    if n.event_type == "new-block-template":
+        return {}
+    # score changes and the rest carry plain JSON-able payloads
+    return {k: v for k, v in n.data.items() if isinstance(v, (int, str, bool, float, list))}
+
+
 class _RpcHandler(socketserver.StreamRequestHandler):
+    """One connection: request/response lines plus, after a `subscribe`,
+    interleaved `{"notification": ...}` lines.  Notifications flow through
+    a bounded per-connection queue drained by a dedicated writer thread
+    (notify/src/broadcaster.rs role) so a slow consumer can never stall the
+    consensus thread publishing the event — overflow drops, never blocks."""
+
     def handle(self):
+        import queue as _queue
+
         daemon: Daemon = self.server.daemon  # type: ignore[attr-defined]
-        for line in self.rfile:
-            line = line.strip()
-            if not line:
-                continue
-            req_id = None
+        outq: _queue.Queue = _queue.Queue(maxsize=4096)
+        stop = threading.Event()
+        listener_ref = [None]
+
+        def writer():
+            # drain until the sentinel: queued responses still flush after
+            # stop is set (half-close clients must get their last reply);
+            # a dead socket or stop+empty ends the thread
+            while True:
+                try:
+                    item = outq.get(timeout=0.5)
+                except _queue.Empty:
+                    if stop.is_set():
+                        return
+                    continue
+                if item is None:
+                    return
+                try:
+                    self.wfile.write(item)
+                    self.wfile.flush()
+                except OSError:
+                    stop.set()
+                    return
+
+        wt = threading.Thread(target=writer, daemon=True, name="rpc-notify-writer")
+        wt.start()
+        try:
+            for line in self.rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                req_id = None
+                try:
+                    req = json.loads(line)
+                    req_id = req.get("id")
+                    method = req.get("method", "")
+                    params = req.get("params", {})
+                    if method in ("subscribe", "unsubscribe"):
+                        result = daemon.handle_subscription(
+                            method, params, outq, listener_ref, stop
+                        )
+                    else:
+                        result = daemon.dispatch(method, params)
+                    resp = {"id": req_id, "result": result}
+                except Exception as e:  # noqa: BLE001 - wire boundary
+                    resp = {"id": req_id, "error": str(e)}
+                outq.put((json.dumps(resp) + "\n").encode())
+        finally:
+            if listener_ref[0] is not None:
+                with daemon._dispatch_lock:
+                    daemon.rpc.unregister_listener(listener_ref[0])
+            stop.set()
             try:
-                req = json.loads(line)
-                req_id = req.get("id")
-                result = daemon.dispatch(req.get("method", ""), req.get("params", {}))
-                resp = {"id": req_id, "result": result}
-            except Exception as e:  # noqa: BLE001 - wire boundary
-                resp = {"id": req_id, "error": str(e)}
-            self.wfile.write((json.dumps(resp) + "\n").encode())
-            self.wfile.flush()
+                outq.put_nowait(None)
+            except _queue.Full:
+                pass  # writer exits via stop+empty / OSError
 
 
 class Daemon:
@@ -199,6 +286,7 @@ class Daemon:
         """Rebind every consensus-holding service after a staging commit
         (Node already rebuilt its MiningManager)."""
         old_db = self.db
+        old_notifier = self.rpc.notifier
         self.consensus = new_consensus
         self.mining = self.node.mining
         self.utxoindex = UtxoIndex(new_consensus) if self.args.utxoindex else None
@@ -213,6 +301,11 @@ class Daemon:
             shutdown_fn=self.rpc.shutdown_fn,
         )
         self.rpc.metrics_provider = lambda: self.metrics_data.last
+        # live wire subscriptions must survive the swap: keep the old
+        # notifier object (listener ids intact) and re-chain it onto the
+        # new consensus root
+        old_notifier.rebind_parent(new_consensus.notification_root)
+        self.rpc.notifier = old_notifier
         if new_consensus.storage.db is not None:
             # atomic pointer rotation: tmp + rename so a crash mid-write
             # cannot leave a truncated ACTIVE behind
@@ -272,6 +365,44 @@ class Daemon:
             bytes.fromhex(p["txid"]), p.get("acceptingBlockDaaScore", 0)
         ),
     }
+
+    def handle_subscription(self, method: str, params: dict, outq, listener_ref, stop) -> str:
+        """subscribe/unsubscribe verbs for one connection.
+
+        params: {"event": <EVENT_TYPES name>, "addresses": [bech32...]?}.
+        The connection's listener is registered lazily on first subscribe;
+        its callback only enqueues (never blocks the notifier)."""
+        import queue as _queue
+
+        from kaspa_tpu.notify.notifier import EVENT_TYPES
+
+        event = params.get("event")
+        if event not in EVENT_TYPES:
+            raise ValueError(f"unknown event type {event!r}")
+        with self._dispatch_lock:
+            if listener_ref[0] is None:
+
+                def on_notification(n, _outq=outq, _stop=stop):
+                    if _stop.is_set():
+                        return
+                    try:
+                        _outq.put_nowait(
+                            (
+                                json.dumps(
+                                    {"notification": {"event": n.event_type, "data": _serialize_notification(n)}}
+                                )
+                                + "\n"
+                            ).encode()
+                        )
+                    except _queue.Full:
+                        pass  # slow consumer: drop rather than stall consensus
+
+                listener_ref[0] = self.rpc.register_listener(on_notification)
+            if method == "subscribe":
+                self.rpc.start_notify(listener_ref[0], event, params.get("addresses"))
+            else:
+                self.rpc.stop_notify(listener_ref[0], event)
+        return "ok"
 
     def dispatch(self, method: str, params: dict):
         with self._dispatch_lock:
@@ -370,6 +501,88 @@ class Daemon:
                 self.consensus.storage.db = None
                 self.db.close()
                 self.db = None
+
+
+class NotificationClient:
+    """Persistent RPC connection with notification streaming (the
+    rpc/grpc/client + notify subscriber pair).  ``call`` issues regular
+    requests on the same socket; streamed ``{"notification": ...}`` lines
+    land in ``self.notifications`` (a Queue) as (event, data) tuples."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        import queue as _queue
+
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._timeout = timeout
+        self._responses: _queue.Queue = _queue.Queue()
+        self.notifications: _queue.Queue = _queue.Queue()
+        self._next_id = 0
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True, name="rpc-notify-reader")
+        self._reader.start()
+
+    def _read_loop(self):
+        try:
+            for line in self._rfile:
+                msg = json.loads(line)
+                if "notification" in msg:
+                    n = msg["notification"]
+                    self.notifications.put((n["event"], n["data"]))
+                else:
+                    self._responses.put(msg)
+        except (OSError, ValueError):
+            pass
+        self._responses.put(None)  # connection closed
+
+    def call(self, method: str, params: dict | None = None):
+        import queue as _queue
+        import time as _time
+
+        self._next_id += 1
+        req_id = self._next_id
+        self._sock.sendall(
+            (json.dumps({"id": req_id, "method": method, "params": params or {}}) + "\n").encode()
+        )
+        deadline = _time.monotonic() + self._timeout
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"rpc call {method} timed out after {self._timeout}s")
+            try:
+                resp = self._responses.get(timeout=remaining)
+            except _queue.Empty:
+                raise TimeoutError(f"rpc call {method} timed out after {self._timeout}s") from None
+            if resp is None:
+                raise ConnectionError("connection closed")
+            if resp.get("id") != req_id:
+                continue  # stale response from an earlier timed-out call
+            if "error" in resp:
+                raise RuntimeError(resp["error"])
+            return resp["result"]
+
+    def subscribe(self, event: str, addresses: list[str] | None = None):
+        params = {"event": event}
+        if addresses:
+            params["addresses"] = addresses
+        return self.call("subscribe", params)
+
+    def unsubscribe(self, event: str, addresses: list[str] | None = None):
+        params = {"event": event}
+        if addresses:
+            params["addresses"] = addresses
+        return self.call("unsubscribe", params)
+
+    def next_notification(self, timeout: float = 30.0):
+        return self.notifications.get(timeout=timeout)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 def rpc_call(addr: str, method: str, params: dict | None = None, timeout: float = 30.0):

@@ -85,6 +85,20 @@ class Notifier:
             # Subscriber: propagate notifications (and subscriptions) upstream
             self._parent_listener_id = parent.register(self.notify)
 
+    def rebind_parent(self, new_parent: "Notifier") -> None:
+        """Re-chain this notifier onto a new upstream (consensus staging
+        swap): listeners and their subscriptions survive; active event
+        types are re-propagated so the new root keeps publishing them."""
+        if self.parent is not None and self._parent_listener_id is not None:
+            self.parent.unregister(self._parent_listener_id)
+        self.parent = new_parent
+        self._parent_listener_id = new_parent.register(self.notify)
+        for event in EVENT_TYPES:
+            subs = [l.subscriptions[event] for l in self._listeners.values()]
+            if any(s.active for s in subs):
+                addresses = set().union(*(s.addresses for s in subs if s.active)) or None
+                new_parent.start_notify(self._parent_listener_id, event, addresses)
+
     def register(self, callback: Callable[[Notification], None]) -> int:
         lid = self._next_id
         self._next_id += 1
@@ -155,6 +169,13 @@ class ConsensusNotificationRoot(Notifier):
             self.notify(
                 Notification(
                     "utxos-changed",
-                    {"added": added_utxos, "removed": removed_utxos, "spk_set": spk_set},
+                    {
+                        "added": added_utxos,
+                        "removed": removed_utxos,
+                        "spk_set": spk_set,
+                        # carried so remote consumers can classify coinbase
+                        # maturity without a separate daa-score subscription
+                        "virtual_daa_score": virtual_state.daa_score,
+                    },
                 )
             )

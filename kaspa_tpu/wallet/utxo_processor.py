@@ -14,6 +14,22 @@ from dataclasses import dataclass
 from enum import Enum
 
 
+def _wire_utxo_pair(u: dict):
+    """Decode one wire utxo record into (TransactionOutpoint, UtxoEntry)."""
+    from kaspa_tpu.consensus.model import ScriptPublicKey, TransactionOutpoint, UtxoEntry
+
+    op = TransactionOutpoint(bytes.fromhex(u["outpoint"]["transaction_id"]), u["outpoint"]["index"])
+    e = u["utxo_entry"]
+    spk = e.get("script_public_key", {})
+    entry = UtxoEntry(
+        amount=e["amount"],
+        script_public_key=ScriptPublicKey(spk.get("version", 0), bytes.fromhex(spk.get("script", ""))),
+        block_daa_score=e["block_daa_score"],
+        is_coinbase=e["is_coinbase"],
+    )
+    return op, entry
+
+
 class WalletEventType(Enum):
     BALANCE = "balance"
     PENDING = "pending"
@@ -99,6 +115,20 @@ class UtxoProcessor:
             self._mature[op] = entry
             self._emit(WalletEventType.MATURITY, outpoint=op, amount=entry.amount)
         return bool(matured)
+
+    # --- remote feed (the RPC-wire subscriber path) ---
+
+    def feed_wire_notification(self, event: str, data: dict) -> None:
+        """Consume a streamed (event, data) pair from a NotificationClient
+        subscription — the wallet-over-the-wire path (processor.rs consuming
+        the gRPC notification stream)."""
+        if event == "utxos-changed":
+            added = [_wire_utxo_pair(u) for u in data.get("added", [])]
+            removed = [_wire_utxo_pair(u) for u in data.get("removed", [])]
+            daa = data.get("virtual_daa_score", self._virtual_daa)
+            self.on_utxos_changed(added, removed, daa)
+        elif event == "virtual-daa-score-changed":
+            self.on_virtual_daa_score_changed(data["daa_score"])
 
     # --- queries ---
 

@@ -1,0 +1,119 @@
+"""Notification streaming over the RPC wire.
+
+Reference: notify/src/notifier.rs + rpc/grpc/server notification streaming —
+a remote client subscribes on the same TCP connection it issues requests on,
+the node mines, and the client observes BlockAdded / UtxosChanged /
+VirtualDaaScoreChanged WITHOUT polling; a wallet UtxoProcessor consumes the
+stream and tracks balance remotely.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kaspa_tpu.node.daemon import Daemon, NotificationClient, parse_args
+from kaspa_tpu.sim.simulator import Miner
+
+
+@pytest.fixture()
+def daemon(tmp_path):
+    args = parse_args(["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0", "--bps", "2"])
+    d = Daemon(args)
+    addr = d.start()
+    yield d, addr
+    d.stop()
+
+
+def _miner_address(miner, prefix="kaspasim"):
+    from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+    return extract_script_pub_key_address(miner.spk, prefix).to_string()
+
+
+def test_subscription_streams_without_polling(daemon):
+    d, addr = daemon
+    miner = Miner(0, random.Random(4))
+    addr_str = _miner_address(miner)
+
+    client = NotificationClient(addr)
+    try:
+        assert client.subscribe("block-added") == "ok"
+        assert client.subscribe("utxos-changed", [addr_str]) == "ok"
+        assert client.subscribe("virtual-daa-score-changed") == "ok"
+
+        # remote wallet: UtxoProcessor fed purely by the stream
+        from kaspa_tpu.wallet.utxo_processor import UtxoProcessor
+
+        class _Key:
+            spk = miner.spk
+
+        class _Account:
+            receive_keys = [_Key()]
+
+        uproc = UtxoProcessor(_Account(), d.params.coinbase_maturity)
+
+        for _ in range(3):
+            t = client.call("getBlockTemplate", {"payAddress": addr_str})
+            res = client.call("submitBlockByTemplateHash", {"hash": t["block_hash"]})
+            assert res["status"] in ("utxo_valid", "utxo_pending")
+            d.mining.template_cache.clear()
+
+        events = {}
+        # 3 blocks produce >= 3 block-added + >= 2 utxos-changed (coinbases
+        # become spendable when their block is chain-verified) + daa ticks
+        for _ in range(8):
+            event, data = client.next_notification(timeout=30)
+            events.setdefault(event, []).append(data)
+            uproc.feed_wire_notification(event, data)
+            if len(events.get("block-added", [])) >= 3 and events.get("utxos-changed"):
+                break
+        assert len(events["block-added"]) >= 3
+        assert events["utxos-changed"], "no UtxosChanged crossed the wire"
+        assert events.get("virtual-daa-score-changed"), "no daa-score stream"
+        added = [u for n in events["utxos-changed"] for u in n["added"]]
+        assert added and all("script_public_key" in u["utxo_entry"] for u in added)
+        # the remote wallet saw its coinbase balance (immature => pending)
+        assert uproc.balance().total > 0
+
+        # unsubscribe stops the flow for that event
+        assert client.unsubscribe("block-added") == "ok"
+        t = client.call("getBlockTemplate", {"payAddress": addr_str})
+        client.call("submitBlockByTemplateHash", {"hash": t["block_hash"]})
+        d.mining.template_cache.clear()
+        import queue
+
+        saw_block_added = False
+        try:
+            while True:
+                event, _ = client.next_notification(timeout=2)
+                if event == "block-added":
+                    saw_block_added = True
+        except queue.Empty:
+            pass
+        assert not saw_block_added
+    finally:
+        client.close()
+
+
+def test_address_filtered_utxos_changed(daemon):
+    """A listener filtered to an unrelated address sees no UtxosChanged."""
+    d, addr = daemon
+    miner = Miner(0, random.Random(4))
+    other = Miner(1, random.Random(5))
+    client = NotificationClient(addr)
+    try:
+        client.subscribe("utxos-changed", [_miner_address(other)])
+        for _ in range(2):
+            t = client.call("getBlockTemplate", {"payAddress": _miner_address(miner)})
+            client.call("submitBlockByTemplateHash", {"hash": t["block_hash"]})
+            d.mining.template_cache.clear()
+        import queue
+
+        with pytest.raises(queue.Empty):
+            while True:
+                event, data = client.next_notification(timeout=2)
+                assert not (event == "utxos-changed" and data["added"]), "filter leaked"
+    finally:
+        client.close()
