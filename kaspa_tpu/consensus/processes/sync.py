@@ -1,0 +1,88 @@
+"""Sync manager: block locators and antipast queries for IBD negotiation.
+
+Reference: consensus/src/processes/sync/mod.rs (SyncManager):
+``create_block_locator_from_pruning_point`` builds an exponentially-spaced
+selected-chain locator (step doubling per hop, low appended last), and
+``antipast_hashes_between`` yields the block hashes a donor must serve so a
+peer holding ``low`` converges to ``high`` — the chain walk's mergesets,
+excluding anything already in ``low``'s past.
+"""
+
+from __future__ import annotations
+
+
+class SyncError(Exception):
+    pass
+
+
+class SyncManager:
+    def __init__(self, consensus):
+        self.c = consensus
+
+    def create_block_locator_from_pruning_point(
+        self, high: bytes, low: bytes, limit: int | None = None
+    ) -> list[bytes]:
+        """sync/mod.rs:201 — selected-chain hashes from ``high`` down to
+        ``low`` with exponentially growing blue-score gaps (step doubling),
+        ``low`` always last."""
+        c = self.c
+        if not c.reachability.is_chain_ancestor_of(low, high):
+            raise SyncError("locator low hash is not in the high hash's chain")
+        gd = c.storage.ghostdag
+        low_bs = gd.get_blue_score(low)
+        locator: list[bytes] = []
+        current = high
+        step = 1
+        while gd.get_blue_score(current) > low_bs:
+            locator.append(current)
+            if limit is not None and len(locator) == limit:
+                break
+            target = max(gd.get_blue_score(current) - step, low_bs)
+            while gd.get_blue_score(current) > target:
+                current = gd.get_selected_parent(current)
+            step *= 2
+        locator.append(low)
+        return locator
+
+    def find_highest_common_chain_block(self, low: bytes, high: bytes) -> bytes:
+        """sync/mod.rs find_highest_common_chain_block: walk down ``low``'s
+        selected chain until a block on ``high``'s selected chain."""
+        c = self.c
+        current = low
+        while not (
+            c.reachability.has(current) and c.reachability.is_chain_ancestor_of(current, high)
+        ):
+            current = c.storage.ghostdag.get_selected_parent(current)
+        return current
+
+    def antipast_hashes_between(
+        self, low: bytes, high: bytes, max_blocks: int | None = None
+    ) -> tuple[list[bytes], bytes]:
+        """sync/mod.rs:76 — hashes between low's antipast and high's
+        antipast (excludes low, includes high), capped at ``max_blocks``.
+        Returns (hashes ascending by (blue_work, hash), highest chain block
+        reached) so callers can continue from ``highest_reached``."""
+        c = self.c
+        original_low = low
+        low = self.find_highest_common_chain_block(low, high)
+        gd = c.storage.ghostdag
+        reach = c.reachability
+        collected: set[bytes] = set()
+        highest_reached = low
+        for current in reach.forward_chain_iterator(low, high):
+            if current == low:
+                continue
+            data = gd.get(current)
+            mergeset = [current, *data.unordered_mergeset()]
+            if max_blocks is not None and len(collected) + len(mergeset) > max_blocks:
+                break
+            for m in mergeset:
+                if m in collected or m == low:
+                    continue
+                if reach.has(m) and reach.is_dag_ancestor_of(m, original_low) and m != original_low:
+                    continue  # the peer already has everything in low's past
+                collected.add(m)
+            highest_reached = current
+        collected.discard(original_low)
+        hashes = sorted(collected, key=lambda h: (gd.get_blue_work(h), h))
+        return hashes, highest_reached

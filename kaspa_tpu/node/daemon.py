@@ -224,7 +224,10 @@ class Daemon:
         from kaspa_tpu.p2p.address_manager import AddressManager, ConnectionManager
 
         self.address_manager = AddressManager()
-        self.connection_manager = ConnectionManager(self.node, self.address_manager)
+        self.connection_manager = ConnectionManager(
+            self.node, self.address_manager, tick_seconds=5.0
+        )
+        self.node.address_manager = self.address_manager
         self.rpc = RpcCoreService(
             self.consensus,
             self.mining,
@@ -461,10 +464,13 @@ class Daemon:
             lhost, lport = self.args.listen.rsplit(":", 1)
             self.p2p_server = P2PServer(self.node, lhost, int(lport), address_manager=self.address_manager)
             self.p2p_server.start()
-            self.log.info("P2P listening on %s:%s", lhost, lport)
+            self.node.listen_port = int(self.p2p_server.address.rsplit(":", 1)[1])
+            self.log.info("P2P listening on %s", self.p2p_server.address)
+        self.connection_manager.start()
         return []
 
     def _stop_p2p_service(self) -> None:
+        self.connection_manager.stop()
         if self.p2p_server is not None:
             self.p2p_server.stop()
             self.p2p_server = None
@@ -480,9 +486,16 @@ class Daemon:
 
     def connect_peer(self, address: str):
         """Dial a peer over the wire and catch up from it (IBD)."""
+        from kaspa_tpu.p2p.address_manager import NetAddress
         from kaspa_tpu.p2p.transport import connect_outbound
 
         peer = connect_outbound(self.node, address)
+        # register the RESOLVED address (getpeername) so the connection
+        # manager's connected-set comparison matches and never re-dials
+        na = getattr(peer, "peer_address", None)
+        if na is not None:
+            self.address_manager.add_address(na)
+            self.address_manager.mark_connection_success(na)
         with self.node.lock:
             self.node.ibd_from(peer)
         return peer

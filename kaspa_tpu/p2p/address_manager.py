@@ -185,6 +185,10 @@ class ConnectionManager:
             peer = transport.connect_outbound(self.node, str(address))
             peer.peer_address = address
             self.amgr.mark_connection_success(address)
+            # per-peer IBD flow kicks off on connect (flow registration);
+            # _on_chain_info no-ops when the peer has nothing we lack
+            with self.node.lock:
+                self.node.ibd_from(peer)
             return True
         except (OSError, ConnectionError):
             self.amgr.mark_connection_failure(address)

@@ -31,7 +31,6 @@ MSG_BLOCK = "block"
 MSG_INV_TXS = "invtransactions"
 MSG_REQUEST_TXS = "requesttransactions"
 MSG_TX = "transaction"
-MSG_REQUEST_IBD_BLOCKS = "requestibdblocks"
 MSG_IBD_BLOCKS = "ibdblocks"
 # proof-based IBD (flows/src/ibd/flow.rs negotiate + headers-proof path)
 MSG_REQUEST_IBD_CHAIN_INFO = "requestibdchaininfo"
@@ -42,6 +41,11 @@ MSG_REQUEST_TRUSTED_DATA = "requestpruningpointtrusteddata"
 MSG_TRUSTED_DATA = "pruningpointtrusteddata"
 MSG_REQUEST_PP_UTXOS = "requestpruningpointutxoset"
 MSG_PP_UTXO_CHUNK = "pruningpointutxosetchunk"
+# locator sync negotiation (flows/src/ibd/negotiate.rs + sync/mod.rs)
+MSG_IBD_BLOCK_LOCATOR = "ibdblocklocator"
+# address exchange (flows/src/v7/address.rs)
+MSG_REQUEST_ADDRESSES = "requestaddresses"
+MSG_ADDRESSES = "addresses"
 
 PP_UTXO_CHUNK_SIZE = 4096  # entries per chunk (ibd/flow.rs utxo chunking)
 
@@ -81,6 +85,14 @@ class Node:
         self.name = name
         self.cmgr = ConsensusManager(consensus)
         self.mining = MiningManager(consensus)
+        # wired by the daemon; None in bare in-process tests (flows no-op)
+        self.address_manager = None
+        self.listen_port = 0  # advertised in the version handshake
+        import secrets
+
+        # per-node identity nonce (the reference's version message peer id):
+        # a version carrying OUR id is a self-connection and is dropped
+        self.id = secrets.randbits(64)
         self.cmgr.on_swap(self._on_consensus_swap)
         self.peers: list = []  # the Hub (p2p/src/core/hub.rs)
         self.orphan_blocks: dict[bytes, Block] = {}  # flowcontext/orphans.rs
@@ -153,16 +165,65 @@ class Node:
             # handshake.rs: version negotiation incl. network match
             if isinstance(payload, dict) and payload.get("network", self.consensus.params.name) != self.consensus.params.name:
                 raise ProtocolError(f"network mismatch: {payload.get('network')}")
+            if isinstance(payload, dict) and payload.get("id") and payload["id"] == self.id:
+                # gossip taught us our own address and we dialed ourselves
+                if self.address_manager is not None and getattr(peer, "peer_address", None):
+                    self.address_manager.remove(peer.peer_address)
+                if hasattr(peer, "close"):
+                    peer.close()
+                raise ProtocolError("self-connection detected (matching version id)")
+            # record the peer's advertised listen address for gossip
+            # (flow_context.rs registers it with the address manager)
+            if (
+                self.address_manager is not None
+                and isinstance(payload, dict)
+                and payload.get("listen_port")
+                and getattr(peer, "peer_address", None) is not None
+            ):
+                from kaspa_tpu.p2p.address_manager import NetAddress
+
+                self.address_manager.add_address(
+                    NetAddress(peer.peer_address.ip, payload["listen_port"])
+                )
             if not getattr(peer, "version_sent", True):
                 # inbound wire peer: reciprocate with our own version
                 peer.version_sent = True
                 peer.send(
                     MSG_VERSION,
-                    {"protocol_version": PROTOCOL_VERSION, "network": self.consensus.params.name, "listen_port": 0},
+                    {
+                        "protocol_version": PROTOCOL_VERSION,
+                        "network": self.consensus.params.name,
+                        "listen_port": self.listen_port,
+                        "id": self.id,
+                    },
                 )
             peer.send(MSG_VERACK, PROTOCOL_VERSION)
         elif msg_type == MSG_VERACK:
             peer.handshaken = True
+            if self.address_manager is not None:
+                peer.send(MSG_REQUEST_ADDRESSES, {})
+        elif msg_type == MSG_REQUEST_ADDRESSES:
+            peers = []
+            if self.address_manager is not None:
+                import itertools
+
+                peers = [
+                    str(a)
+                    for a in itertools.islice(
+                        self.address_manager.iterate_prioritized_random_addresses(), 256
+                    )
+                ]
+            peer.send(MSG_ADDRESSES, peers)
+        elif msg_type == MSG_ADDRESSES:
+            # gossip intake: feed the address manager (ban-filtered there)
+            if self.address_manager is not None:
+                from kaspa_tpu.p2p.address_manager import NetAddress
+
+                for a in payload[:256]:
+                    try:
+                        self.address_manager.add_address(NetAddress.parse(a))
+                    except ValueError:
+                        continue
         elif msg_type == "ping":
             peer.send("pong", payload)
         elif msg_type == "pong":
@@ -197,11 +258,31 @@ class Node:
             except (MempoolError, TxRuleError):
                 return  # relay rejections are not punished unless malformed
             self.broadcast_tx(payload)
-        elif msg_type == MSG_REQUEST_IBD_BLOCKS:
-            # serve blocks above the requested low hashes in topological order
-            blocks = self._blocks_in_topological_order()
-            have = set(payload)
-            peer.send(MSG_IBD_BLOCKS, [b for b in blocks if b.hash not in have])
+        elif msg_type == MSG_IBD_BLOCK_LOCATOR:
+            # negotiate.rs donor side: highest locator entry we know anchors
+            # the antipast query; unknown locator => serve from our pruning
+            # point (the syncer should have proof-synced first)
+            from kaspa_tpu.consensus.processes.sync import SyncManager
+
+            sm = SyncManager(self.consensus)
+            reach = self.consensus.reachability
+            sink = self.consensus.sink()
+            # only a chain ancestor of our sink anchors the walk safely:
+            # retained anticone blocks near the retention boundary may have
+            # had their selected-parent chain pruned underneath them
+            common = next(
+                (h for h in payload if reach.has(h) and reach.is_chain_ancestor_of(h, sink)),
+                None,
+            )
+            if common is None:
+                common = self.consensus.pruning_processor.pruning_point
+            hashes, _highest = sm.antipast_hashes_between(common, self.consensus.sink())
+            bts = self.consensus.storage.block_transactions
+            hdrs = self.consensus.storage.headers
+            peer.send(
+                MSG_IBD_BLOCKS,
+                [Block(hdrs.get(h), bts.get(h)) for h in hashes if bts.has(h)],
+            )
         elif msg_type == MSG_IBD_BLOCKS:
             staging = self._ibd.get("staging") if self._ibd.get("peer") is peer else None
             target = staging.consensus if staging is not None else self.consensus
@@ -335,20 +416,14 @@ class Node:
                 except RuleError:
                     pass
 
-    def _blocks_in_topological_order(self) -> list[Block]:
-        """All block bodies sorted by (blue_work, hash) — a topological order
-        since ancestors always have strictly smaller blue work."""
-        gd = self.consensus.storage.ghostdag
-        hashes = [
-            h
-            for h in self.consensus.storage.headers.keys()
-            if h != self.consensus.params.genesis.hash and self.consensus.storage.block_transactions.has(h)
-        ]
-        hashes.sort(key=lambda h: (gd.get_blue_work(h), h))
-        return [
-            Block(self.consensus.storage.headers.get(h), self.consensus.storage.block_transactions.get(h))
-            for h in hashes
-        ]
+    def _send_locator(self, peer: Peer, consensus: Consensus) -> None:
+        from kaspa_tpu.consensus.processes.sync import SyncManager
+
+        sm = SyncManager(consensus)
+        locator = sm.create_block_locator_from_pruning_point(
+            consensus.sink(), consensus.pruning_processor.pruning_point
+        )
+        peer.send(MSG_IBD_BLOCK_LOCATOR, locator)
 
     def ibd_from(self, peer: Peer) -> None:
         """IBD negotiation (ibd/flow.rs determine_ibd_type): ask for the
@@ -375,9 +450,10 @@ class Node:
             )
         ):
             # peer's pruning point is connected within our known history
-            # (header-only proof remnants without reachability do NOT count)
-            have = [h for h in self.consensus.storage.headers.keys()]
-            peer.send(MSG_REQUEST_IBD_BLOCKS, have)
+            # (header-only proof remnants without reachability do NOT count):
+            # negotiate with an exponential block locator instead of a full
+            # inventory (sync/mod.rs create_block_locator_from_pruning_point)
+            self._send_locator(peer, self.consensus)
             return
         # too far behind: headers-proof sync (ibd/flow.rs IbdType::DownloadHeadersProof)
         self._ibd = {"peer": peer, "phase": "proof"}
@@ -415,8 +491,7 @@ class Node:
             staging.cancel()
             raise ProtocolError(f"invalid pruning proof data from peer: {e}") from e
         self._ibd = {"peer": peer, "phase": "blocks", "staging": staging}
-        have = list(staging.consensus.storage.headers.keys())
-        peer.send(MSG_REQUEST_IBD_BLOCKS, have)
+        self._send_locator(peer, staging.consensus)
 
     def _finalize_proof_ibd(self, staging) -> None:
         self._ibd = {}
@@ -439,6 +514,6 @@ def connect(a: Node, b: Node) -> tuple[Peer, Peer]:
     pb.remote = pa
     a.peers.append(pa)
     b.peers.append(pb)
-    pa.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": a.consensus.params.name, "listen_port": 0})
-    pb.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": b.consensus.params.name, "listen_port": 0})
+    pa.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": a.consensus.params.name, "listen_port": 0, "id": a.id})
+    pb.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": b.consensus.params.name, "listen_port": 0, "id": b.id})
     return pa, pb

@@ -184,7 +184,12 @@ def connect_outbound(node: Node, address: str, timeout: float = 10.0) -> WirePee
     peer.start()
     peer.send(
         MSG_VERSION,
-        {"protocol_version": PROTOCOL_VERSION, "network": node.consensus.params.name, "listen_port": 0},
+        {
+            "protocol_version": PROTOCOL_VERSION,
+            "network": node.consensus.params.name,
+            "listen_port": node.listen_port,
+            "id": node.id,
+        },
     )
     if not peer.wait_handshaken(timeout):
         peer.close()

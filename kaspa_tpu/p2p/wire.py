@@ -25,8 +25,10 @@ from kaspa_tpu.p2p.node import (
     MSG_PP_UTXO_CHUNK,
     MSG_PRUNING_PROOF,
     MSG_REQUEST_BLOCK,
-    MSG_REQUEST_IBD_BLOCKS,
     MSG_REQUEST_IBD_CHAIN_INFO,
+    MSG_ADDRESSES,
+    MSG_IBD_BLOCK_LOCATOR,
+    MSG_REQUEST_ADDRESSES,
     MSG_REQUEST_PP_UTXOS,
     MSG_REQUEST_PRUNING_PROOF,
     MSG_REQUEST_TRUSTED_DATA,
@@ -53,7 +55,6 @@ _TYPE_IDS = {
     MSG_INV_TXS: 5,
     MSG_REQUEST_TXS: 6,
     MSG_TX: 7,
-    MSG_REQUEST_IBD_BLOCKS: 8,
     MSG_IBD_BLOCKS: 9,
     MSG_PING: 10,
     MSG_PONG: 11,
@@ -65,16 +66,20 @@ _TYPE_IDS = {
     MSG_TRUSTED_DATA: 17,
     MSG_REQUEST_PP_UTXOS: 18,
     MSG_PP_UTXO_CHUNK: 19,
+    MSG_IBD_BLOCK_LOCATOR: 20,
+    MSG_REQUEST_ADDRESSES: 21,
+    MSG_ADDRESSES: 22,
 }
 _TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
 
 
 def _enc_version(p) -> bytes:
-    """payload: {protocol_version, network, listen_port}"""
+    """payload: {protocol_version, network, listen_port, id}"""
     w = io.BytesIO()
     serde.write_varint(w, p["protocol_version"])
     serde.write_bytes(w, p["network"].encode())
     serde.write_varint(w, p.get("listen_port", 0))
+    serde.write_varint(w, p.get("id", 0))
     return w.getvalue()
 
 
@@ -84,6 +89,7 @@ def _dec_version(data: bytes):
         "protocol_version": serde.read_varint(r),
         "network": serde.read_bytes(r).decode(),
         "listen_port": serde.read_varint(r),
+        "id": serde.read_varint(r),
     }
 
 
@@ -230,6 +236,19 @@ def _dec_utxo_chunk(data: bytes) -> dict:
     return {"offset": offset, "pairs": pairs, "done": r.read(1) == b"\x01"}
 
 
+def _enc_strings(items) -> bytes:
+    w = io.BytesIO()
+    serde.write_varint(w, len(items))
+    for it in items:
+        serde.write_bytes(w, it.encode())
+    return w.getvalue()
+
+
+def _dec_strings(data: bytes) -> list[str]:
+    r = io.BytesIO(data)
+    return [serde.read_bytes(r).decode() for _ in range(serde.read_varint(r))]
+
+
 _CODECS = {
     MSG_VERSION: (_enc_version, _dec_version),
     MSG_VERACK: (_enc_varint, _dec_varint),
@@ -239,7 +258,6 @@ _CODECS = {
     MSG_INV_TXS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
     MSG_REQUEST_TXS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
     MSG_TX: (serde.encode_tx, serde.decode_tx),
-    MSG_REQUEST_IBD_BLOCKS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
     MSG_IBD_BLOCKS: (_enc_blocks, _dec_blocks),
     MSG_PING: (_enc_varint, _dec_varint),
     MSG_PONG: (_enc_varint, _dec_varint),
@@ -251,6 +269,9 @@ _CODECS = {
     MSG_TRUSTED_DATA: (_enc_trusted, _dec_trusted),
     MSG_REQUEST_PP_UTXOS: (_enc_varint, _dec_varint),
     MSG_PP_UTXO_CHUNK: (_enc_utxo_chunk, _dec_utxo_chunk),
+    MSG_IBD_BLOCK_LOCATOR: (serde.encode_hash_list, serde.decode_hash_list_bytes),
+    MSG_REQUEST_ADDRESSES: (_enc_empty, _dec_empty),
+    MSG_ADDRESSES: (_enc_strings, _dec_strings),
 }
 
 

@@ -152,7 +152,7 @@ def test_wire_codec_roundtrip():
         assert pos[0] == len(frame)
         return decoded
 
-    v = {"protocol_version": 7, "network": "kaspa-simnet", "listen_port": 16111}
+    v = {"protocol_version": 7, "network": "kaspa-simnet", "listen_port": 16111, "id": 99}
     assert roundtrip(MSG_VERSION, v) == v
     h = rng.randbytes(32)
     assert roundtrip(MSG_INV_BLOCK, h) == h
