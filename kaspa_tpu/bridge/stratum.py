@@ -1,11 +1,13 @@
 """Stratum bridge: miner-facing job server over block templates.
 
 Reference: bridge/src/stratum_server.rs + client_handler.rs +
-mining_state.rs (the rk-stratum bridge): accepts stratum JSON-line
-connections from miners, serves jobs derived from node block templates
-(pre-PoW hash + timestamp), tracks a bounded job ring, validates
-submitted nonces against the share and network targets, and forwards
-solved blocks to the node.
+mining_state.rs + share_handler.rs (the rk-stratum bridge): accepts
+stratum JSON-line connections from miners, serves jobs derived from node
+block templates (pre-PoW hash + timestamp), tracks a bounded job ring,
+validates submitted nonces against the per-worker share target and the
+network target, forwards solved blocks to the node, runs the vardiff
+loop (share_handler.rs vardiff_compute_next_diff, same tunables), and
+exposes Prometheus-style metrics (prom.rs).
 
 Protocol (line-delimited JSON, the kaspa-stratum dialect):
   -> {"id", "method": "mining.subscribe", "params": [agent]}
@@ -21,9 +23,11 @@ Protocol (line-delimited JSON, the kaspa-stratum dialect):
 from __future__ import annotations
 
 import json
+import math
 import secrets
 import socketserver
 import threading
+import time
 
 from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.consensus.difficulty import compact_to_target
@@ -34,11 +38,134 @@ log = get_logger("stratum")
 
 MAX_JOBS = 256
 
+# stratum difficulty 1 reference target (hasher.rs DIFF1 convention)
+DIFF1_TARGET = (1 << 255) - 1
+
+# VarDiff tunables (share_handler.rs:44-58, same values)
+VARDIFF_MIN_ELAPSED_SECS = 30.0
+VARDIFF_MAX_ELAPSED_SECS_NO_SHARES = 90.0
+VARDIFF_MIN_SHARES = 3.0
+VARDIFF_LOWER_RATIO = 0.75  # below this => decrease diff
+VARDIFF_UPPER_RATIO = 1.25  # above this => increase diff
+VARDIFF_MAX_STEP_UP = 2.0  # max 2x per adjustment tick
+VARDIFF_MAX_STEP_DOWN = 0.5  # max -50% per adjustment tick
+
+
+def vardiff_pow2_clamp_towards(current: float, next_: float) -> float:
+    """share_handler.rs:46 — snap toward the nearest power of two."""
+    if not math.isfinite(next_) or next_ <= 0.0:
+        return 1.0
+    exp = math.ceil(math.log2(next_)) if next_ >= current else math.floor(math.log2(next_))
+    clamped = 2.0 ** int(exp)
+    return clamped if clamped >= 1.0 else 1.0
+
+
+def vardiff_compute_next_diff(
+    current: float, shares: float, elapsed_secs: float, expected_spm: float, clamp_pow2: bool
+) -> float | None:
+    """share_handler.rs:56 vardiff_compute_next_diff, ported verbatim:
+    returns the next difficulty or None when no adjustment applies."""
+    if not math.isfinite(current) or current <= 0.0:
+        return None
+    if not math.isfinite(elapsed_secs) or elapsed_secs <= 0.0:
+        return None
+    if shares == 0.0 and elapsed_secs >= VARDIFF_MAX_ELAPSED_SECS_NO_SHARES:
+        next_ = max(current * VARDIFF_MAX_STEP_DOWN, 1.0)
+        if clamp_pow2:
+            next_ = vardiff_pow2_clamp_towards(current, next_)
+        return None if next_ == current else next_
+    if elapsed_secs < VARDIFF_MIN_ELAPSED_SECS or shares < VARDIFF_MIN_SHARES:
+        return None
+    observed_spm = (shares / elapsed_secs) * 60.0
+    ratio = observed_spm / expected_spm if expected_spm > 0 else 1.0
+    if VARDIFF_LOWER_RATIO <= ratio <= VARDIFF_UPPER_RATIO:
+        return None
+    step = min(max(math.sqrt(ratio), VARDIFF_MAX_STEP_DOWN), VARDIFF_MAX_STEP_UP)
+    next_ = max(current * step, 1.0)
+    if clamp_pow2:
+        next_ = vardiff_pow2_clamp_towards(current, next_)
+    return None if next_ == current else next_
+
 
 class StratumError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(message)
         self.code = code
+
+
+class WorkerStats:
+    """Per-worker share window + difficulty (share_handler.rs WorkerStats)."""
+
+    def __init__(self, difficulty: float, now: float):
+        self.difficulty = difficulty
+        self.window_shares = 0
+        self.window_start = now
+        self.total_accepted = 0
+        self.total_stale = 0
+        self.total_duplicate = 0
+        self.total_low_diff = 0
+        self.blocks_found = 0
+        self.connected_at = now
+
+
+class ShareHandler:
+    """Share accounting + the vardiff loop (share_handler.rs).
+
+    ``now`` is injectable for deterministic tests."""
+
+    def __init__(
+        self,
+        expected_shares_per_min: float = 20.0,  # app_config.rs default
+        initial_difficulty: float = 1.0,
+        clamp_pow2: bool = True,
+        now=time.monotonic,
+    ):
+        self.expected_spm = expected_shares_per_min
+        self.initial_difficulty = initial_difficulty
+        self.clamp_pow2 = clamp_pow2
+        self.now = now
+        self.workers: dict[str, WorkerStats] = {}
+        self._mu = threading.Lock()
+
+    def worker(self, name: str) -> WorkerStats:
+        with self._mu:
+            ws = self.workers.get(name)
+            if ws is None:
+                ws = self.workers[name] = WorkerStats(self.initial_difficulty, self.now())
+            return ws
+
+    def share_target(self, name: str) -> int:
+        d = max(self.worker(name).difficulty, 1.0)
+        return int(DIFF1_TARGET / d)
+
+    def record_share(self, name: str, outcome: str) -> None:
+        ws = self.worker(name)
+        with self._mu:
+            if outcome == "accepted":
+                ws.total_accepted += 1
+                ws.window_shares += 1
+            elif outcome == "stale":
+                ws.total_stale += 1
+            elif outcome == "duplicate":
+                ws.total_duplicate += 1
+            elif outcome == "low-diff":
+                ws.total_low_diff += 1
+
+    def maybe_adjust(self, name: str) -> float | None:
+        """Run one vardiff evaluation for the worker; returns the NEW
+        difficulty when it changed (callers push mining.set_difficulty)."""
+        ws = self.worker(name)
+        with self._mu:
+            elapsed = self.now() - ws.window_start
+            nxt = vardiff_compute_next_diff(
+                ws.difficulty, float(ws.window_shares), elapsed, self.expected_spm, self.clamp_pow2
+            )
+            if nxt is None:
+                return None
+            ws.difficulty = nxt
+            ws.window_shares = 0
+            ws.window_start = self.now()
+            return nxt
 
 
 class MiningState:
@@ -51,6 +178,8 @@ class MiningState:
         self._mu = threading.Lock()
         self.shares_accepted = 0
         self.shares_stale = 0
+        self.shares_duplicate = 0
+        self.shares_low_diff = 0
         self.blocks_found = 0
 
     def add_job(self, block) -> int:
@@ -85,13 +214,21 @@ class StratumBridge:
     ``template_source() -> Block`` and ``submit_block(block) -> status``
     bind it to a node (in-process or RPC)."""
 
-    def __init__(self, template_source, submit_block, share_difficulty_shift: int = 8):
+    def __init__(
+        self,
+        template_source,
+        submit_block,
+        expected_shares_per_min: float = 20.0,
+        initial_difficulty: float = 1.0,
+        clamp_pow2: bool = True,
+        now=time.monotonic,
+    ):
         self.template_source = template_source
         self.submit_block = submit_block
         self.state = MiningState()
-        # share target = network target << shift (easier shares for vardiff
-        # accounting; the reference runs a full vardiff loop)
-        self.share_difficulty_shift = share_difficulty_shift
+        self.share_handler = ShareHandler(
+            expected_shares_per_min, initial_difficulty, clamp_pow2, now
+        )
 
     # --- jobs ---
 
@@ -108,37 +245,87 @@ class StratumBridge:
 
     # --- shares ---
 
-    def submit(self, job_id: int, nonce: int) -> bool:
+    def submit(self, worker: str, job_id: int, nonce: int) -> bool:
         """Returns True when the share also solved a block."""
         block = self.state.get_job(job_id)
         if block is None:
             self.state.shares_stale += 1
+            self.share_handler.record_share(worker, "stale")
             raise StratumError(21, "Job not found")  # stale share
         if not self.state.register_share(job_id, nonce):
+            self.state.shares_duplicate += 1
+            self.share_handler.record_share(worker, "duplicate")
             raise StratumError(22, "Duplicate share")
         pre_pow = chash.header_hash_override_nonce_time(block.header, 0, 0)
         value = int.from_bytes(pow_hash(pre_pow, block.header.timestamp, nonce), "little")
         network_target = compact_to_target(block.header.bits)
-        share_target = min(network_target << self.share_difficulty_shift, (1 << 256) - 1)
+        share_target = max(self.share_handler.share_target(worker), network_target)
         if value > share_target:
+            self.state.shares_low_diff += 1
+            self.share_handler.record_share(worker, "low-diff")
             raise StratumError(20, "Low difficulty share")
         self.state.shares_accepted += 1
+        self.share_handler.record_share(worker, "accepted")
         if value <= network_target:
             # block found: graft the nonce and hand it to the node
             block.header.nonce = nonce
             block.header.invalidate_cache()
             self.submit_block(block)
             self.state.blocks_found += 1
+            self.share_handler.worker(worker).blocks_found += 1
             return True
         return False
 
+    # --- metrics (prom.rs exposition) ---
+
+    def metrics_text(self) -> str:
+        s = self.state
+        lines = [
+            "# TYPE stratum_shares_accepted_total counter",
+            f"stratum_shares_accepted_total {s.shares_accepted}",
+            "# TYPE stratum_shares_stale_total counter",
+            f"stratum_shares_stale_total {s.shares_stale}",
+            "# TYPE stratum_shares_duplicate_total counter",
+            f"stratum_shares_duplicate_total {s.shares_duplicate}",
+            "# TYPE stratum_shares_low_diff_total counter",
+            f"stratum_shares_low_diff_total {s.shares_low_diff}",
+            "# TYPE stratum_blocks_found_total counter",
+            f"stratum_blocks_found_total {s.blocks_found}",
+            "# TYPE stratum_worker_difficulty gauge",
+        ]
+        with self.share_handler._mu:
+            workers = [(name, ws.difficulty) for name, ws in self.share_handler.workers.items()]
+        for name, diff in workers:
+            label = name.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+            lines.append(f'stratum_worker_difficulty{{worker="{label}"}} {diff}')
+        return "\n".join(lines) + "\n"
+
 
 class _StratumHandler(socketserver.StreamRequestHandler):
+    # periodic wakeup so vardiff's zero-share decay path runs for idle
+    # miners (share_handler.rs evaluates on a timer, not only per share)
+    IDLE_TICK_SECS = 10.0
+
     def handle(self):
+        import socket as _socket
+
         bridge: StratumBridge = self.server.bridge  # type: ignore[attr-defined]
         extranonce = secrets.token_hex(2)
-        authorized = False
-        for line in self.rfile:
+        worker = None
+        self.connection.settimeout(self.IDLE_TICK_SECS)
+        while True:
+            try:
+                line = self.rfile.readline()
+            except (_socket.timeout, TimeoutError):
+                if worker is not None:
+                    new_diff = bridge.share_handler.maybe_adjust(worker)
+                    if new_diff is not None:
+                        self._notify("mining.set_difficulty", [new_diff])
+                continue
+            except OSError:
+                return
+            if not line:
+                return
             line = line.strip()
             if not line:
                 continue
@@ -153,22 +340,30 @@ class _StratumHandler(socketserver.StreamRequestHandler):
                 if method == "mining.subscribe":
                     self._reply(rid, [["kaspa/1.0", extranonce], extranonce])
                 elif method == "mining.authorize":
-                    authorized = True
+                    worker = str(params[0]) if params else f"worker-{extranonce}"
+                    ws = bridge.share_handler.worker(worker)
                     self._reply(rid, True)
                     self._notify("set_extranonce", [extranonce])
-                    self._notify("mining.set_difficulty", [1.0])
+                    self._notify("mining.set_difficulty", [ws.difficulty])
                     self._notify("mining.notify", bridge.notify_params())
                 elif method == "mining.submit":
-                    if not authorized:
+                    if worker is None:
                         raise StratumError(24, "Unauthorized")
                     _worker, job_hex, nonce_hex = params[:3]
-                    solved = bridge.submit(int(job_hex, 16), int(nonce_hex, 16))
+                    solved = bridge.submit(worker, int(job_hex, 16), int(nonce_hex, 16))
                     self._reply(rid, True)
+                    # vardiff tick rides the submit path (share_handler.rs
+                    # evaluates per share against the worker's window)
+                    new_diff = bridge.share_handler.maybe_adjust(worker)
+                    if new_diff is not None:
+                        self._notify("mining.set_difficulty", [new_diff])
                     if solved:
                         self._notify("mining.notify", bridge.notify_params())
                 elif method == "mining.get_job":
                     # convenience poll for miners without notify support
                     self._reply(rid, bridge.notify_params())
+                elif method == "mining.get_metrics":
+                    self._reply(rid, bridge.metrics_text())
                 else:
                     self._reply(rid, None, error=[20, f"unknown method {method}", None])
             except StratumError as e:

@@ -123,6 +123,12 @@ class MiningManager:
         cached = self.template_cache.get()
         if cached is not None:
             return cached
+        if timestamp is None:
+            # real templates carry wall-clock time (clamped to pmt+1 by the
+            # builder) — sync-state gating reads sink recency off these
+            import time as _time
+
+            timestamp = int(_time.time() * 1000)
         from kaspa_tpu.consensus.mass import BlockMassLimits
 
         limits = BlockMassLimits.with_shared_limit(self.consensus.params.max_block_mass)

@@ -1,0 +1,3 @@
+from kaspa_tpu.mining.rule_engine import MiningRuleEngine, SyncRateRule
+
+__all__ = ["MiningRuleEngine", "SyncRateRule"]

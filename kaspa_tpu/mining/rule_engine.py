@@ -1,0 +1,128 @@
+"""Mining rule engine: sync-state gating for block-template serving.
+
+Reference: protocol/mining/src/rule_engine.rs + rules/sync_rate_rule.rs.
+``should_mine`` allows template serving only when the node has peer
+connectivity (mainnet/testnet; isolated networks are exempt) AND is
+nearly synced (sink timestamp within a quarter of the difficulty-window
+duration of now) — OR the sync-rate rule fired: the node stopped
+receiving blocks (rate below 50% of expected) while its finality point
+is recent, meaning the network itself stalled and mining should resume
+to revive it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+SNAPSHOT_INTERVAL = 10  # seconds between sync-rate samples (rule_engine.rs:27)
+SYNC_RATE_THRESHOLD = 0.50  # sync_rate_rule.rs:18
+SYNC_RATE_WINDOW_MAX_SIZE = 5 * 60 // SNAPSHOT_INTERVAL
+SYNC_RATE_WINDOW_MIN_THRESHOLD = 60 // SNAPSHOT_INTERVAL
+
+
+class SyncRateRule:
+    """sync_rate_rule.rs: sliding window of (received, expected) blocks."""
+
+    def __init__(self):
+        self.use_sync_rate_rule = False
+        self._samples: deque[tuple[int, float]] = deque()
+        self._total_received = 0
+        self._total_expected = 0.0
+        self._mu = threading.Lock()
+
+    def check_rule(self, received_blocks: int, expected_blocks: float, finality_recent: bool) -> None:
+        with self._mu:
+            self._samples.append((received_blocks, expected_blocks))
+            self._total_received += received_blocks
+            self._total_expected += expected_blocks
+            while len(self._samples) > SYNC_RATE_WINDOW_MAX_SIZE:
+                old_r, old_e = self._samples.popleft()
+                self._total_received -= old_r
+                self._total_expected -= old_e
+            if len(self._samples) < SYNC_RATE_WINDOW_MIN_THRESHOLD:
+                return
+            rate = self._total_received / self._total_expected if self._total_expected > 0 else 1.0
+            # low receive rate + recent finality point => the network (not
+            # this node) stalled: permit mining to revive it
+            self.use_sync_rate_rule = rate < SYNC_RATE_THRESHOLD and finality_recent
+
+
+class MiningRuleEngine:
+    """rule_engine.rs MiningRuleEngine over the python service runtime."""
+
+    def __init__(
+        self,
+        consensus_provider,
+        params,
+        has_peers,
+        require_peers: bool | None = None,
+        allow_unsynced: bool = False,
+        now_ms=lambda: int(time.time() * 1000),
+    ):
+        """``consensus_provider() -> Consensus``; ``has_peers() -> bool``.
+        ``require_peers`` defaults by network name: mainnet/testnet require
+        connectivity, isolated networks (simnet/devnet) do not
+        (rule_engine.rs has_sufficient_peer_connectivity)."""
+        self._consensus = consensus_provider
+        self.params = params
+        self._has_peers = has_peers
+        if require_peers is None:
+            require_peers = any(t in params.name for t in ("mainnet", "testnet"))
+        self.require_peers = require_peers
+        # args.rs enable_unsynced_mining: bypass the sync gate entirely
+        # (simnet/devnet single-node operation mines from genesis)
+        self.allow_unsynced = allow_unsynced
+        self.now_ms = now_ms
+        self.sync_rate_rule = SyncRateRule()
+        self._last_blocks = None
+
+    # --- predicates (rule_engine.rs:106-143) ---
+
+    def has_sufficient_peer_connectivity(self) -> bool:
+        return not self.require_peers or self._has_peers()
+
+    def synced_threshold_ms(self) -> int:
+        """A quarter of the expected difficulty-window duration (~10 min)."""
+        window_ms = (
+            self.params.target_time_per_block
+            * self.params.difficulty_window_size
+            * self.params.difficulty_sample_rate
+        )
+        return window_ms // 4
+
+    def is_nearly_synced(self, sink_timestamp_ms: int) -> bool:
+        return self.now_ms() < sink_timestamp_ms + self.synced_threshold_ms()
+
+    def should_mine(self, sink_timestamp_ms: int) -> bool:
+        if self.allow_unsynced:
+            return True
+        if not self.has_sufficient_peer_connectivity():
+            return False
+        return self.is_nearly_synced(sink_timestamp_ms) or self.sync_rate_rule.use_sync_rate_rule
+
+    def is_sink_recent_and_connected(self, sink_timestamp_ms: int) -> bool:
+        return self.has_sufficient_peer_connectivity() and self.is_nearly_synced(sink_timestamp_ms)
+
+    # --- sampling worker body (rule_engine.rs worker; call every tick) ---
+
+    def sample(self, elapsed_secs: float | None = None) -> None:
+        """One sync-monitor tick: delta of processed bodies vs expected
+        block count for the elapsed period, fed into the sync-rate rule."""
+        c = self._consensus()
+        blocks = c.counters.snapshot().body_counts
+        if self._last_blocks is None:
+            self._last_blocks = blocks
+            return
+        delta = max(0, blocks - self._last_blocks)
+        self._last_blocks = blocks
+        elapsed = elapsed_secs if elapsed_secs is not None else float(SNAPSHOT_INTERVAL)
+        expected = elapsed * 1000.0 / self.params.target_time_per_block
+        fp = c.depth_manager.finality_point(c.sink())
+        try:
+            fp_ts = c.storage.headers.get_timestamp(fp)
+        except KeyError:
+            fp_ts = c.storage.headers.get_timestamp(c.params.genesis.hash)
+        finality_recent = self.now_ms() < fp_ts + self.params.finality_depth * self.params.target_time_per_block
+        self.sync_rate_rule.check_rule(delta, expected, finality_recent)

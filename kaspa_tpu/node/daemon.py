@@ -36,10 +36,13 @@ def parse_args(argv=None) -> DaemonArgs:
     p = argparse.ArgumentParser(prog="kaspa-tpu-node", description="kaspa-tpu full node")
     p.add_argument("--appdir", default=os.path.expanduser("~/.kaspa-tpu"), help="data directory")
     p.add_argument("--rpclisten", default="127.0.0.1:16110", help="host:port for JSON-RPC")
-    p.add_argument("--network", default="simnet", choices=["simnet"], help="network (mainnet params land with PoW sync)")
+    p.add_argument(
+        "--network", default="simnet", choices=["simnet", "mainnet", "testnet", "devnet"],
+        help="network preset (real genesis for mainnet/testnet/devnet; simnet uses the fast test params)",
+    )
     p.add_argument("--bps", type=int, default=2, help="simnet blocks per second")
     p.add_argument("--utxoindex", action=argparse.BooleanOptionalAction, default=True, help="maintain the UTXO index")
-    p.add_argument("--address-prefix", default="kaspasim")
+    p.add_argument("--address-prefix", default=None, help="bech32 prefix (defaults per network)")
     p.add_argument(
         "--persist",
         action=argparse.BooleanOptionalAction,
@@ -47,6 +50,12 @@ def parse_args(argv=None) -> DaemonArgs:
         help="crash-safe consensus persistence under <appdir>/consensus.db (restart resumes)",
     )
     p.add_argument("--listen", default=None, help="host:port for the P2P wire (omit to disable inbound P2P)")
+    p.add_argument("--stratum", default=None, help="host:port for the stratum bridge (omit to disable)")
+    p.add_argument("--stratum-pay-address", default=None, help="address stratum block templates pay to")
+    p.add_argument(
+        "--enable-unsynced-mining", action=argparse.BooleanOptionalAction, default=None,
+        help="serve block templates while unsynced (defaults on for simnet, off otherwise; args.rs enable_unsynced_mining)",
+    )
     p.add_argument("--connect", action="append", default=[], help="peer host:port to dial (repeatable); IBD runs on connect")
     # consensus-parameter overrides (kaspad exposes these for testnets;
     # primarily for pruning/IBD integration tests at small scale)
@@ -184,14 +193,36 @@ class _RpcHandler(socketserver.StreamRequestHandler):
                 pass  # writer exits via stop+empty / OSError
 
 
+DB_VERSION = 1
+# version -> upgrade fn(engine) bringing a DB from `version` to `version+1`
+# (daemon.rs:441-522 upgrade machinery; populated as formats evolve)
+DB_UPGRADES: dict = {}
+
+_NETWORK_PREFIX = {"simnet": "kaspasim", "mainnet": "kaspa", "testnet": "kaspatest", "devnet": "kaspadev"}
+
+
+def _network_params_for(args: DaemonArgs) -> Params:
+    if args.network == "simnet":
+        return simnet_params(bps=args.bps)
+    from kaspa_tpu.consensus import networks
+
+    return {
+        "mainnet": networks.mainnet_params,
+        "testnet": networks.testnet_params,
+        "devnet": networks.devnet_params,
+    }[args.network]()
+
+
 class Daemon:
     """create_core_with_runtime equivalent: wire every service together."""
 
     def __init__(self, args: DaemonArgs, params: Params | None = None):
         self.args = args
         os.makedirs(args.appdir, exist_ok=True)
+        if getattr(args, "address_prefix", None) is None:
+            args.address_prefix = _NETWORK_PREFIX.get(args.network, "kaspasim")
         self.params = _apply_param_overrides(
-            params if params is not None else simnet_params(bps=args.bps), args
+            params if params is not None else _network_params_for(args), args
         )
         self.db = None
         if getattr(args, "persist", False):
@@ -215,6 +246,7 @@ class Daemon:
                     except OSError:
                         pass
             self.db = KvStore(os.path.join(args.appdir, active))
+            self._check_db_version(self.db)
         self.consensus = Consensus(self.params, db=self.db)
         self.node = Node(self.consensus, name="daemon")
         self.node.cmgr._factory = self._staging_factory
@@ -238,6 +270,16 @@ class Daemon:
             connection_manager=self.connection_manager,
             shutdown_fn=lambda: threading.Thread(target=self.stop, daemon=True).start(),
         )
+        from kaspa_tpu.mining import MiningRuleEngine
+
+        allow_unsynced = getattr(args, "enable_unsynced_mining", None)
+        if allow_unsynced is None:
+            allow_unsynced = args.network == "simnet"
+        self.rule_engine = MiningRuleEngine(
+            lambda: self.consensus, self.params, lambda: bool(self.node.peers),
+            allow_unsynced=allow_unsynced,
+        )
+        self.rpc.rule_engine = self.rule_engine
         # consensus/mempool objects are single-writer: RPC dispatch and P2P
         # reader threads all serialize through the node lock (the reference
         # takes consensus sessions; an RW split can come later)
@@ -267,10 +309,61 @@ class Daemon:
                 )
 
         self.tick.register(10.0, sample_metrics)
+
+        def sample_rule_engine():
+            with self._dispatch_lock:
+                self.rule_engine.sample()
+
+        from kaspa_tpu.mining.rule_engine import SNAPSHOT_INTERVAL
+
+        self.tick.register(float(SNAPSHOT_INTERVAL), sample_rule_engine)
         self.rpc.metrics_provider = lambda: self.metrics_data.last
         self.core.bind(self.tick)
         self.core.bind(CallbackService("rpc-server", on_start=self._start_rpc_service, on_stop=self._stop_rpc_service))
         self.core.bind(CallbackService("p2p-server", on_start=self._start_p2p_service, on_stop=self._stop_p2p_service))
+        self.stratum_server = None
+        if getattr(args, "stratum", None):
+            self.core.bind(
+                CallbackService("stratum", on_start=self._start_stratum_service, on_stop=self._stop_stratum_service)
+            )
+
+    def _check_db_version(self, db) -> None:
+        """Stamp fresh DBs; refuse (or upgrade, when a hook exists) stale
+        ones instead of silently misreading a foreign format
+        (daemon.rs:441-522)."""
+        key = b"MTdb_version"
+        net_key = b"MTdb_network"
+        raw = db.engine.get(key)
+        if raw is None:
+            if len(db.engine) > 0:
+                raise SystemExit(
+                    "consensus DB has no version stamp (pre-versioning format); "
+                    "delete the datadir or run the DB tooling to migrate"
+                )
+            db.engine.put(key, str(DB_VERSION).encode())
+            db.engine.put(net_key, self.params.name.encode())
+            return
+        stamped_net = (db.engine.get(net_key) or b"").decode()
+        if stamped_net and stamped_net != self.params.name:
+            raise SystemExit(
+                f"consensus DB belongs to network {stamped_net!r}, not {self.params.name!r}; "
+                "use a separate --appdir per network"
+            )
+        version = int(raw)
+        while version < DB_VERSION:
+            upgrade = DB_UPGRADES.get(version)
+            if upgrade is None:
+                raise SystemExit(
+                    f"consensus DB version {version} is older than {DB_VERSION} "
+                    "and no upgrade path exists; delete the datadir to resync"
+                )
+            upgrade(db.engine)
+            version += 1
+            db.engine.put(key, str(version).encode())
+        if version > DB_VERSION:
+            raise SystemExit(
+                f"consensus DB version {version} is newer than this binary supports ({DB_VERSION})"
+            )
 
     # --- staging consensus (proof IBD) ---
 
@@ -304,6 +397,7 @@ class Daemon:
             shutdown_fn=self.rpc.shutdown_fn,
         )
         self.rpc.metrics_provider = lambda: self.metrics_data.last
+        self.rpc.rule_engine = self.rule_engine
         # live wire subscriptions must survive the swap: keep the old
         # notifier object (listener ids intact) and re-chain it onto the
         # new consensus root
@@ -477,6 +571,42 @@ class Daemon:
         for peer in list(self.node.peers):
             if hasattr(peer, "close"):
                 peer.close()
+
+    def _start_stratum_service(self, _core) -> list:
+        from kaspa_tpu.bridge.stratum import StratumBridge, StratumServer
+        from kaspa_tpu.consensus.processes.coinbase import MinerData
+        from kaspa_tpu.crypto.addresses import Address, pay_to_address_script
+
+        pay = getattr(self.args, "stratum_pay_address", None)
+        if not pay:
+            raise ValueError("--stratum requires --stratum-pay-address")
+        spk = pay_to_address_script(Address.from_string(pay))
+        miner_data = MinerData(spk, b"")
+
+        def template_source():
+            with self._dispatch_lock:
+                # same sync gate as the RPC path (rule_engine.rs should_mine):
+                # stratum miners must not burn hashrate on a stale tip
+                sink_ts = self.consensus.storage.headers.get_timestamp(self.consensus.sink())
+                if not self.rule_engine.should_mine(sink_ts):
+                    raise ValueError("node is not synced: block templates unavailable")
+                return self.mining.get_block_template(miner_data)
+
+        def submit(block):
+            with self._dispatch_lock:
+                return self.node.submit_block(block)
+
+        bridge = StratumBridge(template_source, submit)
+        host, port = self.args.stratum.rsplit(":", 1)
+        self.stratum_server = StratumServer(bridge, host, int(port))
+        self.stratum_server.start()
+        self.log.info("stratum bridge on %s", self.stratum_server.address)
+        return []
+
+    def _stop_stratum_service(self) -> None:
+        if self.stratum_server is not None:
+            self.stratum_server.stop()
+            self.stratum_server = None
 
     def start(self) -> str:
         self.core.start()

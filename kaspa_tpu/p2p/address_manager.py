@@ -173,9 +173,10 @@ class ConnectionManager:
     def _connected_addresses(self) -> set[NetAddress]:
         out = set()
         for peer in list(self.node.peers):
-            addr = getattr(peer, "peer_address", None)
-            if addr is not None:
-                out.add(addr)
+            for attr in ("peer_address", "advertised_address"):
+                addr = getattr(peer, attr, None)
+                if addr is not None:
+                    out.add(addr)
         return out
 
     def _dial(self, address: NetAddress) -> bool:

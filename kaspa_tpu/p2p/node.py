@@ -166,25 +166,34 @@ class Node:
             if isinstance(payload, dict) and payload.get("network", self.consensus.params.name) != self.consensus.params.name:
                 raise ProtocolError(f"network mismatch: {payload.get('network')}")
             if isinstance(payload, dict) and payload.get("id") and payload["id"] == self.id:
-                # gossip taught us our own address and we dialed ourselves
+                # gossip taught us our own address and we dialed ourselves;
+                # scrub the LISTEN address (what gossip stored), not the
+                # dialing socket's ephemeral source address
                 if self.address_manager is not None and getattr(peer, "peer_address", None):
+                    from kaspa_tpu.p2p.address_manager import NetAddress
+
                     self.address_manager.remove(peer.peer_address)
+                    if payload.get("listen_port"):
+                        self.address_manager.remove(
+                            NetAddress(peer.peer_address.ip, payload["listen_port"])
+                        )
                 if hasattr(peer, "close"):
                     peer.close()
                 raise ProtocolError("self-connection detected (matching version id)")
             # record the peer's advertised listen address for gossip
             # (flow_context.rs registers it with the address manager)
             if (
-                self.address_manager is not None
-                and isinstance(payload, dict)
+                isinstance(payload, dict)
                 and payload.get("listen_port")
                 and getattr(peer, "peer_address", None) is not None
             ):
                 from kaspa_tpu.p2p.address_manager import NetAddress
 
-                self.address_manager.add_address(
-                    NetAddress(peer.peer_address.ip, payload["listen_port"])
-                )
+                # remember the peer's listen identity on the peer itself so
+                # the connection manager never back-dials a live inbound peer
+                peer.advertised_address = NetAddress(peer.peer_address.ip, payload["listen_port"])
+                if self.address_manager is not None:
+                    self.address_manager.add_address(peer.advertised_address)
             if not getattr(peer, "version_sent", True):
                 # inbound wire peer: reciprocate with our own version
                 peer.version_sent = True

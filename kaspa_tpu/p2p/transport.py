@@ -128,6 +128,8 @@ class WirePeer:
         while time.monotonic() < deadline:
             if self.handshaken:
                 return True
+            if not self.alive:
+                return False  # peer rejected us (e.g. self-connection)
             time.sleep(0.01)
         return False
 

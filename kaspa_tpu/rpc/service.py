@@ -172,6 +172,14 @@ class RpcCoreService:
     def get_block_template(self, pay_address: str, extra_data: bytes = b"") -> Block:
         from kaspa_tpu.consensus.processes.coinbase import MinerData
 
+        # MiningRuleEngine gate (rule_engine.rs should_mine): templates are
+        # refused while the node is unsynced/disconnected, unless the
+        # sync-rate rule determined the network itself stalled
+        engine = getattr(self, "rule_engine", None)
+        if engine is not None:
+            sink_ts = self.consensus.storage.headers.get_timestamp(self.consensus.sink())
+            if not engine.should_mine(sink_ts):
+                raise RpcError("node is not synced: block templates unavailable")
         addr = Address.from_string(pay_address)
         spk = pay_to_address_script(addr)
         return self.mining.get_block_template(MinerData(spk, extra_data))

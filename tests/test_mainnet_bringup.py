@@ -1,0 +1,164 @@
+"""Mainnet/testnet daemon bring-up, DB versioning, and mining-rule gating.
+
+Reference: kaspad/src/daemon.rs:303-522 (network selection, DB version
+stamping/upgrade refusal) and protocol/mining/src/rule_engine.rs
+(sync-state-gated template serving).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from kaspa_tpu.node.daemon import DB_VERSION, Daemon, parse_args, rpc_call
+from kaspa_tpu.consensus.params import simnet_params
+
+
+def test_daemon_mainnet_bringup(tmp_path):
+    """The daemon starts on real mainnet params: real genesis loads, is
+    queryable by its published hash, templates are refused while unsynced,
+    and a fabricated block is rejected by real-PoW validation."""
+    from kaspa_tpu.consensus.networks import GENESIS_DATA
+
+    args = parse_args(
+        ["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0", "--network", "mainnet", "--no-persist"]
+    )
+    d = Daemon(args)
+    addr = d.start()
+    try:
+        info = rpc_call(addr, "getServerInfo")
+        assert info["network_id"] == "kaspa-mainnet"
+        assert args.address_prefix == "kaspa"
+        genesis_hash = GENESIS_DATA["mainnet"]["hash"]
+        blk = rpc_call(addr, "getBlock", {"hash": genesis_hash})
+        assert blk["hash"] == genesis_hash
+        assert blk["header"]["daa_score"] == GENESIS_DATA["mainnet"]["daa_score"]
+        assert blk["verbose"]["is_chain_block"]
+
+        # MiningRuleEngine: no peers + stale sink => no templates (mainnet
+        # requires connectivity AND sync; rule_engine.rs should_mine)
+        from kaspa_tpu.wallet.account import Account
+
+        pay = Account.from_seed(b"\x04" * 32, prefix="kaspa").addresses()[0]
+        with pytest.raises(RuntimeError, match="not synced"):
+            rpc_call(addr, "getBlockTemplate", {"payAddress": pay})
+
+        # a fabricated extension block fails real PoW validation
+        from kaspa_tpu.consensus.model import Header
+        from kaspa_tpu.consensus.model.block import Block
+        from kaspa_tpu.consensus.consensus import RuleError
+
+        g = bytes.fromhex(genesis_hash)
+        fake = Header(
+            version=1, parents_by_level=[[g]], hash_merkle_root=b"\x00" * 32,
+            accepted_id_merkle_root=b"\x00" * 32, utxo_commitment=b"\x00" * 32,
+            timestamp=GENESIS_DATA["mainnet"]["timestamp"] + 1000,
+            bits=GENESIS_DATA["mainnet"]["bits"], nonce=7,
+            daa_score=GENESIS_DATA["mainnet"]["daa_score"] + 1,
+            blue_work=1, blue_score=1, pruning_point=g,
+        )
+        with pytest.raises(RuleError):
+            d.consensus.validate_and_insert_block(Block(fake, []))
+    finally:
+        d.stop()
+
+
+def test_db_version_stamp_and_refusal(tmp_path):
+    args = parse_args(["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0", "--bps", "2"])
+    d = Daemon(args)
+    d.start()
+    assert d.db.engine.get(b"MTdb_version") == str(DB_VERSION).encode()
+    d.stop()
+
+    # tamper the stamp: the daemon must refuse, not misread the format
+    from kaspa_tpu.storage.kv import KvStore
+
+    db = KvStore(str(tmp_path / "consensus.db"))
+    db.engine.put(b"MTdb_version", b"99")
+    db.close()
+    with pytest.raises(SystemExit, match="newer"):
+        Daemon(parse_args(["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0", "--bps", "2"]))
+
+
+def test_rule_engine_predicates():
+    from kaspa_tpu.mining import MiningRuleEngine
+
+    params = simnet_params(bps=2)
+    clock = [1_000_000_000_000]
+    peers = [False]
+    engine = MiningRuleEngine(
+        lambda: None, params, lambda: peers[0], require_peers=True, now_ms=lambda: clock[0]
+    )
+    fresh = clock[0] - 1000
+    stale = clock[0] - 2 * engine.synced_threshold_ms()
+
+    assert not engine.should_mine(fresh)  # no peers
+    peers[0] = True
+    assert engine.should_mine(fresh)
+    assert not engine.should_mine(stale)  # connected but behind
+
+    # sync-rate rule: enough samples of a stalled network (low receive
+    # rate, recent finality) flips the override and mining resumes
+    for _ in range(6):
+        engine.sync_rate_rule.check_rule(0, 20.0, finality_recent=True)
+    assert engine.sync_rate_rule.use_sync_rate_rule
+    assert engine.should_mine(stale)
+    # ...but not when the finality point is old too (this node is behind)
+    engine2 = MiningRuleEngine(
+        lambda: None, params, lambda: True, require_peers=True, now_ms=lambda: clock[0]
+    )
+    for _ in range(6):
+        engine2.sync_rate_rule.check_rule(0, 20.0, finality_recent=False)
+    assert not engine2.sync_rate_rule.use_sync_rate_rule
+    assert not engine2.should_mine(stale)
+
+
+def test_templates_refused_during_ibd_served_after(tmp_path):
+    """Two nodes: the syncer refuses templates during IBD and serves them
+    once caught up (rule_engine.rs should_mine over sink recency)."""
+    from kaspa_tpu.wallet.account import Account
+
+    now_ms = int(time.time() * 1000)
+    # genesis 2 hours in the past: a fresh node is NOT nearly synced
+    params = simnet_params(bps=2, genesis_timestamp=now_ms - 2 * 3600 * 1000)
+    pay = Account.from_seed(b"\x05" * 32, prefix="kaspasim").addresses()[0]
+
+    args_a = parse_args(
+        ["--appdir", str(tmp_path / "a"), "--rpclisten", "127.0.0.1:0",
+         "--listen", "127.0.0.1:0", "--enable-unsynced-mining"]
+    )
+    a = Daemon(args_a, params=simnet_params(bps=2, genesis_timestamp=now_ms - 2 * 3600 * 1000))
+    addr_a = a.start()
+    args_b = parse_args(
+        ["--appdir", str(tmp_path / "b"), "--rpclisten", "127.0.0.1:0", "--no-enable-unsynced-mining"]
+    )
+    b = Daemon(args_b, params=simnet_params(bps=2, genesis_timestamp=now_ms - 2 * 3600 * 1000))
+    addr_b = b.start()
+    try:
+        # bootstrap miner (explicitly opted into unsynced mining) builds a
+        # chain with wall-clock timestamps
+        for _ in range(6):
+            t = rpc_call(addr_a, "getBlockTemplate", {"payAddress": pay})
+            rpc_call(addr_a, "submitBlockByTemplateHash", {"hash": t["block_hash"]})
+            a.mining.template_cache.clear()
+        sink_a = rpc_call(addr_a, "getBlockDagInfo")["sink"]
+
+        # B, unsynced: refuses templates
+        with pytest.raises(RuntimeError, match="not synced"):
+            rpc_call(addr_b, "getBlockTemplate", {"payAddress": pay})
+
+        # B catches up over the wire, then serves templates
+        b.connect_peer(f"127.0.0.1:{a.p2p_server.address.rsplit(':', 1)[1]}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if rpc_call(addr_b, "getBlockDagInfo")["sink"] == sink_a:
+                break
+            time.sleep(0.3)
+        assert rpc_call(addr_b, "getBlockDagInfo")["sink"] == sink_a
+        t = rpc_call(addr_b, "getBlockTemplate", {"payAddress": pay})
+        assert t["block_hash"]
+    finally:
+        a.stop()
+        b.stop()

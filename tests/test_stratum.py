@@ -1,0 +1,183 @@
+"""Stratum bridge: protocol round-trip, share validation, vardiff, metrics.
+
+Reference strategy: bridge/src/tests.rs + share_handler.rs — an in-process
+stratum client drives subscribe/authorize/notify/submit against a
+daemon-backed bridge over real TCP; share rejection paths (stale,
+duplicate, low difficulty) and the vardiff adjustment loop are exercised
+explicitly (vardiff with an injected clock for determinism).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+
+import pytest
+
+from kaspa_tpu.bridge.stratum import (
+    ShareHandler,
+    StratumBridge,
+    StratumServer,
+    vardiff_compute_next_diff,
+)
+from kaspa_tpu.node.daemon import Daemon, parse_args
+from kaspa_tpu.sim.simulator import Miner
+
+
+class _StratumClient:
+    def __init__(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=30)
+        self.f = self.sock.makefile("rwb")
+        self._id = 0
+        self.notifications = []
+
+    def call(self, method, params):
+        self._id += 1
+        self.f.write((json.dumps({"id": self._id, "method": method, "params": params}) + "\n").encode())
+        self.f.flush()
+        while True:
+            msg = json.loads(self.f.readline())
+            if msg.get("id") == self._id:
+                return msg
+            self.notifications.append(msg)
+
+    def drain_notifications(self, until_method=None, limit=10):
+        out = list(self.notifications)
+        self.notifications.clear()
+        while until_method and not any(m.get("method") == until_method for m in out) and limit:
+            out.append(json.loads(self.f.readline()))
+            limit -= 1
+        return out
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    """Daemon + TCP stratum bridge, simnet (skip-PoW => every share solves)."""
+    miner = Miner(0, random.Random(6))
+    from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+    pay = extract_script_pub_key_address(miner.spk, "kaspasim").to_string()
+    args = parse_args(
+        ["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0",
+         "--bps", "2", "--stratum", "127.0.0.1:0", "--stratum-pay-address", pay]
+    )
+    d = Daemon(args)
+    d.start()
+    yield d, d.stratum_server.address
+    d.stop()
+
+
+def test_stratum_mine_over_tcp(rig):
+    d, addr = rig
+    client = _StratumClient(addr)
+    try:
+        sub = client.call("mining.subscribe", ["kaspa-miner/1.0"])
+        assert sub["error"] is None and sub["result"][1]
+        auth = client.call("mining.authorize", ["worker1", "x"])
+        assert auth["result"] is True
+        notes = client.drain_notifications(until_method="mining.notify")
+        methods = [m.get("method") for m in notes]
+        assert "mining.set_difficulty" in methods and "mining.notify" in methods
+        job = next(m for m in notes if m.get("method") == "mining.notify")["params"]
+        job_id = job[0]
+
+        # simnet skips PoW checks in consensus, but the bridge still runs
+        # the real heavy-hash against the (easy) simnet target: nonce 1 hits
+        before = d.consensus.get_virtual_daa_score()
+        res = client.call("mining.submit", ["worker1", job_id, f"{1:016x}"])
+        assert res["error"] is None and res["result"] is True
+        assert d.consensus.get_virtual_daa_score() == before + 1
+
+        # duplicate share rejected
+        dup = client.call("mining.submit", ["worker1", job_id, f"{1:016x}"])
+        assert dup["error"] is not None and dup["error"][0] == 22
+
+        # stale job rejected
+        stale = client.call("mining.submit", ["worker1", "0000ffff", f"{2:016x}"])
+        assert stale["error"] is not None and stale["error"][0] == 21
+
+        # metrics exposition reflects the outcomes
+        m = client.call("mining.get_metrics", [])["result"]
+        assert "stratum_shares_accepted_total 1" in m
+        assert "stratum_shares_duplicate_total 1" in m
+        assert "stratum_shares_stale_total 1" in m
+        assert "stratum_blocks_found_total 1" in m
+        assert 'stratum_worker_difficulty{worker="worker1"}' in m
+    finally:
+        client.close()
+
+
+def test_vardiff_adjusts_to_hashrate():
+    """share_handler.rs vardiff: a too-fast worker gets a higher difficulty,
+    a silent worker decays toward 1 — deterministic injected clock."""
+    clock = [0.0]
+    sh = ShareHandler(expected_shares_per_min=20.0, initial_difficulty=8.0, now=lambda: clock[0])
+
+    # worker storms 60 shares in 36s => observed 100/min >> 20/min target
+    for _ in range(60):
+        sh.record_share("fast", "accepted")
+    clock[0] = 36.0
+    new = sh.maybe_adjust("fast")
+    assert new is not None and new > 8.0
+    assert sh.worker("fast").window_shares == 0  # window reset
+
+    # worker with zero shares for 90s+ has its difficulty halved
+    sh2 = ShareHandler(expected_shares_per_min=20.0, initial_difficulty=8.0, now=lambda: clock[0])
+    sh2.worker("idle")
+    clock[0] = 36.0 + 95.0
+    sh2.worker("idle").window_start = 36.0
+    new2 = sh2.maybe_adjust("idle")
+    assert new2 is not None and new2 < 8.0
+
+    # in-band rate leaves difficulty untouched
+    sh3 = ShareHandler(expected_shares_per_min=20.0, initial_difficulty=8.0, now=lambda: clock[0])
+    for _ in range(12):
+        sh3.record_share("ok", "accepted")
+    sh3.worker("ok").window_start = clock[0] - 36.0  # 12 shares/36s = 20/min
+    assert sh3.maybe_adjust("ok") is None
+
+
+def test_vardiff_compute_matches_reference_semantics():
+    # below min elapsed / min shares: no adjustment
+    assert vardiff_compute_next_diff(4.0, 2.0, 10.0, 20.0, True) is None
+    # step clamps at 2x up and 0.5x down
+    up = vardiff_compute_next_diff(4.0, 1000.0, 30.0, 20.0, False)
+    assert up == pytest.approx(8.0)  # sqrt(ratio) clamped to 2.0
+    down = vardiff_compute_next_diff(4.0, 3.0, 3600.0, 20.0, False)
+    assert down == pytest.approx(2.0)  # clamped to 0.5x
+    # pow2 clamp snaps toward powers of two, floor 1.0
+    assert vardiff_compute_next_diff(4.0, 1000.0, 30.0, 20.0, True) == 8.0
+    assert vardiff_compute_next_diff(1.0, 0.0, 95.0, 20.0, True) is None  # already at floor
+
+
+def test_low_difficulty_share_rejected():
+    """A share above the worker's target but below nothing is rejected 20."""
+    from kaspa_tpu.consensus.params import simnet_params
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.sim.simulator import Miner as M
+
+    params = simnet_params(bps=2)
+    c = Consensus(params)
+    miner = M(0, random.Random(3))
+    template = c.build_block_template(miner.miner_data, [])
+
+    bridge = StratumBridge(
+        lambda: template, lambda b: "utxo_valid", initial_difficulty=float(1 << 50)
+    )
+    # absurd difficulty => share target far below any real heavy-hash value,
+    # but never below the (easy simnet) network target per the max() floor.
+    # Force a hard network target to expose the share path:
+    template.header.bits = 0x1D00FFFF  # bitcoin-ish hard target
+    template.header.invalidate_cache()
+    job_id, _pre, _ts = bridge.new_job()
+    from kaspa_tpu.bridge.stratum import StratumError
+
+    with pytest.raises(StratumError) as ei:
+        bridge.submit("w", job_id, 12345)
+    assert ei.value.code == 20
+    assert bridge.state.shares_low_diff == 1
