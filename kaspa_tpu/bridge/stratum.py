@@ -313,19 +313,28 @@ class _StratumHandler(socketserver.StreamRequestHandler):
         extranonce = secrets.token_hex(2)
         worker = None
         self.connection.settimeout(self.IDLE_TICK_SECS)
+        # own line buffer: a timeout mid-line must keep the partial bytes
+        # (BufferedReader.readline would discard them on the exception)
+        buf = bytearray()
         while True:
-            try:
-                line = self.rfile.readline()
-            except (_socket.timeout, TimeoutError):
-                if worker is not None:
-                    new_diff = bridge.share_handler.maybe_adjust(worker)
-                    if new_diff is not None:
-                        self._notify("mining.set_difficulty", [new_diff])
+            nl = buf.find(b"\n")
+            if nl < 0:
+                try:
+                    chunk = self.connection.recv(65536)
+                except (_socket.timeout, TimeoutError):
+                    if worker is not None:
+                        new_diff = bridge.share_handler.maybe_adjust(worker)
+                        if new_diff is not None:
+                            self._notify("mining.set_difficulty", [new_diff])
+                    continue
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
                 continue
-            except OSError:
-                return
-            if not line:
-                return
+            line = bytes(buf[:nl])
+            del buf[: nl + 1]
             line = line.strip()
             if not line:
                 continue

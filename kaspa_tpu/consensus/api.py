@@ -1,0 +1,326 @@
+"""ConsensusApi: the formal boundary between consensus and its consumers.
+
+Reference: consensus/core/src/api/mod.rs (the ~87-method ConsensusApi
+trait).  RPC, P2P flows, indexes and tools talk to consensus exclusively
+through this facade — never by reaching into `Consensus` attributes — so
+staging swaps, session locking and store reorganisations cannot silently
+break consumers.  Method names and shapes mirror the trait; methods the
+reference marks unimplemented-by-default raise ConsensusError the same
+way the trait's default bodies panic.
+"""
+
+from __future__ import annotations
+
+
+class ConsensusError(Exception):
+    pass
+
+
+class ConsensusApi:
+    """Facade over one Consensus instance (api/mod.rs:114)."""
+
+    def __init__(self, consensus):
+        self._c = consensus
+
+    # -- block intake (api/mod.rs:114-131) ------------------------------
+
+    def build_block_template(self, miner_data, txs, timestamp=None):
+        return self._c.build_block_template(miner_data, txs, timestamp)
+
+    def validate_and_insert_block(self, block) -> str:
+        return self._c.validate_and_insert_block(block)
+
+    def validate_and_insert_header(self, header) -> str:
+        return self._c.validate_and_insert_header(header)
+
+    # -- mempool support (api/mod.rs:133-163) ----------------------------
+
+    def validate_mempool_transaction(self, tx, entries, pov_daa_score, flags):
+        """``entries``: resolved UtxoEntry list aligned with tx.inputs
+        (transaction_validator.py validate_populated_transaction_and_get_fee)."""
+        return self._c.transaction_validator.validate_populated_transaction_and_get_fee(
+            tx, entries, pov_daa_score, flags
+        )
+
+    def validate_tx_in_isolation(self, tx) -> None:
+        self._c.transaction_validator.validate_tx_in_isolation(tx)
+
+    def calculate_transaction_non_contextual_masses(self, tx):
+        return self._c.transaction_validator.mass_calculator.calc_non_contextual_masses(tx)
+
+    # -- virtual state (api/mod.rs:166-230) ------------------------------
+
+    def get_stats(self) -> dict:
+        return {
+            "block_count": len(self._c.storage.headers) - 1,
+            "header_count": len(self._c.storage.headers),
+            "tx_count": len(self._c.storage.block_transactions),
+            "virtual_daa_score": self.get_virtual_daa_score(),
+        }
+
+    def get_virtual_daa_score(self) -> int:
+        return self._c.get_virtual_daa_score()
+
+    def get_virtual_bits(self) -> int:
+        return self._c.virtual_state.bits
+
+    def get_virtual_past_median_time(self) -> int:
+        return self._c.virtual_state.past_median_time
+
+    def get_virtual_merge_depth_root(self) -> bytes | None:
+        from kaspa_tpu.consensus.reachability import ORIGIN
+
+        sink = self.get_sink()
+        root = self._c.depth_manager.merge_depth_root(sink)
+        return root if root != ORIGIN else None
+
+    def get_sink(self) -> bytes:
+        return self._c.sink()
+
+    def get_sink_timestamp(self) -> int:
+        return self._c.storage.headers.get_timestamp(self.get_sink())
+
+    def get_sink_blue_score(self) -> int:
+        return self._c.storage.ghostdag.get_blue_score(self.get_sink())
+
+    def get_sink_daa_score_timestamp(self) -> tuple[int, int]:
+        sink = self.get_sink()
+        h = self._c.storage.headers.get(sink)
+        return h.daa_score, h.timestamp
+
+    def get_retention_period_root(self) -> bytes:
+        return self._c.pruning_processor.retention_period_root
+
+    def estimate_block_count(self) -> dict:
+        return {"block_count": len(self._c.storage.headers) - 1, "header_count": len(self._c.storage.headers)}
+
+    def get_virtual_chain_from_block(self, low: bytes, added_limit: int | None = None) -> dict:
+        chain = []
+        cur = self.get_sink()
+        while cur != low:
+            chain.append(cur)
+            if cur == self._c.params.genesis.hash:
+                raise ConsensusError(f"block {low.hex()} is not a chain ancestor of the sink")
+            cur = self._c.storage.ghostdag.get_selected_parent(cur)
+        chain.reverse()
+        if added_limit is not None:
+            chain = chain[:added_limit]
+        return {"added": chain, "removed": []}
+
+    def get_virtual_parents(self) -> set[bytes]:
+        return set(self._c.virtual_state.parents)
+
+    def get_virtual_parents_len(self) -> int:
+        return len(self._c.virtual_state.parents)
+
+    def get_virtual_utxos(self, from_outpoint=None, chunk_size: int = 1000):
+        self._c.get_virtual_utxo_view()  # repositions utxo_set at the sink
+        diff = self._c.virtual_utxo_diff
+        merged = {}
+        for op, entry in self._c.utxo_set.items():
+            if op not in diff.remove:
+                merged[op] = entry
+        merged.update(diff.add)
+        items = sorted(merged.items(), key=lambda kv: (kv[0].transaction_id, kv[0].index))
+        if from_outpoint is not None:
+            key = (from_outpoint.transaction_id, from_outpoint.index)
+            items = [kv for kv in items if (kv[0].transaction_id, kv[0].index) > key]
+        return items[:chunk_size]
+
+    def get_tips(self) -> list[bytes]:
+        return sorted(self._c.tips)
+
+    def get_tips_len(self) -> int:
+        return len(self._c.tips)
+
+    def calc_transaction_hash_merkle_root(self, txs) -> bytes:
+        from kaspa_tpu.crypto import merkle
+
+        return merkle.calc_hash_merkle_root(txs)
+
+    # -- pruning / proof (api/mod.rs:303-370, 404-423, 495-567) ----------
+
+    def validate_pruning_proof(self, proof, defender_proof=None):
+        return self._c.pruning_proof_manager.validate_proof(proof, defender_proof)
+
+    def apply_pruning_proof(self, proof, trusted, utxo_set, defender_proof=None) -> None:
+        self._c.pruning_proof_manager.import_pruning_data(proof, trusted, utxo_set, defender_proof)
+
+    def get_pruning_point_proof(self):
+        return self._c.pruning_proof_manager.build_proof()
+
+    def get_pruning_point_anticone_and_trusted_data(self):
+        return self._c.pruning_proof_manager.get_trusted_data()
+
+    def get_pruning_point_utxos(self):
+        return self._c.pruning_processor.pruning_utxo_set
+
+    def pruning_point(self) -> bytes:
+        return self._c.pruning_processor.pruning_point
+
+    def pruning_point_headers(self) -> list:
+        return [self._c.storage.headers.get(h) for h in self._c.pruning_processor.past_pruning_points]
+
+    def get_n_last_pruning_points(self, n: int) -> list[bytes]:
+        return self._c.pruning_processor.past_pruning_points[-n:]
+
+    def finality_point(self) -> bytes:
+        return self._c.depth_manager.finality_point(self.get_sink())
+
+    def inactivity_shortcut_block_for_pov(self, pov_block: bytes) -> bytes:
+        gd = self.get_ghostdag_data(pov_block)
+        target = gd.blue_score - self._c.params.finality_depth - 1
+        if target < 0:
+            return self._c.params.genesis.hash
+        try:
+            return self._c._selected_chain_block_at(target)
+        except Exception as e:  # retention violation => typed facade error
+            raise ConsensusError(str(e)) from e
+
+    # -- topology / reachability (api/mod.rs:376-401) --------------------
+
+    def is_chain_ancestor_of(self, low: bytes, high: bytes) -> bool:
+        return self._c.reachability.is_chain_ancestor_of(low, high)
+
+    def is_chain_block(self, block: bytes) -> bool:
+        return self._c.reachability.is_chain_ancestor_of(block, self.get_sink())
+
+    def get_hashes_between(self, low: bytes, high: bytes, max_blocks: int | None = None):
+        from kaspa_tpu.consensus.processes.sync import SyncManager
+
+        return SyncManager(self._c).antipast_hashes_between(low, high, max_blocks)
+
+    def get_anticone(self, block: bytes) -> list[bytes]:
+        reach = self._c.reachability
+        return [
+            h
+            for h in self._c.storage.headers.keys()
+            if reach.has(h)
+            and h != block
+            and not reach.is_dag_ancestor_of(h, block)
+            and not reach.is_dag_ancestor_of(block, h)
+        ]
+
+    def create_block_locator_from_pruning_point(self, high: bytes, limit: int | None = None):
+        from kaspa_tpu.consensus.processes.sync import SyncManager
+
+        return SyncManager(self._c).create_block_locator_from_pruning_point(
+            high, self.pruning_point(), limit
+        )
+
+    def create_virtual_selected_chain_block_locator(self, low=None, high=None):
+        from kaspa_tpu.consensus.processes.sync import SyncManager
+
+        return SyncManager(self._c).create_block_locator_from_pruning_point(
+            high if high is not None else self.get_sink(),
+            low if low is not None else self.pruning_point(),
+        )
+
+    # -- block data (api/mod.rs:384-470) ----------------------------------
+
+    def get_header(self, block: bytes):
+        if not self._c.storage.headers.has(block):
+            raise ConsensusError(f"header {block.hex()} not found")
+        return self._c.storage.headers.get(block)
+
+    def get_headers_selected_tip(self) -> bytes:
+        return self.get_sink()
+
+    def get_block(self, block: bytes):
+        from kaspa_tpu.consensus.model.block import Block
+
+        if not self._c.storage.block_transactions.has(block):
+            raise ConsensusError(f"block {block.hex()} has no body")
+        return Block(self.get_header(block), self._c.storage.block_transactions.get(block))
+
+    def get_block_even_if_header_only(self, block: bytes):
+        from kaspa_tpu.consensus.model.block import Block
+
+        txs = (
+            self._c.storage.block_transactions.get(block)
+            if self._c.storage.block_transactions.has(block)
+            else []
+        )
+        return Block(self.get_header(block), txs)
+
+    def get_block_body(self, block: bytes):
+        if not self._c.storage.block_transactions.has(block):
+            raise ConsensusError(f"block {block.hex()} has no body")
+        return self._c.storage.block_transactions.get(block)
+
+    def get_block_transactions(self, block: bytes, indices=None):
+        txs = self.get_block_body(block)
+        if indices is None:
+            return txs
+        return [txs[i] for i in indices]
+
+    def get_ghostdag_data(self, block: bytes):
+        if not self._c.storage.ghostdag.has(block):
+            raise ConsensusError(f"no ghostdag data for {block.hex()}")
+        return self._c.storage.ghostdag.get(block)
+
+    def get_block_children(self, block: bytes) -> list[bytes] | None:
+        if not self._c.storage.relations.has(block):
+            return None
+        return self._c.storage.relations.get_children(block)
+
+    def get_block_parents(self, block: bytes) -> list[bytes] | None:
+        if not self._c.storage.relations.has(block):
+            return None
+        return self._c.storage.relations.get_parents(block)
+
+    def get_block_status(self, block: bytes) -> str | None:
+        return self._c.storage.statuses.get(block)
+
+    def get_block_acceptance_data(self, block: bytes):
+        acc = self._c.acceptance_data.try_get(block)
+        if acc is None:
+            raise ConsensusError(f"no acceptance data for {block.hex()}")
+        return acc
+
+    def get_blocks_acceptance_data(self, blocks):
+        return [self.get_block_acceptance_data(b) for b in blocks]
+
+    def get_block_count(self) -> int:
+        return len(self._c.storage.headers) - 1
+
+    def block_exists(self, block: bytes) -> bool:
+        return self._c.storage.headers.has(block)
+
+    # -- misc (api/mod.rs:509-529) ----------------------------------------
+
+    def estimate_network_hashes_per_second(self, start_hash=None, window_size: int = 1000) -> int:
+        """Σ selected-chain work over `window_size` blocks / elapsed time
+        (rpc.rs semantics; the oldest block bounds the span uncounted)."""
+        from kaspa_tpu.consensus.difficulty import calc_work
+
+        c = self._c
+        cur = start_hash if start_hash is not None else self.get_sink()
+        if not c.storage.headers.has(cur):
+            raise ConsensusError("start hash not found")
+        genesis = c.params.genesis.hash
+        total_work = 0
+        last = c.storage.headers.get_timestamp(cur)
+        first = last
+        for _ in range(window_size):
+            if cur == genesis:
+                break
+            total_work += calc_work(c.storage.headers.get_bits(cur))
+            cur = c.storage.ghostdag.get_selected_parent(cur)
+            first = c.storage.headers.get_timestamp(cur)
+        elapsed_ms = max(last - first, 1)
+        return total_work * 1000 // elapsed_ms
+
+    def get_missing_block_body_hashes(self, high: bytes) -> list[bytes]:
+        c = self._c
+        pp = self.pruning_point()
+        if not c.reachability.is_chain_ancestor_of(pp, high):
+            raise ConsensusError("pruning point not in the given chain")
+        out = []
+        for h in c.reachability.forward_chain_iterator(pp, high):
+            if not c.storage.block_transactions.has(h):
+                out.append(h)
+        return out
+
+    def creation_timestamp(self) -> int:
+        return self._c.params.genesis.timestamp

@@ -54,6 +54,12 @@ class RpcCoreService:
         shutdown_fn=None,
     ):
         self.consensus = consensus
+        # the formal consensus boundary (consensus/core/src/api/mod.rs):
+        # primary reads route through the facade; remaining direct
+        # consensus.storage accesses are being migrated method by method
+        from kaspa_tpu.consensus.api import ConsensusApi
+
+        self.api = ConsensusApi(consensus)
         self.mining = mining
         # None => run without an index: address-based queries unavailable
         self.utxoindex = utxoindex
@@ -76,41 +82,39 @@ class RpcCoreService:
     def get_server_info(self) -> ServerInfo:
         return ServerInfo(
             network_id=self.consensus.params.name,
-            virtual_daa_score=self.consensus.get_virtual_daa_score(),
+            virtual_daa_score=self.api.get_virtual_daa_score(),
         )
 
     def get_block_dag_info(self) -> dict:
         vs = self.consensus.virtual_state
         return {
             "network": self.consensus.params.name,
-            "block_count": len(self.consensus.storage.headers) - 1,
-            "tip_hashes": sorted(h.hex() for h in self.consensus.tips),
+            "block_count": self.api.get_block_count(),
+            "tip_hashes": [h.hex() for h in self.api.get_tips()],
             "virtual_parent_hashes": [h.hex() for h in vs.parents],
             "difficulty_bits": vs.bits,
             "past_median_time": vs.past_median_time,
             "virtual_daa_score": vs.daa_score,
-            "sink": self.consensus.sink().hex(),
+            "sink": self.api.get_sink().hex(),
             "pruning_point": self.consensus.params.genesis.hash.hex(),
         }
 
     def get_sink(self) -> bytes:
-        return self.consensus.sink()
+        return self.api.get_sink()
 
     def get_sink_blue_score(self) -> int:
-        return self.consensus.storage.ghostdag.get_blue_score(self.consensus.sink())
+        return self.api.get_sink_blue_score()
 
     def get_virtual_chain_from_block(self, low: bytes) -> dict:
         """Selected-chain path from `low` to the sink + acceptance data."""
-        if not self.consensus.storage.headers.has(low):
+        if not self.api.block_exists(low):
             raise RpcError(f"block {low.hex()} not found")
-        chain = []
-        cur = self.consensus.sink()
-        while cur != low:
-            chain.append(cur)
-            if cur == self.consensus.params.genesis.hash:
-                raise RpcError(f"block {low.hex()} is not a chain ancestor of the sink")
-            cur = self.consensus.storage.ghostdag.get_selected_parent(cur)
-        chain.reverse()
+        from kaspa_tpu.consensus.api import ConsensusError
+
+        try:
+            chain = self.api.get_virtual_chain_from_block(low)["added"]
+        except ConsensusError as e:
+            raise RpcError(str(e)) from e
         return {
             "added_chain_blocks": [h.hex() for h in chain],
             "accepted_transaction_ids": {
@@ -121,9 +125,9 @@ class RpcCoreService:
     # --- blocks ---
 
     def get_block(self, block_hash: bytes, include_transactions: bool = True) -> dict:
-        if not self.consensus.storage.headers.has(block_hash):
+        if not self.api.block_exists(block_hash):
             raise RpcError(f"block {block_hash.hex()} not found")
-        header = self.consensus.storage.headers.get(block_hash)
+        header = self.api.get_header(block_hash)
         out = {
             "hash": block_hash.hex(),
             "header": {
@@ -141,8 +145,8 @@ class RpcCoreService:
                 "pruning_point": header.pruning_point.hex(),
             },
             "verbose": {
-                "status": self.consensus.storage.statuses.get(block_hash),
-                "is_chain_block": self.consensus.reachability.is_chain_ancestor_of(block_hash, self.consensus.sink()),
+                "status": self.api.get_block_status(block_hash),
+                "is_chain_block": self.api.is_chain_block(block_hash),
             },
         }
         if include_transactions and self.consensus.storage.block_transactions.has(block_hash):
@@ -153,7 +157,7 @@ class RpcCoreService:
         """Blocks in the future of `low_hash` (inclusive), or all blocks."""
         hashes = list(self.consensus.storage.headers.keys())
         if low_hash is not None:
-            if not self.consensus.storage.headers.has(low_hash):
+            if not self.api.block_exists(low_hash):
                 raise RpcError(f"block {low_hash.hex()} not found")
             hashes = [h for h in hashes if self.consensus.reachability.is_dag_ancestor_of(low_hash, h)]
         return [self.get_block(h, include_transactions) for h in hashes]
@@ -166,7 +170,7 @@ class RpcCoreService:
             status = self.consensus.validate_and_insert_block(block)
         except RuleError as e:
             raise RpcError(f"block rejected: {e}") from e
-        self.mining.handle_new_block_transactions(block.transactions, self.consensus.get_virtual_daa_score())
+        self.mining.handle_new_block_transactions(block.transactions, self.api.get_virtual_daa_score())
         return status
 
     def get_block_template(self, pay_address: str, extra_data: bytes = b"") -> Block:
@@ -177,7 +181,7 @@ class RpcCoreService:
         # sync-rate rule determined the network itself stalled
         engine = getattr(self, "rule_engine", None)
         if engine is not None:
-            sink_ts = self.consensus.storage.headers.get_timestamp(self.consensus.sink())
+            sink_ts = self.consensus.storage.headers.get_timestamp(self.api.get_sink())
             if not engine.should_mine(sink_ts):
                 raise RpcError("node is not synced: block templates unavailable")
         addr = Address.from_string(pay_address)
@@ -263,10 +267,10 @@ class RpcCoreService:
         sc = self.consensus.transaction_validator.sig_cache
         return {
             "uptime_seconds": time.time() - self.start_time,
-            "block_count": len(self.consensus.storage.headers) - 1,
+            "block_count": self.api.get_block_count(),
             "tip_count": len(self.consensus.tips),
             "mempool_size": len(self.mining.mempool),
-            "virtual_daa_score": self.consensus.get_virtual_daa_score(),
+            "virtual_daa_score": self.api.get_virtual_daa_score(),
             "sig_cache_hits": sc.hits,
             "sig_cache_misses": sc.misses,
             "process_counters": asdict(self.consensus.counters.snapshot()),
@@ -300,7 +304,7 @@ class RpcCoreService:
         }
 
     def get_block_count(self) -> dict:
-        n = len(self.consensus.storage.headers) - 1
+        n = self.api.get_block_count()
         return {"header_count": n, "block_count": n}
 
     def get_sync_status(self) -> bool:
@@ -326,14 +330,14 @@ class RpcCoreService:
     # --- headers / chain queries ---
 
     def get_headers(self, start_hash: bytes, limit: int = 100, is_ascending: bool = True) -> list[dict]:
-        if not self.consensus.storage.headers.has(start_hash):
+        if not self.api.block_exists(start_hash):
             raise RpcError(f"block {start_hash.hex()} not found")
         out = []
         cur = start_hash
         gd = self.consensus.storage.ghostdag
         if is_ascending:
             # follow the selected chain toward the sink
-            sink = self.consensus.sink()
+            sink = self.api.get_sink()
             if not self.consensus.reachability.is_chain_ancestor_of(cur, sink):
                 raise RpcError("start hash is not on the selected chain")
             while len(out) < limit:
@@ -397,29 +401,14 @@ class RpcCoreService:
         return out
 
     def estimate_network_hashes_per_second(self, window_size: int = 1000, start_hash: bytes | None = None) -> int:
-        """Σ chain-block work over the window / elapsed time (rpc.rs).
+        """Σ chain-block work over the window / elapsed time (rpc.rs) —
+        delegated to the ConsensusApi estimator."""
+        from kaspa_tpu.consensus.api import ConsensusError
 
-        The oldest visited block bounds the timespan but its work is NOT
-        counted: N blocks of work were produced over N intervals, and we
-        only observe the interval span back to block N+1."""
-        from kaspa_tpu.consensus.difficulty import calc_work
-
-        cons = self.consensus
-        cur = start_hash if start_hash is not None else cons.sink()
-        if not cons.storage.headers.has(cur):
-            raise RpcError("start hash not found")
-        genesis = cons.params.genesis.hash
-        total_work = 0
-        last = cons.storage.headers.get_timestamp(cur)
-        first = last
-        for _ in range(window_size):
-            if cur == genesis:
-                break
-            total_work += calc_work(cons.storage.headers.get_bits(cur))
-            cur = cons.storage.ghostdag.get_selected_parent(cur)
-            first = cons.storage.headers.get_timestamp(cur)
-        elapsed_ms = max(last - first, 1)
-        return total_work * 1000 // elapsed_ms
+        try:
+            return self.api.estimate_network_hashes_per_second(start_hash, window_size)
+        except ConsensusError as e:
+            raise RpcError(str(e)) from e
 
     def get_block_reward_info(self, block_hash: bytes | None = None) -> dict:
         cons = self.consensus

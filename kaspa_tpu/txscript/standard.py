@@ -92,3 +92,29 @@ def parse_single_push(script: bytes) -> bytes | None:
     if 1 <= op <= 75 and len(script) == 1 + op:
         return script[1:]
     return None
+
+
+def _multisig_script(pub_keys: list[bytes], required: int, check_op: int) -> bytes:
+    from kaspa_tpu.txscript.script_builder import ScriptBuilder
+
+    if not pub_keys:
+        raise ValueError("provided public keys should not be empty")
+    if not (1 <= required <= len(pub_keys)):
+        raise ValueError(f"invalid required signatures {required} for {len(pub_keys)} keys")
+    b = ScriptBuilder().add_i64(required)
+    for k in pub_keys:
+        b.add_data(k)
+    b.add_i64(len(pub_keys))
+    b.add_op(check_op)
+    return b.drain()
+
+
+def multisig_redeem_script(pub_keys32: list[bytes], required: int) -> bytes:
+    """m-of-n schnorr multisig redeem script (standard/multisig.rs:18):
+    <m> <key1> ... <keyn> <n> OpCheckMultiSig."""
+    return _multisig_script(pub_keys32, required, 0xAE)  # OpCheckMultiSig
+
+
+def multisig_redeem_script_ecdsa(pub_keys33: list[bytes], required: int) -> bytes:
+    """ECDSA variant (standard/multisig.rs:44)."""
+    return _multisig_script(pub_keys33, required, 0xA9)  # OpCheckMultiSigECDSA

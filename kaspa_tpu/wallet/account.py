@@ -35,6 +35,133 @@ class DerivedAddress:
         return standard.pay_to_pub_key(self.key.x_only_public_key())
 
 
+@dataclass
+class MultisigAddress:
+    index: int
+    redeem_script: bytes
+    address: Address
+
+    @property
+    def spk(self):
+        return standard.pay_to_script_hash_script(self.redeem_script)
+
+
+class MultisigAccount:
+    """m-of-n schnorr multisig account (wallet/core account/variants/
+    multisig.rs): every cosigner derives the same chain; addresses are
+    P2SH over the ordered-keys redeem script, spends carry m signatures in
+    key order plus the redeem script push."""
+
+    def __init__(self, masters: list[ExtendedKey], required: int, account_index: int = 0, prefix: str = "kaspasim"):
+        if not masters:
+            raise WalletError("multisig needs at least one cosigner key")
+        if not (1 <= required <= len(masters)):
+            raise WalletError(f"invalid m-of-n: {required} of {len(masters)}")
+        self.prefix = prefix
+        self.required = required
+        self._chains = [
+            m.derive_path(kaspa_account_path(account_index)).derive_child(0) for m in masters
+        ]
+        self.receive_keys: list[MultisigAddress] = []
+        self.derive_receive_address()
+
+    @staticmethod
+    def from_seeds(seeds: list[bytes], required: int, account_index: int = 0, prefix: str = "kaspasim") -> "MultisigAccount":
+        return MultisigAccount([ExtendedKey.from_seed(s) for s in seeds], required, account_index, prefix)
+
+    def _keys_at(self, index: int) -> list[ExtendedKey]:
+        return [chain.derive_child(index) for chain in self._chains]
+
+    def derive_receive_address(self) -> MultisigAddress:
+        i = len(self.receive_keys)
+        keys = self._keys_at(i)
+        redeem = standard.multisig_redeem_script(
+            [k.x_only_public_key() for k in keys], self.required
+        )
+        spk = standard.pay_to_script_hash_script(redeem)
+        from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+        derived = MultisigAddress(i, redeem, extract_script_pub_key_address(spk, self.prefix))
+        self.receive_keys.append(derived)
+        return derived
+
+    def addresses(self) -> list[str]:
+        return [d.address.to_string() for d in self.receive_keys]
+
+    def spendable_utxos(self, utxoindex, virtual_daa_score: int, coinbase_maturity: int):
+        out = []
+        for d in self.receive_keys:
+            for outpoint, entry in utxoindex.get_utxos_by_script(d.spk.script).items():
+                if entry.is_coinbase and entry.block_daa_score + coinbase_maturity > virtual_daa_score:
+                    continue
+                out.append((outpoint, entry, d))
+        return out
+
+    def balance(self, utxoindex) -> int:
+        return sum(utxoindex.get_balance_by_script(d.spk.script) for d in self.receive_keys)
+
+    def build_send(
+        self, utxoindex, to_address: str, amount: int, fee: int, virtual_daa_score: int,
+        coinbase_maturity: int, signer_indices: list[int] | None = None, aux=b"\x00" * 32,
+        mass_calculator=None,
+    ) -> Transaction:
+        """Build + sign an m-of-n spend.  ``signer_indices`` picks which
+        cosigners sign (defaults to the first m); signatures are emitted in
+        key order as OpCheckMultiSig verifies them positionally."""
+        if signer_indices is None:
+            signer_indices = list(range(self.required))
+        signer_indices = sorted(set(signer_indices))
+        if len(signer_indices) != self.required:
+            raise WalletError(
+                f"need exactly {self.required} distinct signers, got {len(signer_indices)}"
+            )
+        if any(i < 0 or i >= len(self._chains) for i in signer_indices):
+            raise WalletError(f"signer index out of range (0..{len(self._chains) - 1})")
+        spendables = self.spendable_utxos(utxoindex, virtual_daa_score, coinbase_maturity)
+        spendables.sort(key=lambda t: -t[1].amount)
+        selected, total = [], 0
+        for outpoint, entry, d in spendables:
+            selected.append((outpoint, entry, d))
+            total += entry.amount
+            if total >= amount + fee:
+                break
+        if total < amount + fee:
+            raise WalletError(f"insufficient funds: have {total}, need {amount + fee}")
+
+        from kaspa_tpu.crypto.addresses import pay_to_address_script
+        from kaspa_tpu.txscript.script_builder import ScriptBuilder
+
+        outputs = [TransactionOutput(amount, pay_to_address_script(Address.from_string(to_address)))]
+        change = total - amount - fee
+        if change > 0:
+            outputs.append(TransactionOutput(change, self.receive_keys[0].spk))
+        # the sig-op commit covers the KEY count, not the signature count:
+        # OpCheckMultiSig may attempt a verify per key while matching
+        # signatures positionally (vm._op_checkmultisig_impl; the
+        # reference's static counter charges n for CheckMultiSig too)
+        n_keys = len(self._chains)
+        inputs = [TransactionInput(op, b"", 0, ComputeCommit.sigops(n_keys)) for op, _, _ in selected]
+        tx = Transaction(0, inputs, outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+
+        entries = [e for _, e, _ in selected]
+        if mass_calculator is None:
+            from kaspa_tpu.consensus.mass import MassCalculator
+
+            mass_calculator = MassCalculator()
+        tx.storage_mass = mass_calculator.calc_contextual_masses(tx, entries)
+        reused = chash.SigHashReusedValues()
+        for i, (_, entry, derived) in enumerate(selected):
+            msg = chash.calc_schnorr_signature_hash(tx, entries, i, chash.SIG_HASH_ALL, reused)
+            keys = self._keys_at(derived.index)
+            b = ScriptBuilder()
+            for s_idx in signer_indices:
+                sig = eclib.schnorr_sign(msg, keys[s_idx].key, aux)
+                b.add_data(sig + bytes([chash.SIG_HASH_ALL]))
+            b.add_data(derived.redeem_script)
+            tx.inputs[i].signature_script = b.drain()
+        return tx
+
+
 class Account:
     def __init__(self, master: ExtendedKey, account_index: int = 0, prefix: str = "kaspasim"):
         self.prefix = prefix

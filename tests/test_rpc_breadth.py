@@ -130,3 +130,33 @@ def test_address_manager_ban_expiry():
     assert amgr.is_banned("9.9.9.9")
     clock[0] = 24 * 60 * 60 * 1000 + 1
     assert not amgr.is_banned("9.9.9.9")
+
+
+def test_consensus_api_facade(svc):
+    """The formal ConsensusApi boundary (consensus/core/src/api/mod.rs):
+    consumers read consensus through it, and errors are typed."""
+    import pytest as _pytest
+
+    from kaspa_tpu.consensus.api import ConsensusError
+
+    service, node = svc
+    api = service.api
+    sink = api.get_sink()
+    assert api.block_exists(sink) and api.is_chain_block(sink)
+    assert api.get_block(sink).hash == sink
+    assert api.get_block_status(sink) == "utxo_valid"
+    assert api.get_sink_blue_score() == api.get_ghostdag_data(sink).blue_score
+    assert api.get_virtual_daa_score() >= 1
+    assert sink in api.get_tips()
+    assert api.get_block_count() >= 1
+    daa, ts = api.get_sink_daa_score_timestamp()
+    assert daa >= 1 and ts > 0
+    assert api.pruning_point() == node.consensus.params.genesis.hash
+    chain = api.get_virtual_chain_from_block(node.consensus.params.genesis.hash)
+    assert chain["added"][-1] == sink
+    locator = api.create_virtual_selected_chain_block_locator()
+    assert locator[0] == sink and locator[-1] == api.pruning_point()
+    with _pytest.raises(ConsensusError):
+        api.get_header(b"\x99" * 32)
+    with _pytest.raises(ConsensusError):
+        api.get_block_acceptance_data(b"\x99" * 32)

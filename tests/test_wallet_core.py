@@ -207,3 +207,84 @@ def test_utxo_processor_maturity_and_balance_events():
     up.on_utxos_changed(added=[], removed=[(op2, None)], virtual_daa_score=111)
     assert up.balance() == Balance(mature=500, pending=0)
     assert [e.type for e in events] == [WalletEventType.BALANCE]
+
+
+def test_multisig_account_round_trip():
+    """2-of-3 schnorr multisig (wallet/core multisig variant): fund the
+    P2SH address, spend with 2 cosigners through full consensus validation,
+    and prove 1 signature is insufficient."""
+    import random
+
+    import pytest as _pytest
+
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.consensus.params import simnet_params
+    from kaspa_tpu.index import UtxoIndex
+    from kaspa_tpu.sim.simulator import Miner
+    from kaspa_tpu.wallet.account import Account, MultisigAccount
+
+    params = simnet_params(bps=2)
+    c = Consensus(params)
+    index = UtxoIndex(c)
+    miner = Miner(0, random.Random(8))
+
+    funder = Account.from_seed(b"\x11" * 32)
+    ms = MultisigAccount.from_seeds([b"\x21" * 32, b"\x22" * 32, b"\x23" * 32], required=2)
+    ms_addr = ms.addresses()[0]
+
+    def mine(txs=None):
+        blk = c.build_block_template(miner.miner_data, txs or [])
+        assert c.validate_and_insert_block(blk) in ("utxo_valid", "utxo_pending")
+        return blk
+
+    # mature some miner coinbases, then fund the multisig address
+    fund_pay = funder.addresses()[0]
+    for _ in range(params.coinbase_maturity + 2):
+        blk = c.build_block_template(
+            __import__("kaspa_tpu.consensus.processes.coinbase", fromlist=["MinerData"]).MinerData(
+                funder.receive_keys[0].spk, b""
+            ),
+            [],
+        )
+        assert c.validate_and_insert_block(blk) in ("utxo_valid", "utxo_pending")
+    daa = c.get_virtual_daa_score()
+    fund_tx = funder.build_send(index, ms_addr, 5_000_000_000, 10_000, daa, params.coinbase_maturity)
+    mine([fund_tx])
+    mine()  # a block's txs are accepted by the NEXT chain block merging it
+    assert ms.balance(index) == 5_000_000_000
+
+    # 2-of-3 spend back to the funder validates through consensus
+    daa = c.get_virtual_daa_score()
+    spend = ms.build_send(index, fund_pay, 1_000_000_000, 10_000, daa, params.coinbase_maturity,
+                          signer_indices=[0, 2])
+    mine([spend])
+    mine()
+    assert ms.balance(index) == 5_000_000_000 - 1_000_000_000 - 10_000
+
+    # requesting fewer signers than m is refused at build time ...
+    daa = c.get_virtual_daa_score()
+    from kaspa_tpu.wallet.account import WalletError
+
+    with _pytest.raises(WalletError):
+        ms.build_send(index, fund_pay, 1_000, 1_000, daa, params.coinbase_maturity, signer_indices=[1])
+    # ... and an under-signed script (1 sig grafted into a 2-of-3 redeem)
+    # fails consensus validation: the block template drops the tx
+    under = ms.build_send(index, fund_pay, 1_000_000_000, 10_000, daa, params.coinbase_maturity)
+    from kaspa_tpu.txscript.script_builder import ScriptBuilder
+    from kaspa_tpu.consensus import hashing as chash2
+
+    for i, inp in enumerate(under.inputs):
+        # strip to a single signature: re-parse pushes and keep sig1+redeem
+        script = inp.signature_script
+        # first push: 65-byte sig blob (0x41 <sig+type>); last push: redeem
+        sig1 = script[1:66]
+        redeem = ms.receive_keys[0].redeem_script
+        b = ScriptBuilder()
+        b.add_data(sig1)
+        b.add_data(redeem)
+        inp.signature_script = b.drain()
+    # explicit test-harness txs bypass template filtering; consensus chain
+    # verification must disqualify the block carrying the under-signed tx
+    blk = c.build_block_template(miner.miner_data, [under])
+    assert len(blk.transactions) == 2
+    assert c.validate_and_insert_block(blk) == "disqualified" 
