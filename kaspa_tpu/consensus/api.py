@@ -114,18 +114,33 @@ class ConsensusApi:
         return len(self._c.virtual_state.parents)
 
     def get_virtual_utxos(self, from_outpoint=None, chunk_size: int = 1000):
+        import heapq
+
         self._c.get_virtual_utxo_view()  # repositions utxo_set at the sink
         diff = self._c.virtual_utxo_diff
-        merged = {}
-        for op, entry in self._c.utxo_set.items():
-            if op not in diff.remove:
-                merged[op] = entry
-        merged.update(diff.add)
-        items = sorted(merged.items(), key=lambda kv: (kv[0].transaction_id, kv[0].index))
-        if from_outpoint is not None:
-            key = (from_outpoint.transaction_id, from_outpoint.index)
-            items = [kv for kv in items if (kv[0].transaction_id, kv[0].index) > key]
-        return items[:chunk_size]
+        after = (
+            (from_outpoint.transaction_id, from_outpoint.index) if from_outpoint is not None else None
+        )
+
+        def qualifies(op):
+            return (after is None or (op.transaction_id, op.index) > after) and op not in diff.remove
+
+        # O(N + chunk log chunk): one filtered pass + partial selection,
+        # never a full materialized sort of the whole UTXO set per page
+        candidates = (
+            (op, e) for op, e in self._c.utxo_set.items() if qualifies(op) and op not in diff.add
+        )
+        merged = list(
+            heapq.nsmallest(chunk_size, candidates, key=lambda kv: (kv[0].transaction_id, kv[0].index))
+        )
+        extra = [
+            (op, e)
+            for op, e in diff.add.items()
+            if after is None or (op.transaction_id, op.index) > after
+        ]
+        merged.extend(extra)
+        merged.sort(key=lambda kv: (kv[0].transaction_id, kv[0].index))
+        return merged[:chunk_size]
 
     def get_tips(self) -> list[bytes]:
         return sorted(self._c.tips)
@@ -164,6 +179,19 @@ class ConsensusApi:
     def get_n_last_pruning_points(self, n: int) -> list[bytes]:
         return self._c.pruning_processor.past_pruning_points[-n:]
 
+    def get_finality_conflicts(self) -> dict[bytes, str]:
+        """Observed finality conflicts: violating tip -> active|resolved."""
+        return dict(self._c._finality_conflicts)
+
+    def acknowledge_finality_conflicts(self) -> list[bytes]:
+        """Mark every active conflict resolved (operator action); returns
+        the acknowledged tips.  The entries stay tracked so the virtual
+        resolver does not re-notify them."""
+        acked = [t for t, st in self._c._finality_conflicts.items() if st == "active"]
+        for t in acked:
+            self._c._finality_conflicts[t] = "resolved"
+        return acked
+
     def finality_point(self) -> bytes:
         return self._c.depth_manager.finality_point(self.get_sink())
 
@@ -191,15 +219,25 @@ class ConsensusApi:
         return SyncManager(self._c).antipast_hashes_between(low, high, max_blocks)
 
     def get_anticone(self, block: bytes) -> list[bytes]:
+        """BFS down from the tips, pruning at ancestors of ``block`` — the
+        visit set is future(block) + anticone(block) + the pruned frontier,
+        not the whole header store (traversal_manager anticone walk)."""
         reach = self._c.reachability
-        return [
-            h
-            for h in self._c.storage.headers.keys()
-            if reach.has(h)
-            and h != block
-            and not reach.is_dag_ancestor_of(h, block)
-            and not reach.is_dag_ancestor_of(block, h)
-        ]
+        relations = self._c.storage.relations
+        out, seen = [], set()
+        queue = [t for t in self._c.tips if reach.has(t)]
+        seen.update(queue)
+        while queue:
+            h = queue.pop()
+            if h == block or reach.is_dag_ancestor_of(h, block):
+                continue  # h and its whole past are in past(block) or block
+            if not reach.is_dag_ancestor_of(block, h):
+                out.append(h)
+            for p in relations.get_parents(h) if relations.has(h) else []:
+                if p not in seen and reach.has(p):
+                    seen.add(p)
+                    queue.append(p)
+        return out
 
     def create_block_locator_from_pruning_point(self, high: bytes, limit: int | None = None):
         from kaspa_tpu.consensus.processes.sync import SyncManager

@@ -65,6 +65,15 @@ def _neg_bytes(b: bytes) -> bytes:
     return bytes(255 - x for x in b)
 
 
+def _FinalityConflictNotification(tip: bytes, finality_point: bytes):
+    from kaspa_tpu.notify.notifier import Notification
+
+    return Notification(
+        "finality-conflict",
+        {"violating_tip": tip.hex(), "finality_point": finality_point.hex()},
+    )
+
+
 @dataclass
 class VirtualState:
     """reference: consensus/src/model/stores/virtual_state.rs"""
@@ -166,6 +175,12 @@ class Consensus:
         self._acc_added: dict = {}
         self._acc_removed: dict = {}
         self.reach_mergesets = self.storage.reach_mergesets
+
+        # finality conflicts observed (tips heavier than the sink that
+        # exclude the finality point): tip -> "active" | "resolved".
+        # Entries are never dropped while the tip remains heavier, so an
+        # acknowledged conflict is not re-notified every resolve cycle
+        self._finality_conflicts: dict[bytes, str] = {}
 
         # KIP-21: materialized lane state + selected-chain index, both moved
         # in lock-step with utxo_position (smt-store / selected_chain_store)
@@ -526,7 +541,43 @@ class Consensus:
                 bw = self.storage.ghostdag.get_blue_work(h)
                 _hq.heappush(heap, ((-bw, _neg_bytes(h)), h))
 
+        # finality filter (processor.rs:296-316): only tips in the future of
+        # the virtual finality point can become the sink; a heavier tip on
+        # the wrong side is a FINALITY CONFLICT — surface it, never adopt it
+        finality_point = None
+        if self.virtual_state is not None:
+            pp = self.pruning_processor.pruning_point
+            fp = self.depth_manager.calc_finality_point(self.virtual_state.ghostdag_data, pp)
+            # virtual_finality_point (processor.rs:386-391): the finality
+            # point only anchors when it sits on the pruning point's chain;
+            # otherwise the pruning point itself is the anchor (e.g. right
+            # after a trusted proof import, where the computed point falls
+            # into pruned/disconnected history)
+            if (
+                fp != ORIGIN
+                and self.reachability.has(fp)
+                and self.reachability.is_chain_ancestor_of(pp, fp)
+            ):
+                finality_point = fp
+            elif self.reachability.has(pp):
+                finality_point = pp
+        allowed_tips = []
         for t in self.tips:
+            if finality_point is not None and not self.reachability.is_dag_ancestor_of(finality_point, t):
+                if (
+                    t not in self._finality_conflicts
+                    and self.storage.ghostdag.get_blue_work(t)
+                    > self.storage.ghostdag.get_blue_work(self.sink())
+                ):
+                    # a chain heavier than ours that excludes our finality
+                    # point: requires manual intervention (flow_context.rs
+                    # on_finality_conflict -> FinalityConflict notification)
+                    self._finality_conflicts[t] = "active"
+                    self.notification_root.notify(
+                        _FinalityConflictNotification(t, finality_point)
+                    )
+                continue
+            allowed_tips.append(t)
             push(t)
         sink = None
         while heap:
@@ -542,16 +593,18 @@ class Consensus:
         # (inquirer.rs hint_virtual_selected_parent)
         self.reachability.hint_virtual_selected_parent(sink)
 
-        # virtual parents: bounded count of chain-qualified tips, sink first
-        # (pick_virtual_parents, processor.rs:1013-1146; bounded-merge checks
-        # arrive with the merge-depth milestone)
+        # virtual parents: bounded count of chain-qualified tips from the
+        # finality-filtered set, sink first (pick_virtual_parents,
+        # processor.rs:1013-1146) — virtual must never merge a tip that
+        # excludes the finality point
         others = sorted(
-            (t for t in self.tips if t != sink and self._ensure_chain_utxo_valid(t)),
+            (t for t in allowed_tips if t != sink and self._ensure_chain_utxo_valid(t)),
             key=lambda h: (self.storage.ghostdag.get_blue_work(h), h),
             reverse=True,
         )
         virtual_parents = [sink] + others[: self.params.max_block_parents - 1]
         vgd = self.ghostdag_manager.ghostdag(virtual_parents)
+        assert vgd.selected_parent == sink, "virtual selected parent must be the sink"
 
         # compute virtual window state
         daa_window = self.window_manager.block_daa_window(vgd)

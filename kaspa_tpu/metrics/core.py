@@ -125,6 +125,9 @@ def collect_snapshot(consensus, mining, perf_monitor, p2p_node=None, wire_stats=
     v["node_chain_blocks_processed_count"] = counters.chain_block_counts
     v["node_mass_processed_count"] = counters.mass_counts
     v["node_database_blocks_count"] = len(consensus.storage.block_transactions)
+    if consensus.storage.db is not None and hasattr(consensus.storage.db, "mem_stats"):
+        for k2, v2 in consensus.storage.db.mem_stats().items():
+            v[f"node_database_{k2}"] = v2
     v["node_database_headers_count"] = len(consensus.storage.headers)
     v["network_mempool_size"] = len(mining.mempool)
     v["network_tip_hashes_count"] = len(consensus.tips)

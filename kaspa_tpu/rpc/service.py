@@ -420,7 +420,23 @@ class RpcCoreService:
         return {"block_hash": h.hex(), "daa_score": daa, "subsidy": subsidy}
 
     def resolve_finality_conflict(self, finality_block_hash: bytes) -> dict:
-        raise RpcError("no active finality conflict to resolve")
+        """Operator acknowledgement of a finality conflict (rpc.rs
+        resolve_finality_conflict): clears the tracked conflicts and emits
+        FinalityConflictResolved; adopting the competing chain requires a
+        resync from a peer carrying it (the reference likewise requires
+        manual intervention)."""
+        acked = self.api.acknowledge_finality_conflicts()
+        if not acked:
+            raise RpcError("no active finality conflict to resolve")
+        from kaspa_tpu.notify.notifier import Notification
+
+        self.consensus.notification_root.notify(
+            Notification(
+                "finality-conflict-resolved",
+                {"finality_block_hash": finality_block_hash.hex()},
+            )
+        )
+        return {}
 
     _RETURN_ADDRESS_DAA_SLACK = 2_000  # search radius around the claimed score
 

@@ -75,6 +75,11 @@ def cmd_stats(store: KvStore) -> int:
         print(f"{name:<24}{n:>10}{size:>14}")
     print(f"{'TOTAL':<24}{total_keys:>10}{total_bytes:>14}")
     print(f"log size on disk: {store.size_on_disk()} bytes")
+    ms = store.mem_stats()
+    print(
+        f"arena: {ms['arena_slabs']} slabs, {ms['arena_reserved_bytes']} reserved, "
+        f"{ms['arena_in_use_bytes']} in use, {ms['arena_large_allocs']} large allocs"
+    )
     return 0
 
 

@@ -18,16 +18,22 @@ import struct
 import subprocess
 import threading
 
-_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvstore", "kvstore.cc")
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvstore", "libkvstore.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvstore")
+_SRC = os.path.join(_NATIVE_DIR, "kvstore.cc")
+_HEADERS = (os.path.join(_NATIVE_DIR, "arena.h"),)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libkvstore.so")
 _BUILD_LOCK = threading.Lock()
 
 
+def _src_mtime() -> float:
+    return max(os.path.getmtime(f) for f in (_SRC, *_HEADERS) if os.path.exists(f))
+
+
 def _build_native():
-    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= _src_mtime():
         return _LIB_PATH
     with _BUILD_LOCK:
-        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= _src_mtime():
             return _LIB_PATH
         tmp = _LIB_PATH + f".tmp{os.getpid()}"
         subprocess.run(
@@ -65,6 +71,7 @@ class _NativeEngine:
         ]
         lib.kv_count_prefix.restype = ctypes.c_uint64
         lib.kv_count_prefix.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_mem_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
         lib.kv_compact.argtypes = [ctypes.c_void_p]
         self._lib = lib
         self._h = lib.kv_open(path.encode())
@@ -141,6 +148,18 @@ class _NativeEngine:
 
     def count_prefix(self, prefix: bytes) -> int:
         return self._lib.kv_count_prefix(self._h, prefix, len(prefix))
+
+    def mem_stats(self) -> dict:
+        """Slab-arena stats of the resident index (the kaspa-alloc
+        visibility story: allocator behavior is observable)."""
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.kv_mem_stats(self._h, out)
+        return {
+            "arena_slabs": out[0],
+            "arena_reserved_bytes": out[1],
+            "arena_in_use_bytes": out[2],
+            "arena_large_allocs": out[3],
+        }
 
     def compact(self):
         rc = self._lib.kv_compact(self._h)
@@ -249,6 +268,9 @@ class _PythonEngine:
     def count_prefix(self, prefix: bytes) -> int:
         return sum(1 for k in self.index if k.startswith(prefix))
 
+    def mem_stats(self) -> dict:
+        return {"arena_slabs": 0, "arena_reserved_bytes": 0, "arena_in_use_bytes": 0, "arena_large_allocs": 0}
+
     def compact(self):
         pass
 
@@ -278,6 +300,9 @@ class KvStore:
 
     def batch(self):
         return _Batch(self.engine)
+
+    def mem_stats(self) -> dict:
+        return self.engine.mem_stats()
 
     def size_on_disk(self) -> int:
         try:

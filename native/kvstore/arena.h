@@ -1,0 +1,126 @@
+// kaspa-tpu native allocator: size-classed slab arena for the KV index.
+//
+// The reference ships kaspa-alloc (mimalloc as the global allocator +
+// activation hooks) because the node's hot allocation path — millions of
+// small key/node allocations in the store layer — dominates allocator
+// behavior.  Here the same role is played where it matters in THIS
+// runtime: the native engine's resident structures (map nodes + key
+// bytes) allocate from size-class freelists carved out of 64 KiB slabs,
+// mimalloc's small-object strategy in miniature:
+//
+// - size classes in 16-byte steps up to 512 bytes (beyond that, malloc);
+// - per-class freelists, O(1) alloc/free, no per-object headers;
+// - slabs are never returned to the OS while the store lives (freed
+//   objects recycle within their class), eliminating heap churn and
+//   fragmentation for the long-running node process;
+// - stats (slab count, bytes reserved/in-use) surface through the C ABI
+//   into the python metrics snapshot (kaspa-alloc's visibility story).
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <vector>
+
+namespace kvarena {
+
+constexpr size_t kSlabBytes = 64 * 1024;
+constexpr size_t kStep = 16;
+constexpr size_t kMaxSmall = 512;
+constexpr size_t kNumClasses = kMaxSmall / kStep;  // 32 classes
+
+struct Stats {
+  uint64_t slabs = 0;
+  uint64_t reserved_bytes = 0;
+  uint64_t in_use_bytes = 0;
+  uint64_t large_allocs = 0;  // fell through to malloc
+};
+
+class SlabArena {
+ public:
+  SlabArena() : free_lists_(kNumClasses, nullptr), bump_(nullptr), bump_left_(0) {}
+
+  ~SlabArena() {
+    for (void* s : slabs_) std::free(s);
+  }
+
+  SlabArena(const SlabArena&) = delete;
+  SlabArena& operator=(const SlabArena&) = delete;
+
+  void* alloc(size_t n) {
+    if (n == 0) n = 1;
+    if (n > kMaxSmall) {
+      stats_.large_allocs++;
+      return std::malloc(n);
+    }
+    size_t cls = (n + kStep - 1) / kStep - 1;
+    size_t sz = (cls + 1) * kStep;
+    stats_.in_use_bytes += sz;
+    if (free_lists_[cls]) {
+      void* p = free_lists_[cls];
+      free_lists_[cls] = *reinterpret_cast<void**>(p);
+      return p;
+    }
+    if (bump_left_ < sz) {
+      void* slab = std::malloc(kSlabBytes);
+      slabs_.push_back(slab);
+      stats_.slabs++;
+      stats_.reserved_bytes += kSlabBytes;
+      bump_ = static_cast<char*>(slab);
+      bump_left_ = kSlabBytes;
+    }
+    void* p = bump_;
+    bump_ += sz;
+    bump_left_ -= sz;
+    return p;
+  }
+
+  void free(void* p, size_t n) {
+    if (p == nullptr) return;
+    if (n == 0) n = 1;
+    if (n > kMaxSmall) {
+      std::free(p);
+      return;
+    }
+    size_t cls = (n + kStep - 1) / kStep - 1;
+    stats_.in_use_bytes -= (cls + 1) * kStep;
+    *reinterpret_cast<void**>(p) = free_lists_[cls];
+    free_lists_[cls] = p;
+  }
+
+  const Stats& stats() const { return stats_; }
+
+ private:
+  std::vector<void*> slabs_;
+  std::vector<void*> free_lists_;
+  char* bump_;
+  size_t bump_left_;
+  Stats stats_;
+};
+
+// std-compatible allocator adapter binding a container to one SlabArena.
+template <typename T>
+struct ArenaAllocator {
+  using value_type = T;
+
+  SlabArena* arena;
+
+  explicit ArenaAllocator(SlabArena* a) : arena(a) {}
+  template <typename U>
+  ArenaAllocator(const ArenaAllocator<U>& other) : arena(other.arena) {}
+
+  T* allocate(size_t n) { return static_cast<T*>(arena->alloc(n * sizeof(T))); }
+  void deallocate(T* p, size_t n) { arena->free(p, n * sizeof(T)); }
+
+  template <typename U>
+  bool operator==(const ArenaAllocator<U>& o) const {
+    return arena == o.arena;
+  }
+  template <typename U>
+  bool operator!=(const ArenaAllocator<U>& o) const {
+    return arena != o.arena;
+  }
+};
+
+}  // namespace kvarena

@@ -23,9 +23,13 @@
 #include <fcntl.h>
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <string>
+#include <string_view>
 #include <map>
 #include <vector>
+
+#include "arena.h"
 
 namespace {
 
@@ -60,9 +64,40 @@ struct Store {
   FILE* log = nullptr;
   int read_fd = -1;            // separate fd for pread (no seek races with appends)
   uint64_t file_end = 0;       // durable end of log (next batch frame starts here)
-  std::map<std::string, ValueRef> index;
+  // resident index: keys interned in the slab arena, rb-tree nodes
+  // allocated from it too (the kaspa-alloc role for this runtime)
+  std::unique_ptr<kvarena::SlabArena> arena = std::make_unique<kvarena::SlabArena>();
+  using IndexAlloc = kvarena::ArenaAllocator<std::pair<const std::string_view, ValueRef>>;
+  using Index = std::map<std::string_view, ValueRef, std::less<>, IndexAlloc>;
+  Index index{std::less<>(), IndexAlloc(arena.get())};
   std::string pending;         // current batch payload under construction
   bool in_batch = false;
+
+  std::string_view intern_key(const char* k, uint32_t klen) {
+    char* p = static_cast<char*>(arena->alloc(klen));
+    memcpy(p, k, klen);
+    return std::string_view(p, klen);
+  }
+
+  void upsert(const char* k, uint32_t klen, ValueRef ref) {
+    std::string_view key(k, klen);
+    auto it = index.find(key);
+    if (it != index.end()) {
+      it->second = ref;
+    } else {
+      index.emplace(intern_key(k, klen), ref);
+    }
+  }
+
+  void erase_key(const char* k, uint32_t klen) {
+    std::string_view key(k, klen);
+    auto it = index.find(key);
+    if (it != index.end()) {
+      std::string_view stored = it->first;
+      index.erase(it);
+      arena->free(const_cast<char*>(stored.data()), stored.size());
+    }
+  }
 
   int replay() {
     FILE* f = fopen(path.c_str(), "rb");
@@ -92,12 +127,12 @@ struct Store {
         memcpy(&vlen, &buf[off + 5], 4);
         off += 9;
         if (off + klen + vlen > plen) { ok = false; break; }
-        std::string key(reinterpret_cast<char*>(&buf[off]), klen);
+        const char* kptr = reinterpret_cast<char*>(&buf[off]);
         off += klen;
         if (op == 0) {
-          index[key] = ValueRef{payload_base + off, vlen, false};
+          upsert(kptr, klen, ValueRef{payload_base + off, vlen, false});
         } else {
-          index.erase(key);
+          erase_key(kptr, klen);
         }
         off += vlen;
       }
@@ -133,11 +168,10 @@ struct Store {
     memcpy(p + 5, &vlen, 4);
     memcpy(p + 9, key, klen);
     if (vlen) memcpy(p + 9 + klen, val, vlen);
-    std::string k(key, klen);
     if (op == 0) {
-      index[k] = ValueRef{base + 9 + klen, vlen, true};
+      upsert(key, klen, ValueRef{base + 9 + klen, vlen, true});
     } else {
-      index.erase(k);
+      erase_key(key, klen);
     }
   }
 
@@ -159,7 +193,7 @@ struct Store {
       memcpy(&klen, &pending[off + 1], 4);
       memcpy(&vlen, &pending[off + 5], 4);
       off += 9;
-      std::string key(&pending[off], klen);
+      std::string_view key(&pending[off], klen);
       off += klen;
       if (op == 0) {
         auto it = index.find(key);
@@ -238,7 +272,7 @@ int kv_delete(void* h, const char* key, uint32_t klen) {
 // returns value length, or -1 if missing; copies up to cap bytes into out
 int64_t kv_get(void* h, const char* key, uint32_t klen, char* out, uint32_t cap) {
   Store* s = static_cast<Store*>(h);
-  auto it = s->index.find(std::string(key, klen));
+  auto it = s->index.find(std::string_view(key, klen));
   if (it == s->index.end()) return -1;
   if (out && cap) {
     if (!s->read_value(it->second, out, cap)) return -2;
@@ -281,10 +315,10 @@ void kv_iterate(void* h, kv_iter_cb cb, void* ctx) {
 void kv_iterate_prefix(void* h, const char* prefix, uint32_t plen, int want_values, kv_iter_cb cb,
                        void* ctx) {
   Store* s = static_cast<Store*>(h);
-  std::string pfx(prefix, plen);
+  std::string_view pfx(prefix, plen);
   std::string buf;
   for (auto it = s->index.lower_bound(pfx); it != s->index.end(); ++it) {
-    if (it->first.compare(0, plen, pfx) != 0) break;
+    if (it->first.substr(0, plen) != pfx) break;
     if (want_values) {
       buf.resize(it->second.len);
       if (it->second.len && !s->read_value(it->second, &buf[0], it->second.len)) continue;
@@ -297,13 +331,22 @@ void kv_iterate_prefix(void* h, const char* prefix, uint32_t plen, int want_valu
 
 uint64_t kv_count_prefix(void* h, const char* prefix, uint32_t plen) {
   Store* s = static_cast<Store*>(h);
-  std::string pfx(prefix, plen);
+  std::string_view pfx(prefix, plen);
   uint64_t n = 0;
   for (auto it = s->index.lower_bound(pfx); it != s->index.end(); ++it) {
-    if (it->first.compare(0, plen, pfx) != 0) break;
+    if (it->first.substr(0, plen) != pfx) break;
     n++;
   }
   return n;
+}
+
+// arena stats: [slabs, reserved_bytes, in_use_bytes, large_allocs]
+void kv_mem_stats(void* h, uint64_t* out4) {
+  const kvarena::Stats& st = static_cast<Store*>(h)->arena->stats();
+  out4[0] = st.slabs;
+  out4[1] = st.reserved_bytes;
+  out4[2] = st.in_use_bytes;
+  out4[3] = st.large_allocs;
 }
 
 // compaction: rewrite the log with only live records (one atomic batch)
@@ -313,8 +356,11 @@ int kv_compact(void* h) {
   std::string tmp = s->path + ".compact";
   FILE* nf = fopen(tmp.c_str(), "wb");
   if (!nf) return -30;
-  Store out;
-  out.log = nf;
+  // one frame holding every live record; new value offsets recorded in
+  // index order so a second pass can rebind without touching keys/arena
+  std::string payload;
+  std::vector<uint64_t> new_offsets;
+  new_offsets.reserve(s->index.size());
   std::string buf;
   for (const auto& kv : s->index) {
     buf.resize(kv.second.len);
@@ -323,10 +369,24 @@ int kv_compact(void* h) {
       remove(tmp.c_str());
       return -34;
     }
-    out.append_record(0, kv.first.data(), static_cast<uint32_t>(kv.first.size()), buf.data(),
-                      kv.second.len);
+    uint32_t klen = static_cast<uint32_t>(kv.first.size());
+    uint32_t vlen = kv.second.len;
+    size_t base = payload.size();
+    payload.resize(base + 9 + klen + vlen);
+    char* p = &payload[base];
+    p[0] = 0;
+    memcpy(p + 1, &klen, 4);
+    memcpy(p + 5, &vlen, 4);
+    memcpy(p + 9, kv.first.data(), klen);
+    if (vlen) memcpy(p + 9 + klen, buf.data(), vlen);
+    new_offsets.push_back(8ull + base + 9 + klen);  // frame header is 8 bytes
   }
-  if (out.flush_batch() != 0) {
+  uint32_t plen = static_cast<uint32_t>(payload.size());
+  uint32_t crc = crc32(reinterpret_cast<const uint8_t*>(payload.data()), plen);
+  bool wrote = fwrite(kMagic, 1, 4, nf) == 4 && fwrite(&plen, 4, 1, nf) == 1 &&
+               (plen == 0 || fwrite(payload.data(), 1, plen, nf) == plen) &&
+               fwrite(&crc, 4, 1, nf) == 1 && fflush(nf) == 0;
+  if (!wrote) {
     fclose(nf);
     remove(tmp.c_str());
     return -31;
@@ -353,9 +413,12 @@ int kv_compact(void* h) {
   close(s->read_fd);
   s->log = new_log;
   s->read_fd = new_fd;
-  // rebind index to the compacted file's offsets
-  s->index = std::move(out.index);
-  s->file_end = out.file_end;
+  // rebind the live index's value refs to the compacted file's offsets
+  size_t i = 0;
+  for (auto& kv : s->index) {
+    kv.second = ValueRef{new_offsets[i++], kv.second.len, false};
+  }
+  s->file_end = 8ull + plen + 4ull;
   return 0;
 }
 

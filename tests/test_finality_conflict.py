@@ -1,0 +1,78 @@
+"""Finality conflict detection: a heavier chain excluding the finality point
+must never be adopted — it is surfaced as a FinalityConflict notification
+and requires manual resolution (virtual_processor finality filtering +
+flow_context.rs on_finality_conflict)."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import GenesisBlock, Params
+from kaspa_tpu.sim.simulator import Miner
+
+
+def _params() -> Params:
+    genesis = GenesisBlock(hash=b"\x01" + b"\x00" * 31, bits=0x207FFFFF, timestamp=0)
+    return Params.from_bps(
+        "simnet-finality", 2, genesis, skip_proof_of_work=True, coinbase_maturity=8,
+        merge_depth=10, finality_depth=20, pruning_depth=60, pruning_proof_m=10,
+        difficulty_window_size=15, min_difficulty_window_size=5, difficulty_sample_rate=2,
+        past_median_time_window_size=10, past_median_time_sample_rate=2,
+    )
+
+
+def test_finality_violating_chain_not_adopted():
+    params = _params()
+    c = Consensus(params)
+    miner = Miner(0, random.Random(12))
+    events = []
+    lid = c.notification_root.register(lambda n: events.append(n))
+    c.notification_root.start_notify(lid, "finality-conflict")
+    c.notification_root.start_notify(lid, "finality-conflict-resolved")
+
+    # main chain: 40 blocks (well past finality_depth=20)
+    for i in range(40):
+        t = c.build_block_template(miner.miner_data, [], timestamp=1_000 + 600 * i)
+        assert c.validate_and_insert_block(t) in ("utxo_valid", "utxo_pending")
+    main_sink = c.sink()
+
+    # heavier side chain from genesis: 50 blocks, never merging main
+    fork_tip = params.genesis.hash
+    for i in range(50):
+        blk = c.build_block_with_parents([fork_tip], miner.miner_data, [], timestamp=2_000 + 600 * i)
+        status = c.validate_and_insert_block(blk)
+        assert status in ("utxo_valid", "utxo_pending"), status
+        fork_tip = blk.hash
+
+    # the fork is heavier ...
+    assert c.storage.ghostdag.get_blue_work(fork_tip) > c.storage.ghostdag.get_blue_work(main_sink)
+    # ... but the sink must stay on the finality-anchored chain
+    assert c.sink() == main_sink
+    assert c.reachability.is_chain_ancestor_of(main_sink, c.sink())
+    conflicts = [n for n in events if n.event_type == "finality-conflict"]
+    assert conflicts, "no FinalityConflict notification emitted"
+    assert any(n.data["violating_tip"] == fork_tip.hex() for n in conflicts)
+    # mining continues on the honest chain
+    t = c.build_block_template(miner.miner_data, [], timestamp=60_000)
+    assert c.validate_and_insert_block(t) in ("utxo_valid", "utxo_pending")
+    assert c.reachability.is_chain_ancestor_of(main_sink, c.sink())
+
+    # operator resolution clears the conflict and emits the resolved event
+    from kaspa_tpu.p2p import Node
+    from kaspa_tpu.rpc import RpcCoreService
+
+    node = Node(c, "finality-test")
+    svc = RpcCoreService(c, node.mining, p2p_node=node)
+    assert "active" in c._finality_conflicts.values()
+    svc.resolve_finality_conflict(main_sink)
+    assert all(st == "resolved" for st in c._finality_conflicts.values())
+    resolved = [n for n in events if n.event_type == "finality-conflict-resolved"]
+    assert resolved and resolved[0].data["finality_block_hash"] == main_sink.hex()
+
+    from kaspa_tpu.rpc.service import RpcError
+
+    with pytest.raises(RpcError):
+        svc.resolve_finality_conflict(main_sink)

@@ -70,3 +70,22 @@ def test_goref_header_hash_roundtrip():
     # 224 non-coinbase spends in this capture (the "1060" in the dir name
     # counts the originating scenario's total txs, not per-file spends)
     assert sum(len(b.transactions) - 1 for b in blocks) == 224
+
+
+@pytest.mark.skipif(
+    not os.path.exists(PRUNING_DAG) or not os.environ.get("KASPA_TPU_FULL_REPLAY"),
+    reason="full 5000-block pruning replay is ~25 min; set KASPA_TPU_FULL_REPLAY=1",
+)
+def test_goref_custom_pruning_depth_full_5000():
+    """The complete custom-pruning-depth DAG: deep pruning execution and
+    proof serving exercised over the whole file (the once-per-round deep
+    tail run; the 700-block prefix covers the fast path)."""
+    consensus = replay_goref(PRUNING_DAG)
+    assert consensus.get_virtual_daa_score() >= 4900
+    pp = consensus.pruning_processor
+    assert pp.pruning_point != consensus.params.genesis.hash
+    assert pp.check_pruning_utxo_commitment()
+    assert consensus.storage.statuses.get(consensus.sink()) == "utxo_valid"
+    # a pruned node must still serve an acceptable proof
+    proof = consensus.pruning_proof_manager.build_proof()
+    assert proof and proof[0]
