@@ -36,6 +36,7 @@ def parse_args(argv=None) -> DaemonArgs:
     p = argparse.ArgumentParser(prog="kaspa-tpu-node", description="kaspa-tpu full node")
     p.add_argument("--appdir", default=os.path.expanduser("~/.kaspa-tpu"), help="data directory")
     p.add_argument("--rpclisten", default="127.0.0.1:16110", help="host:port for JSON-RPC")
+    p.add_argument("--rpclisten-wrpc", default=None, help="host:port for the WebSocket JSON wRPC server (omit to disable)")
     p.add_argument(
         "--network", default="simnet", choices=["simnet", "mainnet", "testnet", "devnet"],
         help="network preset (real genesis for mainnet/testnet/devnet; simnet uses the fast test params)",
@@ -124,73 +125,95 @@ def _serialize_notification(n) -> dict:
     return {k: v for k, v in n.data.items() if isinstance(v, (int, str, bool, float, list))}
 
 
-class _RpcHandler(socketserver.StreamRequestHandler):
-    """One connection: request/response lines plus, after a `subscribe`,
-    interleaved `{"notification": ...}` lines.  Notifications flow through
-    a bounded per-connection queue drained by a dedicated writer thread
-    (notify/src/broadcaster.rs role) so a slow consumer can never stall the
-    consensus thread publishing the event — overflow drops, never blocks."""
+class ConnectionPump:
+    """Per-connection outbound pump shared by every RPC transport (line-
+    JSON and WebSocket): a bounded queue drained by a dedicated writer
+    thread (notify/src/broadcaster.rs role) so a slow consumer can never
+    stall the consensus thread publishing an event — overflow drops, never
+    blocks — plus the subscription-listener lifecycle."""
 
-    def handle(self):
+    def __init__(self, daemon: "Daemon", wfile, name: str):
         import queue as _queue
 
+        self.daemon = daemon
+        self.outq: _queue.Queue = _queue.Queue(maxsize=4096)
+        self.stop = threading.Event()
+        self.listener_ref = [None]
+        self._wfile = wfile
+        self._queue_mod = _queue
+        self._writer = threading.Thread(target=self._writer_loop, daemon=True, name=name)
+        self._writer.start()
+
+    def _writer_loop(self):
+        # drain until the sentinel: queued responses still flush after
+        # stop is set (half-close clients must get their last reply);
+        # a dead socket or stop+empty ends the thread
+        while True:
+            try:
+                item = self.outq.get(timeout=0.5)
+            except self._queue_mod.Empty:
+                if self.stop.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            try:
+                self._wfile.write(item)
+                self._wfile.flush()
+            except OSError:
+                self.stop.set()
+                return
+
+    def send(self, data: bytes) -> None:
+        self.outq.put(data)
+
+    def handle_request(self, payload: bytes, notification_sink=None) -> bytes:
+        """Dispatch one JSON request; returns the encoded response line.
+        ``notification_sink``: queue-like receiving notification lines
+        (defaults to the raw outq — the line-JSON transport)."""
+        req_id = None
+        try:
+            req = json.loads(payload)
+            req_id = req.get("id")
+            method = req.get("method", "")
+            params = req.get("params", {})
+            if method in ("subscribe", "unsubscribe"):
+                result = self.daemon.handle_subscription(
+                    method, params, notification_sink or self.outq, self.listener_ref, self.stop
+                )
+            else:
+                result = self.daemon.dispatch(method, params)
+            resp = {"id": req_id, "result": result}
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            resp = {"id": req_id, "error": str(e)}
+        return (json.dumps(resp) + "\n").encode()
+
+    def close(self) -> None:
+        if self.listener_ref[0] is not None:
+            with self.daemon._dispatch_lock:
+                self.daemon.rpc.unregister_listener(self.listener_ref[0])
+        self.stop.set()
+        try:
+            self.outq.put_nowait(None)
+        except self._queue_mod.Full:
+            pass  # writer exits via stop+empty / OSError
+
+
+class _RpcHandler(socketserver.StreamRequestHandler):
+    """One connection: request/response lines plus, after a `subscribe`,
+    interleaved `{"notification": ...}` lines over the shared pump."""
+
+    def handle(self):
         daemon: Daemon = self.server.daemon  # type: ignore[attr-defined]
-        outq: _queue.Queue = _queue.Queue(maxsize=4096)
-        stop = threading.Event()
-        listener_ref = [None]
-
-        def writer():
-            # drain until the sentinel: queued responses still flush after
-            # stop is set (half-close clients must get their last reply);
-            # a dead socket or stop+empty ends the thread
-            while True:
-                try:
-                    item = outq.get(timeout=0.5)
-                except _queue.Empty:
-                    if stop.is_set():
-                        return
-                    continue
-                if item is None:
-                    return
-                try:
-                    self.wfile.write(item)
-                    self.wfile.flush()
-                except OSError:
-                    stop.set()
-                    return
-
-        wt = threading.Thread(target=writer, daemon=True, name="rpc-notify-writer")
-        wt.start()
+        pump = ConnectionPump(daemon, self.wfile, "rpc-notify-writer")
         try:
             for line in self.rfile:
                 line = line.strip()
                 if not line:
                     continue
-                req_id = None
-                try:
-                    req = json.loads(line)
-                    req_id = req.get("id")
-                    method = req.get("method", "")
-                    params = req.get("params", {})
-                    if method in ("subscribe", "unsubscribe"):
-                        result = daemon.handle_subscription(
-                            method, params, outq, listener_ref, stop
-                        )
-                    else:
-                        result = daemon.dispatch(method, params)
-                    resp = {"id": req_id, "result": result}
-                except Exception as e:  # noqa: BLE001 - wire boundary
-                    resp = {"id": req_id, "error": str(e)}
-                outq.put((json.dumps(resp) + "\n").encode())
+                pump.send(pump.handle_request(line))
         finally:
-            if listener_ref[0] is not None:
-                with daemon._dispatch_lock:
-                    daemon.rpc.unregister_listener(listener_ref[0])
-            stop.set()
-            try:
-                outq.put_nowait(None)
-            except _queue.Full:
-                pass  # writer exits via stop+empty / OSError
+            pump.close()
 
 
 DB_VERSION = 1
@@ -321,6 +344,11 @@ class Daemon:
         self.core.bind(self.tick)
         self.core.bind(CallbackService("rpc-server", on_start=self._start_rpc_service, on_stop=self._stop_rpc_service))
         self.core.bind(CallbackService("p2p-server", on_start=self._start_p2p_service, on_stop=self._stop_p2p_service))
+        self.wrpc_server = None
+        if getattr(args, "rpclisten_wrpc", None):
+            self.core.bind(
+                CallbackService("wrpc-server", on_start=self._start_wrpc_service, on_stop=self._stop_wrpc_service)
+            )
         self.stratum_server = None
         if getattr(args, "stratum", None):
             self.core.bind(
@@ -571,6 +599,19 @@ class Daemon:
         for peer in list(self.node.peers):
             if hasattr(peer, "close"):
                 peer.close()
+
+    def _start_wrpc_service(self, _core) -> list:
+        from kaspa_tpu.rpc.wrpc import WrpcServer
+
+        host, port = self.args.rpclisten_wrpc.rsplit(":", 1)
+        self.wrpc_server = WrpcServer(self, host, int(port))
+        self.wrpc_server.start()
+        return []
+
+    def _stop_wrpc_service(self) -> None:
+        if self.wrpc_server is not None:
+            self.wrpc_server.stop()
+            self.wrpc_server = None
 
     def _start_stratum_service(self, _core) -> list:
         from kaspa_tpu.bridge.stratum import StratumBridge, StratumServer

@@ -1,0 +1,344 @@
+"""wRPC: WebSocket JSON-RPC transport over RpcCoreService.
+
+Reference: rpc/wrpc/server/src/{server,service}.rs — the WebSocket RPC
+stack (Borsh and JSON encodings) binding the same RpcApi the gRPC stack
+serves.  This module implements the JSON encoding end-to-end on a
+hand-rolled RFC 6455 server (no external deps): HTTP upgrade handshake,
+masked client frames, text frames both ways, ping/pong, close.  Requests
+reuse the daemon's dispatch table; `subscribe`/`unsubscribe` stream
+notifications on the same connection exactly like the line-JSON transport
+(per-connection bounded queue + writer thread, notify/src/broadcaster.rs
+role).
+
+Wire messages (JSON text frames):
+  -> {"id": 1, "method": "getBlockDagInfo", "params": {}}
+  <- {"id": 1, "result": {...}}
+  <- {"notification": {"event": "block-added", "data": {...}}}
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import queue
+import socket
+import socketserver
+import struct
+import threading
+
+from kaspa_tpu.core.log import get_logger
+
+log = get_logger("wrpc")
+
+_WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def accept_key(client_key: str) -> str:
+    return base64.b64encode(hashlib.sha1(client_key.encode() + _WS_GUID).digest()).decode()
+
+
+def encode_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
+    """One complete frame (FIN set).  Servers send unmasked (RFC 6455 §5.1);
+    clients must mask."""
+    head = bytes([0x80 | opcode])
+    mbit = 0x80 if mask else 0
+    n = len(payload)
+    if n < 126:
+        head += bytes([mbit | n])
+    elif n < 1 << 16:
+        head += bytes([mbit | 126]) + struct.pack(">H", n)
+    else:
+        head += bytes([mbit | 127]) + struct.pack(">Q", n)
+    if mask:
+        key = os.urandom(4)
+        masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        return head + key + masked
+    return head + payload
+
+
+MAX_MESSAGE_BYTES = 16 * 1024 * 1024  # wrpc server message cap
+
+
+def _unmask(payload: bytes, key: bytes) -> bytes:
+    if not payload:
+        return payload
+    n = len(payload)
+    m = (key * (n // 4 + 1))[:n]
+    return (int.from_bytes(payload, "little") ^ int.from_bytes(m, "little")).to_bytes(n, "little")
+
+
+def read_frame(read_exactly) -> tuple[int, bytes, bool]:
+    """Returns (opcode, payload, fin); raises ConnectionError on EOF and
+    ValueError when the declared length exceeds MAX_MESSAGE_BYTES."""
+    b0, b1 = read_exactly(2)
+    fin = bool(b0 & 0x80)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        (n,) = struct.unpack(">H", read_exactly(2))
+    elif n == 127:
+        (n,) = struct.unpack(">Q", read_exactly(8))
+    if n > MAX_MESSAGE_BYTES:
+        raise ValueError(f"frame of {n} bytes exceeds the {MAX_MESSAGE_BYTES} cap")
+    key = read_exactly(4) if masked else None
+    payload = read_exactly(n) if n else b""
+    if key:
+        payload = _unmask(payload, key)
+    return opcode, payload, fin
+
+
+def read_message(read_exactly) -> tuple[int, bytes]:
+    """One complete message: assembles continuation frames until FIN
+    (RFC 6455 §5.4); control frames may interleave and are returned
+    immediately when they arrive before any data frame."""
+    opcode, payload, fin = read_frame(read_exactly)
+    if opcode in (OP_CLOSE, OP_PING, OP_PONG):
+        return opcode, payload
+    parts = [payload]
+    total = len(payload)
+    while not fin:
+        op2, chunk, fin = read_frame(read_exactly)
+        if op2 in (OP_CLOSE, OP_PING, OP_PONG):
+            # control frames may interleave within a fragmented message;
+            # surface close immediately, ignore ping/pong mid-assembly
+            if op2 == OP_CLOSE:
+                return op2, chunk
+            continue
+        total += len(chunk)
+        if total > MAX_MESSAGE_BYTES:
+            raise ValueError("fragmented message exceeds the size cap")
+        parts.append(chunk)
+    return opcode, b"".join(parts)
+
+
+class _WrpcHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        daemon = self.server.daemon  # type: ignore[attr-defined]
+        # --- HTTP upgrade handshake ---
+        request_line = self.rfile.readline()
+        headers = {}
+        while True:
+            line = self.rfile.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            k, _, v = line.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        if b"GET" not in request_line or "sec-websocket-key" not in headers:
+            self.wfile.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+            return
+        # cross-site WebSocket hijacking guard: browsers always send Origin;
+        # only local origins may drive the node RPC (native clients send none)
+        origin = headers.get("origin")
+        if origin is not None and not any(
+            allowed in origin for allowed in ("localhost", "127.0.0.1", "[::1]")
+        ):
+            self.wfile.write(b"HTTP/1.1 403 Forbidden\r\n\r\n")
+            return
+        self.wfile.write(
+            (
+                "HTTP/1.1 101 Switching Protocols\r\n"
+                "Upgrade: websocket\r\n"
+                "Connection: Upgrade\r\n"
+                f"Sec-WebSocket-Accept: {accept_key(headers['sec-websocket-key'])}\r\n\r\n"
+            ).encode()
+        )
+
+        from kaspa_tpu.node.daemon import ConnectionPump
+
+        pump = ConnectionPump(daemon, self.wfile, "wrpc-writer")
+
+        def read_exactly(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = self.rfile.read(n - len(buf))
+                if not chunk:
+                    raise ConnectionError("peer closed")
+                buf += chunk
+            return buf
+
+        try:
+            while not pump.stop.is_set():
+                try:
+                    opcode, payload = read_message(read_exactly)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                if opcode == OP_CLOSE:
+                    pump.send(encode_frame(OP_CLOSE, payload[:2]))
+                    return
+                if opcode == OP_PING:
+                    pump.send(encode_frame(OP_PONG, payload))
+                    continue
+                if opcode not in (OP_TEXT, OP_BINARY):
+                    continue
+                line = pump.handle_request(payload, notification_sink=_WsQueueAdapter(pump.outq))
+                pump.send(encode_frame(OP_TEXT, line.rstrip(b"\n")))
+        finally:
+            pump.close()
+
+
+class _WsQueueAdapter:
+    """Adapts the daemon's line-oriented notification enqueue (bytes ending
+    in newline) into WebSocket text frames on the shared outbound queue."""
+
+    def __init__(self, outq: queue.Queue):
+        self._outq = outq
+
+    def put_nowait(self, line: bytes) -> None:
+        self._outq.put_nowait(encode_frame(OP_TEXT, line.rstrip(b"\n")))
+
+
+class WrpcServer:
+    """WebSocket RPC front end (wrpc/server/src/server.rs)."""
+
+    def __init__(self, daemon, host: str = "127.0.0.1", port: int = 0):
+        srv = socketserver.ThreadingTCPServer((host, port), _WrpcHandler, bind_and_activate=False)
+        srv.allow_reuse_address = True
+        srv.daemon_threads = True
+        srv.server_bind()
+        srv.server_activate()
+        srv.daemon = daemon  # type: ignore[attr-defined]
+        self._srv = srv
+        self.address = f"{host}:{srv.server_address[1]}"
+        self._thread = threading.Thread(target=srv.serve_forever, daemon=True, name="wrpc-accept")
+
+    def start(self) -> str:
+        self._thread.start()
+        log.info("wRPC (WebSocket JSON) listening on %s", self.address)
+        return self.address
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class WrpcClient:
+    """Minimal WebSocket JSON-RPC client (wrpc/client): id-matched calls +
+    streamed notifications in a queue."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self._timeout = timeout
+        key = base64.b64encode(os.urandom(16)).decode()
+        self._sock.sendall(
+            (
+                f"GET / HTTP/1.1\r\nHost: {addr}\r\nUpgrade: websocket\r\n"
+                f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        status = self._read_line()
+        if b"101" not in status:
+            raise ConnectionError(f"websocket upgrade refused: {status!r}")
+        accept = None
+        while True:
+            line = self._read_line()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"sec-websocket-accept:"):
+                accept = line.split(b":", 1)[1].strip().decode()
+        if accept != accept_key(key):
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+        self._responses: queue.Queue = queue.Queue()
+        self._parked: dict = {}  # id -> response popped by another caller
+        self._parked_lock = threading.Lock()
+        self.notifications: queue.Queue = queue.Queue()
+        self._next_id = 0
+        self._reader = threading.Thread(target=self._read_loop, daemon=True, name="wrpc-client-reader")
+        self._reader.start()
+
+    def _read_line(self) -> bytes:
+        out = b""
+        while not out.endswith(b"\n"):
+            c = self._sock.recv(1)
+            if not c:
+                return out
+            out += c
+        return out
+
+    def _read_exactly(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def _read_loop(self):
+        try:
+            while True:
+                opcode, payload = read_message(self._read_exactly)
+                if opcode == OP_CLOSE:
+                    break
+                if opcode == OP_PING:
+                    self._sock.sendall(encode_frame(OP_PONG, payload, mask=True))
+                    continue
+                if opcode not in (OP_TEXT, OP_BINARY):
+                    continue
+                msg = json.loads(payload)
+                if "notification" in msg:
+                    n = msg["notification"]
+                    self.notifications.put((n["event"], n["data"]))
+                else:
+                    self._responses.put(msg)
+        except (OSError, ValueError, ConnectionError):
+            pass
+        self._responses.put(None)
+
+    def call(self, method: str, params: dict | None = None):
+        import time as _time
+
+        self._next_id += 1
+        req_id = self._next_id
+        frame = encode_frame(
+            OP_TEXT, json.dumps({"id": req_id, "method": method, "params": params or {}}).encode(), mask=True
+        )
+        self._sock.sendall(frame)
+        deadline = _time.monotonic() + self._timeout
+        while True:
+            with self._parked_lock:
+                resp = self._parked.pop(req_id, None)
+            if resp is None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"wrpc call {method} timed out")
+                try:
+                    resp = self._responses.get(timeout=remaining)
+                except queue.Empty:
+                    raise TimeoutError(f"wrpc call {method} timed out") from None
+                if resp is None:
+                    raise ConnectionError("connection closed")
+                if resp.get("id") != req_id:
+                    # another caller's reply: park it instead of dropping
+                    with self._parked_lock:
+                        self._parked[resp.get("id")] = resp
+                    continue
+            if "error" in resp:
+                raise RuntimeError(resp["error"])
+            return resp["result"]
+
+    def subscribe(self, event: str, addresses: list[str] | None = None):
+        params = {"event": event}
+        if addresses:
+            params["addresses"] = addresses
+        return self.call("subscribe", params)
+
+    def next_notification(self, timeout: float = 30.0):
+        return self.notifications.get(timeout=timeout)
+
+    def close(self):
+        try:
+            self._sock.sendall(encode_frame(OP_CLOSE, b"", mask=True))
+        except OSError:
+            pass
+        self._sock.close()
