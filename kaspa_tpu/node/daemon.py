@@ -58,6 +58,7 @@ def parse_args(argv=None) -> DaemonArgs:
         help="serve block templates while unsynced (defaults on for simnet, off otherwise; args.rs enable_unsynced_mining)",
     )
     p.add_argument("--connect", action="append", default=[], help="peer host:port to dial (repeatable); IBD runs on connect")
+    p.add_argument("--dnsseed", action="append", default=[], help="seed hostname[:port] resolved into the address book (repeatable)")
     # consensus-parameter overrides (kaspad exposes these for testnets;
     # primarily for pruning/IBD integration tests at small scale)
     p.add_argument("--override-pruning-depth", type=int, default=None)
@@ -651,6 +652,15 @@ class Daemon:
 
     def start(self) -> str:
         self.core.start()
+        seeds = getattr(self.args, "dnsseed", []) or []
+        if seeds:
+            # resolver latency must not block startup (a dead seed hangs
+            # getaddrinfo for its full timeout, serially per seed)
+            def _seed():
+                n = self.address_manager.dns_seed(seeds, default_port=16111)
+                self.log.info("dns seeding added %d addresses from %d seeds", n, len(seeds))
+
+            threading.Thread(target=_seed, daemon=True, name="dnsseed").start()
         for peer_addr in getattr(self.args, "connect", []) or []:
             self.connect_peer(peer_addr)
         return self._rpc_addr

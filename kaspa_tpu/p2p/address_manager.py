@@ -101,6 +101,28 @@ class AddressManager:
                 out.append(pool.pop()[0].address)
         return out
 
+    def dns_seed(self, seeds: list[str], default_port: int) -> int:
+        """Resolve seed hostnames into the address book (flow_context
+        dnsseed bootstrap; the reference resolves its per-network seeder
+        list when the book runs low).  Returns the number of addresses
+        added; resolution failures are skipped, never fatal."""
+        import socket as _socket
+
+        added = 0
+        for seed in seeds:
+            host, _, port = seed.partition(":")
+            try:
+                infos = _socket.getaddrinfo(host, int(port) if port else default_port, type=_socket.SOCK_STREAM)
+            except (OSError, ValueError):
+                continue
+            for info in infos:
+                ip = info[4][0]
+                addr = NetAddress(ip, info[4][1])
+                if not self.is_banned(ip):
+                    self.add_address(addr)
+                    added += 1
+        return added
+
     def get_all_addresses(self) -> list[NetAddress]:
         with self._lock:
             return list(self._store)

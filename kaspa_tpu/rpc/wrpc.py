@@ -96,10 +96,11 @@ def read_frame(read_exactly) -> tuple[int, bytes, bool]:
     return opcode, payload, fin
 
 
-def read_message(read_exactly) -> tuple[int, bytes]:
+def read_message(read_exactly, on_ping=None) -> tuple[int, bytes]:
     """One complete message: assembles continuation frames until FIN
-    (RFC 6455 §5.4); control frames may interleave and are returned
-    immediately when they arrive before any data frame."""
+    (RFC 6455 §5.4).  Control frames interleaved mid-assembly are handled
+    in place: close surfaces immediately, pings invoke ``on_ping(payload)``
+    (callers answer with a pong per §5.5.2), pongs are dropped."""
     opcode, payload, fin = read_frame(read_exactly)
     if opcode in (OP_CLOSE, OP_PING, OP_PONG):
         return opcode, payload
@@ -108,10 +109,10 @@ def read_message(read_exactly) -> tuple[int, bytes]:
     while not fin:
         op2, chunk, fin = read_frame(read_exactly)
         if op2 in (OP_CLOSE, OP_PING, OP_PONG):
-            # control frames may interleave within a fragmented message;
-            # surface close immediately, ignore ping/pong mid-assembly
             if op2 == OP_CLOSE:
                 return op2, chunk
+            if op2 == OP_PING and on_ping is not None:
+                on_ping(chunk)
             continue
         total += len(chunk)
         if total > MAX_MESSAGE_BYTES:
@@ -136,13 +137,17 @@ class _WrpcHandler(socketserver.StreamRequestHandler):
             self.wfile.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
             return
         # cross-site WebSocket hijacking guard: browsers always send Origin;
-        # only local origins may drive the node RPC (native clients send none)
+        # only EXACT local origin hosts may drive the node RPC (substring
+        # checks are bypassable via localhost.evil.com); native clients
+        # send no Origin at all
         origin = headers.get("origin")
-        if origin is not None and not any(
-            allowed in origin for allowed in ("localhost", "127.0.0.1", "[::1]")
-        ):
-            self.wfile.write(b"HTTP/1.1 403 Forbidden\r\n\r\n")
-            return
+        if origin is not None:
+            from urllib.parse import urlsplit
+
+            host = (urlsplit(origin).hostname or "").lower()
+            if host not in ("localhost", "127.0.0.1", "::1"):
+                self.wfile.write(b"HTTP/1.1 403 Forbidden\r\n\r\n")
+                return
         self.wfile.write(
             (
                 "HTTP/1.1 101 Switching Protocols\r\n"
@@ -168,7 +173,10 @@ class _WrpcHandler(socketserver.StreamRequestHandler):
         try:
             while not pump.stop.is_set():
                 try:
-                    opcode, payload = read_message(read_exactly)
+                    opcode, payload = read_message(
+                        read_exactly,
+                        on_ping=lambda p: pump.send(encode_frame(OP_PONG, p)),
+                    )
                 except (ConnectionError, OSError, ValueError):
                     return
                 if opcode == OP_CLOSE:
@@ -248,11 +256,12 @@ class WrpcClient:
                 accept = line.split(b":", 1)[1].strip().decode()
         if accept != accept_key(key):
             raise ConnectionError("bad Sec-WebSocket-Accept")
-        self._responses: queue.Queue = queue.Queue()
-        self._parked: dict = {}  # id -> response popped by another caller
-        self._parked_lock = threading.Lock()
+        self._responses: dict = {}  # id -> response (reader fills)
+        self._response_cv = threading.Condition()
+        self._closed = False
         self.notifications: queue.Queue = queue.Queue()
         self._next_id = 0
+        self._id_lock = threading.Lock()
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="wrpc-client-reader")
         self._reader.start()
 
@@ -277,7 +286,10 @@ class WrpcClient:
     def _read_loop(self):
         try:
             while True:
-                opcode, payload = read_message(self._read_exactly)
+                opcode, payload = read_message(
+                    self._read_exactly,
+                    on_ping=lambda p: self._sock.sendall(encode_frame(OP_PONG, p, mask=True)),
+                )
                 if opcode == OP_CLOSE:
                     break
                 if opcode == OP_PING:
@@ -290,42 +302,37 @@ class WrpcClient:
                     n = msg["notification"]
                     self.notifications.put((n["event"], n["data"]))
                 else:
-                    self._responses.put(msg)
+                    with self._response_cv:
+                        self._responses[msg.get("id")] = msg
+                        self._response_cv.notify_all()
         except (OSError, ValueError, ConnectionError):
             pass
-        self._responses.put(None)
+        with self._response_cv:
+            self._closed = True
+            self._response_cv.notify_all()
 
     def call(self, method: str, params: dict | None = None):
         import time as _time
 
-        self._next_id += 1
-        req_id = self._next_id
+        with self._id_lock:
+            self._next_id += 1
+            req_id = self._next_id
         frame = encode_frame(
             OP_TEXT, json.dumps({"id": req_id, "method": method, "params": params or {}}).encode(), mask=True
         )
         self._sock.sendall(frame)
         deadline = _time.monotonic() + self._timeout
-        while True:
-            with self._parked_lock:
-                resp = self._parked.pop(req_id, None)
-            if resp is None:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(f"wrpc call {method} timed out")
-                try:
-                    resp = self._responses.get(timeout=remaining)
-                except queue.Empty:
-                    raise TimeoutError(f"wrpc call {method} timed out") from None
-                if resp is None:
+        with self._response_cv:
+            while req_id not in self._responses:
+                if self._closed:
                     raise ConnectionError("connection closed")
-                if resp.get("id") != req_id:
-                    # another caller's reply: park it instead of dropping
-                    with self._parked_lock:
-                        self._parked[resp.get("id")] = resp
-                    continue
-            if "error" in resp:
-                raise RuntimeError(resp["error"])
-            return resp["result"]
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not self._response_cv.wait(timeout=remaining):
+                    raise TimeoutError(f"wrpc call {method} timed out")
+            resp = self._responses.pop(req_id)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["result"]
 
     def subscribe(self, event: str, addresses: list[str] | None = None):
         params = {"event": event}

@@ -1,11 +1,17 @@
-"""Wallet CLI (reference: cli/ — the wallet terminal's core commands).
+"""Wallet CLI (reference: cli/ — the wallet terminal).
 
-Talks to a running node over the JSON-RPC wire:
+Talks to a running node over the JSON-RPC wire.  One-shot subcommands:
 
     python -m kaspa_tpu.wallet --rpc 127.0.0.1:16110 address --seed-file s.txt
     python -m kaspa_tpu.wallet --rpc 127.0.0.1:16110 balance --seed-file s.txt
     python -m kaspa_tpu.wallet --rpc 127.0.0.1:16110 send --seed-file s.txt \
         --to kaspasim:... --amount 100000000 --fee 2000
+
+or the interactive terminal (the reference cli/ shell):
+
+    python -m kaspa_tpu.wallet --rpc 127.0.0.1:16110 repl --seed-file s.txt
+    kaspa-tpu> help | address | new-address | balance | node |
+               send <to> <amount> [fee] | monitor <seconds> | exit
 """
 
 from __future__ import annotations
@@ -26,6 +32,142 @@ def _account(args) -> Account:
     return acct
 
 
+class _RemoteIndex:
+    """utxoindex facade backed by the node's RPC (one-shot + repl send)."""
+
+    def __init__(self, rpc_addr: str, prefix: str):
+        self.rpc_addr = rpc_addr
+        self.prefix = prefix
+
+    def get_utxos_by_script(self, script: bytes):
+        from kaspa_tpu.consensus.model import ScriptPublicKey, TransactionOutpoint, UtxoEntry
+        from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+        addr = extract_script_pub_key_address(ScriptPublicKey(0, script), self.prefix).to_string()
+        out = {}
+        for u in rpc_call(self.rpc_addr, "getUtxosByAddresses", {"addresses": [addr]}):
+            op = TransactionOutpoint(bytes.fromhex(u["outpoint"]["transaction_id"]), u["outpoint"]["index"])
+            out[op] = UtxoEntry(
+                u["utxo_entry"]["amount"], ScriptPublicKey(0, script),
+                u["utxo_entry"]["block_daa_score"], u["utxo_entry"]["is_coinbase"],
+            )
+        return out
+
+    def get_balance_by_script(self, script: bytes) -> int:
+        return sum(e.amount for e in self.get_utxos_by_script(script).values())
+
+
+def _send(acct, rpc_addr: str, prefix: str, to: str, amount: int, fee: int) -> str:
+    info = rpc_call(rpc_addr, "getServerInfo")
+    tx = acct.build_send(
+        _RemoteIndex(rpc_addr, prefix), to, amount, fee, info["virtual_daa_score"],
+        coinbase_maturity=info.get("coinbase_maturity", 200),
+    )
+    # first-use signature-kernel load in the node can take minutes
+    return rpc_call(rpc_addr, "submitTransaction", {"tx": tx_to_wire(tx)}, timeout=600.0)
+
+
+REPL_HELP = """commands:
+  address              list receive addresses
+  new-address          derive the next receive address
+  balance              total balance over derived addresses
+  node                 node server info
+  send <to> <amount> [fee]   build, sign and submit a spend (sompi)
+  monitor <seconds>    stream live wallet events (UtxosChanged/daa)
+  help                 this text
+  exit | quit          leave the terminal"""
+
+
+def repl(acct, args, stdin=None, stdout=None) -> int:
+    """The interactive wallet terminal (reference cli/ shell)."""
+    import sys as _sys
+
+    stdin = stdin or _sys.stdin
+    stdout = stdout or _sys.stdout
+
+    def out(msg: str) -> None:
+        print(msg, file=stdout, flush=True)
+
+    out(f"kaspa-tpu wallet terminal — node {args.rpc} — 'help' for commands")
+    while True:
+        try:
+            stdout.write("kaspa-tpu> ")
+            stdout.flush()
+            line = stdin.readline()
+        except (KeyboardInterrupt, EOFError):
+            return 0
+        if not line:
+            return 0
+        parts = line.split()
+        if not parts:
+            continue
+        cmd, *rest = parts
+        try:
+            if cmd in ("exit", "quit"):
+                return 0
+            elif cmd == "help":
+                out(REPL_HELP)
+            elif cmd == "address":
+                for a in acct.addresses():
+                    out(a)
+            elif cmd == "new-address":
+                out(acct.derive_receive_address().address.to_string())
+            elif cmd == "balance":
+                total = sum(
+                    rpc_call(args.rpc, "getBalanceByAddress", {"address": a}) for a in acct.addresses()
+                )
+                out(f"{total} sompi ({total / 1e8:.8f} KAS)")
+            elif cmd == "node":
+                info = rpc_call(args.rpc, "getServerInfo")
+                out(f"network {info['network_id']} daa {info['virtual_daa_score']} version {info['server_version']}")
+            elif cmd == "send":
+                if len(rest) < 2:
+                    out("usage: send <to> <amount> [fee]")
+                    continue
+                to, amount = rest[0], int(rest[1])
+                fee = int(rest[2]) if len(rest) > 2 else 2000
+                out(f"submitted {_send(acct, args.rpc, args.prefix, to, amount, fee)}")
+            elif cmd == "monitor":
+                seconds = float(rest[0]) if rest else 10.0
+                _monitor(acct, args, seconds, out)
+            else:
+                out(f"unknown command {cmd!r} — 'help' for commands")
+        except Exception as e:  # noqa: BLE001 - terminal loop must survive
+            out(f"error: {e}")
+
+
+def _monitor(acct, args, seconds: float, out) -> None:
+    """Stream wallet events over a notification subscription (the
+    reference terminal's live event feed)."""
+    import queue as _queue
+    import time as _time
+
+    from kaspa_tpu.node.daemon import NotificationClient
+    from kaspa_tpu.wallet.utxo_processor import UtxoProcessor, WalletEventType
+
+    client = NotificationClient(args.rpc)
+    maturity = rpc_call(args.rpc, "getServerInfo").get("coinbase_maturity", 200)
+    uproc = UtxoProcessor(acct, coinbase_maturity=maturity)
+    uproc.add_listener(
+        lambda ev: out(f"[{ev.type.value}] {ev.data.get('balance') or ev.data}")
+    )
+    try:
+        client.subscribe("utxos-changed", acct.addresses())
+        client.subscribe("virtual-daa-score-changed")
+        deadline = _time.monotonic() + seconds
+        out(f"monitoring for {seconds:.0f}s ...")
+        while _time.monotonic() < deadline:
+            try:
+                event, data = client.next_notification(timeout=max(0.2, deadline - _time.monotonic()))
+            except _queue.Empty:
+                break
+            uproc.feed_wire_notification(event, data)
+    finally:
+        client.close()
+    b = uproc.balance()
+    out(f"monitor done: observed balance mature={b.mature} pending={b.pending}")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kaspa-tpu-wallet")
     p.add_argument("--rpc", default="127.0.0.1:16110", help="node RPC address")
@@ -39,6 +181,7 @@ def main(argv=None) -> int:
     sp.add_argument("--to", required=True)
     sp.add_argument("--amount", type=int, required=True, help="sompi")
     sp.add_argument("--fee", type=int, default=2000)
+    sub.add_parser("repl", help="interactive wallet terminal")
     args = p.parse_args(argv)
 
     acct = _account(args)
@@ -55,35 +198,12 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "send":
-        # fetch spendable utxos via the node's index, then build/sign locally
-        info = rpc_call(args.rpc, "getServerInfo")
-        daa = info["virtual_daa_score"]
-
-        class _RemoteIndex:
-            """utxoindex facade backed by the node's RPC."""
-
-            def get_utxos_by_script(self, script: bytes):
-                from kaspa_tpu.consensus.model import ScriptPublicKey, TransactionOutpoint, UtxoEntry
-                from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
-
-                addr = extract_script_pub_key_address(ScriptPublicKey(0, script), args.prefix).to_string()
-                out = {}
-                for u in rpc_call(args.rpc, "getUtxosByAddresses", {"addresses": [addr]}):
-                    op = TransactionOutpoint(bytes.fromhex(u["outpoint"]["transaction_id"]), u["outpoint"]["index"])
-                    out[op] = UtxoEntry(
-                        u["utxo_entry"]["amount"], ScriptPublicKey(0, script),
-                        u["utxo_entry"]["block_daa_score"], u["utxo_entry"]["is_coinbase"],
-                    )
-                return out
-
-            def get_balance_by_script(self, script: bytes) -> int:
-                return sum(e.amount for e in self.get_utxos_by_script(script).values())
-
-        tx = acct.build_send(_RemoteIndex(), args.to, args.amount, args.fee, daa, coinbase_maturity=rpc_call(args.rpc, "getServerInfo").get("coinbase_maturity", 200))
-        # first-use signature-kernel load in the node can take minutes
-        txid = rpc_call(args.rpc, "submitTransaction", {"tx": tx_to_wire(tx)}, timeout=600.0)
+        txid = _send(acct, args.rpc, args.prefix, args.to, args.amount, args.fee)
         print(f"submitted {txid}")
         return 0
+
+    if args.cmd == "repl":
+        return repl(acct, args)
     return 1
 
 

@@ -124,3 +124,27 @@ def test_three_node_gossip_bootstrap(tmp_path):
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+def test_dns_seeding(tmp_path):
+    """--dnsseed resolves hostnames into the address book at startup
+    (flow_context dnsseed bootstrap)."""
+    from kaspa_tpu.node.daemon import Daemon, parse_args
+
+    args = parse_args(
+        ["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0", "--no-persist",
+         "--dnsseed", "localhost:16333", "--dnsseed", "no-such-host.invalid"]
+    )
+    d = Daemon(args)
+    d.start()
+    try:
+        # seeding runs on a background thread so startup never blocks on DNS
+        _wait(
+            lambda: "127.0.0.1:16333" in [str(a) for a in d.address_manager.get_all_addresses()],
+            10,
+            "dns seed resolution",
+        )
+        known = [str(a) for a in d.address_manager.get_all_addresses()]
+        assert not any("invalid" in a for a in known)  # failures skipped
+    finally:
+        d.stop()

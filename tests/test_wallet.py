@@ -82,3 +82,61 @@ def test_account_send_roundtrip():
     with pytest.raises(WalletError):
         wallet.build_send(index, dest.address.to_string(), 10**18, fee=0,
                           virtual_daa_score=c.get_virtual_daa_score(), coinbase_maturity=params.coinbase_maturity)
+
+
+def test_wallet_interactive_terminal(tmp_path):
+    """The interactive terminal (reference cli/): a scripted session over a
+    live daemon — help, addresses, node info, balance, live monitor of a
+    mined coinbase, derived address, clean exit."""
+    import random
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    from kaspa_tpu.node.daemon import Daemon, parse_args, rpc_call
+
+    seed = tmp_path / "seed.bin"
+    seed.write_bytes(b"\x5a" * 32)
+    from kaspa_tpu.wallet import Account
+
+    acct = Account.from_seed(b"\x5a" * 32, prefix="kaspasim")
+    pay = acct.addresses()[0]
+
+    args = parse_args(["--appdir", str(tmp_path / "node"), "--rpclisten", "127.0.0.1:0", "--bps", "2"])
+    d = Daemon(args)
+    addr = d.start()
+    try:
+        import os
+
+        env = dict(os.environ)
+        env["KASPA_TPU_PLATFORM"] = "cpu"
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kaspa_tpu.wallet", "--rpc", addr, "--seed-file", str(seed), "repl"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+
+        def mine_soon():
+            time.sleep(3)
+            for _ in range(2):
+                t = rpc_call(addr, "getBlockTemplate", {"payAddress": pay})
+                rpc_call(addr, "submitBlockByTemplateHash", {"hash": t["block_hash"]})
+                d.mining.template_cache.clear()
+
+        miner = threading.Thread(target=mine_soon, daemon=True)
+        miner.start()
+        out, _ = proc.communicate(
+            "help\naddress\nnode\nbalance\nmonitor 12\nnew-address\nbadcmd\nexit\n", timeout=120
+        )
+        assert proc.returncode == 0
+        assert "commands:" in out
+        assert pay in out
+        assert "network simnet" in out
+        assert "sompi" in out
+        assert "monitor done" in out and "pending=" in out
+        # the monitored coinbase arrived as a live pending event
+        assert "[pending]" in out or "mature=" in out
+        assert "unknown command 'badcmd'" in out
+    finally:
+        d.stop()
