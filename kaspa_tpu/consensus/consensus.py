@@ -266,6 +266,23 @@ class Consensus:
         holds for blocks whose selected parent was pruned)."""
         self.reach_mergesets[block] = mergeset
 
+    def _rebind_reachability(self) -> None:
+        """Point every manager at a replacement ReachabilityService
+        (snapshot-recovery path)."""
+        self.ghostdag_manager.reachability = self.reachability
+        self.depth_manager.reachability = self.reachability
+        self.parents_manager.reachability = self.reachability
+
+    def save_reachability_snapshot(self) -> None:
+        """Persist the full reachability state + clean marker (called on
+        orderly shutdown; restart then restores it in one decode instead of
+        the O(history) topological rebuild)."""
+        if self.storage.db is None:
+            return
+        self.storage.put_meta(b"reach_snapshot", serde.encode_reachability(self.reachability))
+        self.storage.put_meta(b"reach_clean", b"1")
+        self.storage.flush()
+
     def _persist_tips(self) -> None:
         if self.storage.db is not None:
             self.storage.put_meta(b"tips", serde.encode_hash_list(sorted(self.tips)))
@@ -293,27 +310,43 @@ class Consensus:
 
         engine = self.storage.db.engine
         g = self.params.genesis.hash
-        # transient (blue_work, hash, selected_parent) triples: one ghostdag
-        # decode per block total — the walk below needs only selected_parent
-        order = []
-        for blk in engine.keys_prefix(PREFIX_RELATIONS):
-            raw = engine.get(PREFIX_GHOSTDAG + blk)
-            if raw:
-                gd = serde.decode_ghostdag(raw)
-                order.append((gd.blue_work, blk, gd.selected_parent))
-            else:
-                order.append((0, blk, ORIGIN))
-        order.sort()
-        live = {blk for _, blk, _sp in order}
-        for _, blk, sp in order:
-            if blk == g:
-                self.reachability.add_block(blk, ORIGIN, [], [ORIGIN])
-            else:
-                parents = self.storage.relations.get_parents(blk)
-                live_parents = [p for p in parents if p in live] or [sp]
-                self.reachability.add_block(
-                    blk, sp, self.reach_mergesets.get(blk, []), live_parents
-                )
+        snapshot = self.storage.get_meta(b"reach_snapshot")
+        restored = False
+        if snapshot is not None and self.storage.get_meta(b"reach_clean") == b"1":
+            # clean-shutdown fast path: restore the exact reachability state
+            # in one linear decode, then invalidate the marker so a crash
+            # before the next clean stop falls back to the full rebuild
+            try:
+                serde.decode_reachability(snapshot, self.reachability)
+                restored = True
+            except Exception:  # noqa: BLE001 - corrupt/skewed snapshot
+                # self-heal: a bad snapshot must never brick startup —
+                # reset and take the rebuild path below
+                self.reachability = ReachabilityService()
+                self._rebind_reachability()
+            self.storage.put_meta(b"reach_clean", b"0")
+        if not restored:
+            # transient (blue_work, hash, selected_parent) triples: one
+            # ghostdag decode per block — the walk needs only selected_parent
+            order = []
+            for blk in engine.keys_prefix(PREFIX_RELATIONS):
+                raw = engine.get(PREFIX_GHOSTDAG + blk)
+                if raw:
+                    gd = serde.decode_ghostdag(raw)
+                    order.append((gd.blue_work, blk, gd.selected_parent))
+                else:
+                    order.append((0, blk, ORIGIN))
+            order.sort()
+            live = {blk for _, blk, _sp in order}
+            for _, blk, sp in order:
+                if blk == g:
+                    self.reachability.add_block(blk, ORIGIN, [], [ORIGIN])
+                else:
+                    parents = self.storage.relations.get_parents(blk)
+                    live_parents = [p for p in parents if p in live] or [sp]
+                    self.reachability.add_block(
+                        blk, sp, self.reach_mergesets.get(blk, []), live_parents
+                    )
         # KIP-21 lane state resumes lazily from its persisted snapshot
         self.lane_tracker.load()
         # selected-chain index: only the finality window is ever queried

@@ -413,3 +413,55 @@ def decode_muhash(data: bytes):
 
     mh = MuHash(int.from_bytes(data[:384], "little"), int.from_bytes(data[384:768], "little"))
     return mh
+
+
+# --- reachability snapshot (clean-shutdown fast-restart path) -------------
+
+
+def encode_reachability(reach) -> bytes:
+    """Full ReachabilityService state: intervals, tree parents/children,
+    future covering sets, heights, DAG relations, reindex root.  Written as
+    one blob on clean shutdown; a dirty marker invalidates it so crash
+    restarts fall back to the topological rebuild."""
+    w = io.BytesIO()
+    nodes = list(reach._interval.keys())
+    write_varint(w, len(nodes))
+    for h in nodes:
+        write_hash(w, h)
+        lo, hi = reach._interval[h]
+        write_varint(w, lo)
+        write_varint(w, hi)
+        write_option(w, reach._parent.get(h), write_hash)
+        w.write(encode_hash_list(reach._children.get(h, [])))
+        w.write(encode_hash_list(reach._fcs.get(h, [])))
+        write_varint(w, reach._height.get(h, 0))
+        w.write(encode_hash_list(reach._dag_parents.get(h, [])))
+        w.write(encode_hash_list(reach._dag_children.get(h, [])))
+    write_hash(w, reach._reindex_root)
+    return w.getvalue()
+
+
+def decode_reachability(raw: bytes, reach) -> None:
+    """Restore a ReachabilityService in place from encode_reachability."""
+    r = io.BytesIO(raw)
+    n = read_varint(r)
+    reach._interval = {}
+    reach._parent = {}
+    reach._children = {}
+    reach._fcs = {}
+    reach._height = {}
+    reach._dag_parents = {}
+    reach._dag_children = {}
+    for _ in range(n):
+        h = read_hash(r)
+        lo = read_varint(r)
+        hi = read_varint(r)
+        reach._interval[h] = (lo, hi)
+        has_parent = _read_exact(r, 1) == b"\x01"
+        reach._parent[h] = read_hash(r) if has_parent else None
+        reach._children[h] = read_hash_list(r)
+        reach._fcs[h] = read_hash_list(r)
+        reach._height[h] = read_varint(r)
+        reach._dag_parents[h] = read_hash_list(r)
+        reach._dag_children[h] = read_hash_list(r)
+    reach._reindex_root = read_hash(r)

@@ -691,7 +691,10 @@ class Daemon:
         # RPC thread vs main's wait_for_shutdown path).
         with self._dispatch_lock:
             if self.db is not None:
-                self.consensus.storage.flush()
+                # orderly shutdown: snapshot reachability for the fast
+                # restart path (crashes skip this and rebuild instead);
+                # its flush also commits any other pending ops
+                self.consensus.save_reachability_snapshot()
                 self.consensus.storage.db = None
                 self.db.close()
                 self.db = None

@@ -142,3 +142,58 @@ def test_kill9_mid_replay_recovers(tmp_path, sim_result):
     assert c.sink() == sim_result.sink
     assert c.get_virtual_daa_score() == sim_result.virtual_daa_score
     db.close()
+
+
+def test_reachability_snapshot_fast_restart(tmp_path):
+    """Clean shutdown persists the reachability state; restart restores it
+    byte-for-byte (verified against a forced full rebuild) and invalidates
+    the marker so a subsequent crash falls back to the rebuild path."""
+    import random
+
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.consensus.params import simnet_params
+    from kaspa_tpu.consensus.processes.coinbase import MinerData
+    from kaspa_tpu.sim.simulator import Miner
+    from kaspa_tpu.storage.kv import KvStore
+
+    params = simnet_params(bps=2)
+    path = str(tmp_path / "reach.db")
+    db = KvStore(path)
+    c = Consensus(params, db=db)
+    miner = Miner(0, random.Random(21))
+    for _ in range(25):
+        c.validate_and_insert_block(c.build_block_template(MinerData(miner.spk, b""), []))
+    c.save_reachability_snapshot()
+    expect = (
+        dict(c.reachability._interval), dict(c.reachability._parent),
+        dict(c.reachability._children), dict(c.reachability._fcs),
+        dict(c.reachability._height), dict(c.reachability._dag_parents),
+        dict(c.reachability._dag_children), c.reachability._reindex_root,
+    )
+    sink = c.sink()
+    db.close()
+
+    # snapshot restart restores identical state ...
+    db2 = KvStore(path)
+    c2 = Consensus(params, db=db2)
+    got = (
+        dict(c2.reachability._interval), dict(c2.reachability._parent),
+        dict(c2.reachability._children), dict(c2.reachability._fcs),
+        dict(c2.reachability._height), dict(c2.reachability._dag_parents),
+        dict(c2.reachability._dag_children), c2.reachability._reindex_root,
+    )
+    assert got == expect
+    assert c2.sink() == sink
+    c2.reachability.validate_intervals()
+    # ... and the marker is now dirty: a crash here must rebuild
+    assert c2.storage.get_meta(b"reach_clean") == b"0"
+    # keep processing on the restored index
+    c2.validate_and_insert_block(c2.build_block_template(MinerData(miner.spk, b""), []))
+    db2.close()
+
+    # crash path (no clean shutdown): rebuild still yields equivalent queries
+    db3 = KvStore(path)
+    c3 = Consensus(params, db=db3)
+    assert c3.reachability.is_chain_ancestor_of(params.genesis.hash, c3.sink())
+    c3.reachability.validate_intervals()
+    db3.close()
