@@ -74,7 +74,14 @@ class SyncManager:
                 continue
             data = gd.get(current)
             mergeset = [current, *data.unordered_mergeset()]
-            if max_blocks is not None and len(collected) + len(mergeset) > max_blocks:
+            if (
+                max_blocks is not None
+                and len(collected) + len(mergeset) > max_blocks
+                and highest_reached != low
+            ):
+                # stop at the cap — but only once at least one chain step
+                # landed: a single mergeset larger than max_blocks must
+                # still make progress or chunked IBD would stall/truncate
                 break
             for m in mergeset:
                 if m in collected or m == low:

@@ -43,6 +43,9 @@ MSG_REQUEST_PP_UTXOS = "requestpruningpointutxoset"
 MSG_PP_UTXO_CHUNK = "pruningpointutxosetchunk"
 # locator sync negotiation (flows/src/ibd/negotiate.rs + sync/mod.rs)
 MSG_IBD_BLOCK_LOCATOR = "ibdblocklocator"
+MSG_REQUEST_ANTIPAST = "requestantipast"
+
+IBD_BATCH_SIZE = 512  # blocks per IBD chunk (ibd/flow.rs IBD_BATCH_SIZE shape)
 # address exchange (flows/src/v7/address.rs)
 MSG_REQUEST_ADDRESSES = "requestaddresses"
 MSG_ADDRESSES = "addresses"
@@ -156,9 +159,18 @@ class Node:
     # --- flow handlers (protocol/flows/src/v7/) ---
 
     def _drain(self, peer: Peer) -> None:
-        while peer.inbox:
-            msg_type, payload = peer.inbox.popleft()
-            self._handle(peer, msg_type, payload)
+        # re-entrancy guard: a handler that triggers a send back to this
+        # peer (chunked IBD ping-pong) must ENQUEUE, not recurse — the
+        # outer drain loop picks the message up iteratively
+        if getattr(peer, "_draining", False):
+            return
+        peer._draining = True
+        try:
+            while peer.inbox:
+                msg_type, payload = peer.inbox.popleft()
+                self._handle(peer, msg_type, payload)
+        finally:
+            peer._draining = False
 
     def _handle(self, peer: Peer, msg_type: str, payload) -> None:
         if msg_type == MSG_VERSION:
@@ -271,9 +283,6 @@ class Node:
             # negotiate.rs donor side: highest locator entry we know anchors
             # the antipast query; unknown locator => serve from our pruning
             # point (the syncer should have proof-synced first)
-            from kaspa_tpu.consensus.processes.sync import SyncManager
-
-            sm = SyncManager(self.consensus)
             reach = self.consensus.reachability
             sink = self.consensus.sink()
             # only a chain ancestor of our sink anchors the walk safely:
@@ -285,18 +294,27 @@ class Node:
             )
             if common is None:
                 common = self.consensus.pruning_processor.pruning_point
-            hashes, _highest = sm.antipast_hashes_between(common, self.consensus.sink())
-            bts = self.consensus.storage.block_transactions
-            hdrs = self.consensus.storage.headers
-            peer.send(
-                MSG_IBD_BLOCKS,
-                [Block(hdrs.get(h), bts.get(h)) for h in hashes if bts.has(h)],
-            )
+            self._serve_antipast_chunk(peer, common)
+        elif msg_type == MSG_REQUEST_ANTIPAST:
+            # continuation request: low is the highest chain block the
+            # previous chunk reached (flow.rs IBD batching).  Re-apply the
+            # same pruning-safe anchoring as the locator path, and ALWAYS
+            # reply — a silently dropped continuation would wedge the
+            # syncer's _ibd state forever
+            reach = self.consensus.reachability
+            sink = self.consensus.sink()
+            low = payload
+            if not (reach.has(low) and reach.is_chain_ancestor_of(low, sink)):
+                low = self.consensus.pruning_processor.pruning_point
+            self._serve_antipast_chunk(peer, low)
         elif msg_type == MSG_IBD_BLOCKS:
             staging = self._ibd.get("staging") if self._ibd.get("peer") is peer else None
             target = staging.consensus if staging is not None else self.consensus
-            self._insert_ibd_batch(target, payload)
-            if staging is not None:
+            self._insert_ibd_batch(target, payload["blocks"])
+            if not payload["done"]:
+                # bounded chunks: pull the next batch from where we stopped
+                peer.send(MSG_REQUEST_ANTIPAST, payload["continuation"])
+            elif staging is not None:
                 self._finalize_proof_ibd(staging)
         elif msg_type == MSG_REQUEST_IBD_CHAIN_INFO:
             sink = self.consensus.sink()
@@ -424,6 +442,24 @@ class Node:
                     progress = True
                 except RuleError:
                     pass
+
+    def _serve_antipast_chunk(self, peer: Peer, low: bytes) -> None:
+        """One bounded IBD batch above ``low`` plus the continuation point
+        (flow.rs streams IBD_BATCH_SIZE chunks; the syncer requests the
+        next batch from ``continuation``)."""
+        from kaspa_tpu.consensus.processes.sync import SyncManager
+
+        sm = SyncManager(self.consensus)
+        sink = self.consensus.sink()
+        hashes, highest = sm.antipast_hashes_between(low, sink, max_blocks=IBD_BATCH_SIZE)
+        bts = self.consensus.storage.block_transactions
+        hdrs = self.consensus.storage.headers
+        blocks = [Block(hdrs.get(h), bts.get(h)) for h in hashes if bts.has(h)]
+        done = highest == sink or not hashes
+        peer.send(
+            MSG_IBD_BLOCKS,
+            {"blocks": blocks, "done": done, "continuation": highest},
+        )
 
     def _send_locator(self, peer: Peer, consensus: Consensus) -> None:
         from kaspa_tpu.consensus.processes.sync import SyncManager

@@ -28,6 +28,7 @@ from kaspa_tpu.p2p.node import (
     MSG_REQUEST_IBD_CHAIN_INFO,
     MSG_ADDRESSES,
     MSG_IBD_BLOCK_LOCATOR,
+    MSG_REQUEST_ANTIPAST,
     MSG_REQUEST_ADDRESSES,
     MSG_REQUEST_PP_UTXOS,
     MSG_REQUEST_PRUNING_PROOF,
@@ -69,7 +70,9 @@ _TYPE_IDS = {
     MSG_IBD_BLOCK_LOCATOR: 20,
     MSG_REQUEST_ADDRESSES: 21,
     MSG_ADDRESSES: 22,
+    MSG_REQUEST_ANTIPAST: 23,
 }
+
 _TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
 
 
@@ -111,9 +114,27 @@ def _enc_blocks(blocks) -> bytes:
     return w.getvalue()
 
 
-def _dec_blocks(data: bytes):
-    r = io.BytesIO(data)
+def _dec_blocks_stream(r: io.BytesIO):
     return [serde.decode_block(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
+
+
+def _dec_blocks(data: bytes):
+    return _dec_blocks_stream(io.BytesIO(data))
+
+
+def _enc_ibd_chunk(p) -> bytes:
+    w = io.BytesIO()
+    w.write(_enc_blocks(p["blocks"]))
+    w.write(b"\x01" if p["done"] else b"\x00")
+    w.write(p["continuation"])
+    return w.getvalue()
+
+
+def _dec_ibd_chunk(data: bytes) -> dict:
+    r = io.BytesIO(data)
+    blocks = _dec_blocks_stream(r)
+    done = r.read(1) == b"\x01"
+    return {"blocks": blocks, "done": done, "continuation": r.read(32)}
 
 
 def _enc_empty(_p) -> bytes:
@@ -258,7 +279,7 @@ _CODECS = {
     MSG_INV_TXS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
     MSG_REQUEST_TXS: (serde.encode_hash_list, serde.decode_hash_list_bytes),
     MSG_TX: (serde.encode_tx, serde.decode_tx),
-    MSG_IBD_BLOCKS: (_enc_blocks, _dec_blocks),
+    MSG_IBD_BLOCKS: (_enc_ibd_chunk, _dec_ibd_chunk),
     MSG_PING: (_enc_varint, _dec_varint),
     MSG_PONG: (_enc_varint, _dec_varint),
     MSG_REQUEST_IBD_CHAIN_INFO: (_enc_empty, _dec_empty),
@@ -270,6 +291,7 @@ _CODECS = {
     MSG_REQUEST_PP_UTXOS: (_enc_varint, _dec_varint),
     MSG_PP_UTXO_CHUNK: (_enc_utxo_chunk, _dec_utxo_chunk),
     MSG_IBD_BLOCK_LOCATOR: (serde.encode_hash_list, serde.decode_hash_list_bytes),
+    MSG_REQUEST_ANTIPAST: (lambda h: h, lambda d: d),  # single 32-byte hash
     MSG_REQUEST_ADDRESSES: (_enc_empty, _dec_empty),
     MSG_ADDRESSES: (_enc_strings, _dec_strings),
 }

@@ -153,3 +153,41 @@ def test_synced_node_uses_plain_relay_catchup(donor_node):
     behind.ibd_from(p2)
     assert behind.consensus is target  # no swap happened
     assert behind.consensus.sink() == donor.consensus.sink()
+
+
+def test_chunked_ibd_paginates(monkeypatch):
+    """IBD streams bounded batches with continuation requests (flow.rs
+    IBD_BATCH_SIZE): a 30-block sync at batch size 8 must take multiple
+    chunks and still converge exactly."""
+    import random
+
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.consensus.params import simnet_params
+    from kaspa_tpu.p2p import node as node_mod
+    from kaspa_tpu.p2p.node import Node, connect
+    from kaspa_tpu.sim.simulator import Miner
+
+    monkeypatch.setattr(node_mod, "IBD_BATCH_SIZE", 8)
+    params = simnet_params(bps=2)
+    a = Node(Consensus(params), "chunk-a")
+    b = Node(Consensus(params), "chunk-b")
+    miner = Miner(0, random.Random(33))
+    for _ in range(30):
+        t = a.consensus.build_block_template(miner.miner_data, [])
+        a.consensus.validate_and_insert_block(t)
+
+    chunks = []
+    orig = node_mod.Node._serve_antipast_chunk
+
+    def counting(self, peer, low):
+        chunks.append(low)
+        return orig(self, peer, low)
+
+    monkeypatch.setattr(node_mod.Node, "_serve_antipast_chunk", counting)
+
+    pa, pb = connect(a, b)
+    with b.lock:
+        b.ibd_from(pb)
+    assert b.consensus.sink() == a.consensus.sink()
+    assert b.consensus.get_virtual_daa_score() == 30
+    assert len(chunks) >= 3, f"expected multiple IBD chunks, got {len(chunks)}"
