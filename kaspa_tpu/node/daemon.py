@@ -59,6 +59,18 @@ def parse_args(argv=None) -> DaemonArgs:
     )
     p.add_argument("--connect", action="append", default=[], help="peer host:port to dial (repeatable); IBD runs on connect")
     p.add_argument("--dnsseed", action="append", default=[], help="seed hostname[:port] resolved into the address book (repeatable)")
+    def _ram_scale(v: str) -> float:
+        import math
+
+        x = float(v)
+        # args.rs bounds the flag at parse time; 0/negative/inf/nan would
+        # silently floor every cache or crash the policy scaler
+        if not math.isfinite(x) or not (0.1 <= x <= 10.0):
+            raise argparse.ArgumentTypeError("--ram-scale must be a finite value in [0.1, 10]")
+        return x
+
+    p.add_argument("--ram-scale", type=_ram_scale, default=1.0,
+                   help="scale all store cache budgets, 0.1-10 (cache_policy_builder.rs --ram-scale)")
     # consensus-parameter overrides (kaspad exposes these for testnets;
     # primarily for pruning/IBD integration tests at small scale)
     p.add_argument("--override-pruning-depth", type=int, default=None)
@@ -271,7 +283,10 @@ class Daemon:
                         pass
             self.db = KvStore(os.path.join(args.appdir, active))
             self._check_db_version(self.db)
-        self.consensus = Consensus(self.params, db=self.db)
+        from kaspa_tpu.consensus.stores import CachePolicy
+
+        self.cache_policy = CachePolicy().scaled(getattr(args, "ram_scale", 1.0))
+        self.consensus = Consensus(self.params, db=self.db, cache_policy=self.cache_policy)
         self.node = Node(self.consensus, name="daemon")
         self.node.cmgr._factory = self._staging_factory
         self.node.cmgr.on_swap(self._on_consensus_swap)
@@ -405,7 +420,7 @@ class Daemon:
 
             self._staging_db_name = f"consensus-staging-{int(_time.time() * 1000)}.db"
             db = KvStore(os.path.join(self.args.appdir, self._staging_db_name))
-        return Consensus(self.params, db=db)
+        return Consensus(self.params, db=db, cache_policy=self.cache_policy)
 
     def _on_consensus_swap(self, new_consensus) -> None:
         """Rebind every consensus-holding service after a staging commit

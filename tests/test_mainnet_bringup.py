@@ -162,3 +162,20 @@ def test_templates_refused_during_ibd_served_after(tmp_path):
     finally:
         a.stop()
         b.stop()
+
+
+def test_ram_scale_flag(tmp_path):
+    """--ram-scale multiplies every store cache budget (cache_policy_builder
+    + kaspad --ram-scale)."""
+    from kaspa_tpu.consensus.stores import CachePolicy
+
+    args = parse_args(["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0", "--ram-scale", "0.5"])
+    d = Daemon(args)
+    try:
+        base = CachePolicy()
+        assert d.cache_policy.headers == max(16, int(base.headers * 0.5))
+        assert d.cache_policy.utxo_set == max(16, int(base.utxo_set * 0.5))
+        # the budgets actually bound the attached stores
+        assert d.consensus.storage.headers._access._budget == d.cache_policy.headers
+    finally:
+        d.stop()
