@@ -622,6 +622,9 @@ class Consensus:
                 if p != ORIGIN:
                     push(p)
         assert sink is not None, "no valid sink found"
+        prev_sink = (
+            self.virtual_state.ghostdag_data.selected_parent if self.virtual_state is not None else None
+        )
         # advance the reachability reindex root toward the agreed chain
         # (inquirer.rs hint_virtual_selected_parent)
         self.reachability.hint_virtual_selected_parent(sink)
@@ -665,12 +668,44 @@ class Consensus:
             self.notification_root.notify_virtual_change(
                 self.virtual_state, list(self._acc_added.items()), list(self._acc_removed.items())
             )
+            if prev_sink is not None and prev_sink != sink:
+                self._notify_chain_changed(prev_sink, sink)
         self._acc_added = {}
         self._acc_removed = {}
         # pruning executor: advance the pruning point + delete stale history
         # (pipeline/pruning_processor/processor.rs worker)
         if prev_state is not None:
             self.pruning_processor.advance_if_possible(self.storage.ghostdag.get(sink))
+
+    def _notify_chain_changed(self, prev_sink: bytes, sink: bytes) -> None:
+        """VirtualChainChanged (notify/events.rs): the selected-chain path
+        delta between resolves, with acceptance data for added blocks.
+        The payload is only assembled when someone is subscribed — during
+        IBD this would otherwise hex-encode the entire synced history."""
+        from kaspa_tpu.notify.notifier import Notification
+
+        if not self.notification_root.has_subscribers("virtual-chain-changed"):
+            return
+        # single walk down prev_sink's chain to the first block on sink's
+        # chain collects `removed` and the common ancestor together
+        removed = []
+        cur = prev_sink
+        while not (self.reachability.has(cur) and self.reachability.is_chain_ancestor_of(cur, sink)):
+            removed.append(cur)
+            cur = self.storage.ghostdag.get_selected_parent(cur)
+        added = list(self.reachability.forward_chain_iterator(cur, sink))
+        self.notification_root.notify(
+            Notification(
+                "virtual-chain-changed",
+                {
+                    "added_chain_block_hashes": [h.hex() for h in added],
+                    "removed_chain_block_hashes": [h.hex() for h in removed],
+                    "accepted_transaction_ids": {
+                        h.hex(): [t.hex() for t in self.acceptance_data.get(h, [])] for h in added
+                    },
+                },
+            )
+        )
 
     def _ensure_chain_utxo_valid(self, block: bytes) -> bool:
         """Verify the selected chain up to `block` is UTXO valid; disqualify on failure."""

@@ -139,6 +139,11 @@ class MiningManager:
 
     # --- new-block notification (manager.rs:605 handle_new_block_transactions) ---
 
+    def _notify_new_template(self) -> None:
+        from kaspa_tpu.notify.notifier import Notification
+
+        self.consensus.notification_root.notify(Notification("new-block-template", {}))
+
     def handle_new_block_transactions(self, block_txs: list[Transaction], daa_score: int) -> list[MempoolTx]:
         accepted_ids = [tx.id() for tx in block_txs]
         self.mempool.handle_accepted_transactions(accepted_ids, daa_score)
@@ -146,5 +151,7 @@ class MiningManager:
         self.mempool.remove_conflicting(spent)
         self.mempool.expire(daa_score)
         self.template_cache.clear()
+        # a fresh template is now available (notify/events.rs NewBlockTemplate)
+        self._notify_new_template()
         # attempt to unorphan txs whose parents were just created
         return self.mempool.unorphan_candidates(set(accepted_ids))

@@ -134,6 +134,8 @@ def _serialize_notification(n) -> dict:
         return {"added": pairs("added"), "removed": pairs("removed")}
     if n.event_type == "new-block-template":
         return {}
+    if n.event_type == "virtual-chain-changed":
+        return dict(n.data)  # already JSON-shaped (hex lists + txid map)
     # score changes and the rest carry plain JSON-able payloads
     return {k: v for k, v in n.data.items() if isinstance(v, (int, str, bool, float, list))}
 

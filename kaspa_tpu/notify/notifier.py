@@ -132,6 +132,13 @@ class Notifier:
         ):
             self.parent.stop_notify(self._parent_listener_id, event_type)
 
+    def has_subscribers(self, event_type: str) -> bool:
+        """True when any listener (directly or via a chained child
+        notifier) holds an active subscription for the event."""
+        return any(
+            l.subscriptions[event_type].active for l in self._listeners.values()
+        )
+
     def notify(self, notification: Notification) -> None:
         """Broadcast to all matching listeners (Broadcaster role)."""
         for listener in list(self._listeners.values()):

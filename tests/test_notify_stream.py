@@ -117,3 +117,40 @@ def test_address_filtered_utxos_changed(daemon):
                 assert not (event == "utxos-changed" and data["added"]), "filter leaked"
     finally:
         client.close()
+
+
+def test_chain_changed_and_template_events(daemon):
+    """VirtualChainChanged carries the added selected-chain path with
+    acceptance data; NewBlockTemplate fires when a block invalidates the
+    cached template (notify/events.rs parity)."""
+    d, addr = daemon
+    miner = Miner(0, random.Random(7))
+    pay = _miner_address(miner)
+    client = NotificationClient(addr)
+    try:
+        client.subscribe("virtual-chain-changed")
+        client.subscribe("new-block-template")
+        mined = []
+        for _ in range(2):
+            t = client.call("getBlockTemplate", {"payAddress": pay})
+            client.call("submitBlockByTemplateHash", {"hash": t["block_hash"]})
+            mined.append(t["block_hash"])
+            d.mining.template_cache.clear()
+        events = {"virtual-chain-changed": [], "new-block-template": []}
+        for _ in range(8):
+            try:
+                event, data = client.next_notification(timeout=10)
+            except Exception:  # noqa: BLE001
+                break
+            if event in events:
+                events[event].append(data)
+            if events["virtual-chain-changed"] and events["new-block-template"]:
+                break
+        assert events["new-block-template"], "no NewBlockTemplate event"
+        chains = events["virtual-chain-changed"]
+        assert chains, "no VirtualChainChanged event"
+        added = [h for n in chains for h in n["added_chain_block_hashes"]]
+        assert any(h in mined for h in added)
+        assert all("accepted_transaction_ids" in n for n in chains)
+    finally:
+        client.close()
