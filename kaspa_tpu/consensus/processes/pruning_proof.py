@@ -187,15 +187,27 @@ class PruningProofManager:
     def __init__(self, consensus):
         self.c = consensus
         self.params = consensus.params
+        # donor-side proof cache (the reference maintains persistent
+        # per-level proof stores; serving must not re-run the level BFS +
+        # recolor passes per request): keyed by the pruning point
+        self._proof_cache: tuple[bytes, list[list]] | None = None
 
     # ------------------------------------------------------------------
     # build (donor)
     # ------------------------------------------------------------------
 
     def build_proof(self) -> list[list]:
-        """Per-level header lists, blue-work ascending (build.rs:149)."""
+        """Per-level header lists, blue-work ascending (build.rs:149).
+        Cached per pruning point — the donor serves repeated proof
+        requests (and the pruning executor's keep-set computation) without
+        re-deriving the level sub-DAGs."""
         c = self.c
         pp = c.pruning_processor.pruning_point
+        if self._proof_cache is not None and self._proof_cache[0] == pp:
+            # per-level lists are copied out: a caller mutating its proof
+            # must never corrupt the shared cache the pruning keep-set
+            # computation depends on
+            return [list(level) for level in self._proof_cache[1]]
         m = self.params.pruning_proof_m
         pm = c.parents_manager
         genesis = self.params.genesis.hash
@@ -247,6 +259,7 @@ class PruningProofManager:
             levels.append(level_headers)
             if {h.hash for h in level_headers} <= {pp, genesis}:
                 break  # deeper levels are identical; validator extends
+        self._proof_cache = (pp, [list(level) for level in levels])
         return levels
 
     # ------------------------------------------------------------------
