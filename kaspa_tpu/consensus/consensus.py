@@ -509,6 +509,11 @@ class Consensus:
         # from the miner commitments
         limits = BlockMassLimits.with_shared_limit(self.params.max_block_mass)
         total_compute = total_transient = total_storage = 0
+        # KIP-21 lane limits (body_validation_in_isolation.rs:100-121): cap
+        # occupied subnetwork lanes per block and summed gas per lane.
+        # Applied unconditionally — pre-Toccata valid blocks contain only
+        # native zero-gas non-coinbase txs, so the caps are vacuous there.
+        lanes: dict[bytes, int] = {}  # lane (subnetwork id) -> summed gas
         for tx in txs:
             nc = self.transaction_validator.mass_calculator.calc_non_contextual_masses(tx)
             total_compute += nc.compute_mass
@@ -520,6 +525,20 @@ class Consensus:
                 raise RuleError(f"exceeds transient mass limit: {total_transient} > {limits.transient}")
             if total_storage > limits.storage:
                 raise RuleError(f"exceeds storage mass limit: {total_storage} > {limits.storage}")
+            if not tx.is_coinbase():
+                lane = tx.subnetwork_id
+                if lane in lanes:
+                    gas = lanes[lane] = min(lanes[lane] + tx.gas, (1 << 64) - 1)
+                else:
+                    if len(lanes) >= self.params.lanes_per_block:
+                        raise RuleError(
+                            f"exceeds lanes-per-block limit: {len(lanes) + 1} > {self.params.lanes_per_block}"
+                        )
+                    gas = lanes[lane] = tx.gas
+                if gas > self.params.gas_per_lane:
+                    raise RuleError(
+                        f"exceeds gas-per-lane limit on lane {lane.hex()}: {gas} > {self.params.gas_per_lane}"
+                    )
         seen_ids = set()
         seen_outpoints = set()
         created_outpoints = set()

@@ -118,6 +118,18 @@ class BlockMassLimits:
         )
 
 
+@dataclass
+class BlockLaneLimits:
+    """KIP-21 per-block lane limits (consensus/core/src/mass/mod.rs
+    BlockLaneLimits, constants.rs:98-101): a block may occupy at most
+    `lanes_per_block` distinct subnetwork lanes among its non-coinbase
+    transactions, and the summed gas within any lane is capped at
+    `gas_per_lane`."""
+
+    lanes_per_block: int
+    gas_per_lane: int
+
+
 class MassCalculator:
     def __init__(
         self,

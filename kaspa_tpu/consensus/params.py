@@ -29,6 +29,11 @@ MERGE_DEPTH_DURATION = 3600
 PRUNING_PROOF_M = 1000
 NEVER_ACTIVATION = (1 << 64) - 1  # ForkActivation::never()
 COINBASE_MATURITY_SECONDS = 100
+# Toccata lane limits (constants.rs:94-101): at 10 BPS, 50 lanes/block allows
+# a worst-case rate of 500 SMT lane updates per second; the gas cap is set
+# high for gas-cost granularity within lane/subnet applications.
+DEFAULT_LANES_PER_BLOCK_LIMIT = 50
+DEFAULT_GAS_PER_LANE_LIMIT = 1_000_000_000
 
 FORK_ALWAYS = 0
 FORK_NEVER = (1 << 64) - 1
@@ -146,6 +151,12 @@ class Params:
     skip_proof_of_work: bool = False
     max_block_level: int = 225
     pruning_proof_m: int = PRUNING_PROOF_M
+    # KIP-21 block lane limits (params.rs:347 block_lane_limits). Enforced
+    # unconditionally in body-in-isolation validation: pre-Toccata valid
+    # blocks carry only native zero-gas non-coinbase txs, so the caps are
+    # vacuous before activation (body_validation_in_isolation.rs:98-100).
+    lanes_per_block: int = DEFAULT_LANES_PER_BLOCK_LIMIT
+    gas_per_lane: int = DEFAULT_GAS_PER_LANE_LIMIT
     genesis_override: object = None  # full genesis Block (golden-DAG replay)
     # ForkActivation (config/params.rs:30): DAA score at which the Toccata
     # consensus surface (covenants, introspection breadth, ZK precompiles,

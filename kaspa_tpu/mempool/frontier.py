@@ -17,16 +17,22 @@ Selection:
   distribution's limit case; the reference's take-all/mutating-tree
   selectors reduce to this outcome).
 
-KIP-21 subnetwork lanes are intentionally absent: the framework currently
-runs the pre-Toccata consensus ruleset (see ROADMAP), where every tx rides
-the native lane.
+KIP-21 subnetwork lanes (frontier.rs:166-185): frontier keys carry their
+lane (subnetwork id) and gas, and sampling freezes the lane set once it
+would spill past the lanes-per-block limit — the remainder of the sample is
+a best-feerate-first fill within the already-occupied lanes only (the
+reference k-way-merges per-lane B-trees; a filtered walk of the global tree
+yields the identical order).  Selection-time gas/lane caps are enforced by
+LaneSelectionState (selectors.rs:28-66), matching the consensus
+body-in-isolation lane rules so templates are never built invalid.
 """
 
 from __future__ import annotations
 
 import random
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
+from kaspa_tpu.consensus.model import SUBNETWORK_ID_NATIVE
 from kaspa_tpu.mempool.feerate import ALPHA, FeerateEstimator, FeerateEstimatorArgs
 
 COLLISION_FACTOR = 4
@@ -39,11 +45,16 @@ AVG_MASS_DECAY_FACTOR = 0.99999
 
 @dataclass(frozen=True)
 class FeerateKey:
-    """Sort key: feerate asc, txid tiebreak; weight = feerate**ALPHA."""
+    """Sort key: feerate asc, txid tiebreak; weight = feerate**ALPHA.
+
+    Carries the tx's KIP-21 lane (subnetwork id) and gas so selection can
+    enforce the block lane limits (frontier/feerate_key.rs `lane()`)."""
 
     fee: int
     mass: int
     txid: bytes
+    lane: bytes = SUBNETWORK_ID_NATIVE
+    gas: int = 0
 
     @property
     def feerate(self) -> float:
@@ -196,6 +207,41 @@ class SearchTree:
             node = node.left
 
 
+@dataclass
+class _LaneUsage:
+    tx_count: int = 0
+    gas: int = 0
+
+
+@dataclass
+class LaneSelectionState:
+    """Selection-time KIP-21 lane gating (selectors.rs LaneSelectionState).
+
+    LPB and gas are enforced during selection, but gas is intentionally not
+    part of the global feerate weight since gas capacity is lane-local.
+    The reference additionally carries a `reject` rollback for txs the
+    template builder later drops; here selection is final — frontier txs are
+    pre-validated at mempool intake against the virtual view — so no
+    rollback path exists."""
+
+    lanes_per_block: int
+    gas_per_lane: int
+    occupied: dict[bytes, _LaneUsage] = field(default_factory=dict)
+
+    def try_select(self, lane: bytes, gas: int) -> bool:
+        usage = self.occupied.get(lane)
+        if usage is not None:
+            if usage.gas + gas > self.gas_per_lane:
+                return False
+            usage.tx_count += 1
+            usage.gas += gas
+            return True
+        if len(self.occupied) >= self.lanes_per_block or gas > self.gas_per_lane:
+            return False
+        self.occupied[lane] = _LaneUsage(1, gas)
+        return True
+
+
 class _SampleMassTracker:
     """Stop condition for in-place sampling (frontier.rs SampleMassTracker)."""
 
@@ -250,12 +296,19 @@ class Frontier:
 
     # --- selection -------------------------------------------------------
 
-    def sample_inplace(self, rng: random.Random, max_block_mass: int) -> list[FeerateKey]:
+    def sample_inplace(
+        self, rng: random.Random, max_block_mass: int, lanes_per_block: int | None = None
+    ) -> list[FeerateKey]:
         """Weighted sample of ~1.2x block mass, P(tx) ∝ weight.
 
         Collision narrowing: once the current top item has been sampled,
         the sampling space shrinks below it via a prefix-weight bound, so
         heavily biased weight distributions still converge in O(k log n).
+
+        Lane freeze (frontier.rs sample_inplace): sampling stays fully
+        weighted until the sampled sequence first occupies `lanes_per_block`
+        lanes; the first attempt to spill outside them freezes the lane set
+        and the remainder is a best-first merge within those lanes only.
         """
         assert len(self.tree) > 0
         down = self.tree.descending()
@@ -264,6 +317,8 @@ class Frontier:
         sequence: list[FeerateKey] = []
         tracker = _SampleMassTracker(max_block_mass)
         space = self.tree.total_weight()
+        occupied: set[bytes] = set()
+        frozen = False
         while len(cache) < len(self.tree) and tracker.should_continue():
             query = rng.random() * space
             item = self.tree.search(query)
@@ -281,17 +336,49 @@ class Frontier:
                 item = self.tree.search(query)
             if exhausted:
                 break
+            if lanes_per_block is not None:
+                if len(occupied) < lanes_per_block:
+                    occupied.add(item.lane)
+                elif item.lane not in occupied:
+                    # the weighted sampler wants to spill outside the first
+                    # LPB discovered lanes: freeze and fill intra-lane
+                    frozen = True
+                    break
             cache.add(item.txid)
             tracker.record(item.mass)
             sequence.append(item)
+        if frozen:
+            self._finish_intra_lane_selection(sequence, cache, occupied, tracker)
         return sequence
 
-    def select(self, rng: random.Random, max_block_mass: int) -> list[FeerateKey]:
+    def _finish_intra_lane_selection(
+        self,
+        sequence: list[FeerateKey],
+        cache: set[bytes],
+        occupied: set[bytes],
+        tracker: _SampleMassTracker,
+    ) -> None:
+        """Complete a lane-frozen sample from the occupied lanes only,
+        best-feerate-first (frontier.rs finish_intra_lane_selection).  The
+        reference k-way-merges per-lane B-tree heads; a single descending
+        walk of the global tree filtered to the occupied lanes yields the
+        identical order and is bounded by the remaining mass budget."""
+        for item in self.tree.descending():
+            if not tracker.should_continue():
+                break
+            if item.lane not in occupied or item.txid in cache:
+                continue
+            sequence.append(item)
+            tracker.record(item.mass)
+
+    def select(
+        self, rng: random.Random, max_block_mass: int, lanes_per_block: int | None = None
+    ) -> list[FeerateKey]:
         """Selection order for template building (build_selector)."""
         if len(self.tree) == 0:
             return []
         if self.total_mass > COLLISION_FACTOR * max_block_mass:
-            return self.sample_inplace(rng, max_block_mass)
+            return self.sample_inplace(rng, max_block_mass, lanes_per_block)
         return list(self.tree.descending())
 
     # --- fee estimation --------------------------------------------------

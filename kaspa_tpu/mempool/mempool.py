@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 
 from kaspa_tpu.consensus.model import Transaction, TransactionOutpoint
 from kaspa_tpu.mempool.feerate import FeerateEstimator, FeerateEstimatorArgs
-from kaspa_tpu.mempool.frontier import FeerateKey, Frontier
+from kaspa_tpu.mempool.frontier import FeerateKey, Frontier, LaneSelectionState
 
 
 class MempoolError(Exception):
@@ -62,7 +62,10 @@ class Mempool:
 
     @staticmethod
     def _fkey(entry: MempoolTx) -> FeerateKey:
-        return FeerateKey(entry.fee, max(entry.mass, 1), entry.tx.id())
+        return FeerateKey(
+            entry.fee, max(entry.mass, 1), entry.tx.id(),
+            lane=entry.tx.subnetwork_id, gas=entry.tx.gas,
+        )
 
     def _is_ready(self, entry: MempoolTx) -> bool:
         """Ready = no in-pool ancestor (frontier membership criterion)."""
@@ -179,16 +182,25 @@ class Mempool:
 
     # --- selection (frontier.rs, selectors.rs) ---
 
-    def select_transactions(self, max_count: int = 300, mass_limits=None) -> list[MempoolTx]:
+    def select_transactions(
+        self, max_count: int = 300, mass_limits=None, lane_limits=None
+    ) -> list[MempoolTx]:
         """Frontier-driven template selection: weight-sampled under
         congestion, exact greedy otherwise (frontier.select), then a
         sequence pack bounded by the per-dimension block mass limits
-        (selectors.rs SequenceSelector).  Only frontier (ready) txs are
-        candidates, so no in-block chaining can occur."""
+        (selectors.rs SequenceSelector) and by the KIP-21 lane limits
+        (selectors.rs LaneSelectionState.try_select).  Only frontier
+        (ready) txs are candidates, so no in-block chaining can occur."""
         max_block_mass = mass_limits.compute if mass_limits is not None else 500_000
+        lanes = (
+            LaneSelectionState(lane_limits.lanes_per_block, lane_limits.gas_per_lane)
+            if lane_limits is not None
+            else None
+        )
         chosen: list[MempoolTx] = []
         compute = transient = storage = 0
-        for key in self.frontier.select(self._rng, max_block_mass):
+        lpb = lanes.lanes_per_block if lanes is not None else None
+        for key in self.frontier.select(self._rng, max_block_mass, lanes_per_block=lpb):
             if len(chosen) >= max_count:
                 break
             entry = self.pool.get(key.txid)
@@ -200,6 +212,8 @@ class Mempool:
                 and storage + entry.storage_mass <= mass_limits.storage
             ):
                 continue  # would overflow a block mass dimension
+            if lanes is not None and not lanes.try_select(key.lane, key.gas):
+                continue  # would overflow the lane count or per-lane gas cap
             compute += entry.mass
             transient += entry.transient_mass
             storage += entry.storage_mass

@@ -129,10 +129,12 @@ class MiningManager:
             import time as _time
 
             timestamp = int(_time.time() * 1000)
-        from kaspa_tpu.consensus.mass import BlockMassLimits
+        from kaspa_tpu.consensus.mass import BlockLaneLimits, BlockMassLimits
 
-        limits = BlockMassLimits.with_shared_limit(self.consensus.params.max_block_mass)
-        selected = self.mempool.select_transactions(mass_limits=limits)
+        params = self.consensus.params
+        limits = BlockMassLimits.with_shared_limit(params.max_block_mass)
+        lane_limits = BlockLaneLimits(params.lanes_per_block, params.gas_per_lane)
+        selected = self.mempool.select_transactions(mass_limits=limits, lane_limits=lane_limits)
         template = self.consensus.build_block_template(miner_data, [e.tx for e in selected], timestamp)
         self.template_cache.set(template)
         return template

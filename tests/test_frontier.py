@@ -150,3 +150,82 @@ def test_mempool_frontier_integration():
     # child expired -> frontier drains
     mp.expire(current_daa_score=10**9)
     assert len(mp.frontier) == 0 and len(mp.pool) == 0
+
+
+# ---------------------------------------------------------------------------
+# KIP-21 lane-aware selection (frontier.rs:60-61,166-185, selectors.rs:28-66)
+# ---------------------------------------------------------------------------
+
+from kaspa_tpu.mempool.frontier import LaneSelectionState
+
+
+def _lane_key(i: int, fee: int, mass: int, lane: int, gas: int = 0) -> FeerateKey:
+    return FeerateKey(fee, mass, i.to_bytes(8, "big"), lane=bytes([lane]) + b"\x00" * 19, gas=gas)
+
+
+def test_lane_selection_state_caps():
+    s = LaneSelectionState(lanes_per_block=2, gas_per_lane=100)
+    a, b, c = (bytes([i]) + b"\x00" * 19 for i in (3, 4, 5))
+    assert s.try_select(a, 60)
+    assert s.try_select(a, 40)           # fills lane a's gas exactly
+    assert not s.try_select(a, 1)        # gas cap
+    assert s.try_select(b, 101) is False  # single tx over cap never enters
+    assert s.try_select(b, 0)
+    assert not s.try_select(c, 0)        # LPB cap: third lane refused
+
+
+def test_sample_inplace_freezes_lane_set():
+    """Once the weighted sample occupies LPB lanes, spill attempts freeze the
+    lane set and the remainder comes from those lanes only (best-first)."""
+    rng = random.Random(11)
+    fr = Frontier()
+    n_lanes, per_lane = 40, 120
+    for lane in range(n_lanes):
+        for j in range(per_lane):
+            i = lane * per_lane + j
+            fr.insert(_lane_key(i, fee=2000 * (1 + (i % 7)), mass=2000, lane=3 + lane))
+    assert fr.total_mass > 4 * 50_000  # congested: sampling path
+    lpb = 5
+    sample = fr.sample_inplace(rng, max_block_mass=50_000, lanes_per_block=lpb)
+    lanes_used = {k.lane for k in sample}
+    assert 0 < len(lanes_used) <= lpb
+    assert sum(k.mass for k in sample) >= 50_000  # freeze still fills the block
+
+
+def test_mempool_select_respects_lane_limits():
+    """End-to-end: select_transactions never exceeds the lane count or
+    per-lane gas caps even when the frontier spans many lanes."""
+    from types import SimpleNamespace
+
+    from kaspa_tpu.mempool.mempool import Mempool, MempoolTx
+    from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+    from kaspa_tpu.consensus.model.tx import (
+        ComputeCommit,
+        ScriptPublicKey,
+        TransactionOutpoint,
+        subnetwork_from_byte,
+    )
+
+    mp = Mempool()
+    rng = random.Random(3)
+    for i in range(200):
+        lane = subnetwork_from_byte(3 + i % 20)  # 20 distinct lanes
+        tx = Transaction(
+            1,
+            [TransactionInput(TransactionOutpoint(i.to_bytes(32, "big"), 0), b"", 0, ComputeCommit.budget(0))],
+            [TransactionOutput(1, ScriptPublicKey(0, b"\x51"))],
+            0,
+            lane,
+            40,  # per-tx gas
+            b"",
+        )
+        mp.insert(MempoolTx(tx, fee=rng.randrange(1000, 100_000), mass=2000, added_daa_score=0))
+    lane_limits = SimpleNamespace(lanes_per_block=4, gas_per_lane=100)
+    mass_limits = SimpleNamespace(compute=500_000, transient=500_000, storage=500_000)
+    chosen = mp.select_transactions(mass_limits=mass_limits, lane_limits=lane_limits)
+    assert chosen
+    per_lane_gas: dict[bytes, int] = {}
+    for e in chosen:
+        per_lane_gas[e.tx.subnetwork_id] = per_lane_gas.get(e.tx.subnetwork_id, 0) + e.tx.gas
+    assert len(per_lane_gas) <= 4
+    assert all(g <= 100 for g in per_lane_gas.values())  # => ≤2 txs/lane at gas 40

@@ -311,3 +311,84 @@ def test_first_parent_must_be_selected_parent():
     blk.header.parents_by_level[0] = list(reversed(blk.header.parents_by_level[0]))
     blk.header.invalidate_cache()
     assert c.validate_and_insert_block(blk) == "disqualified"
+
+
+# ---------------------------------------------------------------------------
+# KIP-21 block lane limits (body_validation_in_isolation.rs:100-121,478-496)
+# ---------------------------------------------------------------------------
+
+from kaspa_tpu.consensus.consensus import RuleError
+from kaspa_tpu.consensus.model.tx import TransactionOutpoint, subnetwork_from_byte
+
+
+def _lane_tx(index: int, lane: bytes, gas: int) -> Transaction:
+    """A minimal non-coinbase tx riding subnetwork `lane` with `gas`
+    (mirrors the reference's toccata_lane_tx test helper)."""
+    inp = TransactionInput(
+        TransactionOutpoint(bytes([index]) * 32, 0), b"", (1 << 64) - 1, ComputeCommit.budget(0)
+    )
+    return Transaction(1, [inp], [TransactionOutput(1, SPK)], 0, lane, gas, b"")
+
+
+def _block_with_lane_txs(c, tip, lane_txs, timestamp):
+    """An otherwise-valid block whose body carries `lane_txs` appended after
+    the coinbase; only hash_merkle_root is recommitted — the lane rules fire
+    in body-in-isolation, before any UTXO-context validation."""
+    blk = c.build_block_with_parents([tip], MD, [], timestamp=timestamp)
+    blk.transactions = [blk.transactions[0]] + lane_txs
+    blk.header.hash_merkle_root = merkle.calc_hash_merkle_root(blk.transactions)
+    blk.header.invalidate_cache()
+    blk.invalidate_cache() if hasattr(blk, "invalidate_cache") else None
+    return blk
+
+
+def test_lanes_per_block_limit_rejected():
+    """A block occupying lanes_per_block+1 distinct lanes is rejected; one
+    occupying exactly lanes_per_block passes body-in-isolation."""
+    lpb = 3
+    c = Consensus(_params(0, lanes_per_block=lpb))
+    tip, _ = _grow(c, c.params.genesis.hash, 3)
+
+    over = [_lane_tx(i, subnetwork_from_byte(3 + i), 0) for i in range(lpb + 1)]
+    blk = _block_with_lane_txs(c, tip, over, 50_000)
+    with pytest.raises(RuleError, match="lanes-per-block"):
+        c.validate_and_insert_block(blk)
+
+    # exactly LPB distinct lanes passes the body stage (the block is later
+    # disqualified in UTXO context for its fabricated inputs — no RuleError)
+    at = [_lane_tx(i, subnetwork_from_byte(3 + i), 0) for i in range(lpb)]
+    blk2 = _block_with_lane_txs(c, tip, at, 51_000)
+    assert c.validate_and_insert_block(blk2) in ("disqualified", "utxo_pending")
+
+
+def test_gas_per_lane_limit_rejected():
+    """Summed gas within one lane above gas_per_lane is rejected — by a
+    single tx or accumulated across txs; the same gas spread across distinct
+    lanes is fine."""
+    cap = 1_000
+    c = Consensus(_params(0, gas_per_lane=cap))
+    tip, _ = _grow(c, c.params.genesis.hash, 3)
+
+    one = [_lane_tx(1, subnetwork_from_byte(7), cap + 1)]
+    with pytest.raises(RuleError, match="gas-per-lane"):
+        c.validate_and_insert_block(_block_with_lane_txs(c, tip, one, 50_000))
+
+    split = [_lane_tx(1, subnetwork_from_byte(7), cap // 2 + 1),
+             _lane_tx(2, subnetwork_from_byte(7), cap // 2 + 1)]
+    with pytest.raises(RuleError, match="gas-per-lane"):
+        c.validate_and_insert_block(_block_with_lane_txs(c, tip, split, 51_000))
+
+    spread = [_lane_tx(1, subnetwork_from_byte(7), cap),
+              _lane_tx(2, subnetwork_from_byte(8), cap)]
+    blk = _block_with_lane_txs(c, tip, spread, 52_000)
+    assert c.validate_and_insert_block(blk) in ("disqualified", "utxo_pending")
+
+
+def test_many_txs_single_lane_not_lane_limited():
+    """lanes_per_block caps distinct lanes, not tx count: many zero-gas txs
+    in one lane pass body-in-isolation."""
+    c = Consensus(_params(0, lanes_per_block=2))
+    tip, _ = _grow(c, c.params.genesis.hash, 3)
+    txs = [_lane_tx(i, subnetwork_from_byte(9), 0) for i in range(1, 8)]
+    blk = _block_with_lane_txs(c, tip, txs, 50_000)
+    assert c.validate_and_insert_block(blk) in ("disqualified", "utxo_pending")
