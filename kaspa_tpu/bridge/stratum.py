@@ -63,8 +63,10 @@ def vardiff_pow2_clamp_towards(current: float, next_: float) -> float:
 def vardiff_compute_next_diff(
     current: float, shares: float, elapsed_secs: float, expected_spm: float, clamp_pow2: bool
 ) -> float | None:
-    """share_handler.rs:56 vardiff_compute_next_diff, ported verbatim:
-    returns the next difficulty or None when no adjustment applies."""
+    """Next difficulty, or None when no adjustment applies — same policy
+    constants and semantics as share_handler.rs:56 vardiff_compute_next_diff
+    (including the :100-102 10% hysteresis), since pools tune against the
+    reference's observable adjustment behavior."""
     if not math.isfinite(current) or current <= 0.0:
         return None
     if not math.isfinite(elapsed_secs) or elapsed_secs <= 0.0:
@@ -77,13 +79,21 @@ def vardiff_compute_next_diff(
     if elapsed_secs < VARDIFF_MIN_ELAPSED_SECS or shares < VARDIFF_MIN_SHARES:
         return None
     observed_spm = (shares / elapsed_secs) * 60.0
-    ratio = observed_spm / expected_spm if expected_spm > 0 else 1.0
-    if VARDIFF_LOWER_RATIO <= ratio <= VARDIFF_UPPER_RATIO:
+    ratio = observed_spm / max(expected_spm, 1.0)
+    if not math.isfinite(ratio) or ratio <= 0.0:
+        return None
+    if VARDIFF_LOWER_RATIO < ratio < VARDIFF_UPPER_RATIO:
         return None
     step = min(max(math.sqrt(ratio), VARDIFF_MAX_STEP_DOWN), VARDIFF_MAX_STEP_UP)
     next_ = max(current * step, 1.0)
     if clamp_pow2:
         next_ = vardiff_pow2_clamp_towards(current, next_)
+    # 10% hysteresis (share_handler.rs:100-102): hold difficulty unless the
+    # relative change is large enough — prevents oscillation when pow2
+    # clamping is off and the observed rate hovers near a band edge
+    rel_change = abs(next_ - current) / max(current, 1.0)
+    if rel_change < 0.10:
+        return None
     return None if next_ == current else next_
 
 

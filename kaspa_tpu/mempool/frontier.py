@@ -270,6 +270,10 @@ class Frontier:
 
     def __init__(self, target_time_per_block_seconds: float = 1.0):
         self.tree = SearchTree()
+        # lane -> live key count: bounds the lane-frozen fill walk without
+        # maintaining per-lane ordered structures (frontier.rs keeps per-lane
+        # B-trees; a count is enough for the filtered-walk realization)
+        self.lane_counts: dict[bytes, int] = {}
         self.total_mass = 0
         self.average_transaction_mass = INITIAL_AVG_MASS
         self.target_time_per_block_seconds = target_time_per_block_seconds
@@ -279,6 +283,7 @@ class Frontier:
 
     def insert(self, key: FeerateKey) -> bool:
         if self.tree.insert(key):
+            self.lane_counts[key.lane] = self.lane_counts.get(key.lane, 0) + 1
             self.total_mass += key.mass
             # decaying average: recent txs weigh more, history never vanishes
             self.average_transaction_mass = (
@@ -290,6 +295,11 @@ class Frontier:
 
     def remove(self, key: FeerateKey) -> bool:
         if self.tree.remove(key):
+            n = self.lane_counts.get(key.lane, 0) - 1
+            if n > 0:
+                self.lane_counts[key.lane] = n
+            else:
+                self.lane_counts.pop(key.lane, None)
             self.total_mass -= key.mass
             return True
         return False
@@ -360,13 +370,19 @@ class Frontier:
     ) -> None:
         """Complete a lane-frozen sample from the occupied lanes only,
         best-feerate-first (frontier.rs finish_intra_lane_selection).  The
-        reference k-way-merges per-lane B-tree heads; a single descending
-        walk of the global tree filtered to the occupied lanes yields the
-        identical order and is bounded by the remaining mass budget."""
+        reference k-way-merges per-lane B-tree heads; a descending walk of
+        the global tree filtered to the occupied lanes yields the identical
+        order.  The walk stops at the mass budget or once every live
+        occupied-lane entry has been seen (lane_counts bound) — it does not
+        scan the tail of a large tree whose occupied-lane items are spent."""
+        remaining = sum(self.lane_counts.get(lane, 0) for lane in occupied)
         for item in self.tree.descending():
-            if not tracker.should_continue():
+            if remaining <= 0 or not tracker.should_continue():
                 break
-            if item.lane not in occupied or item.txid in cache:
+            if item.lane not in occupied:
+                continue
+            remaining -= 1
+            if item.txid in cache:
                 continue
             sequence.append(item)
             tracker.record(item.mass)

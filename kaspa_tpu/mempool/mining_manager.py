@@ -71,6 +71,13 @@ class MiningManager:
         missing inputs in the orphan pool."""
         validator = self.consensus.transaction_validator
         validator.validate_tx_in_isolation(tx)
+        # per-tx gas cap (mining/src/mempool/check_transaction_limits.rs:19
+        # RejectGas): a tx whose gas alone exceeds the per-lane cap can never
+        # be mined, so it must not enter the pool
+        if tx.gas > self.consensus.params.gas_per_lane:
+            raise MempoolError(
+                f"transaction gas {tx.gas} exceeds the per-lane cap {self.consensus.params.gas_per_lane}"
+            )
         virtual = self.consensus.virtual_state
         validator.validate_tx_in_header_context(tx, virtual.daa_score, virtual.past_median_time)
 

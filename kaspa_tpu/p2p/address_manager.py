@@ -4,10 +4,10 @@ connection maintenance.
 Reference: components/addressmanager/src/lib.rs (address store with
 connection-failure prioritization, 24h IP bans, weighted random iteration)
 and components/connectionmanager/src/lib.rs (outbound target maintenance,
-permanent connection requests with retry backoff).  UPnP port mapping and
-DNS seeding are intentionally absent: this framework targets controlled
-simnet/testnet deployments (zero-egress environments), so peers come from
---connect/add_peer; the seeding hook is a plain callable for future wiring.
+permanent connection requests with retry backoff).  DNS seeding is
+implemented (`dns_seed` below, resolving per-network seed hostnames into
+the store); UPnP port mapping is absent — controlled deployments reach
+nodes via --connect/add_peer or explicit port forwarding.
 """
 
 from __future__ import annotations

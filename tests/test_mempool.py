@@ -135,3 +135,23 @@ def test_orphan_pool(chain):
     mgr.validate_and_insert_transaction(orphan)
     assert orphan.id() in mgr.mempool.orphans
     assert not mgr.mempool.get(orphan.id())
+
+
+def test_intake_rejects_gas_above_lane_cap(chain):
+    """check_transaction_limits.rs:19 RejectGas: a tx whose own gas exceeds
+    gas_per_lane can never be mined and must be refused at mempool intake."""
+    c, res = chain
+    mm = MiningManager(c)
+    rng = random.Random(77)
+    sim_rng = random.Random(17)
+    miners = [Miner(i, sim_rng) for i in range(2)]
+    tx, _, entry = _signed_spend(c, miners[0], rng)
+    # ride a non-native lane (native txs with gas are already rejected in
+    # isolation); the cap check fires before any signature validation
+    from kaspa_tpu.consensus.model.tx import subnetwork_from_byte
+
+    tx.subnetwork_id = subnetwork_from_byte(9)
+    tx.gas = c.params.gas_per_lane + 1
+    with pytest.raises(MempoolError, match="per-lane cap"):
+        mm.validate_and_insert_transaction(tx)
+    assert len(mm.mempool) == 0

@@ -162,6 +162,21 @@ def test_vardiff_compute_matches_reference_semantics():
     assert vardiff_compute_next_diff(1.0, 0.0, 95.0, 20.0, True) is None  # already at floor
 
 
+def test_vardiff_hysteresis_no_clamp():
+    """share_handler.rs:100-102: without pow2 clamping, adjustments smaller
+    than 10% of the current difficulty are suppressed — a rate hovering just
+    outside the dead band must not oscillate."""
+    # ratio just under the lower band edge: sqrt(0.74) ≈ 0.86 → 14% change
+    # clears the hysteresis and lowers difficulty
+    adj = vardiff_compute_next_diff(100.0, 22.2, 60.0, 30.0, False)
+    assert adj is not None and adj < 100.0
+    # the guard fires when the diff-1.0 floor pulls the step back within
+    # 10% of current: 1.05 * 0.5 floors to 1.0 → 4.8% change → held
+    assert vardiff_compute_next_diff(1.05, 3.0, 3600.0, 20.0, False) is None
+    # same slow-worker inputs at a larger current adjust normally
+    assert vardiff_compute_next_diff(4.0, 3.0, 3600.0, 20.0, False) == pytest.approx(2.0)
+
+
 def test_low_difficulty_share_rejected():
     """A share above the worker's target but below nothing is rejected 20."""
     from kaspa_tpu.consensus.params import simnet_params
