@@ -65,6 +65,30 @@ def _neg_bytes(b: bytes) -> bytes:
     return bytes(255 - x for x in b)
 
 
+def _encode_anchor_segment(segment: list) -> bytes:
+    """[(blue_score, block_hash)] — the bootstrap shortcut-anchor chain
+    persisted under the meta column (headers live in the header store)."""
+    import struct as _struct
+
+    out = [_struct.pack("<I", len(segment))]
+    for bs, blk in segment:
+        out.append(_struct.pack("<Q", bs) + blk)
+    return b"".join(out)
+
+
+def _decode_anchor_segment(raw: bytes) -> list:
+    import struct as _struct
+
+    (n,) = _struct.unpack_from("<I", raw, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        (bs,) = _struct.unpack_from("<Q", raw, off)
+        out.append((bs, raw[off + 8 : off + 40]))
+        off += 40
+    return out
+
+
 def _FinalityConflictNotification(tip: bytes, finality_point: bytes):
     from kaspa_tpu.notify.notifier import Notification
 
@@ -188,6 +212,11 @@ class Consensus:
 
         self.lane_tracker = LaneTracker(self.storage, params.finality_depth, params.genesis.hash)
         self.selected_chain: list[tuple[int, bytes]] = [(0, params.genesis.hash)]
+        # chain linkage for below-pruning-point anchor-segment blocks whose
+        # ghostdag records do not exist (proof bootstrap) or were re-rooted
+        # by pruning: block -> selected parent.  Their headers live in the
+        # ordinary header store.
+        self._segment_prev: dict[bytes, bytes] = {}
 
         if self.storage.is_initialized():
             self._load_state()
@@ -291,6 +320,193 @@ class Consensus:
         if self.storage.db is not None:
             self.storage.put_meta(b"utxo_position", self.utxo_position)
 
+    # ------------------------------------------------------------------
+    # KIP-21 lane-state transfer (IBD / trusted bootstrap)
+    # ------------------------------------------------------------------
+
+    def _chain_parent(self, block: bytes) -> bytes | None:
+        """Selected parent along the final (pruned-history) chain.
+
+        The anchor archive takes precedence: pruning re-roots surviving
+        ghostdag records whose parents were deleted to ORIGIN, while the
+        archive records the true chain linkage before deletion (history
+        below the pruning point is final, so archived links never go
+        stale).  Above the archive, live ghostdag is authoritative."""
+        sp = self._segment_prev.get(block)
+        if sp is not None:
+            return sp
+        if self.storage.ghostdag.has(block):
+            sp = self.storage.ghostdag.get_selected_parent(block)
+            if sp != ORIGIN:
+                return sp
+        return None
+
+    def export_pp_lane_state(self):
+        """Lane state at the pruning point, for IBD serving — the donor side
+        of flows/src/ibd/flow.rs:145-150 sync_new_smt_state.
+
+        Returns None when the PP is pre-Toccata (the receiver starts empty,
+        mirroring the reference's set_pruning_smt_stable fast path), else
+        ``(meta, lanes, segment)``:
+
+        - meta: {lanes_root, pcd, parent_seq_commit, shortcut_block,
+          inactivity_shortcut} — the reference's 96-byte SmtMetadata plus
+          the shortcut identity;
+        - lanes: sorted [(lane_key, tip, blue_score)] at the PP;
+        - segment: the selected-chain HEADERS from the PP's
+          inactivity-shortcut block up to the PP itself — the receiver's
+          shortcut anchors for the first finality-window of post-bootstrap
+          chain blocks.  Whole headers, not bare value pairs: each is bound
+          to the proof-validated PP by the parent-hash chain, so a peer
+          cannot substitute anchor values without mining real alternative
+          headers in the PP's past.  (The reference reads the same data
+          from headers it retains below the PP.)
+        """
+        from kaspa_tpu.consensus.smt_processor import ZERO_HASH
+
+        pp = self.pruning_processor.pruning_point
+        if pp == self.params.genesis.hash:
+            return None
+        hdr = self.storage.headers.get(pp)
+        if not self.params.toccata_active(hdr.daa_score):
+            return None
+        build = self.lane_tracker.builds.try_get(pp)
+        if build is None:
+            return None
+
+        # rewind the materialized lane tips from the current UTXO position
+        # back to the PP by applying per-chain-block undo records (the
+        # in-RAM selected_chain index is trimmed, so walk storage)
+        tips = dict(self.lane_tracker.lane_tips)
+        cur = self.utxo_position
+        while cur != pp:
+            b = self.lane_tracker.builds.try_get(cur)
+            if b is not None:
+                for lk, prev in b.undo.items():
+                    if prev is None:
+                        tips.pop(lk, None)
+                    else:
+                        tips[lk] = prev
+            cur = self._chain_parent(cur)
+            if cur is None:
+                return None  # chain walk left our materialized history
+
+        if not self.storage.headers.has(build.shortcut_block):
+            return None  # anchor headers not retained (pre-upgrade DB)
+        sc_hdr = self.storage.headers.get(build.shortcut_block)
+        inactivity = (
+            sc_hdr.accepted_id_merkle_root
+            if self.params.toccata_active(sc_hdr.daa_score)
+            else ZERO_HASH
+        )
+        # the seq-commit chains from the GHOSTDAG selected parent (which the
+        # post-Toccata chain rule also pins as direct_parents()[0])
+        parent = (
+            self.storage.ghostdag.get_selected_parent(pp)
+            if self.storage.ghostdag.has(pp)
+            else hdr.direct_parents()[0]
+        )
+        meta = {
+            "lanes_root": build.lanes_root,
+            "pcd": build.payload_ctx_digest,
+            "parent_seq_commit": self.storage.headers.get(parent).accepted_id_merkle_root,
+            "shortcut_block": build.shortcut_block,
+            "inactivity_shortcut": inactivity,
+        }
+
+        # anchor segment: chain headers from shortcut(pp) to pp inclusive
+        segment = []
+        cur = pp
+        while True:
+            if not self.storage.headers.has(cur):
+                return None
+            segment.append(self.storage.headers.get(cur))
+            if cur == build.shortcut_block or cur == self.params.genesis.hash:
+                break
+            cur = self._chain_parent(cur)
+            if cur is None:
+                return None
+        segment.reverse()
+        lanes = sorted((lk, tip, bs) for lk, (tip, bs) in tips.items())
+        return meta, lanes, segment
+
+    def import_pp_lane_state(self, meta: dict, lanes: list, segment: list) -> None:
+        """Install a transferred pruning-point lane state into this (freshly
+        proof-bootstrapped) consensus — the receiving side of
+        sync_new_smt_state / import_pruning_point_smt.
+
+        The lane set and metadata are verified against the proof-validated
+        PP header's sequencing commitment (verify_lane_state), and the
+        anchor-segment headers are verified as a parent-hash chain ending
+        at the PP: header[i].hash must appear in header[i+1]'s direct
+        parents and the last header must BE the proven PP header, so every
+        anchor's (daa_score, accepted_id_merkle_root, blue_score) is bound
+        through block hashes to the proof.
+        """
+        from kaspa_tpu.consensus.smt_processor import LaneStateError, ZERO_HASH, verify_lane_state
+
+        pp = self.pruning_processor.pruning_point
+        hdr = self.storage.headers.get(pp)
+        # wire-decoded headers carry a cached hash restored from peer bytes;
+        # recompute so every hash-binding check below is over real contents
+        for h in segment:
+            h.invalidate_cache()
+        if not segment or segment[-1].hash != pp:
+            raise LaneStateError("anchor segment must end at the pruning point")
+        if segment[0].hash != meta["shortcut_block"]:
+            raise LaneStateError("anchor segment must start at the shortcut block")
+        for a, b in zip(segment, segment[1:]):
+            # post-Toccata chain blocks pin the selected parent as the FIRST
+            # direct parent (utxo_validation.rs:219-238), which rules out a
+            # donor routing the segment through non-selected parents; for
+            # pre-Toccata hops membership is the strongest header-level
+            # check, and such anchors fold to ZERO regardless
+            if self.params.toccata_active(b.daa_score):
+                if b.direct_parents()[0] != a.hash:
+                    raise LaneStateError("anchor segment hop is not the selected parent")
+            elif a.hash not in b.direct_parents():
+                raise LaneStateError("anchor segment headers do not form a parent chain")
+            if b.blue_score <= a.blue_score:
+                raise LaneStateError("anchor segment blue scores must strictly ascend")
+        if len(segment) > 1 and self.storage.ghostdag.has(pp):
+            if self.storage.ghostdag.get_selected_parent(pp) != segment[-2].hash:
+                raise LaneStateError("anchor segment disagrees with the PP's selected parent")
+        # the seq-commit chains from the GHOSTDAG selected parent
+        # (smt_processor.compute); trusted ghostdag gives it for the PP
+        par = (
+            self.storage.ghostdag.get_selected_parent(pp)
+            if self.storage.ghostdag.has(pp)
+            else hdr.direct_parents()[0]
+        )
+        if self.storage.headers.has(par):
+            if meta["parent_seq_commit"] != self.storage.headers.get(par).accepted_id_merkle_root:
+                raise LaneStateError("metadata parent commitment contradicts the PP parent header")
+        # the claimed folded shortcut value must equal what the (now hash-
+        # bound) shortcut header itself folds to
+        sc_hdr = segment[0]
+        expected_fold = (
+            sc_hdr.accepted_id_merkle_root
+            if self.params.toccata_active(sc_hdr.daa_score)
+            else ZERO_HASH
+        )
+        if meta["inactivity_shortcut"] != expected_fold:
+            raise LaneStateError("metadata inactivity shortcut contradicts the shortcut header")
+        verify_lane_state(hdr, meta, lanes)
+
+        self.lane_tracker.import_state(pp, hdr, meta, lanes)
+        pairs = []
+        for i, h in enumerate(segment):
+            if not self.storage.headers.has(h.hash):
+                self.storage.headers.insert(h)
+                self.storage.statuses.set(h.hash, StatusesStore.STATUS_HEADER_ONLY)
+            if i > 0:
+                self._segment_prev[h.hash] = segment[i - 1].hash
+            pairs.append((h.blue_score, h.hash))
+        self.selected_chain = pairs
+        if self.storage.db is not None:
+            self.storage.put_meta(b"lane_anchor_segment", _encode_anchor_segment(pairs))
+        self.storage.flush()
+
     def _load_state(self) -> None:
         """Restore consensus state from the attached DB.
 
@@ -360,6 +576,28 @@ class Consensus:
                 break
             cur = self.storage.ghostdag.get_selected_parent(cur)
         self.selected_chain = chain[::-1]
+        # prepend the bootstrap anchor segment (below-PP shortcut anchors
+        # whose headers were imported with the lane state) where it reaches
+        # below the rebuilt chain's base
+        raw_seg = self.storage.get_meta(b"lane_anchor_segment")
+        if raw_seg:
+            # defensively truncate a stale blob at the first missing header:
+            # filtering interior holes would splice non-parents together in
+            # _segment_prev and poison future exports
+            decoded = _decode_anchor_segment(raw_seg)
+            first_live = next(
+                (i for i, (_, blk) in enumerate(decoded) if self.storage.headers.has(blk)),
+                len(decoded),
+            )
+            entries = decoded[first_live:]
+            if any(not self.storage.headers.has(blk) for _, blk in entries):
+                entries = []  # interior hole: unusable without false links
+            for i, (bs, blk) in enumerate(entries):
+                if i > 0:
+                    self._segment_prev[blk] = entries[i - 1][1]
+            base_bs = self.selected_chain[0][0] if self.selected_chain else None
+            prefix = [(bs, blk) for bs, blk in entries if base_bs is None or bs < base_bs]
+            self.selected_chain = prefix + self.selected_chain
 
         self._resolve_virtual()
         # the load-time resolve may reposition the UTXO set; flush that
@@ -851,9 +1089,21 @@ class Consensus:
 
         i = bisect.bisect_right(self.selected_chain, (target_bs, b"\xff" * 32)) - 1
         if i < 0:
-            # selected_chain retention must reach finality_depth+1 below the
-            # tip; a miss here means pruning trimmed too close — fail loudly
-            # rather than return a wrong inactivity-shortcut anchor
+            # Target below our chain base.  If the base block is itself
+            # pre-Toccata, it is a valid anchor: the reference's backward
+            # walk stops at the first pre-Toccata ancestor and folds the
+            # shortcut to ZERO (processor.rs:890-905) — any deeper true
+            # anchor is also pre-Toccata and folds identically.  This is
+            # the bootstrap-from-a-pre-Toccata-PP case, where no anchor
+            # segment below the PP exists.
+            base = self.selected_chain[0][1]
+            base_hdr = self.storage.headers.get(base)
+            if not self.params.toccata_active(base_hdr.daa_score):
+                return base
+            # otherwise selected_chain retention must reach
+            # finality_depth+1 below the tip; a miss means pruning trimmed
+            # too close — fail loudly rather than anchor the inactivity
+            # shortcut wrongly
             raise RuleError(
                 f"selected-chain retention violated: no entry with blue_score <= {target_bs}"
             )

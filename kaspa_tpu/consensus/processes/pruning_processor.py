@@ -118,6 +118,52 @@ class PruningProcessor:
                 pass
         return keep
 
+    def _anchor_keep_set(self, new_pp: bytes) -> set[bytes]:
+        """Selected-chain blocks within the inactivity window below the new
+        pruning point, kept header-only through pruning.  KIP-21 lane-state
+        export (consensus.export_pp_lane_state) serves these headers to
+        bootstrapping peers as hash-bound shortcut anchors, and local
+        shortcut resolution reads them — the reference likewise resolves
+        below-PP shortcuts from headers it retains
+        (processor.rs:870-905 inactivity_shortcut_block_for_pov).
+        Empty on networks that never activate Toccata."""
+        from kaspa_tpu.consensus.params import NEVER_ACTIVATION
+
+        c = self.c
+        keep: set[bytes] = set()
+        if c.params.toccata_activation == NEVER_ACTIVATION:
+            return keep
+        if not c.storage.ghostdag.has(new_pp):
+            return keep
+        pp_bs = c.storage.ghostdag.get_blue_score(new_pp)
+        lo = max(pp_bs - c.params.finality_depth - 64, 0)
+        chain = []  # (blue_score, hash) ascending once reversed
+        cur = new_pp
+        while True:
+            keep.add(cur)
+            bs = (
+                c.storage.ghostdag.get_blue_score(cur)
+                if c.storage.ghostdag.has(cur)
+                else (c.storage.headers.get(cur).blue_score if c.storage.headers.has(cur) else 0)
+            )
+            chain.append((bs, cur))
+            if cur == c.params.genesis.hash or bs <= lo:
+                break
+            nxt = c._chain_parent(cur)
+            if nxt is None:
+                break
+            # record the chain linkage before ghostdag re-rooting can lose it
+            c._segment_prev.setdefault(cur, nxt)
+            cur = nxt
+        # refresh the persisted anchor segment to the current window so a
+        # restart never resurrects chain entries whose headers this prune
+        # deletes (the stale-meta hazard)
+        if c.storage.db is not None and chain:
+            from kaspa_tpu.consensus.consensus import _encode_anchor_segment
+
+            c.storage.put_meta(b"lane_anchor_segment", _encode_anchor_segment(chain[::-1]))
+        return keep
+
     def prune(self, new_pp: bytes, retention_root: bytes) -> None:
         c = self.c
         reach = c.reachability
@@ -126,7 +172,11 @@ class PruningProcessor:
         # and the pruning proof slices for the new pp (the reference keeps
         # dedicated per-level proof stores; we must stay able to serve and
         # rebuild proofs after history deletion)
-        keep_headers = self._window_keep_set(new_pp) | set(self.past_pruning_points)
+        keep_headers = (
+            self._window_keep_set(new_pp)
+            | set(self.past_pruning_points)
+            | self._anchor_keep_set(new_pp)
+        )
         for level_headers in c.pruning_proof_manager.build_proof():
             keep_headers.update(h.hash for h in level_headers)
         # the pruning-sample chain from pp to genesis: expected-pruning-point
@@ -172,6 +222,7 @@ class PruningProcessor:
             c.storage.relations.delete(h)
             c.storage.statuses.delete(h)
             c.reach_mergesets.delete(h)
+            c._segment_prev.pop(h, None)
         # prune tips that can never be merged by virtual (not in future(pp))
         pruned_tips = {t for t in c.tips if t in delete_set}
         if pruned_tips:

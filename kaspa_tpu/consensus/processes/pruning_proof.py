@@ -664,6 +664,10 @@ class PruningProofManager:
 
         c.utxo_set.replace_all(utxo_set)
         c.utxo_position = pp
+        # the selected-chain index must track the materialized position —
+        # the fresh-consensus genesis entry is not on this chain (it gets
+        # extended below the PP by the imported lane-state anchor segment)
+        c.selected_chain = [(trusted.ghostdag[pp].blue_score, pp)]
         c.multisets[pp] = ms
         # virtual parents are constrained to future(pp) (the reference's
         # pruning-point-on-virtual-chain invariant): anticone blocks stay

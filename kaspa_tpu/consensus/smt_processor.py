@@ -127,6 +127,37 @@ def collect_mergeset_seq_data(mergeset_acceptance, headers_store) -> MergesetSeq
     return MergesetSeqData(sorted(lane_activities.items()), miner_payload_leaves)
 
 
+class LaneStateError(Exception):
+    """Imported lane state fails verification against the PP header."""
+
+
+def verify_lane_state(pp_header, meta: dict, lanes: list) -> None:
+    """Verify a transferred pruning-point lane state against the PP header
+    (kaspa-seq-commit verify.rs verify_smt_metadata + the lanes-root check
+    performed by the streaming importer, flows/src/ibd/flow.rs:742-752).
+
+    ``meta``: {lanes_root, pcd, parent_seq_commit, shortcut_block,
+    inactivity_shortcut}; ``lanes``: [(lane_key32, tip32, blue_score)].
+
+    Soundness: the PP header is proof-validated, and its
+    accepted_id_merkle_root binds (parent_seq_commit, inactivity_shortcut,
+    lanes_root, pcd) jointly through the seq-commit hash chain — a peer
+    cannot shift wrongness between fields without a hash break.  The lanes
+    themselves are bound by lanes_root via the SMT rebuild below.
+    """
+    tree = SparseMerkleTree(SEQ_COMMIT_ACTIVE)
+    for lk, tip, bs in lanes:
+        tree.insert(lk, sc.smt_leaf_hash(tip, bs))
+    if tree.root() != meta["lanes_root"]:
+        raise LaneStateError("transferred lanes do not hash to the claimed lanes root")
+    activity_root = sc.activity_root_hash(meta["inactivity_shortcut"], meta["lanes_root"])
+    state_root = sc.seq_state_root(activity_root, meta["pcd"])
+    if sc.seq_commit(meta["parent_seq_commit"], state_root) != pp_header.accepted_id_merkle_root:
+        raise LaneStateError(
+            "lane-state metadata does not reproduce the pruning point's sequencing commitment"
+        )
+
+
 class ConsensusSeqCommitAccessor:
     """Live SeqCommitAccessor over consensus state (model/services/
     seq_commit_accessor.rs): what OpChainblockSeqCommit (0xd4) queries."""
@@ -239,7 +270,9 @@ class LaneTracker:
 
         ``selected_chain_index(target_bs) -> bytes`` returns the highest
         selected-chain block (ancestor-or-equal of the selected parent)
-        with blue_score <= target_bs, or the genesis hash.
+        with blue_score <= target_bs, or the genesis hash.  Shortcut
+        anchors always have headers locally: live history retains them, and
+        proof bootstrap imports the below-PP anchor-segment headers.
         """
         sp = gd.selected_parent
         parent_header = headers_store.get(sp)
@@ -375,3 +408,27 @@ class LaneTracker:
     def prune(self, block: bytes) -> None:
         """Drop the build record of a pruned chain block."""
         self.builds.delete(block)
+
+    # -- IBD import ------------------------------------------------------
+
+    def import_state(self, pp: bytes, pp_header, meta: dict, lanes: list) -> None:
+        """Install a verified pruning-point lane state (the receiving side
+        of flows/src/ibd/flow.rs sync_new_smt_state → consensus
+        import_pruning_point_smt).  Caller must have run verify_lane_state.
+        """
+        for lk, tip, bs in lanes:
+            self._set_tip(lk, (tip, bs))
+            self._stage_tip(lk, (tip, bs))
+        # the PP's build record anchors parent lookups for the first
+        # post-bootstrap chain block (parent_active, shortcut seeding) —
+        # the role of the reference's SmtBlockMetadata row for the PP
+        self.builds[pp] = SmtBuild(
+            seq_commit=pp_header.accepted_id_merkle_root,
+            lanes_root=meta["lanes_root"],
+            payload_ctx_digest=meta["pcd"],
+            active_lanes_count=len(lanes),
+            shortcut_block=meta["shortcut_block"],
+            updates={lk: (tip, bs) for lk, tip, bs in lanes},
+            expired=(),
+            undo={},
+        )

@@ -41,6 +41,9 @@ MSG_REQUEST_TRUSTED_DATA = "requestpruningpointtrusteddata"
 MSG_TRUSTED_DATA = "pruningpointtrusteddata"
 MSG_REQUEST_PP_UTXOS = "requestpruningpointutxoset"
 MSG_PP_UTXO_CHUNK = "pruningpointutxosetchunk"
+# KIP-21 lane-state sync (flows/src/ibd/flow.rs:145-150 sync_new_smt_state)
+MSG_REQUEST_PP_SMT = "requestpruningpointsmtstate"
+MSG_PP_SMT_CHUNK = "pruningpointsmtstatechunk"
 # locator sync negotiation (flows/src/ibd/negotiate.rs + sync/mod.rs)
 MSG_IBD_BLOCK_LOCATOR = "ibdblocklocator"
 MSG_REQUEST_ANTIPAST = "requestantipast"
@@ -51,6 +54,7 @@ MSG_REQUEST_ADDRESSES = "requestaddresses"
 MSG_ADDRESSES = "addresses"
 
 PP_UTXO_CHUNK_SIZE = 4096  # entries per chunk (ibd/flow.rs utxo chunking)
+PP_SMT_CHUNK_SIZE = 4096  # lanes/anchors per chunk (ibd SMT_CHUNK_SIZE role)
 
 PROTOCOL_VERSION = 7
 
@@ -363,6 +367,50 @@ class Node:
             )
         elif msg_type == MSG_PP_UTXO_CHUNK:
             self._on_pp_utxo_chunk(peer, payload)
+        elif msg_type == MSG_REQUEST_PP_SMT:
+            # the request pins the pruning point (RequestPruningPointSmtState
+            # carries pruning_point_hash in the reference, ibd/flow.rs:714):
+            # a mid-IBD local pruning advance must not switch snapshots under
+            # a receiver still paging the old state
+            req_pp = payload["pp"]
+            cached = getattr(self, "_pp_smt_snapshot", None)
+            if cached is None or cached[0] != req_pp:
+                if req_pp != self.consensus.pruning_processor.pruning_point:
+                    # neither the cached snapshot nor our live PP: cannot serve
+                    peer.send(
+                        MSG_PP_SMT_CHUNK,
+                        {"active": False, "meta": None, "offset": 0, "lanes": [], "segment": [], "done": True},
+                    )
+                    return
+                self._pp_smt_snapshot = cached = (req_pp, self.consensus.export_pp_lane_state())
+            state = cached[1]
+            if state is None:
+                peer.send(
+                    MSG_PP_SMT_CHUNK,
+                    {"active": False, "meta": None, "offset": 0, "lanes": [], "segment": [], "done": True},
+                )
+            else:
+                meta, lanes, segment = state
+                start = int(payload["offset"])
+                lane_part = lanes[start : start + PP_SMT_CHUNK_SIZE]
+                rem = PP_SMT_CHUNK_SIZE - len(lane_part)
+                seg_start = max(0, start - len(lanes))
+                seg_part = segment[seg_start : seg_start + rem] if rem > 0 else []
+                total = len(lanes) + len(segment)
+                sent = start + len(lane_part) + len(seg_part)
+                peer.send(
+                    MSG_PP_SMT_CHUNK,
+                    {
+                        "active": True,
+                        "meta": meta if start == 0 else None,
+                        "offset": start,
+                        "lanes": lane_part,
+                        "segment": seg_part,
+                        "done": sent >= total,
+                    },
+                )
+        elif msg_type == MSG_PP_SMT_CHUNK:
+            self._on_pp_smt_chunk(peer, payload)
 
     def _insert_ibd_batch(self, target: Consensus, blocks) -> None:
         """Bulk intake through the concurrent pipeline: the whole batch goes
@@ -535,6 +583,59 @@ class Node:
             self._ibd = {}
             staging.cancel()
             raise ProtocolError(f"invalid pruning proof data from peer: {e}") from e
+        # KIP-21: a post-Toccata pruning point needs its lane state before
+        # any post-PP chain block can be seq-commit-verified
+        # (flows/src/ibd/flow.rs:145-150); pre-Toccata starts empty
+        sc = staging.consensus
+        pp = sc.pruning_processor.pruning_point
+        pp_hdr = sc.storage.headers.get(pp)
+        if sc.params.toccata_active(pp_hdr.daa_score) and pp != sc.params.genesis.hash:
+            self._ibd = {
+                "peer": peer, "phase": "smt", "staging": staging, "smt_pp": pp,
+                "smt_meta": None, "smt_lanes": [], "smt_seg": [],
+            }
+            peer.send(MSG_REQUEST_PP_SMT, {"pp": pp, "offset": 0})
+            return
+        self._ibd = {"peer": peer, "phase": "blocks", "staging": staging}
+        self._send_locator(peer, staging.consensus)
+
+    def _on_pp_smt_chunk(self, peer: Peer, payload: dict) -> None:
+        from kaspa_tpu.consensus.smt_processor import LaneStateError
+
+        if self._ibd.get("peer") is not peer or self._ibd.get("phase") != "smt":
+            return
+        staging = self._ibd["staging"]
+        if not payload.get("active", True):
+            # we only request lane state for a post-Toccata PP, so a donor
+            # claiming there is none cannot seed a verifiable bootstrap
+            self._ibd = {}
+            staging.cancel()
+            raise ProtocolError("peer cannot serve lane state for a post-Toccata pruning point")
+        if payload.get("meta") is not None:
+            self._ibd["smt_meta"] = payload["meta"]
+        self._ibd["smt_lanes"].extend(payload["lanes"])
+        self._ibd["smt_seg"].extend(payload["segment"])
+        if not payload["done"]:
+            if not payload["lanes"] and not payload["segment"]:
+                self._ibd = {}
+                staging.cancel()
+                raise ProtocolError("peer sent an empty non-final SMT chunk (no progress)")
+            peer.send(
+                MSG_REQUEST_PP_SMT,
+                {
+                    "pp": self._ibd["smt_pp"],
+                    "offset": payload["offset"] + len(payload["lanes"]) + len(payload["segment"]),
+                },
+            )
+            return
+        try:
+            staging.consensus.import_pp_lane_state(
+                self._ibd["smt_meta"], self._ibd["smt_lanes"], self._ibd["smt_seg"]
+            )
+        except (LaneStateError, KeyError, TypeError) as e:
+            self._ibd = {}
+            staging.cancel()
+            raise ProtocolError(f"invalid pruning point SMT state from peer: {e}") from e
         self._ibd = {"peer": peer, "phase": "blocks", "staging": staging}
         self._send_locator(peer, staging.consensus)
 

@@ -22,7 +22,9 @@ from kaspa_tpu.p2p.node import (
     MSG_IBD_CHAIN_INFO,
     MSG_INV_BLOCK,
     MSG_INV_TXS,
+    MSG_PP_SMT_CHUNK,
     MSG_PP_UTXO_CHUNK,
+    MSG_REQUEST_PP_SMT,
     MSG_PRUNING_PROOF,
     MSG_REQUEST_BLOCK,
     MSG_REQUEST_IBD_CHAIN_INFO,
@@ -71,6 +73,8 @@ _TYPE_IDS = {
     MSG_REQUEST_ADDRESSES: 21,
     MSG_ADDRESSES: 22,
     MSG_REQUEST_ANTIPAST: 23,
+    MSG_REQUEST_PP_SMT: 24,
+    MSG_PP_SMT_CHUNK: 25,
 }
 
 _TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
@@ -257,6 +261,68 @@ def _dec_utxo_chunk(data: bytes) -> dict:
     return {"offset": offset, "pairs": pairs, "done": r.read(1) == b"\x01"}
 
 
+def _enc_smt_request(p) -> bytes:
+    """{pp: hash32, offset} — the pinned pruning point + paging offset."""
+    w = io.BytesIO()
+    w.write(p["pp"])
+    serde.write_varint(w, p["offset"])
+    return w.getvalue()
+
+
+def _dec_smt_request(data: bytes) -> dict:
+    r = io.BytesIO(data)
+    return {"pp": r.read(32), "offset": serde.read_varint(r)}
+
+
+def _enc_smt_chunk(p) -> bytes:
+    """KIP-21 lane-state chunk: metadata (first chunk only) + lane triples
+    + shortcut-anchor segment entries (flows/src/ibd/streams.rs SmtStream)."""
+    w = io.BytesIO()
+    w.write(b"\x01" if p.get("active", True) else b"\x00")
+    meta = p.get("meta")
+    if meta is None:
+        w.write(b"\x00")
+    else:
+        w.write(b"\x01")
+        w.write(meta["lanes_root"] + meta["pcd"] + meta["parent_seq_commit"])
+        w.write(meta["shortcut_block"] + meta["inactivity_shortcut"])
+    serde.write_varint(w, p["offset"])
+    serde.write_varint(w, len(p["lanes"]))
+    for lk, tip, bs in p["lanes"]:
+        w.write(lk + tip + struct.pack("<Q", bs))
+    serde.write_varint(w, len(p["segment"]))
+    for hdr in p["segment"]:
+        serde.write_bytes(w, serde.encode_header(hdr))
+    w.write(b"\x01" if p["done"] else b"\x00")
+    return w.getvalue()
+
+
+def _dec_smt_chunk(data: bytes) -> dict:
+    r = io.BytesIO(data)
+    active = r.read(1) == b"\x01"
+    meta = None
+    if r.read(1) == b"\x01":
+        lanes_root, pcd, parent = r.read(32), r.read(32), r.read(32)
+        shortcut, inactivity = r.read(32), r.read(32)
+        meta = {
+            "lanes_root": lanes_root, "pcd": pcd, "parent_seq_commit": parent,
+            "shortcut_block": shortcut, "inactivity_shortcut": inactivity,
+        }
+    offset = serde.read_varint(r)
+    lanes = []
+    for _ in range(serde.read_varint(r)):
+        lk, tip = r.read(32), r.read(32)
+        (bs,) = struct.unpack("<Q", r.read(8))
+        lanes.append((lk, tip, bs))
+    segment = [
+        serde.decode_header(serde.read_bytes(r)) for _ in range(serde.read_varint(r))
+    ]
+    return {
+        "active": active, "meta": meta, "offset": offset,
+        "lanes": lanes, "segment": segment, "done": r.read(1) == b"\x01",
+    }
+
+
 def _enc_strings(items) -> bytes:
     w = io.BytesIO()
     serde.write_varint(w, len(items))
@@ -294,6 +360,8 @@ _CODECS = {
     MSG_REQUEST_ANTIPAST: (lambda h: h, lambda d: d),  # single 32-byte hash
     MSG_REQUEST_ADDRESSES: (_enc_empty, _dec_empty),
     MSG_ADDRESSES: (_enc_strings, _dec_strings),
+    MSG_REQUEST_PP_SMT: (_enc_smt_request, _dec_smt_request),
+    MSG_PP_SMT_CHUNK: (_enc_smt_chunk, _dec_smt_chunk),
 }
 
 

@@ -1,0 +1,265 @@
+"""KIP-21 lane-state sync over proof IBD.
+
+A post-Toccata pruning point commits to an SMT over active lanes; a fresh
+node bootstrapping from a pruning proof cannot recompute that state from
+pruned history, so the donor serves it and the receiver verifies it against
+the proven PP header's sequencing commitment before installing it
+(flows/src/ibd/flow.rs:145-150 sync_new_smt_state,
+kaspa-seq-commit verify.rs verify_smt_metadata).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus import seq_commit as sc
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model.tx import (
+    SUBNETWORK_ID_NATIVE,
+    ComputeCommit,
+    Transaction,
+    TransactionInput,
+    TransactionOutput,
+)
+from kaspa_tpu.consensus.params import GenesisBlock, Params
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.p2p.node import Node, ProtocolError, connect
+from kaspa_tpu.sim.simulator import Miner
+from kaspa_tpu.txscript import standard
+
+
+def _toccata_prune_params() -> Params:
+    genesis = GenesisBlock(hash=b"\x01" + b"\x00" * 31, bits=0x207FFFFF, timestamp=0)
+    return Params.from_bps(
+        "simnet-smtibd",
+        2,
+        genesis,
+        skip_proof_of_work=True,
+        coinbase_maturity=8,
+        merge_depth=15,
+        finality_depth=30,
+        pruning_depth=60,
+        pruning_proof_m=10,
+        difficulty_window_size=15,
+        min_difficulty_window_size=5,
+        difficulty_sample_rate=2,
+        past_median_time_window_size=10,
+        past_median_time_sample_rate=2,
+        toccata_activation=0,
+    )
+
+
+def _signed_spend(consensus, miner, rng, fee=100_000):
+    view = consensus.get_virtual_utxo_view()
+    pov = consensus.get_virtual_daa_score()
+    maturity = consensus.params.coinbase_maturity
+    for outpoint, entry in sorted(
+        consensus.utxo_set.items(), key=lambda kv: (kv[0].transaction_id, kv[0].index)
+    ):
+        if view.get(outpoint) is None or entry.script_public_key != miner.spk:
+            continue
+        if entry.is_coinbase and entry.block_daa_score + maturity > pov:
+            continue
+        tx = Transaction(
+            0,
+            [TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))],
+            [TransactionOutput(entry.amount - fee, miner.spk)],
+            0,
+            SUBNETWORK_ID_NATIVE,
+            0,
+            b"",
+        )
+        reused = chash.SigHashReusedValues()
+        msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+        sig = eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32))
+        tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+        return tx
+    return None
+
+
+@pytest.fixture(scope="module")
+def toccata_donor():
+    """A toccata-active donor whose pruning point moved past genesis, with
+    periodic native-lane touches so the PP lane state is non-trivial."""
+    params = _toccata_prune_params()
+    donor = Node(Consensus(params), "donor")
+    miner = Miner(0, random.Random(31))
+    rng = random.Random(7)
+    for i in range(160):
+        txs = []
+        if i % 8 == 5:
+            tx = _signed_spend(donor.consensus, miner, rng)
+            if tx is not None:
+                txs = [tx]
+        t = donor.consensus.build_block_template(miner.miner_data, txs)
+        donor.submit_block(t)
+    assert donor.consensus.pruning_processor.pruning_point != params.genesis.hash
+    return params, donor
+
+
+def test_donor_export_roundtrips_verification(toccata_donor):
+    """The donor's exported PP lane state passes the receiver-side
+    verification against the PP header, and the PP build metadata matches."""
+    from kaspa_tpu.consensus.smt_processor import verify_lane_state
+
+    params, donor = toccata_donor
+    cons = donor.consensus
+    pp = cons.pruning_processor.pruning_point
+    state = cons.export_pp_lane_state()
+    assert state is not None
+    meta, lanes, segment = state
+    pp_header = cons.storage.headers.get(pp)
+    verify_lane_state(pp_header, meta, lanes)  # must not raise
+    build = cons.lane_tracker.builds.try_get(pp)
+    assert meta["lanes_root"] == build.lanes_root
+    # segment is a hash-bound header chain: shortcut .. pp
+    assert segment[-1].hash == pp and segment[0].hash == build.shortcut_block
+    for a, b in zip(segment, segment[1:]):
+        assert a.hash in b.direct_parents()
+    # the coinbase lane is touched by every chain block: always present
+    assert any(lk == sc.COINBASE_LANE_KEY for lk, _, _ in lanes)
+
+
+def test_proof_ibd_transfers_lane_state(toccata_donor):
+    """End-to-end: a fresh node proof-bootstraps from a post-Toccata donor,
+    its PP lane root equals the donor's recorded one, the full post-PP chain
+    re-verifies (seq commits recomputed over the imported state), and new
+    post-bootstrap tx-bearing blocks flow both ways."""
+    params, donor = toccata_donor
+    joiner = Node(Consensus(params), "joiner")
+    original = joiner.consensus
+    pj, pd = connect(joiner, donor)
+    joiner.ibd_from(pj)
+    assert joiner.consensus is not original  # staging swapped in
+
+    pp = donor.consensus.pruning_processor.pruning_point
+    assert joiner.consensus.pruning_processor.pruning_point == pp
+    jb = joiner.consensus.lane_tracker.builds.try_get(pp)
+    db = donor.consensus.lane_tracker.builds.try_get(pp)
+    assert jb is not None and jb.lanes_root == db.lanes_root
+    # materialized state converged with the donor's at the shared position
+    assert joiner.consensus.sink() == donor.consensus.sink()
+    assert joiner.consensus.lane_tracker.tree.root() == donor.consensus.lane_tracker.tree.root()
+    assert joiner.consensus.lane_tracker.lane_tips == donor.consensus.lane_tracker.lane_tips
+
+    # post-bootstrap blocks with lane touches validate on both sides
+    miner = Miner(1, random.Random(5))
+    rng = random.Random(23)
+    dminer = Miner(0, random.Random(31))
+    for i in range(6):
+        tx = _signed_spend(donor.consensus, dminer, rng)
+        t = donor.consensus.build_block_template(dminer.miner_data, [tx] if tx else [])
+        donor.submit_block(t)
+        assert joiner.consensus.sink() == donor.consensus.sink()
+    t = joiner.consensus.build_block_template(miner.miner_data, [])
+    joiner.submit_block(t)
+    assert donor.consensus.sink() == joiner.consensus.sink()
+
+
+def test_tampered_lane_state_rejected(toccata_donor):
+    """A peer serving a lane set that does not hash to the committed root is
+    detected and the staging bootstrap is cancelled."""
+    params, donor = toccata_donor
+    cons = donor.consensus
+    state = cons.export_pp_lane_state()
+    meta, lanes, segment = state
+    # tamper one lane tip
+    bad_lanes = list(lanes)
+    lk, tip, bs = bad_lanes[0]
+    bad_lanes[0] = (lk, bytes(32), bs)
+    # prime the donor's serving snapshot with the tampered state
+    pp = cons.pruning_processor.pruning_point
+    donor._pp_smt_snapshot = (pp, (meta, bad_lanes, segment))
+    try:
+        joiner = Node(Consensus(params), "joiner2")
+        pj, pd = connect(joiner, donor)
+        with pytest.raises(ProtocolError, match="SMT state"):
+            joiner.ibd_from(pj)
+    finally:
+        donor._pp_smt_snapshot = None  # restore clean serving
+
+
+def test_bootstrap_lane_state_survives_restart(toccata_donor, tmp_path):
+    """A proof-bootstrapped node restarted from disk resumes the imported
+    lane state and anchors, and keeps accepting post-Toccata chain blocks."""
+    from kaspa_tpu.storage.kv import KvStore
+
+    params, donor = toccata_donor
+    # proof IBD populates a staging consensus; persistence rides the staging
+    # DB exactly as the daemon rotates it (node/daemon.py _staging_factory)
+    path = str(tmp_path / "joiner-staging.db")
+    joiner = Node(Consensus(params), "joiner3")
+    joiner.cmgr._factory = lambda: Consensus(params, KvStore(path))
+    pj, pd = connect(joiner, donor)
+    joiner.ibd_from(pj)
+    root = joiner.consensus.lane_tracker.tree.root()
+    tips = dict(joiner.consensus.lane_tracker.lane_tips)
+    chain_base = joiner.consensus.selected_chain[0]
+    sink = joiner.consensus.sink()
+    joiner.consensus.storage.flush()
+    joiner.consensus.storage.db.close()
+    joiner.consensus.storage.db = None
+
+    db2 = KvStore(path)
+    c2 = Consensus(params, db2)
+    assert c2.sink() == sink
+    assert c2.lane_tracker.tree.root() == root
+    assert c2.lane_tracker.lane_tips == tips
+    # the below-PP anchor coverage (incl. headers) survived the restart:
+    # the rebuilt chain index reaches at least as deep as the imported
+    # segment base (ghostdag-dense test networks rebuild even deeper)
+    assert c2.selected_chain[0][0] <= chain_base[0]
+    assert chain_base in c2.selected_chain
+    assert c2.storage.headers.has(chain_base[1])
+    # still validates new donor blocks after restart
+    miner = Miner(0, random.Random(31))
+    t = donor.consensus.build_block_template(miner.miner_data, [])
+    donor.submit_block(t)
+    assert c2.validate_and_insert_block(t) == "utxo_valid"
+    db2.close()
+
+
+def test_bootstrap_from_pre_toccata_pp_crossing_activation():
+    """Bootstrap from a PRE-Toccata pruning point on a network whose
+    activation falls between the PP and the tips: no lane state is
+    transferred (there is none), and post-activation chain blocks resolve
+    their inactivity shortcut to the pre-Toccata chain base, folding to
+    ZERO exactly like the reference's backward walk
+    (processor.rs:890-905) — so the bootstrapped node stays in consensus."""
+    params = _toccata_prune_params()
+    params.toccata_activation = 130
+    donor = Node(Consensus(params), "donor-x")
+    miner = Miner(0, random.Random(31))
+    rng = random.Random(7)
+    for i in range(160):
+        txs = []
+        if i % 8 == 5:
+            tx = _signed_spend(donor.consensus, miner, rng)
+            if tx is not None:
+                txs = [tx]
+        donor.submit_block(donor.consensus.build_block_template(miner.miner_data, txs))
+    pp = donor.consensus.pruning_processor.pruning_point
+    assert pp != params.genesis.hash
+    pp_hdr = donor.consensus.storage.headers.get(pp)
+    assert not params.toccata_active(pp_hdr.daa_score)  # PP is pre-fork
+    tip_hdr = donor.consensus.storage.headers.get(donor.consensus.sink())
+    assert params.toccata_active(tip_hdr.daa_score)  # tips are post-fork
+
+    joiner = Node(Consensus(params), "joiner-x")
+    original = joiner.consensus
+    pj, pd = connect(joiner, donor)
+    joiner.ibd_from(pj)
+    assert joiner.consensus is not original
+    assert joiner.consensus.sink() == donor.consensus.sink()
+
+    # both directions keep accepting post-activation blocks
+    for _ in range(4):
+        tx = _signed_spend(donor.consensus, miner, rng)
+        donor.submit_block(donor.consensus.build_block_template(miner.miner_data, [tx] if tx else []))
+        assert joiner.consensus.sink() == donor.consensus.sink()
+    m2 = Miner(1, random.Random(5))
+    joiner.submit_block(joiner.consensus.build_block_template(m2.miner_data, []))
+    assert donor.consensus.sink() == joiner.consensus.sink()
