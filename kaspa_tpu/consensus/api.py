@@ -110,8 +110,17 @@ class ConsensusApi:
     def get_virtual_parents(self) -> set[bytes]:
         return set(self._c.virtual_state.parents)
 
+    def get_virtual_parents_ordered(self) -> list[bytes]:
+        """Virtual parents in consensus order (selected parent first) —
+        the RPC-visible ordering."""
+        return list(self._c.virtual_state.parents)
+
     def get_virtual_parents_len(self) -> int:
         return len(self._c.virtual_state.parents)
+
+    def get_virtual_utxo_view(self):
+        """Read view over the virtual UTXO set (mempool/tx-resolution)."""
+        return self._c.get_virtual_utxo_view()
 
     def get_virtual_utxos(self, from_outpoint=None, chunk_size: int = 1000):
         import heapq
@@ -324,6 +333,75 @@ class ConsensusApi:
 
     def block_exists(self, block: bytes) -> bool:
         return self._c.storage.headers.has(block)
+
+    def has_block_body(self, block: bytes) -> bool:
+        return self._c.storage.block_transactions.has(block)
+
+    def iter_block_hashes(self):
+        """All known block hashes (header store keys)."""
+        return self._c.storage.headers.keys()
+
+    def get_daa_score(self, block: bytes) -> int:
+        return self._c.storage.headers.get_daa_score(block)
+
+    def get_block_timestamp(self, block: bytes) -> int:
+        return self._c.storage.headers.get_timestamp(block)
+
+    def get_selected_parent(self, block: bytes) -> bytes:
+        return self._c.storage.ghostdag.get_selected_parent(block)
+
+    def is_dag_ancestor_of(self, low: bytes, high: bytes) -> bool:
+        return self._c.reachability.is_dag_ancestor_of(low, high)
+
+    def get_next_chain_ancestor(self, descendant: bytes, ancestor: bytes) -> bytes:
+        """The selected-chain child of `ancestor` on the path to `descendant`."""
+        return self._c.reachability.get_next_chain_ancestor(descendant, ancestor)
+
+    def get_current_block_color(self, block: bytes) -> bool:
+        """Blue/red of `block` from the virtual's perspective: the color
+        assigned by the lowest selected-chain block merging it
+        (consensus/mod.rs get_current_block_color)."""
+        sink = self.get_sink()
+        if block == sink or self.is_chain_ancestor_of(block, sink):
+            return True
+        if not self.is_dag_ancestor_of(block, sink):
+            raise ConsensusError("block is not in the past of the virtual sink")
+        merging = sink
+        genesis = self._c.params.genesis.hash
+        while merging != genesis:
+            sp = self.get_selected_parent(merging)
+            if not self.is_dag_ancestor_of(block, sp):
+                break
+            merging = sp
+        return block in self.get_ghostdag_data(merging).mergeset_blues
+
+    def iter_acceptance(self):
+        """(accepting chain block, accepted txids) pairs over the retained
+        acceptance column (tx-index source data)."""
+        return self._c.acceptance_data.items()
+
+    def get_accepted_transaction_ids(self, block: bytes) -> list:
+        """Accepted txids of a chain block, or [] when not a chain block /
+        outside retention (the virtual-chain RPC shape)."""
+        acc = self._c.acceptance_data.try_get(block)
+        return list(acc) if acc is not None else []
+
+    def find_output_script(self, outpoint, max_daa: int | None = None):
+        """Bounded body search for a funding output's script (the
+        reference resolves this through its tx-index; here retained bodies
+        below `max_daa` are scanned)."""
+        store = self._c.storage.block_transactions
+        for bh in list(store.keys()):
+            if (
+                max_daa
+                and self.block_exists(bh)
+                and self.get_daa_score(bh) > max_daa
+            ):
+                continue
+            for tx in store.get(bh):
+                if tx.id() == outpoint.transaction_id and outpoint.index < len(tx.outputs):
+                    return tx.outputs[outpoint.index].script_public_key
+        return None
 
     # -- misc (api/mod.rs:509-529) ----------------------------------------
 

@@ -504,6 +504,9 @@ class BlockTransactionsStore:
     def has(self, block: bytes) -> bool:
         return block in self._access
 
+    def keys(self):
+        return self._access.keys()
+
     def __len__(self) -> int:
         return len(self._access)
 

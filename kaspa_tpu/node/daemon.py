@@ -642,11 +642,14 @@ class Daemon:
         spk = pay_to_address_script(Address.from_string(pay))
         miner_data = MinerData(spk, b"")
 
+        from kaspa_tpu.consensus.api import ConsensusApi
+
         def template_source():
             with self._dispatch_lock:
                 # same sync gate as the RPC path (rule_engine.rs should_mine):
-                # stratum miners must not burn hashrate on a stale tip
-                sink_ts = self.consensus.storage.headers.get_timestamp(self.consensus.sink())
+                # stratum miners must not burn hashrate on a stale tip.
+                # self.consensus re-resolves per call: staging swaps rebind it
+                sink_ts = ConsensusApi(self.consensus).get_sink_timestamp()
                 if not self.rule_engine.should_mine(sink_ts):
                     raise ValueError("node is not synced: block templates unavailable")
                 return self.mining.get_block_template(miner_data)

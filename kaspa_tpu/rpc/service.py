@@ -55,8 +55,8 @@ class RpcCoreService:
     ):
         self.consensus = consensus
         # the formal consensus boundary (consensus/core/src/api/mod.rs):
-        # primary reads route through the facade; remaining direct
-        # consensus.storage accesses are being migrated method by method
+        # all consensus reads route through the facade, so staging swaps
+        # can never race readers against internal stores
         from kaspa_tpu.consensus.api import ConsensusApi
 
         self.api = ConsensusApi(consensus)
@@ -86,17 +86,16 @@ class RpcCoreService:
         )
 
     def get_block_dag_info(self) -> dict:
-        vs = self.consensus.virtual_state
         return {
             "network": self.consensus.params.name,
             "block_count": self.api.get_block_count(),
             "tip_hashes": [h.hex() for h in self.api.get_tips()],
-            "virtual_parent_hashes": [h.hex() for h in vs.parents],
-            "difficulty_bits": vs.bits,
-            "past_median_time": vs.past_median_time,
-            "virtual_daa_score": vs.daa_score,
+            "virtual_parent_hashes": [h.hex() for h in self.api.get_virtual_parents_ordered()],
+            "difficulty_bits": self.api.get_virtual_bits(),
+            "past_median_time": self.api.get_virtual_past_median_time(),
+            "virtual_daa_score": self.api.get_virtual_daa_score(),
             "sink": self.api.get_sink().hex(),
-            "pruning_point": self.consensus.params.genesis.hash.hex(),
+            "pruning_point": self.api.pruning_point().hex(),
         }
 
     def get_sink(self) -> bytes:
@@ -118,7 +117,7 @@ class RpcCoreService:
         return {
             "added_chain_blocks": [h.hex() for h in chain],
             "accepted_transaction_ids": {
-                h.hex(): [t.hex() for t in self.consensus.acceptance_data.get(h, [])] for h in chain
+                h.hex(): [t.hex() for t in self.api.get_accepted_transaction_ids(h)] for h in chain
             },
         }
 
@@ -149,17 +148,17 @@ class RpcCoreService:
                 "is_chain_block": self.api.is_chain_block(block_hash),
             },
         }
-        if include_transactions and self.consensus.storage.block_transactions.has(block_hash):
-            out["transactions"] = [self._tx_to_rpc(tx) for tx in self.consensus.storage.block_transactions.get(block_hash)]
+        if include_transactions and self.api.has_block_body(block_hash):
+            out["transactions"] = [self._tx_to_rpc(tx) for tx in self.api.get_block_transactions(block_hash)]
         return out
 
     def get_blocks(self, low_hash: bytes | None = None, include_transactions: bool = False) -> list[dict]:
         """Blocks in the future of `low_hash` (inclusive), or all blocks."""
-        hashes = list(self.consensus.storage.headers.keys())
+        hashes = list(self.api.iter_block_hashes())
         if low_hash is not None:
             if not self.api.block_exists(low_hash):
                 raise RpcError(f"block {low_hash.hex()} not found")
-            hashes = [h for h in hashes if self.consensus.reachability.is_dag_ancestor_of(low_hash, h)]
+            hashes = [h for h in hashes if self.api.is_dag_ancestor_of(low_hash, h)]
         return [self.get_block(h, include_transactions) for h in hashes]
 
     def submit_block(self, block: Block) -> str:
@@ -167,7 +166,7 @@ class RpcCoreService:
             if self.p2p_node is not None:
                 # the node path runs the concurrent pipeline + orphan/relay
                 return self.p2p_node.submit_block(block)
-            status = self.consensus.validate_and_insert_block(block)
+            status = self.api.validate_and_insert_block(block)
         except RuleError as e:
             raise RpcError(f"block rejected: {e}") from e
         self.mining.handle_new_block_transactions(block.transactions, self.api.get_virtual_daa_score())
@@ -181,7 +180,7 @@ class RpcCoreService:
         # sync-rate rule determined the network itself stalled
         engine = getattr(self, "rule_engine", None)
         if engine is not None:
-            sink_ts = self.consensus.storage.headers.get_timestamp(self.api.get_sink())
+            sink_ts = self.api.get_sink_timestamp()
             if not engine.should_mine(sink_ts):
                 raise RpcError("node is not synced: block templates unavailable")
         addr = Address.from_string(pay_address)
@@ -268,7 +267,7 @@ class RpcCoreService:
         return {
             "uptime_seconds": time.time() - self.start_time,
             "block_count": self.api.get_block_count(),
-            "tip_count": len(self.consensus.tips),
+            "tip_count": self.api.get_tips_len(),
             "mempool_size": len(self.mining.mempool),
             "virtual_daa_score": self.api.get_virtual_daa_score(),
             "sig_cache_hits": sc.hits,
@@ -334,70 +333,55 @@ class RpcCoreService:
             raise RpcError(f"block {start_hash.hex()} not found")
         out = []
         cur = start_hash
-        gd = self.consensus.storage.ghostdag
         if is_ascending:
             # follow the selected chain toward the sink
             sink = self.api.get_sink()
-            if not self.consensus.reachability.is_chain_ancestor_of(cur, sink):
+            if not self.api.is_chain_ancestor_of(cur, sink):
                 raise RpcError("start hash is not on the selected chain")
             while len(out) < limit:
                 out.append(self.get_block(cur, include_transactions=False)["header"] | {"hash": cur.hex()})
                 if cur == sink:
                     break
-                cur = self.consensus.reachability.get_next_chain_ancestor(sink, cur)
+                cur = self.api.get_next_chain_ancestor(sink, cur)
         else:
             genesis = self.consensus.params.genesis.hash
             while len(out) < limit:
                 out.append(self.get_block(cur, include_transactions=False)["header"] | {"hash": cur.hex()})
                 if cur == genesis:
                     break
-                cur = gd.get_selected_parent(cur)
+                cur = self.api.get_selected_parent(cur)
         return out
 
     def get_current_block_color(self, block_hash: bytes) -> dict:
-        """Blue/red of `block_hash` from the virtual's perspective: the color
-        assigned by the selected chain block that merges it (rpc.rs
-        get_current_block_color -> consensus get_current_block_color)."""
-        cons = self.consensus
-        if not cons.storage.headers.has(block_hash):
+        """Blue/red of `block_hash` from the virtual's perspective (rpc.rs
+        get_current_block_color -> ConsensusApi get_current_block_color)."""
+        from kaspa_tpu.consensus.api import ConsensusError
+
+        if not self.api.block_exists(block_hash):
             raise RpcError(f"block {block_hash.hex()} not found")
-        sink = cons.sink()
-        if block_hash == sink or cons.reachability.is_chain_ancestor_of(block_hash, sink):
-            return {"blue": True}
-        if not cons.reachability.is_dag_ancestor_of(block_hash, sink):
-            raise RpcError("block is not in the past of the virtual sink")
-        # the merging chain block is the lowest selected-chain block that is
-        # a DAG descendant of the target: descend selected parents while the
-        # parent still has the target in its past
-        merging = sink
-        genesis = cons.params.genesis.hash
-        while merging != genesis:
-            sp = cons.storage.ghostdag.get_selected_parent(merging)
-            if not cons.reachability.is_dag_ancestor_of(block_hash, sp):
-                break
-            merging = sp
-        gd = cons.storage.ghostdag.get(merging)
-        return {"blue": block_hash in gd.mergeset_blues}
+        try:
+            return {"blue": self.api.get_current_block_color(block_hash)}
+        except ConsensusError as e:
+            raise RpcError(str(e)) from e
 
     def get_daa_score_timestamp_estimate(self, daa_scores: list[int]) -> list[int]:
         """Timestamps of the selected-chain blocks nearest each DAA score."""
-        cons = self.consensus
         chain = []
-        cur = cons.sink()
-        genesis = cons.params.genesis.hash
+        cur = self.api.get_sink()
+        genesis = self.consensus.params.genesis.hash
         while True:
             chain.append(cur)
             if cur == genesis:
                 break
-            cur = cons.storage.ghostdag.get_selected_parent(cur)
+            cur = self.api.get_selected_parent(cur)
         chain.reverse()
-        scores = [cons.storage.headers.get_daa_score(h) for h in chain]
+        scores = [self.api.get_daa_score(h) for h in chain]
         import bisect
 
         out = []
         for q in daa_scores:
             i = min(bisect.bisect_left(scores, q), len(chain) - 1)
-            out.append(cons.storage.headers.get_timestamp(chain[i]))
+            out.append(self.api.get_block_timestamp(chain[i]))
         return out
 
     def estimate_network_hashes_per_second(self, window_size: int = 1000, start_hash: bytes | None = None) -> int:
@@ -411,12 +395,11 @@ class RpcCoreService:
             raise RpcError(str(e)) from e
 
     def get_block_reward_info(self, block_hash: bytes | None = None) -> dict:
-        cons = self.consensus
-        h = block_hash if block_hash is not None else cons.sink()
-        if not cons.storage.headers.has(h):
+        h = block_hash if block_hash is not None else self.api.get_sink()
+        if not self.api.block_exists(h):
             raise RpcError(f"block {h.hex()} not found")
-        daa = cons.storage.headers.get_daa_score(h)
-        subsidy = cons.coinbase_manager.calc_block_subsidy(daa)
+        daa = self.api.get_daa_score(h)
+        subsidy = self.consensus.coinbase_manager.calc_block_subsidy(daa)
         return {"block_hash": h.hex(), "daa_score": daa, "subsidy": subsidy}
 
     def resolve_finality_conflict(self, finality_block_hash: bytes) -> dict:
@@ -447,21 +430,20 @@ class RpcCoreService:
         blocks; the funding output is then resolved from bodies in the
         accepting block's past within the same bounded window (the reference
         resolves it via its tx-index; pruned or out-of-window history raises)."""
-        cons = self.consensus
         lo = accepting_block_daa_score - self._RETURN_ADDRESS_DAA_SLACK
         hi = accepting_block_daa_score + self._RETURN_ADDRESS_DAA_SLACK
         src_tx = None
-        for bh, txids in cons.acceptance_data.items():
-            daa = cons.storage.headers.get_daa_score(bh)
+        for bh, txids in self.api.iter_acceptance():
+            daa = self.api.get_daa_score(bh)
             if accepting_block_daa_score and not (lo <= daa <= hi):
                 continue
             if txid not in txids:
                 continue
             # scan the merged blocks' bodies for the tx
-            for cand in [bh, *cons.storage.ghostdag.get(bh).unordered_mergeset()]:
-                if not cons.storage.block_transactions.has(cand):
+            for cand in [bh, *self.api.get_ghostdag_data(bh).unordered_mergeset()]:
+                if not self.api.has_block_body(cand):
                     continue
-                for tx in cons.storage.block_transactions.get(cand):
+                for tx in self.api.get_block_transactions(cand):
                     if tx.id() == txid:
                         src_tx = tx
                         break
@@ -480,15 +462,7 @@ class RpcCoreService:
     def _find_output_script(self, outpoint, max_daa: int):
         """Bounded body search for a funding output: only blocks below the
         acceptance window's upper DAA bound are scanned."""
-        cons = self.consensus
-        store = cons.storage.block_transactions
-        for bh in list(getattr(store, "_txs", {})):
-            if max_daa and cons.storage.headers.has(bh) and cons.storage.headers.get_daa_score(bh) > max_daa:
-                continue
-            for tx in store.get(bh):
-                if tx.id() == outpoint.transaction_id and outpoint.index < len(tx.outputs):
-                    return tx.outputs[outpoint.index].script_public_key
-        return None
+        return self.api.find_output_script(outpoint, max_daa)
 
     # --- fees ---
 
@@ -539,7 +513,7 @@ class RpcCoreService:
         }
         out = {a: {"address": a, "sending": [], "receiving": []} for a in addresses}
         pool = self.mining.mempool.pool
-        view = self.consensus.get_virtual_utxo_view()
+        view = self.api.get_virtual_utxo_view()
         for txid, e in pool.items():
             for o in e.tx.outputs:
                 a = spk_to_addr.get(o.script_public_key.script)

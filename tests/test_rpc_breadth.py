@@ -160,3 +160,58 @@ def test_consensus_api_facade(svc):
         api.get_header(b"\x99" * 32)
     with _pytest.raises(ConsensusError):
         api.get_block_acceptance_data(b"\x99" * 32)
+
+
+def test_utxo_return_address_resolves():
+    """getUtxoReturnAddress resolves the first input's funding address from
+    retained bodies (rpc.rs get_utxo_return_address; the reference uses its
+    tx-index)."""
+    from kaspa_tpu.consensus import hashing as chash
+    from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+    from kaspa_tpu.consensus.model.tx import ComputeCommit, SUBNETWORK_ID_NATIVE
+    from kaspa_tpu.crypto import eclib
+    from kaspa_tpu.txscript import standard
+
+    params = simnet_params(bps=2)
+    params.coinbase_maturity = 2
+    node = Node(Consensus(params), "ra-test")
+    service = RpcCoreService(node.consensus, node.mining, address_prefix="kaspasim")
+    miner = Miner(0, random.Random(5))
+    rng = random.Random(9)
+    c = node.consensus
+    for i in range(6):
+        node.submit_block(c.build_block_template(miner.miner_data, [], timestamp=10_000 + 600 * i))
+    # spend a mature coinbase back to the miner
+    view = c.get_virtual_utxo_view()
+    pov = c.get_virtual_daa_score()
+    spend = None
+    for op, entry in sorted(c.utxo_set.items(), key=lambda kv: (kv[0].transaction_id, kv[0].index)):
+        if view.get(op) is None or entry.script_public_key != miner.spk:
+            continue
+        if entry.is_coinbase and entry.block_daa_score + params.coinbase_maturity > pov:
+            continue
+        tx = Transaction(
+            0,
+            [TransactionInput(op, b"", 0, ComputeCommit.sigops(1))],
+            [TransactionOutput(entry.amount - 1000, miner.spk)],
+            0, SUBNETWORK_ID_NATIVE, 0, b"",
+        )
+        reused = chash.SigHashReusedValues()
+        msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+        tx.inputs[0].signature_script = standard.schnorr_signature_script(
+            eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32)), chash.SIG_HASH_ALL
+        )
+        spend = tx
+        break
+    assert spend is not None
+    blk = c.build_block_with_parents([c.sink()], miner.miner_data, [spend], timestamp=20_000)
+    assert c.validate_and_insert_block(blk) == "utxo_valid"
+    # the NEXT chain block accepts the tx
+    nxt = c.build_block_with_parents([blk.hash], miner.miner_data, [], timestamp=21_000)
+    assert c.validate_and_insert_block(nxt) == "utxo_valid"
+    accepting_daa = c.get_virtual_daa_score()
+
+    addr = service.get_utxo_return_address(spend.id(), accepting_daa)
+    from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+    assert addr == extract_script_pub_key_address(miner.spk, "kaspasim").to_string()
