@@ -123,6 +123,9 @@ class Consensus:
         self.params = params
         self.storage = ConsensusStorage(db, cache_policy)
         self.reachability = ReachabilityService()
+        # reachability rides every flush batch: dirty nodes are staged so a
+        # kill -9 restart decodes the RN column instead of rebuilding
+        self.storage.pre_flush_hooks.append(self._stage_reachability_dirty)
         self.ghostdag_manager = GhostdagManager(
             params.genesis.hash,
             params.ghostdag_k,
@@ -302,14 +305,32 @@ class Consensus:
         self.depth_manager.reachability = self.reachability
         self.parents_manager.reachability = self.reachability
 
+    def _stage_reachability_dirty(self) -> None:
+        """Stage the reachability nodes mutated since the last flush into
+        the RN column (pre-flush hook: the records join the same atomic
+        batch as the block state that produced them).  This keeps the
+        persistent reachability index the source of truth — crash restarts
+        decode it instead of rebuilding, matching the reference's
+        store-backed design (processes/reachability/)."""
+        from kaspa_tpu.consensus.stores import PREFIX_REACH_NODE
+
+        r = self.reachability
+        if self.storage.db is None or (not r._dirty and not r._deleted):
+            return
+        for h in r._deleted:
+            self.storage.stage(PREFIX_REACH_NODE + h, None)
+        for h in r._dirty:
+            self.storage.stage(PREFIX_REACH_NODE + h, serde.encode_reach_node(r, h))
+        self.storage.put_meta(b"reach_reindex_root", r._reindex_root)
+        r._dirty.clear()
+        r._deleted.clear()
+
     def save_reachability_snapshot(self) -> None:
-        """Persist the full reachability state + clean marker (called on
-        orderly shutdown; restart then restores it in one decode instead of
-        the O(history) topological rebuild)."""
+        """Orderly-shutdown persistence.  With the incremental RN column the
+        crash and clean paths are identical — this just flushes any staged
+        remainder (kept for API compatibility with earlier DB layouts)."""
         if self.storage.db is None:
             return
-        self.storage.put_meta(b"reach_snapshot", serde.encode_reachability(self.reachability))
-        self.storage.put_meta(b"reach_clean", b"1")
         self.storage.flush()
 
     def _persist_tips(self) -> None:
@@ -526,21 +547,48 @@ class Consensus:
 
         engine = self.storage.db.engine
         g = self.params.genesis.hash
-        snapshot = self.storage.get_meta(b"reach_snapshot")
         restored = False
-        if snapshot is not None and self.storage.get_meta(b"reach_clean") == b"1":
-            # clean-shutdown fast path: restore the exact reachability state
-            # in one linear decode, then invalidate the marker so a crash
-            # before the next clean stop falls back to the full rebuild
-            try:
-                serde.decode_reachability(snapshot, self.reachability)
+        # primary path: the incrementally-persisted RN column — written at
+        # every flush, so crash and clean restarts are both O(decode)
+        from kaspa_tpu.consensus.stores import PREFIX_REACH_NODE
+
+        try:
+            n_nodes = 0
+            for key, raw in engine.items_prefix(PREFIX_REACH_NODE):
+                serde.decode_reach_node(self.reachability, key, raw)
+                n_nodes += 1
+            if n_nodes:
+                root = self.storage.get_meta(b"reach_reindex_root")
+                if root is not None:
+                    self.reachability._reindex_root = root
+                # the column IS the persisted state: nothing is dirty
+                self.reachability._dirty.clear()
                 restored = True
-            except Exception:  # noqa: BLE001 - corrupt/skewed snapshot
-                # self-heal: a bad snapshot must never brick startup —
-                # reset and take the rebuild path below
-                self.reachability = ReachabilityService()
-                self._rebind_reachability()
-            self.storage.put_meta(b"reach_clean", b"0")
+        except Exception:  # noqa: BLE001 - corrupt column must not brick startup
+            self.reachability = ReachabilityService()
+            self._rebind_reachability()
+            # purge the corrupt column so the rebuild's rewrite converges
+            # (stale orphan records would otherwise throw on every restart)
+            for key in list(engine.keys_prefix(PREFIX_REACH_NODE)):
+                self.storage.stage(PREFIX_REACH_NODE + key, None)
+            restored = False
+        if not restored:
+            # legacy clean-shutdown blob (pre-RN-column DBs)
+            snapshot = self.storage.get_meta(b"reach_snapshot")
+            if snapshot is not None and self.storage.get_meta(b"reach_clean") == b"1":
+                try:
+                    serde.decode_reachability(snapshot, self.reachability)
+                    # migrate: everything is dirty so the next flush writes
+                    # the whole RN column; drop the legacy blob
+                    self.reachability._dirty = set(self.reachability._interval.keys())
+                    restored = True
+                except Exception:  # noqa: BLE001 - corrupt/skewed snapshot
+                    self.reachability = ReachabilityService()
+                    self._rebind_reachability()
+                from kaspa_tpu.consensus.stores import PREFIX_META
+
+                self.storage.stage(PREFIX_META + b"reach_snapshot", None)
+                self.storage.put_meta(b"reach_clean", b"0")
         if not restored:
             # transient (blue_work, hash, selected_parent) triples: one
             # ghostdag decode per block — the walk needs only selected_parent

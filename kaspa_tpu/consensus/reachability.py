@@ -106,6 +106,18 @@ class ReachabilityService:
         # of any remaining block is recomputable (relations.rs:53-78)
         self._dag_parents: dict[bytes, list[bytes]] = {ORIGIN: []}
         self._dag_children: dict[bytes, list[bytes]] = {ORIGIN: []}
+        # incremental persistence (the reference's store-backed model:
+        # reachability stores are the source of truth and are never rebuilt
+        # — processes/reachability/): every mutation marks the touched
+        # nodes; the consensus flush stages exactly those records, so a
+        # kill -9 restart decodes the column instead of rebuilding
+        self._dirty: set[bytes] = {ORIGIN}
+        self._deleted: set[bytes] = set()
+
+    def _mark(self, *blocks: bytes) -> None:
+        for b in blocks:
+            self._dirty.add(b)
+            self._deleted.discard(b)
 
     # ------------------------------------------------------------------
     # queries (inquirer.rs)
@@ -183,6 +195,7 @@ class ReachabilityService:
         self._dag_children[block] = []
         for p in parents:
             self._dag_children.setdefault(p, []).append(block)
+        self._mark(block, *parents)
 
     def _add_tree_block(self, new: bytes, parent: bytes) -> None:
         remaining = self._remaining_after(parent)
@@ -191,6 +204,7 @@ class ReachabilityService:
         self._children[new] = []
         self._fcs[new] = []
         self._height[new] = self._height[parent] + 1
+        self._mark(new, parent)
         if _I.size(remaining) <= 0:
             # the empty interval at the exact end of capacity: reindex relies
             # on this position
@@ -203,6 +217,7 @@ class ReachabilityService:
         found, i = self._bsearch(self._fcs[merged], new)
         assert not found, "FCS inconsistency: chain relation within mergeset"
         self._fcs[merged].insert(i, new)
+        self._mark(merged)
 
     def _children_capacity(self, block: bytes):
         iv = self._interval[block]
@@ -258,6 +273,7 @@ class ReachabilityService:
                 ivs = _I.split_exponential(self._children_capacity(current), [sizes[c] for c in children])
                 for c, iv in zip(children, ivs):
                     self._interval[c] = iv
+                    self._mark(c)
                 queue.extend(children)
 
     def _reindex_intervals(self, new_child: bytes) -> None:
@@ -307,9 +323,11 @@ class ReachabilityService:
             for sib in siblings:
                 if sib == allocation_block:
                     self._interval[sib] = grow_alloc(self._interval[sib], offset)
+                    self._mark(sib)
                     self._propagate_interval(sib, sizes)
                     break
                 self._interval[sib] = shift_sibling(self._interval[sib], offset)
+                self._mark(sib)
                 self._propagate_interval(sib, sizes)
 
         slack_sum = 0
@@ -322,6 +340,7 @@ class ReachabilityService:
                 # the whole traversed chain
                 offset = required + slack * path_len - slack_sum
                 self._interval[current] = shrink_chain(self._interval[current], offset)
+                self._mark(current)
                 self._propagate_interval(current, sizes)
                 offset_siblings(current, offset)
                 path_slack_alloc = slack
@@ -331,6 +350,7 @@ class ReachabilityService:
             if slack_sum >= required:
                 offset = avail - (slack_sum - required)
                 self._interval[current] = shrink_chain(self._interval[current], offset)
+                self._mark(current)
                 offset_siblings(current, offset)
                 break
             current = self.get_next_chain_ancestor(self._reindex_root, current)
@@ -344,6 +364,7 @@ class ReachabilityService:
             avail = _I.size(remaining_fn(current))
             offset = avail - path_slack_alloc
             self._interval[current] = shrink_chain(self._interval[current], offset)
+            self._mark(current)
             offset_siblings(current, offset)
 
     # ------------------------------------------------------------------
@@ -401,6 +422,7 @@ class ReachabilityService:
             tight = (piv[0] + slack, piv[0] + slack + sum_before - 1)
             for c, iv in zip(before, _I.split_exact(tight, csizes)):
                 self._interval[c] = iv
+                self._mark(c)
                 self._propagate_interval(c, sizes)
 
         sum_after = 0
@@ -412,6 +434,7 @@ class ReachabilityService:
             tight = (piv[1] - slack - sum_after, piv[1] - slack - 1)
             for c, iv in zip(after, _I.split_exact(tight, csizes)):
                 self._interval[c] = iv
+                self._mark(c)
                 self._propagate_interval(c, sizes)
 
         allocation = (piv[0] + sum_before + slack, piv[1] - sum_after - slack - 1)
@@ -421,6 +444,7 @@ class ReachabilityService:
             self._interval[child] = (allocation[0] + slack, allocation[1] - slack)
             self._propagate_interval(child, sizes)
         self._interval[child] = allocation
+        self._mark(child)
 
     # ------------------------------------------------------------------
     # deletion (inquirer.rs delete_block) — the pruning executor's hook
@@ -469,19 +493,24 @@ class ReachabilityService:
             ]
             newp = [p for p in self._dag_parents[child] if p != block] + needed
             self._dag_parents[child] = newp
+            self._mark(child)
             for gp in needed:
                 self._dag_children.setdefault(gp, []).append(child)
+                self._mark(gp)
         for p in dag_parents:
             ch = self._dag_children.get(p)
             if ch and block in ch:
                 ch.remove(block)
+                self._mark(p)
 
         # tree splice
         siblings = self._children[parent]
         idx = siblings.index(block)
         siblings[idx : idx + 1] = children
+        self._mark(parent)
         for c in children:
             self._parent[c] = parent
+            self._mark(c)
 
         # FCS surgery: replace `block` with its tree children
         for merged in mergeset:
@@ -489,11 +518,13 @@ class ReachabilityService:
             found, i = self._bsearch(fcs, block)
             assert found and fcs[i] == block, "FCS inconsistency during delete"
             fcs[i : i + 1] = children
+            self._mark(merged)
 
         if not children:
             if idx > 0:
                 sib = siblings[idx - 1]
                 self._interval[sib] = (self._interval[sib][0], interval[1])
+                self._mark(sib)
         elif len(children) == 1:
             self._interval[children[0]] = interval
         else:
@@ -505,6 +536,8 @@ class ReachabilityService:
             self._reindex_root = parent
         del self._interval[block], self._parent[block], self._children[block], self._fcs[block], self._height[block]
         del self._dag_parents[block], self._dag_children[block]
+        self._dirty.discard(block)
+        self._deleted.add(block)
 
     def validate_intervals(self, root: bytes = ORIGIN) -> None:
         """Debug invariant check (reachability/tests/mod.rs

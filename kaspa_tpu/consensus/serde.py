@@ -418,31 +418,42 @@ def decode_muhash(data: bytes):
 # --- reachability snapshot (clean-shutdown fast-restart path) -------------
 
 
-def encode_reachability(reach) -> bytes:
-    """Full ReachabilityService state: intervals, tree parents/children,
-    future covering sets, heights, DAG relations, reindex root.  Written as
-    one blob on clean shutdown; a dirty marker invalidates it so crash
-    restarts fall back to the topological rebuild."""
+def encode_reach_node(reach, h: bytes) -> bytes:
+    """One reachability node's persistent record (interval, tree links, FCS,
+    height, DAG relations) — the per-flush incremental unit; the column of
+    these records is the crash-safe source of truth (the reference's
+    always-persistent reachability stores)."""
     w = io.BytesIO()
-    nodes = list(reach._interval.keys())
-    write_varint(w, len(nodes))
-    for h in nodes:
-        write_hash(w, h)
-        lo, hi = reach._interval[h]
-        write_varint(w, lo)
-        write_varint(w, hi)
-        write_option(w, reach._parent.get(h), write_hash)
-        w.write(encode_hash_list(reach._children.get(h, [])))
-        w.write(encode_hash_list(reach._fcs.get(h, [])))
-        write_varint(w, reach._height.get(h, 0))
-        w.write(encode_hash_list(reach._dag_parents.get(h, [])))
-        w.write(encode_hash_list(reach._dag_children.get(h, [])))
-    write_hash(w, reach._reindex_root)
+    lo, hi = reach._interval[h]
+    write_varint(w, lo)
+    write_varint(w, hi)
+    write_option(w, reach._parent.get(h), write_hash)
+    w.write(encode_hash_list(reach._children.get(h, [])))
+    w.write(encode_hash_list(reach._fcs.get(h, [])))
+    write_varint(w, reach._height.get(h, 0))
+    w.write(encode_hash_list(reach._dag_parents.get(h, [])))
+    w.write(encode_hash_list(reach._dag_children.get(h, [])))
     return w.getvalue()
 
 
+def decode_reach_node(reach, h: bytes, raw: bytes) -> None:
+    """Install one node record into a ReachabilityService being loaded."""
+    r = io.BytesIO(raw)
+    lo = read_varint(r)
+    hi = read_varint(r)
+    reach._interval[h] = (lo, hi)
+    has_parent = _read_exact(r, 1) == b"\x01"
+    reach._parent[h] = read_hash(r) if has_parent else None
+    reach._children[h] = read_hash_list(r)
+    reach._fcs[h] = read_hash_list(r)
+    reach._height[h] = read_varint(r)
+    reach._dag_parents[h] = read_hash_list(r)
+    reach._dag_children[h] = read_hash_list(r)
+
+
 def decode_reachability(raw: bytes, reach) -> None:
-    """Restore a ReachabilityService in place from encode_reachability."""
+    """Restore a ReachabilityService from a legacy full-state snapshot blob
+    (pre-RN-column DBs only; the matching encoder was retired with it)."""
     r = io.BytesIO(raw)
     n = read_varint(r)
     reach._interval = {}

@@ -43,6 +43,7 @@ PREFIX_PRUNING_SAMPLES = b"PS"
 PREFIX_REACH_MERGESET = b"RM"
 PREFIX_BLOCK_LEVELS = b"LV"
 PREFIX_META = b"MT"
+PREFIX_REACH_NODE = b"RN"  # per-node reachability records (crash-safe restart)
 
 
 @dataclass
@@ -595,6 +596,9 @@ class ConsensusStorage:
         self.policy = policy or CachePolicy()
         self.pending: list[tuple[bytes, bytes | None]] = []
         self._registered: list[CachedDbAccess] = []
+        # callbacks run at the head of every flush so owners of derived
+        # state (e.g. reachability dirty-node staging) join the same batch
+        self.pre_flush_hooks: list = []
         self.headers = HeaderStore(self)
         self.relations = RelationsStore(self)
         self.ghostdag = GhostdagStore(self)
@@ -654,7 +658,11 @@ class ConsensusStorage:
         return self.db.engine.get(PREFIX_META + name)
 
     def flush(self) -> None:
-        if self.db is None or not self.pending:
+        if self.db is None:
+            return
+        for hook in self.pre_flush_hooks:
+            hook()
+        if not self.pending:
             return
         with self.db.batch() as b:
             for key, value in self.pending:
