@@ -56,7 +56,26 @@ MSG_ADDRESSES = "addresses"
 PP_UTXO_CHUNK_SIZE = 4096  # entries per chunk (ibd/flow.rs utxo chunking)
 PP_SMT_CHUNK_SIZE = 4096  # lanes/anchors per chunk (ibd SMT_CHUNK_SIZE role)
 
-PROTOCOL_VERSION = 7
+# v8 body-only sync (flows/src/v8/request_block_bodies.rs): bodies for
+# blocks whose headers the requester already holds
+MSG_REQUEST_BLOCK_BODIES = "requestblockbodies"
+MSG_BLOCK_BODIES = "blockbodies"
+
+# Protocol-version tiers (flows/src/{v7,v8,v10}/mod.rs + flow_context.rs:63):
+# v7 = base flow set, v8/v9 = + block-body requests (body-only IBD),
+# v10 = + pruning-point SMT state (Toccata).  The handshake negotiates
+# min(local, peer) and flows outside the negotiated tier are refused.
+PROTOCOL_VERSION = 10
+MIN_PROTOCOL_VERSION = 7
+_MSG_MIN_VERSION = {
+    MSG_REQUEST_BLOCK_BODIES: 8,
+    MSG_BLOCK_BODIES: 8,
+    MSG_REQUEST_PP_SMT: 10,
+    MSG_PP_SMT_CHUNK: 10,
+}
+# one day before Toccata activation upgraded nodes stop accepting outdated
+# peers (flow_context.rs:827-838)
+_ACTIVATION_GATE_SECONDS = 24 * 60 * 60
 
 
 class ProtocolError(Exception):
@@ -70,6 +89,10 @@ class Peer:
     node: "Node"
     remote: "Peer | None" = None
     handshaken: bool = False
+    # negotiated protocol tier: min(our version, peer's advertised
+    # version); floored until the handshake so pre-handshake messages
+    # from later tiers are refused, not served
+    protocol_version: int = MIN_PROTOCOL_VERSION
     inbox: deque = field(default_factory=deque)
     known_blocks: set = field(default_factory=set)
     known_txs: set = field(default_factory=set)
@@ -100,6 +123,8 @@ class Node:
         # per-node identity nonce (the reference's version message peer id):
         # a version carrying OUR id is a self-connection and is dropped
         self.id = secrets.randbits(64)
+        # advertised protocol tier; tests cap this to simulate old peers
+        self.protocol_version = PROTOCOL_VERSION
         self.cmgr.on_swap(self._on_consensus_swap)
         self.peers: list = []  # the Hub (p2p/src/core/hub.rs)
         self.orphan_blocks: dict[bytes, Block] = {}  # flowcontext/orphans.rs
@@ -177,10 +202,33 @@ class Node:
             peer._draining = False
 
     def _handle(self, peer: Peer, msg_type: str, payload) -> None:
+        # tier gate: flows introduced in a later protocol version than the
+        # negotiated one are refused (the reference simply never registers
+        # them for the old tier, flow_context.rs:837-852)
+        min_v = _MSG_MIN_VERSION.get(msg_type)
+        if min_v is not None and peer.protocol_version < min_v:
+            raise ProtocolError(
+                f"message {msg_type} requires protocol v{min_v} but v{peer.protocol_version} was negotiated"
+            )
         if msg_type == MSG_VERSION:
             # handshake.rs: version negotiation incl. network match
             if isinstance(payload, dict) and payload.get("network", self.consensus.params.name) != self.consensus.params.name:
                 raise ProtocolError(f"network mismatch: {payload.get('network')}")
+            peer_pv = payload.get("protocol_version", MIN_PROTOCOL_VERSION) if isinstance(payload, dict) else MIN_PROTOCOL_VERSION
+            if peer_pv < MIN_PROTOCOL_VERSION:
+                raise ProtocolError(f"protocol version mismatch: ours {self.protocol_version}, peer {peer_pv}")
+            # one day before Toccata activation, refuse pre-Toccata tiers:
+            # a v<10 peer cannot serve/receive lane state and would fork
+            # (flow_context.rs:827-841)
+            params = self.consensus.params
+            gate_daa = self.consensus.get_virtual_daa_score() + _ACTIVATION_GATE_SECONDS * max(
+                1, round(1000 / params.target_time_per_block)
+            )
+            if params.toccata_active(gate_daa) and peer_pv < 10:
+                raise ProtocolError(
+                    f"protocol v10 required near Toccata activation (peer advertises v{peer_pv})"
+                )
+            peer.protocol_version = min(self.protocol_version, peer_pv)
             if isinstance(payload, dict) and payload.get("id") and payload["id"] == self.id:
                 # gossip taught us our own address and we dialed ourselves;
                 # scrub the LISTEN address (what gossip stored), not the
@@ -216,13 +264,13 @@ class Node:
                 peer.send(
                     MSG_VERSION,
                     {
-                        "protocol_version": PROTOCOL_VERSION,
+                        "protocol_version": self.protocol_version,
                         "network": self.consensus.params.name,
                         "listen_port": self.listen_port,
                         "id": self.id,
                     },
                 )
-            peer.send(MSG_VERACK, PROTOCOL_VERSION)
+            peer.send(MSG_VERACK, self.protocol_version)
         elif msg_type == MSG_VERACK:
             peer.handshaken = True
             if self.address_manager is not None:
@@ -336,6 +384,17 @@ class Node:
             peer.send(MSG_PRUNING_PROOF, self.consensus.pruning_proof_manager.build_proof())
         elif msg_type == MSG_PRUNING_PROOF:
             if self._ibd.get("peer") is peer and self._ibd.get("phase") == "proof":
+                # early tier gate: the proof's claimed PP header reveals a
+                # post-Toccata bootstrap before the (much larger) trusted
+                # data + UTXO set are transferred; the authoritative check
+                # after proof validation remains in _on_pp_utxo_chunk
+                if payload and payload[0] and peer.protocol_version < 10:
+                    claimed_pp = payload[0][-1]
+                    if self.consensus.params.toccata_active(claimed_pp.daa_score):
+                        self._ibd = {}
+                        raise ProtocolError(
+                            "peer protocol tier too old for a post-Toccata bootstrap (needs v10)"
+                        )
                 self._ibd["proof"] = payload
                 self._ibd["phase"] = "trusted"
                 peer.send(MSG_REQUEST_TRUSTED_DATA, {})
@@ -411,6 +470,28 @@ class Node:
                 )
         elif msg_type == MSG_PP_SMT_CHUNK:
             self._on_pp_smt_chunk(peer, payload)
+        elif msg_type == MSG_REQUEST_BLOCK_BODIES:
+            # v8 body-only serving (request_block_bodies.rs): bodies for
+            # blocks the requester holds headers for
+            out = []
+            # bounded like the chunked IBD path: a peer cannot make the
+            # server materialize its whole body store in one frame
+            for h in payload[:IBD_BATCH_SIZE]:
+                if self.consensus.storage.block_transactions.has(h):
+                    out.append((h, self.consensus.storage.block_transactions.get(h)))
+            peer.send(MSG_BLOCK_BODIES, out)
+        elif msg_type == MSG_BLOCK_BODIES:
+            # attach received bodies to header-only blocks and run them
+            # through the normal intake pipeline
+            blocks = []
+            for h, txs in payload:
+                if not self.consensus.storage.headers.has(h):
+                    continue
+                if self.consensus.storage.block_transactions.has(h):
+                    continue  # already have the body
+                blocks.append(Block(self.consensus.storage.headers.get(h), list(txs)))
+            if blocks:
+                self._insert_ibd_batch(self.consensus, blocks)
 
     def _insert_ibd_batch(self, target: Consensus, blocks) -> None:
         """Bulk intake through the concurrent pipeline: the whole batch goes
@@ -552,6 +633,13 @@ class Node:
         self._ibd = {"peer": peer, "phase": "proof"}
         peer.send(MSG_REQUEST_PRUNING_PROOF, {})
 
+    def request_bodies(self, peer: Peer, hashes: list[bytes]) -> None:
+        """v8 body-only fetch for blocks we hold headers for
+        (request_block_bodies.rs client side; requires tier >= 8)."""
+        if peer.protocol_version < 8:
+            raise ProtocolError("peer protocol tier does not support body requests (needs v8)")
+        peer.send(MSG_REQUEST_BLOCK_BODIES, hashes)
+
     def _on_pp_utxo_chunk(self, peer: Peer, payload: dict) -> None:
         from kaspa_tpu.consensus.processes.pruning_proof import ProofError
         from kaspa_tpu.consensus.utxo import UtxoCollection
@@ -590,6 +678,14 @@ class Node:
         pp = sc.pruning_processor.pruning_point
         pp_hdr = sc.storage.headers.get(pp)
         if sc.params.toccata_active(pp_hdr.daa_score) and pp != sc.params.genesis.hash:
+            if peer.protocol_version < 10:
+                # the donor cannot speak the SMT flow: a post-Toccata
+                # bootstrap from it would start without lane state and fork
+                self._ibd = {}
+                staging.cancel()
+                raise ProtocolError(
+                    "peer protocol tier too old for a post-Toccata bootstrap (needs v10)"
+                )
             self._ibd = {
                 "peer": peer, "phase": "smt", "staging": staging, "smt_pp": pp,
                 "smt_meta": None, "smt_lanes": [], "smt_seg": [],
@@ -660,6 +756,6 @@ def connect(a: Node, b: Node) -> tuple[Peer, Peer]:
     pb.remote = pa
     a.peers.append(pa)
     b.peers.append(pb)
-    pa.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": a.consensus.params.name, "listen_port": 0, "id": a.id})
-    pb.send(MSG_VERSION, {"protocol_version": PROTOCOL_VERSION, "network": b.consensus.params.name, "listen_port": 0, "id": b.id})
+    pa.send(MSG_VERSION, {"protocol_version": a.protocol_version, "network": a.consensus.params.name, "listen_port": 0, "id": a.id})
+    pb.send(MSG_VERSION, {"protocol_version": b.protocol_version, "network": b.consensus.params.name, "listen_port": 0, "id": b.id})
     return pa, pb

@@ -27,7 +27,7 @@ import threading
 _SEND_QUEUE_LIMIT = 4096  # frames; overflow => drop the peer (slow consumer)
 
 from kaspa_tpu.p2p import wire
-from kaspa_tpu.p2p.node import MSG_VERSION, PROTOCOL_VERSION, Node, ProtocolError
+from kaspa_tpu.p2p.node import MIN_PROTOCOL_VERSION, MSG_VERSION, Node, ProtocolError
 
 
 class WirePeer:
@@ -46,6 +46,8 @@ class WirePeer:
             self.peer_address = None
         self.version_sent = outbound  # inbound reciprocates on VERSION receipt
         self.handshaken = False
+        # tier floor until the handshake negotiates (node._handle sets it)
+        self.protocol_version = MIN_PROTOCOL_VERSION
         self.known_blocks: set = set()
         self.known_txs: set = set()
         self.alive = True
@@ -187,7 +189,7 @@ def connect_outbound(node: Node, address: str, timeout: float = 10.0) -> WirePee
     peer.send(
         MSG_VERSION,
         {
-            "protocol_version": PROTOCOL_VERSION,
+            "protocol_version": node.protocol_version,
             "network": node.consensus.params.name,
             "listen_port": node.listen_port,
             "id": node.id,

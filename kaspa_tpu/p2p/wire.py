@@ -22,8 +22,10 @@ from kaspa_tpu.p2p.node import (
     MSG_IBD_CHAIN_INFO,
     MSG_INV_BLOCK,
     MSG_INV_TXS,
+    MSG_BLOCK_BODIES,
     MSG_PP_SMT_CHUNK,
     MSG_PP_UTXO_CHUNK,
+    MSG_REQUEST_BLOCK_BODIES,
     MSG_REQUEST_PP_SMT,
     MSG_PRUNING_PROOF,
     MSG_REQUEST_BLOCK,
@@ -75,6 +77,8 @@ _TYPE_IDS = {
     MSG_REQUEST_ANTIPAST: 23,
     MSG_REQUEST_PP_SMT: 24,
     MSG_PP_SMT_CHUNK: 25,
+    MSG_REQUEST_BLOCK_BODIES: 26,
+    MSG_BLOCK_BODIES: 27,
 }
 
 _TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
@@ -323,6 +327,28 @@ def _dec_smt_chunk(data: bytes) -> dict:
     }
 
 
+def _enc_bodies(items) -> bytes:
+    """[(block_hash, [tx, ...])] — v8 body-only sync payload."""
+    w = io.BytesIO()
+    serde.write_varint(w, len(items))
+    for h, txs in items:
+        w.write(h)
+        serde.write_varint(w, len(txs))
+        for tx in txs:
+            serde.write_bytes(w, serde.encode_tx(tx))
+    return w.getvalue()
+
+
+def _dec_bodies(data: bytes) -> list:
+    r = io.BytesIO(data)
+    out = []
+    for _ in range(serde.read_varint(r)):
+        h = r.read(32)
+        txs = [serde.decode_tx(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
+        out.append((h, txs))
+    return out
+
+
 def _enc_strings(items) -> bytes:
     w = io.BytesIO()
     serde.write_varint(w, len(items))
@@ -362,6 +388,8 @@ _CODECS = {
     MSG_ADDRESSES: (_enc_strings, _dec_strings),
     MSG_REQUEST_PP_SMT: (_enc_smt_request, _dec_smt_request),
     MSG_PP_SMT_CHUNK: (_enc_smt_chunk, _dec_smt_chunk),
+    MSG_REQUEST_BLOCK_BODIES: (serde.encode_hash_list, serde.decode_hash_list_bytes),
+    MSG_BLOCK_BODIES: (_enc_bodies, _dec_bodies),
 }
 
 
