@@ -172,6 +172,14 @@ class ConnectionPump:
                 continue
             if item is None:
                 return
+            if callable(item):
+                # deferred encoding: expensive serialization (e.g. Borsh
+                # full-block notifications) runs on this writer thread, not
+                # on the consensus thread that published the event
+                try:
+                    item = item()
+                except Exception:  # noqa: BLE001 - encoding failure drops the frame
+                    continue
             try:
                 self._wfile.write(item)
                 self._wfile.flush()

@@ -160,6 +160,7 @@ class _WrpcHandler(socketserver.StreamRequestHandler):
         from kaspa_tpu.node.daemon import ConnectionPump
 
         pump = ConnectionPump(daemon, self.wfile, "wrpc-writer")
+        borsh_listener_ref = [None]  # Borsh-path notifier registration
 
         def read_exactly(n):
             buf = b""
@@ -187,10 +188,43 @@ class _WrpcHandler(socketserver.StreamRequestHandler):
                     continue
                 if opcode not in (OP_TEXT, OP_BINARY):
                     continue
+                if opcode == OP_BINARY:
+                    # Borsh encoding rides binary frames; JSON rides text
+                    # (the reference serves the two encodings on separate
+                    # ports — one socket, frame-typed, here)
+                    from kaspa_tpu.rpc import borsh_codec
+
+                    resp = borsh_codec.handle_frame(
+                        daemon,
+                        payload,
+                        notification_sink=_WsBinaryAdapter(pump.outq),
+                        listener_ref=borsh_listener_ref,
+                        stop=pump.stop,
+                    )
+                    pump.send(encode_frame(OP_BINARY, resp))
+                    continue
                 line = pump.handle_request(payload, notification_sink=_WsQueueAdapter(pump.outq))
                 pump.send(encode_frame(OP_TEXT, line.rstrip(b"\n")))
         finally:
+            if borsh_listener_ref[0] is not None:
+                with daemon._dispatch_lock:
+                    daemon.rpc.unregister_listener(borsh_listener_ref[0])
             pump.close()
+
+
+class _WsBinaryAdapter:
+    """Wraps Borsh notification frames (bytes, or zero-arg thunks evaluated
+    lazily on the writer thread) into WebSocket binary frames on the shared
+    outbound queue."""
+
+    def __init__(self, outq: queue.Queue):
+        self._outq = outq
+
+    def put_nowait(self, frame) -> None:
+        if callable(frame):
+            self._outq.put_nowait(lambda _f=frame: encode_frame(OP_BINARY, _f()))
+        else:
+            self._outq.put_nowait(encode_frame(OP_BINARY, frame))
 
 
 class _WsQueueAdapter:
@@ -260,6 +294,7 @@ class WrpcClient:
         self._response_cv = threading.Condition()
         self._closed = False
         self.notifications: queue.Queue = queue.Queue()
+        self.borsh_notifications: queue.Queue = queue.Queue()
         self._next_id = 0
         self._id_lock = threading.Lock()
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="wrpc-client-reader")
@@ -297,6 +332,19 @@ class WrpcClient:
                     continue
                 if opcode not in (OP_TEXT, OP_BINARY):
                     continue
+                if opcode == OP_BINARY:
+                    # Borsh frames: notifications to their queue, responses
+                    # keyed by the frame id
+                    from kaspa_tpu.rpc import borsh_codec
+
+                    kind, msg_id, op, r = borsh_codec.decode_frame(payload)
+                    if kind == borsh_codec.KIND_NOTIFICATION:
+                        self.borsh_notifications.put((op, r.read()))
+                    else:
+                        with self._response_cv:
+                            self._responses[("borsh", msg_id)] = (kind, op, r.read())
+                            self._response_cv.notify_all()
+                    continue
                 msg = json.loads(payload)
                 if "notification" in msg:
                     n = msg["notification"]
@@ -305,7 +353,7 @@ class WrpcClient:
                     with self._response_cv:
                         self._responses[msg.get("id")] = msg
                         self._response_cv.notify_all()
-        except (OSError, ValueError, ConnectionError):
+        except (OSError, ValueError, ConnectionError, EOFError, struct.error):
             pass
         with self._response_cv:
             self._closed = True
@@ -333,6 +381,34 @@ class WrpcClient:
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["result"]
+
+    def call_borsh(self, op: int, payload: bytes = b""):
+        """One Borsh-encoded request; returns the raw response payload
+        bytes (raises on a KIND_ERROR frame)."""
+        import time as _time
+
+        from kaspa_tpu.rpc import borsh_codec
+
+        with self._id_lock:
+            self._next_id += 1
+            req_id = self._next_id
+        frame = borsh_codec.encode_frame(borsh_codec.KIND_REQUEST, op, payload, req_id)
+        self._sock.sendall(encode_frame(OP_BINARY, frame, mask=True))
+        deadline = _time.monotonic() + self._timeout
+        key = ("borsh", req_id)
+        with self._response_cv:
+            while key not in self._responses:
+                if self._closed:
+                    raise ConnectionError("connection closed")
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not self._response_cv.wait(timeout=remaining):
+                    raise TimeoutError(f"borsh call op={op} timed out")
+            kind, _op, body = self._responses.pop(key)
+        if kind == borsh_codec.KIND_ERROR:
+            import io as _io
+
+            raise RuntimeError(borsh_codec.r_string(_io.BytesIO(body)))
+        return body
 
     def subscribe(self, event: str, addresses: list[str] | None = None):
         params = {"event": event}
