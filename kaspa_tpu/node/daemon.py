@@ -179,6 +179,9 @@ class ConnectionPump:
                 try:
                     item = item()
                 except Exception:  # noqa: BLE001 - encoding failure drops the frame
+                    from kaspa_tpu.core.log import get_logger
+
+                    get_logger("rpc.pump").exception("deferred notification encoding failed")
                     continue
             try:
                 self._wfile.write(item)

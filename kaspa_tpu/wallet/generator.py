@@ -98,6 +98,7 @@ class Generator:
         self.mc = mass_calculator if mass_calculator is not None else MassCalculator()
         self.sig_op_count = sig_op_count
         self.summary = GeneratorSummary()
+        self._wallet_mc = None  # built lazily from self.mc's gram costs
 
     # --- mass/fee helpers ---
 
@@ -217,11 +218,24 @@ class Generator:
             s.final_transaction_id = pending.tx.id()
 
     def _estimate_fee(self, n_inputs: int, n_outputs: int) -> int:
-        """Cheap upfront estimate (generator settles exactly per stage):
-        serialized-size-driven compute mass dominates for standard spends."""
-        approx_size = 32 + n_inputs * 150 + n_outputs * 45
-        approx_mass = approx_size * self.mc.mass_per_tx_byte + n_inputs * self.mc.mass_per_sig_op
-        return max(int(approx_mass * self.feerate), 1)
+        """Upfront estimate priced with the wallet mass surface
+        (wallet/core/src/tx/mass.rs).  The generator still settles exact
+        masses per stage; this only steers UTXO selection."""
+        from kaspa_tpu.wallet.mass import WalletMassCalculator
+
+        wmc = self._wallet_mc
+        if wmc is None:
+            from types import SimpleNamespace
+
+            # gram costs come from the generator's consensus calculator
+            wmc = self._wallet_mc = WalletMassCalculator(SimpleNamespace(
+                mass_per_tx_byte=self.mc.mass_per_tx_byte,
+                mass_per_script_pub_key_byte=self.mc.mass_per_script_pub_key_byte,
+                mass_per_sig_op=self.mc.mass_per_sig_op,
+                storage_mass_parameter=self.mc.storage_mass_parameter,
+            ))
+        mass = wmc.estimate_standard_compute_mass(n_inputs, n_outputs, self.sig_op_count)
+        return max(int(mass * self.feerate), 1)
 
 
 def estimate(utxo_iterator, change_spk, outputs, feerate: float = 1.0, mass_calculator=None) -> GeneratorSummary:

@@ -1,0 +1,180 @@
+"""Wallet-side mass/fee estimation (wallet/core/src/tx/mass.rs).
+
+The wallet prices transactions BEFORE signing: serialized sizes are
+estimated deterministically, unsigned inputs are charged the standard
+Schnorr signature size per required signature, and the overall mass is
+max(compute, storage) exactly as consensus will compute it.  Every formula
+below is a line-for-line numeric port of the cited mass.rs items — wallets
+tune change/dust decisions against these exact numbers.
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.consensus.mass import MassCalculator as ConsensusMassCalculator
+
+HASH_SIZE = 32
+SUBNETWORK_ID_SIZE = 20
+# 1 byte OP_DATA_65 + 64-byte signature + 1 byte sighash type (mass.rs:16)
+SIGNATURE_SIZE = 66
+# sompi per 1000 grams of mass (mass.rs:21)
+MINIMUM_RELAY_TRANSACTION_FEE = 100_000
+# standardness ceiling (mass.rs:25)
+MAXIMUM_STANDARD_TRANSACTION_MASS = 100_000
+MAX_SOMPI = 29_000_000_000 * 100_000_000  # consensus/core constants
+# max standard script-public-key vector size used for standard outputs
+SCRIPT_VECTOR_SIZE = 36
+
+
+def calc_minimum_required_transaction_relay_fee(mass: int) -> int:
+    """mass.rs:29-45: scale the base fee by mass; floor at the base fee."""
+    minimum_fee = (mass * MINIMUM_RELAY_TRANSACTION_FEE) // 1000
+    if minimum_fee == 0:
+        minimum_fee = MINIMUM_RELAY_TRANSACTION_FEE
+    return min(minimum_fee, MAX_SOMPI)
+
+
+def outpoint_serialized_size() -> int:
+    """mass.rs:182-187: txid hash + u32 index."""
+    return HASH_SIZE + 4
+
+
+def transaction_input_serialized_byte_size(inp) -> int:
+    """mass.rs:173-181."""
+    return outpoint_serialized_size() + 8 + len(inp.signature_script) + 8
+
+
+def transaction_output_serialized_byte_size(out) -> int:
+    """mass.rs:190-196."""
+    return 8 + 2 + 8 + len(out.script_public_key.script)
+
+
+def transaction_standard_output_serialized_byte_size() -> int:
+    """mass.rs:198-205 (standard output priced at the max script vector)."""
+    return 8 + 2 + 8 + SCRIPT_VECTOR_SIZE
+
+
+STANDARD_OUTPUT_SIZE_PLUS_INPUT_SIZE = transaction_standard_output_serialized_byte_size() + 148
+STANDARD_OUTPUT_SIZE_PLUS_INPUT_SIZE_3X = STANDARD_OUTPUT_SIZE_PLUS_INPUT_SIZE * 3
+
+
+def blank_transaction_serialized_byte_size() -> int:
+    """mass.rs:154-171: fixed fields of an input/output-less tx."""
+    return 2 + 8 + 8 + 8 + SUBNETWORK_ID_SIZE + 8 + HASH_SIZE + 8
+
+
+def transaction_serialized_byte_size(tx) -> int:
+    """mass.rs:131-153."""
+    return (
+        blank_transaction_serialized_byte_size()
+        + sum(transaction_input_serialized_byte_size(i) for i in tx.inputs)
+        + sum(transaction_output_serialized_byte_size(o) for o in tx.outputs)
+        + len(tx.payload)
+    )
+
+
+class WalletMassCalculator:
+    """wallet/core/src/tx/mass.rs MassCalculator."""
+
+    def __init__(self, params):
+        self.mass_per_tx_byte = params.mass_per_tx_byte
+        self.mass_per_script_pub_key_byte = params.mass_per_script_pub_key_byte
+        self.mass_per_sig_op = params.mass_per_sig_op
+        self.storage_mass_parameter = params.storage_mass_parameter
+        self._consensus_mc = ConsensusMassCalculator(
+            mass_per_tx_byte=params.mass_per_tx_byte,
+            mass_per_script_pub_key_byte=params.mass_per_script_pub_key_byte,
+            mass_per_sig_op=params.mass_per_sig_op,
+            storage_mass_parameter=params.storage_mass_parameter,
+        )
+
+    # -- dust (mass.rs:227-233) ------------------------------------------
+
+    def is_dust(self, value: int) -> bool:
+        return (value * 1000) // STANDARD_OUTPUT_SIZE_PLUS_INPUT_SIZE_3X < MINIMUM_RELAY_TRANSACTION_FEE
+
+    # -- compute mass (mass.rs:236-291) ----------------------------------
+
+    def blank_transaction_compute_mass(self) -> int:
+        return blank_transaction_serialized_byte_size() * self.mass_per_tx_byte
+
+    def calc_compute_mass_for_payload(self, payload_byte_size: int) -> int:
+        # the payload byte term is hardened against the normalized transient
+        # byte factor (mass.rs:245-258)
+        normalized_transient_byte_factor = 2
+        return payload_byte_size * max(self.mass_per_tx_byte, normalized_transient_byte_factor)
+
+    def calc_compute_mass_for_output(self, out) -> int:
+        return (
+            self.mass_per_script_pub_key_byte * (2 + len(out.script_public_key.script))
+            + transaction_output_serialized_byte_size(out) * self.mass_per_tx_byte
+        )
+
+    def calc_compute_mass_for_input(self, inp, tx_version: int = 0) -> int:
+        """Per-input grams.  The reference leaves budget commits as a TODO
+        ("Add support for v1 transactions", mass.rs:272); here they are
+        charged exactly like consensus does (consensus/mass.py:162-165,
+        GRAMS_PER_COMPUTE_BUDGET_UNIT) so the wallet never under-prices a
+        v1 spend."""
+        from kaspa_tpu.consensus.mass import GRAMS_PER_COMPUTE_BUDGET_UNIT
+
+        if tx_version >= 1:
+            script_mass = GRAMS_PER_COMPUTE_BUDGET_UNIT * (inp.compute_commit.compute_budget() or 0)
+        else:
+            script_mass = (inp.compute_commit.sig_op_count() or 0) * self.mass_per_sig_op
+        return script_mass + transaction_input_serialized_byte_size(inp) * self.mass_per_tx_byte
+
+    def calc_signature_compute_mass_for_inputs(self, number_of_inputs: int, minimum_signatures: int = 1) -> int:
+        return SIGNATURE_SIZE * self.mass_per_tx_byte * max(minimum_signatures, 1) * number_of_inputs
+
+    def calc_compute_mass_for_signed_transaction(self, tx) -> int:
+        return (
+            self.blank_transaction_compute_mass()
+            + self.calc_compute_mass_for_payload(len(tx.payload))
+            + sum(self.calc_compute_mass_for_output(o) for o in tx.outputs)
+            + sum(self.calc_compute_mass_for_input(i, tx.version) for i in tx.inputs)
+        )
+
+    def estimate_standard_compute_mass(
+        self, n_inputs: int, n_outputs: int, sig_op_count: int = 1, minimum_signatures: int = 1
+    ) -> int:
+        """Pre-selection estimate for a standard shape: unsigned inputs
+        (fixed fields only) + standard-size outputs + signature mass —
+        the generator's UTXO-selection steering surface."""
+        input_size = outpoint_serialized_size() + 8 + 8  # empty script
+        size = (
+            blank_transaction_serialized_byte_size()
+            + n_inputs * input_size
+            + n_outputs * transaction_standard_output_serialized_byte_size()
+        )
+        return (
+            size * self.mass_per_tx_byte
+            + self.calc_signature_compute_mass_for_inputs(n_inputs, minimum_signatures)
+            + n_inputs * sig_op_count * self.mass_per_sig_op
+            + n_outputs * self.mass_per_script_pub_key_byte * (2 + SCRIPT_VECTOR_SIZE)
+        )
+
+    def calc_compute_mass_for_unsigned_transaction(self, tx, minimum_signatures: int = 1) -> int:
+        return self.calc_compute_mass_for_signed_transaction(tx) + self.calc_signature_compute_mass_for_inputs(
+            len(tx.inputs), minimum_signatures
+        )
+
+    # -- storage + overall (mass.rs:298-330) -----------------------------
+
+    def calc_storage_mass(self, tx, entries) -> int:
+        sm = self._consensus_mc.calc_contextual_masses(tx, entries)
+        if sm is None:
+            # the reference surfaces this as MassCalculationError
+            raise ValueError("storage mass incomputable for this transaction shape")
+        return sm
+
+    def combine_mass(self, compute_mass: int, storage_mass: int) -> int:
+        return max(compute_mass, storage_mass)
+
+    def calc_overall_mass_for_unsigned_transaction(self, tx, entries, minimum_signatures: int = 1) -> int:
+        return self.combine_mass(
+            self.calc_compute_mass_for_unsigned_transaction(tx, minimum_signatures),
+            self.calc_storage_mass(tx, entries),
+        )
+
+    def calc_minimum_transaction_fee_from_mass(self, mass: int) -> int:
+        return calc_minimum_required_transaction_relay_fee(mass)
