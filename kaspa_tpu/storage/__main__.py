@@ -39,6 +39,7 @@ PREFIX_NAMES = {
     st.PREFIX_CHILDREN: "relations-children",
     st.PREFIX_BLOCK_LEVELS: "block-levels",
     st.PREFIX_META: "metadata",
+    st.PREFIX_REACH_NODE: "reachability-nodes",
     b"SM": "smt-builds",
     b"SL": "smt-lane-tips",
 }
