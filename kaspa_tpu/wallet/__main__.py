@@ -71,11 +71,87 @@ REPL_HELP = """commands:
   address              list receive addresses
   new-address          derive the next receive address
   balance              total balance over derived addresses
+  utxos                list spendable UTXOs (outpoint, amount, maturity)
   node                 node server info
+  dag                  DAG tip state (block count, sink, pruning point)
+  estimate <to> <amount>     price a spend without sending (mass, fees)
+  fee-rates            node feerate estimator buckets
   send <to> <amount> [fee]   build, sign and submit a spend (sompi)
+  sweep [fee]          consolidate every spendable UTXO to a fresh address
   monitor <seconds>    stream live wallet events (UtxosChanged/daa)
   help                 this text
   exit | quit          leave the terminal"""
+
+
+def _spendables(acct, args):
+    """(spendable utxos, server info): the snapshot every balance-touching
+    terminal command starts from."""
+    info = rpc_call(args.rpc, "getServerInfo")
+    index = _RemoteIndex(args.rpc, args.prefix)
+    utxos = acct.spendable_utxos(
+        index, info["virtual_daa_score"], info.get("coinbase_maturity", 200)
+    )
+    return utxos, info
+
+
+def _estimate(acct, args, to: str, amount: int, out) -> None:
+    """Dry-run pricing via the wallet mass surface (cli estimate verb /
+    WalletApi estimate): never signs, never submits."""
+    from kaspa_tpu.consensus.mass import MassCalculator
+    from kaspa_tpu.crypto.addresses import Address
+    from kaspa_tpu.wallet.mass import (
+        WalletMassCalculator,
+        calc_minimum_required_transaction_relay_fee,
+    )
+
+    Address.from_string(to)  # a quote for an unparseable destination is noise
+    utxos, _info = _spendables(acct, args)
+    utxos.sort(key=lambda t: -t[1].amount)
+    # gram costs from the same calculator build_send prices with
+    wmc = WalletMassCalculator(MassCalculator())
+    # fee depends on input count which depends on fee: iterate the greedy
+    # largest-first selection (build_send's order) to a fixed point
+    fee = calc_minimum_required_transaction_relay_fee(
+        wmc.estimate_standard_compute_mass(1, 2)
+    )
+    for _ in range(4):
+        selected, acc = [], 0
+        for item in utxos:
+            selected.append(item)
+            acc += item[1].amount
+            if acc >= amount + fee:
+                break
+        if acc < amount + fee:
+            out(f"insufficient funds: spendable {acc} < {amount + fee} (incl. fee)")
+            return
+        mass = wmc.estimate_standard_compute_mass(len(selected), 2)
+        new_fee = calc_minimum_required_transaction_relay_fee(mass)
+        if new_fee == fee:
+            break
+        fee = new_fee
+    out(
+        f"inputs {len(selected)}  outputs 2  est. compute mass {mass} grams\n"
+        f"relay fee floor {fee} sompi  change {acc - amount - fee} (after floor fee)"
+    )
+
+
+def _sweep(acct, args, fee: int, out) -> None:
+    """Consolidate every spendable UTXO into one output on a fresh address
+    (cli sweep verb).  Reports what the built transaction actually
+    consumed, not a pre-selection snapshot."""
+    utxos, info = _spendables(acct, args)
+    total = sum(e.amount for _, e, _ in utxos)
+    if total <= fee:
+        out(f"nothing to sweep (spendable {total} <= fee {fee})")
+        return
+    dest = acct.derive_receive_address().address.to_string()
+    tx = acct.build_send(
+        _RemoteIndex(args.rpc, args.prefix), dest, total - fee, fee,
+        info["virtual_daa_score"], coinbase_maturity=info.get("coinbase_maturity", 200),
+    )
+    txid = rpc_call(args.rpc, "submitTransaction", {"tx": tx_to_wire(tx)}, timeout=600.0)
+    swept = sum(o.value for o in tx.outputs) + fee
+    out(f"swept {len(tx.inputs)} utxos ({swept} sompi) -> {dest}\nsubmitted {txid}")
 
 
 def repl(acct, args, stdin=None, stdout=None) -> int:
@@ -127,6 +203,35 @@ def repl(acct, args, stdin=None, stdout=None) -> int:
                 to, amount = rest[0], int(rest[1])
                 fee = int(rest[2]) if len(rest) > 2 else 2000
                 out(f"submitted {_send(acct, args.rpc, args.prefix, to, amount, fee)}")
+            elif cmd == "utxos":
+                rows, _info = _spendables(acct, args)
+                for op, entry, _d in sorted(rows, key=lambda t: -t[1].amount):
+                    kind = "coinbase" if entry.is_coinbase else "standard"
+                    out(f"{op.transaction_id.hex()}:{op.index}  {entry.amount} sompi  {kind}  daa {entry.block_daa_score}")
+                out(f"{len(rows)} spendable utxos")
+            elif cmd == "dag":
+                d = rpc_call(args.rpc, "getBlockDagInfo")
+                out(
+                    f"blocks {d['block_count']}  daa {d['virtual_daa_score']}  "
+                    f"sink {d['sink'][:16]}  pruning-point {d['pruning_point'][:16]}  "
+                    f"tips {len(d['tip_hashes'])}"
+                )
+            elif cmd == "estimate":
+                if len(rest) < 2:
+                    out("usage: estimate <to> <amount>")
+                    continue
+                _estimate(acct, args, rest[0], int(rest[1]), out)
+            elif cmd == "fee-rates":
+                est = rpc_call(args.rpc, "getFeeEstimate")
+                pb = est["priority_bucket"]
+                out(f"priority: {pb['feerate']:.2f} sompi/g (~{pb['estimated_seconds']:.1f}s)")
+                for b in est.get("normal_buckets", []):
+                    out(f"normal:   {b['feerate']:.2f} sompi/g (~{b['estimated_seconds']:.1f}s)")
+                for b in est.get("low_buckets", []):
+                    out(f"low:      {b['feerate']:.2f} sompi/g (~{b['estimated_seconds']:.1f}s)")
+            elif cmd == "sweep":
+                fee = int(rest[0]) if rest else 2000
+                _sweep(acct, args, fee, out)
             elif cmd == "monitor":
                 seconds = float(rest[0]) if rest else 10.0
                 _monitor(acct, args, seconds, out)

@@ -126,15 +126,23 @@ def test_wallet_interactive_terminal(tmp_path):
 
         miner = threading.Thread(target=mine_soon, daemon=True)
         miner.start()
-        out, _ = proc.communicate(
-            "help\naddress\nnode\nbalance\nmonitor 12\nnew-address\nbadcmd\nexit\n", timeout=120
+        script = (
+            f"help\naddress\nnode\ndag\nbalance\nutxos\nfee-rates\n"
+            f"estimate {pay} 1\nmonitor 12\nnew-address\nbadcmd\nexit\n"
         )
+        out, _ = proc.communicate(script, timeout=120)
         assert proc.returncode == 0
         assert "commands:" in out
         assert pay in out
         assert "network simnet" in out
         assert "sompi" in out
         assert "monitor done" in out and "pending=" in out
+        assert "blocks " in out and "pruning-point" in out  # dag
+        assert "spendable utxos" in out  # utxos listing
+        assert "sompi/g" in out  # fee-rates buckets
+        # estimate prints mass/fee pricing (or a clean insufficient-funds
+        # message before any coinbase matured)
+        assert ("relay fee floor" in out) or ("insufficient funds" in out)
         # the monitored coinbase arrived as a live pending event
         assert "[pending]" in out or "mature=" in out
         assert "unknown command 'badcmd'" in out
