@@ -51,6 +51,7 @@ def parse_args(argv=None) -> DaemonArgs:
         help="crash-safe consensus persistence under <appdir>/consensus.db (restart resumes)",
     )
     p.add_argument("--listen", default=None, help="host:port for the P2P wire (omit to disable inbound P2P)")
+    p.add_argument("--upnp", action="store_true", help="map the P2P listen port on the internet gateway via UPnP")
     p.add_argument("--stratum", default=None, help="host:port for the stratum bridge (omit to disable)")
     p.add_argument("--stratum-pay-address", default=None, help="address stratum block templates pay to")
     p.add_argument(
@@ -617,11 +618,54 @@ class Daemon:
             self.p2p_server.start()
             self.node.listen_port = int(self.p2p_server.address.rsplit(":", 1)[1])
             self.log.info("P2P listening on %s", self.p2p_server.address)
+            if getattr(self.args, "upnp", False):
+                self._start_upnp(self.node.listen_port)
         self.connection_manager.start()
         return []
 
+    def _start_upnp(self, listen_port: int) -> None:
+        """Map the P2P listen port on the internet gateway and keep the
+        lease alive (addressmanager configure_port_mapping + the
+        port_mapping_extender service).  Discovery runs off-thread and the
+        whole feature fails soft — no cooperative gateway, no mapping."""
+
+        def run():
+            import http.client as _http_client
+
+            from kaspa_tpu.p2p.upnp import UpnpError, configure_port_mapping
+
+            try:
+                external_ip, extender = configure_port_mapping(listen_port)
+            except (UpnpError, OSError, _http_client.HTTPException) as e:
+                self.log.info("UPnP unavailable: %s", e)
+                return
+            with self._upnp_lock:
+                if self._upnp_stopped:
+                    # the daemon shut down while discovery was in flight:
+                    # tear the fresh mapping down instead of leaking it
+                    extender.stop()
+                    return
+                self.upnp_extender = extender
+            if self.address_manager is not None:
+                from kaspa_tpu.p2p.address_manager import NetAddress
+
+                # gossiped to peers, excluded from our own outbound dials
+                self.address_manager.add_local_address(NetAddress(external_ip, listen_port))
+            self.log.info("publicly routable address %s:%d registered", external_ip, listen_port)
+
+        self._upnp_lock = threading.Lock()
+        self._upnp_stopped = False
+        threading.Thread(target=run, daemon=True, name="upnp-setup").start()
+
     def _stop_p2p_service(self) -> None:
         self.connection_manager.stop()
+        if getattr(self, "_upnp_lock", None) is not None:
+            with self._upnp_lock:
+                self._upnp_stopped = True
+                extender = getattr(self, "upnp_extender", None)
+                self.upnp_extender = None
+            if extender is not None:
+                extender.stop()
         if self.p2p_server is not None:
             self.p2p_server.stop()
             self.p2p_server = None

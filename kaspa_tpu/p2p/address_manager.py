@@ -6,8 +6,9 @@ connection-failure prioritization, 24h IP bans, weighted random iteration)
 and components/connectionmanager/src/lib.rs (outbound target maintenance,
 permanent connection requests with retry backoff).  DNS seeding is
 implemented (`dns_seed` below, resolving per-network seed hostnames into
-the store); UPnP port mapping is absent — controlled deployments reach
-nodes via --connect/add_peer or explicit port forwarding.
+the store), and UPnP port mapping lives in `upnp.py` (daemon `--upnp`):
+the mapped external address joins the store for gossip but is tracked in
+`local_addresses` so the connection manager never dials the node itself.
 """
 
 from __future__ import annotations
@@ -49,8 +50,19 @@ class AddressManager:
         self._now_ms = now_ms or (lambda: int(time.time() * 1000))
         self._store: dict[NetAddress, _Entry] = {}
         self._banned: dict[str, int] = {}  # ip -> ban timestamp ms
+        # our own publicly routable addresses: gossiped, never dialed
+        self.local_addresses: set[NetAddress] = set()
         self._lock = threading.RLock()
         self._rng = random.Random(0xADD7)
+
+    def add_local_address(self, address: NetAddress) -> None:
+        """Register one of OUR publicly routable addresses: gossiped to
+        peers like any stored address but excluded from outbound dialing
+        (the reference keeps local addresses in a separate non-dialable
+        list, addressmanager lib.rs local_net_addresses)."""
+        with self._lock:
+            self.local_addresses.add(address)
+        self.add_address(address)
 
     def add_address(self, address: NetAddress) -> None:
         with self._lock:
@@ -238,7 +250,7 @@ class ConnectionManager:
         for addr in self.amgr.iterate_prioritized_random_addresses(exclude=connected):
             if missing <= 0:
                 break
-            if self.amgr.is_banned(addr.ip):
-                continue
+            if self.amgr.is_banned(addr.ip) or addr in self.amgr.local_addresses:
+                continue  # never dial our own mapped/advertised address
             if self._dial(addr):
                 missing -= 1

@@ -1,0 +1,239 @@
+"""UPnP IGD port mapping + lease extender.
+
+The role of the reference's igd-backed mapping
+(components/addressmanager/src/lib.rs:30-34 UPNP_DEADLINE_SEC/
+UPNP_EXTEND_PERIOD/UPNP_REGISTRATION_NAME, configure_port_mapping,
+port_mapping_extender.rs Extender): discover the internet gateway over
+SSDP, learn the external IP, register a TCP mapping for the P2P listen
+port with a short lease, and re-register on a half-lease tick so the
+mapping dies soon after the node does.
+
+Pure stdlib (UDP SSDP + HTTP SOAP); every network touch has a short
+timeout and the whole feature fails soft — a node without a cooperative
+gateway just runs unmapped, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import http.client
+import re
+import socket
+import threading
+import urllib.parse
+import urllib.request
+
+from kaspa_tpu.core.log import get_logger
+
+log = get_logger("p2p.upnp")
+
+UPNP_DEADLINE_SEC = 2 * 60
+UPNP_EXTEND_PERIOD = UPNP_DEADLINE_SEC // 2
+UPNP_REGISTRATION_NAME = "kaspa-tpu"
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+_SEARCH_TARGETS = (
+    "urn:schemas-upnp-org:device:InternetGatewayDevice:1",
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+)
+_SERVICE_TYPES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+class UpnpError(Exception):
+    pass
+
+
+class Gateway:
+    """One discovered IGD control endpoint."""
+
+    def __init__(self, control_url: str, service_type: str):
+        self.control_url = control_url
+        self.service_type = service_type
+
+    def _soap(self, action: str, body_args: str, timeout: float = 5.0) -> str:
+        u = urllib.parse.urlsplit(self.control_url)
+        envelope = (
+            '<?xml version="1.0"?>'
+            '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+            's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+            f'<s:Body><u:{action} xmlns:u="{self.service_type}">{body_args}</u:{action}>'
+            "</s:Body></s:Envelope>"
+        )
+        conn = http.client.HTTPConnection(u.hostname, u.port or 80, timeout=timeout)
+        try:
+            conn.request(
+                "POST",
+                u.path or "/",
+                body=envelope.encode(),
+                headers={
+                    "Content-Type": 'text/xml; charset="utf-8"',
+                    "SOAPAction": f'"{self.service_type}#{action}"',
+                },
+            )
+            resp = conn.getresponse()
+            data = resp.read().decode("utf-8", "replace")
+            if resp.status != 200:
+                raise UpnpError(f"{action} failed: HTTP {resp.status}: {data[:200]}")
+            return data
+        finally:
+            conn.close()
+
+    def get_external_ip(self) -> str:
+        data = self._soap("GetExternalIPAddress", "")
+        m = re.search(r"<NewExternalIPAddress>([^<]+)</NewExternalIPAddress>", data)
+        if not m:
+            raise UpnpError("gateway returned no external IP")
+        return m.group(1).strip()
+
+    def add_port_mapping(
+        self,
+        external_port: int,
+        internal_ip: str,
+        internal_port: int,
+        lease_sec: int = UPNP_DEADLINE_SEC,
+        description: str = UPNP_REGISTRATION_NAME,
+        protocol: str = "TCP",
+    ) -> None:
+        self._soap(
+            "AddPortMapping",
+            "<NewRemoteHost></NewRemoteHost>"
+            f"<NewExternalPort>{external_port}</NewExternalPort>"
+            f"<NewProtocol>{protocol}</NewProtocol>"
+            f"<NewInternalPort>{internal_port}</NewInternalPort>"
+            f"<NewInternalClient>{internal_ip}</NewInternalClient>"
+            "<NewEnabled>1</NewEnabled>"
+            f"<NewPortMappingDescription>{description}</NewPortMappingDescription>"
+            f"<NewLeaseDuration>{lease_sec}</NewLeaseDuration>",
+        )
+
+    def delete_port_mapping(self, external_port: int, protocol: str = "TCP") -> None:
+        self._soap(
+            "DeletePortMapping",
+            "<NewRemoteHost></NewRemoteHost>"
+            f"<NewExternalPort>{external_port}</NewExternalPort>"
+            f"<NewProtocol>{protocol}</NewProtocol>",
+        )
+
+
+def discover_gateway(timeout: float = 3.0, ssdp_addr=SSDP_ADDR) -> Gateway:
+    """SSDP M-SEARCH for an IGD, then resolve its WAN control URL from the
+    device description document."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    location = None
+    try:
+        for target in _SEARCH_TARGETS:
+            msg = (
+                "M-SEARCH * HTTP/1.1\r\n"
+                f"HOST: {ssdp_addr[0]}:{ssdp_addr[1]}\r\n"
+                'MAN: "ssdp:discover"\r\n'
+                "MX: 2\r\n"
+                f"ST: {target}\r\n\r\n"
+            )
+            try:
+                sock.sendto(msg.encode(), ssdp_addr)
+                data, _peer = sock.recvfrom(4096)
+            except (socket.timeout, OSError):
+                continue
+            m = re.search(rb"(?im)^location:\s*(\S+)", data)
+            if m:
+                location = m.group(1).decode()
+                break
+    finally:
+        sock.close()
+    if location is None:
+        raise UpnpError("no internet gateway answered SSDP discovery")
+
+    with urllib.request.urlopen(location, timeout=timeout) as resp:
+        desc = resp.read().decode("utf-8", "replace")
+    base = urllib.parse.urlsplit(location)
+    for service_type in _SERVICE_TYPES:
+        # the serviceType and its controlURL live in the same <service> block
+        pat = (
+            r"<service>(?:(?!</service>).)*?"
+            + re.escape(service_type)
+            + r"(?:(?!</service>).)*?<controlURL>([^<]+)</controlURL>"
+        )
+        m = re.search(pat, desc, re.S)
+        if m:
+            control = m.group(1).strip()
+            if not control.startswith("http"):
+                control = f"{base.scheme}://{base.netloc}{control if control.startswith('/') else '/' + control}"
+            return Gateway(control, service_type)
+    raise UpnpError("gateway description exposes no WAN connection service")
+
+
+class PortMappingExtender:
+    """Re-registers the mapping every half-lease until stopped
+    (port_mapping_extender.rs Extender::worker)."""
+
+    def __init__(
+        self,
+        gateway: Gateway,
+        external_port: int,
+        internal_ip: str,
+        internal_port: int,
+        period_sec: float = UPNP_EXTEND_PERIOD,
+        lease_sec: int = UPNP_DEADLINE_SEC,
+    ):
+        self.gateway = gateway
+        self.external_port = external_port
+        self.internal_ip = internal_ip
+        self.internal_port = internal_port
+        self.period_sec = period_sec
+        self.lease_sec = lease_sec
+        self.extend_count = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True, name="upnp-extender")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_sec):
+            try:
+                self.gateway.add_port_mapping(
+                    self.external_port, self.internal_ip, self.internal_port, self.lease_sec
+                )
+                self.extend_count += 1
+                log.trace("extended external port mapping %d", self.external_port)
+            except Exception as e:  # noqa: BLE001 - keep extending on transient errors
+                log.warn("extend external ip mapping err: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        try:
+            self.gateway.delete_port_mapping(self.external_port)
+        except Exception:  # noqa: BLE001 - gateway may be gone on shutdown
+            pass
+
+
+def configure_port_mapping(
+    listen_port: int, timeout: float = 3.0, ssdp_addr=SSDP_ADDR
+) -> tuple[str, PortMappingExtender]:
+    """Discover the gateway, map `listen_port`, return (external_ip,
+    running extender) — the reference's configure_port_mapping.  Raises
+    UpnpError when no cooperative gateway exists (callers fail soft)."""
+    gw = discover_gateway(timeout=timeout, ssdp_addr=ssdp_addr)
+    external_ip = gw.get_external_ip()
+    # the local address the gateway should forward to: the interface that
+    # routes toward the gateway
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect((urllib.parse.urlsplit(gw.control_url).hostname, 1))
+        internal_ip = probe.getsockname()[0]
+    finally:
+        probe.close()
+    gw.add_port_mapping(listen_port, internal_ip, listen_port)
+    extender = PortMappingExtender(gw, listen_port, internal_ip, listen_port)
+    extender.start()
+    log.info(
+        "UPnP mapping established: %s:%d -> %s:%d (lease %ds)",
+        external_ip, listen_port, internal_ip, listen_port, UPNP_DEADLINE_SEC,
+    )
+    return external_ip, extender

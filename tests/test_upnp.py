@@ -1,0 +1,154 @@
+"""UPnP IGD port mapping against a fake local gateway.
+
+Stands in for the reference's igd-backed mapping
+(components/addressmanager configure_port_mapping +
+port_mapping_extender.rs): SSDP discovery, device-description parsing,
+GetExternalIPAddress, AddPortMapping with a lease, extender re-adds on the
+half-lease tick, DeletePortMapping on stop.
+"""
+
+from __future__ import annotations
+
+import http.server
+import re
+import socket
+import threading
+
+import pytest
+
+from kaspa_tpu.p2p import upnp
+
+
+DESCRIPTION_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+  <device>
+    <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+    <serviceList>
+      <service>
+        <serviceType>urn:schemas-upnp-org:service:Layer3Forwarding:1</serviceType>
+        <controlURL>/l3f</controlURL>
+      </service>
+      <service>
+        <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+        <controlURL>/ctl/WANIP</controlURL>
+      </service>
+    </serviceList>
+  </device>
+</root>"""
+
+
+class _FakeIgd(http.server.BaseHTTPRequestHandler):
+    mappings: list = []
+    deletions: list = []
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_GET(self):
+        body = DESCRIPTION_XML.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0))).decode()
+        action = self.headers.get("SOAPAction", "")
+        if "GetExternalIPAddress" in action:
+            payload = "<NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>"
+        elif "AddPortMapping" in action:
+            ext = re.search(r"<NewExternalPort>(\d+)</NewExternalPort>", body).group(1)
+            lease = re.search(r"<NewLeaseDuration>(\d+)</NewLeaseDuration>", body).group(1)
+            desc = re.search(r"<NewPortMappingDescription>([^<]*)<", body).group(1)
+            type(self).mappings.append((int(ext), int(lease), desc))
+            payload = ""
+        elif "DeletePortMapping" in action:
+            ext = re.search(r"<NewExternalPort>(\d+)</NewExternalPort>", body).group(1)
+            type(self).deletions.append(int(ext))
+            payload = ""
+        else:
+            self.send_response(500)
+            self.end_headers()
+            return
+        resp = f'<?xml version="1.0"?><s:Envelope><s:Body>{payload}</s:Body></s:Envelope>'.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+
+
+@pytest.fixture()
+def fake_gateway():
+    _FakeIgd.mappings = []
+    _FakeIgd.deletions = []
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeIgd)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    http_port = httpd.server_address[1]
+
+    # SSDP responder on a localhost UDP port (tests cannot multicast)
+    ssdp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    ssdp.bind(("127.0.0.1", 0))
+    ssdp_addr = ssdp.getsockname()
+    stop = threading.Event()
+
+    def respond():
+        ssdp.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                data, peer = ssdp.recvfrom(2048)
+            except socket.timeout:
+                continue
+            if b"M-SEARCH" in data:
+                ssdp.sendto(
+                    (
+                        "HTTP/1.1 200 OK\r\n"
+                        f"LOCATION: http://127.0.0.1:{http_port}/desc.xml\r\n"
+                        "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n\r\n"
+                    ).encode(),
+                    peer,
+                )
+
+    threading.Thread(target=respond, daemon=True).start()
+    yield ssdp_addr
+    stop.set()
+    httpd.shutdown()
+    ssdp.close()
+
+
+def test_discovery_mapping_and_extender(fake_gateway):
+    gw = upnp.discover_gateway(timeout=2.0, ssdp_addr=fake_gateway)
+    assert gw.service_type.endswith("WANIPConnection:1")
+    assert gw.get_external_ip() == "203.0.113.7"
+
+    gw.add_port_mapping(16111, "127.0.0.1", 16111)
+    assert _FakeIgd.mappings == [(16111, upnp.UPNP_DEADLINE_SEC, upnp.UPNP_REGISTRATION_NAME)]
+
+    # extender re-adds on its tick, delete runs on stop
+    ext = upnp.PortMappingExtender(gw, 16111, "127.0.0.1", 16111, period_sec=0.2)
+    ext.start()
+    import time
+
+    deadline = time.monotonic() + 5
+    while ext.extend_count < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ext.stop()
+    assert ext.extend_count >= 2
+    assert len(_FakeIgd.mappings) >= 3  # initial + at least two extensions
+    assert _FakeIgd.deletions == [16111]
+
+
+def test_configure_port_mapping_end_to_end(fake_gateway):
+    external_ip, ext = upnp.configure_port_mapping(16111, timeout=2.0, ssdp_addr=fake_gateway)
+    try:
+        assert external_ip == "203.0.113.7"
+        assert _FakeIgd.mappings and _FakeIgd.mappings[0][0] == 16111
+    finally:
+        ext.stop()
+    assert _FakeIgd.deletions == [16111]
+
+
+def test_no_gateway_fails_soft():
+    # nothing answers on this closed localhost port: discovery raises the
+    # typed error the daemon catches (fail-soft path)
+    with pytest.raises(upnp.UpnpError, match="no internet gateway"):
+        upnp.discover_gateway(timeout=0.3, ssdp_addr=("127.0.0.1", 1))
