@@ -18,6 +18,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from kaspa_tpu.consensus.consensus import Consensus, RuleError
+from kaspa_tpu.consensus.stores import StatusesStore
 from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.mempool.mempool import MempoolError
@@ -60,6 +61,12 @@ PP_SMT_CHUNK_SIZE = 4096  # lanes/anchors per chunk (ibd SMT_CHUNK_SIZE role)
 # blocks whose headers the requester already holds
 MSG_REQUEST_BLOCK_BODIES = "requestblockbodies"
 MSG_BLOCK_BODIES = "blockbodies"
+# headers-first sync (request_headers.rs RequestHeaders/BlockHeaders):
+# stream headers above a chain anchor, bodies follow via the v8 flow
+MSG_REQUEST_HEADERS = "requestheaders"
+MSG_HEADERS = "blockheaders"
+# typed pre-disconnect diagnostic (p2p.proto RejectMessage)
+MSG_REJECT = "reject"
 
 # Protocol-version tiers (flows/src/{v7,v8,v10}/mod.rs + flow_context.rs:63):
 # v7 = base flow set, v8/v9 = + block-body requests (body-only IBD),
@@ -70,6 +77,8 @@ MIN_PROTOCOL_VERSION = 7
 _MSG_MIN_VERSION = {
     MSG_REQUEST_BLOCK_BODIES: 8,
     MSG_BLOCK_BODIES: 8,
+    MSG_REQUEST_HEADERS: 8,  # headers-first rides the body-only tier
+    MSG_HEADERS: 8,
     MSG_REQUEST_PP_SMT: 10,
     MSG_PP_SMT_CHUNK: 10,
 }
@@ -470,6 +479,48 @@ class Node:
                 )
         elif msg_type == MSG_PP_SMT_CHUNK:
             self._on_pp_smt_chunk(peer, payload)
+        elif msg_type == MSG_REQUEST_HEADERS:
+            # serve one bounded chunk of headers above `low` along the
+            # antipast walk (request_headers.rs).  A known off-chain anchor
+            # is fine: antipast_hashes_between resolves it to the common
+            # chain block; only an UNKNOWN anchor falls back pruning-safe
+            low = payload
+            if not self.consensus.reachability.has(low):
+                low = self.consensus.pruning_processor.pruning_point
+            self._serve_antipast_chunk(peer, low, headers_only=True)
+        elif msg_type == MSG_HEADERS:
+            if not getattr(peer, "_headers_first", False):
+                return  # unsolicited headers stream
+            statuses = self.consensus.storage.statuses
+            bodies = self.consensus.storage.block_transactions
+            need_bodies = []
+            for h in payload["headers"]:
+                h.invalidate_cache()  # wire-decoded cache is untrusted
+                status = statuses.get(h.hash)
+                if status is None:
+                    try:
+                        self.consensus.validate_and_insert_header(h)
+                    except RuleError:
+                        continue
+                    status = statuses.get(h.hash)
+                # fetch bodies only for header-only blocks we lack — never
+                # for already-complete or known-invalid ones
+                if status == StatusesStore.STATUS_HEADER_ONLY and not bodies.has(h.hash):
+                    need_bodies.append(h.hash)
+            for i in range(0, len(need_bodies), IBD_BATCH_SIZE):
+                self.request_bodies(peer, need_bodies[i : i + IBD_BATCH_SIZE])
+            if not payload["done"]:
+                peer.send(MSG_REQUEST_HEADERS, payload["continuation"])
+            else:
+                peer._headers_first = False
+        elif msg_type == MSG_REJECT:
+            # peer-reported protocol rejection: log and let the connection
+            # wind down (p2p.proto RejectMessage semantics)
+            from kaspa_tpu.core.log import get_logger
+
+            get_logger("p2p").warn("peer rejected us: %s", payload)
+            if hasattr(peer, "close"):
+                peer.close()
         elif msg_type == MSG_REQUEST_BLOCK_BODIES:
             # v8 body-only serving (request_block_bodies.rs): bodies for
             # blocks the requester holds headers for
@@ -572,10 +623,11 @@ class Node:
                 except RuleError:
                     pass
 
-    def _serve_antipast_chunk(self, peer: Peer, low: bytes) -> None:
+    def _serve_antipast_chunk(self, peer: Peer, low: bytes, headers_only: bool = False) -> None:
         """One bounded IBD batch above ``low`` plus the continuation point
         (flow.rs streams IBD_BATCH_SIZE chunks; the syncer requests the
-        next batch from ``continuation``)."""
+        next batch from ``continuation``).  ``headers_only`` serves the v8
+        headers-first stream over the same walk/batching discipline."""
         from kaspa_tpu.consensus.processes.sync import SyncManager
 
         sm = SyncManager(self.consensus)
@@ -583,8 +635,12 @@ class Node:
         hashes, highest = sm.antipast_hashes_between(low, sink, max_blocks=IBD_BATCH_SIZE)
         bts = self.consensus.storage.block_transactions
         hdrs = self.consensus.storage.headers
-        blocks = [Block(hdrs.get(h), bts.get(h)) for h in hashes if bts.has(h)]
         done = highest == sink or not hashes
+        if headers_only:
+            headers = [hdrs.get(h) for h in hashes if hdrs.has(h)]
+            peer.send(MSG_HEADERS, {"headers": headers, "done": done, "continuation": highest})
+            return
+        blocks = [Block(hdrs.get(h), bts.get(h)) for h in hashes if bts.has(h)]
         peer.send(
             MSG_IBD_BLOCKS,
             {"blocks": blocks, "done": done, "continuation": highest},
@@ -639,6 +695,18 @@ class Node:
         if peer.protocol_version < 8:
             raise ProtocolError("peer protocol tier does not support body requests (needs v8)")
         peer.send(MSG_REQUEST_BLOCK_BODIES, hashes)
+
+    def headers_first_sync(self, peer: Peer) -> None:
+        """v8 headers-first catch-up: stream headers above our sink anchor,
+        then fetch just the bodies (ibd body_only_ibd_permitted mode)."""
+        if peer.protocol_version < 8:
+            raise ProtocolError("peer protocol tier does not support headers-first sync (needs v8)")
+        if self._ibd:
+            # one sync at a time: never race an in-flight (possibly staging)
+            # IBD with a second header stream into the same consensus
+            raise ProtocolError("a sync is already in flight")
+        peer._headers_first = True
+        peer.send(MSG_REQUEST_HEADERS, self.consensus.sink())
 
     def _on_pp_utxo_chunk(self, peer: Peer, payload: dict) -> None:
         from kaspa_tpu.consensus.processes.pruning_proof import ProofError

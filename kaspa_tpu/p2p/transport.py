@@ -93,6 +93,14 @@ class WirePeer:
                     self.node._handle(self, msg_type, payload)
         except (ConnectionError, OSError):
             pass
+        except ProtocolError as e:
+            # tell the peer WHY before dropping it (p2p.proto RejectMessage)
+            from kaspa_tpu.p2p.node import MSG_REJECT
+
+            try:
+                self.send(MSG_REJECT, str(e))
+            except Exception:  # noqa: BLE001 - socket may already be gone
+                pass
         except Exception:  # noqa: BLE001 - wire boundary: malformed frames,
             # codec decode errors, or consensus rejections from adversarial
             # payloads all mean "drop the peer" (reference would score/ban)

@@ -23,9 +23,12 @@ from kaspa_tpu.p2p.node import (
     MSG_INV_BLOCK,
     MSG_INV_TXS,
     MSG_BLOCK_BODIES,
+    MSG_HEADERS,
     MSG_PP_SMT_CHUNK,
     MSG_PP_UTXO_CHUNK,
+    MSG_REJECT,
     MSG_REQUEST_BLOCK_BODIES,
+    MSG_REQUEST_HEADERS,
     MSG_REQUEST_PP_SMT,
     MSG_PRUNING_PROOF,
     MSG_REQUEST_BLOCK,
@@ -79,6 +82,9 @@ _TYPE_IDS = {
     MSG_PP_SMT_CHUNK: 25,
     MSG_REQUEST_BLOCK_BODIES: 26,
     MSG_BLOCK_BODIES: 27,
+    MSG_REQUEST_HEADERS: 28,
+    MSG_HEADERS: 29,
+    MSG_REJECT: 30,
 }
 
 _TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
@@ -141,8 +147,10 @@ def _enc_ibd_chunk(p) -> bytes:
 def _dec_ibd_chunk(data: bytes) -> dict:
     r = io.BytesIO(data)
     blocks = _dec_blocks_stream(r)
-    done = r.read(1) == b"\x01"
-    return {"blocks": blocks, "done": done, "continuation": r.read(32)}
+    tail = r.read(33)
+    if len(tail) != 33:
+        raise WireError("truncated IBD chunk (missing done/continuation)")
+    return {"blocks": blocks, "done": tail[:1] == b"\x01", "continuation": tail[1:]}
 
 
 def _enc_empty(_p) -> bytes:
@@ -327,6 +335,26 @@ def _dec_smt_chunk(data: bytes) -> dict:
     }
 
 
+def _enc_headers_chunk(p) -> bytes:
+    """Headers-first chunk: header list + done flag + continuation."""
+    w = io.BytesIO()
+    serde.write_varint(w, len(p["headers"]))
+    for h in p["headers"]:
+        serde.write_bytes(w, serde.encode_header(h))
+    w.write(b"\x01" if p["done"] else b"\x00")
+    w.write(p["continuation"])
+    return w.getvalue()
+
+
+def _dec_headers_chunk(data: bytes) -> dict:
+    r = io.BytesIO(data)
+    headers = [serde.decode_header(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
+    tail = r.read(33)
+    if len(tail) != 33:
+        raise WireError("truncated headers chunk (missing done/continuation)")
+    return {"headers": headers, "done": tail[:1] == b"\x01", "continuation": tail[1:]}
+
+
 def _enc_bodies(items) -> bytes:
     """[(block_hash, [tx, ...])] — v8 body-only sync payload."""
     w = io.BytesIO()
@@ -390,6 +418,9 @@ _CODECS = {
     MSG_PP_SMT_CHUNK: (_enc_smt_chunk, _dec_smt_chunk),
     MSG_REQUEST_BLOCK_BODIES: (serde.encode_hash_list, serde.decode_hash_list_bytes),
     MSG_BLOCK_BODIES: (_enc_bodies, _dec_bodies),
+    MSG_REQUEST_HEADERS: (lambda h: h, lambda d: d),  # single 32-byte hash
+    MSG_HEADERS: (_enc_headers_chunk, _dec_headers_chunk),
+    MSG_REJECT: (lambda s_: s_.encode(), lambda d: d.decode("utf-8", "replace")),
 }
 
 

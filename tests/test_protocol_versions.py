@@ -100,3 +100,64 @@ def test_body_only_fetch_completes_header_only_blocks():
     pa2, pc = connect(a, c)
     with pytest.raises(ProtocolError, match="needs v8"):
         a.request_bodies(pa2, [blocks[0].hash])
+
+
+def test_headers_first_sync_end_to_end():
+    """v8 headers-first catch-up: the syncer streams headers above its sink
+    anchor, fetches only the bodies, and converges to the donor's state —
+    the reference's body_only_ibd_permitted mode (v8/mod.rs)."""
+    params = simnet_params(bps=2)
+    donor = Node(Consensus(params), "donor")
+    blocks = _mine(donor, 20)
+    joiner = Node(Consensus(params), "joiner")
+    pj, pd = connect(joiner, donor)
+    joiner.headers_first_sync(pj)
+    assert joiner.consensus.sink() == donor.consensus.sink()
+    for blk in blocks:
+        assert joiner.consensus.storage.block_transactions.has(blk.hash)
+    # a v7 peer cannot drive it
+    old = Node(Consensus(params), "old")
+    old.protocol_version = 7
+    po, _ = connect(old, donor)
+    with pytest.raises(ProtocolError, match="needs v8"):
+        old.headers_first_sync(po)
+
+
+def test_headers_first_wire_roundtrip():
+    """The headers chunk + reject frames survive the binary codec."""
+    from kaspa_tpu.p2p import wire
+    from kaspa_tpu.p2p.node import MSG_HEADERS, MSG_REJECT, MSG_REQUEST_HEADERS
+
+    params = simnet_params(bps=2)
+    n = Node(Consensus(params), "w")
+    blocks = _mine(n, 3)
+    payload = {
+        "headers": [b.header for b in blocks],
+        "done": False,
+        "continuation": blocks[-1].hash,
+    }
+    frame = wire.encode_frame(MSG_HEADERS, payload)
+    buf = memoryview(frame)
+    pos = [0]
+
+    def rd(k):
+        b = bytes(buf[pos[0] : pos[0] + k])
+        pos[0] += k
+        return b
+
+    name, dec = wire.read_message(rd)
+    assert name == MSG_HEADERS and not dec["done"]
+    assert [h.hash for h in dec["headers"]] == [b.header.hash for b in blocks]
+    assert dec["continuation"] == blocks[-1].hash
+
+    frame2 = wire.encode_frame(MSG_REJECT, "protocol violation: test")
+    buf = memoryview(frame2)
+    pos[0] = 0
+    name2, dec2 = wire.read_message(rd)
+    assert name2 == MSG_REJECT and dec2 == "protocol violation: test"
+
+    frame3 = wire.encode_frame(MSG_REQUEST_HEADERS, blocks[0].hash)
+    buf = memoryview(frame3)
+    pos[0] = 0
+    name3, dec3 = wire.read_message(rd)
+    assert name3 == MSG_REQUEST_HEADERS and dec3 == blocks[0].hash
