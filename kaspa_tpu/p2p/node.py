@@ -22,6 +22,7 @@ from kaspa_tpu.consensus.stores import StatusesStore
 from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.utils.sync import LockCtx
 
 # p2p.proto payload types modeled this round
 MSG_VERSION = "version"
@@ -139,8 +140,11 @@ class Node:
         self.orphan_blocks: dict[bytes, Block] = {}  # flowcontext/orphans.rs
         self._ibd: dict = {}  # proof-IBD state machine (one active sync)
         # single-writer discipline: wire reader threads and RPC dispatch all
-        # serialize consensus/mempool access through this lock
-        self.lock = threading.RLock()
+        # serialize consensus/mempool access through this lock.  Ranked
+        # BELOW the pipeline's consensus-commit lock (rank 10): handlers
+        # take node -> commit, never the inverse (LockCtx asserts this
+        # under KASPA_TPU_LOCK_DEBUG)
+        self.lock = LockCtx("node", rank=5)
         # the concurrent pipeline IS the block intake — relay, RPC submit and
         # IBD all flow through it (the reference runs its 4-processor
         # pipeline always, consensus/src/consensus/mod.rs:369-401; there is

@@ -25,6 +25,7 @@ from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.mempool.mempool import MempoolError
 from kaspa_tpu.metrics import PerfMonitor
 from kaspa_tpu.notify.notifier import Notifier
+from kaspa_tpu.utils.sync import lock_trace_snapshot as _lock_trace_snapshot
 
 
 class RpcError(Exception):
@@ -274,6 +275,9 @@ class RpcCoreService:
             "sig_cache_misses": sc.misses,
             "process_counters": asdict(self.consensus.counters.snapshot()),
             "process_metrics": asdict(self.perf_monitor.sample()),
+            # per-lock acquisition/hold aggregates when KASPA_TPU_LOCK_DEBUG
+            # is on (the reference's semaphore-trace analog); {} otherwise
+            "lock_trace": _lock_trace_snapshot(),
             # grouped snapshot with derived rates (metrics/core/src/data.rs),
             # sampled by the daemon's tick service
             "snapshot": (

@@ -17,6 +17,7 @@ from __future__ import annotations
 import collections
 import os
 import threading
+import time
 
 
 class Closed(Exception):
@@ -88,6 +89,35 @@ class Channel:
 
 _LOCK_DEBUG = bool(os.environ.get("KASPA_TPU_LOCK_DEBUG"))
 _held = threading.local()
+# per-lock contention/hold aggregates under debug: the runtime analog of
+# the reference's semaphore trace feature (utils/src/sync/semaphore.rs
+# trace-enabled acquisition accounting)
+_trace_mu = threading.Lock()
+_trace: dict[str, list] = {}  # name -> [acquisitions, total_hold_s, max_hold_s]
+
+
+def set_lock_debug(on: bool) -> None:
+    """Toggle lock-order checking + hold tracing (tests; env is read once)."""
+    global _LOCK_DEBUG
+    _LOCK_DEBUG = bool(on)
+
+
+def lock_trace_snapshot() -> dict:
+    """{lock name: {acquisitions, total_hold_s, max_hold_s}} accumulated
+    while debug is on — contention hunting without a profiler attached."""
+    with _trace_mu:
+        return {
+            name: {"acquisitions": c, "total_hold_s": round(t, 6), "max_hold_s": round(m, 6)}
+            for name, (c, t, m) in _trace.items()
+        }
+
+
+def _trace_record(name: str, held_s: float) -> None:
+    with _trace_mu:
+        entry = _trace.setdefault(name, [0, 0.0, 0.0])
+        entry[0] += 1
+        entry[1] += held_s
+        entry[2] = max(entry[2], held_s)
 
 
 class LockCtx:
@@ -102,7 +132,8 @@ class LockCtx:
         self._lock = lock if lock is not None else threading.RLock()
 
     def __enter__(self):
-        if _LOCK_DEBUG:
+        tracked = _LOCK_DEBUG
+        if tracked:
             stack = getattr(_held, "stack", None)
             if stack is None:
                 stack = _held.stack = []
@@ -111,12 +142,21 @@ class LockCtx:
                     f"lock-order violation: acquiring {self.name}(rank {self.rank}) "
                     f"while holding {stack[-1][2]}(rank {stack[-1][1]})"
                 )
-            stack.append((self, self.rank, self.name))
         self._lock.acquire()
+        if tracked:
+            # timestamp AFTER acquire: the trace measures hold time, not
+            # wait+hold (contention shows as many short holds, not one long)
+            stack.append((self, self.rank, self.name, time.perf_counter()))
         return self
 
     def __exit__(self, *exc):
         self._lock.release()
-        if _LOCK_DEBUG:
-            _held.stack.pop()
+        # pop-if-ours regardless of the current debug flag: a debug toggle
+        # while locks are held must neither pop a foreign/missing entry nor
+        # leave a stale one behind (set_lock_debug races are test-only, but
+        # corruption here would surface as false ordering violations)
+        stack = getattr(_held, "stack", None)
+        if stack and stack[-1][0] is self:
+            entry = stack.pop()
+            _trace_record(self.name, time.perf_counter() - entry[3])
         return False
