@@ -129,9 +129,17 @@ class CachedDbAccess:
     # -- reads ---------------------------------------------------------
 
     def try_get(self, key: bytes):
-        if key in self._cache:
-            self._cache.move_to_end(key)
-            return self._cache[key]
+        obj = self._cache.get(key)
+        if obj is not None:
+            # recency bookkeeping only matters under eviction pressure:
+            # unbounded caches (no DB) and caches far below budget cannot
+            # evict, so hit order cannot change any outcome — and this is
+            # the hottest read path in header validation (the difficulty
+            # windows issue tens of millions of hits per few thousand
+            # blocks)
+            if self._budget is not None and len(self._cache) * 2 >= self._budget:
+                self._cache.move_to_end(key)
+            return obj
         raw = self._db_raw(key)
         if raw is None:
             return None
