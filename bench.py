@@ -118,8 +118,19 @@ def _child_main() -> None:
 
     jax_setup.setup()
 
+    # span capture + metric registry ride the result line (success AND
+    # failure): when the backend wedges, the tail shows exactly which spans
+    # ever completed (host marshal? device dispatch?) and what compiled
+    from kaspa_tpu.observability import snapshot as obs_snapshot
+    from kaspa_tpu.observability import trace
+
+    trace.set_capture(512)
+
+    def _obs() -> dict:
+        return {"metrics": obs_snapshot(), "spans": trace.drain()}
+
     if not _child_probe(PROBE_TIMEOUT_S):
-        print(json.dumps({"child_error": "probe_timeout"}))
+        print(json.dumps({"child_error": "probe_timeout", "observability": _obs()}))
         sys.stdout.flush()
         os._exit(3)
 
@@ -183,6 +194,7 @@ def _child_main() -> None:
                 "value": round(value, 1),
                 "unit": UNIT,
                 "vs_baseline": round(value / BASELINE, 4),
+                "observability": _obs(),
             }
         )
     )
@@ -195,8 +207,11 @@ def _child_main() -> None:
 # ==========================================================================
 
 
-def _run_attempt(timeout_s: float) -> tuple[dict | None, str]:
-    """One fresh-subprocess attempt.  Returns (result_json | None, note)."""
+def _run_attempt(timeout_s: float) -> tuple[dict | None, str, dict | None]:
+    """One fresh-subprocess attempt.
+    Returns (result_json | None, note, observability | None) — the obs tail
+    comes back even from failed children so the final error line can carry
+    the last evidence of what the device did before wedging."""
     env = dict(os.environ)
     env["KASPA_TPU_BENCH_CHILD"] = "1"
     proc = subprocess.Popen(
@@ -214,7 +229,7 @@ def _run_attempt(timeout_s: float) -> tuple[dict | None, str]:
             proc.communicate(timeout=10)
         except Exception:
             pass
-        return None, f"attempt timeout after {timeout_s:.0f}s (killed)"
+        return None, f"attempt timeout after {timeout_s:.0f}s (killed)", None
     for line in reversed((out or "").strip().splitlines()):
         line = line.strip()
         if not line.startswith("{"):
@@ -224,10 +239,10 @@ def _run_attempt(timeout_s: float) -> tuple[dict | None, str]:
         except json.JSONDecodeError:
             continue
         if obj.get("metric") == METRIC and obj.get("value", 0) > 0:
-            return obj, "ok"
+            return obj, "ok", obj.get("observability")
         if "child_error" in obj:
-            return None, f"child: {obj['child_error']}"
-    return None, f"child exited rc={proc.returncode} without a result line"
+            return None, f"child: {obj['child_error']}", obj.get("observability")
+    return None, f"child exited rc={proc.returncode} without a result line", None
 
 
 def main() -> None:
@@ -237,6 +252,7 @@ def main() -> None:
 
     deadline = time.monotonic() + TOTAL_BUDGET_S
     notes: list[str] = []
+    last_obs: dict | None = None
     for attempt in range(MAX_ATTEMPTS):
         remaining = deadline - time.monotonic()
         if attempt > 0 and remaining <= RETRY_BACKOFF_S + 60:
@@ -245,8 +261,10 @@ def main() -> None:
         # always give the first attempt its full window; later ones get
         # whatever budget remains (a wedged backend burns probe-time only)
         timeout_s = ATTEMPT_TIMEOUT_S if attempt == 0 else min(ATTEMPT_TIMEOUT_S, remaining - 10)
-        result, note = _run_attempt(timeout_s)
+        result, note, obs = _run_attempt(timeout_s)
         notes.append(f"attempt {attempt + 1}: {note}")
+        if obs is not None:
+            last_obs = obs
         if result is not None:
             print(json.dumps(result))
             return
@@ -261,6 +279,7 @@ def main() -> None:
                 "vs_baseline": 0.0,
                 "error": "device backend unresponsive after fresh-subprocess retries: "
                 + "; ".join(notes),
+                "observability": last_obs,
             }
         )
     )

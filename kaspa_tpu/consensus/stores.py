@@ -24,6 +24,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from dataclasses import dataclass, fields
 from kaspa_tpu.consensus.model import Header, Transaction
+from kaspa_tpu.observability.core import REGISTRY
 
 # key prefixes (database/src/registry.rs DatabaseStorePrefixes shape)
 PREFIX_HEADERS = b"HD"
@@ -44,6 +45,27 @@ PREFIX_REACH_MERGESET = b"RM"
 PREFIX_BLOCK_LEVELS = b"LV"
 PREFIX_META = b"MT"
 PREFIX_REACH_NODE = b"RN"  # per-node reachability records (crash-safe restart)
+
+# prefix -> human store name for cache telemetry (ConsensusStorage.cache_stats)
+_PREFIX_NAMES = {
+    PREFIX_HEADERS: "headers",
+    PREFIX_RELATIONS: "relations",
+    PREFIX_CHILDREN: "children",
+    PREFIX_GHOSTDAG: "ghostdag",
+    PREFIX_STATUSES: "statuses",
+    PREFIX_BLOCK_TXS: "block_txs",
+    PREFIX_UTXO_DIFFS: "utxo_diffs",
+    PREFIX_MULTISETS: "multisets",
+    PREFIX_ACCEPTANCE: "acceptance",
+    PREFIX_DAA_EXCLUDED: "daa_excluded",
+    PREFIX_UTXO_SET: "utxo_set",
+    PREFIX_PRUNING_UTXO: "pruning_utxo",
+    PREFIX_DEPTH: "depth",
+    PREFIX_PRUNING_SAMPLES: "pruning_samples",
+    PREFIX_REACH_MERGESET: "reach_mergesets",
+    PREFIX_BLOCK_LEVELS: "levels",
+    PREFIX_REACH_NODE: "reach_nodes",
+}
 
 
 @dataclass
@@ -97,11 +119,16 @@ class CachedDbAccess:
         self._cache: OrderedDict = OrderedDict()
         self._dirty: set = set()        # staged writes not yet flushed (pinned)
         self._pending_del: set = set()  # staged deletes not yet flushed
+        # plain-int cache telemetry (GIL-atomic; aggregated by
+        # ConsensusStorage.cache_stats into the observability registry)
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
         if storage.db is not None:
             self._count = storage.db.engine.count_prefix(prefix)
-            storage.register(self)
         else:
             self._count = 0
+        storage.register(self)
 
     # -- internal ------------------------------------------------------
 
@@ -117,6 +144,7 @@ class CachedDbAccess:
             for k in self._cache:
                 if k not in self._dirty:
                     del self._cache[k]
+                    self._evictions += 1
                     break
             else:
                 return  # everything pinned; evict after next flush
@@ -131,6 +159,7 @@ class CachedDbAccess:
     def try_get(self, key: bytes):
         obj = self._cache.get(key)
         if obj is not None:
+            self._hits += 1
             # recency bookkeeping only matters under eviction pressure:
             # unbounded caches (no DB) and caches far below budget cannot
             # evict, so hit order cannot change any outcome — and this is
@@ -140,10 +169,15 @@ class CachedDbAccess:
             if self._budget is not None and len(self._cache) * 2 >= self._budget:
                 self._cache.move_to_end(key)
             return obj
+        self._misses += 1
         raw = self._db_raw(key)
         if raw is None:
             return None
         obj = self._decode(raw)
+        # `None` IS the miss sentinel of this cache: a decoder returning
+        # None would alias a present row with a miss, silently re-reading
+        # (and re-decoding) it forever — fail loudly instead
+        assert obj is not None, f"decoder for store prefix {self._prefix!r} returned None for key {key!r}"
         self._cache[key] = obj
         self._evict()
         return obj
@@ -198,6 +232,10 @@ class CachedDbAccess:
     # -- writes --------------------------------------------------------
 
     def write(self, key: bytes, obj) -> None:
+        # `None` is reserved as the miss sentinel (try_get/get return it
+        # for absent keys); caching a literal None would make the entry
+        # unreadable — every lookup would miss through to the DB forever
+        assert obj is not None, "CachedDbAccess values must not be None (None is the miss sentinel)"
         if self._storage.db is not None:
             if key not in self:
                 self._count += 1
@@ -644,9 +682,26 @@ class ConsensusStorage:
         )
         self.utxo_set = UtxoSetStore(self, PREFIX_UTXO_SET, self.policy.utxo_set)
         self.pruning_utxo_set = UtxoSetStore(self, PREFIX_PRUNING_UTXO, self.policy.pruning_utxo)
+        # bound method via WeakMethod inside the registry: per-test storages
+        # don't leak, and multiple live storages merge by numeric sum
+        REGISTRY.register_collector("store_cache", self.cache_stats)
 
     def register(self, access: CachedDbAccess) -> None:
         self._registered.append(access)
+
+    def cache_stats(self) -> dict:
+        """Per-store decode-cache telemetry: {store: {hits, misses,
+        evictions, entries}}.  Consumed by the observability registry
+        (which derives hit_rate); reading plain ints is torn-read safe."""
+        out: dict[str, dict] = {}
+        for access in self._registered:
+            name = _PREFIX_NAMES.get(access._prefix, access._prefix.decode("ascii", "replace"))
+            d = out.setdefault(name, {"hits": 0, "misses": 0, "evictions": 0, "entries": 0})
+            d["hits"] += access._hits
+            d["misses"] += access._misses
+            d["evictions"] += access._evictions
+            d["entries"] += len(access._cache)
+        return out
 
     def stage(self, key: bytes, value: bytes | None) -> None:
         """Queue one write-through op (value None = delete)."""

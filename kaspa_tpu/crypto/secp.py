@@ -17,9 +17,24 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from kaspa_tpu.crypto import eclib
+from kaspa_tpu.observability.core import PERCENT_BUCKETS, REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
 from kaspa_tpu.ops.secp256k1.verify import ecdsa_verify, schnorr_verify
+
+# batch shape telemetry: occupancy is the fraction of padded device lanes
+# doing useful work, the quantity batch-verify throughput is dominated by
+# (committee-consensus signature studies measure exactly this); dispatched
+# shapes proxy XLA recompiles — every new bucket is a fresh jit trace
+_BATCH_SIZE = REGISTRY.histogram("secp_batch_size", SIZE_BUCKETS, help="logical verify jobs per device batch")
+_OCCUPANCY = REGISTRY.histogram(
+    "secp_batch_occupancy_pct", PERCENT_BUCKETS, help="logical batch size / padded bucket size * 100"
+)
+_PADDED_LANES = REGISTRY.counter("secp_padded_lanes", help="device lanes wasted on pad-to-bucket")
+_NEW_SHAPES = REGISTRY.counter_family(
+    "secp_dispatch_shapes", "kernel", help="distinct padded bucket sizes dispatched (jit recompile proxy)"
+)
+_seen_shapes: set = set()
 
 W = bi.FP.W
 _CHALLENGE_MID = hashlib.sha256(
@@ -90,6 +105,13 @@ class _Batch:
         if n == 0:
             return np.zeros(0, dtype=bool)
         b = _bucket(n)
+        _BATCH_SIZE.observe(n)
+        _OCCUPANCY.observe(100.0 * n / b)
+        _PADDED_LANES.inc(b - n)
+        shape_key = (kernel.__name__, b)
+        if shape_key not in _seen_shapes:
+            _seen_shapes.add(shape_key)
+            _NEW_SHAPES.inc(kernel.__name__)
         ok = np.zeros(b, dtype=bool)
         ok[:n] = self.ok
         pad = [0] * (b - n)

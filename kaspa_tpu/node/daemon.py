@@ -355,11 +355,19 @@ class Daemon:
         self.metrics_data = MetricsData()
         self.tick = TickService()
 
+        # prometheus text rendered on the metrics tick (not per scrape):
+        # rendering walks the whole registry, so it rides the existing
+        # 10s cadence and getMetricsPrometheus serves the cached page
+        self.prom_text = ""
+
         def sample_metrics():
             with self._dispatch_lock:
                 self.metrics_data.push(
                     collect_snapshot(self.consensus, self.mining, self.perf_monitor, p2p_node=self.node)
                 )
+            from kaspa_tpu.observability import prom
+
+            self.prom_text = prom.render()
 
         self.tick.register(10.0, sample_metrics)
 
@@ -487,6 +495,7 @@ class Daemon:
         "getBalanceByAddress": lambda rpc, p: rpc.get_balance_by_address(p["address"]),
         "getCoinSupply": lambda rpc, p: rpc.get_coin_supply(),
         "getMetrics": lambda rpc, p: rpc.get_metrics(),
+        "getMetricsPrometheus": lambda rpc, p: rpc.get_metrics_prometheus(),
         "ping": lambda rpc, p: rpc.ping(),
         "getCurrentNetwork": lambda rpc, p: rpc.get_current_network(),
         "getInfo": lambda rpc, p: rpc.get_info(),

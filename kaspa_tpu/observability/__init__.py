@@ -1,0 +1,34 @@
+"""Observability layer: span tracing, hot-path histograms, counters.
+
+Usage::
+
+    from kaspa_tpu.observability import trace
+    with trace.span("pipeline.stage", block=h.hex()[:8]):
+        ...
+
+    from kaspa_tpu.observability.core import REGISTRY
+    REGISTRY.counter("my_counter").inc()
+
+``snapshot()`` returns the full registry as deterministic plain dicts
+(what ``RpcCoreService.get_metrics`` embeds under ``observability``);
+``kaspa_tpu.observability.prom.render()`` emits the same registry as
+Prometheus exposition text.
+"""
+
+from kaspa_tpu.observability import trace  # noqa: F401
+from kaspa_tpu.observability.core import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    PERCENT_BUCKETS,
+    REGISTRY,
+    SIZE_BUCKETS,
+    Counter,
+    CounterFamily,
+    Histogram,
+    HistogramFamily,
+    Registry,
+)
+
+
+def snapshot() -> dict:
+    """Global registry snapshot (counters, histograms, collector gauges)."""
+    return REGISTRY.snapshot()

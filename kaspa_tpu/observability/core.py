@@ -1,0 +1,302 @@
+"""Observability core: counters, fixed-bucket histograms, global registry.
+
+The telemetry substrate the validation pipeline and the TPU kernel path
+report into (the role of the reference's perf-monitor + log counters, but
+structured): plain-int counters and fixed-bucket histograms mutated without
+locks — Python int += and list-slot += are atomic under the GIL — plus a
+process-global ``REGISTRY`` whose ``snapshot()`` copies everything into
+deterministic plain dicts (sorted keys, JSON-serializable) for
+``RpcCoreService.get_metrics`` and the Prometheus exporter (prom.py).
+
+Hot-path discipline: metric objects are created once at import/module
+level and call sites hold direct references; ``observe``/``inc`` never
+allocate beyond the bisect index.  Registration (rare) takes a lock;
+mutation (hot) never does.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from bisect import bisect_left
+
+# log-spaced latency edges in SECONDS: 10 µs .. 10 s (spans, dispatch, IO)
+DEFAULT_LATENCY_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# power-of-two size edges (batch sizes, queue depths)
+SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536)
+
+# occupancy percentage edges
+PERCENT_BUCKETS = (10.0, 25.0, 50.0, 62.5, 75.0, 87.5, 95.0, 100.0)
+
+
+class Counter:
+    """Monotonic counter; also serves as a cell of a CounterFamily."""
+
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def snapshot(self) -> int:
+        return self.value
+
+    def reset(self) -> None:
+        self.value = 0
+
+
+class CounterFamily:
+    """Counter with one label dimension; cells created on first use.
+
+    Hot call sites should hold ``cell(label)`` and call ``inc`` on it.
+    """
+
+    __slots__ = ("name", "help", "label", "_cells")
+
+    def __init__(self, name: str, label: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.label = label
+        self._cells: dict[str, Counter] = {}
+
+    def cell(self, labelval: str) -> Counter:
+        c = self._cells.get(labelval)
+        if c is None:
+            # benign race under the GIL: last assignment wins, both cells
+            # start at 0 and only one remains reachable
+            c = self._cells.setdefault(labelval, Counter(self.name, self.help))
+        return c
+
+    def inc(self, labelval: str, n: int = 1) -> None:
+        self.cell(labelval).value += n
+
+    def snapshot(self) -> dict:
+        return {k: c.value for k, c in sorted(self._cells.items())}
+
+    def reset(self) -> None:
+        for c in self._cells.values():
+            c.value = 0
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus ``le`` semantics: a value lands
+    in the first bucket whose upper edge is >= the value; values above the
+    last edge land in the implicit +Inf bucket)."""
+
+    __slots__ = ("name", "help", "edges", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, name: str, buckets=DEFAULT_LATENCY_BUCKETS, help: str = ""):
+        self.name = name
+        self.help = help
+        self.edges = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.edges) + 1)  # +1 = +Inf overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect_left(self.edges, v)] += 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (upper edge of the bucket
+        holding the q-th observation; +Inf bucket reports observed max)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank and c:
+                return self.edges[i] if i < len(self.edges) else self.max
+        return self.max
+
+    def snapshot(self) -> dict:
+        out = {
+            "count": self.count,
+            "sum": self.sum,
+            "buckets": [[le, c] for le, c in zip(self.edges, self.counts)] + [["+Inf", self.counts[-1]]],
+        }
+        if self.count:
+            out["min"] = self.min
+            out["max"] = self.max
+            out["p50"] = self.quantile(0.50)
+            out["p90"] = self.quantile(0.90)
+            out["p99"] = self.quantile(0.99)
+        return out
+
+    def reset(self) -> None:
+        self.counts = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+
+class HistogramFamily:
+    """Histogram with one label dimension (e.g. per pipeline stage)."""
+
+    __slots__ = ("name", "help", "label", "buckets", "_cells")
+
+    def __init__(self, name: str, label: str, buckets=DEFAULT_LATENCY_BUCKETS, help: str = ""):
+        self.name = name
+        self.help = help
+        self.label = label
+        self.buckets = tuple(sorted(buckets))
+        self._cells: dict[str, Histogram] = {}
+
+    def cell(self, labelval: str) -> Histogram:
+        h = self._cells.get(labelval)
+        if h is None:
+            h = self._cells.setdefault(labelval, Histogram(self.name, self.buckets, self.help))
+        return h
+
+    def observe(self, labelval: str, v: float) -> None:
+        self.cell(labelval).observe(v)
+
+    def snapshot(self) -> dict:
+        return {k: h.snapshot() for k, h in sorted(self._cells.items())}
+
+    def reset(self) -> None:
+        for h in self._cells.values():
+            h.reset()
+
+
+def _merge_numeric(dst: dict, src: dict) -> dict:
+    """Recursively sum numeric leaves (multiple collectors, same name —
+    e.g. several live ConsensusStorage instances in one process)."""
+    for k, v in src.items():
+        if isinstance(v, dict):
+            dst[k] = _merge_numeric(dst.get(k, {}), v)
+        elif isinstance(v, (int, float)) and isinstance(dst.get(k), (int, float)):
+            dst[k] = dst[k] + v
+        else:
+            dst[k] = v
+    return dst
+
+
+def _derive_rates(d: dict) -> None:
+    """Where a dict carries hits+misses, attach the derived hit_rate."""
+    if "hits" in d and "misses" in d:
+        total = d["hits"] + d["misses"]
+        d["hit_rate"] = (d["hits"] / total) if total else 0.0
+    for v in d.values():
+        if isinstance(v, dict):
+            _derive_rates(v)
+
+
+class Registry:
+    """Process-global metric registry.
+
+    Metric creation is idempotent (get-or-create by name) so modules can
+    declare their instruments at import time; ``snapshot()`` walks
+    everything without taking the registration lock — mutation is
+    GIL-atomic and a torn read across metrics is acceptable for telemetry.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._counters: dict[str, Counter | CounterFamily] = {}
+        self._histograms: dict[str, Histogram | HistogramFamily] = {}
+        # name -> list of weakref-able callables contributing gauge trees
+        self._collectors: dict[str, list] = {}
+
+    # -- registration (rare; locked) -----------------------------------
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        with self._mu:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter(name, help)
+            assert isinstance(m, Counter), f"{name} already registered with labels"
+            return m
+
+    def counter_family(self, name: str, label: str, help: str = "") -> CounterFamily:
+        with self._mu:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = CounterFamily(name, label, help)
+            assert isinstance(m, CounterFamily), f"{name} already registered without labels"
+            return m
+
+    def histogram(self, name: str, buckets=DEFAULT_LATENCY_BUCKETS, help: str = "") -> Histogram:
+        with self._mu:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = Histogram(name, buckets, help)
+            assert isinstance(m, Histogram), f"{name} already registered with labels"
+            return m
+
+    def histogram_family(
+        self, name: str, label: str, buckets=DEFAULT_LATENCY_BUCKETS, help: str = ""
+    ) -> HistogramFamily:
+        with self._mu:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = HistogramFamily(name, label, buckets, help)
+            assert isinstance(m, HistogramFamily), f"{name} already registered without labels"
+            return m
+
+    def register_collector(self, name: str, fn) -> None:
+        """Attach a ``() -> dict`` gauge source under ``name``.  Bound
+        methods are held via WeakMethod so short-lived owners (per-test
+        Consensus instances) never leak; plain functions are held strong.
+        Multiple collectors under one name are merged by numeric sum."""
+        import inspect
+
+        ref = weakref.WeakMethod(fn) if inspect.ismethod(fn) else (lambda fn=fn: fn)
+        with self._mu:
+            self._collectors.setdefault(name, []).append(ref)
+
+    # -- snapshot (hot-ish; lock-free) ---------------------------------
+
+    def snapshot(self) -> dict:
+        counters = {name: m.snapshot() for name, m in sorted(self._counters.items())}
+        histograms = {name: m.snapshot() for name, m in sorted(self._histograms.items())}
+        out = {"counters": counters, "histograms": histograms}
+        for name, refs in sorted(self._collectors.items()):
+            merged: dict = {}
+            live = []
+            for ref in refs:
+                fn = ref()
+                if fn is None:
+                    continue  # owner collected; prune below
+                live.append(ref)
+                try:
+                    contribution = fn()
+                except Exception:  # noqa: BLE001 - telemetry must not throw
+                    continue
+                if isinstance(contribution, dict):
+                    _merge_numeric(merged, contribution)
+            if len(live) != len(refs):
+                with self._mu:
+                    self._collectors[name] = live
+            _derive_rates(merged)
+            out[name] = merged
+        return out
+
+    def reset(self) -> None:
+        """Zero all metric values in place (keeps the object identities
+        hot-path modules captured at import).  Test helper."""
+        for m in self._counters.values():
+            m.reset()
+        for m in self._histograms.values():
+            m.reset()
+
+
+REGISTRY = Registry()

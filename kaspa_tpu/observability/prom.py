@@ -1,0 +1,106 @@
+"""Prometheus text exposition (format 0.0.4) over the global registry.
+
+Renders the SAME registry ``RpcCoreService.get_metrics`` snapshots:
+counters as ``<name>_total``, histograms with cumulative ``le`` buckets +
+``_sum``/``_count``, and collector gauge trees flattened to
+``kaspa_<collector>_<path>`` (one-level dicts become a ``key`` label).
+The daemon re-renders on its metrics tick (node/daemon.py) and serves the
+text via the ``getMetricsPrometheus`` RPC.
+"""
+
+from __future__ import annotations
+
+import re
+
+from kaspa_tpu.observability.core import (
+    Counter,
+    CounterFamily,
+    Histogram,
+    HistogramFamily,
+    REGISTRY,
+)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+PREFIX = "kaspa_"
+
+
+def _name(raw: str) -> str:
+    n = _NAME_RE.sub("_", raw)
+    if not n.startswith(PREFIX):
+        n = PREFIX + n
+    return n
+
+
+def _esc(labelval: str) -> str:
+    return str(labelval).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def _render_histogram(lines: list[str], name: str, label: str | None, cells) -> None:
+    for labelval, hist in cells:
+        base = f'{name}_bucket{{{label}="{_esc(labelval)}",le=' if label is not None else f"{name}_bucket{{le="
+        cum = 0
+        for le, c in zip(hist.edges, hist.counts):
+            cum += c
+            lines.append(f'{base}"{_fmt(float(le))}"}} {cum}')
+        cum += hist.counts[-1]
+        lines.append(f'{base}"+Inf"}} {cum}')
+        suffix = f'{{{label}="{_esc(labelval)}"}}' if label is not None else ""
+        lines.append(f"{name}_sum{suffix} {_fmt(hist.sum)}")
+        lines.append(f"{name}_count{suffix} {hist.count}")
+
+
+def _flatten_gauges(lines: list[str], name: str, tree: dict) -> None:
+    # {store: {stat: num}} is the common collector shape: emit
+    # kaspa_<name>_<stat>{key="store"}; anything deeper flattens by path.
+    for key in sorted(tree):
+        val = tree[key]
+        if isinstance(val, dict):
+            if all(isinstance(v, (int, float)) for v in val.values()):
+                for stat in sorted(val):
+                    lines.append(f'{name}_{_NAME_RE.sub("_", stat)}{{key="{_esc(key)}"}} {_fmt(val[stat])}')
+            else:
+                _flatten_gauges(lines, f'{name}_{_NAME_RE.sub("_", key)}', val)
+        elif isinstance(val, (int, float)):
+            lines.append(f'{name}_{_NAME_RE.sub("_", key)} {_fmt(val)}')
+
+
+def render(registry=REGISTRY) -> str:
+    """The full registry as Prometheus exposition text."""
+    lines: list[str] = []
+    for raw, m in sorted(registry._counters.items()):
+        name = _name(raw)
+        if m.help:
+            lines.append(f"# HELP {name} {m.help}")
+        lines.append(f"# TYPE {name} counter")
+        if isinstance(m, CounterFamily):
+            for labelval, cell in sorted(m._cells.items()):
+                lines.append(f'{name}_total{{{m.label}="{_esc(labelval)}"}} {cell.value}')
+        else:
+            lines.append(f"{name}_total {m.value}")
+    for raw, m in sorted(registry._histograms.items()):
+        name = _name(raw)
+        if m.help:
+            lines.append(f"# HELP {name} {m.help}")
+        lines.append(f"# TYPE {name} histogram")
+        if isinstance(m, HistogramFamily):
+            _render_histogram(lines, name, m.label, sorted(m._cells.items()))
+        else:
+            _render_histogram(lines, name, None, [(None, m)])
+    snap = registry.snapshot()
+    for cname in sorted(snap):
+        if cname in ("counters", "histograms"):
+            continue
+        tree = snap[cname]
+        if isinstance(tree, dict) and tree:
+            # untyped samples: the flattened names vary per leaf, so a
+            # single TYPE line cannot legally cover the family
+            _flatten_gauges(lines, _name(cname), tree)
+    return "\n".join(lines) + "\n"

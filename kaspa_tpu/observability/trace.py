@@ -1,0 +1,156 @@
+"""Low-overhead span tracer: ``trace.span("stage", **attrs)``.
+
+Spans time a code region on the monotonic clock (perf_counter_ns), nest
+through a thread-local stack, and report their duration into a per-stage
+latency histogram in the global registry.  Optionally a bounded in-memory
+span log captures every completed span (name, path, start, duration,
+thread, attrs) for offline replay by ``tools/trace_report.py``.
+
+Cost model (the contract tests/test_observability.py asserts loosely):
+- tracing disabled: ``span()`` returns a shared no-op object — well under
+  a microsecond per use;
+- tracing enabled: one small-object allocation, two clock reads, one
+  histogram observe and a stack push/pop — single-digit microseconds.
+
+Exception safety: ``__exit__`` always pops the stack and always records
+the span (tagging ``error`` with the exception type); the exception
+propagates unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from time import perf_counter_ns
+
+from kaspa_tpu.observability.core import DEFAULT_LATENCY_BUCKETS, REGISTRY
+
+# per-stage latency: the "per-stage latency histograms" surface of
+# RpcCoreService.get_metrics()["observability"]["histograms"]
+SPAN_HIST = REGISTRY.histogram_family(
+    "span_duration_seconds", "stage", DEFAULT_LATENCY_BUCKETS,
+    help="wall time of traced spans by stage name",
+)
+
+_tls = threading.local()
+_enabled = True
+_capture: deque | None = None  # bounded span log for trace_report replay
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("name", "attrs", "path", "_t0")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.path = name
+        self._t0 = 0
+
+    def __enter__(self):
+        st = _stack()
+        if st:
+            self.path = st[-1].path + "/" + self.name
+        st.append(self)
+        self._t0 = perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_ns = perf_counter_ns() - self._t0
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        SPAN_HIST.observe(self.name, dur_ns * 1e-9)
+        cap = _capture
+        if cap is not None:
+            if exc_type is not None:
+                self.attrs["error"] = exc_type.__name__
+            cap.append(
+                {
+                    "name": self.name,
+                    "path": self.path,
+                    "start_us": self._t0 // 1000,
+                    "dur_us": dur_ns / 1000.0,
+                    "thread": threading.current_thread().name,
+                    "depth": len(st),
+                    "attrs": self.attrs,
+                }
+            )
+        return False  # never swallow the exception
+
+
+def span(name: str, **attrs) -> Span | _NoopSpan:
+    """Open a timed span; use as ``with trace.span("stage", key=val):``."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, attrs)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def current_path() -> str:
+    """Slash-joined path of the active span stack on this thread."""
+    st = getattr(_tls, "stack", None)
+    return st[-1].path if st else ""
+
+
+def set_capture(maxlen: int = 65536) -> None:
+    """Turn the bounded span log on (maxlen > 0) or off (maxlen == 0)."""
+    global _capture
+    _capture = deque(maxlen=maxlen) if maxlen > 0 else None
+
+
+def drain() -> list[dict]:
+    """Return and clear the captured span log (oldest first)."""
+    cap = _capture
+    if cap is None:
+        return []
+    out = []
+    while cap:
+        try:
+            out.append(cap.popleft())
+        except IndexError:  # racing producer threads; good enough
+            break
+    return out
+
+
+def dump(path: str) -> int:
+    """Write the captured span log as JSONL for tools/trace_report.py."""
+    import json
+
+    spans = drain()
+    with open(path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+    return len(spans)

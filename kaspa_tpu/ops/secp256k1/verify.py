@@ -16,11 +16,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kaspa_tpu.observability import trace
+from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
 
 FP = bi.FP
 FN = bi.FN
+
+
+def _jit_compile_counts() -> dict:
+    """Actual jit cache sizes of the verify kernels — one entry per
+    (shape, backend) compilation.  When the round-5 style "0.0
+    verifies/sec" failure recurs, this says whether the device ever
+    finished a compile at all."""
+    out = {}
+    for name, fn in (("schnorr", schnorr_verify_kernel), ("ecdsa", ecdsa_verify_kernel)):
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:  # noqa: BLE001 - jax internals may shift
+            pass
+    return {"jit_compiles": out}
+
+
+REGISTRY.register_collector("secp", _jit_compile_counts)
 
 
 def _use_pallas() -> bool:
@@ -55,11 +74,16 @@ def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarra
     if _use_pallas():
         from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
 
-        return verify_batch_pallas(px, py, r_canon, s_scalars, e_scalars, valid_in, ecdsa=False)
+        with trace.span("secp.device_dispatch", kernel="schnorr_pallas"):
+            return verify_batch_pallas(px, py, r_canon, s_scalars, e_scalars, valid_in, ecdsa=False)
     b = np.asarray(px).shape[0]
-    sd = _scalars_to_digits(s_scalars, b)
-    ed = _scalars_to_digits(e_scalars, b)
-    return np.asarray(schnorr_verify_kernel(px, py, r_canon, sd, ed, valid_in))
+    # host marshal vs device dispatch split: when throughput collapses,
+    # this localizes the stall to python packing or the XLA round trip
+    with trace.span("secp.host_marshal", kernel="schnorr", batch=b):
+        sd = _scalars_to_digits(s_scalars, b)
+        ed = _scalars_to_digits(e_scalars, b)
+    with trace.span("secp.device_dispatch", kernel="schnorr", batch=b):
+        return np.asarray(schnorr_verify_kernel(px, py, r_canon, sd, ed, valid_in))
 
 
 def ecdsa_verify(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in) -> np.ndarray:
@@ -67,11 +91,14 @@ def ecdsa_verify(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in) -> np.ndar
     if _use_pallas():
         from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
 
-        return verify_batch_pallas(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in, ecdsa=True)
+        with trace.span("secp.device_dispatch", kernel="ecdsa_pallas"):
+            return verify_batch_pallas(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in, ecdsa=True)
     b = np.asarray(px).shape[0]
-    u1 = _scalars_to_digits(u1_scalars, b)
-    u2 = _scalars_to_digits(u2_scalars, b)
-    return np.asarray(ecdsa_verify_kernel(px, py, r_n_canon, u1, u2, valid_in))
+    with trace.span("secp.host_marshal", kernel="ecdsa", batch=b):
+        u1 = _scalars_to_digits(u1_scalars, b)
+        u2 = _scalars_to_digits(u2_scalars, b)
+    with trace.span("secp.device_dispatch", kernel="ecdsa", batch=b):
+        return np.asarray(ecdsa_verify_kernel(px, py, r_n_canon, u1, u2, valid_in))
 
 
 @jax.jit

@@ -88,6 +88,14 @@ _MSG_MIN_VERSION = {
 _ACTIVATION_GATE_SECONDS = 24 * 60 * 60
 
 
+def _activation_gate_blocks(target_time_per_block_ms: int) -> int:
+    """DAA-score horizon equal to one day of blocks.  Division before
+    rounding: the old per-second blocks-rate factor collapsed to 1 for any
+    target slower than 1 BPS (round(1000/10000) == 0 → clamped to 1), which
+    turned the one-day gate into ten days on sub-1-BPS networks."""
+    return round(_ACTIVATION_GATE_SECONDS * 1000 / target_time_per_block_ms)
+
+
 class ProtocolError(Exception):
     """Peer misbehavior that warrants disconnect/ban (flows ProtocolError)."""
 
@@ -234,8 +242,8 @@ class Node:
             # a v<10 peer cannot serve/receive lane state and would fork
             # (flow_context.rs:827-841)
             params = self.consensus.params
-            gate_daa = self.consensus.get_virtual_daa_score() + _ACTIVATION_GATE_SECONDS * max(
-                1, round(1000 / params.target_time_per_block)
+            gate_daa = self.consensus.get_virtual_daa_score() + _activation_gate_blocks(
+                params.target_time_per_block
             )
             if params.toccata_active(gate_daa) and peer_pv < 10:
                 raise ProtocolError(

@@ -23,11 +23,23 @@ from __future__ import annotations
 import queue
 import socket
 import threading
+from time import perf_counter_ns
 
 _SEND_QUEUE_LIMIT = 4096  # frames; overflow => drop the peer (slow consumer)
 
+from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.p2p import wire
 from kaspa_tpu.p2p.node import MIN_PROTOCOL_VERSION, MSG_VERSION, Node, ProtocolError
+
+# codec cost only (socket IO excluded): encode is timed around
+# wire.encode_frame in send(), decode around wire.decode_payload in the
+# reader loop — blocking recv time would otherwise swamp the histogram
+_ENC_TIME = REGISTRY.histogram("p2p_frame_encode_seconds", help="wire frame encode time (codec only)")
+_DEC_TIME = REGISTRY.histogram("p2p_frame_decode_seconds", help="wire payload decode time (codec only)")
+_FRAMES_TX = REGISTRY.counter("p2p_frames_tx", help="frames enqueued for send")
+_FRAMES_RX = REGISTRY.counter("p2p_frames_rx", help="frames received and decoded")
+_BYTES_TX = REGISTRY.counter("p2p_bytes_tx", help="frame bytes enqueued for send")
+_BYTES_RX = REGISTRY.counter("p2p_bytes_rx", help="frame bytes received (incl. headers)")
 
 
 class WirePeer:
@@ -58,11 +70,31 @@ class WirePeer:
     def send(self, msg_type: str, payload) -> None:
         if not self.alive:
             return
+        t0 = perf_counter_ns()
         frame = wire.encode_frame(msg_type, payload)
+        _ENC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
+        _FRAMES_TX.inc()
+        _BYTES_TX.inc(len(frame))
         try:
             self._outq.put_nowait(frame)
         except queue.Full:
             self.close()
+
+    def flush(self, timeout: float = 1.0) -> bool:
+        """Block until every frame enqueued so far has hit the socket.
+
+        Implemented as a sentinel Event that rides the FIFO behind the
+        pending frames; the writer thread sets it once everything ahead of
+        it has been sendall()'d.  Bounded wait: a wedged peer must not be
+        able to pin the caller (returns False on timeout/overflow)."""
+        if not self.alive:
+            return False
+        done = threading.Event()
+        try:
+            self._outq.put_nowait(done)
+        except queue.Full:
+            return False
+        return done.wait(timeout)
 
     def _writer_loop(self) -> None:
         try:
@@ -70,6 +102,9 @@ class WirePeer:
                 frame = self._outq.get()
                 if frame is None:
                     return
+                if isinstance(frame, threading.Event):
+                    frame.set()  # flush barrier: everything ahead is on the wire
+                    continue
                 self.sock.sendall(frame)
         except OSError:
             pass
@@ -88,7 +123,15 @@ class WirePeer:
     def _reader_loop(self) -> None:
         try:
             while self.alive:
-                msg_type, payload = wire.read_message(self._read_exactly)
+                # read_message() inlined so only decode_payload (the codec
+                # work) is timed — the header/body reads block on the peer
+                type_id, plen = wire.decode_frame(self._read_exactly(7))
+                body = self._read_exactly(plen)
+                t0 = perf_counter_ns()
+                msg_type, payload = wire.decode_payload(type_id, body)
+                _DEC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
+                _FRAMES_RX.inc()
+                _BYTES_RX.inc(7 + plen)
                 with self.node.lock:
                     self.node._handle(self, msg_type, payload)
         except (ConnectionError, OSError):
@@ -99,6 +142,9 @@ class WirePeer:
 
             try:
                 self.send(MSG_REJECT, str(e))
+                # the finally-close below would otherwise race the writer
+                # thread and RST the socket before the reject frame leaves
+                self.flush()
             except Exception:  # noqa: BLE001 - socket may already be gone
                 pass
         except Exception:  # noqa: BLE001 - wire boundary: malformed frames,

@@ -50,6 +50,21 @@ from kaspa_tpu.p2p.node import (
 MAGIC = b"\x4b\x54"  # "KT"
 MAX_FRAME = 1 << 30
 
+
+class WireError(Exception):
+    pass
+
+
+def _read_exact(r: io.BytesIO, n: int) -> bytes:
+    """Fixed-width read that refuses to come up short.  ``BytesIO.read``
+    silently returns fewer bytes at EOF, so a truncated adversarial frame
+    would otherwise decode into garbage values (zero hashes, flipped
+    flags) instead of being rejected at the wire boundary."""
+    buf = r.read(n)
+    if len(buf) != n:
+        raise WireError(f"truncated frame: wanted {n} bytes, got {len(buf)}")
+    return buf
+
 MSG_PING = "ping"
 MSG_PONG = "pong"
 
@@ -283,7 +298,7 @@ def _enc_smt_request(p) -> bytes:
 
 def _dec_smt_request(data: bytes) -> dict:
     r = io.BytesIO(data)
-    return {"pp": r.read(32), "offset": serde.read_varint(r)}
+    return {"pp": _read_exact(r, 32), "offset": serde.read_varint(r)}
 
 
 def _enc_smt_chunk(p) -> bytes:
@@ -311,11 +326,11 @@ def _enc_smt_chunk(p) -> bytes:
 
 def _dec_smt_chunk(data: bytes) -> dict:
     r = io.BytesIO(data)
-    active = r.read(1) == b"\x01"
+    active = _read_exact(r, 1) == b"\x01"
     meta = None
-    if r.read(1) == b"\x01":
-        lanes_root, pcd, parent = r.read(32), r.read(32), r.read(32)
-        shortcut, inactivity = r.read(32), r.read(32)
+    if _read_exact(r, 1) == b"\x01":
+        lanes_root, pcd, parent = _read_exact(r, 32), _read_exact(r, 32), _read_exact(r, 32)
+        shortcut, inactivity = _read_exact(r, 32), _read_exact(r, 32)
         meta = {
             "lanes_root": lanes_root, "pcd": pcd, "parent_seq_commit": parent,
             "shortcut_block": shortcut, "inactivity_shortcut": inactivity,
@@ -323,15 +338,15 @@ def _dec_smt_chunk(data: bytes) -> dict:
     offset = serde.read_varint(r)
     lanes = []
     for _ in range(serde.read_varint(r)):
-        lk, tip = r.read(32), r.read(32)
-        (bs,) = struct.unpack("<Q", r.read(8))
+        lk, tip = _read_exact(r, 32), _read_exact(r, 32)
+        (bs,) = struct.unpack("<Q", _read_exact(r, 8))
         lanes.append((lk, tip, bs))
     segment = [
         serde.decode_header(serde.read_bytes(r)) for _ in range(serde.read_varint(r))
     ]
     return {
         "active": active, "meta": meta, "offset": offset,
-        "lanes": lanes, "segment": segment, "done": r.read(1) == b"\x01",
+        "lanes": lanes, "segment": segment, "done": _read_exact(r, 1) == b"\x01",
     }
 
 
@@ -371,7 +386,7 @@ def _dec_bodies(data: bytes) -> list:
     r = io.BytesIO(data)
     out = []
     for _ in range(serde.read_varint(r)):
-        h = r.read(32)
+        h = _read_exact(r, 32)
         txs = [serde.decode_tx(serde.read_bytes(r)) for _ in range(serde.read_varint(r))]
         out.append((h, txs))
     return out
@@ -422,10 +437,6 @@ _CODECS = {
     MSG_HEADERS: (_enc_headers_chunk, _dec_headers_chunk),
     MSG_REJECT: (lambda s_: s_.encode(), lambda d: d.decode("utf-8", "replace")),
 }
-
-
-class WireError(Exception):
-    pass
 
 
 def encode_frame(msg_type: str, payload) -> bytes:

@@ -13,6 +13,15 @@ import threading
 from collections import deque
 from dataclasses import dataclass, field
 
+from kaspa_tpu.observability.core import REGISTRY
+
+# intake shape: how much out-of-order / duplicate traffic the deps manager
+# absorbs (IBD storms show up here before they show up as stage latency)
+_REGISTERED = REGISTRY.counter("deps_tasks_registered", help="task groups opened")
+_ABSORBED = REGISTRY.counter("deps_duplicates_absorbed", help="same-hash submissions merged into a group")
+_PARKED = REGISTRY.counter("deps_tasks_parked", help="try_begin deferrals under a pending parent")
+_RELEASED = REGISTRY.counter("deps_dependents_released", help="parked tasks rescheduled by a parent completing")
+
 
 @dataclass
 class _TaskGroup:
@@ -37,8 +46,10 @@ class BlockTaskDependencyManager:
                 g = _TaskGroup()
                 g.tasks.append(task)
                 self._pending[task_id] = g
+                _REGISTERED.inc()
                 return True
             group.tasks.append(task)
+            _ABSORBED.inc()
             return False
 
     def try_begin(self, task_id: bytes, parents_of) -> object | None:
@@ -52,6 +63,7 @@ class BlockTaskDependencyManager:
                 parent_group = self._pending.get(parent)
                 if parent_group is not None and parent != task_id:
                     parent_group.dependent_tasks.append(task_id)
+                    _PARKED.inc()
                     return None
             group.taken = True
             return group.tasks[0]
@@ -70,6 +82,7 @@ class BlockTaskDependencyManager:
             del self._pending[task_id]
             if not self._pending:
                 self._idle.notify_all()
+            _RELEASED.inc(len(group.dependent_tasks))
             return group.dependent_tasks
 
     def is_pending(self, task_id: bytes) -> bool:

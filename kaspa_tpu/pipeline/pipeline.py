@@ -29,10 +29,29 @@ from __future__ import annotations
 import threading
 from concurrent.futures import Future
 from dataclasses import dataclass
+from time import perf_counter_ns
 
 from kaspa_tpu.consensus.stores import StatusesStore
+from kaspa_tpu.observability import trace
+from kaspa_tpu.observability.core import DEFAULT_LATENCY_BUCKETS, REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.pipeline.deps_manager import BlockTaskDependencyManager
 from kaspa_tpu.utils.sync import Channel, Closed, LockCtx
+
+# queue wait vs execute split per stage — the question the round-5 bench
+# failure could not answer ("which stage stalled?")
+_Q_WAIT = REGISTRY.histogram_family(
+    "pipeline_queue_wait_seconds", "stage", DEFAULT_LATENCY_BUCKETS,
+    help="time a task sat queued before a worker picked it up",
+)
+_LOCK_WAIT = REGISTRY.histogram(
+    "pipeline_commit_lock_wait_seconds", DEFAULT_LATENCY_BUCKETS,
+    help="time stage workers waited on the ranked commit lock",
+)
+_VIRT_BATCH = REGISTRY.histogram(
+    "pipeline_virtual_batch_size", SIZE_BUCKETS,
+    help="blocks absorbed per virtual-resolution cycle",
+)
+_SUBMITTED = REGISTRY.counter("pipeline_tasks_submitted", help="blocks entered into the pipeline")
 
 
 @dataclass
@@ -40,6 +59,7 @@ class _Task:
     block: object  # Block (or header-only Block with empty txs)
     header_only: bool
     future: Future
+    enqueue_ns: int = 0  # set at submit / virtual hand-off for queue-wait spans
 
 
 class ConsensusPipeline:
@@ -76,7 +96,8 @@ class ConsensusPipeline:
         its own result.
         """
         fut: Future = Future()
-        task = _Task(block, header_only, fut)
+        task = _Task(block, header_only, fut, enqueue_ns=perf_counter_ns())
+        _SUBMITTED.inc()
         with self._idle_mu:
             self._inflight += 1
         fut.add_done_callback(self._on_done)
@@ -141,32 +162,40 @@ class ConsensusPipeline:
             task = self.deps.try_begin(task_id, lambda t: t.block.header.direct_parents())
             if task is None:
                 continue  # parked under a pending parent
+            _Q_WAIT.observe("stage", (perf_counter_ns() - task.enqueue_ns) * 1e-9)
             duplicate_status = None
             err = None
             try:
-                # GIL-releasing precompute outside the commit lock: header
-                # hash + merkle leaves hash concurrently across workers
-                blk = task.block
-                _ = blk.hash
-                if not task.header_only:
-                    for tx in blk.transactions:
-                        tx.id()
-                with self._lock:
-                    existing = consensus.storage.statuses.get(blk.hash)
-                    if existing is not None and (
-                        task.header_only or existing != StatusesStore.STATUS_HEADER_ONLY
-                    ):
-                        duplicate_status = existing  # no reprocessing
-                    else:
-                        if consensus._process_header(blk.header):
-                            consensus.counters.inc_headers()
-                        if task.header_only:
-                            consensus.storage.flush()
-                        else:
-                            consensus.counters.inc_blocks_submitted()
-                            consensus._process_body(blk)
-                            consensus.counters.inc_bodies()
-                            consensus.counters.inc_txs(len(blk.transactions))
+                with trace.span("pipeline.stage"):
+                    # GIL-releasing precompute outside the commit lock: header
+                    # hash + merkle leaves hash concurrently across workers
+                    blk = task.block
+                    with trace.span("pipeline.precompute"):
+                        _ = blk.hash
+                        if not task.header_only:
+                            for tx in blk.transactions:
+                                tx.id()
+                    t_lock = perf_counter_ns()
+                    with self._lock:
+                        _LOCK_WAIT.observe((perf_counter_ns() - t_lock) * 1e-9)
+                        with trace.span("pipeline.commit"):
+                            existing = consensus.storage.statuses.get(blk.hash)
+                            if existing is not None and (
+                                task.header_only or existing != StatusesStore.STATUS_HEADER_ONLY
+                            ):
+                                duplicate_status = existing  # no reprocessing
+                            else:
+                                with trace.span("pipeline.header"):
+                                    if consensus._process_header(blk.header):
+                                        consensus.counters.inc_headers()
+                                if task.header_only:
+                                    consensus.storage.flush()
+                                else:
+                                    consensus.counters.inc_blocks_submitted()
+                                    with trace.span("pipeline.body"):
+                                        consensus._process_body(blk)
+                                    consensus.counters.inc_bodies()
+                                    consensus.counters.inc_txs(len(blk.transactions))
             except Exception as e:
                 err = e
             # on success, hand the task to the virtual queue BEFORE releasing
@@ -174,6 +203,7 @@ class ConsensusPipeline:
             # its parent into tips/virtual resolution
             if err is None and duplicate_status is None and not task.header_only:
                 try:
+                    task.enqueue_ns = perf_counter_ns()
                     self._virtual_q.send(task)
                 except Closed:
                     err = RuntimeError("pipeline shut down")
@@ -197,15 +227,22 @@ class ConsensusPipeline:
             except Closed:
                 return
             batch = [first] + self._virtual_q.drain()
+            now = perf_counter_ns()
+            _VIRT_BATCH.observe(len(batch))
+            for task in batch:
+                _Q_WAIT.observe("virtual", (now - task.enqueue_ns) * 1e-9)
+            t_lock = perf_counter_ns()
             with self._lock:
+                _LOCK_WAIT.observe((perf_counter_ns() - t_lock) * 1e-9)
                 try:
-                    for task in batch:
-                        consensus.notification_root.notify_block_added(task.block)
-                        consensus._update_tips(task.block.hash)
-                    # one virtual resolution absorbs the whole cycle: chain
-                    # verification batches signatures across these blocks
-                    consensus._resolve_virtual()
-                    consensus.storage.flush()
+                    with trace.span("pipeline.virtual", batch=len(batch)):
+                        for task in batch:
+                            consensus.notification_root.notify_block_added(task.block)
+                            consensus._update_tips(task.block.hash)
+                        # one virtual resolution absorbs the whole cycle: chain
+                        # verification batches signatures across these blocks
+                        consensus._resolve_virtual()
+                        consensus.storage.flush()
                 except Exception as e:
                     for task in batch:
                         if not task.future.done():

@@ -25,6 +25,7 @@ from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.mempool.mempool import MempoolError
 from kaspa_tpu.metrics import PerfMonitor
 from kaspa_tpu.notify.notifier import Notifier
+from kaspa_tpu.observability import snapshot as observability_snapshot
 from kaspa_tpu.utils.sync import lock_trace_snapshot as _lock_trace_snapshot
 
 
@@ -285,7 +286,18 @@ class RpcCoreService:
                 if self.metrics_provider is not None and (snap := self.metrics_provider()) is not None
                 else None
             ),
+            # span/histogram/counter registry (observability/core): per-stage
+            # pipeline latencies, secp batch occupancy, jit compile counts,
+            # store cache hit rates — the same tree prom.render() exports
+            "observability": observability_snapshot(),
         }
+
+    def get_metrics_prometheus(self) -> str:
+        """The observability registry in Prometheus text exposition format
+        (the reference daemon's --prometheus endpoint analog)."""
+        from kaspa_tpu.observability import prom
+
+        return prom.render()
 
     # --- node info / misc (rpc.rs ping/get_info/get_current_network/...) ---
 

@@ -20,8 +20,16 @@ from dataclasses import dataclass, field
 
 from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.crypto import secp
+from kaspa_tpu.observability import trace
+from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.txscript import standard
 from kaspa_tpu.txscript.caches import SigCache
+
+# fast-path vs fallback mix: a fallback-heavy workload starves the device
+# batch, which is the first thing to check when occupancy drops
+_JOBS = REGISTRY.counter_family("txscript_batch_jobs", "kind", help="signature jobs queued for device dispatch")
+_SIGCACHE_SKIPS = REGISTRY.counter("txscript_batch_sigcache_skips", help="jobs answered by the sig cache pre-dispatch")
+_VM_FALLBACKS = REGISTRY.counter("txscript_vm_fallbacks", help="inputs routed to the host VM instead of the batch")
 
 
 class ScriptCheckError(Exception):
@@ -104,6 +112,7 @@ class BatchScriptChecker:
             # non-fast-path scripts go through the host VM
             if self.vm_fallback is None:
                 raise ScriptCheckError(f"unsupported script class {cls.value} (VM fallback not wired)", i)
+            _VM_FALLBACKS.inc()
             try:
                 self.vm_fallback(tx, utxo_entries, i, reused, pov_daa_score, seq_commit_accessor=seq_commit_accessor)
             except Exception as e:  # VM raises on invalid script
@@ -113,9 +122,11 @@ class BatchScriptChecker:
         cache_key = (kind, sig, msg, pubkey)
         cached = self.sig_cache.get(cache_key)
         if cached is not None:
+            _SIGCACHE_SKIPS.inc()
             if not cached:
                 self._fail(token, ScriptCheckError("invalid signature (cached)", input_index))
             return
+        _JOBS.inc(kind)
 
         def cb(ok: bool, token=token, input_index=input_index):
             if not ok:
@@ -129,12 +140,14 @@ class BatchScriptChecker:
         schnorr = [j for j in self._jobs if j.kind == "schnorr"]
         ecdsa = [j for j in self._jobs if j.kind == "ecdsa"]
         if schnorr:
-            mask = secp.schnorr_verify_batch([(j.pubkey, j.msg, j.sig) for j in schnorr])
+            with trace.span("txscript.dispatch", kind="schnorr", jobs=len(schnorr)):
+                mask = secp.schnorr_verify_batch([(j.pubkey, j.msg, j.sig) for j in schnorr])
             for j, ok in zip(schnorr, mask):
                 self.sig_cache.insert(j.cache_key, bool(ok))
                 j.callback(bool(ok))
         if ecdsa:
-            mask = secp.ecdsa_verify_batch([(j.pubkey, j.msg, j.sig) for j in ecdsa])
+            with trace.span("txscript.dispatch", kind="ecdsa", jobs=len(ecdsa)):
+                mask = secp.ecdsa_verify_batch([(j.pubkey, j.msg, j.sig) for j in ecdsa])
             for j, ok in zip(ecdsa, mask):
                 self.sig_cache.insert(j.cache_key, bool(ok))
                 j.callback(bool(ok))

@@ -28,7 +28,16 @@ import hashlib
 from dataclasses import dataclass as _dataclass
 
 from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.observability.core import DEFAULT_LATENCY_BUCKETS, REGISTRY
 from kaspa_tpu.txscript.caches import SigCache
+
+# host-VM pressure: how often validation leaves the device fast path and
+# how long each general-script execution costs
+_VM_EXECUTIONS = REGISTRY.counter("txscript_vm_executions", help="full input executions on the host VM")
+_VM_ERRORS = REGISTRY.counter("txscript_vm_errors", help="host VM executions rejecting the input")
+_VM_EXEC_TIME = REGISTRY.histogram(
+    "txscript_vm_execute_seconds", DEFAULT_LATENCY_BUCKETS, help="wall time per host-VM input execution"
+)
 
 MAX_STACK_SIZE = 244
 MAX_SCRIPTS_SIZE = 10_000
@@ -314,6 +323,19 @@ class TxScriptEngine:
 
     def execute(self) -> None:
         """Full input execution: sig script, spk, optional p2sh redeem."""
+        from time import perf_counter_ns
+
+        _VM_EXECUTIONS.inc()
+        t0 = perf_counter_ns()
+        try:
+            self._execute_inner()
+        except Exception:
+            _VM_ERRORS.inc()
+            raise
+        finally:
+            _VM_EXEC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
+
+    def _execute_inner(self) -> None:
         from kaspa_tpu.txscript import standard
 
         entry = self.utxo_entries[self.input_index]

@@ -59,6 +59,47 @@ def test_cltv_script_via_builder_executes():
     TxScriptEngine(tx, [entry], 0).execute()
 
 
+def test_vm_execution_counters():
+    """Engine runs tick the observability counters (success and error)."""
+    from kaspa_tpu.txscript import vm as vm_mod
+
+    execs, errors = vm_mod._VM_EXECUTIONS.value, vm_mod._VM_ERRORS.value
+    base_time = vm_mod._VM_EXEC_TIME.count
+    script = ScriptBuilder().add_op(0x51).script()  # OP_TRUE
+    TxScriptEngine().execute_script(script, verify_only_push=False)
+    # execute_script is the low-level path; the counters wrap execute()
+    from kaspa_tpu.consensus.model import (
+        ComputeCommit,
+        ScriptPublicKey,
+        Transaction,
+        TransactionInput,
+        TransactionOutpoint,
+        UtxoEntry,
+    )
+    from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE
+
+    tx = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(b"\x01" * 32, 0), b"", 0, ComputeCommit.sigops(0))],
+        [],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    entry = UtxoEntry(10, ScriptPublicKey(0, script), 0, False)
+    TxScriptEngine(tx, [entry], 0).execute()
+    assert vm_mod._VM_EXECUTIONS.value == execs + 1
+    assert vm_mod._VM_EXEC_TIME.count == base_time + 1
+    assert vm_mod._VM_ERRORS.value == errors
+    bad = ScriptBuilder().add_op(0x00).script()  # OP_FALSE -> final stack false
+    entry_bad = UtxoEntry(10, ScriptPublicKey(0, bad), 0, False)
+    with pytest.raises(Exception):
+        TxScriptEngine(tx, [entry_bad], 0).execute()
+    assert vm_mod._VM_ERRORS.value == errors + 1
+    assert vm_mod._VM_EXECUTIONS.value == execs + 2
+
+
 def test_perf_monitor_samples():
     mon = PerfMonitor()
     m = mon.sample()

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Replay a captured span log into a per-stage flame summary.
+
+Input: a JSONL span log written by ``kaspa_tpu.observability.trace.dump``
+(one span dict per line: name/path/start_us/dur_us/thread/depth/attrs), or
+a JSON document embedding such a list under an ``observability`` /
+``spans`` key — e.g. a bench.py result line or a BENCH_*.json entry whose
+``tail`` carries the snapshot.
+
+Output: a path-aggregated flame table (total vs self time, counts,
+mean/max) plus the slowest individual spans — enough to answer "which
+stage stalled" when a bench reports 0.0 verifies/sec:
+
+    python tools/trace_report.py /tmp/spans.jsonl
+    python tools/trace_report.py BENCH_r06.json --top 15
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _find_spans(obj) -> list | None:
+    """Depth-first hunt for a list of span dicts inside a JSON document."""
+    if isinstance(obj, list):
+        if obj and isinstance(obj[0], dict) and "dur_us" in obj[0] and ("path" in obj[0] or "name" in obj[0]):
+            return obj
+        for item in obj:
+            found = _find_spans(item)
+            if found is not None:
+                return found
+        return None
+    if isinstance(obj, dict):
+        for key in ("spans", "observability", "tail"):
+            if key in obj:
+                found = _find_spans(obj[key])
+                if found is not None:
+                    return found
+        for v in obj.values():
+            found = _find_spans(v)
+            if found is not None:
+                return found
+    return None
+
+
+def load_spans(path: str) -> list[dict]:
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if doc is not None:
+        spans = _find_spans(doc)
+        if spans is None:
+            raise SystemExit(f"{path}: JSON document contains no span list")
+        return spans
+    spans = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        spans.append(json.loads(line))
+    return spans
+
+
+def aggregate(spans: list[dict]) -> dict[str, dict]:
+    """Per-path totals; self time = total minus direct children's total."""
+    agg: dict[str, dict] = {}
+    for s in spans:
+        path = s.get("path") or s.get("name", "?")
+        a = agg.setdefault(path, {"count": 0, "total_us": 0.0, "max_us": 0.0})
+        dur = float(s.get("dur_us", 0.0))
+        a["count"] += 1
+        a["total_us"] += dur
+        if dur > a["max_us"]:
+            a["max_us"] = dur
+    for path, a in agg.items():
+        child_total = sum(
+            other["total_us"]
+            for opath, other in agg.items()
+            if opath.startswith(path + "/") and "/" not in opath[len(path) + 1 :]
+        )
+        a["self_us"] = max(0.0, a["total_us"] - child_total)
+    return agg
+
+
+def _ms(us: float) -> str:
+    return f"{us / 1000.0:10.3f}"
+
+
+def render_report(spans: list[dict], top: int = 10) -> str:
+    if not spans:
+        return "no spans in input\n"
+    agg = aggregate(spans)
+    lines = [f"{len(spans)} spans over {len(agg)} stages", ""]
+    lines.append(f"{'stage (path)':<52} {'count':>7} {'total ms':>10} {'self ms':>10} {'mean ms':>9} {'max ms':>10}")
+    lines.append("-" * 102)
+    # flame ordering: depth-first by path so children sit under parents,
+    # roots sorted by total time descending
+    roots = sorted(
+        (p for p in agg if "/" not in p), key=lambda p: -agg[p]["total_us"]
+    )
+
+    def emit(path: str, indent: int) -> None:
+        a = agg[path]
+        label = ("  " * indent) + path.rsplit("/", 1)[-1]
+        mean = a["total_us"] / a["count"]
+        lines.append(
+            f"{label:<52} {a['count']:>7} {_ms(a['total_us'])} {_ms(a['self_us'])} "
+            f"{mean / 1000.0:>9.3f} {_ms(a['max_us'])}"
+        )
+        children = sorted(
+            (
+                p
+                for p in agg
+                if p.startswith(path + "/") and "/" not in p[len(path) + 1 :]
+            ),
+            key=lambda p: -agg[p]["total_us"],
+        )
+        for c in children:
+            emit(c, indent + 1)
+
+    for r in roots:
+        emit(r, 0)
+    lines.append("")
+    lines.append(f"slowest {top} spans:")
+    slowest = sorted(spans, key=lambda s: -float(s.get("dur_us", 0.0)))[:top]
+    for s in slowest:
+        attrs = s.get("attrs") or {}
+        attr_txt = " ".join(f"{k}={v}" for k, v in attrs.items())
+        lines.append(
+            f"  {float(s.get('dur_us', 0.0)) / 1000.0:10.3f} ms  {s.get('path', s.get('name', '?')):<40}"
+            f" [{s.get('thread', '?')}] {attr_txt}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="per-stage flame summary from a span log")
+    ap.add_argument("log", help="span JSONL file or JSON document embedding a span list")
+    ap.add_argument("--top", type=int, default=10, help="slowest individual spans to list")
+    args = ap.parse_args(argv)
+    sys.stdout.write(render_report(load_spans(args.log), top=args.top))
+
+
+if __name__ == "__main__":
+    main()
