@@ -71,6 +71,29 @@ def _child_probe(timeout_s: float) -> bool:
     return bool(ok)
 
 
+def _child_probe_main() -> None:
+    """Probe-only child (KASPA_TPU_BENCH_MODE=probe): one trivial jit,
+    one JSON line, exit 0/3.  The parent's session-start probe and
+    tools/roundcheck.py both run this in a fresh interpreter so a wedged
+    PJRT client dies with the child, never with the caller."""
+    from kaspa_tpu.utils import jax_setup
+
+    jax_setup.setup()
+    t0 = time.perf_counter()
+    ok = _child_probe(PROBE_TIMEOUT_S)
+    print(
+        json.dumps(
+            {
+                "probe_ok": ok,
+                "elapsed_s": round(time.perf_counter() - t0, 3),
+                "platform": os.environ.get("JAX_PLATFORMS", ""),
+            }
+        )
+    )
+    sys.stdout.flush()
+    os._exit(0 if ok else 3)
+
+
 def _gen_unique_batch(b: int):
     """b distinct BIP340 (pubkey, msg, sig) triples via incremental points.
 
@@ -245,10 +268,123 @@ def _run_attempt(timeout_s: float) -> tuple[dict | None, str, dict | None]:
     return None, f"child exited rc={proc.returncode} without a result line", None
 
 
+def _utc_stamp(compact: bool = True) -> str:
+    fmt = "%Y%m%dT%H%M%SZ" if compact else "%Y-%m-%dT%H:%M:%SZ"
+    return time.strftime(fmt, time.gmtime())
+
+
+def _run_json_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
+    """Fresh subprocess -> last JSON line on stdout (None on hang/garbage)."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except Exception:
+            pass
+        return None, f"killed after {timeout_s:.0f}s"
+    for line in reversed((out or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), f"rc={proc.returncode}"
+            except json.JSONDecodeError:
+                continue
+    return None, f"rc={proc.returncode}, no JSON line"
+
+
+def _session_probe(log: list) -> bool:
+    """Session-start device probe: trivial jit in a fresh child, hard
+    parent-side timeout.  Every step lands in ``log`` with a UTC stamp so a
+    wedge leaves a trail instead of a silent death."""
+    timeout_s = PROBE_TIMEOUT_S + 30  # child gets PROBE_TIMEOUT_S; +30 for interpreter spin-up
+    log.append({"t": _utc_stamp(), "event": "session_probe_start", "timeout_s": timeout_s})
+    obj, note = _run_json_child(
+        {"KASPA_TPU_BENCH_CHILD": "1", "KASPA_TPU_BENCH_MODE": "probe"}, timeout_s
+    )
+    ok = bool(obj and obj.get("probe_ok"))
+    log.append({"t": _utc_stamp(), "event": "session_probe_result", "ok": ok, "note": note, "child": obj})
+    return ok
+
+
+def _cpu_fallback(log: list) -> dict | None:
+    """Wedge path: rerun the workload on the CPU XLA backend (reduced batch)
+    so the dossier carries real throughput numbers, not just a corpse."""
+    b = int(os.environ.get("KASPA_TPU_BENCH_FALLBACK_B", "1024"))
+    log.append({"t": _utc_stamp(), "event": "cpu_fallback_start", "batch": b})
+    obj, note = _run_json_child(
+        {"KASPA_TPU_BENCH_CHILD": "1", "JAX_PLATFORMS": "cpu", "KASPA_TPU_BENCH_B": str(b)},
+        ATTEMPT_TIMEOUT_S,
+    )
+    if obj is not None:
+        obj.pop("observability", None)  # the dossier wants numbers, not span dumps
+    log.append({"t": _utc_stamp(), "event": "cpu_fallback_result", "note": note, "result": obj})
+    return obj
+
+
+def _write_wedge_dossier(probe_log: list, fallback: dict | None) -> str:
+    """Timestamped evidence file for a wedged device session."""
+    out_dir = os.environ.get("KASPA_TPU_BENCH_DOSSIER_DIR", ".")
+    path = os.path.join(out_dir, f"bench_wedge_{_utc_stamp()}.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "created": _utc_stamp(compact=False),
+                "reason": "device probe wedge at session start",
+                "metric": METRIC,
+                "batch": B,
+                "probe_log": probe_log,
+                "cpu_fallback": fallback,
+            },
+            f,
+            indent=2,
+        )
+    return path
+
+
 def main() -> None:
     if os.environ.get("KASPA_TPU_BENCH_CHILD"):
-        _child_main()
+        if os.environ.get("KASPA_TPU_BENCH_MODE") == "probe":
+            _child_probe_main()
+        else:
+            _child_main()
         return  # unreachable (child exits)
+
+    # session-start probe: a dead backend is diagnosed in ~2 min with a
+    # dossier on disk, instead of burning the whole attempt budget first
+    probe_log: list = []
+    probe_ok = _session_probe(probe_log)
+    if "--probe" in sys.argv[1:]:
+        print(json.dumps({"probe_ok": probe_ok, "log": probe_log}))
+        sys.exit(0 if probe_ok else 1)
+    if not probe_ok:
+        fallback = _cpu_fallback(probe_log)
+        dossier = _write_wedge_dossier(probe_log, fallback)
+        fb_value = float(fallback.get("value", 0.0)) if fallback else 0.0
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": 0.0,
+                    "unit": UNIT,
+                    "vs_baseline": 0.0,
+                    "error": "device probe wedged at session start (see wedge dossier)",
+                    "wedge_dossier": dossier,
+                    "cpu_fallback_value": fb_value,
+                }
+            )
+        )
+        return
 
     deadline = time.monotonic() + TOTAL_BUDGET_S
     notes: list[str] = []

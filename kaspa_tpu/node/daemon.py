@@ -51,6 +51,12 @@ def parse_args(argv=None) -> DaemonArgs:
         help="crash-safe consensus persistence under <appdir>/consensus.db (restart resumes)",
     )
     p.add_argument("--listen", default=None, help="host:port for the P2P wire (omit to disable inbound P2P)")
+    p.add_argument(
+        "--p2p-proto",
+        action="store_true",
+        help="speak the reference-compatible protobuf/gRPC P2P wire instead of the custom frame codec "
+        "(both ends of a connection must use the same wire)",
+    )
     p.add_argument("--upnp", action="store_true", help="map the P2P listen port on the internet gateway via UPnP")
     p.add_argument("--stratum", default=None, help="host:port for the stratum bridge (omit to disable)")
     p.add_argument("--stratum-pay-address", default=None, help="address stratum block templates pay to")
@@ -340,6 +346,7 @@ class Daemon:
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
         self.p2p_server = None
+        self.p2p_wire = "proto" if getattr(args, "p2p_proto", False) else "custom"
 
         # service runtime (core/src/core.rs): ordered start, reverse-order
         # stop, periodic metrics sampling on the tick service
@@ -365,6 +372,9 @@ class Daemon:
                 self.metrics_data.push(
                     collect_snapshot(self.consensus, self.mining, self.perf_monitor, p2p_node=self.node)
                 )
+                # piggyback cache hygiene on the metrics cadence: drops the
+                # pruning-point SMT snapshot once stale (anchor moved or idle)
+                self.node.prune_caches()
             from kaspa_tpu.observability import prom
 
             self.prom_text = prom.render()
@@ -620,13 +630,15 @@ class Daemon:
 
     def _start_p2p_service(self, _core) -> list:
         if getattr(self.args, "listen", None):
-            from kaspa_tpu.p2p.transport import P2PServer
+            from kaspa_tpu.p2p.transport import P2PServer, get_codec
 
             lhost, lport = self.args.listen.rsplit(":", 1)
-            self.p2p_server = P2PServer(self.node, lhost, int(lport), address_manager=self.address_manager)
+            self.p2p_server = P2PServer(
+                self.node, lhost, int(lport), address_manager=self.address_manager, codec=get_codec(self.p2p_wire)
+            )
             self.p2p_server.start()
             self.node.listen_port = int(self.p2p_server.address.rsplit(":", 1)[1])
-            self.log.info("P2P listening on %s", self.p2p_server.address)
+            self.log.info("P2P listening on %s (%s wire)", self.p2p_server.address, self.p2p_wire)
             if getattr(self.args, "upnp", False):
                 self._start_upnp(self.node.listen_port)
         self.connection_manager.start()
@@ -752,9 +764,9 @@ class Daemon:
     def connect_peer(self, address: str):
         """Dial a peer over the wire and catch up from it (IBD)."""
         from kaspa_tpu.p2p.address_manager import NetAddress
-        from kaspa_tpu.p2p.transport import connect_outbound
+        from kaspa_tpu.p2p.transport import connect_outbound, get_codec
 
-        peer = connect_outbound(self.node, address)
+        peer = connect_outbound(self.node, address, codec=get_codec(self.p2p_wire))
         # register the RESOLVED address (getpeername) so the connection
         # manager's connected-set comparison matches and never re-dials
         na = getattr(peer, "peer_address", None)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
+from time import monotonic as _monotonic
 
 from kaspa_tpu.consensus.consensus import Consensus, RuleError
 from kaspa_tpu.consensus.stores import StatusesStore
@@ -86,6 +87,14 @@ _MSG_MIN_VERSION = {
 # one day before Toccata activation upgraded nodes stop accepting outdated
 # peers (flow_context.rs:827-838)
 _ACTIVATION_GATE_SECONDS = 24 * 60 * 60
+
+# serve-side SMT snapshot lifetime (prune_caches): a snapshot nobody has
+# requested for the TTL is dead weight (it holds the full lane/segment
+# export); one whose anchor the local pruning point has moved past gets a
+# shorter grace so a receiver mid-page (which refreshes last-use every
+# chunk request) can finish, but an abandoned transfer cannot pin it
+SMT_SNAPSHOT_TTL_SECONDS = 300.0
+SMT_SNAPSHOT_STALE_GRACE_SECONDS = 60.0
 
 
 def _activation_gate_blocks(target_time_per_block_ms: int) -> int:
@@ -179,6 +188,31 @@ class Node:
         if cached is not None:
             self._ibd_pipeline = None
             cached[1].shutdown()
+
+    def prune_caches(self, now: float | None = None) -> None:
+        """Drop serve-side IBD snapshots that outlived their usefulness.
+
+        Called under ``self.lock`` (SMT request handler + the daemon's
+        metrics tick).  The SMT snapshot ``(anchor_pp, state, last_use)``
+        dies when idle past SMT_SNAPSHOT_TTL_SECONDS, or — once the local
+        pruning point has advanced past its anchor — after the shorter
+        stale grace (an active receiver refreshes last_use every chunk
+        request and finishes; an abandoned transfer cannot pin the export
+        forever).  The UTXO snapshot is keyed to the live pruning point
+        only, so it drops as soon as the anchor moves.
+        """
+        now = _monotonic() if now is None else now
+        pp = self.consensus.pruning_processor.pruning_point
+        snap = getattr(self, "_pp_smt_snapshot", None)
+        if snap is not None:
+            # tests prime bare (pp, state) snapshots; treat those as fresh
+            anchor, last_use = snap[0], (snap[2] if len(snap) > 2 else now)
+            limit = SMT_SNAPSHOT_TTL_SECONDS if anchor == pp else SMT_SNAPSHOT_STALE_GRACE_SECONDS
+            if now - last_use > limit:
+                self._pp_smt_snapshot = None
+        usnap = getattr(self, "_pp_utxo_snapshot", None)
+        if usnap is not None and usnap[0] != pp:
+            self._pp_utxo_snapshot = None
 
     # --- hub / relay (flow_context.rs on_new_block -> broadcast) ---
 
@@ -453,6 +487,7 @@ class Node:
             # a mid-IBD local pruning advance must not switch snapshots under
             # a receiver still paging the old state
             req_pp = payload["pp"]
+            self.prune_caches()  # expired snapshots never serve another chunk
             cached = getattr(self, "_pp_smt_snapshot", None)
             if cached is None or cached[0] != req_pp:
                 if req_pp != self.consensus.pruning_processor.pruning_point:
@@ -462,7 +497,10 @@ class Node:
                         {"active": False, "meta": None, "offset": 0, "lanes": [], "segment": [], "done": True},
                     )
                     return
-                self._pp_smt_snapshot = cached = (req_pp, self.consensus.export_pp_lane_state())
+                cached = (req_pp, self.consensus.export_pp_lane_state(), _monotonic())
+            else:
+                cached = (cached[0], cached[1], _monotonic())  # refresh last-use
+            self._pp_smt_snapshot = cached
             state = cached[1]
             if state is None:
                 peer.send(

@@ -1,0 +1,28 @@
+"""Reference-compatible protobuf P2P wire (protocol/p2p/proto).
+
+Layers (bottom up):
+
+- ``wire_format``: dependency-free protobuf wire-format engine — varint,
+  zigzag, tag/wire-type framing, length-delimited fields, descriptor-driven
+  message encode/decode with unknown-field skip.
+- ``schema``: the vendored message-schema table mirroring the reference's
+  ``messages.proto``/``p2p.proto`` payload set (KaspadMessage oneof).
+- ``codec``: model objects (Header/Transaction/Block/TrustedData...) <->
+  proto dicts <-> KaspadMessage bytes, plus the tier-version mapping.
+- ``framing``: the gRPC-style 5-byte message prefix the reference's tonic
+  stack puts around every KaspadMessage on the socket.
+
+The transport binding (``GrpcProtoCodec``) lives in ``p2p/transport.py``
+next to the custom-frame codec; both speak to the same flow layer.
+"""
+
+from kaspa_tpu.p2p.proto.codec import (  # noqa: F401
+    ProtoError,
+    decode_kaspad_message,
+    encode_kaspad_message,
+)
+from kaspa_tpu.p2p.proto.framing import (  # noqa: F401
+    GRPC_FRAME_OVERHEAD,
+    encode_grpc_frame,
+    read_grpc_frame,
+)

@@ -1,0 +1,49 @@
+"""gRPC message framing (the tonic layer under the reference's P2P).
+
+The reference node's peers exchange `KaspadMessage`s over a bidirectional
+gRPC stream; on the wire each message rides a 5-byte gRPC frame prefix:
+
+    compressed-flag(1) | message-length(4, BIG-endian) | message
+
+(gRPC "Length-Prefixed-Message", the HTTP/2 DATA payload layout).  This
+module is that framing over our existing socket transport — an HTTP/2-lite
+wire: the stream framing is byte-identical to what tonic puts inside DATA
+frames, without the surrounding HTTP/2 connection machinery, which the
+transport layer (TCP + reader/writer threads) already provides.
+
+Compression is never used by the reference P2P and is refused here.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from kaspa_tpu.p2p.proto.wire_format import ProtoWireError
+
+GRPC_FRAME_OVERHEAD = 5
+MAX_GRPC_MESSAGE = 1 << 30  # same bound as the custom wire's MAX_FRAME
+
+
+def encode_grpc_frame(message: bytes) -> bytes:
+    if len(message) > MAX_GRPC_MESSAGE:
+        raise ProtoWireError(f"oversized gRPC message {len(message)}")
+    return b"\x00" + struct.pack(">I", len(message)) + message
+
+
+def decode_grpc_prefix(prefix: bytes) -> int:
+    """5-byte gRPC prefix -> message length; refuses compressed frames."""
+    if len(prefix) != GRPC_FRAME_OVERHEAD:
+        raise ProtoWireError(f"short gRPC prefix ({len(prefix)} bytes)")
+    if prefix[0] & 0x01:
+        raise ProtoWireError("compressed gRPC frames are not supported")
+    if prefix[0] & ~0x01:
+        raise ProtoWireError(f"reserved gRPC flag bits set ({prefix[0]:#x})")
+    (n,) = struct.unpack(">I", prefix[1:5])
+    if n > MAX_GRPC_MESSAGE:
+        raise ProtoWireError(f"oversized gRPC message {n}")
+    return n
+
+
+def read_grpc_frame(read_exactly) -> bytes:
+    """Read one length-prefixed message via ``read_exactly(n) -> bytes``."""
+    return read_exactly(decode_grpc_prefix(read_exactly(GRPC_FRAME_OVERHEAD)))

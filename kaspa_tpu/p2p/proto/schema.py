@@ -1,0 +1,312 @@
+"""Vendored message-schema table (protocol/p2p/proto/{p2p,messages}.proto).
+
+Each descriptor mirrors the reference proto definition: same field numbers,
+same scalar kinds, same nesting — so bytes we emit parse in a prost/tonic
+stack and vice versa.  A descriptor is::
+
+    {"name": str, "fields": {field_number: (name, kind, repeated, nested)}}
+
+with kinds from wire_format (uint32/uint64/int64/sint64/bool/bytes/string/
+message).
+
+Two deliberate deviations, both riding protobuf's unknown-field rule so a
+reference decoder simply skips them:
+
+- **Extension fields** numbered >= 1000 inside reference messages carry
+  payload our flows need but the reference schema lacks (chunk offsets and
+  done flags where the reference streams separate control messages;
+  ComputeCommit budgets and covenants from the local consensus extensions).
+- **Extension payloads** numbered >= 1000 in the ``KaspadMessage`` oneof
+  carry whole message types with no reference analog (KIP-21 SMT state,
+  the chunked IBD block stream, trusted-data blobs).
+
+Everything in the reference-numbered range is structurally faithful.
+"""
+
+from __future__ import annotations
+
+
+def _msg(name: str, *fields) -> dict:
+    return {
+        "name": name,
+        "fields": {num: (fname, kind, repeated, nested) for num, fname, kind, repeated, nested in fields},
+    }
+
+
+def _f(num: int, name: str, kind: str, repeated: bool = False, message: dict | None = None):
+    return (num, name, kind, repeated, message)
+
+
+# -- shared leaf messages (p2p.proto) --------------------------------------
+
+HASH = _msg("Hash", _f(1, "bytes", "bytes"))
+TRANSACTION_ID = _msg("TransactionId", _f(1, "bytes", "bytes"))
+SUBNETWORK_ID = _msg("SubnetworkId", _f(1, "bytes", "bytes"))
+
+NET_ADDRESS = _msg(
+    "NetAddress",
+    _f(1, "timestamp", "int64"),
+    _f(3, "ip", "bytes"),
+    _f(4, "port", "uint32"),
+)
+
+OUTPOINT = _msg(
+    "Outpoint",
+    _f(1, "transactionId", "message", message=TRANSACTION_ID),
+    _f(2, "index", "uint32"),
+)
+
+SCRIPT_PUBLIC_KEY = _msg(
+    "ScriptPublicKey",
+    _f(1, "script", "bytes"),
+    _f(2, "version", "uint32"),
+)
+
+# covenantId is a local consensus extension (tx.rs Covenant) — ext field
+UTXO_ENTRY = _msg(
+    "UtxoEntry",
+    _f(1, "amount", "uint64"),
+    _f(2, "scriptPublicKey", "message", message=SCRIPT_PUBLIC_KEY),
+    _f(3, "blockDaaScore", "uint64"),
+    _f(4, "isCoinbase", "bool"),
+    _f(1000, "covenantId", "bytes"),
+)
+
+OUTPOINT_AND_UTXO_ENTRY_PAIR = _msg(
+    "OutpointAndUtxoEntryPair",
+    _f(1, "outpoint", "message", message=OUTPOINT),
+    _f(2, "utxoEntry", "message", message=UTXO_ENTRY),
+)
+
+COVENANT = _msg(
+    "Covenant",
+    _f(1, "authorizingInput", "uint32"),
+    _f(2, "covenantId", "bytes"),
+)
+
+TRANSACTION_INPUT = _msg(
+    "TransactionInput",
+    _f(1, "previousOutpoint", "message", message=OUTPOINT),
+    _f(2, "signatureScript", "bytes"),
+    _f(3, "sequence", "uint64"),
+    _f(4, "sigOpCount", "uint32"),
+    # v1+ txs carry a compute budget instead of a sig-op count
+    # (ComputeCommit, tx.rs:71-97) — extension field
+    _f(1000, "computeBudget", "uint32"),
+)
+
+TRANSACTION_OUTPUT = _msg(
+    "TransactionOutput",
+    _f(1, "value", "uint64"),
+    _f(2, "scriptPublicKey", "message", message=SCRIPT_PUBLIC_KEY),
+    _f(1000, "covenant", "message", message=COVENANT),
+)
+
+TRANSACTION = _msg(
+    "TransactionMessage",
+    _f(1, "version", "uint32"),
+    _f(2, "inputs", "message", repeated=True, message=TRANSACTION_INPUT),
+    _f(3, "outputs", "message", repeated=True, message=TRANSACTION_OUTPUT),
+    _f(4, "lockTime", "uint64"),
+    _f(5, "subnetworkId", "message", message=SUBNETWORK_ID),
+    _f(6, "gas", "uint64"),
+    _f(8, "payload", "bytes"),
+    _f(9, "mass", "uint64"),  # KIP-9 committed storage mass
+)
+
+BLOCK_LEVEL_PARENTS = _msg(
+    "BlockLevelParents",
+    _f(1, "parentHashes", "message", repeated=True, message=HASH),
+)
+
+BLOCK_HEADER = _msg(
+    "BlockHeader",
+    _f(1, "version", "uint32"),
+    _f(3, "hashMerkleRoot", "message", message=HASH),
+    _f(4, "acceptedIdMerkleRoot", "message", message=HASH),
+    _f(5, "utxoCommitment", "message", message=HASH),
+    _f(6, "timestamp", "int64"),
+    _f(7, "bits", "uint32"),
+    _f(8, "nonce", "uint64"),
+    _f(9, "daaScore", "uint64"),
+    _f(10, "blueWork", "bytes"),  # minimal big-endian Uint192
+    _f(12, "parents", "message", repeated=True, message=BLOCK_LEVEL_PARENTS),
+    _f(13, "blueScore", "uint64"),
+    _f(14, "pruningPoint", "message", message=HASH),
+)
+
+BLOCK = _msg(
+    "BlockMessage",
+    _f(1, "header", "message", message=BLOCK_HEADER),
+    _f(2, "transactions", "message", repeated=True, message=TRANSACTION),
+)
+
+# -- handshake / control ---------------------------------------------------
+
+VERSION = _msg(
+    "VersionMessage",
+    _f(1, "protocolVersion", "uint32"),
+    _f(2, "services", "uint64"),
+    _f(3, "timestamp", "int64"),
+    _f(4, "address", "message", message=NET_ADDRESS),
+    _f(5, "id", "bytes"),
+    _f(6, "userAgent", "string"),
+    _f(8, "disableRelayTx", "bool"),
+    _f(9, "subnetworkId", "message", message=SUBNETWORK_ID),
+    _f(10, "network", "string"),
+)
+
+VERACK = _msg("VerackMessage")
+PING = _msg("PingMessage", _f(1, "nonce", "uint64"))
+PONG = _msg("PongMessage", _f(1, "nonce", "uint64"))
+REJECT = _msg("RejectMessage", _f(1, "reason", "string"))
+
+REQUEST_ADDRESSES = _msg(
+    "RequestAddressesMessage",
+    _f(1, "includeAllSubnetworks", "bool"),
+    _f(2, "subnetworkId", "message", message=SUBNETWORK_ID),
+)
+ADDRESSES = _msg(
+    "AddressesMessage",
+    _f(1, "addressList", "message", repeated=True, message=NET_ADDRESS),
+)
+
+# -- relay -----------------------------------------------------------------
+
+INV_RELAY_BLOCK = _msg("InvRelayBlockMessage", _f(1, "hash", "message", message=HASH))
+REQUEST_RELAY_BLOCKS = _msg(
+    "RequestRelayBlocksMessage", _f(1, "hashes", "message", repeated=True, message=HASH)
+)
+INV_TRANSACTIONS = _msg(
+    "InvTransactionsMessage", _f(1, "ids", "message", repeated=True, message=TRANSACTION_ID)
+)
+REQUEST_TRANSACTIONS = _msg(
+    "RequestTransactionsMessage", _f(1, "ids", "message", repeated=True, message=TRANSACTION_ID)
+)
+
+# -- IBD -------------------------------------------------------------------
+
+# reference streams headers with separate RequestNextHeaders/DoneHeaders
+# control messages; our flow layer rides done/continuation on the chunk
+# itself — extension fields a reference decoder skips
+REQUEST_HEADERS = _msg(
+    "RequestHeadersMessage",
+    _f(1, "lowHash", "message", message=HASH),
+    _f(2, "highHash", "message", message=HASH),
+)
+BLOCK_HEADERS = _msg(
+    "BlockHeadersMessage",
+    _f(1, "blockHeaders", "message", repeated=True, message=BLOCK_HEADER),
+    _f(1000, "done", "bool"),
+    _f(1001, "continuation", "bytes"),
+)
+
+REQUEST_PP_PROOF = _msg("RequestPruningPointProofMessage")
+PP_PROOF_HEADER_ARRAY = _msg(
+    "PruningPointProofHeaderArray",
+    _f(1, "headers", "message", repeated=True, message=BLOCK_HEADER),
+)
+PP_PROOF = _msg(
+    "PruningPointProofMessage",
+    _f(1, "headers", "message", repeated=True, message=PP_PROOF_HEADER_ARRAY),
+)
+
+REQUEST_PP_UTXOS = _msg(
+    "RequestPruningPointUTXOSetMessage",
+    _f(1, "pruningPointHash", "message", message=HASH),
+    _f(1000, "offset", "uint64"),  # our chunk paging (reference uses RequestNext)
+)
+PP_UTXO_CHUNK = _msg(
+    "PruningPointUtxoSetChunkMessage",
+    _f(1, "outpointAndUtxoEntryPairs", "message", repeated=True, message=OUTPOINT_AND_UTXO_ENTRY_PAIR),
+    _f(1000, "offset", "uint64"),
+    _f(1001, "done", "bool"),
+)
+
+IBD_CHAIN_BLOCK_LOCATOR = _msg(
+    "IbdChainBlockLocatorMessage",
+    _f(1, "blockLocatorHashes", "message", repeated=True, message=HASH),
+)
+REQUEST_ANTICONE = _msg(
+    "RequestAnticoneMessage",
+    _f(1, "blockHash", "message", message=HASH),
+    _f(2, "contextHash", "message", message=HASH),
+)
+
+# -- extension payloads (no reference analog; oneof numbers >= 1000) -------
+
+IBD_BLOCKS_CHUNK = _msg(
+    "IbdBlocksChunkMessage",
+    _f(1, "blocks", "message", repeated=True, message=BLOCK),
+    _f(2, "done", "bool"),
+    _f(3, "continuation", "bytes"),
+)
+REQUEST_IBD_CHAIN_INFO = _msg("RequestIbdChainInfoMessage")
+IBD_CHAIN_INFO = _msg(
+    "IbdChainInfoMessage",
+    _f(1, "sink", "bytes"),
+    _f(2, "sinkBlueWork", "bytes"),  # minimal big-endian, like blueWork
+    _f(3, "pruningPoint", "bytes"),
+)
+REQUEST_TRUSTED_DATA = _msg("RequestTrustedDataMessage")
+# the trusted-data bundle (headers + ghostdag + windows + bodies maps) and
+# the KIP-21 SMT chunk keep their canonical serde layout inside a bytes
+# envelope: the flows consume them whole, and re-projecting the nested
+# maps into proto would buy no interop (no reference schema exists)
+TRUSTED_DATA_BLOB = _msg("TrustedDataBlobMessage", _f(1, "blob", "bytes"))
+REQUEST_PP_SMT = _msg(
+    "RequestPruningPointSmtStateMessage",
+    _f(1, "pruningPointHash", "bytes"),
+    _f(2, "offset", "uint64"),
+)
+PP_SMT_CHUNK_BLOB = _msg("PruningPointSmtStateChunkMessage", _f(1, "blob", "bytes"))
+REQUEST_BLOCK_BODIES = _msg(
+    "RequestBlockBodiesMessage", _f(1, "hashes", "message", repeated=True, message=HASH)
+)
+BLOCK_BODY_ENTRY = _msg(
+    "BlockBodyEntry",
+    _f(1, "hash", "bytes"),
+    _f(2, "transactions", "message", repeated=True, message=TRANSACTION),
+)
+BLOCK_BODIES = _msg(
+    "BlockBodiesMessage",
+    _f(1, "entries", "message", repeated=True, message=BLOCK_BODY_ENTRY),
+)
+
+# -- the KaspadMessage oneof (messages.proto) ------------------------------
+
+# oneof field numbers < 1000 are the reference's messages.proto numbering;
+# >= 1000 are extension payloads (skipped by a reference decoder)
+KASPAD_MESSAGE = _msg(
+    "KaspadMessage",
+    _f(1, "addresses", "message", message=ADDRESSES),
+    _f(2, "block", "message", message=BLOCK),
+    _f(3, "transaction", "message", message=TRANSACTION),
+    _f(6, "requestAddresses", "message", message=REQUEST_ADDRESSES),
+    _f(10, "requestRelayBlocks", "message", message=REQUEST_RELAY_BLOCKS),
+    _f(12, "requestTransactions", "message", message=REQUEST_TRANSACTIONS),
+    _f(14, "invRelayBlock", "message", message=INV_RELAY_BLOCK),
+    _f(15, "invTransactions", "message", message=INV_TRANSACTIONS),
+    _f(16, "ping", "message", message=PING),
+    _f(17, "pong", "message", message=PONG),
+    _f(19, "verack", "message", message=VERACK),
+    _f(20, "version", "message", message=VERSION),
+    _f(22, "reject", "message", message=REJECT),
+    _f(25, "pruningPointUtxoSetChunk", "message", message=PP_UTXO_CHUNK),
+    _f(36, "requestPruningPointUTXOSet", "message", message=REQUEST_PP_UTXOS),
+    _f(37, "requestHeaders", "message", message=REQUEST_HEADERS),
+    _f(41, "blockHeaders", "message", message=BLOCK_HEADERS),
+    _f(42, "requestPruningPointProof", "message", message=REQUEST_PP_PROOF),
+    _f(43, "pruningPointProof", "message", message=PP_PROOF),
+    _f(48, "ibdChainBlockLocator", "message", message=IBD_CHAIN_BLOCK_LOCATOR),
+    _f(49, "requestAnticone", "message", message=REQUEST_ANTICONE),
+    _f(1001, "ibdBlocksChunk", "message", message=IBD_BLOCKS_CHUNK),
+    _f(1002, "requestIbdChainInfo", "message", message=REQUEST_IBD_CHAIN_INFO),
+    _f(1003, "ibdChainInfo", "message", message=IBD_CHAIN_INFO),
+    _f(1004, "requestTrustedData", "message", message=REQUEST_TRUSTED_DATA),
+    _f(1005, "trustedData", "message", message=TRUSTED_DATA_BLOB),
+    _f(1008, "requestPruningPointSmtState", "message", message=REQUEST_PP_SMT),
+    _f(1009, "pruningPointSmtStateChunk", "message", message=PP_SMT_CHUNK_BLOB),
+    _f(1010, "requestBlockBodies", "message", message=REQUEST_BLOCK_BODIES),
+    _f(1011, "blockBodies", "message", message=BLOCK_BODIES),
+)

@@ -1,0 +1,179 @@
+"""Deterministic sample payloads for every vendored KaspadMessage type.
+
+One representative payload per flow message type, built from fixed bytes —
+the input side of the golden-vector fixtures pinned under
+``tests/fixtures/proto/``.  ``tools/gen_proto_fixtures.py`` encodes these
+into the pinned ``.bin`` files; ``tests/test_proto_wire.py`` asserts that
+today's codec still produces byte-identical encodings and round-trips them
+back to equal payloads.  Change a schema field and the fixture diff shows
+exactly which wire bytes moved.
+"""
+
+from __future__ import annotations
+
+from kaspa_tpu.consensus.model.block import Block
+from kaspa_tpu.consensus.model.header import Header
+from kaspa_tpu.consensus.model.tx import (
+    ComputeCommit,
+    Covenant,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.consensus.processes.pruning_proof import TrustedData
+from kaspa_tpu.consensus.stores import GhostdagData
+from kaspa_tpu.p2p import node as p2p_node
+from kaspa_tpu.p2p.wire import MSG_PING, MSG_PONG
+
+
+def _bh(i: int) -> bytes:
+    """Deterministic 32-byte hash: byte `i` repeated."""
+    return bytes([i]) * 32
+
+
+def sample_header(seed: int = 1) -> Header:
+    return Header(
+        version=1,
+        parents_by_level=[[_bh(seed), _bh(seed + 1)], [_bh(seed + 2)]],
+        hash_merkle_root=_bh(seed + 3),
+        accepted_id_merkle_root=_bh(seed + 4),
+        utxo_commitment=_bh(seed + 5),
+        timestamp=1_700_000_000_000 + seed,
+        bits=0x1E7FFFFF,
+        nonce=0xDEADBEEF + seed,
+        daa_score=1000 + seed,
+        blue_work=0xCAFE_F00D_0000 + seed,
+        blue_score=900 + seed,
+        pruning_point=_bh(seed + 6),
+    )
+
+
+def sample_tx(seed: int = 1, budget: bool = True) -> Transaction:
+    cc = ComputeCommit.budget(5000 + seed) if budget else ComputeCommit.sigops(2)
+    return Transaction(
+        version=1 if budget else 0,
+        inputs=[
+            TransactionInput(
+                TransactionOutpoint(_bh(seed + 7), 3),
+                b"\x41" * 65,
+                0xFFFFFFFF,
+                cc,
+            )
+        ],
+        outputs=[
+            TransactionOutput(50_000_000, ScriptPublicKey(0, b"\x20" + _bh(seed + 8) + b"\xac"), None),
+            TransactionOutput(
+                7_000_000,
+                ScriptPublicKey(0, b"\x51"),
+                Covenant(0, _bh(seed + 9)),
+            ),
+        ],
+        lock_time=0,
+        subnetwork_id=b"\x00" * 20,
+        gas=0,
+        payload=b"",
+        storage_mass=2036 + seed,
+    )
+
+
+def sample_block(seed: int = 1) -> Block:
+    return Block(sample_header(seed), [sample_tx(seed), sample_tx(seed + 16, budget=False)])
+
+
+def _sample_utxo_pairs(seed: int = 1):
+    return [
+        (
+            TransactionOutpoint(_bh(seed + 20), i),
+            UtxoEntry(
+                amount=1_000 + i,
+                script_public_key=ScriptPublicKey(0, b"\x20" + _bh(seed + 21) + b"\xac"),
+                block_daa_score=500 + i,
+                is_coinbase=(i == 0),
+                covenant_id=_bh(seed + 22) if i == 1 else None,
+            ),
+        )
+        for i in range(2)
+    ]
+
+
+def sample_trusted_data() -> TrustedData:
+    h = sample_header(40)
+    return TrustedData(
+        pruning_point=h.hash,
+        past_pruning_points=[_bh(41), _bh(42)],
+        headers=[h],
+        ghostdag={
+            h.hash: GhostdagData(
+                blue_score=h.blue_score,
+                blue_work=h.blue_work,
+                selected_parent=_bh(40),
+                mergeset_blues=[_bh(40)],
+                mergeset_reds=[],
+                blues_anticone_sizes={_bh(40): 0},
+            )
+        },
+        statuses={h.hash: "UTXOValid"},
+        reach_mergesets={h.hash: [_bh(40)]},
+        bodies={h.hash: [sample_tx(44)]},
+        daa_excluded={h.hash: {_bh(45)}},
+        depth={h.hash: (_bh(46), _bh(47))},
+        pruning_samples={h.hash: _bh(48)},
+        pp_windows={"daa": [(7, _bh(49))], "median_time": [(3, _bh(50))]},
+    )
+
+
+def sample_smt_chunk() -> dict:
+    return {
+        "active": True,
+        "meta": {
+            "lanes_root": _bh(60),
+            "pcd": _bh(61),
+            "parent_seq_commit": _bh(62),
+            "shortcut_block": _bh(63),
+            "inactivity_shortcut": _bh(64),
+        },
+        "offset": 0,
+        "lanes": [(_bh(65), _bh(66), 12), (_bh(67), _bh(68), 34)],
+        "segment": [sample_header(70)],
+        "done": False,
+    }
+
+
+def sample_payloads() -> dict[str, object]:
+    """msg_type -> representative payload, covering the whole converter table."""
+    n = p2p_node
+    return {
+        n.MSG_VERSION: {"protocol_version": 10, "network": "simnet", "listen_port": 16111, "id": 0x1122334455667788},
+        n.MSG_VERACK: 0,
+        MSG_PING: 0x0123456789ABCDEF,
+        MSG_PONG: 0x0123456789ABCDF0,
+        n.MSG_REJECT: "wrong network",
+        n.MSG_REQUEST_ADDRESSES: {},
+        n.MSG_ADDRESSES: ["10.0.0.1:16111", "::1:16112"],
+        n.MSG_INV_BLOCK: _bh(2),
+        n.MSG_REQUEST_BLOCK: [_bh(3), _bh(4)],
+        n.MSG_BLOCK: sample_block(1),
+        n.MSG_TX: sample_tx(5),
+        n.MSG_INV_TXS: [_bh(6)],
+        n.MSG_REQUEST_TXS: [_bh(6), _bh(7)],
+        n.MSG_REQUEST_HEADERS: _bh(8),
+        n.MSG_HEADERS: {"headers": [sample_header(9), sample_header(10)], "done": False, "continuation": _bh(11)},
+        n.MSG_REQUEST_PRUNING_PROOF: {},
+        n.MSG_PRUNING_PROOF: [[sample_header(12)], [sample_header(13), sample_header(14)]],
+        n.MSG_REQUEST_PP_UTXOS: 128,
+        n.MSG_PP_UTXO_CHUNK: {"offset": 128, "pairs": _sample_utxo_pairs(1), "done": True},
+        n.MSG_IBD_BLOCK_LOCATOR: [_bh(15), _bh(16)],
+        n.MSG_REQUEST_ANTIPAST: _bh(17),
+        n.MSG_IBD_BLOCKS: {"blocks": [sample_block(18)], "done": False, "continuation": _bh(19)},
+        n.MSG_REQUEST_IBD_CHAIN_INFO: {},
+        n.MSG_IBD_CHAIN_INFO: {"sink": _bh(20), "sink_blue_work": 0xFEED_0000_1234, "pruning_point": _bh(21)},
+        n.MSG_REQUEST_TRUSTED_DATA: {},
+        n.MSG_TRUSTED_DATA: sample_trusted_data(),
+        n.MSG_REQUEST_PP_SMT: {"pp": _bh(22), "offset": 64},
+        n.MSG_PP_SMT_CHUNK: sample_smt_chunk(),
+        n.MSG_REQUEST_BLOCK_BODIES: [_bh(23)],
+        n.MSG_BLOCK_BODIES: [(_bh(24), [sample_tx(25)])],
+    }

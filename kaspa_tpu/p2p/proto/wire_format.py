@@ -1,0 +1,279 @@
+"""Protobuf wire-format engine (dependency-free).
+
+The byte-level half of the reference's prost-generated codecs
+(protocol/p2p/proto compiled by tonic-build): base-128 varints, zigzag
+signed scalars, the tag = (field_number << 3 | wire_type) framing, and
+length-delimited nested messages/bytes/strings — implemented directly so
+the container needs no protobuf runtime.
+
+Messages are encoded from / decoded into plain dicts, driven by the
+descriptors in ``schema.py``.  Encoding follows proto3 semantics:
+
+- fields are emitted in ascending field-number order (deterministic bytes,
+  required for the golden-vector fixtures),
+- default values (0, "", b"", False, empty list) are skipped,
+- repeated message/bytes fields are emitted as one tagged record each.
+
+Decoding skips unknown fields by wire type (the mechanism that lets a
+reference peer add fields without breaking us, and lets us ride extension
+fields past a reference decoder), counting skips in an observability
+counter.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from kaspa_tpu.observability.core import REGISTRY
+
+_UNKNOWN_FIELDS = REGISTRY.counter(
+    "p2p_proto_unknown_fields_skipped", help="protobuf fields skipped by the unknown-field rule"
+)
+
+
+class ProtoWireError(Exception):
+    """Malformed protobuf bytes (truncation, bad wire type, overlong varint)."""
+
+
+# wire types (protobuf encoding spec)
+WT_VARINT = 0
+WT_I64 = 1
+WT_LEN = 2
+WT_I32 = 5
+
+MAX_VARINT_BYTES = 10  # 64-bit varints never exceed 10 bytes
+
+
+# -- varint / zigzag -------------------------------------------------------
+
+
+def encode_varint(v: int) -> bytes:
+    if v < 0:
+        # proto3 negative int32/int64 values are sign-extended to 64 bits
+        v &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_varint(data: bytes, pos: int) -> tuple[int, int]:
+    """-> (value, new_pos); raises on truncation or overlong encoding."""
+    result = 0
+    shift = 0
+    start = pos
+    while True:
+        if pos >= len(data):
+            raise ProtoWireError("truncated varint")
+        if pos - start >= MAX_VARINT_BYTES:
+            raise ProtoWireError("varint exceeds 10 bytes")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def zigzag_encode(v: int) -> int:
+    """Signed -> unsigned zigzag (sint32/sint64 scalars)."""
+    return (v << 1) ^ (v >> 63) if v < 0 else v << 1
+
+
+def zigzag_decode(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+# -- tags ------------------------------------------------------------------
+
+
+def encode_tag(field_number: int, wire_type: int) -> bytes:
+    return encode_varint((field_number << 3) | wire_type)
+
+
+def decode_tag(data: bytes, pos: int) -> tuple[int, int, int]:
+    """-> (field_number, wire_type, new_pos)."""
+    tag, pos = decode_varint(data, pos)
+    field_number, wire_type = tag >> 3, tag & 0x07
+    if field_number == 0:
+        raise ProtoWireError("field number 0 is reserved")
+    return field_number, wire_type, pos
+
+
+def skip_field(data: bytes, pos: int, wire_type: int) -> int:
+    """Advance past one unknown field's value (the unknown-field rule)."""
+    _UNKNOWN_FIELDS.inc()
+    if wire_type == WT_VARINT:
+        _, pos = decode_varint(data, pos)
+        return pos
+    if wire_type == WT_I64:
+        if pos + 8 > len(data):
+            raise ProtoWireError("truncated fixed64 field")
+        return pos + 8
+    if wire_type == WT_LEN:
+        n, pos = decode_varint(data, pos)
+        if pos + n > len(data):
+            raise ProtoWireError("truncated length-delimited field")
+        return pos + n
+    if wire_type == WT_I32:
+        if pos + 4 > len(data):
+            raise ProtoWireError("truncated fixed32 field")
+        return pos + 4
+    raise ProtoWireError(f"unsupported wire type {wire_type} (groups are not emitted by proto3)")
+
+
+# -- descriptor-driven message encode/decode -------------------------------
+
+# scalar kinds understood by the engine; "message" fields carry a nested
+# descriptor.  sint64 is the zigzag lane; fixed32/fixed64 round the engine
+# out for schema evolution even though the vendored set is varint/LEN-only.
+_VARINT_KINDS = frozenset({"uint32", "uint64", "int64", "bool", "sint64"})
+
+
+def _encode_scalar(kind: str, value) -> bytes:
+    if kind == "bool":
+        return encode_varint(1 if value else 0)
+    if kind == "sint64":
+        return encode_varint(zigzag_encode(int(value)))
+    if kind in ("uint32", "uint64", "int64"):
+        return encode_varint(int(value))
+    if kind == "bytes":
+        return encode_varint(len(value)) + bytes(value)
+    if kind == "string":
+        raw = value.encode("utf-8")
+        return encode_varint(len(raw)) + raw
+    if kind == "fixed64":
+        return struct.pack("<Q", int(value))
+    if kind == "fixed32":
+        return struct.pack("<I", int(value))
+    raise ProtoWireError(f"unknown scalar kind {kind!r}")
+
+
+def _is_default(kind: str, value) -> bool:
+    if kind in ("bytes", "string"):
+        return len(value) == 0
+    if kind == "bool":
+        return not value
+    return value == 0
+
+
+def encode_message(descriptor: dict, msg: dict) -> bytes:
+    """Encode a dict against a schema descriptor -> deterministic bytes."""
+    out = bytearray()
+    for number in sorted(descriptor["fields"]):
+        name, kind, repeated, nested = descriptor["fields"][number]
+        value = msg.get(name)
+        if value is None:
+            continue
+        if repeated:
+            values = value
+        else:
+            values = (value,)
+        for v in values:
+            if kind == "message":
+                body = encode_message(nested, v)
+                out += encode_tag(number, WT_LEN)
+                out += encode_varint(len(body))
+                out += body
+            elif kind in _VARINT_KINDS:
+                if not repeated and _is_default(kind, v):
+                    continue  # proto3: scalar defaults are not emitted
+                out += encode_tag(number, WT_VARINT)
+                out += _encode_scalar(kind, v)
+            elif kind == "fixed64":
+                out += encode_tag(number, WT_I64)
+                out += _encode_scalar(kind, v)
+            elif kind == "fixed32":
+                out += encode_tag(number, WT_I32)
+                out += _encode_scalar(kind, v)
+            else:  # bytes / string
+                if not repeated and _is_default(kind, v):
+                    continue
+                out += encode_tag(number, WT_LEN)
+                out += _encode_scalar(kind, v)
+    return bytes(out)
+
+
+def _decode_scalar(kind: str, data: bytes, pos: int, wire_type: int):
+    if kind in _VARINT_KINDS:
+        if wire_type != WT_VARINT:
+            raise ProtoWireError(f"wire type {wire_type} for varint field")
+        v, pos = decode_varint(data, pos)
+        if kind == "bool":
+            return bool(v), pos
+        if kind == "sint64":
+            return zigzag_decode(v), pos
+        if kind == "int64" and v >= 1 << 63:
+            return v - (1 << 64), pos  # sign-extend
+        if kind == "uint32":
+            return v & 0xFFFFFFFF, pos
+        return v, pos
+    if kind in ("bytes", "string"):
+        if wire_type != WT_LEN:
+            raise ProtoWireError(f"wire type {wire_type} for length-delimited field")
+        n, pos = decode_varint(data, pos)
+        if pos + n > len(data):
+            raise ProtoWireError("truncated length-delimited field")
+        raw = data[pos : pos + n]
+        return (raw.decode("utf-8") if kind == "string" else raw), pos + n
+    if kind == "fixed64":
+        if wire_type != WT_I64 or pos + 8 > len(data):
+            raise ProtoWireError("bad fixed64 field")
+        return struct.unpack_from("<Q", data, pos)[0], pos + 8
+    if kind == "fixed32":
+        if wire_type != WT_I32 or pos + 4 > len(data):
+            raise ProtoWireError("bad fixed32 field")
+        return struct.unpack_from("<I", data, pos)[0], pos + 4
+    raise ProtoWireError(f"unknown scalar kind {kind!r}")
+
+
+def decode_message(descriptor: dict, data: bytes) -> dict:
+    """Decode bytes against a descriptor -> dict.
+
+    Every declared field gets a key: scalars default per proto3, repeated
+    fields default to [], absent sub-messages to None — so the model layer
+    never needs ``.get`` chains.  Unknown fields are skipped.
+    """
+    msg: dict = {}
+    for number in descriptor["fields"]:
+        name, kind, repeated, _nested = descriptor["fields"][number]
+        if repeated:
+            msg[name] = []
+        elif kind == "message":
+            msg[name] = None
+        elif kind in ("bytes",):
+            msg[name] = b""
+        elif kind == "string":
+            msg[name] = ""
+        elif kind == "bool":
+            msg[name] = False
+        else:
+            msg[name] = 0
+    pos = 0
+    while pos < len(data):
+        number, wire_type, pos = decode_tag(data, pos)
+        field = descriptor["fields"].get(number)
+        if field is None:
+            pos = skip_field(data, pos, wire_type)
+            continue
+        name, kind, repeated, nested = field
+        if kind == "message":
+            if wire_type != WT_LEN:
+                raise ProtoWireError(f"wire type {wire_type} for message field {name}")
+            n, pos = decode_varint(data, pos)
+            if pos + n > len(data):
+                raise ProtoWireError(f"truncated message field {name}")
+            value = decode_message(nested, data[pos : pos + n])
+            pos += n
+        else:
+            value, pos = _decode_scalar(kind, data, pos, wire_type)
+        if repeated:
+            msg[name].append(value)
+        else:
+            msg[name] = value
+    return msg

@@ -32,23 +32,88 @@ from kaspa_tpu.p2p import wire
 from kaspa_tpu.p2p.node import MIN_PROTOCOL_VERSION, MSG_VERSION, Node, ProtocolError
 
 # codec cost only (socket IO excluded): encode is timed around
-# wire.encode_frame in send(), decode around wire.decode_payload in the
-# reader loop — blocking recv time would otherwise swamp the histogram
+# codec.encode in send(), decode around codec.decode in the reader loop —
+# blocking recv time would otherwise swamp the histogram.  Both wire
+# implementations (custom frames and protobuf/gRPC) feed the SAME
+# instruments so dashboards compare codecs without relabeling.
 _ENC_TIME = REGISTRY.histogram("p2p_frame_encode_seconds", help="wire frame encode time (codec only)")
 _DEC_TIME = REGISTRY.histogram("p2p_frame_decode_seconds", help="wire payload decode time (codec only)")
 _FRAMES_TX = REGISTRY.counter("p2p_frames_tx", help="frames enqueued for send")
 _FRAMES_RX = REGISTRY.counter("p2p_frames_rx", help="frames received and decoded")
 _BYTES_TX = REGISTRY.counter("p2p_bytes_tx", help="frame bytes enqueued for send")
 _BYTES_RX = REGISTRY.counter("p2p_bytes_rx", help="frame bytes received (incl. headers)")
+_MSGS_TX = REGISTRY.counter_family("p2p_msgs_tx", "type", help="messages sent by flow message type")
+_MSGS_RX = REGISTRY.counter_family("p2p_msgs_rx", "type", help="messages received by flow message type")
+
+
+class CustomWireCodec:
+    """The canonical serde wire of p2p/wire.py (magic|type|len|payload)."""
+
+    name = "custom"
+
+    def encode(self, msg_type: str, payload) -> bytes:
+        return wire.encode_frame(msg_type, payload)
+
+    def read_frame(self, read_exactly) -> tuple[object, bytes, int]:
+        """Blocking read of one frame -> (decode meta, body, wire bytes).
+
+        Kept separate from :meth:`decode` so the reader loop can time codec
+        work alone — socket waits never enter the decode histogram."""
+        type_id, plen = wire.decode_frame(read_exactly(7))
+        return type_id, read_exactly(plen), 7 + plen
+
+    def decode(self, meta, body: bytes) -> tuple[str, object]:
+        return wire.decode_payload(meta, body)
+
+
+class GrpcProtoCodec:
+    """Reference-compatible wire: KaspadMessage protobuf in gRPC framing.
+
+    Byte-compatible with what the reference's tonic stack writes inside
+    HTTP/2 DATA frames (p2p/proto/framing.py has the layout); the payload
+    bytes are the vendored KaspadMessage schema.  Same reader/writer
+    machinery, same flow layer — only the bytes on the socket change.
+    """
+
+    name = "proto"
+
+    def __init__(self):
+        # deferred import: kaspa_tpu.p2p.proto.codec imports node constants,
+        # and transport is imported early by the daemon
+        from kaspa_tpu.p2p.proto import framing
+        from kaspa_tpu.p2p.proto import codec as proto_codec
+
+        self._framing = framing
+        self._codec = proto_codec
+
+    def encode(self, msg_type: str, payload) -> bytes:
+        return self._framing.encode_grpc_frame(self._codec.encode_kaspad_message(msg_type, payload))
+
+    def read_frame(self, read_exactly) -> tuple[object, bytes, int]:
+        n = self._framing.decode_grpc_prefix(read_exactly(self._framing.GRPC_FRAME_OVERHEAD))
+        return None, read_exactly(n), self._framing.GRPC_FRAME_OVERHEAD + n
+
+    def decode(self, _meta, body: bytes) -> tuple[str, object]:
+        return self._codec.decode_kaspad_message(body)
+
+
+def get_codec(name: str):
+    """Wire selector for the daemon's ``--p2p-proto`` flag."""
+    if name == "custom":
+        return CustomWireCodec()
+    if name == "proto":
+        return GrpcProtoCodec()
+    raise ValueError(f"unknown p2p wire codec {name!r} (expected 'custom' or 'proto')")
 
 
 class WirePeer:
     """Router endpoint over a socket (p2p/src/core/router.rs)."""
 
-    def __init__(self, node: Node, sock: socket.socket, outbound: bool):
+    def __init__(self, node: Node, sock: socket.socket, outbound: bool, codec=None):
         self.node = node
         self.sock = sock
         self.outbound = outbound
+        self.codec = codec if codec is not None else CustomWireCodec()
         try:
             ip, port = sock.getpeername()[:2]
             from kaspa_tpu.p2p.address_manager import NetAddress
@@ -71,10 +136,11 @@ class WirePeer:
         if not self.alive:
             return
         t0 = perf_counter_ns()
-        frame = wire.encode_frame(msg_type, payload)
+        frame = self.codec.encode(msg_type, payload)
         _ENC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
         _FRAMES_TX.inc()
         _BYTES_TX.inc(len(frame))
+        _MSGS_TX.inc(msg_type)
         try:
             self._outq.put_nowait(frame)
         except queue.Full:
@@ -123,15 +189,15 @@ class WirePeer:
     def _reader_loop(self) -> None:
         try:
             while self.alive:
-                # read_message() inlined so only decode_payload (the codec
-                # work) is timed — the header/body reads block on the peer
-                type_id, plen = wire.decode_frame(self._read_exactly(7))
-                body = self._read_exactly(plen)
+                # frame read and payload decode are split so only codec work
+                # is timed — the header/body reads block on the peer
+                meta, body, nbytes = self.codec.read_frame(self._read_exactly)
                 t0 = perf_counter_ns()
-                msg_type, payload = wire.decode_payload(type_id, body)
+                msg_type, payload = self.codec.decode(meta, body)
                 _DEC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
                 _FRAMES_RX.inc()
-                _BYTES_RX.inc(7 + plen)
+                _BYTES_RX.inc(nbytes)
+                _MSGS_RX.inc(msg_type)
                 with self.node.lock:
                     self.node._handle(self, msg_type, payload)
         except (ConnectionError, OSError):
@@ -193,9 +259,10 @@ class WirePeer:
 class P2PServer:
     """Listener accepting inbound peers (connection_handler.rs serve)."""
 
-    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 0, address_manager=None):
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 0, address_manager=None, codec=None):
         self.node = node
         self.address_manager = address_manager  # inbound ban enforcement
+        self.codec = codec if codec is not None else CustomWireCodec()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -218,7 +285,8 @@ class P2PServer:
             if self.address_manager is not None and self.address_manager.is_banned(addr[0]):
                 sock.close()
                 continue
-            peer = WirePeer(self.node, sock, outbound=False)
+            # codecs are stateless; the server's instance is shared by peers
+            peer = WirePeer(self.node, sock, outbound=False, codec=self.codec)
             with self.node.lock:
                 self.node.peers.append(peer)
             peer.start()
@@ -231,12 +299,16 @@ class P2PServer:
             pass
 
 
-def connect_outbound(node: Node, address: str, timeout: float = 10.0) -> WirePeer:
-    """Dial a peer, run the version/verack handshake, return the live peer."""
+def connect_outbound(node: Node, address: str, timeout: float = 10.0, codec=None) -> WirePeer:
+    """Dial a peer, run the version/verack handshake, return the live peer.
+
+    Both ends must speak the same wire (``codec``): like the reference,
+    wire selection is deployment configuration, not negotiated in-band —
+    the version handshake only negotiates the flow tier."""
     host, port = address.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)), timeout=timeout)
     sock.settimeout(None)
-    peer = WirePeer(node, sock, outbound=True)
+    peer = WirePeer(node, sock, outbound=True, codec=codec)
     with node.lock:
         node.peers.append(peer)
     peer.start()

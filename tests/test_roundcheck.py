@@ -1,0 +1,80 @@
+"""Round-evidence tooling: roundcheck artifact + bench probe/dossier helpers."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", os.path.join(REPO_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_roundcheck_writes_round_evidence(tmp_path):
+    out = tmp_path / "ROUNDCHECK.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "tools", "roundcheck.py"),
+            "--skip-tests",
+            "--skip-bench",
+            "--blocks",
+            "8",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO_ROOT,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout
+    evidence = json.loads(out.read_text())
+    assert evidence["ok"] is True
+    sim = evidence["sections"]["sim"]
+    assert sim["ok"] and sim["result"]["blocks"] == 8
+    assert "created" in evidence
+
+
+def test_bench_wedge_dossier_shape(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setenv("KASPA_TPU_BENCH_DOSSIER_DIR", str(tmp_path))
+    probe_log = [{"t": bench._utc_stamp(), "event": "session_probe_start", "timeout_s": 1}]
+    fallback = {"metric": bench.METRIC, "value": 123.4, "unit": bench.UNIT}
+    path = bench._write_wedge_dossier(probe_log, fallback)
+    assert os.path.dirname(path) == str(tmp_path)
+    dossier = json.loads(open(path).read())
+    assert dossier["reason"].startswith("device probe wedge")
+    assert dossier["probe_log"] == probe_log
+    assert dossier["cpu_fallback"]["value"] == 123.4
+    # timestamped filename: bench_wedge_<UTC>.json
+    assert os.path.basename(path).startswith("bench_wedge_20")
+
+
+def test_bench_probe_mode_emits_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KASPA_TPU_BENCH_CHILD"] = "1"
+    env["KASPA_TPU_BENCH_MODE"] = "probe"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        timeout=180,
+    )
+    line = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")][-1]
+    obj = json.loads(line)
+    assert obj["probe_ok"] is True and proc.returncode == 0
+    assert obj["platform"] == "cpu"

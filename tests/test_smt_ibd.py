@@ -263,3 +263,50 @@ def test_bootstrap_from_pre_toccata_pp_crossing_activation():
     m2 = Miner(1, random.Random(5))
     joiner.submit_block(joiner.consensus.build_block_template(m2.miner_data, []))
     assert donor.consensus.sink() == joiner.consensus.sink()
+
+
+def test_smt_snapshot_bounded_by_ttl_and_anchor(toccata_donor):
+    """The serve-side SMT snapshot is invalidated by prune_caches: after the
+    idle TTL while its anchor is live, after the shorter stale grace once
+    the local pruning point moved past it — and a chunk request re-arms the
+    clock (an active receiver keeps its snapshot alive)."""
+    from kaspa_tpu.p2p.node import (
+        MSG_REQUEST_PP_SMT,
+        SMT_SNAPSHOT_STALE_GRACE_SECONDS,
+        SMT_SNAPSHOT_TTL_SECONDS,
+    )
+
+    params, donor = toccata_donor
+    pp = donor.consensus.pruning_processor.pruning_point
+    state = donor.consensus.export_pp_lane_state()
+    t0 = 1000.0
+
+    # live anchor: survives until idle past the TTL
+    donor._pp_smt_snapshot = (pp, state, t0)
+    donor.prune_caches(t0 + SMT_SNAPSHOT_TTL_SECONDS - 1)
+    assert donor._pp_smt_snapshot is not None
+    donor.prune_caches(t0 + SMT_SNAPSHOT_TTL_SECONDS + 1)
+    assert donor._pp_smt_snapshot is None
+
+    # stale anchor (pruning point moved on): only the shorter grace
+    donor._pp_smt_snapshot = (b"\x99" * 32, state, t0)
+    donor.prune_caches(t0 + SMT_SNAPSHOT_STALE_GRACE_SECONDS - 1)
+    assert donor._pp_smt_snapshot is not None
+    donor.prune_caches(t0 + SMT_SNAPSHOT_STALE_GRACE_SECONDS + 1)
+    assert donor._pp_smt_snapshot is None
+
+    # a stale UTXO snapshot drops as soon as the anchor moves
+    donor._pp_utxo_snapshot = (b"\x98" * 32, [])
+    donor.prune_caches(t0)
+    assert donor._pp_utxo_snapshot is None
+
+    # serving a chunk request (re)creates the snapshot with a fresh clock
+    joiner = Node(Consensus(params), "joiner-prune")
+    pj, _pd = connect(joiner, donor)
+    pj.send(MSG_REQUEST_PP_SMT, {"pp": pp, "offset": 0})
+    snap = donor._pp_smt_snapshot
+    assert snap is not None and snap[0] == pp and len(snap) == 3
+    first_ref = snap[2]
+    pj.send(MSG_REQUEST_PP_SMT, {"pp": pp, "offset": 1})
+    assert donor._pp_smt_snapshot[2] >= first_ref  # last-use refreshed
+    donor._pp_smt_snapshot = None  # restore clean serving for other tests

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Regenerate the golden-vector protobuf fixtures.
+
+Encodes every sample payload from kaspa_tpu.p2p.proto.vectors into
+tests/fixtures/proto/<msg_type>.bin plus a manifest with sizes and the
+schema oneof key per type.  Run after an intentional schema change and
+commit the diff — tests/test_proto_wire.py pins these bytes.
+
+    python tools/gen_proto_fixtures.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kaspa_tpu.p2p.proto.codec import _CONVERTERS, encode_kaspad_message  # noqa: E402
+from kaspa_tpu.p2p.proto.vectors import sample_payloads  # noqa: E402
+
+
+def main() -> None:
+    out_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures", "proto")
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = {}
+    for msg_type, payload in sorted(sample_payloads().items()):
+        data = encode_kaspad_message(msg_type, payload)
+        with open(os.path.join(out_dir, f"{msg_type}.bin"), "wb") as f:
+            f.write(data)
+        manifest[msg_type] = {"oneof": _CONVERTERS[msg_type][0], "bytes": len(data)}
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(manifest)} fixtures to {os.path.relpath(out_dir)}")
+
+
+if __name__ == "__main__":
+    main()
