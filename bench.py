@@ -17,6 +17,11 @@ after every attempt fails does it report an explicit zero.
 Every lane verifies a DISTINCT (pubkey, message, signature) triple —
 no tiling — and the batch mixes valid and invalid signatures: the device
 mask must match the pure-python oracle expectation exactly.
+
+``--sweep`` runs the kernel x batch-size x mesh-size grid instead of the
+headline number (one fresh child per cell, mesh via KASPA_TPU_MESH) and
+writes best-per-config to BENCH_SWEEP.json; ``--probe`` just reports
+backend liveness + device count.
 """
 
 from __future__ import annotations
@@ -81,12 +86,18 @@ def _child_probe_main() -> None:
     jax_setup.setup()
     t0 = time.perf_counter()
     ok = _child_probe(PROBE_TIMEOUT_S)
+    devices = 0
+    if ok:
+        import jax
+
+        devices = len(jax.devices())  # the sweep's mesh column source
     print(
         json.dumps(
             {
                 "probe_ok": ok,
                 "elapsed_s": round(time.perf_counter() - t0, 3),
                 "platform": os.environ.get("JAX_PLATFORMS", ""),
+                "devices": devices,
             }
         )
     )
@@ -127,6 +138,115 @@ def _gen_unique_batch(b: int):
     return triples
 
 
+def _gen_unique_ecdsa_batch(b: int):
+    """b distinct ECDSA (pubkey_point, msg, low-S sig) with known nonces.
+
+    Same incremental-point trick as the Schnorr generator: P_i = P_{i-1}+G
+    and R_i = R_{i-1}+G replace two full scalar ladders per lane; s comes
+    from the known nonce k_i = k0+i (one cheap modular inverse per lane).
+    """
+    import random
+
+    from kaspa_tpu.crypto import eclib
+
+    rng = random.Random(2027)
+    sk0 = rng.randrange(1, eclib.N - b)
+    k0 = rng.randrange(1, eclib.N - b)
+    P = eclib.point_mul(eclib.G, sk0)
+    R = eclib.point_mul(eclib.G, k0)
+    triples = []
+    for i in range(b):
+        sk, k = sk0 + i, k0 + i
+        r = R[0] % eclib.N
+        msg = rng.getrandbits(256).to_bytes(32, "big")
+        z = int.from_bytes(msg, "big") % eclib.N
+        s = pow(k, -1, eclib.N) * (z + r * sk) % eclib.N
+        if s > eclib.N // 2:
+            s = eclib.N - s  # low-S, like the signing front-end
+        triples.append((P, msg, r.to_bytes(32, "big") + s.to_bytes(32, "big")))
+        P = eclib.point_add(P, eclib.G)
+        R = eclib.point_add(R, eclib.G)
+    return triples
+
+
+def _child_ecdsa_main(obs_fn) -> None:
+    """ECDSA sweep lane: mirrors the Schnorr child (distinct triples, a
+    corrupted quarter, host-side validity checks matching secp.py's
+    front-end, device mask asserted against the oracle expectation)."""
+    import random
+
+    import numpy as np
+
+    from kaspa_tpu.crypto import eclib
+    from kaspa_tpu.ops import bigint as bi
+    from kaspa_tpu.ops import mesh
+    from kaspa_tpu.ops.secp256k1.verify import ecdsa_verify
+
+    triples = _gen_unique_ecdsa_batch(B)
+    for i in (0, 1, B // 2, B - 1):
+        Pt, msg, sig = triples[i]
+        pub33 = bytes([2 + (Pt[1] & 1)]) + Pt[0].to_bytes(32, "big")
+        assert eclib.ecdsa_verify(pub33, msg, sig), "generator produced bad ecdsa sig"
+
+    expect = [True] * B
+    rng = random.Random(11)
+    sigs = [t[2] for t in triples]
+    for i in range(0, B, 4):  # corrupt a quarter of the batch
+        j = rng.randrange(64)
+        sigs[i] = sigs[i][:j] + bytes([sigs[i][j] ^ (1 + rng.randrange(255))]) + sigs[i][j + 1 :]
+        expect[i] = False
+
+    half_n = eclib.N // 2
+    px = np.zeros((B, 16), np.int32)
+    py = np.zeros((B, 16), np.int32)
+    rc = np.zeros((B, 16), np.int32)
+    u1 = [0] * B
+    u2 = [0] * B
+    ok = np.zeros(B, dtype=bool)
+    for i, ((x, y), msg, _orig) in enumerate(triples):
+        r = int.from_bytes(sigs[i][:32], "big")
+        s = int.from_bytes(sigs[i][32:], "big")
+        # same validity gate as secp.ecdsa_verify_batch (corrupt r/s can
+        # fail by encoding before ever reaching the device)
+        if not (1 <= r < eclib.N) or not (1 <= s < eclib.N) or s > half_n:
+            continue
+        z = int.from_bytes(msg, "big") % eclib.N
+        si = pow(s, -1, eclib.N)
+        px[i] = bi.int_to_limbs(x, 16)
+        py[i] = bi.int_to_limbs(y, 16)
+        rc[i] = bi.int_to_limbs(r, 16)
+        u1[i] = z * si % eclib.N
+        u2[i] = r * si % eclib.N
+        ok[i] = True
+
+    mask = np.asarray(ecdsa_verify(px, py, rc, u1, u2, ok))  # compile + warmup
+    assert mask.tolist() == expect, "BENCH CORRECTNESS FAILURE: ecdsa mask != oracle"
+
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = np.asarray(ecdsa_verify(px, py, rc, u1, u2, ok))
+        best = min(best, time.perf_counter() - t0)
+    assert out.tolist() == expect
+
+    value = B / best
+    print(
+        json.dumps(
+            {
+                "metric": "ecdsa_secp256k1_batch_verify_throughput",
+                "value": round(value, 1),
+                "unit": UNIT,
+                "vs_baseline": round(value / BASELINE, 4),
+                "batch": B,
+                "mesh": mesh.active_size(),
+                "observability": obs_fn(),
+            }
+        )
+    )
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def _child_main() -> None:
     """Generate the batch, verify on device, print the JSON result line.
 
@@ -156,6 +276,10 @@ def _child_main() -> None:
         print(json.dumps({"child_error": "probe_timeout", "observability": _obs()}))
         sys.stdout.flush()
         os._exit(3)
+
+    if os.environ.get("KASPA_TPU_BENCH_KERNEL", "schnorr") == "ecdsa":
+        _child_ecdsa_main(_obs)
+        return  # unreachable (child exits)
 
     from kaspa_tpu.crypto import eclib
     from kaspa_tpu.crypto.secp import schnorr_challenge
@@ -209,6 +333,8 @@ def _child_main() -> None:
         best = min(best, time.perf_counter() - t0)
     assert out.tolist() == expect
 
+    from kaspa_tpu.ops import mesh
+
     value = B / best
     print(
         json.dumps(
@@ -217,6 +343,8 @@ def _child_main() -> None:
                 "value": round(value, 1),
                 "unit": UNIT,
                 "vs_baseline": round(value / BASELINE, 4),
+                "batch": B,
+                "mesh": mesh.active_size(),
                 "observability": _obs(),
             }
         )
@@ -352,6 +480,62 @@ def _write_wedge_dossier(probe_log: list, fallback: dict | None) -> str:
     return path
 
 
+def _sweep(probe_log: list, devices: int) -> None:
+    """ROADMAP item-1 sweep: kernel x batch-size x mesh-size grid, one
+    fresh child per cell, best-per-(kernel, mesh) config into the sweep
+    JSON.  Reuses the headline machinery: each cell still probes in-child
+    and dies alone on a wedged backend; the parent just records the hole.
+    """
+    batches = [
+        int(b) for b in os.environ.get("KASPA_TPU_BENCH_SWEEP_BATCHES", "1024,4096,16384").split(",") if b.strip()
+    ]
+    meshes = [1] + ([devices] if devices > 1 else [])
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    cells = []
+    for kernel in ("schnorr", "ecdsa"):
+        for mesh_n in meshes:
+            for b in batches:
+                cell = {"kernel": kernel, "batch": b, "mesh": mesh_n}
+                remaining = deadline - time.monotonic()
+                if remaining <= 30:
+                    cell.update(value=0.0, note="sweep budget exhausted")
+                    cells.append(cell)
+                    continue
+                obj, note = _run_json_child(
+                    {
+                        "KASPA_TPU_BENCH_CHILD": "1",
+                        "KASPA_TPU_BENCH_B": str(b),
+                        "KASPA_TPU_BENCH_KERNEL": kernel,
+                        "KASPA_TPU_MESH": str(mesh_n),
+                    },
+                    min(ATTEMPT_TIMEOUT_S, remaining),
+                )
+                if obj is not None and obj.get("value", 0) > 0:
+                    cell.update(value=obj["value"], unit=obj.get("unit", UNIT), note="ok")
+                else:
+                    err = (obj or {}).get("child_error", note)
+                    cell.update(value=0.0, note=f"failed: {err}")
+                cells.append(cell)
+    best: dict = {}
+    for c in cells:
+        key = f"{c['kernel']}/mesh{c['mesh']}"
+        if c["value"] > best.get(key, {}).get("value", 0.0):
+            best[key] = {"batch": c["batch"], "value": c["value"]}
+    out_path = os.environ.get("KASPA_TPU_BENCH_SWEEP_PATH", "BENCH_SWEEP.json")
+    doc = {
+        "created": _utc_stamp(compact=False),
+        "devices": devices,
+        "batches": batches,
+        "meshes": meshes,
+        "cells": cells,
+        "best": best,
+        "probe_log": probe_log,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({"sweep": out_path, "devices": devices, "best": best}))
+
+
 def main() -> None:
     if os.environ.get("KASPA_TPU_BENCH_CHILD"):
         if os.environ.get("KASPA_TPU_BENCH_MODE") == "probe":
@@ -384,6 +568,14 @@ def main() -> None:
                 }
             )
         )
+        return
+
+    if "--sweep" in sys.argv[1:]:
+        devices = 0
+        for entry in probe_log:
+            child = entry.get("child") or {}
+            devices = max(devices, int(child.get("devices", 0) or 0))
+        _sweep(probe_log, devices)
         return
 
     deadline = time.monotonic() + TOTAL_BUDGET_S

@@ -78,6 +78,12 @@ def parse_args(argv=None) -> DaemonArgs:
 
     p.add_argument("--ram-scale", type=_ram_scale, default=1.0,
                    help="scale all store cache budgets, 0.1-10 (cache_policy_builder.rs --ram-scale)")
+    p.add_argument(
+        "--mesh", default=None, metavar="N",
+        help="shard batch signature verify + muhash over N devices via shard_map "
+        "(default 1 = single device; 'auto' = every visible device; "
+        "CPU testing: XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+    )
     # consensus-parameter overrides (kaspad exposes these for testnets;
     # primarily for pruning/IBD integration tests at small scale)
     p.add_argument("--override-pruning-depth", type=int, default=None)
@@ -280,6 +286,11 @@ class Daemon:
         self.params = _apply_param_overrides(
             params if params is not None else _network_params_for(args), args
         )
+        from kaspa_tpu.ops import mesh as mesh_dispatch
+
+        # process-wide: every batch verify/muhash call in this daemon routes
+        # through the mesh once configured (> 1)
+        self.mesh_size = mesh_dispatch.configure(getattr(args, "mesh", None))
         self.db = None
         if getattr(args, "persist", False):
             from kaspa_tpu.storage.kv import KvStore
@@ -357,6 +368,8 @@ class Daemon:
         from kaspa_tpu.metrics.perf_monitor import PerfMonitor
 
         self.log = get_logger("daemon")
+        if self.mesh_size > 1:
+            self.log.info("mesh dispatch enabled over %d devices", self.mesh_size)
         self.core = Core()
         self.perf_monitor = PerfMonitor()
         self.metrics_data = MetricsData()

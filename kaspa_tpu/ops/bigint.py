@@ -50,7 +50,10 @@ def int_to_limbs(v: int, w: int) -> np.ndarray:
 
 def ints_to_limbs(vs, w: int) -> np.ndarray:
     """Host: iterable of python ints -> [N, W] int32 limb array."""
-    return np.stack([int_to_limbs(v, w) for v in vs])
+    rows = [int_to_limbs(v, w) for v in vs]
+    if not rows:
+        return np.zeros((0, w), dtype=np.int32)
+    return np.stack(rows)
 
 def limbs_to_int(arr) -> int:
     """Host: limb array (possibly lazy/signed) -> python int."""
@@ -89,18 +92,27 @@ class FieldCtx:
         self.zero = np.zeros(self.W, dtype=np.int32)
         self.one = int_to_limbs(1, self.W)
 
+    # like _const below: a first access inside shard_map's check_rep rewrite
+    # trace yields a RewriteTracer, which must not be cached on the ctx
+
     @property
     def m_limbs_dev(self):
         if not hasattr(self, "_m_limbs_dev"):
             with jax.ensure_compile_time_eval():
-                self._m_limbs_dev = jnp.asarray(self.m_limbs)
+                out = jnp.asarray(self.m_limbs)
+            if isinstance(out, jax.core.Tracer):
+                return out
+            self._m_limbs_dev = out
         return self._m_limbs_dev
 
     @property
     def c_limbs16_dev(self):
         if not hasattr(self, "_c_limbs16_dev"):
             with jax.ensure_compile_time_eval():
-                self._c_limbs16_dev = jnp.asarray(self.c_limbs16)
+                out = jnp.asarray(self.c_limbs16)
+            if isinstance(out, jax.core.Tracer):
+                return out
+            self._c_limbs16_dev = out
         return self._c_limbs16_dev
 
     def __repr__(self):
@@ -142,25 +154,36 @@ def _conv_matrix_np(k: int):
     return m
 
 
-@functools.lru_cache(maxsize=None)
+_CONST_CACHE: dict = {}
+
+
 def _const(arr_factory_key):
     """Memoized device constants: avoids re-running numpy->jax conversion for
     the large one-hot matrices on every traced multiply (a dominant share of
     trace/lowering time for fresh batch shapes).
 
-    ensure_compile_time_eval makes the conversion concrete even when the
-    first call happens inside a jit trace — caching a tracer would leak it
-    into later traces (UnexpectedTracerError)."""
+    ensure_compile_time_eval makes the conversion concrete when the first
+    call happens inside a plain jit trace, but inside shard_map's check_rep
+    rewrite interpreter it still yields a RewriteTracer — memoizing that
+    poisons every later trace in the process, so tracers are returned
+    uncached and only concrete arrays enter the cache."""
+    hit = _CONST_CACHE.get(arr_factory_key)
+    if hit is not None:
+        return hit
     kind, arg = arr_factory_key
     with jax.ensure_compile_time_eval():
         if kind == "conv":
-            return jnp.asarray(_conv_matrix_np(arg))
-        if kind == "collect":
-            return jnp.asarray(_block_collect_np(arg))
-        if kind == "cmat":
+            out = jnp.asarray(_conv_matrix_np(arg))
+        elif kind == "collect":
+            out = jnp.asarray(_block_collect_np(arg))
+        elif kind == "cmat":
             c8, k = arg
-            return jnp.asarray(_c_matrix_np(c8, k))
-    raise KeyError(kind)
+            out = jnp.asarray(_c_matrix_np(c8, k))
+        else:
+            raise KeyError(kind)
+    if not isinstance(out, jax.core.Tracer):
+        _CONST_CACHE[arr_factory_key] = out
+    return out
 
 
 @functools.lru_cache(maxsize=None)

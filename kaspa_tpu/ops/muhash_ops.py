@@ -42,10 +42,17 @@ def batch_product_device(elements: np.ndarray) -> int:
 
     Batches larger than the biggest bucket are reduced bucket-by-bucket with
     the partial products combined on host (cheap: one 3072-bit mul each).
+    With a configured device mesh (> 1) the whole reduction shards over the
+    mesh instead — same result (the monoid product is association-free),
+    one compiled shape per (mesh, bucket).
     """
     n = elements.shape[0]
     if n == 0:
         return 1
+    from kaspa_tpu.ops import mesh
+
+    if mesh.active_size() > 1:
+        return mesh.dispatch_tree_product(elements)
     result = 1
     pos = 0
     while pos < n:

@@ -71,7 +71,10 @@ def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarra
     px/py/r_canon: [B, 16] limb arrays; s_scalars/e_scalars: python-int
     scalar sequences (already reduced mod n); valid_in: [B] bool.
     """
-    if _use_pallas():
+    from kaspa_tpu.ops import mesh
+
+    n_mesh = mesh.active_size()
+    if n_mesh == 1 and _use_pallas():
         from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
 
         with trace.span("secp.device_dispatch", kernel="schnorr_pallas"):
@@ -82,13 +85,21 @@ def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarra
     with trace.span("secp.host_marshal", kernel="schnorr", batch=b):
         sd = _scalars_to_digits(s_scalars, b)
         ed = _scalars_to_digits(e_scalars, b)
+    if n_mesh > 1:
+        # mesh > 1 rides the portable XLA formulation sharded over the
+        # device mesh (the fused Mosaic ladder stays the single-chip path)
+        with trace.span("secp.device_dispatch", kernel="schnorr_mesh", batch=b, mesh=n_mesh):
+            return mesh.dispatch_verify("schnorr", px, py, r_canon, sd, ed, valid_in)
     with trace.span("secp.device_dispatch", kernel="schnorr", batch=b):
         return np.asarray(schnorr_verify_kernel(px, py, r_canon, sd, ed, valid_in))
 
 
 def ecdsa_verify(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in) -> np.ndarray:
     """Backend-dispatching batched ECDSA verify (see schnorr_verify)."""
-    if _use_pallas():
+    from kaspa_tpu.ops import mesh
+
+    n_mesh = mesh.active_size()
+    if n_mesh == 1 and _use_pallas():
         from kaspa_tpu.ops.secp256k1.ladder_pallas import verify_batch_pallas
 
         with trace.span("secp.device_dispatch", kernel="ecdsa_pallas"):
@@ -97,6 +108,9 @@ def ecdsa_verify(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in) -> np.ndar
     with trace.span("secp.host_marshal", kernel="ecdsa", batch=b):
         u1 = _scalars_to_digits(u1_scalars, b)
         u2 = _scalars_to_digits(u2_scalars, b)
+    if n_mesh > 1:
+        with trace.span("secp.device_dispatch", kernel="ecdsa_mesh", batch=b, mesh=n_mesh):
+            return mesh.dispatch_verify("ecdsa", px, py, r_n_canon, u1, u2, valid_in)
     with trace.span("secp.device_dispatch", kernel="ecdsa", batch=b):
         return np.asarray(ecdsa_verify_kernel(px, py, r_n_canon, u1, u2, valid_in))
 

@@ -4,6 +4,11 @@ Builds a virtual-time multi-miner DAG with signed transactions, then
 replays it into a fresh consensus and reports validation throughput:
 
     python -m kaspa_tpu.sim --bps 2 --blocks 100 --miners 4 --tpb 4
+
+Mesh replay (sharded batch verify + muhash over N devices; CPU recipe):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python -m kaspa_tpu.sim --blocks 32 --mesh 8 --json
 """
 
 import argparse
@@ -13,6 +18,7 @@ from kaspa_tpu.utils import jax_setup
 
 jax_setup.setup()
 
+from kaspa_tpu.ops import mesh
 from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
 
 
@@ -24,15 +30,21 @@ def main() -> None:
     p.add_argument("--blocks", type=int, default=64, help="blocks to produce")
     p.add_argument("--tpb", type=int, default=8, help="transactions per block")
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument(
+        "--mesh", default=None, metavar="N",
+        help="shard the replay's batch verify + muhash over N devices ('auto' = all visible)",
+    )
     p.add_argument("--json", action="store_true", help="emit one JSON line")
     args = p.parse_args()
 
+    mesh_size = mesh.configure(args.mesh)
     cfg = SimConfig(
         bps=args.bps, delay=args.delay, num_miners=args.miners,
         num_blocks=args.blocks, txs_per_block=args.tpb, seed=args.seed,
     )
     res = simulate(cfg)
-    elapsed, _fresh = replay(res)
+    elapsed, fresh = replay(res)
+    sink = fresh.sink()
     out = {
         "blocks": len(res.blocks),
         "txs": res.total_txs,
@@ -41,6 +53,11 @@ def main() -> None:
         "replay_blocks_per_sec": round(len(res.blocks) / elapsed, 2),
         "bps_target": args.bps,
         "realtime_factor": round(len(res.blocks) / args.bps / elapsed, 2),
+        "mesh": mesh_size,
+        # end-state fingerprints: identical across --mesh values is the
+        # bit-identity acceptance check for the sharded dispatch
+        "sink": sink.hex(),
+        "utxo_commitment": fresh.multisets[sink].finalize().hex(),
     }
     if args.json:
         print(json.dumps(out))
@@ -48,7 +65,7 @@ def main() -> None:
         print(f"built {out['blocks']} blocks / {out['txs']} txs in {out['build_seconds']}s")
         print(
             f"replayed in {out['replay_seconds']}s = {out['replay_blocks_per_sec']} blocks/s "
-            f"({out['realtime_factor']}x the {args.bps}-BPS real-time rate)"
+            f"({out['realtime_factor']}x the {args.bps}-BPS real-time rate, mesh {mesh_size})"
         )
 
 

@@ -6,22 +6,36 @@ checks of an entire block/mergeset are *collected* into one device batch:
 
     collect phase  : classify each (input, utxo) pair, compute its sighash
                      (host, memoized per tx), queue (pubkey, msg, sig)
-    dispatch phase : one batched Schnorr kernel call + one ECDSA call
+    dispatch phase : one batched Schnorr kernel call + one ECDSA call,
+                     overlapped with the host-VM fallback lane
     resolve phase  : validity bitmask mapped back to per-input results
 
 Consensus equivalence: only canonical standard P2PK spends take the batch
 path; anything else routes to the host VM (txscript.vm) — same acceptance
 decisions as running the reference's engine per input.
+
+The VM fallback lane is *deferred and parallel*: nonstandard inputs are
+queued at collect time and executed at dispatch on a bounded thread pool,
+concurrently with the device batches (the device dispatch releases the GIL
+while XLA runs, so a multisig/P2SH-heavy block no longer serializes the
+fallback work behind — or in front of — the device lane).  Failure
+precedence matches the serial path exactly: VM failures apply first, in
+collect order, then device-batch failures in queue order, so the
+(token -> first error) mapping is bit-identical to serial execution.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import functools
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
 
 from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.crypto import secp
 from kaspa_tpu.observability import trace
-from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.observability.core import REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.txscript import standard
 from kaspa_tpu.txscript.caches import SigCache
 
@@ -30,6 +44,34 @@ from kaspa_tpu.txscript.caches import SigCache
 _JOBS = REGISTRY.counter_family("txscript_batch_jobs", "kind", help="signature jobs queued for device dispatch")
 _SIGCACHE_SKIPS = REGISTRY.counter("txscript_batch_sigcache_skips", help="jobs answered by the sig cache pre-dispatch")
 _VM_FALLBACKS = REGISTRY.counter("txscript_vm_fallbacks", help="inputs routed to the host VM instead of the batch")
+_FALLBACK_BATCH = REGISTRY.histogram(
+    "txscript_fallback_batch_size", SIZE_BUCKETS, help="deferred VM fallback jobs per dispatch"
+)
+
+
+def _default_fallback_workers() -> int:
+    """Bounded pool width for the VM fallback lane (0/1 = serial)."""
+    raw = os.environ.get("KASPA_TPU_VM_FALLBACK_WORKERS")
+    if raw is not None:
+        return max(0, int(raw))
+    return max(2, min(8, os.cpu_count() or 2))
+
+
+_pool_lock = threading.Lock()
+_pool: ThreadPoolExecutor | None = None
+
+
+def _fallback_pool() -> ThreadPoolExecutor:
+    """Shared bounded executor (threads are reused across dispatches and
+    across checkers; daemonized so interpreter shutdown never hangs)."""
+    global _pool
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                _pool = ThreadPoolExecutor(
+                    max_workers=_default_fallback_workers() or 1, thread_name_prefix="vm-fallback"
+                )
+    return _pool
 
 
 class ScriptCheckError(Exception):
@@ -48,15 +90,43 @@ class _Job:
     callback: object  # fn(bool)
 
 
-class BatchScriptChecker:
-    """Collects signature-check jobs across many txs, dispatches once."""
+@dataclass
+class _FallbackJob:
+    token: int
+    input_index: int
+    run: object  # fn() -> None, raises on invalid script
 
-    def __init__(self, sig_cache: SigCache | None = None, vm_fallback=None):
+
+def _run_fallback(job: _FallbackJob) -> Exception | None:
+    """Execute one deferred VM job; returns the failure (or None).
+
+    Runs on pool threads: the engine instance is job-local; the shared
+    SigCache is internally locked; SigHashReusedValues memoization races
+    are benign (idempotent writes of identical digests).
+    """
+    try:
+        job.run()
+        return None
+    except Exception as e:  # noqa: BLE001 - VM raises on invalid script
+        return e
+
+
+class BatchScriptChecker:
+    """Collects signature-check jobs across many txs, dispatches once.
+
+    ``fallback_workers``: width of the VM fallback lane (None = shared
+    default pool, sized by KASPA_TPU_VM_FALLBACK_WORKERS or cpu count;
+    0/1 = serial execution at dispatch — same results either way).
+    """
+
+    def __init__(self, sig_cache: SigCache | None = None, vm_fallback=None, fallback_workers: int | None = None):
         self.sig_cache = sig_cache if sig_cache is not None else SigCache()
         # contract: fn(tx, entries, input_index, reused, pov_daa_score) — the
         # daa score drives fork-activation gating inside the engine
         self.vm_fallback = vm_fallback
+        self.fallback_workers = fallback_workers
         self._jobs: list[_Job] = []
+        self._fallbacks: list[_FallbackJob] = []
         self._results: dict[int, Exception | None] = {}
 
     def collect_tx(self, token: int, tx, utxo_entries, reused=None, pov_daa_score=None, seq_commit_accessor=None) -> None:
@@ -109,14 +179,21 @@ class BatchScriptChecker:
             msg = chash.calc_ecdsa_signature_hash(tx, utxo_entries, i, hash_type, reused)
             self._queue(token, "ecdsa", pubkey, msg, sig, i)
         else:
-            # non-fast-path scripts go through the host VM
+            # non-fast-path scripts defer to the host VM lane (executed at
+            # dispatch, concurrently with the device batches)
             if self.vm_fallback is None:
                 raise ScriptCheckError(f"unsupported script class {cls.value} (VM fallback not wired)", i)
             _VM_FALLBACKS.inc()
-            try:
-                self.vm_fallback(tx, utxo_entries, i, reused, pov_daa_score, seq_commit_accessor=seq_commit_accessor)
-            except Exception as e:  # VM raises on invalid script
-                raise ScriptCheckError(str(e), i) from e
+            self._fallbacks.append(
+                _FallbackJob(
+                    token,
+                    i,
+                    functools.partial(
+                        self.vm_fallback, tx, utxo_entries, i, reused, pov_daa_score,
+                        seq_commit_accessor=seq_commit_accessor,
+                    ),
+                )
+            )
 
     def _queue(self, token, kind, pubkey, msg, sig, input_index):
         cache_key = (kind, sig, msg, pubkey)
@@ -134,23 +211,48 @@ class BatchScriptChecker:
 
         self._jobs.append(_Job(kind, pubkey, msg, sig, cache_key, cb))
 
+    def _effective_workers(self, jobs: int) -> int:
+        w = self.fallback_workers if self.fallback_workers is not None else _default_fallback_workers()
+        return min(w, jobs)
+
     def dispatch(self) -> dict[int, Exception | None]:
-        """Run all queued checks in (at most) two device batches; returns
+        """Run all queued checks: the VM fallback lane on the bounded pool
+        overlapped with (at most) two device batches; returns
         token -> None (valid) | Exception (first failure)."""
+        fallbacks = self._fallbacks
+        self._fallbacks = []
+        pending = None
+        if fallbacks:
+            _FALLBACK_BATCH.observe(len(fallbacks))
+            if self._effective_workers(len(fallbacks)) > 1:
+                pool = _fallback_pool()
+                pending = [pool.submit(_run_fallback, j) for j in fallbacks]
+
         schnorr = [j for j in self._jobs if j.kind == "schnorr"]
         ecdsa = [j for j in self._jobs if j.kind == "ecdsa"]
+        schnorr_mask = ecdsa_mask = None
         if schnorr:
             with trace.span("txscript.dispatch", kind="schnorr", jobs=len(schnorr)):
-                mask = secp.schnorr_verify_batch([(j.pubkey, j.msg, j.sig) for j in schnorr])
-            for j, ok in zip(schnorr, mask):
-                self.sig_cache.insert(j.cache_key, bool(ok))
-                j.callback(bool(ok))
+                schnorr_mask = secp.schnorr_verify_batch([(j.pubkey, j.msg, j.sig) for j in schnorr])
         if ecdsa:
             with trace.span("txscript.dispatch", kind="ecdsa", jobs=len(ecdsa)):
-                mask = secp.ecdsa_verify_batch([(j.pubkey, j.msg, j.sig) for j in ecdsa])
-            for j, ok in zip(ecdsa, mask):
-                self.sig_cache.insert(j.cache_key, bool(ok))
-                j.callback(bool(ok))
+                ecdsa_mask = secp.ecdsa_verify_batch([(j.pubkey, j.msg, j.sig) for j in ecdsa])
+
+        # fallback lane resolution BEFORE the device callbacks: the serial
+        # path ran the VM at collect time, so VM failures must win the
+        # first-error slot over same-token batch failures, in collect order
+        if fallbacks:
+            with trace.span("txscript.fallback_join", jobs=len(fallbacks), parallel=pending is not None):
+                errors = [f.result() for f in pending] if pending is not None else [_run_fallback(j) for j in fallbacks]
+            for job, err in zip(fallbacks, errors):
+                if err is not None:
+                    self._fail(job.token, ScriptCheckError(str(err), job.input_index))
+
+        for jobs, mask in ((schnorr, schnorr_mask), (ecdsa, ecdsa_mask)):
+            if mask is not None:
+                for j, ok in zip(jobs, mask):
+                    self.sig_cache.insert(j.cache_key, bool(ok))
+                    j.callback(bool(ok))
         self._jobs.clear()
         out = self._results
         self._results = {}

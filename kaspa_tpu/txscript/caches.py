@@ -1,14 +1,17 @@
 """Signature cache (reference: crypto/txscript/src/caches.rs:14-55).
 
 Bounded map keyed by (sig, msg, pubkey, kind) with random eviction, exactly
-like the reference's IndexMap+swap_remove scheme.  Shared across the
-validator so repeated relay/mempool/block validations of the same signature
-skip the device round-trip.
+like the reference's IndexMap+swap_remove scheme (the reference wraps it in
+a RwLock; here a plain Lock — the parallel VM fallback lane reads and
+writes it from pool threads, and the multi-step eviction must stay atomic).
+Shared across the validator so repeated relay/mempool/block validations of
+the same signature skip the device round-trip.
 """
 
 from __future__ import annotations
 
 import random
+import threading
 
 
 class SigCache:
@@ -18,27 +21,30 @@ class SigCache:
         self._map: dict[tuple, bool] = {}
         self._keys: list[tuple] = []
         self._rng = random.Random(seed)
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def get(self, key: tuple):
-        v = self._map.get(key)
-        if v is None:
-            self.misses += 1
-        else:
-            self.hits += 1
-        return v
+        with self._lock:
+            v = self._map.get(key)
+            if v is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return v
 
     def insert(self, key: tuple, value: bool) -> None:
-        if key in self._map:
+        with self._lock:
+            if key in self._map:
+                self._map[key] = value
+                return
+            if len(self._keys) == self.size:
+                # random eviction with swap-remove (caches.rs:46-55)
+                i = self._rng.randrange(self.size)
+                old = self._keys[i]
+                del self._map[old]
+                self._keys[i] = self._keys[-1]
+                self._keys.pop()
+            self._keys.append(key)
             self._map[key] = value
-            return
-        if len(self._keys) == self.size:
-            # random eviction with swap-remove (caches.rs:46-55)
-            i = self._rng.randrange(self.size)
-            old = self._keys[i]
-            del self._map[old]
-            self._keys[i] = self._keys[-1]
-            self._keys.pop()
-        self._keys.append(key)
-        self._map[key] = value

@@ -26,6 +26,10 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             os.path.join(REPO_ROOT, "tools", "roundcheck.py"),
             "--skip-tests",
             "--skip-bench",
+            # the mesh lanes re-trace the verify ladder in fresh subprocesses
+            # (minutes on CPU) — they get their own roundcheck run per round,
+            # not a seat inside the tier-1 fast lane
+            "--skip-mesh",
             "--blocks",
             "8",
             "--out",

@@ -1,0 +1,181 @@
+"""Parallel VM fallback lane (txscript/batch.py).
+
+Non-fast-path inputs are queued at collect time and executed at dispatch
+on a bounded thread pool, overlapped with the device batches.  These tests
+pin the serial-equivalence contract: identical results dict (including
+which input index a failure maps to), identical first-error precedence,
+and the `txscript_vm_fallbacks` counter still counting every routed input.
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import (
+    SUBNETWORK_ID_NATIVE,
+    ComputeCommit,
+    ScriptPublicKey,
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+    UtxoEntry,
+)
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.txscript import standard
+from kaspa_tpu.txscript.batch import BatchScriptChecker, ScriptCheckError
+from kaspa_tpu.txscript.caches import SigCache
+from kaspa_tpu.txscript.vm import TxScriptEngine
+
+OP_1, OP_EQUAL = 0x51, 0x87
+
+
+def _vm_fallback(tx, entries, i, reused, pov_daa_score=None, seq_commit_accessor=None):
+    TxScriptEngine(tx, entries, i).execute()
+
+
+def _p2sh_input(value: bytes, ok: bool):
+    """(signature_script, spk) for a trivial P2SH redeem: <v> {OP_1 OP_EQUAL}."""
+    redeem = bytes([OP_1, OP_EQUAL])
+    spk = standard.pay_to_script_hash_script(redeem)
+    push = value if ok else bytes([0x52])  # 2 != 1 -> false stack
+    return push + bytes([len(redeem)]) + redeem, spk
+
+
+def _multisig_input(rng, tx_builder):
+    """2-of-3 schnorr multisig spk + a deferred signer (needs the final tx)."""
+    keys = [rng.randrange(1, eclib.N) for _ in range(3)]
+    pubs = [eclib.schnorr_pubkey(k) for k in keys]
+    spk_script = bytes([0x52]) + b"".join(bytes([32]) + p for p in pubs) + bytes([0x53, 0xAE])
+    return ScriptPublicKey(0, spk_script), keys
+
+
+def _fallback_heavy_tx(seed: int, bad_input: int | None = None):
+    """One tx whose every input routes to the VM lane: P2SH redeems plus a
+    2-of-3 multisig; ``bad_input`` (if set) fails script execution."""
+    rng = random.Random(seed)
+    entries, inputs = [], []
+    for i in range(3):
+        sig_script, spk = _p2sh_input(bytes([OP_1]), ok=(i != bad_input))
+        entries.append(UtxoEntry(10_000, spk, 5, False))
+        inputs.append(
+            TransactionInput(TransactionOutpoint(bytes([seed]) * 32, i), sig_script, 0, ComputeCommit.sigops(0))
+        )
+    ms_spk, ms_keys = _multisig_input(rng, None)
+    entries.append(UtxoEntry(10_000, ms_spk, 5, False))
+    inputs.append(
+        TransactionInput(TransactionOutpoint(bytes([seed]) * 32, 3), b"", 0, ComputeCommit.sigops(3))
+    )
+    tx = Transaction(0, inputs, [TransactionOutput(9_000, entries[0].script_public_key)],
+                     0, SUBNETWORK_ID_NATIVE, 0, b"")
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(tx, entries, 3, chash.SIG_HASH_ALL, reused)
+    sigs = [eclib.schnorr_sign(msg, k, rng.randbytes(32)) + bytes([chash.SIG_HASH_ALL]) for k in ms_keys]
+    ms_script = bytes([len(sigs[0])]) + sigs[0] + bytes([len(sigs[2])]) + sigs[2]
+    if bad_input == 3:
+        ms_script = bytes([len(sigs[2])]) + sigs[2] + bytes([len(sigs[0])]) + sigs[0]  # wrong order
+    tx.inputs[3].signature_script = ms_script
+    return tx, entries
+
+
+def _p2pk_tx(seed: int, corrupt: bool = False):
+    rng = random.Random(seed)
+    sk = rng.randrange(1, eclib.N)
+    pub = eclib.schnorr_pubkey(sk)
+    spk = standard.pay_to_pub_key(pub)
+    entry = UtxoEntry(10_000, spk, 5, False)
+    tx = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(bytes([seed, 1]) * 16, 0), b"", 0, ComputeCommit.sigops(1))],
+        [TransactionOutput(9_000, spk)], 0, SUBNETWORK_ID_NATIVE, 0, b"",
+    )
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+    sig = eclib.schnorr_sign(msg, sk, rng.randbytes(32))
+    if corrupt:
+        sig = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+    tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+    return tx, [entry]
+
+
+def _run_block(workers: int | None, bad_p2sh_token=2, bad_ms_token=4):
+    """Collect a fallback-heavy 'block' and dispatch with the given lane
+    width; returns {token: error | None}."""
+    checker = BatchScriptChecker(SigCache(), _vm_fallback, fallback_workers=workers)
+    blueprint = [
+        (0, _fallback_heavy_tx(10)),
+        (1, _p2pk_tx(11)),
+        (2, _fallback_heavy_tx(12, bad_input=1)),
+        (3, _p2pk_tx(13, corrupt=True)),
+        (4, _fallback_heavy_tx(14, bad_input=3)),
+        (5, _fallback_heavy_tx(15)),
+    ]
+    for token, (tx, entries) in blueprint:
+        checker.collect_tx(token, tx, entries)
+    return checker.dispatch()
+
+
+def _summarize(results):
+    return {
+        t: None if e is None else (type(e).__name__, getattr(e, "input_index", None), str(e))
+        for t, e in results.items()
+    }
+
+
+def test_parallel_matches_serial():
+    serial = _run_block(workers=0)
+    parallel = _run_block(workers=4)
+    assert _summarize(serial) == _summarize(parallel)
+    # the mix actually exercised both lanes
+    assert serial[0] is None and serial[1] is None and serial[5] is None
+    assert serial[2] is not None and serial[3] is not None and serial[4] is not None
+
+
+@pytest.mark.parametrize("workers", [0, 4])
+def test_failure_maps_to_input_index(workers):
+    results = _run_block(workers=workers)
+    assert isinstance(results[2], ScriptCheckError)
+    assert results[2].input_index == 1  # the corrupted P2SH redeem
+    assert isinstance(results[4], ScriptCheckError)
+    assert results[4].input_index == 3  # the wrong-order multisig
+    # fast-path failure still maps too
+    assert isinstance(results[3], ScriptCheckError)
+    assert results[3].input_index == 0
+
+
+def test_fallback_counter_increments():
+    before = REGISTRY.snapshot()["counters"]["txscript_vm_fallbacks"]
+    _run_block(workers=4)
+    after = REGISTRY.snapshot()["counters"]["txscript_vm_fallbacks"]
+    # 4 fallback-heavy txs x 4 VM-routed inputs each
+    assert after - before == 16
+
+
+def test_vm_error_precedence_over_batch_error():
+    """A token with both a VM failure and a batch failure must surface the
+    VM error exactly like the serial path did (VM ran at collect time,
+    so it owned the first-error slot)."""
+    rng = random.Random(20)
+    sig_script, spk_bad = _p2sh_input(bytes([OP_1]), ok=False)
+    tx, entries = _p2pk_tx(21, corrupt=True)
+    tx.inputs.append(TransactionInput(TransactionOutpoint(b"\x22" * 32, 1), sig_script, 0, ComputeCommit.sigops(0)))
+    entries.append(UtxoEntry(10_000, spk_bad, 5, False))
+    for workers in (0, 4):
+        checker = BatchScriptChecker(SigCache(), _vm_fallback, fallback_workers=workers)
+        checker.collect_tx(7, tx, entries)
+        err = checker.dispatch()[7]
+        assert isinstance(err, ScriptCheckError)
+        # serial parity: the VM failure (input 1) wins over the batch
+        # signature failure (input 0)
+        assert err.input_index == 1, (workers, err.input_index, str(err))
+
+
+def test_fallback_without_vm_raises_at_collect():
+    tx, entries = _fallback_heavy_tx(30)
+    checker = BatchScriptChecker(SigCache(), vm_fallback=None)
+    checker.collect_tx(0, tx, entries)
+    err = checker.dispatch()[0]
+    assert isinstance(err, ScriptCheckError)
+    assert "VM fallback not wired" in str(err)
