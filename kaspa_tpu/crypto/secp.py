@@ -17,10 +17,12 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from kaspa_tpu.crypto import eclib
+from kaspa_tpu.observability import trace
 from kaspa_tpu.observability.core import PERCENT_BUCKETS, REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
 from kaspa_tpu.ops.secp256k1.verify import ecdsa_verify, schnorr_verify
+from kaspa_tpu.resilience.breaker import device_breaker
 
 # batch shape telemetry: occupancy is the fraction of padded device lanes
 # doing useful work, the quantity batch-verify throughput is dominated by
@@ -35,6 +37,14 @@ _NEW_SHAPES = REGISTRY.counter_family(
     "secp_dispatch_shapes", "kernel", help="distinct padded bucket sizes dispatched (jit recompile proxy)"
 )
 _seen_shapes: set = set()
+
+# degraded-lane occupancy: how much of the verify workload is riding the
+# host oracle instead of the device (breaker open, or a dispatch died) —
+# the quantity the hostile-load sustain run reports
+_DEGRADED_DISPATCHES = REGISTRY.counter(
+    "secp_degraded_dispatches", help="batches routed to the host degraded lane (breaker open / dispatch failed)"
+)
+_DEGRADED_JOBS = REGISTRY.counter("secp_degraded_jobs", help="verify jobs executed on the host degraded lane")
 
 W = bi.FP.W
 _CHALLENGE_MID = hashlib.sha256(
@@ -126,12 +136,44 @@ class _Batch:
         return np.asarray(mask)[:n]
 
 
+def _run_guarded(batch: _Batch, kernel, items: list, host_verify) -> np.ndarray:
+    """Dispatch through the device circuit breaker.
+
+    CLOSED/probing: the device runs the batch; any dispatch exception
+    (wedged chip, XLA error, injected fault) counts toward a trip and the
+    batch reroutes.  OPEN: the host degraded lane verifies each raw triple
+    with the eclib oracle — same acceptance decisions, host throughput —
+    until a backoff-spaced probe succeeds and the breaker re-arms.
+    """
+    n = len(batch.ok)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    br = device_breaker()
+    if br.allow():
+        try:
+            mask = batch.run(kernel)
+        except Exception:  # noqa: BLE001 - device boundary: any failure trips
+            br.record_failure()
+        else:
+            br.record_success()
+            return mask
+    _DEGRADED_DISPATCHES.inc()
+    _DEGRADED_JOBS.inc(n)
+    with trace.span("secp.degraded_dispatch", kernel=kernel.__name__, jobs=n):
+        mask = np.zeros(n, dtype=bool)
+        for i, (pub, msg, sig) in enumerate(items):
+            if batch.ok[i]:  # host-precheck failures stay False
+                mask[i] = bool(host_verify(pub, msg, sig))
+    return mask
+
+
 def schnorr_verify_batch(items) -> np.ndarray:
     """items: iterable of (pubkey32, msg32, sig64) -> bool mask.
 
     Encoding/range checks and lift_x run on host (failures short-circuit to
     False without occupying useful device lanes beyond padding).
     """
+    items = list(items)
     batch = _Batch()
     for pub, msg, sig in items:
         # BIP340 allows arbitrary-length messages (matching eclib oracle);
@@ -147,11 +189,12 @@ def schnorr_verify_batch(items) -> np.ndarray:
             continue
         e = schnorr_challenge(sig[:32], pub, msg)
         batch.push(pk[0], pk[1], r, s, e)
-    return batch.run(schnorr_verify)
+    return _run_guarded(batch, schnorr_verify, items, eclib.schnorr_verify)
 
 
 def ecdsa_verify_batch(items) -> np.ndarray:
     """items: iterable of (pubkey33, msg32, sig64_compact) -> bool mask."""
+    items = list(items)
     batch = _Batch()
     half_n = eclib.N // 2
     for pub, msg, sig in items:
@@ -169,4 +212,4 @@ def ecdsa_verify_batch(items) -> np.ndarray:
         u1 = z * si % eclib.N
         u2 = r * si % eclib.N
         batch.push(pk[0], pk[1], r, u1, u2)
-    return batch.run(ecdsa_verify)
+    return _run_guarded(batch, ecdsa_verify, items, eclib.ecdsa_verify)

@@ -793,6 +793,20 @@ class Daemon:
     def stop(self) -> None:
         self.core.shutdown()  # reverse bind order: p2p, rpc, tick (blocks
         # until services are down, even when another thread began the stop)
+        # drain asynchronous validation work before the db handle goes away:
+        # blocks in flight inside the pipeline and script jobs on the VM
+        # fallback lane both write through consensus stores — killing them
+        # mid-commit is exactly the torn state the journal exists to absorb,
+        # so an ORDERLY stop should not manufacture one
+        try:
+            self.node.pipeline.wait_for_idle(timeout=30.0)
+        except Exception:  # noqa: BLE001 - drain is best-effort on the way down
+            pass
+        self.node._drop_ibd_pipeline()
+        self.node.pipeline.shutdown()
+        from kaspa_tpu.txscript import batch as script_batch
+
+        script_batch.drain_fallback_pool(timeout=10.0)
         # quiesce dispatch before closing the native handle: an in-flight
         # handler finishes under the lock; later ones see db == None and
         # stage() no-ops (server is already down, nothing new arrives).

@@ -172,6 +172,12 @@ def dispatch_verify(kind: str, px, py, rc, d1_digits, d2_digits, valid_in) -> np
     cached shard_map entry, unpads the mask.  Pad lanes carry zeroed limbs
     and ``valid_in=False`` so they can never contribute a True.
     """
+    from kaspa_tpu.resilience.faults import FAULTS
+
+    # mesh-specific fault point (a single wedged shard kills the whole
+    # shard_map dispatch); propagates into the device breaker like any
+    # other dispatch failure
+    FAULTS.fire("device.mesh.dispatch")
     n = active_size()
     px = np.asarray(px)
     b = px.shape[0]

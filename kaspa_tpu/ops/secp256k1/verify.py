@@ -20,6 +20,7 @@ from kaspa_tpu.observability import trace
 from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
+from kaspa_tpu.resilience.faults import FAULTS
 
 FP = bi.FP
 FN = bi.FN
@@ -71,6 +72,9 @@ def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarra
     px/py/r_canon: [B, 16] limb arrays; s_scalars/e_scalars: python-int
     scalar sequences (already reduced mod n); valid_in: [B] bool.
     """
+    # raise/wedge/slow the whole batch here — above every backend path, so
+    # the breaker in crypto/secp.py sees the failure whichever way it routes
+    FAULTS.fire("device.verify")
     from kaspa_tpu.ops import mesh
 
     n_mesh = mesh.active_size()
@@ -96,6 +100,7 @@ def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarra
 
 def ecdsa_verify(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in) -> np.ndarray:
     """Backend-dispatching batched ECDSA verify (see schnorr_verify)."""
+    FAULTS.fire("device.verify")
     from kaspa_tpu.ops import mesh
 
     n_mesh = mesh.active_size()

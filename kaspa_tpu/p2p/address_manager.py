@@ -166,6 +166,13 @@ class AddressManager:
             return [ip for ip in list(self._banned) if self.is_banned(ip)]
 
 
+# failed-dial backoff: 2s, 4s, 8s, ... capped at 5 min, each delay jittered
+# by a uniform 0.5x-1.5x factor so a network blip doesn't resynchronize
+# every node's reconnect storm onto the same tick
+RECONNECT_BACKOFF_BASE = 2.0
+RECONNECT_BACKOFF_MAX = 300.0
+
+
 class ConnectionManager:
     """Maintains outbound connections toward a target count.
 
@@ -180,6 +187,12 @@ class ConnectionManager:
         self.outbound_target = outbound_target
         self.tick_seconds = tick_seconds
         self._permanent: dict[NetAddress, int] = {}  # address -> retry attempts
+        # per-address reconnect gate: monotonic instant before which the
+        # address must not be redialed (exponential in consecutive failures)
+        self._next_dial: dict[NetAddress, float] = {}
+        self._fail_counts: dict[NetAddress, int] = {}
+        self._rng = random.Random(0xBACC0FF)
+        self._clock = time.monotonic
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.RLock()
@@ -229,20 +242,36 @@ class ConnectionManager:
             self.amgr.mark_connection_failure(address)
             return False
 
+    def _may_dial(self, address: NetAddress, now: float) -> bool:
+        with self._lock:
+            return self._next_dial.get(address, 0.0) <= now
+
+    def _note_dial(self, address: NetAddress, ok: bool) -> None:
+        """Update the per-address reconnect gate after a dial attempt."""
+        with self._lock:
+            if ok:
+                self._next_dial.pop(address, None)
+                self._fail_counts.pop(address, None)
+                return
+            n = self._fail_counts.get(address, 0)
+            self._fail_counts[address] = n + 1
+            delay = min(RECONNECT_BACKOFF_BASE * (2.0 ** n), RECONNECT_BACKOFF_MAX)
+            delay *= 0.5 + self._rng.random()  # jitter: decorrelate the fleet
+            self._next_dial[address] = self._clock() + delay
+
     def _tick(self) -> None:
+        now = self._clock()
         connected = self._connected_addresses()
         # permanent requests first (exponential backoff by attempt count)
         with self._lock:
             pending = [a for a in self._permanent if a not in connected]
         for addr in pending:
-            if self.amgr.is_banned(addr.ip):
+            if self.amgr.is_banned(addr.ip) or not self._may_dial(addr, now):
                 continue
-            if self._dial(addr):
-                with self._lock:
-                    self._permanent[addr] = 0
-            else:
-                with self._lock:
-                    self._permanent[addr] += 1
+            ok = self._dial(addr)
+            self._note_dial(addr, ok)
+            with self._lock:
+                self._permanent[addr] = 0 if ok else self._permanent[addr] + 1
         # fill toward the outbound target from the address book
         missing = self.outbound_target - len(self._connected_addresses())
         if missing <= 0:
@@ -252,5 +281,9 @@ class ConnectionManager:
                 break
             if self.amgr.is_banned(addr.ip) or addr in self.amgr.local_addresses:
                 continue  # never dial our own mapped/advertised address
-            if self._dial(addr):
+            if not self._may_dial(addr, now):
+                continue
+            ok = self._dial(addr)
+            self._note_dial(addr, ok)
+            if ok:
                 missing -= 1

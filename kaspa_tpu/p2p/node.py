@@ -14,6 +14,7 @@ Messages are (type, payload) tuples; types mirror p2p.proto payload names.
 
 from __future__ import annotations
 
+import os
 from collections import deque
 from dataclasses import dataclass, field
 from time import monotonic as _monotonic
@@ -23,6 +24,7 @@ from kaspa_tpu.consensus.stores import StatusesStore
 from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.utils.sync import LockCtx
 
 # p2p.proto payload types modeled this round
@@ -88,6 +90,22 @@ _MSG_MIN_VERSION = {
 # peers (flow_context.rs:827-838)
 _ACTIVATION_GATE_SECONDS = 24 * 60 * 60
 
+# peer misbehavior accounting (flows ProtocolError + the reference's
+# ban-score ladder): repeat offenses accumulate per connection; crossing
+# the threshold bans the peer's IP in the address manager, which both
+# refuses future inbound accepts and stops outbound redials
+PEER_BAN_SCORE = int(os.environ.get("KASPA_TPU_BAN_SCORE", "100"))
+# an IBD donor that stops making progress (no message advancing the sync
+# for this long) is abandoned — the one-active-sync slot must not be
+# wedgeable by a stalled or malicious peer
+IBD_DEADLINE_SECONDS = float(os.environ.get("KASPA_TPU_IBD_DEADLINE", "120"))
+
+_MISBEHAVIOR_POINTS = REGISTRY.counter_family(
+    "p2p_misbehavior_points", "reason", help="misbehavior points assessed, by offense"
+)
+_PEERS_BANNED = REGISTRY.counter("p2p_peers_banned", help="peers that crossed the ban-score threshold")
+_IBD_TIMEOUTS = REGISTRY.counter("p2p_ibd_timeouts", help="in-flight syncs abandoned for lack of progress")
+
 # serve-side SMT snapshot lifetime (prune_caches): a snapshot nobody has
 # requested for the TTL is dead weight (it holds the full lane/segment
 # export); one whose anchor the local pruning point has moved past gets a
@@ -106,7 +124,18 @@ def _activation_gate_blocks(target_time_per_block_ms: int) -> int:
 
 
 class ProtocolError(Exception):
-    """Peer misbehavior that warrants disconnect/ban (flows ProtocolError)."""
+    """Peer misbehavior that warrants disconnect/ban (flows ProtocolError).
+
+    ``points`` is the misbehavior score the reader loop assesses before
+    dropping the connection.  Handshake outcomes that reflect OUR state or
+    a misconfiguration rather than hostility (self-connection via our own
+    gossiped address, wrong network, version mismatch, busy sync slot) set
+    0 — banning by IP on those would take out every co-hosted node behind
+    the same address."""
+
+    def __init__(self, msg: str, points: int = 100):
+        super().__init__(msg)
+        self.points = points
 
 
 @dataclass
@@ -202,6 +231,22 @@ class Node:
         only, so it drops as soon as the anchor moves.
         """
         now = _monotonic() if now is None else now
+        if self._ibd:
+            # IBD progress deadline: _handle refreshes last_progress on
+            # every message from the donor; a donor that goes quiet past
+            # the deadline loses the (single) sync slot and the connection
+            last = self._ibd.setdefault("last_progress", now)
+            if now - last > IBD_DEADLINE_SECONDS:
+                stalled, self._ibd = self._ibd, {}
+                _IBD_TIMEOUTS.inc()
+                staging = stalled.get("staging")
+                if staging is not None:
+                    staging.cancel()
+                self._drop_ibd_pipeline()
+                donor = stalled.get("peer")
+                self.score_misbehavior(donor, "ibd_stall", 40)
+                if donor is not None and hasattr(donor, "close"):
+                    donor.close()
         pp = self.consensus.pruning_processor.pruning_point
         snap = getattr(self, "_pp_smt_snapshot", None)
         if snap is not None:
@@ -213,6 +258,28 @@ class Node:
         usnap = getattr(self, "_pp_utxo_snapshot", None)
         if usnap is not None and usnap[0] != pp:
             self._pp_utxo_snapshot = None
+
+    def score_misbehavior(self, peer, reason: str, points: int) -> bool:
+        """Assess misbehavior points against ``peer``; True once banned.
+
+        Per-connection accumulator with an IP-level consequence: crossing
+        PEER_BAN_SCORE bans the address in the address manager (inbound
+        accepts refused, outbound dials stopped, gossip filtered).  Callers
+        decide whether to also close the connection — the reader loop is
+        usually already unwinding it.
+        """
+        if peer is None:
+            return False
+        score = getattr(peer, "misbehavior_score", 0) + points
+        peer.misbehavior_score = score
+        _MISBEHAVIOR_POINTS.inc(reason, points)
+        if score < PEER_BAN_SCORE:
+            return False
+        _PEERS_BANNED.inc()
+        addr = getattr(peer, "peer_address", None)
+        if self.address_manager is not None and addr is not None:
+            self.address_manager.ban(addr.ip)
+        return True
 
     # --- hub / relay (flow_context.rs on_new_block -> broadcast) ---
 
@@ -257,6 +324,10 @@ class Node:
             peer._draining = False
 
     def _handle(self, peer: Peer, msg_type: str, payload) -> None:
+        # any message from the active IBD donor counts as sync progress
+        # (the deadline in prune_caches fires on silence, not slowness)
+        if self._ibd and self._ibd.get("peer") is peer:
+            self._ibd["last_progress"] = _monotonic()
         # tier gate: flows introduced in a later protocol version than the
         # negotiated one are refused (the reference simply never registers
         # them for the old tier, flow_context.rs:837-852)
@@ -268,10 +339,12 @@ class Node:
         if msg_type == MSG_VERSION:
             # handshake.rs: version negotiation incl. network match
             if isinstance(payload, dict) and payload.get("network", self.consensus.params.name) != self.consensus.params.name:
-                raise ProtocolError(f"network mismatch: {payload.get('network')}")
+                raise ProtocolError(f"network mismatch: {payload.get('network')}", points=0)
             peer_pv = payload.get("protocol_version", MIN_PROTOCOL_VERSION) if isinstance(payload, dict) else MIN_PROTOCOL_VERSION
             if peer_pv < MIN_PROTOCOL_VERSION:
-                raise ProtocolError(f"protocol version mismatch: ours {self.protocol_version}, peer {peer_pv}")
+                raise ProtocolError(
+                    f"protocol version mismatch: ours {self.protocol_version}, peer {peer_pv}", points=0
+                )
             # one day before Toccata activation, refuse pre-Toccata tiers:
             # a v<10 peer cannot serve/receive lane state and would fork
             # (flow_context.rs:827-841)
@@ -281,7 +354,7 @@ class Node:
             )
             if params.toccata_active(gate_daa) and peer_pv < 10:
                 raise ProtocolError(
-                    f"protocol v10 required near Toccata activation (peer advertises v{peer_pv})"
+                    f"protocol v10 required near Toccata activation (peer advertises v{peer_pv})", points=0
                 )
             peer.protocol_version = min(self.protocol_version, peer_pv)
             if isinstance(payload, dict) and payload.get("id") and payload["id"] == self.id:
@@ -298,7 +371,7 @@ class Node:
                         )
                 if hasattr(peer, "close"):
                     peer.close()
-                raise ProtocolError("self-connection detected (matching version id)")
+                raise ProtocolError("self-connection detected (matching version id)", points=0)
             # record the peer's advertised listen address for gossip
             # (flow_context.rs registers it with the address manager)
             if (
@@ -643,7 +716,12 @@ class Node:
         try:
             self.pipeline.validate_and_insert_block(block)
         except RuleError:
-            return  # invalid relay: reference would score/ban the peer
+            # invalid relay blocks are an offense, not an instant ban: an
+            # honest peer can relay a block it hasn't fully validated, but
+            # a stream of them crosses the threshold
+            if self.score_misbehavior(peer, "invalid_block", 40) and hasattr(peer, "close"):
+                peer.close()
+            return
         self.mining.handle_new_block_transactions(block.transactions, self.consensus.get_virtual_daa_score())
         self._try_unorphan(block.hash)
         self.broadcast_block(block)
@@ -754,7 +832,7 @@ class Node:
         if self._ibd:
             # one sync at a time: never race an in-flight (possibly staging)
             # IBD with a second header stream into the same consensus
-            raise ProtocolError("a sync is already in flight")
+            raise ProtocolError("a sync is already in flight", points=0)
         peer._headers_first = True
         peer.send(MSG_REQUEST_HEADERS, self.consensus.sink())
 

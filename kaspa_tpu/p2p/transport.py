@@ -20,6 +20,7 @@ queue overflows is dropped as too-slow.
 
 from __future__ import annotations
 
+import os
 import queue
 import socket
 import threading
@@ -30,6 +31,8 @@ _SEND_QUEUE_LIMIT = 4096  # frames; overflow => drop the peer (slow consumer)
 from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.p2p import wire
 from kaspa_tpu.p2p.node import MIN_PROTOCOL_VERSION, MSG_VERSION, Node, ProtocolError
+from kaspa_tpu.resilience import faults as fault_mod
+from kaspa_tpu.resilience.faults import FAULTS
 
 # codec cost only (socket IO excluded): encode is timed around
 # codec.encode in send(), decode around codec.decode in the reader loop —
@@ -123,6 +126,12 @@ class WirePeer:
             self.peer_address = None
         self.version_sent = outbound  # inbound reciprocates on VERSION receipt
         self.handshaken = False
+        self.misbehavior_score = 0
+        # a half-open socket (SYN accepted, VERSION never arrives) must not
+        # pin a reader thread forever; after the handshake the read deadline
+        # relaxes to read_timeout (0 = disabled — block indefinitely)
+        self.handshake_timeout = float(os.environ.get("KASPA_TPU_P2P_HANDSHAKE_TIMEOUT", "15"))
+        self.read_timeout = float(os.environ.get("KASPA_TPU_P2P_READ_TIMEOUT", "0"))
         # tier floor until the handshake negotiates (node._handle sets it)
         self.protocol_version = MIN_PROTOCOL_VERSION
         self.known_blocks: set = set()
@@ -138,6 +147,14 @@ class WirePeer:
         t0 = perf_counter_ns()
         frame = self.codec.encode(msg_type, payload)
         _ENC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
+        act = FAULTS.fire("p2p.send")
+        if act is not None:
+            if act.mode == "disconnect":
+                self.close()
+                return
+            frame = fault_mod.mangle_frame(frame, act)
+            if frame is None:  # drop: the frame silently never leaves
+                return
         _FRAMES_TX.inc()
         _BYTES_TX.inc(len(frame))
         _MSGS_TX.inc(msg_type)
@@ -177,6 +194,12 @@ class WirePeer:
         finally:
             self.close()
 
+    def _score(self, peer, reason: str, points: int) -> bool:
+        # test doubles and minimal node stubs don't carry the misbehavior
+        # ledger; treat them as never banning
+        score = getattr(self.node, "score_misbehavior", None)
+        return bool(score(peer, reason, points)) if score is not None else False
+
     def _read_exactly(self, n: int) -> bytes:
         buf = b""
         while len(buf) < n:
@@ -188,22 +211,46 @@ class WirePeer:
 
     def _reader_loop(self) -> None:
         try:
+            # handshake deadline: socket.timeout is an OSError subclass, so
+            # an expired deadline lands in the handler below and closes the
+            # peer — the reference's handshake timeout in connection_handler
+            self.sock.settimeout(self.handshake_timeout or None)
+            steady = False
             while self.alive:
+                act = FAULTS.fire("p2p.recv")
+                if act is not None and act.mode == "disconnect":
+                    raise ConnectionError("injected disconnect")
                 # frame read and payload decode are split so only codec work
                 # is timed — the header/body reads block on the peer
                 meta, body, nbytes = self.codec.read_frame(self._read_exactly)
                 t0 = perf_counter_ns()
-                msg_type, payload = self.codec.decode(meta, body)
+                try:
+                    msg_type, payload = self.codec.decode(meta, body)
+                except Exception:  # noqa: BLE001 - body didn't decode but the
+                    # frame header did, so the stream is still in sync: score
+                    # the peer and keep reading.  A repeat offender crosses
+                    # the ban threshold and is dropped + address-banned.
+                    if self._score(self, "malformed_frame", 40):
+                        raise ConnectionError("peer banned for malformed frames") from None
+                    continue
                 _DEC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
                 _FRAMES_RX.inc()
                 _BYTES_RX.inc(nbytes)
                 _MSGS_RX.inc(msg_type)
                 with self.node.lock:
                     self.node._handle(self, msg_type, payload)
+                if self.handshaken and not steady:
+                    steady = True
+                    self.sock.settimeout(self.read_timeout or None)
         except (ConnectionError, OSError):
             pass
         except ProtocolError as e:
-            # tell the peer WHY before dropping it (p2p.proto RejectMessage)
+            # protocol violations score per the error's own weight (benign
+            # handshake mismatches carry 0), and the peer is told WHY
+            # before dropping it (p2p.proto RejectMessage)
+            points = getattr(e, "points", 100)
+            if points:
+                self._score(self, "protocol_error", points)
             from kaspa_tpu.p2p.node import MSG_REJECT
 
             try:
@@ -215,8 +262,9 @@ class WirePeer:
                 pass
         except Exception:  # noqa: BLE001 - wire boundary: malformed frames,
             # codec decode errors, or consensus rejections from adversarial
-            # payloads all mean "drop the peer" (reference would score/ban)
-            pass
+            # payloads all mean "drop the peer", with misbehavior points so
+            # a repeat offender graduates to a ban
+            self._score(self, "malformed_frame", 40)
         finally:
             self.close()
 
@@ -307,7 +355,8 @@ def connect_outbound(node: Node, address: str, timeout: float = 10.0, codec=None
     the version handshake only negotiates the flow tier."""
     host, port = address.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)), timeout=timeout)
-    sock.settimeout(None)
+    # the reader loop owns the socket deadline from here (handshake_timeout,
+    # then read_timeout once handshaken)
     peer = WirePeer(node, sock, outbound=True, codec=codec)
     with node.lock:
         node.peers.append(peer)

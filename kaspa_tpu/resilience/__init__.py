@@ -1,0 +1,12 @@
+"""Chaos-engineering layer: deterministic fault injection, the device
+circuit breaker, and the hostile-load sustain harness.
+
+- ``faults``: seedable fault-point registry wired into the hot layers
+  (device dispatch, P2P transport, storage, VM fallback lane).
+- ``breaker``: device-dispatch circuit breaker tripping to the host
+  degraded lane, re-probing with exponential backoff.
+- ``sustain``: ``sim --hostile`` workload + SUSTAIN.json report
+  (ROADMAP item 5).
+"""
+
+from kaspa_tpu.resilience.faults import FAULTS, FaultInjected  # noqa: F401

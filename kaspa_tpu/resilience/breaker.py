@@ -1,0 +1,166 @@
+"""Device-dispatch circuit breaker with exponential re-probe backoff.
+
+State machine (the classic closed/open/half-open breaker, applied to the
+TPU batch-verify dispatch):
+
+    CLOSED     every dispatch goes to the device; N consecutive failures
+               (KASPA_TPU_BREAKER_THRESHOLD, default 3) trip to OPEN
+    OPEN       dispatches are denied — the caller routes the batch to the
+               host degraded lane — until the backoff window elapses
+               (base * 2^k, capped; KASPA_TPU_BREAKER_BACKOFF_BASE /
+               KASPA_TPU_BREAKER_BACKOFF_MAX, defaults 0.25s / 30s)
+    HALF_OPEN  exactly one probe dispatch is allowed through; success
+               re-arms (CLOSED, recovery latency recorded), failure
+               re-opens with a doubled backoff
+
+Determinism note: trips, probes and recoveries are driven by the
+*attempt* sequence (each ``allow() == True``), which is workload-
+determined; only the number of denied dispatches while OPEN depends on
+wall clock.  Transition records therefore carry the attempt index (the
+deterministic coordinate) and land in SUSTAIN.json's breaker section
+alongside the wall-clock recovery latencies.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from kaspa_tpu.observability.core import REGISTRY
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+_TRIPS = REGISTRY.counter_family("breaker_trips", "breaker", help="breaker transitions into OPEN")
+_PROBES = REGISTRY.counter_family("breaker_probes", "breaker", help="half-open probe dispatches")
+_RECOVERIES = REGISTRY.counter_family("breaker_recoveries", "breaker", help="breaker re-arms (probe succeeded)")
+_RECOVERY_LATENCY = REGISTRY.histogram(
+    "breaker_recovery_seconds", help="trip-to-recovery latency of the device breaker"
+)
+
+_MAX_TRANSITIONS = 256  # bounded transition log (oldest dropped)
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 3,
+        backoff_base: float = 0.25,
+        backoff_max: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self.name = name
+        self.failure_threshold = max(1, failure_threshold)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.state = CLOSED
+            self.consecutive_failures = 0
+            self.attempts = 0  # allow() == True count: the deterministic coordinate
+            self.denied = 0
+            self.trips = 0
+            self.probes = 0
+            self.recoveries = 0
+            self.recovery_latencies: list[float] = []
+            self.transitions: list[dict] = []
+            self._backoff_exp = 0
+            self._reopen_at = 0.0
+            self._tripped_at = 0.0
+
+    # --- the dispatch gate ------------------------------------------------
+
+    def allow(self) -> bool:
+        """True = dispatch to the device (counts as an attempt); False =
+        take the degraded lane."""
+        with self._lock:
+            if self.state == CLOSED:
+                self.attempts += 1
+                return True
+            if self.state == OPEN and self._clock() >= self._reopen_at:
+                self._transition(HALF_OPEN)
+                self.probes += 1
+                _PROBES.inc(self.name)
+                self.attempts += 1
+                return True
+            # OPEN inside the backoff window, or a HALF_OPEN probe already
+            # in flight on another thread
+            self.denied += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state != CLOSED:
+                latency = self._clock() - self._tripped_at
+                self.recovery_latencies.append(latency)
+                _RECOVERY_LATENCY.observe(latency)
+                self.recoveries += 1
+                _RECOVERIES.inc(self.name)
+                self._backoff_exp = 0
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == HALF_OPEN:
+                # failed probe: back off harder before the next one
+                self._backoff_exp += 1
+                self._open()
+            elif self.state == CLOSED and self.consecutive_failures >= self.failure_threshold:
+                self.trips += 1
+                _TRIPS.inc(self.name)
+                self._tripped_at = self._clock()
+                self._open()
+
+    def _open(self) -> None:
+        delay = min(self.backoff_base * (2.0**self._backoff_exp), self.backoff_max)
+        self._reopen_at = self._clock() + delay
+        self._transition(OPEN)
+
+    def _transition(self, to: str) -> None:
+        self.transitions.append({"attempt": self.attempts, "from": self.state, "to": to})
+        del self.transitions[:-_MAX_TRANSITIONS]
+        self.state = to
+
+    # --- reporting --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "attempts": self.attempts,
+                "denied": self.denied,
+                "trips": self.trips,
+                "probes": self.probes,
+                "recoveries": self.recoveries,
+                "recovery_latency_seconds": [round(x, 6) for x in self.recovery_latencies[-32:]],
+                "transitions": list(self.transitions[-32:]),
+            }
+
+
+_device_breaker: CircuitBreaker | None = None
+_device_lock = threading.Lock()
+
+
+def device_breaker() -> CircuitBreaker:
+    """The process-wide breaker guarding batched device signature verify
+    (env knobs: KASPA_TPU_BREAKER_THRESHOLD / _BACKOFF_BASE / _BACKOFF_MAX)."""
+    global _device_breaker
+    if _device_breaker is None:
+        with _device_lock:
+            if _device_breaker is None:
+                _device_breaker = CircuitBreaker(
+                    "device_verify",
+                    failure_threshold=int(os.environ.get("KASPA_TPU_BREAKER_THRESHOLD", "3")),
+                    backoff_base=float(os.environ.get("KASPA_TPU_BREAKER_BACKOFF_BASE", "0.25")),
+                    backoff_max=float(os.environ.get("KASPA_TPU_BREAKER_BACKOFF_MAX", "30")),
+                )
+                REGISTRY.register_collector("resilience", lambda: {"device_verify": _device_breaker.snapshot()})
+    return _device_breaker

@@ -1,0 +1,231 @@
+"""Deterministic, seedable fault-injection registry.
+
+Fault points are named strings compiled into the hot layers:
+
+    device.verify        batch signature dispatch (ops/secp256k1/verify.py)
+    device.mesh.dispatch sharded shard_map dispatch (ops/mesh.py)
+    vm.fallback.exec     one deferred VM fallback job (txscript/batch.py)
+    p2p.send             outgoing frame (p2p/transport.py)
+    p2p.recv             incoming frame read (p2p/transport.py)
+    storage.commit       write-batch commit (storage/kv.py, both engines)
+    storage.flush        python-engine log append (storage/kv.py)
+
+A *schedule* maps point name -> spec dict:
+
+    {"device.verify":    {"mode": "error", "hits": [2, 3, 4]},
+     "vm.fallback.exec": {"mode": "error", "every": 5, "max": 8},
+     "p2p.send":         {"mode": "corrupt", "after": 3, "max": 3}}
+
+Selection is by **hit index** (1-based count of times the point is
+reached), never by wall clock or unseeded randomness: hit ``k`` fires iff
+``k in hits``, or ``every and k % every == 0``, or ``after and k >=
+after`` — bounded by ``max`` total firings per point.  Two runs of the
+same workload under the same schedule therefore fire the same hits, and
+the event log (sorted by ``(point, hit)`` since pool threads may reach a
+point concurrently) is byte-identical.
+
+Modes:
+
+    error      raise FaultInjected at the point
+    wedge      sleep ``delay`` (default 0.05s) then raise FaultWedged —
+               a batch that hangs, then dies (a real hang would pin the
+               test harness)
+    slow       sleep ``delay`` (default 0.02s), then continue normally
+    stall      alias of slow (peer-stall reads)
+    corrupt / truncate / drop / disconnect / partial
+               cooperative: ``fire`` returns a FaultAction the call site
+               applies (flip frame bytes, cut a frame short, drop it,
+               sever the connection, tear a storage append)
+
+Arming: ``FAULTS.configure(schedule, seed)`` in-process, or the
+``KASPA_TPU_FAULTS`` env var (inline JSON, or ``@/path/to/schedule.json``)
+plus ``KASPA_TPU_FAULT_SEED`` for subprocesses — read at import so a
+freshly spawned node arms before any fault point is reached.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+from kaspa_tpu.observability.core import REGISTRY
+
+_INJECTIONS = REGISTRY.counter_family("fault_injections", "point", help="fired fault injections by point")
+
+_SLEEP_DEFAULTS = {"wedge": 0.05, "slow": 0.02, "stall": 0.02}
+
+
+class FaultInjected(Exception):
+    """Raised at an armed fault point (modes error/wedge).
+
+    Call sites treat it as a transient infrastructure failure — the VM
+    fallback lane retries the job, the device breaker counts it toward a
+    trip — so an injected fault can degrade throughput but never change a
+    consensus decision.
+    """
+
+    def __init__(self, point: str, hit: int, mode: str = "error"):
+        super().__init__(f"fault injected at {point} (hit {hit}, mode {mode})")
+        self.point = point
+        self.hit = hit
+        self.mode = mode
+
+
+class FaultWedged(FaultInjected):
+    """A dispatch that hung for ``delay`` and then died."""
+
+
+class FaultAction:
+    """Cooperative fault handed back to the call site.
+
+    ``rng`` is seeded from (registry seed, point, hit) so any random
+    choice the call site makes (which byte to flip, where to cut) is
+    reproducible.
+    """
+
+    __slots__ = ("point", "hit", "mode", "delay", "rng")
+
+    def __init__(self, point: str, hit: int, mode: str, delay: float, seed: int):
+        self.point = point
+        self.hit = hit
+        self.mode = mode
+        self.delay = delay
+        self.rng = random.Random((seed << 20) ^ hash(point) ^ (hit * 0x9E3779B9))
+
+
+class FaultRegistry:
+    """Process-wide registry; near-zero cost while disarmed (one attribute
+    load and a branch per compiled-in fault point)."""
+
+    def __init__(self):
+        self._armed = False
+        self._lock = threading.Lock()
+        self._schedule: dict[str, dict] = {}
+        self._seed = 0
+        self._hits: dict[str, int] = {}
+        self._fired: dict[str, int] = {}
+        self._events: list[tuple[str, int, str]] = []
+
+    # --- configuration ----------------------------------------------------
+
+    def configure(self, schedule: dict | None, seed: int = 0) -> None:
+        """Arm ``schedule`` (point -> spec) with ``seed``; resets all hit
+        counters and the event log.  ``None``/empty disarms."""
+        with self._lock:
+            self._schedule = dict(schedule or {})
+            self._seed = int(seed)
+            self._hits = {}
+            self._fired = {}
+            self._events = []
+            self._armed = bool(self._schedule)
+
+    def clear(self) -> None:
+        self.configure(None)
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    # --- the hot-path hook ------------------------------------------------
+
+    def fire(self, point: str) -> FaultAction | None:
+        """Count a hit at ``point``; raise/sleep/return per the schedule.
+
+        Returns None when disarmed, unscheduled, or this hit does not
+        match; raises FaultInjected/FaultWedged for error/wedge modes;
+        sleeps and returns None for slow/stall; returns a FaultAction for
+        cooperative modes.
+        """
+        if not self._armed:
+            return None
+        with self._lock:
+            spec = self._schedule.get(point)
+            if spec is None:
+                return None
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit
+            if not self._matches(spec, point, hit):
+                return None
+            self._fired[point] = self._fired.get(point, 0) + 1
+            mode = spec.get("mode", "error")
+            self._events.append((point, hit, mode))
+        _INJECTIONS.inc(point)
+        delay = float(spec.get("delay", _SLEEP_DEFAULTS.get(mode, 0.0)))
+        if mode == "error":
+            raise FaultInjected(point, hit, mode)
+        if mode == "wedge":
+            time.sleep(delay)
+            raise FaultWedged(point, hit, mode)
+        if mode in ("slow", "stall"):
+            time.sleep(delay)
+            return None
+        return FaultAction(point, hit, mode, delay, self._seed)
+
+    def _matches(self, spec: dict, point: str, hit: int) -> bool:
+        limit = spec.get("max")
+        if limit is not None and self._fired.get(point, 0) >= limit:
+            return False
+        hits = spec.get("hits")
+        if hits is not None and hit in hits:
+            return True
+        every = spec.get("every")
+        if every and hit % every == 0:
+            return True
+        after = spec.get("after")
+        if after is not None and hit >= after and hits is None and not every:
+            return True
+        return False
+
+    # --- reporting --------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        """Fired injections as dicts, sorted by (point, hit) — the sort
+        makes the log byte-identical even when pool threads interleave."""
+        with self._lock:
+            evs = sorted(self._events)
+        return [{"point": p, "hit": h, "mode": m} for p, h, m in evs]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "armed": self._armed,
+                "seed": self._seed,
+                "points": {
+                    p: {"hits": self._hits.get(p, 0), "fired": self._fired.get(p, 0)}
+                    for p in sorted(set(self._hits) | set(self._schedule))
+                },
+            }
+
+
+FAULTS = FaultRegistry()
+REGISTRY.register_collector("faults", FAULTS.snapshot)
+
+
+def mangle_frame(frame: bytes, act: FaultAction) -> bytes | None:
+    """Apply a cooperative frame fault; returns the mutated frame, or None
+    for ``drop``.  Corruption targets the body region (offset >= 8) so the
+    receiver sees a decode error, not a desynced length field."""
+    if act.mode == "drop":
+        return None
+    if act.mode == "truncate":
+        return frame[: max(1, len(frame) // 2)]
+    if act.mode == "corrupt":
+        i = 8 + act.rng.randrange(len(frame) - 8) if len(frame) > 8 else len(frame) - 1
+        return frame[:i] + bytes([frame[i] ^ 0x5A]) + frame[i + 1 :]
+    return frame
+
+
+def _configure_from_env() -> None:
+    raw = os.environ.get("KASPA_TPU_FAULTS")
+    if not raw:
+        return
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    FAULTS.configure(json.loads(raw), int(os.environ.get("KASPA_TPU_FAULT_SEED", "0")))
+
+
+_configure_from_env()

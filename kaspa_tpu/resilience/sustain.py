@@ -1,0 +1,190 @@
+"""Hostile-load sustain run: the chaos-engineering acceptance harness.
+
+Builds a hostile workload (multisig/P2SH script mix that bypasses the
+device fast path, plus an attacker side-DAG forking from genesis with
+~1.5x the block count — a deep reorg when its heavier chain lands), then
+replays it twice into fresh consensus instances:
+
+  1. fault-free, in build order — the baseline fingerprints
+  2. under a seeded fault schedule, delivered in shuffled windows through
+     an orphan-tolerant queue (blocks held until their parents arrive)
+
+and asserts the post-recovery end state (sink, utxo_commitment,
+virtual_daa_score) is identical.  Every injected fault is transient
+infrastructure noise — a device dispatch that errors into the breaker's
+degraded lane, a VM fallback job that retries — so the faulted run must
+converge to the byte-identical fault-free state; ``matches_fault_free``
+in SUSTAIN.json is the acceptance bit.
+
+The report splits deterministic data (fault event log, fingerprints)
+from wall-clock data (throughput, breaker recovery latencies, lock-hold
+traces): two runs of the same workload + schedule + seed produce
+byte-identical ``deterministic`` sections.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from dataclasses import asdict, replace
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.resilience.breaker import device_breaker
+from kaspa_tpu.resilience.faults import FAULTS
+from kaspa_tpu.sim.simulator import SimConfig, simulate
+from kaspa_tpu.utils.sync import lock_trace_snapshot, set_lock_debug
+
+# metric counters whose faulted-replay deltas land in SUSTAIN.json
+_DELTA_COUNTERS = (
+    "secp_degraded_dispatches",
+    "secp_degraded_jobs",
+    "txscript_vm_fault_retries",
+    "kv_journal_repairs",
+)
+
+
+def default_schedule() -> dict:
+    """The stock hostile schedule: four consecutive device-verify errors
+    (trips the breaker, then fails its first probe — exercising trip,
+    degraded lane, backoff doubling, and recovery) plus every-5th VM
+    fallback job erroring (exercising the retry lane), capped at 8."""
+    return {
+        "device.verify": {"mode": "error", "hits": [2, 3, 4, 5]},
+        "vm.fallback.exec": {"mode": "error", "every": 5, "max": 8},
+    }
+
+
+def build_workload(cfg: SimConfig) -> dict:
+    """Hostile main DAG plus an attacker fork from the same genesis.
+
+    The attacker sim runs with seed+1 (distinct miners/keys) and ~1.5x
+    the blocks, so once its blocks are all delivered its chain carries
+    more blue work and the virtual reorgs deep past the main DAG."""
+    main = simulate(cfg)
+    attacker = simulate(
+        replace(cfg, num_blocks=max(cfg.num_blocks * 3 // 2, cfg.num_blocks + 1), seed=cfg.seed + 1)
+    )
+    return {"cfg": cfg, "main": main, "attacker": attacker, "blocks": main.blocks + attacker.blocks}
+
+
+def _fingerprints(consensus: Consensus) -> dict:
+    sink = consensus.sink()
+    return {
+        "sink": sink.hex(),
+        "utxo_commitment": consensus.multisets[sink].finalize().hex(),
+        "virtual_daa_score": consensus.get_virtual_daa_score(),
+    }
+
+
+def _insert(consensus: Consensus, block) -> None:
+    status = consensus.validate_and_insert_block(block)
+    assert status in ("utxo_valid", "utxo_pending"), f"sustain replay rejected block: {status}"
+
+
+def _orphan_tolerant_replay(consensus: Consensus, blocks: list, seed: int, window: int = 8) -> None:
+    """Deliver ``blocks`` in deterministically shuffled windows; a block
+    whose parents have not arrived is parked and flushed once they do —
+    the orphan-pool discipline a real node applies to out-of-order
+    gossip, here driving the faulted run's out-of-order stress."""
+    rng = random.Random(seed ^ 0x5EED)
+    order: list = []
+    for i in range(0, len(blocks), window):
+        chunk = list(blocks[i : i + window])
+        rng.shuffle(chunk)
+        order.extend(chunk)
+
+    def ready(b) -> bool:
+        return all(consensus.storage.headers.has(p) for p in b.header.direct_parents())
+
+    pending: dict[bytes, object] = {}
+    for b in order:
+        if not ready(b):
+            pending[b.hash] = b
+            continue
+        _insert(consensus, b)
+        progress = True
+        while progress:
+            progress = False
+            for h, pb in list(pending.items()):
+                if ready(pb):
+                    del pending[h]
+                    _insert(consensus, pb)
+                    progress = True
+    assert not pending, f"{len(pending)} orphans never became insertable"
+
+
+def _counter_value(counters: dict, name: str):
+    v = counters.get(name, 0)
+    return dict(v) if isinstance(v, dict) else v
+
+
+def _delta(before: dict, after: dict, name: str):
+    b, a = _counter_value(before, name), _counter_value(after, name)
+    if isinstance(a, dict):
+        b = b if isinstance(b, dict) else {}
+        return {k: a[k] - b.get(k, 0) for k in sorted(a) if a[k] - b.get(k, 0)}
+    return a - (b if isinstance(b, (int, float)) else 0)
+
+
+def run_sustain(
+    cfg: SimConfig,
+    schedule: dict | None = None,
+    seed: int = 0,
+    out: str | None = None,
+    workload: dict | None = None,
+) -> dict:
+    """Run the hostile sustain benchmark; returns (and optionally writes
+    to ``out``) the SUSTAIN.json report dict."""
+    schedule = default_schedule() if schedule is None else schedule
+    wl = workload if workload is not None else build_workload(cfg)
+    blocks = wl["blocks"]
+
+    # fault-free baseline first, while nothing is armed
+    FAULTS.clear()
+    baseline = Consensus(wl["main"].params)
+    for b in blocks:
+        _insert(baseline, b)
+    base_fp = _fingerprints(baseline)
+
+    breaker = device_breaker()
+    breaker.reset()
+    set_lock_debug(True)
+    before = REGISTRY.snapshot()["counters"]
+    FAULTS.configure(schedule, seed)
+    try:
+        faulted = Consensus(wl["main"].params)
+        t0 = time.perf_counter()
+        _orphan_tolerant_replay(faulted, blocks, seed)
+        elapsed = time.perf_counter() - t0
+        events = FAULTS.events()
+    finally:
+        FAULTS.clear()
+        set_lock_debug(False)
+    after = REGISTRY.snapshot()["counters"]
+    fp = _fingerprints(faulted)
+
+    report = {
+        "config": {**asdict(cfg), "fault_seed": seed, "schedule": schedule},
+        "deterministic": {
+            "blocks": len(blocks),
+            "events": events,
+            "fingerprints": fp,
+            "fault_free_fingerprints": base_fp,
+            "matches_fault_free": fp == base_fp,
+        },
+        "breaker": breaker.snapshot(),
+        "metrics": {
+            "replay_seconds": round(elapsed, 3),
+            "blocks_per_sec": round(len(blocks) / elapsed, 2) if elapsed else None,
+            "fault_injections": _delta(before, after, "fault_injections"),
+            **{name: _delta(before, after, name) for name in _DELTA_COUNTERS},
+        },
+        "lock_traces": lock_trace_snapshot(),
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return report

@@ -35,13 +35,30 @@ def main() -> None:
         help="shard the replay's batch verify + muhash over N devices ('auto' = all visible)",
     )
     p.add_argument("--json", action="store_true", help="emit one JSON line")
+    p.add_argument(
+        "--hostile", action="store_true",
+        help="hostile-load sustain run: multisig/P2SH fast-path-bypass script mix, "
+        "attacker-fork deep reorg, out-of-order delivery; writes SUSTAIN.json",
+    )
+    p.add_argument(
+        "--faults", default="default", metavar="SPEC",
+        help="fault schedule for --hostile: 'default', 'none', inline JSON, or @/path/to/schedule.json",
+    )
+    p.add_argument(
+        "--sustain-out", default="SUSTAIN.json", metavar="PATH",
+        help="where --hostile writes its report (default SUSTAIN.json)",
+    )
     args = p.parse_args()
 
     mesh_size = mesh.configure(args.mesh)
     cfg = SimConfig(
         bps=args.bps, delay=args.delay, num_miners=args.miners,
         num_blocks=args.blocks, txs_per_block=args.tpb, seed=args.seed,
+        hostile=args.hostile,
     )
+    if args.hostile:
+        _run_hostile(cfg, args)
+        return
     res = simulate(cfg)
     elapsed, fresh = replay(res)
     sink = fresh.sink()
@@ -67,6 +84,48 @@ def main() -> None:
             f"replayed in {out['replay_seconds']}s = {out['replay_blocks_per_sec']} blocks/s "
             f"({out['realtime_factor']}x the {args.bps}-BPS real-time rate, mesh {mesh_size})"
         )
+
+
+def _parse_schedule(spec: str):
+    from kaspa_tpu.resilience.sustain import default_schedule
+
+    if spec == "default":
+        return default_schedule()
+    if spec == "none":
+        return {}
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return json.load(f)
+    return json.loads(spec)
+
+
+def _run_hostile(cfg, args) -> None:
+    from kaspa_tpu.resilience.sustain import run_sustain
+
+    report = run_sustain(cfg, schedule=_parse_schedule(args.faults), seed=args.seed, out=args.sustain_out)
+    det, brk = report["deterministic"], report["breaker"]
+    summary = {
+        "blocks": det["blocks"],
+        "matches_fault_free": det["matches_fault_free"],
+        "fault_events": len(det["events"]),
+        "breaker_trips": brk["trips"],
+        "breaker_recoveries": brk["recoveries"],
+        "degraded_dispatches": report["metrics"]["secp_degraded_dispatches"],
+        "replay_seconds": report["metrics"]["replay_seconds"],
+        "sink": det["fingerprints"]["sink"],
+        "utxo_commitment": det["fingerprints"]["utxo_commitment"],
+        "sustain_out": args.sustain_out,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"sustain: {det['blocks']} blocks, {len(det['events'])} faults injected, "
+            f"breaker trips={brk['trips']} recoveries={brk['recoveries']}, "
+            f"matches_fault_free={det['matches_fault_free']} -> {args.sustain_out}"
+        )
+    if not det["matches_fault_free"]:
+        raise SystemExit(2)
 
 
 if __name__ == "__main__":

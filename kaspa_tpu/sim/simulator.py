@@ -18,7 +18,7 @@ from dataclasses import dataclass
 
 from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.consensus.consensus import Consensus
-from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+from kaspa_tpu.consensus.model import ScriptPublicKey, Transaction, TransactionInput, TransactionOutput
 from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.consensus.mass import BlockMassLimits, NonContextualMasses
 from kaspa_tpu.consensus.model.tx import ComputeCommit, SUBNETWORK_ID_NATIVE
@@ -36,6 +36,12 @@ class SimConfig:
     num_blocks: int = 64
     txs_per_block: int = 8
     seed: int = 42
+    # hostile workload: a deterministic fraction of P2PK spends split into
+    # bare-multisig + P2SH outputs, whose later spends bypass the device
+    # fast path entirely (they ride the host-VM fallback lane) — the
+    # script mix the hostile-load sustain run stresses
+    hostile: bool = False
+    hostile_fraction: float = 0.4
 
 
 @dataclass
@@ -49,12 +55,22 @@ class SimResult:
 
 
 class Miner:
-    def __init__(self, idx: int, rng: random.Random):
+    def __init__(self, idx: int, rng: random.Random, hostile: bool = False):
         self.idx = idx
         self.seckey = rng.randrange(1, eclib.N)
         self.pubkey = eclib.schnorr_pubkey(self.seckey)
         self.spk = standard.pay_to_pub_key(self.pubkey)
         self.miner_data = MinerData(self.spk, extra_data=f"miner-{idx}".encode())
+        self.hostile = hostile
+        if hostile:
+            # hostile-mode script destinations (extra rng draws happen only
+            # here, so non-hostile DAGs stay byte-identical per seed):
+            # a 2-of-3 bare schnorr multisig and a trivially-redeemable P2SH
+            self.ms_keys = [rng.randrange(1, eclib.N) for _ in range(3)]
+            self.ms_pubs = [eclib.schnorr_pubkey(k) for k in self.ms_keys]
+            self.ms_spk = ScriptPublicKey(0, standard.multisig_redeem_script(self.ms_pubs, 2))
+            self.p2sh_redeem = bytes([0x51, 0x87])  # OP_1 OP_EQUAL
+            self.p2sh_spk = standard.pay_to_script_hash_script(self.p2sh_redeem)
 
 
 def _make_tx(miner: Miner, outpoint, entry, rng: random.Random, mass_calculator=None) -> Transaction:
@@ -78,12 +94,81 @@ def _make_tx(miner: Miner, outpoint, entry, rng: random.Random, mass_calculator=
     return tx
 
 
+def _sign_and_finish(tx: Transaction, entry, miner: Miner, rng: random.Random, mass_calculator) -> Transaction:
+    """Storage mass + single-input P2PK schnorr signature (shared tail)."""
+    tx.storage_mass = mass_calculator.calc_contextual_masses(tx, [entry])
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+    sig = eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32))
+    tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+    tx._id_cache = None
+    return tx
+
+
+def _make_hostile_split_tx(miner: Miner, outpoint, entry, rng: random.Random, mass_calculator) -> Transaction:
+    """Spend a P2PK UTXO into one multisig + one P2SH output: the next
+    spends of those outputs are host-VM-lane work (fast-path bypass)."""
+    half = entry.amount // 2
+    if half == 0:
+        return None
+    outputs = [TransactionOutput(half, miner.ms_spk), TransactionOutput(entry.amount - half, miner.p2sh_spk)]
+    inp = TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))
+    tx = Transaction(0, [inp], outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+    return _sign_and_finish(tx, entry, miner, rng, mass_calculator)
+
+
+def _push(data: bytes) -> bytes:
+    assert len(data) <= 75
+    return bytes([len(data)]) + data
+
+
+def _spend_multisig_tx(miner: Miner, outpoint, entry, rng: random.Random, mass_calculator) -> Transaction:
+    """2-of-3 bare multisig spend back to the miner's P2PK.
+
+    Signatures are pushed in key order (the VM scans keys forward); the
+    worst-case sig-op charge is 3 (sig #2 burning a miss on key #1), hence
+    the committed budget.
+    """
+    half = entry.amount // 2
+    if half == 0:
+        return None
+    outputs = [TransactionOutput(half, miner.spk), TransactionOutput(entry.amount - half, miner.spk)]
+    inp = TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(3))
+    tx = Transaction(0, [inp], outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+    tx.storage_mass = mass_calculator.calc_contextual_masses(tx, [entry])
+    reused = chash.SigHashReusedValues()
+    msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+    sig_script = b""
+    for key in (miner.ms_keys[0], miner.ms_keys[2]):
+        sig = eclib.schnorr_sign(msg, key, rng.randbytes(32))
+        sig_script += _push(sig + bytes([chash.SIG_HASH_ALL]))
+    tx.inputs[0].signature_script = sig_script
+    tx._id_cache = None
+    return tx
+
+
+def _spend_p2sh_tx(miner: Miner, outpoint, entry, mass_calculator) -> Transaction:
+    """P2SH spend (trivial OP_1 OP_EQUAL redeem): no signatures at all,
+    pure VM-lane script execution."""
+    half = entry.amount // 2
+    if half == 0:
+        return None
+    outputs = [TransactionOutput(half, miner.spk), TransactionOutput(entry.amount - half, miner.spk)]
+    inp = TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(0))
+    tx = Transaction(0, [inp], outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+    tx.storage_mass = mass_calculator.calc_contextual_masses(tx, [entry])
+    # sig script: OP_1 (redeem's EQUAL operand) then the redeem push
+    tx.inputs[0].signature_script = bytes([0x51]) + _push(miner.p2sh_redeem)
+    tx._id_cache = None
+    return tx
+
+
 def simulate(cfg: SimConfig) -> SimResult:
     """Build a DAG with one authoritative consensus + per-miner delayed views."""
     rng = random.Random(cfg.seed)
     params = simnet_params(bps=cfg.bps)
     consensus = Consensus(params)
-    miners = [Miner(i, rng) for i in range(cfg.num_miners)]
+    miners = [Miner(i, rng, hostile=cfg.hostile) for i in range(cfg.num_miners)]
 
     t0 = time.perf_counter()
     events = []
@@ -128,11 +213,20 @@ def simulate(cfg: SimConfig) -> SimResult:
                     continue
                 if view.get(outpoint) is None:
                     continue
-                if entry.script_public_key != miner.spk:
-                    continue
                 if entry.is_coinbase and entry.block_daa_score + params.coinbase_maturity > pov_daa_score:
                     continue
-                tx = _make_tx(miner, outpoint, entry, rng, mass_calc)
+                spk = entry.script_public_key
+                if spk == miner.spk:
+                    if cfg.hostile and rng.random() < cfg.hostile_fraction:
+                        tx = _make_hostile_split_tx(miner, outpoint, entry, rng, mass_calc)
+                    else:
+                        tx = _make_tx(miner, outpoint, entry, rng, mass_calc)
+                elif cfg.hostile and spk == miner.ms_spk:
+                    tx = _spend_multisig_tx(miner, outpoint, entry, rng, mass_calc)
+                elif cfg.hostile and spk == miner.p2sh_spk:
+                    tx = _spend_p2sh_tx(miner, outpoint, entry, mass_calc)
+                else:
+                    continue
                 if tx is None:
                     continue
                 # template-builder discipline: stop at the per-dimension

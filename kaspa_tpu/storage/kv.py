@@ -18,6 +18,14 @@ import struct
 import subprocess
 import threading
 
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.resilience.faults import FAULTS, FaultInjected
+
+_JOURNAL_REPAIRS = REGISTRY.counter(
+    "kv_journal_repairs", help="torn log tails truncated back to the last valid frame on replay"
+)
+_TORN_BYTES = REGISTRY.counter("kv_journal_torn_bytes", help="garbage bytes discarded by journal repair")
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvstore")
 _SRC = os.path.join(_NATIVE_DIR, "kvstore.cc")
 _HEADERS = (os.path.join(_NATIVE_DIR, "arena.h"),)
@@ -108,6 +116,9 @@ class _NativeEngine:
             raise IOError(f"kv_batch_begin failed: {rc}")
 
     def batch_commit(self):
+        # fires BEFORE the native commit: the engine's own crash-safety
+        # (CRC-framed atomic batch) must absorb the abandoned batch
+        FAULTS.fire("storage.commit")
         rc = self._lib.kv_batch_commit(self._h)
         if rc != 0:
             raise IOError(f"kv_batch_commit failed: {rc}")
@@ -215,6 +226,15 @@ class _PythonEngine:
                     self.index.pop(key, None)
                 p += vlen
             off = end + 4
+        if off < len(data):
+            # torn tail (crash mid-frame): truncate back to the last valid
+            # frame so the append handle extends the *valid* prefix —
+            # without this, later frames land after garbage and are
+            # silently orphaned on the next replay
+            _JOURNAL_REPAIRS.inc()
+            _TORN_BYTES.inc(len(data) - off)
+            with open(self.path, "r+b") as f:
+                f.truncate(off)
 
     def put(self, key, value):
         self._pending += bytes([0]) + struct.pack("<II", len(key), len(value)) + key + value
@@ -234,8 +254,30 @@ class _PythonEngine:
         if not self._pending:
             return
         payload = bytes(self._pending)
-        self._log.write(b"KBAT" + struct.pack("<I", len(payload)) + payload + struct.pack("<I", zlib.crc32(payload)))
-        self._log.flush()
+        frame = b"KBAT" + struct.pack("<I", len(payload)) + payload + struct.pack("<I", zlib.crc32(payload))
+        act = FAULTS.fire("storage.flush")
+        if act is not None and act.mode == "partial":
+            # simulated crash mid-write: a deterministic prefix of the frame
+            # hits the disk, the rest never does.  _pending is retained and
+            # the torn tail is left behind — replay truncates it on reopen.
+            cut = act.rng.randrange(1, len(frame))
+            self._log.write(frame[:cut])
+            self._log.flush()
+            raise FaultInjected("storage.flush", act.hit, act.mode)
+        start = self._log.tell()
+        try:
+            self._log.write(frame)
+            self._log.flush()
+        except Exception:
+            # atomic append: a failed/short write must not leave a torn
+            # frame for the *next* flush to bury — roll the file back to
+            # the pre-write offset and keep _pending for a retry
+            try:
+                self._log.seek(start)
+                self._log.truncate(start)
+            except OSError:
+                pass
+            raise
         self._pending = bytearray()
 
     def get(self, key):
@@ -248,6 +290,9 @@ class _PythonEngine:
         self._batch = True
 
     def batch_commit(self):
+        # same placement as the native engine: the abandoned batch must be
+        # absorbed by the CRC frame discipline, not half-applied
+        FAULTS.fire("storage.commit")
         self._batch = False
         self._flush()
 

@@ -36,6 +36,7 @@ from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.crypto import secp
 from kaspa_tpu.observability import trace
 from kaspa_tpu.observability.core import REGISTRY, SIZE_BUCKETS
+from kaspa_tpu.resilience.faults import FAULTS, FaultInjected
 from kaspa_tpu.txscript import standard
 from kaspa_tpu.txscript.caches import SigCache
 
@@ -46,6 +47,9 @@ _SIGCACHE_SKIPS = REGISTRY.counter("txscript_batch_sigcache_skips", help="jobs a
 _VM_FALLBACKS = REGISTRY.counter("txscript_vm_fallbacks", help="inputs routed to the host VM instead of the batch")
 _FALLBACK_BATCH = REGISTRY.histogram(
     "txscript_fallback_batch_size", SIZE_BUCKETS, help="deferred VM fallback jobs per dispatch"
+)
+_VM_RETRIES = REGISTRY.counter(
+    "txscript_vm_fault_retries", help="VM fallback jobs retried after an injected transient fault"
 )
 
 
@@ -103,12 +107,68 @@ def _run_fallback(job: _FallbackJob) -> Exception | None:
     Runs on pool threads: the engine instance is job-local; the shared
     SigCache is internally locked; SigHashReusedValues memoization races
     are benign (idempotent writes of identical digests).
+
+    An injected ``vm.fallback.exec`` fault is a *transient infrastructure*
+    failure, not a script verdict: the job retries, so fault schedules can
+    never flip a consensus decision (the sustain run's sink-identity check
+    depends on this).
     """
-    try:
-        job.run()
-        return None
-    except Exception as e:  # noqa: BLE001 - VM raises on invalid script
-        return e
+    while True:
+        try:
+            FAULTS.fire("vm.fallback.exec")
+            job.run()
+            return None
+        except FaultInjected:
+            _VM_RETRIES.inc()
+            continue
+        except Exception as e:  # noqa: BLE001 - VM raises on invalid script
+            return e
+
+
+# in-flight accounting for the shared pool so daemon shutdown can drain
+# the deferred VM lane instead of abandoning futures mid-dispatch
+_inflight_lock = threading.Lock()
+_inflight = 0
+_inflight_zero = threading.Event()
+_inflight_zero.set()
+
+
+def _submit_tracked(pool: ThreadPoolExecutor, job: _FallbackJob):
+    global _inflight
+    with _inflight_lock:
+        _inflight += 1
+        _inflight_zero.clear()
+
+    def run():
+        global _inflight
+        try:
+            return _run_fallback(job)
+        finally:
+            with _inflight_lock:
+                _inflight -= 1
+                if _inflight == 0:
+                    _inflight_zero.set()
+
+    return pool.submit(run)
+
+
+def drain_fallback_pool(timeout: float = 10.0) -> bool:
+    """Block until every in-flight deferred VM job has resolved (True) or
+    the timeout expires (False).  Dispatchers joining their own futures is
+    the common case; this is the daemon-shutdown barrier."""
+    return _inflight_zero.wait(timeout)
+
+
+def shutdown_fallback_pool(timeout: float = 10.0) -> bool:
+    """Drain, then retire the shared executor (a later dispatch lazily
+    rebuilds it).  Returns whether the drain completed in time."""
+    global _pool
+    drained = drain_fallback_pool(timeout)
+    with _pool_lock:
+        pool, _pool = _pool, None
+    if pool is not None:
+        pool.shutdown(wait=False)
+    return drained
 
 
 class BatchScriptChecker:
@@ -226,7 +286,7 @@ class BatchScriptChecker:
             _FALLBACK_BATCH.observe(len(fallbacks))
             if self._effective_workers(len(fallbacks)) > 1:
                 pool = _fallback_pool()
-                pending = [pool.submit(_run_fallback, j) for j in fallbacks]
+                pending = [_submit_tracked(pool, j) for j in fallbacks]
 
         schnorr = [j for j in self._jobs if j.kind == "schnorr"]
         ecdsa = [j for j in self._jobs if j.kind == "ecdsa"]

@@ -1,0 +1,243 @@
+"""Peer-facing resilience: handshake deadlines, misbehavior scoring to a
+ban, injected wire faults, reconnect backoff, and the IBD progress
+deadline.
+
+The shape under test: an adversarial or broken peer costs bounded
+resources (one reader thread until a deadline, 40 points per malformed
+frame until a ban) and a flapping address is redialed on an exponential,
+jittered schedule instead of a tight loop.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.p2p import wire
+from kaspa_tpu.p2p.address_manager import (
+    RECONNECT_BACKOFF_BASE,
+    RECONNECT_BACKOFF_MAX,
+    AddressManager,
+    ConnectionManager,
+    NetAddress,
+)
+from kaspa_tpu.p2p.node import MSG_VERSION, Node
+from kaspa_tpu.p2p.transport import P2PServer, connect_outbound
+from kaspa_tpu.resilience.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _wait(predicate, timeout: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _recv_eof(sock: socket.socket, timeout: float) -> bool:
+    """True if the remote closes the connection within ``timeout``."""
+    sock.settimeout(timeout)
+    try:
+        while True:
+            if sock.recv(4096) == b"":
+                return True
+    except (socket.timeout, ConnectionError, OSError):
+        return False
+
+
+def test_half_open_socket_reaped_by_handshake_deadline(monkeypatch):
+    """A peer that connects and never speaks (SYN flood residue, wedged
+    middlebox) is dropped at the handshake deadline instead of pinning a
+    reader thread forever."""
+    monkeypatch.setenv("KASPA_TPU_P2P_HANDSHAKE_TIMEOUT", "0.5")
+    node = Node(Consensus(simnet_params(bps=2)), "victim")
+    server = P2PServer(node, port=0)
+    server.start()
+    try:
+        host, port = server.address.rsplit(":", 1)
+        raw = socket.create_connection((host, int(port)), timeout=5.0)
+        try:
+            assert _wait(lambda: len(node.peers) == 1, 5.0), "accept never registered"
+            # send nothing: the handshake deadline must reap the peer
+            assert _recv_eof(raw, 5.0), "half-open socket was not closed"
+            assert _wait(lambda: len(node.peers) == 0, 5.0)
+        finally:
+            raw.close()
+    finally:
+        server.stop()
+
+
+def _handshake_version_frame(node: Node) -> bytes:
+    return wire.encode_frame(
+        MSG_VERSION,
+        {
+            "protocol_version": node.protocol_version,
+            "network": node.consensus.params.name,
+            "listen_port": 0,
+            "id": 0xDEAD,
+        },
+    )
+
+
+def _malformed_body_frame() -> bytes:
+    """Valid header (magic/type/len intact — the stream stays synced), body
+    that cannot decode: an addresses payload whose count varint promises
+    far more bytes than arrive."""
+    type_id = wire._TYPE_IDS["addresses"]
+    body = b"\xff" * 5
+    return wire.MAGIC + bytes([type_id]) + struct.pack("<I", len(body)) + body
+
+
+def test_corrupt_frames_score_then_ban_then_refused(monkeypatch):
+    """Three body-corrupt frames: 40 points each, the third crosses the ban
+    threshold — the peer is dropped, the IP is banned, and a reconnect is
+    refused at accept."""
+    node = Node(Consensus(simnet_params(bps=2)), "victim")
+    amgr = AddressManager()
+    node.address_manager = amgr
+    server = P2PServer(node, port=0, address_manager=amgr)
+    server.start()
+    try:
+        host, port = server.address.rsplit(":", 1)
+        raw = socket.create_connection((host, int(port)), timeout=5.0)
+        try:
+            raw.sendall(_handshake_version_frame(node))
+            assert _wait(lambda: len(node.peers) == 1, 5.0)
+            peer = node.peers[0]
+            for _ in range(3):
+                raw.sendall(_malformed_body_frame())
+            assert _wait(lambda: peer.misbehavior_score >= 100, 5.0), peer.misbehavior_score
+            assert _wait(lambda: amgr.is_banned("127.0.0.1"), 5.0)
+            assert _wait(lambda: not peer.alive, 5.0)
+        finally:
+            raw.close()
+
+        # the banned address is refused at accept (socket closed unserved)
+        raw2 = socket.create_connection((host, int(port)), timeout=5.0)
+        try:
+            assert _recv_eof(raw2, 5.0), "banned peer was served"
+            assert len(node.peers) == 0
+        finally:
+            raw2.close()
+    finally:
+        server.stop()
+
+
+def test_injected_send_faults_drop_and_disconnect():
+    """p2p.send cooperative faults: a dropped frame silently never leaves
+    (connection stays up); an injected disconnect severs the peer."""
+    params = simnet_params(bps=2)
+    a = Node(Consensus(params), "a")
+    b = Node(Consensus(params), "b")
+    server = P2PServer(a, port=0)
+    server.start()
+    out_peer = None
+    try:
+        out_peer = connect_outbound(b, server.address)
+        assert out_peer.wait_handshaken(10.0)
+        assert _wait(lambda: a.peers and a.peers[0].handshaken, 10.0)
+
+        FAULTS.configure({"p2p.send": {"mode": "drop", "hits": [1]}}, seed=1)
+        out_peer.send("ping", 1)  # dropped on the floor
+        out_peer.send("ping", 2)  # hit 2: passes
+        assert out_peer.alive
+        time.sleep(0.3)
+        assert a.peers and a.peers[0].alive  # dropped frame != dropped peer
+
+        FAULTS.configure({"p2p.send": {"mode": "disconnect", "hits": [1]}}, seed=1)
+        out_peer.send("ping", 3)
+        assert not out_peer.alive
+        assert _wait(lambda: len(a.peers) == 0, 5.0)  # remote sees the close
+    finally:
+        server.stop()
+        for peer in list(a.peers) + list(b.peers) + ([out_peer] if out_peer else []):
+            peer.close()
+
+
+def test_reconnect_backoff_grows_exponentially_with_jitter():
+    amgr = AddressManager()
+    cm = ConnectionManager(SimpleNamespace(peers=[]), amgr, tick_seconds=3600)
+    now = [1000.0]
+    cm._clock = lambda: now[0]
+    addr = NetAddress("10.0.0.1", 16111)
+
+    delays = []
+    for _ in range(12):
+        cm._note_dial(addr, ok=False)
+        delays.append(cm._next_dial[addr] - now[0])
+    for n, d in enumerate(delays):
+        base = min(RECONNECT_BACKOFF_BASE * (2.0**n), RECONNECT_BACKOFF_MAX)
+        assert 0.5 * base <= d <= 1.5 * base, (n, d, base)
+    assert delays[-1] <= 1.5 * RECONNECT_BACKOFF_MAX  # cap holds
+    assert delays[3] > delays[0]  # growth is visible through the jitter
+
+    # the gate blocks until the delay elapses, then admits a redial
+    assert not cm._may_dial(addr, now[0])
+    now[0] += 1.5 * RECONNECT_BACKOFF_MAX + 1
+    assert cm._may_dial(addr, now[0])
+
+    # one success resets the ladder to the base delay
+    cm._note_dial(addr, ok=True)
+    assert addr not in cm._next_dial and addr not in cm._fail_counts
+    cm._note_dial(addr, ok=False)
+    first = cm._next_dial[addr] - now[0]
+    assert first <= 1.5 * RECONNECT_BACKOFF_BASE
+
+
+def test_tick_respects_backoff_gate():
+    """A permanent peer that fails to dial is not redialed until its
+    backoff window elapses — no tight reconnect loop."""
+    amgr = AddressManager()
+    cm = ConnectionManager(SimpleNamespace(peers=[]), amgr, tick_seconds=3600)
+    now = [500.0]
+    cm._clock = lambda: now[0]
+    dials = []
+    cm._dial = lambda addr: (dials.append(addr), False)[1]
+    addr = NetAddress("10.0.0.2", 16111)
+    cm._permanent[addr] = 0
+
+    cm._tick()
+    cm._tick()  # same instant: gated
+    assert len(dials) == 1
+    now[0] += 1.5 * RECONNECT_BACKOFF_BASE + 0.1  # past any jittered first delay
+    cm._tick()
+    assert len(dials) == 2
+    assert cm._permanent[addr] == 2  # retry attempts tracked
+
+
+def test_ibd_progress_deadline_drops_stalled_donor():
+    """A donor that goes quiet mid-IBD past the deadline loses the sync
+    slot, is scored, and is disconnected."""
+    node = Node(Consensus(simnet_params(bps=2)), "joiner")
+    closed = []
+
+    class FakeDonor:
+        misbehavior_score = 0
+        peer_address = None
+
+        def close(self):
+            closed.append(1)
+
+    donor = FakeDonor()
+    node._ibd = {"peer": donor, "last_progress": 1000.0}
+    with node.lock:
+        node.prune_caches(now=1000.0 + 1)  # inside the deadline
+    assert node._ibd and not closed
+    with node.lock:
+        node.prune_caches(now=1000.0 + 10_000)
+    assert not node._ibd
+    assert donor.misbehavior_score == 40 and closed == [1]

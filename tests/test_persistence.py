@@ -144,6 +144,83 @@ def test_kill9_mid_replay_recovers(tmp_path, sim_result):
     db.close()
 
 
+_FAULT_KILL_SCRIPT = textwrap.dedent(
+    """
+    import os, pickle, signal, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kaspa_tpu.utils import jax_setup; jax_setup.setup()
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.resilience.faults import FAULTS, FaultInjected
+    from kaspa_tpu.storage.kv import KvStore
+
+    path, blocks_pkl = sys.argv[1], sys.argv[2]
+    with open(blocks_pkl, "rb") as f:
+        params, blocks = pickle.load(f)
+    db = KvStore(path, native=False)
+    c = Consensus(params, db=db)
+    for i, b in enumerate(blocks):
+        if i == 6:
+            # arm a one-shot torn-append fault: the next journal flush
+            # writes a deterministic prefix of its frame, then "crashes"
+            FAULTS.configure({"storage.flush": {"mode": "partial", "after": 1, "max": 1}}, seed=13)
+        try:
+            c.validate_and_insert_block(b)
+        except FaultInjected:
+            # power loss at the torn write: die without any cleanup path
+            print(f"faulted {i}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        print(f"inserted {i}", flush=True)
+    """
+)
+
+
+def test_kill9_on_injected_partial_flush_recovers(tmp_path, sim_result):
+    """A mid-batch torn append (injected storage.flush partial fault)
+    followed by SIGKILL: the reopened store repairs the torn tail back to
+    the last consistent frame and the full replay reconverges — the
+    chaos-layer version of the kill-mid-replay test, with the crash point
+    placed deterministically inside a journal write."""
+    import pickle
+
+    path = str(tmp_path / "consensus-fault.db")
+    blocks_pkl = str(tmp_path / "blocks.pkl")
+    with open(blocks_pkl, "wb") as f:
+        pickle.dump((sim_result.params, sim_result.blocks), f)
+    script = str(tmp_path / "killme-faulted.py")
+    with open(script, "w") as f:
+        f.write(_FAULT_KILL_SCRIPT)
+
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, path, blocks_pkl],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == -signal.SIGKILL, f"expected SIGKILL exit: {proc.returncode}\n{proc.stderr}"
+    lines = proc.stdout.splitlines()
+    assert sum(1 for ln in lines if ln.startswith("inserted")) >= 6
+    assert any(ln.startswith("faulted") for ln in lines), "fault never fired"
+    assert os.path.getsize(path) > 0
+
+    from kaspa_tpu.observability.core import REGISTRY
+
+    repairs_before = REGISTRY.snapshot()["counters"].get("kv_journal_repairs", 0)
+    db = KvStore(path, native=False)
+    # replay repaired the torn tail left by the killed writer
+    assert REGISTRY.snapshot()["counters"].get("kv_journal_repairs", 0) == repairs_before + 1
+    c = Consensus(sim_result.params, db=db)
+    recovered = {b.hash for b in sim_result.blocks if c.storage.statuses.get(b.hash) is not None}
+    assert len(recovered) >= 1
+    # re-apply every block (duplicates are no-ops) -> identical final state
+    for b in sim_result.blocks:
+        c.validate_and_insert_block(b)
+    assert c.sink() == sim_result.sink
+    assert c.get_virtual_daa_score() == sim_result.virtual_daa_score
+    db.close()
+
+
 def test_reachability_snapshot_fast_restart(tmp_path):
     """Clean shutdown persists the reachability state; restart restores it
     byte-for-byte (verified against a forced full rebuild) and invalidates

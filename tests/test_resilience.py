@@ -1,0 +1,368 @@
+"""Chaos-engineering layer: fault registry, device breaker, degraded lane,
+crash-safe storage, and the VM fallback retry/drain discipline.
+
+The invariants under test: fault selection is hit-indexed and seeded
+(never wall clock), so two runs of one workload fire identical faults;
+an injected fault may cost throughput (degraded lane, retry, journal
+repair) but never changes an acceptance decision or loses committed
+state.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from kaspa_tpu.crypto import eclib, secp
+from kaspa_tpu.resilience import breaker as breaker_mod
+from kaspa_tpu.resilience.faults import FAULTS, FaultInjected, FaultWedged, mangle_frame
+from kaspa_tpu.storage.kv import _PythonEngine
+from kaspa_tpu.txscript import batch as script_batch
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends disarmed with a fresh device breaker."""
+    FAULTS.clear()
+    breaker_mod.device_breaker().reset()
+    yield
+    FAULTS.clear()
+    breaker_mod.device_breaker().reset()
+
+
+# --- fault registry -------------------------------------------------------
+
+
+def test_hit_selection_hits_every_after_max():
+    FAULTS.configure({"p.hits": {"mode": "error", "hits": [2, 4]}}, seed=1)
+    fired = []
+    for i in range(1, 6):
+        try:
+            FAULTS.fire("p.hits")
+        except FaultInjected as e:
+            fired.append((i, e.hit))
+    assert fired == [(2, 2), (4, 4)]
+
+    FAULTS.configure({"p.every": {"mode": "error", "every": 3, "max": 2}}, seed=1)
+    fired = [i for i in range(1, 13) if _fires("p.every", i)]
+    assert fired == [3, 6]  # every 3rd, capped at 2 firings
+
+    FAULTS.configure({"p.after": {"mode": "error", "after": 4}}, seed=1)
+    fired = [i for i in range(1, 8) if _fires("p.after", i)]
+    assert fired == [4, 5, 6, 7]
+
+
+def _fires(point: str, _i: int) -> bool:
+    try:
+        FAULTS.fire(point)
+        return False
+    except FaultInjected:
+        return True
+
+
+def test_unscheduled_points_and_disarmed_registry_are_free():
+    assert FAULTS.fire("never.scheduled") is None  # disarmed
+    FAULTS.configure({"other.point": {"mode": "error", "hits": [1]}}, seed=0)
+    assert FAULTS.fire("never.scheduled") is None  # armed, not scheduled
+
+
+def test_event_log_is_deterministic_and_sorted():
+    schedule = {"b.point": {"mode": "error", "hits": [1, 3]}, "a.point": {"mode": "slow", "delay": 0, "hits": [2]}}
+
+    def run():
+        FAULTS.configure(schedule, seed=9)
+        for _ in range(4):
+            for p in ("b.point", "a.point"):
+                try:
+                    FAULTS.fire(p)
+                except FaultInjected:
+                    pass
+        return FAULTS.events()
+
+    first, second = run(), run()
+    assert first == second
+    assert first == [
+        {"point": "a.point", "hit": 2, "mode": "slow"},
+        {"point": "b.point", "hit": 1, "mode": "error"},
+        {"point": "b.point", "hit": 3, "mode": "error"},
+    ]
+
+
+def test_wedge_sleeps_then_raises():
+    FAULTS.configure({"w": {"mode": "wedge", "delay": 0.05, "hits": [1]}}, seed=0)
+    t0 = time.monotonic()
+    with pytest.raises(FaultWedged):
+        FAULTS.fire("w")
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_cooperative_action_rng_is_seed_stable():
+    def draws(seed):
+        FAULTS.configure({"c": {"mode": "corrupt", "hits": [1]}}, seed=seed)
+        act = FAULTS.fire("c")
+        assert act is not None and act.mode == "corrupt"
+        return [act.rng.randrange(1000) for _ in range(4)]
+
+    assert draws(7) == draws(7)
+    assert draws(7) != draws(8)
+
+
+def test_mangle_frame_modes():
+    FAULTS.configure({"c": {"mode": "corrupt", "after": 1}}, seed=3)
+    frame = bytes(range(64))
+    act = FAULTS.fire("c")
+    mangled = mangle_frame(frame, act)
+    assert len(mangled) == len(frame) and mangled != frame
+    assert mangled[:8] == frame[:8]  # header region untouched: stream stays synced
+    act2 = FAULTS.fire("c")
+    act2.mode = "truncate"
+    assert mangle_frame(frame, act2) == frame[:32]
+    act3 = FAULTS.fire("c")
+    act3.mode = "drop"
+    assert mangle_frame(frame, act3) is None
+
+
+# --- circuit breaker ------------------------------------------------------
+
+
+def _fake_clock(start=100.0):
+    now = [start]
+
+    def clock():
+        return now[0]
+
+    return clock, now
+
+
+def test_breaker_trips_probes_and_recovers():
+    clock, now = _fake_clock()
+    br = breaker_mod.CircuitBreaker("t", failure_threshold=2, backoff_base=1.0, clock=clock)
+    assert br.allow() and br.allow()
+    br.record_failure()
+    br.record_failure()  # second consecutive failure: trip
+    assert br.state == breaker_mod.OPEN and br.trips == 1
+    assert not br.allow() and br.denied == 1  # inside the backoff window
+    now[0] += 1.0
+    assert br.allow()  # half-open probe
+    assert br.state == breaker_mod.HALF_OPEN and br.probes == 1
+    now[0] += 2.5
+    br.record_success()
+    assert br.state == breaker_mod.CLOSED and br.recoveries == 1
+    assert br.recovery_latencies == [pytest.approx(3.5)]
+    assert [t["to"] for t in br.transitions] == ["open", "half_open", "closed"]
+
+
+def test_breaker_failed_probe_doubles_backoff():
+    clock, now = _fake_clock()
+    br = breaker_mod.CircuitBreaker("t", failure_threshold=1, backoff_base=1.0, backoff_max=3.0, clock=clock)
+    assert br.allow()
+    br.record_failure()  # trip: reopen after 1s
+    now[0] += 0.5
+    assert not br.allow()
+    now[0] += 0.5
+    assert br.allow()  # probe at +1s
+    br.record_failure()  # failed probe: reopen after 2s
+    now[0] += 1.9
+    assert not br.allow()
+    now[0] += 0.2
+    assert br.allow()
+    br.record_failure()  # second failed probe: 4s capped to backoff_max=3
+    now[0] += 2.9
+    assert not br.allow()
+    now[0] += 0.2
+    assert br.allow()
+    br.record_success()
+    assert br.state == breaker_mod.CLOSED
+
+
+def test_breaker_half_open_admits_single_probe():
+    clock, now = _fake_clock()
+    br = breaker_mod.CircuitBreaker("t", failure_threshold=1, backoff_base=1.0, clock=clock)
+    br.allow()
+    br.record_failure()
+    now[0] += 1.5
+    assert br.allow()  # the probe
+    assert not br.allow()  # concurrent dispatch while the probe is in flight
+    br.record_success()
+    assert br.allow()
+
+
+# --- degraded dispatch lane ----------------------------------------------
+
+
+def _schnorr_items(n=10, seed=5):
+    rng = random.Random(seed)
+    items = []
+    for i in range(n):
+        sk = rng.randrange(1, eclib.N)
+        msg = rng.randbytes(32)
+        pub = eclib.schnorr_pubkey(sk)
+        sig = eclib.schnorr_sign(msg, sk, rng.randbytes(32))
+        if i % 3 == 1:
+            msg = rng.randbytes(32)  # wrong message: host-verify False
+        elif i % 3 == 2 and i % 2 == 0:
+            pub = b"\x00" * 32  # invalid pubkey: precheck False
+        items.append((pub, msg, sig))
+    return items
+
+
+def test_degraded_lane_matches_oracle_decisions():
+    """With every device dispatch erroring, the host degraded lane must
+    return exactly the oracle's accept/reject mask — faults degrade
+    throughput, never decisions."""
+    items = _schnorr_items()
+    expect = [eclib.schnorr_verify(p, m, s) for p, m, s in items]
+    FAULTS.configure({"device.verify": {"mode": "error", "after": 1}}, seed=0)
+    mask = secp.schnorr_verify_batch(items)
+    assert list(mask) == expect
+    assert any(expect) and not all(expect)
+    br = breaker_mod.device_breaker()
+    assert br.consecutive_failures >= 1 or br.state != breaker_mod.CLOSED
+
+
+def test_breaker_trip_reroutes_then_recovers_on_device_health(monkeypatch):
+    """Three faulted dispatches trip the device breaker; once the schedule
+    is exhausted and the backoff elapses, the probe succeeds and dispatch
+    returns to the device lane."""
+    import numpy as np
+
+    # stand-in kernel with the real fault point, so the test exercises the
+    # breaker state machine without paying the XLA compile of the ladder
+    def fake_kernel(px, py, rc, d1, d2, ok):
+        FAULTS.fire("device.verify")
+        return np.asarray(ok)
+
+    fake_kernel.__name__ = "schnorr_verify"
+    monkeypatch.setattr(secp, "schnorr_verify", fake_kernel)
+
+    br = breaker_mod.device_breaker()
+    items = _schnorr_items(4)
+    oracle = [eclib.schnorr_verify(p, m, s) for p, m, s in items]
+    FAULTS.configure({"device.verify": {"mode": "error", "hits": [1, 2, 3]}}, seed=0)
+    for _ in range(3):
+        assert list(secp.schnorr_verify_batch(items)) == oracle  # degraded lane
+    assert br.state == breaker_mod.OPEN and br.trips == 1
+    # inside the backoff window: denied, still host-served, still correct
+    assert list(secp.schnorr_verify_batch(items)) == oracle
+    assert br.denied >= 1
+    time.sleep(br.backoff_base + 0.05)
+    # successful probe: the (stand-in) device answers again
+    mask = secp.schnorr_verify_batch(items)
+    assert br.state == breaker_mod.CLOSED and br.recoveries == 1
+    assert len(mask) == len(items)
+
+
+# --- VM fallback lane: retry + drain --------------------------------------
+
+
+def test_vm_fallback_retries_injected_fault_to_success():
+    runs = []
+
+    def work():
+        runs.append(1)
+
+    job = script_batch._FallbackJob(token=0, input_index=0, run=work)
+    FAULTS.configure({"vm.fallback.exec": {"mode": "error", "hits": [1, 2]}}, seed=0)
+    assert script_batch._run_fallback(job) is None
+    assert len(runs) == 1  # two faulted attempts retried, third ran the job
+
+
+def test_vm_fallback_real_failures_are_not_retried():
+    runs = []
+
+    def bad():
+        runs.append(1)
+        raise ValueError("script rejected")
+
+    job = script_batch._FallbackJob(token=0, input_index=3, run=bad)
+    err = script_batch._run_fallback(job)
+    assert isinstance(err, ValueError) and len(runs) == 1
+
+
+def test_drain_fallback_pool_waits_for_inflight_jobs():
+    release = threading.Event()
+    done = []
+
+    def slow():
+        release.wait(5.0)
+        done.append(1)
+
+    pool = script_batch._fallback_pool()
+    futs = [
+        script_batch._submit_tracked(pool, script_batch._FallbackJob(token=i, input_index=i, run=slow))
+        for i in range(3)
+    ]
+    assert not script_batch.drain_fallback_pool(timeout=0.1)  # still in flight
+    release.set()
+    assert script_batch.drain_fallback_pool(timeout=5.0)
+    assert len(done) == 3 and all(f.result() is None for f in futs)
+
+
+# --- crash-safe storage ---------------------------------------------------
+
+
+def test_torn_tail_is_repaired_and_later_writes_survive(tmp_path):
+    """A torn frame at the log tail is truncated on replay, so frames
+    appended by the NEXT session land on the valid prefix instead of being
+    buried behind garbage (the orphaned-frame regression)."""
+    path = str(tmp_path / "kv.log")
+    eng = _PythonEngine(path)
+    eng.put(b"a", b"1")
+    eng.put(b"b", b"2")
+    eng.close()
+
+    with open(path, "ab") as f:
+        f.write(b"KBAT\xff\xff")  # torn frame: header cut mid-length
+
+    eng2 = _PythonEngine(path)  # replay truncates the torn tail
+    assert eng2.get(b"a") == b"1" and eng2.get(b"b") == b"2"
+    eng2.put(b"c", b"3")
+    eng2.close()
+
+    eng3 = _PythonEngine(path)
+    assert [eng3.get(k) for k in (b"a", b"b", b"c")] == [b"1", b"2", b"3"]
+    eng3.close()
+
+
+def test_partial_flush_fault_reopens_to_pre_batch_state(tmp_path):
+    """An injected mid-append crash (partial frame on disk) must reopen to
+    the state before the torn batch — and the survivor keeps accepting
+    writes."""
+    path = str(tmp_path / "kv.log")
+    eng = _PythonEngine(path)
+    eng.put(b"k0", b"stable")
+    FAULTS.configure({"storage.flush": {"mode": "partial", "hits": [1]}}, seed=4)
+    with pytest.raises(FaultInjected):
+        eng.put(b"k1", b"torn")
+    FAULTS.clear()
+    # the writer process "died" here: reopen from the on-disk image
+    eng2 = _PythonEngine(path)
+    assert eng2.get(b"k0") == b"stable"
+    assert eng2.get(b"k1") is None  # torn batch fully rolled back
+    eng2.put(b"k2", b"after")
+    eng2.close()
+    eng3 = _PythonEngine(path)
+    assert eng3.get(b"k2") == b"after" and eng3.get(b"k1") is None
+    eng3.close()
+
+
+def test_batch_commit_fault_preserves_atomicity(tmp_path):
+    """storage.commit erroring mid write-batch: nothing from the batch may
+    be visible after reopen (the engine's all-or-nothing contract)."""
+    from kaspa_tpu.storage.kv import KvStore
+
+    path = str(tmp_path / "kv.db")
+    db = KvStore(path, native=False)
+    db.engine.put(b"base", b"v")
+    FAULTS.configure({"storage.commit": {"mode": "error", "hits": [1]}}, seed=0)
+    with pytest.raises(FaultInjected):
+        with db.batch() as b:
+            b.put(b"x", b"1")
+            b.put(b"y", b"2")
+    FAULTS.clear()
+    db2 = KvStore(path, native=False)
+    assert db2.engine.get(b"base") == b"v"
+    db2.close()

@@ -28,8 +28,10 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             "--skip-bench",
             # the mesh lanes re-trace the verify ladder in fresh subprocesses
             # (minutes on CPU) — they get their own roundcheck run per round,
-            # not a seat inside the tier-1 fast lane
+            # not a seat inside the tier-1 fast lane; same for the chaos
+            # sustain run (three full replays of a hostile workload)
             "--skip-mesh",
+            "--skip-chaos",
             "--blocks",
             "8",
             "--out",

@@ -1,16 +1,19 @@
 #!/usr/bin/env python
 """One-command round evidence: fast-lane tests + sim replay + bench probe
-+ multichip dryrun + mesh smoke.
++ multichip dryrun + mesh smoke + chaos sustain.
 
 Runs the repo's tier-1 fast lane, a short simulator replay, the bench
 session probe, the sharded multichip dryrun (on every visible device,
-forced-CPU), and a `--mesh 8` sim smoke replay, then writes a single
+forced-CPU), a `--mesh 8` sim smoke replay, and the hostile-load chaos
+sustain run (seeded fault schedule; the faulted replay must converge to
+the bit-identical fault-free end state), then writes a single
 round-evidence JSON (ROUNDCHECK.json) summarizing them — the artifact a
-driver round or a reviewer reads instead of five scrollback logs.
+driver round or a reviewer reads instead of six scrollback logs.
 
     python tools/roundcheck.py                 # everything
     python tools/roundcheck.py --skip-bench    # no device probe
     python tools/roundcheck.py --skip-mesh     # no multichip/mesh lanes
+    python tools/roundcheck.py --skip-chaos    # no fault-injection sustain
     python tools/roundcheck.py --out my.json   # custom artifact path
 
 Exit code 0 iff every section that ran passed.
@@ -78,6 +81,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-sim", action="store_true", help="skip the simulator replay")
     ap.add_argument("--skip-bench", action="store_true", help="skip the bench device probe")
     ap.add_argument("--skip-mesh", action="store_true", help="skip the multichip dryrun + mesh smoke replay")
+    ap.add_argument("--skip-chaos", action="store_true", help="skip the hostile-load chaos sustain run")
+    ap.add_argument("--chaos-blocks", type=int, default=24, help="chaos sustain main-DAG length")
     # long enough that coinbase maturity passes and real signature batches
     # flow through the sharded verify path (a 12-block replay carries 0 txs)
     ap.add_argument("--mesh-blocks", type=int, default=48, help="mesh smoke replay length")
@@ -164,6 +169,33 @@ def main(argv: list[str] | None = None) -> int:
         sect["result"] = result
         sect["ok"] = sect["rc"] == 0 and bool(result) and result.get("mesh") == 8
         evidence["sections"]["mesh_smoke"] = sect
+        ok &= sect["ok"]
+
+    if not args.skip_chaos:
+        # chaos sustain: seeded fault schedule under hostile script mix +
+        # attacker-fork reorg; the acceptance bit is the faulted run
+        # converging to the byte-identical fault-free end state with the
+        # breaker demonstrably tripping and recovering (round evidence for
+        # ROADMAP item 5)
+        sect = _run(
+            [
+                sys.executable, "-m", "kaspa_tpu.sim",
+                "--hostile", "--faults", "default", "--blocks", str(args.chaos_blocks),
+                "--tpb", "4", "--seed", "7", "--json",
+                "--sustain-out", os.path.join(REPO_ROOT, "SUSTAIN.json"),
+            ],
+            900.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        result = _last_json_line(sect)
+        sect["result"] = result
+        sect["ok"] = (
+            sect["rc"] == 0
+            and bool(result)
+            and bool(result.get("matches_fault_free"))
+            and result.get("breaker_trips", 0) >= 1
+        )
+        evidence["sections"]["chaos"] = sect
         ok &= sect["ok"]
 
     evidence["ok"] = ok
