@@ -247,6 +247,128 @@ def _child_ecdsa_main(obs_fn) -> None:
     os._exit(0)
 
 
+def _child_dispatch_main(obs_fn) -> None:
+    """Dispatch-layer lane (KASPA_TPU_BENCH_MODE=dispatch): coalesced
+    cross-block dispatch vs legacy per-block dispatch over the SAME jobs
+    and the SAME device kernel, so the delta isolates the dispatch layer.
+
+    Legacy = one blocking device call per chunk (what per-block
+    ``BatchScriptChecker.dispatch`` does); coalesced = every chunk
+    submitted to the CoalescingDispatcher up front, masks collected from
+    tickets.  Both lanes are oracle-checked before timing.
+    """
+    import random
+
+    from kaspa_tpu.crypto import secp
+    from kaspa_tpu.ops import dispatch as coalesce
+    from kaspa_tpu.ops import mesh
+
+    total = int(os.environ.get("KASPA_TPU_BENCH_DISPATCH_B", "512"))
+    chunk = int(os.environ.get("KASPA_TPU_BENCH_CHUNK", "16"))
+    passes = int(os.environ.get("KASPA_TPU_BENCH_DISPATCH_PASSES", "2"))
+    kind = os.environ.get("KASPA_TPU_BENCH_KERNEL", "schnorr")
+    # deterministic flush behavior while timing: size-triggered flushes plus
+    # one final nudge, with the age timer parked out of the way
+    os.environ.setdefault("KASPA_TPU_COALESCE_AGE_MS", "500")
+    target = coalesce.configure(os.environ.get("KASPA_TPU_COALESCE") or min(total, 256))
+
+    if kind == "ecdsa":
+        raw = _gen_unique_ecdsa_batch(total)
+        items = [(bytes([2 + (P[1] & 1)]) + P[0].to_bytes(32, "big"), msg, sig) for P, msg, sig in raw]
+        batch_fn = secp.ecdsa_verify_batch
+    else:
+        raw = _gen_unique_batch(total)
+        items = [(pub, msg, sig) for _P, pub, msg, sig in raw]
+        batch_fn = secp.schnorr_verify_batch
+    expect = [True] * total
+    rng = random.Random(13)
+    for i in range(0, total, 4):  # corrupt a quarter of the jobs
+        pub, msg, sig = items[i]
+        j = rng.randrange(64)
+        items[i] = (pub, msg, sig[:j] + bytes([sig[j] ^ (1 + rng.randrange(255))]) + sig[j + 1 :])
+        expect[i] = False
+    chunks = [items[i : i + chunk] for i in range(0, total, chunk)]
+
+    engine = coalesce.active()
+    assert engine is not None, "coalescing engine failed to configure"
+
+    def run_legacy() -> list:
+        out = []
+        for ch in chunks:
+            out.extend(bool(v) for v in batch_fn(ch))
+        return out
+
+    def run_coalesced() -> list:
+        tickets = [engine.submit(kind, list(ch)) for ch in chunks]
+        out = []
+        for t in tickets:
+            out.extend(bool(v) for v in t.wait())
+        return out
+
+    # compile + warmup both shapes, oracle-checked
+    assert run_legacy() == expect, "BENCH CORRECTNESS FAILURE: legacy mask != oracle"
+    assert run_coalesced() == expect, "BENCH CORRECTNESS FAILURE: coalesced mask != oracle"
+
+    legacy_best = coalesced_best = float("inf")
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        out = run_legacy()
+        legacy_best = min(legacy_best, time.perf_counter() - t0)
+        assert out == expect
+        t0 = time.perf_counter()
+        out = run_coalesced()
+        coalesced_best = min(coalesced_best, time.perf_counter() - t0)
+        assert out == expect
+
+    legacy_vps = total / legacy_best
+    coalesced_vps = total / coalesced_best
+    result = {
+        "metric": "verify_dispatch_coalescing",
+        "value": round(coalesced_vps, 1),
+        "unit": UNIT,
+        "legacy_vps": round(legacy_vps, 1),
+        "coalesced_vps": round(coalesced_vps, 1),
+        "speedup": round(coalesced_vps / legacy_vps, 3),
+        "batch": total,
+        "chunk": chunk,
+        "coalesce_target": target,
+        "passes": passes,
+        "kernel": kind,
+        "mesh": mesh.active_size(),
+    }
+
+    # optional end-to-end identity check: replay the same simulated DAG with
+    # coalescing off and on; sink + utxo_commitment must be bit-identical
+    replay_blocks = int(os.environ.get("KASPA_TPU_BENCH_DISPATCH_REPLAY", "0"))
+    if replay_blocks:
+        from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
+
+        cfg = SimConfig(
+            bps=2, delay=2.0, num_miners=4, num_blocks=replay_blocks, txs_per_block=4, seed=42
+        )
+        res = simulate(cfg)
+        coalesce.configure(0)
+        _, fresh_legacy = replay(res)
+        sink_l = fresh_legacy.sink()
+        commit_l = fresh_legacy.multisets[sink_l].finalize().hex()
+        coalesce.configure(target)
+        _, fresh_co = replay(res)
+        sink_c = fresh_co.sink()
+        commit_c = fresh_co.multisets[sink_c].finalize().hex()
+        result.update(
+            replay_blocks=replay_blocks,
+            replay_txs=res.total_txs,  # must be > 0 for the check to mean anything
+            replay_identical=bool(sink_l == sink_c and commit_l == commit_c),
+            sink=sink_c.hex(),
+            utxo_commitment=commit_c,
+        )
+
+    coalesce.drain(timeout=10.0)
+    print(json.dumps({**result, "observability": obs_fn()}))
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def _child_main() -> None:
     """Generate the batch, verify on device, print the JSON result line.
 
@@ -276,6 +398,10 @@ def _child_main() -> None:
         print(json.dumps({"child_error": "probe_timeout", "observability": _obs()}))
         sys.stdout.flush()
         os._exit(3)
+
+    if os.environ.get("KASPA_TPU_BENCH_MODE") == "dispatch":
+        _child_dispatch_main(_obs)
+        return  # unreachable (child exits)
 
     if os.environ.get("KASPA_TPU_BENCH_KERNEL", "schnorr") == "ecdsa":
         _child_ecdsa_main(_obs)
@@ -460,7 +586,11 @@ def _cpu_fallback(log: list) -> dict | None:
     return obj
 
 
-def _write_wedge_dossier(probe_log: list, fallback: dict | None) -> str:
+def _write_wedge_dossier(
+    probe_log: list,
+    fallback: dict | None,
+    reason: str = "device probe wedge at session start",
+) -> str:
     """Timestamped evidence file for a wedged device session."""
     out_dir = os.environ.get("KASPA_TPU_BENCH_DOSSIER_DIR", ".")
     path = os.path.join(out_dir, f"bench_wedge_{_utc_stamp()}.json")
@@ -468,7 +598,7 @@ def _write_wedge_dossier(probe_log: list, fallback: dict | None) -> str:
         json.dump(
             {
                 "created": _utc_stamp(compact=False),
-                "reason": "device probe wedge at session start",
+                "reason": reason,
                 "metric": METRIC,
                 "batch": B,
                 "probe_log": probe_log,
@@ -478,6 +608,58 @@ def _write_wedge_dossier(probe_log: list, fallback: dict | None) -> str:
             indent=2,
         )
     return path
+
+
+WEDGE_TTL_S = float(os.environ.get("KASPA_TPU_BENCH_WEDGE_TTL_S", "3600"))
+
+
+def _cached_wedge(log: list) -> tuple[str, dict] | None:
+    """Fast-fail on a recent wedge verdict.
+
+    A wedged backend costs the full probe + retry spiral to re-diagnose
+    (minutes of subprocess timeouts), and the verdict rarely changes
+    within the hour.  If a ``bench_wedge_*.json`` dossier younger than
+    KASPA_TPU_BENCH_WEDGE_TTL_S exists, reuse it instead of re-proving
+    the same timeout.  KASPA_TPU_BENCH_FORCE_PROBE=1 bypasses the cache
+    (the daemon's recurring BenchCapture sets it so device *recovery* is
+    still noticed within one tick interval).
+    """
+    if os.environ.get("KASPA_TPU_BENCH_FORCE_PROBE"):
+        return None
+    out_dir = os.environ.get("KASPA_TPU_BENCH_DOSSIER_DIR", ".")
+    try:
+        names = os.listdir(out_dir)
+    except OSError:
+        return None
+    now = time.time()
+    newest, newest_mtime = None, 0.0
+    for fn in names:
+        if not (fn.startswith("bench_wedge_") and fn.endswith(".json")):
+            continue
+        path = os.path.join(out_dir, fn)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        if now - mtime <= WEDGE_TTL_S and mtime > newest_mtime:
+            newest, newest_mtime = path, mtime
+    if newest is None:
+        return None
+    try:
+        with open(newest) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    log.append(
+        {
+            "t": _utc_stamp(),
+            "event": "cached_wedge_verdict",
+            "dossier": newest,
+            "age_s": round(now - newest_mtime, 1),
+            "ttl_s": WEDGE_TTL_S,
+        }
+    )
+    return newest, doc
 
 
 def _sweep(probe_log: list, devices: int) -> None:
@@ -516,8 +698,55 @@ def _sweep(probe_log: list, devices: int) -> None:
                     err = (obj or {}).get("child_error", note)
                     cell.update(value=0.0, note=f"failed: {err}")
                 cells.append(cell)
+    # coalesce-depth column: dispatch-layer throughput (cross-block
+    # coalescing vs per-block dispatch over the same chunked jobs), one
+    # dispatch-mode child per depth — measures the layer the kernel cells
+    # can't see
+    depths = [
+        int(d) for d in os.environ.get("KASPA_TPU_BENCH_SWEEP_DEPTHS", "4,16").split(",") if d.strip()
+    ]
+    chunk = int(os.environ.get("KASPA_TPU_BENCH_CHUNK", "16"))
+    for kernel in ("schnorr", "ecdsa"):
+        for mesh_n in meshes:
+            for depth in depths:
+                target = depth * chunk
+                cell = {"kernel": kernel, "batch": target, "mesh": mesh_n, "coalesce_depth": depth}
+                remaining = deadline - time.monotonic()
+                if remaining <= 30:
+                    cell.update(value=0.0, note="sweep budget exhausted")
+                    cells.append(cell)
+                    continue
+                obj, note = _run_json_child(
+                    {
+                        "KASPA_TPU_BENCH_CHILD": "1",
+                        "KASPA_TPU_BENCH_MODE": "dispatch",
+                        "KASPA_TPU_BENCH_KERNEL": kernel,
+                        "KASPA_TPU_BENCH_DISPATCH_B": str(target * 2),
+                        "KASPA_TPU_BENCH_CHUNK": str(chunk),
+                        "KASPA_TPU_COALESCE": str(target),
+                        "KASPA_TPU_MESH": str(mesh_n),
+                    },
+                    min(ATTEMPT_TIMEOUT_S, remaining),
+                )
+                if obj is not None and obj.get("coalesced_vps", 0) > 0:
+                    cell.update(
+                        value=obj["coalesced_vps"],
+                        speedup=obj.get("speedup"),
+                        legacy_vps=obj.get("legacy_vps"),
+                        unit=obj.get("unit", UNIT),
+                        note="ok",
+                    )
+                else:
+                    err = (obj or {}).get("child_error", note)
+                    cell.update(value=0.0, note=f"failed: {err}")
+                cells.append(cell)
     best: dict = {}
     for c in cells:
+        if "coalesce_depth" in c:
+            key = f"{c['kernel']}/mesh{c['mesh']}/coalesce"
+            if c["value"] > best.get(key, {}).get("value", 0.0):
+                best[key] = {"batch": c["batch"], "depth": c["coalesce_depth"], "value": c["value"]}
+            continue
         key = f"{c['kernel']}/mesh{c['mesh']}"
         if c["value"] > best.get(key, {}).get("value", 0.0):
             best[key] = {"batch": c["batch"], "value": c["value"]}
@@ -544,9 +773,35 @@ def main() -> None:
             _child_main()
         return  # unreachable (child exits)
 
+    # fast-fail: a wedge dossier younger than the TTL is a standing verdict —
+    # skip the probe + fresh-subprocess retry spiral entirely
+    probe_log: list = []
+    cached = _cached_wedge(probe_log)
+    if cached is not None:
+        dossier, doc = cached
+        if "--probe" in sys.argv[1:]:
+            print(json.dumps({"probe_ok": False, "cached_wedge": dossier, "log": probe_log}))
+            sys.exit(1)
+        fb = doc.get("cpu_fallback") or {}
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": 0.0,
+                    "unit": UNIT,
+                    "vs_baseline": 0.0,
+                    "error": "cached wedge verdict within TTL "
+                    "(KASPA_TPU_BENCH_FORCE_PROBE=1 to re-probe)",
+                    "wedge_dossier": dossier,
+                    "cached": True,
+                    "cpu_fallback_value": float(fb.get("value") or 0.0),
+                }
+            )
+        )
+        return
+
     # session-start probe: a dead backend is diagnosed in ~2 min with a
     # dossier on disk, instead of burning the whole attempt budget first
-    probe_log: list = []
     probe_ok = _session_probe(probe_log)
     if "--probe" in sys.argv[1:]:
         print(json.dumps({"probe_ok": probe_ok, "log": probe_log}))
@@ -598,6 +853,13 @@ def main() -> None:
             return
         time.sleep(RETRY_BACKOFF_S)
 
+    # the retry spiral exhausting IS a wedge verdict: record it as a dossier
+    # so the next invocation within the TTL fast-fails instead of burning
+    # another full attempt budget on the same sick backend
+    probe_log.append({"t": _utc_stamp(), "event": "attempt_spiral_exhausted", "notes": notes})
+    dossier = _write_wedge_dossier(
+        probe_log, None, reason="attempt spiral exhausted (probe answered, workload never finished)"
+    )
     print(
         json.dumps(
             {
@@ -607,6 +869,7 @@ def main() -> None:
                 "vs_baseline": 0.0,
                 "error": "device backend unresponsive after fresh-subprocess retries: "
                 + "; ".join(notes),
+                "wedge_dossier": dossier,
                 "observability": last_obs,
             }
         )

@@ -84,6 +84,18 @@ def parse_args(argv=None) -> DaemonArgs:
         "(default 1 = single device; 'auto' = every visible device; "
         "CPU testing: XLA_FLAGS=--xla_force_host_platform_device_count=8)",
     )
+    p.add_argument(
+        "--coalesce", default=None, metavar="N",
+        help="coalesce signature verify jobs across blocks into super-batches of "
+        "up to N jobs before device dispatch (default off; 'auto' seeds the "
+        "target from BENCH_SWEEP.json; flush age via KASPA_TPU_COALESCE_AGE_MS)",
+    )
+    p.add_argument(
+        "--bench-capture", action=argparse.BooleanOptionalAction, default=False,
+        help="re-probe the device on the periodic tick and capture a fresh "
+        "bench.py number the moment a trivial jit answers "
+        "(interval via KASPA_TPU_BENCH_RECHECK_S; results in <appdir>/BENCH_CAPTURE.json)",
+    )
     # consensus-parameter overrides (kaspad exposes these for testnets;
     # primarily for pruning/IBD integration tests at small scale)
     p.add_argument("--override-pruning-depth", type=int, default=None)
@@ -286,11 +298,16 @@ class Daemon:
         self.params = _apply_param_overrides(
             params if params is not None else _network_params_for(args), args
         )
+        from kaspa_tpu.ops import dispatch as verify_dispatch
         from kaspa_tpu.ops import mesh as mesh_dispatch
 
         # process-wide: every batch verify/muhash call in this daemon routes
         # through the mesh once configured (> 1)
         self.mesh_size = mesh_dispatch.configure(getattr(args, "mesh", None))
+        # process-wide: verify jobs coalesce across blocks/callers into
+        # super-batches once configured (> 0); mesh must resolve first so
+        # 'auto' picks the sweep's best batch for the active mesh size
+        self.coalesce_target = verify_dispatch.configure(getattr(args, "coalesce", None))
         self.db = None
         if getattr(args, "persist", False):
             from kaspa_tpu.storage.kv import KvStore
@@ -370,6 +387,8 @@ class Daemon:
         self.log = get_logger("daemon")
         if self.mesh_size > 1:
             self.log.info("mesh dispatch enabled over %d devices", self.mesh_size)
+        if self.coalesce_target:
+            self.log.info("verify coalescing enabled, super-batch target %d", self.coalesce_target)
         self.core = Core()
         self.perf_monitor = PerfMonitor()
         self.metrics_data = MetricsData()
@@ -393,6 +412,16 @@ class Daemon:
             self.prom_text = prom.render()
 
         self.tick.register(10.0, sample_metrics)
+
+        # recurring-timer bench capture (ROADMAP item 1): re-probe the
+        # device on the metrics cadence, run the full bench the moment a
+        # trivial jit answers, keep the best number in the appdir
+        self.bench_capture = None
+        if getattr(args, "bench_capture", False):
+            from kaspa_tpu.node.bench_capture import BenchCapture
+
+            self.bench_capture = BenchCapture(args.appdir, logger=self.log)
+            self.tick.register(10.0, self.bench_capture.tick)
 
         def sample_rule_engine():
             with self._dispatch_lock:
@@ -804,9 +833,15 @@ class Daemon:
             pass
         self.node._drop_ibd_pipeline()
         self.node.pipeline.shutdown()
+        from kaspa_tpu.ops import dispatch as verify_dispatch
         from kaspa_tpu.txscript import batch as script_batch
 
         script_batch.drain_fallback_pool(timeout=10.0)
+        # same barrier for the async coalescing queue: flush staged verify
+        # chunks and block until every callback has resolved — tickets
+        # resolving after the db handle closes would write sig-cache entries
+        # for a consensus object that is already torn down
+        verify_dispatch.drain(timeout=10.0)
         # quiesce dispatch before closing the native handle: an in-flight
         # handler finishes under the lock; later ones see db == None and
         # stage() no-ops (server is already down, nothing new arrives).

@@ -402,6 +402,46 @@ def inv(ctx: FieldCtx, x):
     return exp_const(ctx, x, ctx.modulus - 2)
 
 
+def inv_batch(ctx: FieldCtx, x, zero_mask=None):
+    """Batch-affine Montgomery inversion along the leading axis.
+
+    Replaces B independent Fermat ladders with ~3(B-1) modular multiplies
+    plus ONE Fermat inversion of the running product:
+
+        inv(x_i) == prefix_{i-1} * suffix_{i+1} * inv(prod_j x_j)
+
+    Prefix/suffix products are two O(log B)-depth associative scans —
+    modular multiplication is associative, so the scan's reassociation is
+    exact (lazy-limb representations may differ; values mod m cannot).
+    Zeros would poison the shared product, so zero lanes are substituted
+    with 1 through the chain and masked back to 0 on output, preserving
+    ``inv``'s inv(0) == 0 convention.
+
+    x: [B, ..., W] lazy limbs; zero_mask: optional [B, ...] bool marking
+    canonical zeros (computed here when absent). Returns lazy limbs.
+    """
+    b = x.shape[0]
+    if b == 0:
+        return x
+    if zero_mask is None:
+        zero_mask = is_zero(ctx, x)
+    one = jnp.broadcast_to(jnp.asarray(ctx.one), x.shape).astype(jnp.int32)
+    u = jnp.where(zero_mask[..., None], one, x)
+    if b == 1:
+        return jnp.where(zero_mask[..., None], jnp.zeros_like(x), inv(ctx, u))
+
+    def mulfn(p, q):
+        return mul(ctx, p, q)
+
+    pre = jax.lax.associative_scan(mulfn, u, axis=0)  # pre[i] = u_0 .. u_i
+    suf = jax.lax.associative_scan(mulfn, u, axis=0, reverse=True)
+    total_inv = inv(ctx, pre[-1])  # the single Fermat ladder
+    left = jnp.concatenate([one[:1], pre[:-1]], axis=0)  # prod of lanes < i
+    right = jnp.concatenate([suf[1:], one[:1]], axis=0)  # prod of lanes > i
+    out = mul(ctx, mul(ctx, left, right), jnp.broadcast_to(total_inv, x.shape))
+    return jnp.where(zero_mask[..., None], jnp.zeros_like(x), out)
+
+
 # ---------------------------------------------------------------------------
 # field contexts used by the framework
 # ---------------------------------------------------------------------------

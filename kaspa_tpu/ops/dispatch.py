@@ -1,0 +1,361 @@
+"""Asynchronous verify-dispatch engine: cross-block batch coalescing.
+
+`BatchScriptChecker.dispatch()` historically blocked per block: every
+block's handful of signature jobs paid full jit-dispatch latency at low
+device occupancy.  This module owns a process-wide coalescing queue in
+front of the batched verify kernels (`crypto/secp.py`): signature jobs
+from *concurrent* callers — pipeline stage workers, mempool checks, RPC
+validators — accumulate into device-sized super-batches and are flushed
+by a dedicated dispatcher thread:
+
+- **size**: a kind's pending jobs reach the adaptive target (seeded from
+  ``BENCH_SWEEP.json``'s best batch for the active mesh, fallback 1024);
+- **age**: the oldest queued chunk exceeds the flush age
+  (``KASPA_TPU_COALESCE_AGE_MS``, default 2 ms);
+- **nudge**: a caller blocks on its ticket — the queue flushes as soon
+  as the dispatcher is idle, so a serial caller sees near-zero added
+  latency and *bit-identical* results (verify masks are per-lane
+  functions of each triple; batch composition cannot change them);
+- **drain/barrier**: shutdown or an explicit `drain()` flushes
+  everything and blocks until every callback has resolved.
+
+Double buffering: the staging buffer is swapped out wholesale under the
+lock (the host keeps collecting/sighashing block N+1 into the fresh
+buffer) while the dispatcher marshals the taken chunks and runs the
+device kernel — the taken arrays are *donated* to the dispatch in the
+sense that no host reference mutates them afterwards, so XLA is free to
+alias them.  The mesh path (`ops/mesh.py`) pads once per super-batch
+instead of once per block.
+
+Consensus note: `_calculate_utxo_state` consumes each merged block's
+script results before building the next block's UTXO view, so the
+production consensus path keeps its synchronous `dispatch()` semantics
+(submit + nudge).  Coalescing wins come from jobs that arrive while the
+device is busy — concurrent pipeline stages, the mempool lane — and from
+callers that use `dispatch_async()` to overlap their own host work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kaspa_tpu.observability import trace
+from kaspa_tpu.observability.core import REGISTRY, SIZE_BUCKETS
+
+DEFAULT_TARGET = 1024
+_TARGET_MIN, _TARGET_MAX = 8, 16384
+_WAIT_CAP_S = 600.0  # ticket.wait() hard cap: covers a cold ladder compile
+
+_COALESCE_DEPTH = REGISTRY.histogram(
+    "dispatch_coalesce_depth", SIZE_BUCKETS,
+    help="caller chunks merged into one super-batch, per dispatch",
+)
+_SUPER_BATCH = REGISTRY.histogram(
+    "dispatch_super_batch_size", SIZE_BUCKETS,
+    help="verify jobs per coalesced super-batch dispatch",
+)
+_QUEUE_AGE = REGISTRY.histogram(
+    "dispatch_queue_age_seconds",
+    (0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0, 5.0),
+    help="oldest chunk's queue residency at flush time",
+)
+_FLUSHES = REGISTRY.counter_family(
+    "dispatch_flushes", "reason", help="super-batch flushes by trigger (size/age/nudge/drain)"
+)
+_COALESCED_JOBS = REGISTRY.counter_family(
+    "dispatch_coalesced_jobs", "kind", help="verify jobs routed through the coalescing queue"
+)
+
+
+class Ticket:
+    """Per-chunk completion handle: resolves to the [n] bool validity mask
+    for exactly the items submitted (super-batch slicing is internal)."""
+
+    __slots__ = ("_engine", "_event", "_mask", "_error")
+
+    def __init__(self, engine: "CoalescingDispatcher | None"):
+        self._engine = engine
+        self._event = threading.Event()
+        self._mask: np.ndarray | None = None
+        self._error: Exception | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> np.ndarray:
+        """Block for this chunk's mask; nudges the queue so a lone waiter
+        never sits out the full flush age."""
+        if not self._event.is_set():
+            if self._engine is not None:
+                self._engine.nudge()
+            if not self._event.wait(timeout if timeout is not None else _WAIT_CAP_S):
+                raise TimeoutError("verify dispatch ticket timed out")
+        if self._error is not None:
+            raise self._error
+        return self._mask
+
+    def _resolve(self, mask: np.ndarray | None, error: Exception | None) -> None:
+        self._mask = mask
+        self._error = error
+        self._event.set()
+
+
+@dataclass
+class _Chunk:
+    kind: str  # "schnorr" | "ecdsa"
+    items: list  # [(pubkey, msg, sig), ...] — ownership donated on submit
+    ticket: Ticket
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+class CoalescingDispatcher:
+    """Cross-caller coalescing queue in front of secp's batched kernels."""
+
+    def __init__(self, target: int, max_age_s: float):
+        self.target = max(_TARGET_MIN, min(_TARGET_MAX, int(target)))
+        self.max_age_s = max_age_s
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._pending: list[_Chunk] = []  # staging buffer (swapped at flush)
+        self._urgent = False
+        self._unresolved = 0  # chunks submitted but not yet resolved
+        self._closed = False
+        self._thread: threading.Thread | None = None
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, kind: str, items: list) -> Ticket:
+        """Queue one chunk of (pubkey, msg, sig) triples; the caller must
+        not mutate `items` afterwards (donated to the dispatcher)."""
+        ticket = Ticket(self)
+        if not items:
+            ticket._resolve(np.zeros(0, dtype=bool), None)
+            return ticket
+        _COALESCED_JOBS.inc(kind, len(items))
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("verify dispatcher is shut down")
+            self._pending.append(_Chunk(kind, items, ticket))
+            self._unresolved += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="verify-dispatch", daemon=True
+                )
+                self._thread.start()
+            self._wake.notify()
+        return ticket
+
+    def nudge(self) -> None:
+        """Request an immediate flush (a caller is blocked on a ticket)."""
+        with self._lock:
+            self._urgent = True
+            self._wake.notify()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Flush everything and block until every submitted chunk has
+        resolved (True) or the timeout expires (False)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            self._urgent = True
+            self._wake.notify()
+            while self._unresolved > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+        return True
+
+    def close(self, timeout: float = 10.0) -> bool:
+        """Drain, then stop accepting work and retire the thread."""
+        drained = self.drain(timeout)
+        with self._lock:
+            self._closed = True
+            self._wake.notify()
+        return drained
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "target": self.target,
+                "max_age_ms": round(self.max_age_s * 1000, 3),
+                "pending_chunks": len(self._pending),
+                "unresolved_chunks": self._unresolved,
+            }
+
+    # -- dispatcher thread ---------------------------------------------------
+
+    def _flush_reason_locked(self, now: float) -> str | None:
+        if not self._pending:
+            return None
+        if self._closed:
+            return "drain"
+        if self._urgent:
+            return "nudge"
+        per_kind: dict[str, int] = {}
+        for c in self._pending:
+            per_kind[c.kind] = per_kind.get(c.kind, 0) + len(c.items)
+        if any(n >= self.target for n in per_kind.values()):
+            return "size"
+        if now - self._pending[0].enqueued_at >= self.max_age_s:
+            return "age"
+        return None
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while True:
+                    now = time.monotonic()
+                    if not self._pending:
+                        # a stale nudge with nothing queued must not force
+                        # the next lone chunk into a depth-1 flush
+                        self._urgent = False
+                    reason = self._flush_reason_locked(now)
+                    if reason is not None:
+                        break
+                    if self._closed and not self._pending:
+                        return
+                    if self._pending:
+                        # sleep only until the oldest chunk ages out
+                        self._wake.wait(
+                            max(0.0, self.max_age_s - (now - self._pending[0].enqueued_at))
+                        )
+                    else:
+                        self._wake.wait()
+                # double-buffer swap: donate the staged chunks to this flush
+                # cycle; producers refill a fresh buffer while XLA runs below
+                taken, self._pending = self._pending, []
+                self._urgent = False
+            self._dispatch(taken, reason)
+
+    def _dispatch(self, chunks: list[_Chunk], reason: str) -> None:
+        _FLUSHES.inc(reason)
+        now = time.monotonic()
+        by_kind: dict[str, list[_Chunk]] = {}
+        for c in chunks:
+            by_kind.setdefault(c.kind, []).append(c)
+        for kind, group in by_kind.items():
+            # greedy whole-chunk packing into <= target super-batches (a
+            # single chunk larger than the target still goes out in one)
+            i = 0
+            while i < len(group):
+                batch, jobs = [], 0
+                while i < len(group) and (not batch or jobs + len(group[i].items) <= self.target):
+                    batch.append(group[i])
+                    jobs += len(group[i].items)
+                    i += 1
+                self._run_super_batch(kind, batch, jobs, now)
+
+    def _run_super_batch(self, kind: str, batch: list[_Chunk], jobs: int, now: float) -> None:
+        from kaspa_tpu.crypto import secp  # deferred: keeps import DAG acyclic
+
+        _COALESCE_DEPTH.observe(len(batch))
+        _SUPER_BATCH.observe(jobs)
+        _QUEUE_AGE.observe(now - min(c.enqueued_at for c in batch))
+        items = [it for c in batch for it in c.items]
+        try:
+            fn = secp.schnorr_verify_batch if kind == "schnorr" else secp.ecdsa_verify_batch
+            with trace.span("dispatch.super_batch", kind=kind, jobs=jobs, chunks=len(batch)):
+                mask = np.asarray(fn(items))
+        except Exception as e:  # noqa: BLE001 - surfaced on every waiting ticket
+            for c in batch:
+                self._finish(c, None, e)
+            return
+        pos = 0
+        for c in batch:
+            self._finish(c, mask[pos : pos + len(c.items)], None)
+            pos += len(c.items)
+
+    def _finish(self, chunk: _Chunk, mask, error) -> None:
+        chunk.ticket._resolve(mask, error)
+        with self._lock:
+            self._unresolved -= 1
+            if self._unresolved == 0:
+                self._idle.notify_all()
+
+
+# --- process-wide configuration (mirrors ops/mesh.py) -----------------------
+
+_cfg_lock = threading.Lock()
+_configured: str | int | None = None
+_engine: CoalescingDispatcher | None = None
+
+
+def _flush_age_s() -> float:
+    return float(os.environ.get("KASPA_TPU_COALESCE_AGE_MS", "2")) / 1000.0
+
+
+def _sweep_target() -> int:
+    """Adaptive super-batch target: the best-throughput batch recorded by
+    `bench.py --sweep` for the active mesh size, else DEFAULT_TARGET."""
+    path = os.environ.get(
+        "KASPA_TPU_BENCH_SWEEP_PATH",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "BENCH_SWEEP.json"),
+    )
+    try:
+        with open(path) as f:
+            best = json.load(f).get("best", {})
+    except (OSError, ValueError):
+        return DEFAULT_TARGET
+    from kaspa_tpu.ops import mesh
+
+    n = mesh.active_size()
+    for key in (f"schnorr/mesh{n}", "schnorr/mesh1"):
+        entry = best.get(key)
+        if entry and entry.get("batch"):
+            return int(entry["batch"])
+    return DEFAULT_TARGET
+
+
+def configure(spec: int | str | None) -> int:
+    """Select the process-wide coalescing mode; returns the resolved
+    super-batch target (0 = disabled, the default).
+
+    spec: None/0/"off" disable; "auto" seeds the target from
+    BENCH_SWEEP.json; an integer pins the target.  With no explicit spec
+    the KASPA_TPU_COALESCE env var is consulted the same way.
+    """
+    global _configured, _engine
+    with _cfg_lock:
+        raw = spec if spec is not None else os.environ.get("KASPA_TPU_COALESCE", "0")
+        _configured = raw
+        old, _engine = _engine, None
+    if old is not None:
+        old.close(timeout=10.0)
+    if raw in (0, "0", "", "off", None):
+        return 0
+    target = _sweep_target() if raw == "auto" else int(raw)
+    target = max(_TARGET_MIN, min(_TARGET_MAX, target))
+    with _cfg_lock:
+        _engine = CoalescingDispatcher(target, _flush_age_s())
+    return target
+
+
+def active() -> CoalescingDispatcher | None:
+    """The live engine, or None when coalescing is disabled."""
+    return _engine
+
+
+def drain(timeout: float = 10.0) -> bool:
+    """Flush + resolve everything in flight (daemon-shutdown barrier).
+    No-op True when coalescing is disabled."""
+    eng = _engine
+    return eng.drain(timeout) if eng is not None else True
+
+
+def _dispatch_state() -> dict:
+    eng = _engine
+    if eng is None:
+        return {"enabled": False, "configured": str(_configured) if _configured is not None else ""}
+    out = {"enabled": True, "configured": str(_configured)}
+    out.update(eng.stats())
+    return out
+
+
+REGISTRY.register_collector("dispatch", _dispatch_state)

@@ -180,6 +180,24 @@ def to_affine(p):
     return xa, ya, inf
 
 
+def to_affine_batch(p):
+    """Projective -> canonical affine limbs for a batch (leading axis).
+
+    Same contract as ``to_affine``, but all Z inversions share one
+    batch-affine Montgomery inversion (bigint.inv_batch): ~3(B-1) modular
+    multiplies plus a single Fermat ladder instead of B ladders — the
+    dominant per-element saving in the portable XLA verify lane.  Identity
+    lanes (Z ≡ 0) keep zi == 0, matching ``inv``'s inv(0) == 0, so the
+    returned (x, y) are (0, 0) there exactly as in the per-lane path.
+    """
+    x, y, z = p
+    inf = bi.is_zero(FP, z)
+    zi = bi.inv_batch(FP, z, zero_mask=inf)
+    xa = bi.canon(FP, bi.mul(FP, x, zi))
+    ya = bi.canon(FP, bi.mul(FP, y, zi))
+    return xa, ya, inf
+
+
 def scalar_digits_msb(k: int) -> np.ndarray:
     """Host: scalar -> 64 MSB-first 4-bit window digits."""
     return np.array([(k >> (256 - WINDOW * (i + 1))) & 0xF for i in range(N_WINDOWS)], dtype=np.int32)

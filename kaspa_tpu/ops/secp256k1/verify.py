@@ -130,7 +130,9 @@ def schnorr_verify_kernel(px, py, r_canon, s_digits, e_digits, valid_in):
     """
     py_neg = bi.neg(FP, py)
     r = pt.dual_scalar_mul_base(px, py_neg, s_digits, e_digits)
-    xa, ya, inf = pt.to_affine(r)
+    # batch-affine Montgomery inversion: one Fermat ladder per batch
+    # instead of one per lane (see points.to_affine_batch)
+    xa, ya, inf = pt.to_affine_batch(r)
     ok = ~inf
     ok &= jnp.all(xa == r_canon, axis=-1)
     ok &= (ya[..., 0] & 1) == 0
@@ -145,7 +147,7 @@ def ecdsa_verify_kernel(px, py, r_n_canon, u1_digits, u2_digits, valid_in):
     n-field inversions are per-signature scalars).
     """
     r = pt.dual_scalar_mul_base(px, py, u1_digits, u2_digits)
-    xa, _ya, inf = pt.to_affine(r)
+    xa, _ya, inf = pt.to_affine_batch(r)
     x_mod_n = bi.canon(FN, xa)  # x < p < 2**256: reinterpret limbs mod n
     ok = ~inf
     ok &= jnp.all(x_mod_n == r_n_canon, axis=-1)

@@ -18,6 +18,7 @@ from kaspa_tpu.utils import jax_setup
 
 jax_setup.setup()
 
+from kaspa_tpu.ops import dispatch as coalesce
 from kaspa_tpu.ops import mesh
 from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
 
@@ -33,6 +34,12 @@ def main() -> None:
     p.add_argument(
         "--mesh", default=None, metavar="N",
         help="shard the replay's batch verify + muhash over N devices ('auto' = all visible)",
+    )
+    p.add_argument(
+        "--coalesce", default=None, metavar="N",
+        help="route the replay's verify batches through the cross-block coalescing "
+        "queue with super-batch target N ('auto' = best batch from BENCH_SWEEP.json; "
+        "default off — results are bit-identical either way)",
     )
     p.add_argument("--json", action="store_true", help="emit one JSON line")
     p.add_argument(
@@ -51,6 +58,7 @@ def main() -> None:
     args = p.parse_args()
 
     mesh_size = mesh.configure(args.mesh)
+    coalesce_target = coalesce.configure(args.coalesce)
     cfg = SimConfig(
         bps=args.bps, delay=args.delay, num_miners=args.miners,
         num_blocks=args.blocks, txs_per_block=args.tpb, seed=args.seed,
@@ -71,8 +79,9 @@ def main() -> None:
         "bps_target": args.bps,
         "realtime_factor": round(len(res.blocks) / args.bps / elapsed, 2),
         "mesh": mesh_size,
-        # end-state fingerprints: identical across --mesh values is the
-        # bit-identity acceptance check for the sharded dispatch
+        "coalesce": coalesce_target,
+        # end-state fingerprints: identical across --mesh/--coalesce values
+        # is the bit-identity acceptance check for the sharded dispatch
         "sink": sink.hex(),
         "utxo_commitment": fresh.multisets[sink].finalize().hex(),
     }

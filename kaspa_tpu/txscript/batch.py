@@ -265,9 +265,12 @@ class BatchScriptChecker:
             return
         _JOBS.inc(kind)
 
-        def cb(ok: bool, token=token, input_index=input_index):
+        # `fail` is supplied at resolve time: dispatch_async detaches the
+        # results dict into its handle, so the callback must not close over
+        # the checker's (reusable) live state
+        def cb(ok: bool, fail, token=token, input_index=input_index):
             if not ok:
-                self._fail(token, ScriptCheckError("invalid signature", input_index))
+                fail(token, ScriptCheckError("invalid signature", input_index))
 
         self._jobs.append(_Job(kind, pubkey, msg, sig, cache_key, cb))
 
@@ -279,8 +282,20 @@ class BatchScriptChecker:
         """Run all queued checks: the VM fallback lane on the bounded pool
         overlapped with (at most) two device batches; returns
         token -> None (valid) | Exception (first failure)."""
-        fallbacks = self._fallbacks
-        self._fallbacks = []
+        return self.dispatch_async().result()
+
+    def dispatch_async(self) -> "DispatchHandle":
+        """Submit all queued checks without blocking and detach the
+        checker's state into the returned handle: the VM fallback lane
+        goes to the bounded pool, the device lane to the cross-block
+        coalescing queue (`ops/dispatch.py`) when enabled.  The checker is
+        immediately reusable for the next collect round; the handle's
+        ``result()`` yields the same token -> first-error mapping — and
+        the same failure precedence — as the synchronous path."""
+        fallbacks, self._fallbacks = self._fallbacks, []
+        jobs, self._jobs = self._jobs, []
+        results, self._results = self._results, {}
+
         pending = None
         if fallbacks:
             _FALLBACK_BATCH.observe(len(fallbacks))
@@ -288,32 +303,82 @@ class BatchScriptChecker:
                 pool = _fallback_pool()
                 pending = [_submit_tracked(pool, j) for j in fallbacks]
 
-        schnorr = [j for j in self._jobs if j.kind == "schnorr"]
-        ecdsa = [j for j in self._jobs if j.kind == "ecdsa"]
+        schnorr = [j for j in jobs if j.kind == "schnorr"]
+        ecdsa = [j for j in jobs if j.kind == "ecdsa"]
+        from kaspa_tpu.ops import dispatch as coalesce
+
+        engine = coalesce.active()
+        tickets = None
+        if engine is not None:
+            # chunk ownership is donated to the coalescing queue: the item
+            # lists are never touched again from this side
+            tickets = {}
+            if schnorr:
+                tickets["schnorr"] = engine.submit("schnorr", [(j.pubkey, j.msg, j.sig) for j in schnorr])
+            if ecdsa:
+                tickets["ecdsa"] = engine.submit("ecdsa", [(j.pubkey, j.msg, j.sig) for j in ecdsa])
+        return DispatchHandle(self.sig_cache, fallbacks, pending, schnorr, ecdsa, tickets, results)
+
+
+class DispatchHandle:
+    """In-flight dispatch: owns the detached jobs/results of one round."""
+
+    def __init__(self, sig_cache, fallbacks, pending, schnorr, ecdsa, tickets, results):
+        self.sig_cache = sig_cache
+        self._fallbacks = fallbacks
+        self._pending = pending
+        self._schnorr = schnorr
+        self._ecdsa = ecdsa
+        self._tickets = tickets  # None = coalescing disabled (sync device lane)
+        self._results = results
+        self._resolved = False
+
+    def _fail(self, token: int, err: Exception) -> None:
+        if self._results.get(token) is None:
+            self._results[token] = err
+
+    def result(self) -> dict[int, Exception | None]:
+        """Join every lane; token -> None (valid) | Exception (first
+        failure), bit-identical to the legacy synchronous dispatch."""
+        if self._resolved:
+            return self._results
+        self._resolved = True
         schnorr_mask = ecdsa_mask = None
-        if schnorr:
-            with trace.span("txscript.dispatch", kind="schnorr", jobs=len(schnorr)):
-                schnorr_mask = secp.schnorr_verify_batch([(j.pubkey, j.msg, j.sig) for j in schnorr])
-        if ecdsa:
-            with trace.span("txscript.dispatch", kind="ecdsa", jobs=len(ecdsa)):
-                ecdsa_mask = secp.ecdsa_verify_batch([(j.pubkey, j.msg, j.sig) for j in ecdsa])
+        if self._tickets is None:
+            # legacy synchronous device lane (coalescing disabled)
+            if self._schnorr:
+                with trace.span("txscript.dispatch", kind="schnorr", jobs=len(self._schnorr)):
+                    schnorr_mask = secp.schnorr_verify_batch([(j.pubkey, j.msg, j.sig) for j in self._schnorr])
+            if self._ecdsa:
+                with trace.span("txscript.dispatch", kind="ecdsa", jobs=len(self._ecdsa)):
+                    ecdsa_mask = secp.ecdsa_verify_batch([(j.pubkey, j.msg, j.sig) for j in self._ecdsa])
 
         # fallback lane resolution BEFORE the device callbacks: the serial
         # path ran the VM at collect time, so VM failures must win the
         # first-error slot over same-token batch failures, in collect order
-        if fallbacks:
-            with trace.span("txscript.fallback_join", jobs=len(fallbacks), parallel=pending is not None):
-                errors = [f.result() for f in pending] if pending is not None else [_run_fallback(j) for j in fallbacks]
-            for job, err in zip(fallbacks, errors):
+        if self._fallbacks:
+            with trace.span("txscript.fallback_join", jobs=len(self._fallbacks), parallel=self._pending is not None):
+                errors = (
+                    [f.result() for f in self._pending]
+                    if self._pending is not None
+                    else [_run_fallback(j) for j in self._fallbacks]
+                )
+            for job, err in zip(self._fallbacks, errors):
                 if err is not None:
                     self._fail(job.token, ScriptCheckError(str(err), job.input_index))
 
-        for jobs, mask in ((schnorr, schnorr_mask), (ecdsa, ecdsa_mask)):
+        if self._tickets is not None:
+            # coalesced device lane: block on this round's tickets (wait()
+            # nudges the queue, so a serial caller flushes immediately)
+            with trace.span("txscript.dispatch_wait", kinds=",".join(sorted(self._tickets))):
+                if "schnorr" in self._tickets:
+                    schnorr_mask = self._tickets["schnorr"].wait()
+                if "ecdsa" in self._tickets:
+                    ecdsa_mask = self._tickets["ecdsa"].wait()
+
+        for jobs, mask in ((self._schnorr, schnorr_mask), (self._ecdsa, ecdsa_mask)):
             if mask is not None:
                 for j, ok in zip(jobs, mask):
                     self.sig_cache.insert(j.cache_key, bool(ok))
-                    j.callback(bool(ok))
-        self._jobs.clear()
-        out = self._results
-        self._results = {}
-        return out
+                    j.callback(bool(ok), self._fail)
+        return self._results

@@ -59,6 +59,32 @@ def test_inverse(ctx):
     assert got == [pow(x, -1, ctx.modulus) for x in xs]
 
 
+@pytest.mark.parametrize("ctx", [bi.FP, bi.FN], ids=lambda c: c.name)
+def test_batch_inverse_matches_fermat(ctx):
+    """Batch-affine Montgomery inversion (one Fermat inversion + two
+    scan passes of muls) must equal per-lane Fermat exactly; zero lanes
+    pass through as 0 — including the lazy non-canonical zero (= m) —
+    without poisoning the shared product chain."""
+    m = ctx.modulus
+    xs = [1, 2, 3, 0, m - 1, m, 0xDEADBEEF123456789, m // 2]
+    a = jnp.asarray(bi.ints_to_limbs(xs, ctx.W))
+    got = bi.limbs_to_ints(bi.canon(ctx, bi.inv_batch(ctx, a)))
+    assert got == [pow(x, -1, m) if x % m else 0 for x in xs]
+    # agrees lane-for-lane with the per-lane Fermat path on nonzero input
+    nz = jnp.asarray(bi.ints_to_limbs([x for x in xs if x % m], ctx.W))
+    assert bi.limbs_to_ints(bi.canon(ctx, bi.inv_batch(ctx, nz))) == bi.limbs_to_ints(
+        bi.canon(ctx, bi.inv(ctx, nz))
+    )
+
+
+def test_batch_inverse_singleton():
+    ctx = bi.FP
+    a = jnp.asarray(bi.ints_to_limbs([7], ctx.W))
+    assert bi.limbs_to_ints(bi.canon(ctx, bi.inv_batch(ctx, a))) == [pow(7, -1, ctx.modulus)]
+    z = jnp.asarray(bi.ints_to_limbs([0], ctx.W))
+    assert bi.limbs_to_ints(bi.canon(ctx, bi.inv_batch(ctx, z))) == [0]
+
+
 def test_zero_and_eq():
     ctx = bi.FP
     a = jnp.asarray(bi.ints_to_limbs([0, ctx.modulus - 1, 5], ctx.W))

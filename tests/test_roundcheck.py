@@ -29,9 +29,11 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             # the mesh lanes re-trace the verify ladder in fresh subprocesses
             # (minutes on CPU) — they get their own roundcheck run per round,
             # not a seat inside the tier-1 fast lane; same for the chaos
-            # sustain run (three full replays of a hostile workload)
+            # sustain run (three full replays of a hostile workload) and the
+            # coalesced-dispatch throughput lane (bench child + dual replay)
             "--skip-mesh",
             "--skip-chaos",
+            "--skip-dispatch",
             "--blocks",
             "8",
             "--out",
@@ -64,6 +66,50 @@ def test_bench_wedge_dossier_shape(tmp_path, monkeypatch):
     assert dossier["cpu_fallback"]["value"] == 123.4
     # timestamped filename: bench_wedge_<UTC>.json
     assert os.path.basename(path).startswith("bench_wedge_20")
+
+
+def test_bench_cached_wedge_fast_fail(tmp_path, monkeypatch):
+    """A wedge dossier younger than the TTL short-circuits the probe +
+    retry spiral; FORCE_PROBE bypasses; a stale dossier is ignored."""
+    bench = _load_bench()
+    monkeypatch.setenv("KASPA_TPU_BENCH_DOSSIER_DIR", str(tmp_path))
+    monkeypatch.delenv("KASPA_TPU_BENCH_FORCE_PROBE", raising=False)
+
+    log: list = []
+    assert bench._cached_wedge(log) is None  # no dossier yet
+
+    dossier = tmp_path / "bench_wedge_20260805T000000Z.json"
+    dossier.write_text(json.dumps({"reason": "test", "cpu_fallback": {"value": 99.5}}))
+
+    hit = bench._cached_wedge(log)
+    assert hit is not None
+    path, doc = hit
+    assert path == str(dossier)
+    assert doc["cpu_fallback"]["value"] == 99.5
+    assert log and log[-1]["event"] == "cached_wedge_verdict"
+
+    # the recurring daemon capture forces a fresh probe to notice recovery
+    monkeypatch.setenv("KASPA_TPU_BENCH_FORCE_PROBE", "1")
+    assert bench._cached_wedge([]) is None
+    monkeypatch.delenv("KASPA_TPU_BENCH_FORCE_PROBE")
+
+    # outside the TTL the verdict is stale and the probe runs fresh
+    monkeypatch.setattr(bench, "WEDGE_TTL_S", -1.0)
+    assert bench._cached_wedge([]) is None
+
+
+def test_bench_spiral_exhaustion_writes_dossier(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setenv("KASPA_TPU_BENCH_DOSSIER_DIR", str(tmp_path))
+    path = bench._write_wedge_dossier(
+        [{"event": "attempt_spiral_exhausted"}], None,
+        reason="attempt spiral exhausted (probe answered, workload never finished)",
+    )
+    doc = json.loads(open(path).read())
+    assert doc["reason"].startswith("attempt spiral exhausted")
+    # and the fresh dossier is immediately visible to the fast-fail cache
+    monkeypatch.delenv("KASPA_TPU_BENCH_FORCE_PROBE", raising=False)
+    assert bench._cached_wedge([]) is not None
 
 
 def test_bench_probe_mode_emits_json_line():

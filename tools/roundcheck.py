@@ -82,6 +82,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-bench", action="store_true", help="skip the bench device probe")
     ap.add_argument("--skip-mesh", action="store_true", help="skip the multichip dryrun + mesh smoke replay")
     ap.add_argument("--skip-chaos", action="store_true", help="skip the hostile-load chaos sustain run")
+    ap.add_argument("--skip-dispatch", action="store_true", help="skip the coalesced-dispatch throughput lane")
     ap.add_argument("--chaos-blocks", type=int, default=24, help="chaos sustain main-DAG length")
     # long enough that coinbase maturity passes and real signature batches
     # flow through the sharded verify path (a 12-block replay carries 0 txs)
@@ -169,6 +170,41 @@ def main(argv: list[str] | None = None) -> int:
         sect["result"] = result
         sect["ok"] = sect["rc"] == 0 and bool(result) and result.get("mesh") == 8
         evidence["sections"]["mesh_smoke"] = sect
+        ok &= sect["ok"]
+
+    if not args.skip_dispatch:
+        # coalesced dispatch lane: cross-block coalescing vs legacy per-block
+        # dispatch over the same jobs on the CPU bench path.  Chunk size 4
+        # models the sim's per-block signature count (tpb 4; every block
+        # pads half its bucket-8 lanes); the coalesced lane packs the same
+        # jobs into 64-lane super-batches.  Acceptance: >= 1.3x verifies/sec AND
+        # a 24-block sim replay (long enough for coinbase maturity, so real
+        # signature batches flow) bit-identical (sink + utxo_commitment)
+        # with coalescing on vs off.
+        sect = _run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+            900.0,
+            {
+                **mesh_env,
+                "KASPA_TPU_BENCH_CHILD": "1",
+                "KASPA_TPU_BENCH_MODE": "dispatch",
+                "KASPA_TPU_BENCH_DISPATCH_B": "120",
+                "KASPA_TPU_BENCH_CHUNK": "4",
+                "KASPA_TPU_COALESCE": "64",
+                "KASPA_TPU_BENCH_DISPATCH_REPLAY": "24",
+            },
+        )
+        result = _last_json_line(sect)
+        if result is not None:
+            result.pop("observability", None)
+        sect["result"] = result
+        sect["ok"] = (
+            sect["rc"] == 0
+            and bool(result)
+            and result.get("speedup", 0.0) >= 1.3
+            and bool(result.get("replay_identical"))
+        )
+        evidence["sections"]["dispatch"] = sect
         ok &= sect["ok"]
 
     if not args.skip_chaos:
