@@ -1,53 +1,428 @@
-"""UTXO index: script-pubkey -> UTXO inverted index.
+"""UTXO index: script-pubkey -> UTXO inverted index, memory- or DB-backed.
 
 Reference: indexes/utxoindex/src/{index.rs,update_container.rs,stores/} —
 fed by UtxosChanged virtual diffs from the consensus notification root,
-with full resync from the virtual UTXO set on reset.
+with full resync from the virtual UTXO set only on a version/network
+mismatch (stores/indexed_utxos.rs + supply.rs + tips.rs columns).
+
+Persistent mode rides the crash-safe journaled KV batches from storage/kv:
+every UtxosChanged diff lands as ONE atomic write batch (utxo puts/deletes
++ supply + position + an undo-journal record), so a kill -9 can only ever
+leave the index at a batch boundary.  Because consensus publishes the
+notification BEFORE flushing its own stores (virtual resolve precedes
+``storage.flush``), a crash can leave the index AHEAD of the reopened
+consensus by one diff — the bounded undo journal rewinds exactly those
+diffs on reopen, then the selected-chain walk over ``consensus.utxo_diffs``
+replays forward to the live sink.  Full resync is the last resort, never
+the restart path.
+
+DB layout (single KvStore, own file — ``utxoindex.db`` beside the
+consensus DB):
+
+  ``M...``            meta: version, network, position(32B), supply(u64 LE),
+                      dirty marker (present only mid-resync)
+  ``U`` + len(script) as u16 BE + script + txid(32) + index(u32 BE)
+                      -> serde-encoded UtxoEntry (the length prefix makes
+                      the per-script prefix scan exact: no same-prefix
+                      script can alias)
+  ``J`` + seq(u64 BE) -> undo record: prev_pos | new_pos | added | removed
 """
 
 from __future__ import annotations
 
+import struct
+
+from kaspa_tpu.consensus import serde
 from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model import TransactionOutpoint
+from kaspa_tpu.core.log import get_logger
 from kaspa_tpu.notify.notifier import Notification
+from kaspa_tpu.observability.core import REGISTRY
+
+log = get_logger("utxoindex")
+
+INDEX_VERSION = 1
+
+_META_VERSION = b"Mversion"
+_META_NETWORK = b"Mnetwork"
+_META_POSITION = b"Mposition"
+_META_SUPPLY = b"Msupply"
+_META_DIRTY = b"Mdirty"
+_UTXO = b"U"
+_JOURNAL = b"J"
+
+_JOURNAL_KEEP = 16  # rewind depth >> the 1-diff crash window
+_RESYNC_CHUNK = 4096
+
+_OPENS = REGISTRY.counter_family(
+    "utxoindex_opens", "mode", help="index open outcomes: memory/fresh/clean/catchup/resync"
+)
+_DIFFS = REGISTRY.counter("utxoindex_diffs_applied", help="UtxosChanged diffs applied atomically to the index DB")
+_REWINDS = REGISTRY.counter("utxoindex_journal_rewinds", help="crash-window diffs undone from the journal on reopen")
+_CATCHUP = REGISTRY.counter("utxoindex_catchup_blocks", help="chain diffs replayed to reach the live sink on reopen")
+_RESYNCS = REGISTRY.counter("utxoindex_resyncs", help="full rebuilds from the virtual UTXO set")
+
+
+class UtxoIndexError(Exception):
+    pass
+
+
+class _CatchUpError(UtxoIndexError):
+    """Reopen state can't be reconciled incrementally — resync instead."""
+
+
+def utxo_key(script: bytes, outpoint: TransactionOutpoint) -> bytes:
+    if len(script) > 0xFFFF:
+        raise UtxoIndexError(f"script of {len(script)} bytes exceeds the index key bound")
+    return _UTXO + struct.pack(">H", len(script)) + script + outpoint.transaction_id + struct.pack(">I", outpoint.index)
+
+
+def script_prefix(script: bytes) -> bytes:
+    return _UTXO + struct.pack(">H", len(script)) + script
+
+
+def _encode_journal(prev_pos: bytes, new_pos: bytes, added, removed) -> bytes:
+    import io
+
+    w = io.BytesIO()
+    w.write(prev_pos)
+    w.write(new_pos)
+    for pairs in (added, removed):
+        w.write(struct.pack("<I", len(pairs)))
+        for outpoint, entry in pairs:
+            serde.write_outpoint(w, outpoint)
+            serde.write_utxo_entry(w, entry)
+    return w.getvalue()
+
+
+def _decode_journal(data: bytes):
+    import io
+
+    r = io.BytesIO(data)
+    prev_pos = r.read(32)
+    new_pos = r.read(32)
+    out = []
+    for _ in range(2):
+        (n,) = struct.unpack("<I", r.read(4))
+        out.append([(serde.read_outpoint(r), serde.read_utxo_entry(r)) for _ in range(n)])
+    return prev_pos, new_pos, out[0], out[1]
 
 
 class UtxoIndex:
-    def __init__(self, consensus: Consensus):
+    """``UtxoIndex(consensus)`` is the in-memory index (tests, --no-persist);
+    ``UtxoIndex(consensus, db_path=...)`` is the persistent serving index."""
+
+    VERSION = INDEX_VERSION
+
+    def __init__(self, consensus: Consensus, db_path: str | None = None, db=None):
         self.consensus = consensus
-        # spk script bytes -> {outpoint: UtxoEntry}
-        self._by_script: dict[bytes, dict] = {}
+        self.db = db
+        self._owns_db = False
+        if db is None and db_path is not None:
+            from kaspa_tpu.storage.kv import KvStore
+
+            self.db = KvStore(db_path)
+            self._owns_db = True
+        # in-memory mode only: spk script bytes -> {outpoint: UtxoEntry}
+        self._by_script: dict[bytes, dict] | None = {} if self.db is None else None
+        self._position: bytes = consensus.params.genesis.hash
+        self._supply = 0
+        self._journal_seq = 0
+        self.open_mode: str | None = None
+        self.journal_rewinds = 0
+        self.catchup_blocks = 0
         self._listener_id = consensus.notification_root.register(self._on_notification)
         consensus.notification_root.start_notify(self._listener_id, "utxos-changed")
-        self.resync()
+        try:
+            if self.db is None:
+                self.resync()
+                self.open_mode = "memory"
+            else:
+                self._open_persistent()
+        except BaseException:
+            self.close()
+            raise
+        _OPENS.inc(self.open_mode)
+
+    # ------------------------------------------------------------------
+    # notification path
+    # ------------------------------------------------------------------
 
     def _on_notification(self, n: Notification) -> None:
         if n.event_type != "utxos-changed":
             return
-        for outpoint, entry in n.data.get("removed", []):
-            bucket = self._by_script.get(entry.script_public_key.script)
-            if bucket is not None:
-                bucket.pop(outpoint, None)
-                if not bucket:
-                    del self._by_script[entry.script_public_key.script]
-        for outpoint, entry in n.data.get("added", []):
-            self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
+        added = n.data.get("added", [])
+        removed = n.data.get("removed", [])
+        if self.db is None:
+            for outpoint, entry in removed:
+                bucket = self._by_script.get(entry.script_public_key.script)
+                if bucket is not None:
+                    bucket.pop(outpoint, None)
+                    if not bucket:
+                        del self._by_script[entry.script_public_key.script]
+            for outpoint, entry in added:
+                self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
+            return
+        sink = n.data.get("sink", self._position)
+        try:
+            self._apply_diff(added, removed, sink)
+            _DIFFS.inc()
+        except Exception:  # noqa: BLE001 - a broken diff must not wedge consensus
+            log.exception("diff application failed at %s; rebuilding index", sink.hex()[:16])
+            self.resync()
+
+    def _apply_diff(self, added, removed, new_pos: bytes, journal: bool = True) -> None:
+        """ONE atomic batch: entry mutations + supply + position + journal."""
+        eng = self.db.engine
+        delta = 0
+        prev_pos = self._position
+        with self.db.batch() as b:
+            for outpoint, entry in removed:
+                key = utxo_key(entry.script_public_key.script, outpoint)
+                if not eng.has(key):
+                    raise UtxoIndexError(f"removed entry missing from index: {outpoint}")
+                b.delete(key)
+                delta -= entry.amount
+            for outpoint, entry in added:
+                b.put(utxo_key(entry.script_public_key.script, outpoint), serde.encode_utxo_entry(entry))
+                delta += entry.amount
+            if self._supply + delta < 0:
+                raise UtxoIndexError("circulating supply went negative")
+            b.put(_META_SUPPLY, struct.pack("<Q", self._supply + delta))
+            b.put(_META_POSITION, new_pos)
+            if journal and new_pos != prev_pos:
+                b.put(_JOURNAL + struct.pack(">Q", self._journal_seq), _encode_journal(prev_pos, new_pos, added, removed))
+                drop = self._journal_seq - _JOURNAL_KEEP
+                if drop >= 0:
+                    b.delete(_JOURNAL + struct.pack(">Q", drop))
+        self._supply += delta
+        self._position = new_pos
+        if journal and new_pos != prev_pos:
+            self._journal_seq += 1
+
+    # ------------------------------------------------------------------
+    # open / reconcile
+    # ------------------------------------------------------------------
+
+    def _open_persistent(self) -> None:
+        eng = self.db.engine
+        raw_ver = eng.get(_META_VERSION)
+        net = self.consensus.params.name
+        if raw_ver is None:
+            self.resync()
+            self.open_mode = "fresh"
+            return
+        stored_net = (eng.get(_META_NETWORK) or b"").decode()
+        pos = eng.get(_META_POSITION)
+        supply_raw = eng.get(_META_SUPPLY)
+        if (
+            int(raw_ver) != self.VERSION
+            or stored_net != net
+            or pos is None
+            or supply_raw is None
+            or eng.get(_META_DIRTY) is not None  # crashed mid-resync
+        ):
+            self.resync()
+            self.open_mode = "resync"
+            return
+        self._position = pos
+        self._supply = struct.unpack("<Q", supply_raw)[0]
+        self._journal_seq = self._next_journal_seq()
+        target = self.consensus.sink()
+        if pos == target:
+            self.open_mode = "clean"
+            return
+        try:
+            self._catch_up(target)
+            self.open_mode = "catchup"
+        except (UtxoIndexError, KeyError, AssertionError) as e:
+            log.warning("incremental catch-up failed (%s); full resync", e)
+            self.resync()
+            self.open_mode = "resync"
+
+    def _next_journal_seq(self) -> int:
+        keys = self.db.engine.keys_prefix(_JOURNAL)
+        return struct.unpack(">Q", keys[-1])[0] + 1 if keys else 0
+
+    def _known(self, block: bytes) -> bool:
+        c = self.consensus
+        return c.storage.statuses.get(block) is not None and c.reachability.has(block)
+
+    def _catch_up(self, target: bytes) -> None:
+        """Reconcile the stored position with the reopened consensus:
+        (1) rewind journal records while the stored position is unknown to
+        consensus (the notify-before-flush crash window), then (2) the
+        selected-chain back/forward walk over ``utxo_diffs`` — the index's
+        copy of ``Consensus._move_utxo_position``, applied to the DB."""
+        c = self.consensus
+        rewinds = 0
+        while not self._known(self._position):
+            if rewinds >= _JOURNAL_KEEP:
+                raise _CatchUpError("position unknown to consensus beyond journal depth")
+            self._rewind_one()
+            rewinds += 1
+        cur = self._position
+        back = []
+        while not c.reachability.is_chain_ancestor_of(cur, target):
+            back.append(cur)
+            cur = c.storage.ghostdag.get_selected_parent(cur)
+        fwd = []
+        t = target
+        while t != cur:
+            fwd.append(t)
+            t = c.storage.ghostdag.get_selected_parent(t)
+        for b in back:
+            diff = c.utxo_diffs.get(b)
+            if diff is None:
+                raise _CatchUpError(f"no chain diff for {b.hex()[:16]}")
+            # unapply: the inverse mutation, journaled like any other move
+            self._apply_diff(list(diff.remove.items()), list(diff.add.items()),
+                             c.storage.ghostdag.get_selected_parent(b))
+        for b in reversed(fwd):
+            diff = c.utxo_diffs.get(b)
+            if diff is None:
+                raise _CatchUpError(f"no chain diff for {b.hex()[:16]}")
+            self._apply_diff(list(diff.add.items()), list(diff.remove.items()), b)
+        moved = len(back) + len(fwd)
+        self.catchup_blocks += moved
+        _CATCHUP.inc(moved)
+
+    def _rewind_one(self) -> None:
+        """Undo the most recent journaled diff (one atomic batch)."""
+        eng = self.db.engine
+        keys = eng.keys_prefix(_JOURNAL)
+        if not keys:
+            raise _CatchUpError("undo journal is empty")
+        last = keys[-1]
+        prev_pos, new_pos, added, removed = _decode_journal(eng.get(_JOURNAL + last))
+        if new_pos != self._position:
+            raise _CatchUpError("journal head does not match the stored position")
+        delta = 0
+        with self.db.batch() as b:
+            for outpoint, entry in added:
+                key = utxo_key(entry.script_public_key.script, outpoint)
+                if not eng.has(key):
+                    raise _CatchUpError(f"journaled add missing from index: {outpoint}")
+                b.delete(key)
+                delta -= entry.amount
+            for outpoint, entry in removed:
+                b.put(utxo_key(entry.script_public_key.script, outpoint), serde.encode_utxo_entry(entry))
+                delta += entry.amount
+            b.put(_META_SUPPLY, struct.pack("<Q", self._supply + delta))
+            b.put(_META_POSITION, prev_pos)
+            b.delete(_JOURNAL + last)
+        self._supply += delta
+        self._position = prev_pos
+        self._journal_seq = struct.unpack(">Q", last)[0]
+        self.journal_rewinds += 1
+        _REWINDS.inc()
+
+    # ------------------------------------------------------------------
+    # resync
+    # ------------------------------------------------------------------
 
     def resync(self) -> None:
         """Rebuild from the sink UTXO state (index.rs resync).
 
         Tracks the materialized selected-chain state; the unmerged virtual
         mergeset diff is intentionally excluded (it is replayed when those
-        blocks become chain blocks)."""
-        self._by_script.clear()
-        self.consensus._move_utxo_position(self.consensus.sink())
-        for outpoint, entry in self.consensus.utxo_set.items():
-            self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
+        blocks become chain blocks).  Persistent mode writes in chunked
+        atomic batches under a dirty marker, so a crash mid-resync reopens
+        as another resync, never as a silently-partial index."""
+        c = self.consensus
+        c._move_utxo_position(c.sink())
+        if self.db is None:
+            self._by_script.clear()
+            for outpoint, entry in c.utxo_set.items():
+                self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
+            return
+        _RESYNCS.inc()
+        eng = self.db.engine
+        eng.put(_META_DIRTY, b"1")
+        for prefix in (_UTXO, _JOURNAL):
+            keys = eng.keys_prefix(prefix)
+            for i in range(0, len(keys), _RESYNC_CHUNK):
+                with self.db.batch() as b:
+                    for k in keys[i : i + _RESYNC_CHUNK]:
+                        b.delete(prefix + k)
+        supply = 0
+        chunk: list[tuple[bytes, bytes]] = []
+
+        def flush_chunk():
+            with self.db.batch() as b:
+                for k, v in chunk:
+                    b.put(k, v)
+            chunk.clear()
+
+        for outpoint, entry in c.utxo_set.items():
+            chunk.append((utxo_key(entry.script_public_key.script, outpoint), serde.encode_utxo_entry(entry)))
+            supply += entry.amount
+            if len(chunk) >= _RESYNC_CHUNK:
+                flush_chunk()
+        flush_chunk()
+        with self.db.batch() as b:
+            # version/network/position land with the dirty-marker removal:
+            # the index only ever looks committed when it IS committed
+            b.put(_META_VERSION, str(self.VERSION).encode())
+            b.put(_META_NETWORK, c.params.name.encode())
+            b.put(_META_POSITION, c.sink())
+            b.put(_META_SUPPLY, struct.pack("<Q", supply))
+            b.delete(_META_DIRTY)
+        self._position = c.sink()
+        self._supply = supply
+        self._journal_seq = 0
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
 
     def get_utxos_by_script(self, script: bytes) -> dict:
-        return dict(self._by_script.get(script, {}))
+        if self.db is None:
+            return dict(self._by_script.get(script, {}))
+        out = {}
+        for suffix, value in self.db.engine.items_prefix(script_prefix(script)):
+            outpoint = TransactionOutpoint(suffix[:32], struct.unpack(">I", suffix[32:36])[0])
+            out[outpoint] = serde.decode_utxo_entry(value)
+        return out
 
     def get_balance_by_script(self, script: bytes) -> int:
-        return sum(e.amount for e in self._by_script.get(script, {}).values())
+        if self.db is None:
+            return sum(e.amount for e in self._by_script.get(script, {}).values())
+        return sum(e.amount for e in self.get_utxos_by_script(script).values())
 
     def get_circulating_supply(self) -> int:
-        return sum(e.amount for bucket in self._by_script.values() for e in bucket.values())
+        if self.db is None:
+            return sum(e.amount for bucket in self._by_script.values() for e in bucket.values())
+        return self._supply
+
+    def entry_count(self) -> int:
+        if self.db is None:
+            return sum(len(b) for b in self._by_script.values())
+        return self.db.engine.count_prefix(_UTXO)
+
+    @property
+    def position(self) -> bytes:
+        return self._position
+
+    def content_snapshot(self):
+        """(position, supply, ordered U-column pairs) — the identity the
+        kill -9 acceptance compares against a fresh resync.  Journal and
+        meta columns are excluded by construction (they encode HOW the
+        state was reached, not the state)."""
+        if self.db is None:
+            raise UtxoIndexError("content_snapshot requires the persistent index")
+        return (self._position, self._supply, self.db.engine.items_prefix(_UTXO))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        """Unregister from the notification root (a torn-down index must
+        stop receiving diffs) and close an owned DB.  Idempotent."""
+        if self._listener_id is not None:
+            self.consensus.notification_root.unregister(self._listener_id)
+            self._listener_id = None
+        if self._owns_db and self.db is not None:
+            self.db.close()
+            self.db = None

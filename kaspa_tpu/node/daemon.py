@@ -23,8 +23,15 @@ from kaspa_tpu.consensus.consensus import Consensus
 from kaspa_tpu.consensus.params import Params, simnet_params
 from kaspa_tpu.index import UtxoIndex
 from kaspa_tpu.mempool import MiningManager
+from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.p2p import Node
 from kaspa_tpu.rpc import RpcCoreService
+
+# per-encoding request counters (rpc/wrpc/server metrics): line-json is the
+# TCP transport, json/borsh are the WebSocket text/binary frame paths
+_RPC_BY_ENCODING = REGISTRY.counter_family(
+    "rpc_requests_by_encoding", "encoding", help="RPC requests served, by wire encoding"
+)
 
 
 class DaemonArgs(argparse.Namespace):
@@ -43,6 +50,14 @@ def parse_args(argv=None) -> DaemonArgs:
     )
     p.add_argument("--bps", type=int, default=2, help="simnet blocks per second")
     p.add_argument("--utxoindex", action=argparse.BooleanOptionalAction, default=True, help="maintain the UTXO index")
+    p.add_argument(
+        "--fanout-queue", type=int, default=1024,
+        help="per-subscriber bounded notification queue length (serving tier backpressure)",
+    )
+    p.add_argument(
+        "--fanout-policy", default="drop-oldest", choices=["drop-oldest", "disconnect"],
+        help="subscriber queue overflow policy: evict the oldest event, or tear the connection down",
+    )
     p.add_argument("--address-prefix", default=None, help="bech32 prefix (defaults per network)")
     p.add_argument(
         "--persist",
@@ -126,6 +141,14 @@ def _apply_param_overrides(params: Params, args: DaemonArgs) -> Params:
     return params
 
 
+def _json_notification_line(n) -> bytes:
+    """Serving-tier JSON encoder: one Notification -> one wire line.  Runs
+    on the subscriber's sender thread, never on the consensus thread."""
+    return (
+        json.dumps({"notification": {"event": n.event_type, "data": _serialize_notification(n)}}) + "\n"
+    ).encode()
+
+
 def _serialize_notification(n) -> dict:
     """Wire shapes for streamed notifications (rpc/grpc/server's
     notification message bodies, JSON-ified)."""
@@ -172,13 +195,14 @@ class ConnectionPump:
     stall the consensus thread publishing an event — overflow drops, never
     blocks — plus the subscription-listener lifecycle."""
 
-    def __init__(self, daemon: "Daemon", wfile, name: str):
+    def __init__(self, daemon: "Daemon", wfile, name: str, encoding: str = "line-json"):
         import queue as _queue
 
         self.daemon = daemon
         self.outq: _queue.Queue = _queue.Queue(maxsize=4096)
         self.stop = threading.Event()
-        self.listener_ref = [None]
+        self.subscriber_ref = [None]  # one serving Subscriber per connection
+        self.encoding = encoding
         self._wfile = wfile
         self._queue_mod = _queue
         self._writer = threading.Thread(target=self._writer_loop, daemon=True, name=name)
@@ -211,7 +235,7 @@ class ConnectionPump:
             try:
                 self._wfile.write(item)
                 self._wfile.flush()
-            except OSError:
+            except (OSError, ValueError):  # ValueError: write on a closed file object
                 self.stop.set()
                 return
 
@@ -223,6 +247,7 @@ class ConnectionPump:
         ``notification_sink``: queue-like receiving notification lines
         (defaults to the raw outq — the line-JSON transport)."""
         req_id = None
+        _RPC_BY_ENCODING.inc(self.encoding)
         try:
             req = json.loads(payload)
             req_id = req.get("id")
@@ -230,7 +255,7 @@ class ConnectionPump:
             params = req.get("params", {})
             if method in ("subscribe", "unsubscribe"):
                 result = self.daemon.handle_subscription(
-                    method, params, notification_sink or self.outq, self.listener_ref, self.stop
+                    method, params, notification_sink or self.outq, self.subscriber_ref, self.stop
                 )
             else:
                 result = self.daemon.dispatch(method, params)
@@ -240,9 +265,12 @@ class ConnectionPump:
         return (json.dumps(resp) + "\n").encode()
 
     def close(self) -> None:
-        if self.listener_ref[0] is not None:
+        sub = self.subscriber_ref[0]
+        if sub is not None:
+            self.subscriber_ref[0] = None
             with self.daemon._dispatch_lock:
-                self.daemon.rpc.unregister_listener(self.listener_ref[0])
+                self.daemon.broadcaster.unregister(sub)
+            sub.close()  # join the sender thread outside the lock
         self.stop.set()
         try:
             self.outq.put_nowait(None)
@@ -339,7 +367,12 @@ class Daemon:
         self.node.cmgr._factory = self._staging_factory
         self.node.cmgr.on_swap(self._on_consensus_swap)
         self.mining = self.node.mining
-        self.utxoindex = UtxoIndex(self.consensus) if args.utxoindex else None
+        import itertools
+
+        self._fanout_queue = getattr(args, "fanout_queue", None) or 1024
+        self._fanout_policy = getattr(args, "fanout_policy", None) or "drop-oldest"
+        self._sub_seq = itertools.count(1)
+        self.utxoindex = self._make_utxoindex(self.consensus) if args.utxoindex else None
         from kaspa_tpu.p2p.address_manager import AddressManager, ConnectionManager
 
         self.address_manager = AddressManager()
@@ -357,6 +390,13 @@ class Daemon:
             connection_manager=self.connection_manager,
             shutdown_fn=lambda: threading.Thread(target=self.stop, daemon=True).start(),
         )
+        # serving tier: the async fanout stage between the rpc notifier and
+        # every remote subscriber.  Bound to the notifier OBJECT, which
+        # survives consensus staging swaps via rebind_parent, so the
+        # broadcaster (and its wildcard listener id) lives daemon-long.
+        from kaspa_tpu.serving import Broadcaster
+
+        self.broadcaster = Broadcaster(self.rpc.notifier)
         from kaspa_tpu.mining import MiningRuleEngine
 
         allow_unsynced = getattr(args, "enable_unsynced_mining", None)
@@ -483,6 +523,44 @@ class Daemon:
                 f"consensus DB version {version} is newer than this binary supports ({DB_VERSION})"
             )
 
+    def _make_utxoindex(self, consensus) -> UtxoIndex:
+        """Persistent (journaled KV under <appdir>/utxoindex.db) when the
+        node persists; the in-memory index otherwise."""
+        db_path = None
+        if getattr(self.args, "persist", False):
+            db_path = os.path.join(self.args.appdir, "utxoindex.db")
+        return UtxoIndex(consensus, db_path=db_path)
+
+    # --- serving-tier subscribers (one per connection, lazily created) ---
+
+    def make_json_subscriber(self, sink, stop=None):
+        from kaspa_tpu.serving import Subscriber
+
+        return Subscriber(
+            f"json-{next(self._sub_seq)}",
+            _json_notification_line,
+            sink,
+            encoding="json",
+            maxlen=self._fanout_queue,
+            policy=self._fanout_policy,
+            on_disconnect=stop.set if stop is not None else None,
+        )
+
+    def make_borsh_subscriber(self, sink, stop=None):
+        from kaspa_tpu.rpc import borsh_codec
+        from kaspa_tpu.serving import Subscriber
+
+        prefix = self.args.address_prefix
+        return Subscriber(
+            f"borsh-{next(self._sub_seq)}",
+            lambda n: borsh_codec.encode_notification(n, prefix),
+            sink,
+            encoding="borsh",
+            maxlen=self._fanout_queue,
+            policy=self._fanout_policy,
+            on_disconnect=stop.set if stop is not None else None,
+        )
+
     # --- staging consensus (proof IBD) ---
 
     def _staging_factory(self):
@@ -503,7 +581,11 @@ class Daemon:
         old_notifier = self.rpc.notifier
         self.consensus = new_consensus
         self.mining = self.node.mining
-        self.utxoindex = UtxoIndex(new_consensus) if self.args.utxoindex else None
+        if self.utxoindex is not None:
+            # the persistent index owns <appdir>/utxoindex.db: close it
+            # (listener + db handle) before the replacement reopens the path
+            self.utxoindex.close()
+        self.utxoindex = self._make_utxoindex(new_consensus) if self.args.utxoindex else None
         self.rpc = RpcCoreService(
             new_consensus,
             self.mining,
@@ -582,42 +664,32 @@ class Daemon:
         ),
     }
 
-    def handle_subscription(self, method: str, params: dict, outq, listener_ref, stop) -> str:
+    def handle_subscription(self, method: str, params: dict, sink, subscriber_ref, stop) -> str:
         """subscribe/unsubscribe verbs for one connection.
 
         params: {"event": <EVENT_TYPES name>, "addresses": [bech32...]?}.
-        The connection's listener is registered lazily on first subscribe;
-        its callback only enqueues (never blocks the notifier)."""
-        import queue as _queue
-
+        The connection's serving Subscriber (bounded queue + sender thread)
+        is created lazily on first subscribe and registered on the
+        broadcaster; the UtxosChanged address scope is pushed down so
+        filtering happens once per event at the fanout stage."""
         from kaspa_tpu.notify.notifier import EVENT_TYPES
 
         event = params.get("event")
         if event not in EVENT_TYPES:
             raise ValueError(f"unknown event type {event!r}")
+        scripts = None
+        addresses = params.get("addresses")
+        if addresses:
+            from kaspa_tpu.crypto.addresses import Address, pay_to_address_script
+
+            scripts = {pay_to_address_script(Address.from_string(a)).script for a in addresses}
         with self._dispatch_lock:
-            if listener_ref[0] is None:
-
-                def on_notification(n, _outq=outq, _stop=stop):
-                    if _stop.is_set():
-                        return
-                    try:
-                        _outq.put_nowait(
-                            (
-                                json.dumps(
-                                    {"notification": {"event": n.event_type, "data": _serialize_notification(n)}}
-                                )
-                                + "\n"
-                            ).encode()
-                        )
-                    except _queue.Full:
-                        pass  # slow consumer: drop rather than stall consensus
-
-                listener_ref[0] = self.rpc.register_listener(on_notification)
+            if subscriber_ref[0] is None:
+                subscriber_ref[0] = self.broadcaster.register(self.make_json_subscriber(sink, stop))
             if method == "subscribe":
-                self.rpc.start_notify(listener_ref[0], event, params.get("addresses"))
+                self.broadcaster.subscribe(subscriber_ref[0], event, scripts)
             else:
-                self.rpc.stop_notify(listener_ref[0], event)
+                self.broadcaster.unsubscribe(subscriber_ref[0], event)
         return "ok"
 
     def dispatch(self, method: str, params: dict):
@@ -842,6 +914,14 @@ class Daemon:
         # resolving after the db handle closes would write sig-cache entries
         # for a consensus object that is already torn down
         verify_dispatch.drain(timeout=10.0)
+        # serving tier down before the stores: the broadcaster detaches from
+        # the notifier (no new fanout), then the index unhooks its listener
+        # and closes its own db — both idempotent, stop() may race itself
+        with self._dispatch_lock:
+            if getattr(self, "broadcaster", None) is not None:
+                self.broadcaster.close()
+            if self.utxoindex is not None:
+                self.utxoindex.close()
         # quiesce dispatch before closing the native handle: an in-flight
         # handler finishes under the lock; later ones see db == None and
         # stage() no-ops (server is already down, nothing new arrives).

@@ -183,6 +183,12 @@ class ConsensusNotificationRoot(Notifier):
                         # carried so remote consumers can classify coinbase
                         # maturity without a separate daa-score subscription
                         "virtual_daa_score": virtual_state.daa_score,
+                        # the materialized selected-chain position this diff
+                        # moves a consumer to — the persistent utxoindex
+                        # journals (prev, sink) per applied diff so a crash
+                        # between index commit and consensus flush can be
+                        # rewound instead of triggering a full resync
+                        "sink": virtual_state.ghostdag_data.selected_parent,
                     },
                 )
             )

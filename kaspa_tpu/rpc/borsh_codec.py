@@ -31,6 +31,15 @@ OP_SUBSCRIBE = 3
 OP_BLOCK_ADDED_NOTIFICATION = 60
 OP_SUBMIT_BLOCK = 117
 OP_GET_INFO = 141
+# serving-tier methods: this frame's op assignment (the reference numbers
+# them inside the external workflow-rpc crate); pinned by the golden
+# fixtures under tests/fixtures/borsh/
+OP_GET_UTXOS_BY_ADDRESSES = 145
+OP_GET_BALANCE_BY_ADDRESS = 146
+OP_GET_COIN_SUPPLY = 147
+# notification ops follow the EVENT_TYPES order from the block-added base:
+# op = 60 + EVENT_TYPES.index(event) (ops.rs keeps notifications contiguous)
+OP_UTXOS_CHANGED_NOTIFICATION = 64
 
 KIND_REQUEST = 0
 KIND_RESPONSE = 1
@@ -424,6 +433,165 @@ def encode_block_added_notification(w, block, verbose: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serving-tier payloads: UTXO queries + UtxosChanged (message.rs
+# GetUtxosByAddresses*/GetBalanceByAddress*/GetCoinSupply*/UtxosChanged*)
+# ---------------------------------------------------------------------------
+
+def encode_utxo_entry_rpc(w, e) -> None:
+    """RpcUtxoEntry (tx.rs:361-370): amount, spk, daa score, coinbase flag,
+    plus the version-2 Option<covenant id> this consensus carries."""
+    w_u16(w, 2)
+    w_u64(w, e.amount)
+    w_u16(w, e.script_public_key.version)
+    w_bytes(w, e.script_public_key.script)
+    w_u64(w, e.block_daa_score)
+    w_bool(w, e.is_coinbase)
+    if e.covenant_id is None:
+        w_u8(w, 0)
+    else:
+        w_u8(w, 1)
+        w_hash(w, e.covenant_id)
+
+
+def decode_utxo_entry_rpc(r):
+    from kaspa_tpu.consensus.model import ScriptPublicKey, UtxoEntry
+
+    r_u16(r)
+    amount = r_u64(r)
+    spk = ScriptPublicKey(r_u16(r), r_bytes(r))
+    daa = r_u64(r)
+    coinbase = r_bool(r)
+    cov = r_hash(r) if r_u8(r) == 1 else None
+    return UtxoEntry(amount, spk, daa, coinbase, cov)
+
+
+def encode_utxos_by_addresses_entry(w, address: str | None, outpoint, entry) -> None:
+    """RpcUtxosByAddressesEntry (message.rs:1764-1771): Option<address>
+    (None for scripts with no standard address form) + outpoint + entry."""
+    w_u16(w, 1)
+    if address is None:
+        w_u8(w, 0)
+    else:
+        w_u8(w, 1)
+        w_string(w, address)
+    encode_outpoint(w, outpoint)
+    encode_utxo_entry_rpc(w, entry)
+
+
+def decode_utxos_by_addresses_entry(r):
+    r_u16(r)
+    address = r_string(r) if r_u8(r) == 1 else None
+    return address, decode_outpoint(r), decode_utxo_entry_rpc(r)
+
+
+def encode_get_utxos_by_addresses_request(w, addresses: list[str]) -> None:
+    w_u16(w, 1)
+    w_u32(w, len(addresses))
+    for a in addresses:
+        w_string(w, a)
+
+
+def decode_get_utxos_by_addresses_request(r) -> list[str]:
+    r_u16(r)
+    return [r_string(r) for _ in range(r_u32(r))]
+
+
+def encode_get_utxos_by_addresses_response(w, entries) -> None:
+    """entries: (address|None, outpoint, UtxoEntry) triples."""
+    w_u16(w, 1)
+    w_u32(w, len(entries))
+    for address, outpoint, entry in entries:
+        encode_utxos_by_addresses_entry(w, address, outpoint, entry)
+
+
+def decode_get_utxos_by_addresses_response(r):
+    r_u16(r)
+    return [decode_utxos_by_addresses_entry(r) for _ in range(r_u32(r))]
+
+
+def encode_get_balance_by_address_request(w, address: str) -> None:
+    w_u16(w, 1)
+    w_string(w, address)
+
+
+def decode_get_balance_by_address_request(r) -> str:
+    r_u16(r)
+    return r_string(r)
+
+
+def encode_get_balance_by_address_response(w, balance: int) -> None:
+    w_u16(w, 1)
+    w_u64(w, balance)
+
+
+def decode_get_balance_by_address_response(r) -> int:
+    r_u16(r)
+    return r_u64(r)
+
+
+# consensus/core/src/constants.rs MAX_SOMPI: 29B KAS in sompi
+MAX_SOMPI = 29_000_000_000 * 100_000_000
+
+
+def encode_get_coin_supply_request(w) -> None:
+    w_u16(w, 1)
+
+
+def decode_get_coin_supply_request(r) -> dict:
+    r_u16(r)
+    return {}
+
+
+def encode_get_coin_supply_response(w, circulating_sompi: int, max_sompi: int = MAX_SOMPI) -> None:
+    """message.rs GetCoinSupplyResponse: max then circulating."""
+    w_u16(w, 1)
+    w_u64(w, max_sompi)
+    w_u64(w, circulating_sompi)
+
+
+def decode_get_coin_supply_response(r) -> dict:
+    r_u16(r)
+    return {"max_sompi": r_u64(r), "circulating_sompi": r_u64(r)}
+
+
+def encode_utxos_changed_notification(w, added, removed, address_prefix: str | None = None) -> None:
+    """message.rs:3127-3133 UtxosChangedNotification: added/removed entry
+    vecs.  ``added``/``removed`` are (outpoint, UtxoEntry) pairs; addresses
+    are recovered from the script pubkey (None when nonstandard)."""
+    w_u16(w, 1)
+    for pairs in (added, removed):
+        w_u32(w, len(pairs))
+        for outpoint, entry in pairs:
+            address = None
+            if address_prefix is not None:
+                from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+                try:
+                    address = extract_script_pub_key_address(entry.script_public_key, address_prefix).to_string()
+                except Exception:  # noqa: BLE001 - nonstandard script: no address form
+                    address = None
+            encode_utxos_by_addresses_entry(w, address, outpoint, entry)
+
+
+def decode_utxos_changed_notification(r) -> dict:
+    r_u16(r)
+    added = [decode_utxos_by_addresses_entry(r) for _ in range(r_u32(r))]
+    removed = [decode_utxos_by_addresses_entry(r) for _ in range(r_u32(r))]
+    return {"added": added, "removed": removed}
+
+
+def encode_subscribe_request(w, event_op: int, addresses: list[str] | None = None) -> None:
+    """Subscribe payload: the notification op, plus (UtxosChanged only) the
+    bech32 address scope — an empty vec subscribes to all addresses."""
+    w_u32(w, event_op)
+    if event_op == OP_UTXOS_CHANGED_NOTIFICATION:
+        addrs = addresses or []
+        w_u32(w, len(addrs))
+        for a in addrs:
+            w_string(w, a)
+
+
+# ---------------------------------------------------------------------------
 # framing + dispatch
 # ---------------------------------------------------------------------------
 
@@ -445,11 +613,13 @@ def decode_frame(data: bytes):
     return kind, msg_id, op, r
 
 
-def handle_frame(daemon, data: bytes, notification_sink=None, listener_ref=None, stop=None) -> bytes:
+def handle_frame(daemon, data: bytes, notification_sink=None, subscriber_ref=None, stop=None) -> bytes:
     """Dispatch one Borsh wRPC request frame; returns the response frame.
 
     The server side of the reference's Borsh-encoding wRPC endpoint
     (rpc/wrpc/server/src/server.rs) over this module's documented frame.
+    ``subscriber_ref`` is the connection's one-slot serving Subscriber cell
+    (created lazily on first subscribe, torn down by the transport).
     """
     msg_id = 0
     try:
@@ -479,32 +649,59 @@ def handle_frame(daemon, data: bytes, notification_sink=None, listener_ref=None,
             # internal failures propagate to the KIND_ERROR frame below —
             # a miner must not read a node bug as "your block was invalid"
             return encode_frame(KIND_RESPONSE, op, w.getvalue(), msg_id)
+        if op == OP_GET_UTXOS_BY_ADDRESSES:
+            from kaspa_tpu.crypto.addresses import Address, pay_to_address_script
+
+            addresses = decode_get_utxos_by_addresses_request(r)
+            entries = []
+            with daemon._dispatch_lock:
+                index = daemon.rpc._require_index()
+                for a in addresses:
+                    spk = pay_to_address_script(Address.from_string(a))
+                    utxos = index.get_utxos_by_script(spk.script)
+                    for outpoint in sorted(utxos, key=lambda o: (o.transaction_id, o.index)):
+                        entries.append((a, outpoint, utxos[outpoint]))
+            w = io.BytesIO()
+            encode_get_utxos_by_addresses_response(w, entries)
+            return encode_frame(KIND_RESPONSE, op, w.getvalue(), msg_id)
+        if op == OP_GET_BALANCE_BY_ADDRESS:
+            address = decode_get_balance_by_address_request(r)
+            with daemon._dispatch_lock:
+                balance = daemon.rpc.get_balance_by_address(address)
+            w = io.BytesIO()
+            encode_get_balance_by_address_response(w, balance)
+            return encode_frame(KIND_RESPONSE, op, w.getvalue(), msg_id)
+        if op == OP_GET_COIN_SUPPLY:
+            decode_get_coin_supply_request(r)
+            with daemon._dispatch_lock:
+                supply = daemon.rpc.get_coin_supply()["circulating_sompi"]
+            w = io.BytesIO()
+            encode_get_coin_supply_response(w, supply)
+            return encode_frame(KIND_RESPONSE, op, w.getvalue(), msg_id)
         if op == OP_SUBSCRIBE:
             event_op = r_u32(r)
-            if event_op != OP_BLOCK_ADDED_NOTIFICATION:
+            scripts = None
+            if event_op == OP_BLOCK_ADDED_NOTIFICATION:
+                event = "block-added"
+            elif event_op == OP_UTXOS_CHANGED_NOTIFICATION:
+                event = "utxos-changed"
+                addrs = [r_string(r) for _ in range(r_u32(r))]
+                if addrs:
+                    from kaspa_tpu.crypto.addresses import Address, pay_to_address_script
+
+                    scripts = {pay_to_address_script(Address.from_string(a)).script for a in addrs}
+            else:
                 raise ValueError(f"unsupported subscription op {event_op}")
-            # register a Borsh listener directly on the notifier: the raw
-            # Notification carries the Block object, which this encoding
-            # needs in full (the JSON path only streams a summary)
+            # route through the serving broadcaster: one lazily-created
+            # Borsh subscriber per connection, bounded queue + dedicated
+            # sender thread so the full-block/diff encode never runs on the
+            # consensus thread publishing the event
             with daemon._dispatch_lock:
-                if listener_ref[0] is None:
-
-                    def on_notification(n, _sink=notification_sink, _stop=stop):
-                        if _stop is not None and _stop.is_set():
-                            return
-                        if n.event_type != "block-added":
-                            return
-                        blk = n.data["block"]
-                        try:
-                            # enqueue a thunk: the full-block encode runs on
-                            # the connection's writer thread, never on the
-                            # consensus thread publishing the event
-                            _sink.put_nowait(lambda _b=blk: make_block_added_frame(_b))
-                        except Exception:  # noqa: BLE001 - slow consumer: drop
-                            pass
-
-                    listener_ref[0] = daemon.rpc.register_listener(on_notification)
-                daemon.rpc.start_notify(listener_ref[0], "block-added")
+                if subscriber_ref[0] is None:
+                    subscriber_ref[0] = daemon.broadcaster.register(
+                        daemon.make_borsh_subscriber(notification_sink, stop)
+                    )
+                daemon.broadcaster.subscribe(subscriber_ref[0], event, scripts)
             return encode_frame(KIND_RESPONSE, op, b"", msg_id)
         raise ValueError(f"unsupported borsh op {op}")
     except Exception as e:  # noqa: BLE001 - wire boundary
@@ -517,3 +714,20 @@ def make_block_added_frame(block, verbose: dict | None = None) -> bytes:
     w = io.BytesIO()
     encode_block_added_notification(w, block, verbose or {})
     return encode_frame(KIND_NOTIFICATION, OP_BLOCK_ADDED_NOTIFICATION, w.getvalue())
+
+
+def make_utxos_changed_frame(n, address_prefix: str | None = None) -> bytes:
+    w = io.BytesIO()
+    encode_utxos_changed_notification(w, n.data.get("added", []), n.data.get("removed", []), address_prefix)
+    return encode_frame(KIND_NOTIFICATION, OP_UTXOS_CHANGED_NOTIFICATION, w.getvalue())
+
+
+def encode_notification(n, address_prefix: str | None = None) -> bytes | None:
+    """Serving-tier encoder: one Notification -> one Borsh frame, or None
+    when this encoding has no codec for the event (the subscriber skips
+    it).  Runs on the subscriber's sender thread."""
+    if n.event_type == "block-added":
+        return make_block_added_frame(n.data["block"])
+    if n.event_type == "utxos-changed":
+        return make_utxos_changed_frame(n, address_prefix)
+    return None

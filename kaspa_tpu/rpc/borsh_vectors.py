@@ -1,0 +1,76 @@
+"""Deterministic sample payloads for the Borsh wRPC golden vectors.
+
+Mirrors kaspa_tpu.p2p.proto.vectors: one builder producing every serving
+frame from fixed inputs, consumed by tools/gen_borsh_fixtures.py (writes
+tests/fixtures/borsh/) and by the pinning test that asserts the on-disk
+bytes never drift without an intentional regeneration.
+"""
+
+from __future__ import annotations
+
+import io
+
+from kaspa_tpu.consensus.model import ScriptPublicKey, TransactionOutpoint, UtxoEntry
+from kaspa_tpu.notify.notifier import Notification
+from kaspa_tpu.rpc import borsh_codec as bc
+
+# a standard p2pk script (so address recovery has an address to recover)
+# and a deliberately nonstandard one (so the Option<address> None arm is
+# exercised) — fixed bytes, never derived from anything nondeterministic
+_P2PK_SCRIPT = b"\x20" + bytes(range(32)) + b"\xac"
+_WEIRD_SCRIPT = b"\x51\x52\x53"
+_ADDRESS_PREFIX = "kaspasim"
+
+_OUTPOINT_A = TransactionOutpoint(bytes(range(32)), 0)
+_OUTPOINT_B = TransactionOutpoint(bytes(reversed(range(32))), 7)
+
+_ENTRY_A = UtxoEntry(50_000_000_000, ScriptPublicKey(0, _P2PK_SCRIPT), 42, True)
+_ENTRY_B = UtxoEntry(123_456_789, ScriptPublicKey(0, _WEIRD_SCRIPT), 1000, False, covenant_id=b"\xee" * 32)
+
+
+def _address_for(script: bytes) -> str | None:
+    from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+
+    try:
+        return extract_script_pub_key_address(ScriptPublicKey(0, script), _ADDRESS_PREFIX).to_string()
+    except Exception:  # noqa: BLE001 - nonstandard script: no address form
+        return None
+
+
+def sample_frames() -> dict[str, tuple[int, bytes]]:
+    """name -> (op, payload bytes) for every serving-tier Borsh message."""
+    addr_a = _address_for(_P2PK_SCRIPT)
+    out: dict[str, tuple[int, bytes]] = {}
+
+    def add(name: str, op: int, encode, *args) -> None:
+        w = io.BytesIO()
+        encode(w, *args)
+        out[name] = (op, w.getvalue())
+
+    add("get_utxos_by_addresses_request", bc.OP_GET_UTXOS_BY_ADDRESSES,
+        bc.encode_get_utxos_by_addresses_request, [addr_a])
+    add("get_utxos_by_addresses_response", bc.OP_GET_UTXOS_BY_ADDRESSES,
+        bc.encode_get_utxos_by_addresses_response,
+        [(addr_a, _OUTPOINT_A, _ENTRY_A), (None, _OUTPOINT_B, _ENTRY_B)])
+    add("get_balance_by_address_request", bc.OP_GET_BALANCE_BY_ADDRESS,
+        bc.encode_get_balance_by_address_request, addr_a)
+    add("get_balance_by_address_response", bc.OP_GET_BALANCE_BY_ADDRESS,
+        bc.encode_get_balance_by_address_response, 50_000_000_000)
+    add("get_coin_supply_request", bc.OP_GET_COIN_SUPPLY, bc.encode_get_coin_supply_request)
+    add("get_coin_supply_response", bc.OP_GET_COIN_SUPPLY,
+        bc.encode_get_coin_supply_response, 21_000_000_000_000)
+    add("utxos_changed_notification", bc.OP_UTXOS_CHANGED_NOTIFICATION,
+        bc.encode_utxos_changed_notification,
+        [(_OUTPOINT_A, _ENTRY_A)], [(_OUTPOINT_B, _ENTRY_B)], _ADDRESS_PREFIX)
+    add("subscribe_block_added_request", bc.OP_SUBSCRIBE,
+        bc.encode_subscribe_request, bc.OP_BLOCK_ADDED_NOTIFICATION)
+    add("subscribe_utxos_changed_request", bc.OP_SUBSCRIBE,
+        bc.encode_subscribe_request, bc.OP_UTXOS_CHANGED_NOTIFICATION, [addr_a])
+
+    # one full wire frame: the notification as the serving encoder emits it
+    n = Notification("utxos-changed", {"added": [(_OUTPOINT_A, _ENTRY_A)], "removed": []})
+    out["utxos_changed_frame"] = (
+        bc.OP_UTXOS_CHANGED_NOTIFICATION,
+        bc.make_utxos_changed_frame(n, _ADDRESS_PREFIX),
+    )
+    return out

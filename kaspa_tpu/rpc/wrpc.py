@@ -148,19 +148,34 @@ class _WrpcHandler(socketserver.StreamRequestHandler):
             if host not in ("localhost", "127.0.0.1", "::1"):
                 self.wfile.write(b"HTTP/1.1 403 Forbidden\r\n\r\n")
                 return
+        # encoding negotiation (wrpc/server serves Borsh and JSON endpoints;
+        # here one port negotiates via the WebSocket subprotocol): the first
+        # recognized token offered wins and is echoed back per RFC 6455 §4.2.2
+        chosen_proto = None
+        encoding = "json"
+        offered = [t.strip() for t in headers.get("sec-websocket-protocol", "").split(",") if t.strip()]
+        for token in offered:
+            if token.lower() in ("kaspa-borsh", "borsh"):
+                chosen_proto, encoding = token, "borsh"
+                break
+            if token.lower() in ("kaspa-json", "json"):
+                chosen_proto, encoding = token, "json"
+                break
+        proto_line = f"Sec-WebSocket-Protocol: {chosen_proto}\r\n" if chosen_proto else ""
         self.wfile.write(
             (
                 "HTTP/1.1 101 Switching Protocols\r\n"
                 "Upgrade: websocket\r\n"
                 "Connection: Upgrade\r\n"
+                f"{proto_line}"
                 f"Sec-WebSocket-Accept: {accept_key(headers['sec-websocket-key'])}\r\n\r\n"
             ).encode()
         )
 
         from kaspa_tpu.node.daemon import ConnectionPump
 
-        pump = ConnectionPump(daemon, self.wfile, "wrpc-writer")
-        borsh_listener_ref = [None]  # Borsh-path notifier registration
+        pump = ConnectionPump(daemon, self.wfile, "wrpc-writer", encoding=encoding)
+        borsh_subscriber_ref = [None]  # Borsh-path serving Subscriber cell
 
         def read_exactly(n):
             buf = b""
@@ -192,13 +207,15 @@ class _WrpcHandler(socketserver.StreamRequestHandler):
                     # Borsh encoding rides binary frames; JSON rides text
                     # (the reference serves the two encodings on separate
                     # ports — one socket, frame-typed, here)
+                    from kaspa_tpu.node.daemon import _RPC_BY_ENCODING
                     from kaspa_tpu.rpc import borsh_codec
 
+                    _RPC_BY_ENCODING.inc("borsh")
                     resp = borsh_codec.handle_frame(
                         daemon,
                         payload,
                         notification_sink=_WsBinaryAdapter(pump.outq),
-                        listener_ref=borsh_listener_ref,
+                        subscriber_ref=borsh_subscriber_ref,
                         stop=pump.stop,
                     )
                     pump.send(encode_frame(OP_BINARY, resp))
@@ -206,36 +223,52 @@ class _WrpcHandler(socketserver.StreamRequestHandler):
                 line = pump.handle_request(payload, notification_sink=_WsQueueAdapter(pump.outq))
                 pump.send(encode_frame(OP_TEXT, line.rstrip(b"\n")))
         finally:
-            if borsh_listener_ref[0] is not None:
+            sub = borsh_subscriber_ref[0]
+            if sub is not None:
+                borsh_subscriber_ref[0] = None
                 with daemon._dispatch_lock:
-                    daemon.rpc.unregister_listener(borsh_listener_ref[0])
+                    daemon.broadcaster.unregister(sub)
+                sub.close()  # join the sender thread outside the lock
             pump.close()
 
 
 class _WsBinaryAdapter:
     """Wraps Borsh notification frames (bytes, or zero-arg thunks evaluated
     lazily on the writer thread) into WebSocket binary frames on the shared
-    outbound queue."""
+    outbound queue.  ``put`` is the serving Subscriber's blocking sink
+    contract (raises queue.Full on timeout so socket backpressure reaches
+    the subscriber queue and its overflow policy)."""
 
     def __init__(self, outq: queue.Queue):
         self._outq = outq
 
-    def put_nowait(self, frame) -> None:
+    @staticmethod
+    def _wrap(frame):
         if callable(frame):
-            self._outq.put_nowait(lambda _f=frame: encode_frame(OP_BINARY, _f()))
-        else:
-            self._outq.put_nowait(encode_frame(OP_BINARY, frame))
+            return lambda _f=frame: encode_frame(OP_BINARY, _f())
+        return encode_frame(OP_BINARY, frame)
+
+    def put_nowait(self, frame) -> None:
+        self._outq.put_nowait(self._wrap(frame))
+
+    def put(self, frame, timeout: float | None = None) -> None:
+        self._outq.put(self._wrap(frame), timeout=timeout)
 
 
 class _WsQueueAdapter:
     """Adapts the daemon's line-oriented notification enqueue (bytes ending
-    in newline) into WebSocket text frames on the shared outbound queue."""
+    in newline) into WebSocket text frames on the shared outbound queue.
+    ``put`` blocks (and raises queue.Full on timeout) — the serving
+    Subscriber's sink contract."""
 
     def __init__(self, outq: queue.Queue):
         self._outq = outq
 
     def put_nowait(self, line: bytes) -> None:
         self._outq.put_nowait(encode_frame(OP_TEXT, line.rstrip(b"\n")))
+
+    def put(self, line: bytes, timeout: float | None = None) -> None:
+        self._outq.put(encode_frame(OP_TEXT, line.rstrip(b"\n")), timeout=timeout)
 
 
 class WrpcServer:
@@ -266,15 +299,18 @@ class WrpcClient:
     """Minimal WebSocket JSON-RPC client (wrpc/client): id-matched calls +
     streamed notifications in a queue."""
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    def __init__(self, addr: str, timeout: float = 30.0, encoding: str | None = None):
         host, port = addr.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=timeout)
         self._timeout = timeout
+        self.encoding = encoding
         key = base64.b64encode(os.urandom(16)).decode()
+        proto_line = f"Sec-WebSocket-Protocol: kaspa-{encoding}\r\n" if encoding else ""
         self._sock.sendall(
             (
                 f"GET / HTTP/1.1\r\nHost: {addr}\r\nUpgrade: websocket\r\n"
                 f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+                f"{proto_line}"
                 "Sec-WebSocket-Version: 13\r\n\r\n"
             ).encode()
         )
@@ -282,14 +318,19 @@ class WrpcClient:
         if b"101" not in status:
             raise ConnectionError(f"websocket upgrade refused: {status!r}")
         accept = None
+        echoed_proto = None
         while True:
             line = self._read_line()
             if line in (b"\r\n", b"\n", b""):
                 break
             if line.lower().startswith(b"sec-websocket-accept:"):
                 accept = line.split(b":", 1)[1].strip().decode()
+            if line.lower().startswith(b"sec-websocket-protocol:"):
+                echoed_proto = line.split(b":", 1)[1].strip().decode()
         if accept != accept_key(key):
             raise ConnectionError("bad Sec-WebSocket-Accept")
+        if encoding and echoed_proto != f"kaspa-{encoding}":
+            raise ConnectionError(f"server did not accept the {encoding!r} encoding (echoed {echoed_proto!r})")
         self._responses: dict = {}  # id -> response (reader fills)
         self._response_cv = threading.Condition()
         self._closed = False
@@ -415,6 +456,17 @@ class WrpcClient:
         if addresses:
             params["addresses"] = addresses
         return self.call("subscribe", params)
+
+    def subscribe_borsh(self, event_op: int, addresses: list[str] | None = None):
+        """Borsh-encoded subscribe; notifications land in
+        ``self.borsh_notifications`` as (op, payload bytes)."""
+        import io as _io
+
+        from kaspa_tpu.rpc import borsh_codec
+
+        w = _io.BytesIO()
+        borsh_codec.encode_subscribe_request(w, event_op, addresses)
+        return self.call_borsh(borsh_codec.OP_SUBSCRIBE, w.getvalue())
 
     def next_notification(self, timeout: float = 30.0):
         return self.notifications.get(timeout=timeout)

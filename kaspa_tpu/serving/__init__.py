@@ -1,0 +1,13 @@
+"""Serving tier: backpressured notification fanout for remote consumers.
+
+Reference: notify/src/broadcaster.rs + rpc/wrpc/server — the async stage
+between the in-process Notifier chain and the RPC wire transports.
+"""
+
+from kaspa_tpu.serving.broadcaster import (  # noqa: F401
+    POLICIES,
+    POLICY_DISCONNECT,
+    POLICY_DROP_OLDEST,
+    Broadcaster,
+    Subscriber,
+)

@@ -1,0 +1,466 @@
+"""Serving tier: backpressured fanout, persistent utxoindex, dual-encoding
+streams (reference: notify/src/broadcaster.rs + indexes/utxoindex +
+rpc/wrpc/server)."""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import queue
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model import ScriptPublicKey, TransactionOutpoint, UtxoEntry
+from kaspa_tpu.index.utxoindex import _META_DIRTY, _META_VERSION, UtxoIndex
+from kaspa_tpu.notify.notifier import Notification, Notifier
+from kaspa_tpu.serving import POLICY_DISCONNECT, POLICY_DROP_OLDEST, Broadcaster, Subscriber
+from kaspa_tpu.sim.simulator import Miner, SimConfig, simulate
+from kaspa_tpu.storage.kv import KvStore
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# broadcaster: backpressure policies + scope pushdown
+# ---------------------------------------------------------------------------
+
+
+class _BlockedSink:
+    """A connection queue that is wedged until released — the slow consumer."""
+
+    def __init__(self):
+        self.released = threading.Event()
+        self.items: queue.Queue = queue.Queue()
+
+    def put(self, item, timeout=None):
+        if not self.released.is_set():
+            if timeout:
+                time.sleep(min(timeout, 0.02))
+            raise queue.Full
+        self.items.put(item)
+
+
+def test_slow_subscriber_drop_oldest_never_stalls_fast():
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    fast_sink: queue.Queue = queue.Queue()
+    slow_sink = _BlockedSink()
+    enc = lambda n: str(n.data["n"]).encode()  # noqa: E731
+    fast = Subscriber("fast", enc, fast_sink)
+    slow = Subscriber("slow", enc, slow_sink, maxlen=4, policy=POLICY_DROP_OLDEST)
+    try:
+        bc.register(fast)
+        bc.register(slow)
+        bc.subscribe(fast, "block-added")
+        bc.subscribe(slow, "block-added")
+        total = 50
+        for i in range(total):
+            root.notify(Notification("block-added", {"n": i}))
+        # the fast subscriber sees every event, in order, despite the wedge
+        got = [fast_sink.get(timeout=10) for _ in range(total)]
+        assert got == [str(i).encode() for i in range(total)]
+        # the slow one shed load at its bounded queue instead of blocking
+        assert _wait_until(lambda: slow.dropped > 0)
+        assert slow.dropped >= total - slow.maxlen - 2
+        # unwedge: only the retained tail drains, ending at the newest event
+        slow_sink.released.set()
+        assert _wait_until(lambda: slow_sink.items.qsize() > 0 and slow.queue_depth() == 0)
+        time.sleep(0.1)
+        drained = []
+        while not slow_sink.items.empty():
+            drained.append(slow_sink.items.get_nowait())
+        assert len(drained) <= slow.maxlen + 2  # queue + at most the in-flight event
+        assert drained[-1] == str(total - 1).encode()
+    finally:
+        bc.close()
+
+
+def test_slow_subscriber_disconnect_policy_tears_down():
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    fast_sink: queue.Queue = queue.Queue()
+    disconnected = threading.Event()
+    enc = lambda n: str(n.data["n"]).encode()  # noqa: E731
+    fast = Subscriber("fast", enc, fast_sink)
+    slow = Subscriber(
+        "slow", enc, _BlockedSink(), maxlen=2, policy=POLICY_DISCONNECT, on_disconnect=disconnected.set
+    )
+    try:
+        bc.register(fast)
+        bc.register(slow)
+        bc.subscribe(fast, "block-added")
+        bc.subscribe(slow, "block-added")
+        for i in range(10):
+            root.notify(Notification("block-added", {"n": i}))
+        # overflow fires the disconnect callback; the fast stream is untouched
+        assert disconnected.wait(timeout=10)
+        got = [fast_sink.get(timeout=10) for _ in range(10)]
+        assert got == [str(i).encode() for i in range(10)]
+        assert slow._stopped
+    finally:
+        bc.close()
+
+
+class _Spk:
+    def __init__(self, script):
+        self.version = 0
+        self.script = script
+
+
+class _Entry:
+    def __init__(self, script, amount=1):
+        self.script_public_key = _Spk(script)
+        self.amount = amount
+
+
+def _diff_notification(pairs_by_script):
+    added = [(f"op-{s.hex()}-{i}", _Entry(s)) for s, n in pairs_by_script.items() for i in range(n)]
+    return Notification(
+        "utxos-changed",
+        {"added": added, "removed": [], "spk_set": set(pairs_by_script)},
+    )
+
+
+def test_scope_filter_pushdown_and_determinism():
+    sa, sb, sc = b"\x01" * 4, b"\x02" * 4, b"\x03" * 4
+    n = _diff_notification({sc: 1, sa: 2, sb: 1})
+    by_script = Broadcaster._index_diff(n)
+    assert {s: (len(a), len(r)) for s, (a, r) in by_script.items()} == {sa: (2, 0), sb: (1, 0), sc: (1, 0)}
+
+    # scoped filter keeps only matching scripts, in sorted-script order
+    f = Broadcaster._filter_utxos_changed(n, frozenset({sb, sa}), by_script)
+    assert [e.script_public_key.script for _, e in f.data["added"]] == [sa, sa, sb]
+    assert f.data["spk_set"] == {sa, sb}
+    # no overlap -> the event is suppressed before it ever reaches the queue
+    assert Broadcaster._filter_utxos_changed(n, frozenset({b"\x09"}), by_script) is None
+
+    # end to end: same scope -> identical payloads on both subscribers;
+    # a wildcard subscriber sees the whole diff
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    sinks = [queue.Queue() for _ in range(3)]
+    subs = [Subscriber(f"s{i}", lambda x: x, sinks[i]) for i in range(3)]
+    try:
+        for s in subs:
+            bc.register(s)
+        bc.subscribe(subs[0], "utxos-changed", {sa, sb})
+        bc.subscribe(subs[1], "utxos-changed", {sa, sb})
+        bc.subscribe(subs[2], "utxos-changed")  # wildcard
+        root.notify(n)
+        got0 = sinks[0].get(timeout=10)
+        got1 = sinks[1].get(timeout=10)
+        wild = sinks[2].get(timeout=10)
+        assert got0.data["added"] == got1.data["added"]
+        assert [e.script_public_key.script for _, e in got0.data["added"]] == [sa, sa, sb]
+        assert len(wild.data["added"]) == 4
+    finally:
+        bc.close()
+
+
+def test_broadcaster_refcounts_upstream_subscription():
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    s1 = Subscriber("s1", lambda x: x, queue.Queue())
+    s2 = Subscriber("s2", lambda x: x, queue.Queue())
+    try:
+        bc.register(s1)
+        bc.register(s2)
+        bc.subscribe(s1, "block-added")
+        bc.subscribe(s2, "block-added")
+        assert root.has_subscribers("block-added")
+        bc.unsubscribe(s1, "block-added")
+        assert root.has_subscribers("block-added")  # s2 still holds the event
+        bc.unregister(s2)
+        assert not root.has_subscribers("block-added")
+    finally:
+        bc.close()
+        s1.close()
+        s2.close()
+    # close detached the broadcaster's own listener from the notifier
+    assert not root._listeners
+
+
+# ---------------------------------------------------------------------------
+# persistent utxoindex: open modes, journal rewind, resync triggers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chain():
+    cfg = SimConfig(bps=2, delay=0.5, num_miners=2, num_blocks=20, txs_per_block=2, seed=19)
+    return simulate(cfg)
+
+
+def test_persistent_index_lifecycle(tmp_path, chain):
+    import random
+
+    c = Consensus(chain.params)
+    mem = UtxoIndex(c)
+    p1 = str(tmp_path / "idx.db")
+    idx = UtxoIndex(c, db_path=p1)
+    assert idx.open_mode == "fresh"
+    for b in chain.blocks:
+        c.validate_and_insert_block(b)
+
+    # diff-fed persistent state == in-memory state == virtual set
+    assert idx.get_circulating_supply() == sum(e.amount for _, e in c.utxo_set.items())
+    assert idx.entry_count() == mem.entry_count() > 0
+    for script, bucket in mem._by_script.items():
+        assert idx.get_utxos_by_script(script) == dict(bucket)
+    miner0 = Miner(0, random.Random(19))
+    assert idx.get_balance_by_script(miner0.spk.script) == mem.get_balance_by_script(miner0.spk.script) > 0
+
+    # the diff-fed index is byte-identical to a fresh resync
+    fresh = UtxoIndex(c, db_path=str(tmp_path / "fresh.db"))
+    want = fresh.content_snapshot()
+    fresh.close()
+    assert idx.content_snapshot() == want
+
+    # reopen: no resync, no rewinds (position may lag sink by net-empty diffs)
+    idx.close()
+    idx = UtxoIndex(c, db_path=p1)
+    assert idx.open_mode in ("clean", "catchup")
+    assert idx.journal_rewinds == 0
+    assert idx.content_snapshot() == want
+
+    # a journaled diff to a position consensus never heard of (the
+    # notify-before-flush crash window) is rewound on reopen, not resynced
+    ghost = b"\xab" * 32
+    entry = UtxoEntry(777, ScriptPublicKey(0, b"\xaa" * 34), 1, False)
+    idx._apply_diff([(TransactionOutpoint(b"\xcd" * 32, 7), entry)], [], ghost)
+    assert idx.position == ghost
+    idx.close()
+    idx = UtxoIndex(c, db_path=p1)
+    assert idx.open_mode in ("clean", "catchup")
+    assert idx.journal_rewinds >= 1
+    assert idx.content_snapshot() == want
+
+    # version bump -> full resync
+    idx.close()
+    db = KvStore(p1)
+    db.engine.put(_META_VERSION, b"999")
+    db.close()
+    idx = UtxoIndex(c, db_path=p1)
+    assert idx.open_mode == "resync"
+    assert idx.content_snapshot() == want
+
+    # dirty marker (crash mid-resync) -> full resync
+    idx.close()
+    db = KvStore(p1)
+    db.engine.put(_META_DIRTY, b"1")
+    db.close()
+    idx = UtxoIndex(c, db_path=p1)
+    assert idx.open_mode == "resync"
+    assert idx.content_snapshot() == want
+
+    idx.close()
+    mem.close()
+    # closed index no longer receives notifications
+    assert not c.notification_root._listeners
+
+
+_KILL_SCRIPT = textwrap.dedent(
+    """
+    import pickle, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kaspa_tpu.utils import jax_setup; jax_setup.setup()
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.index.utxoindex import UtxoIndex
+    from kaspa_tpu.storage.kv import KvStore
+
+    cons_path, index_path, blocks_pkl = sys.argv[1], sys.argv[2], sys.argv[3]
+    with open(blocks_pkl, "rb") as f:
+        params, blocks = pickle.load(f)
+    db = KvStore(cons_path)
+    c = Consensus(params, db=db)
+    index = UtxoIndex(c, db_path=index_path)
+    for i, b in enumerate(blocks):
+        c.validate_and_insert_block(b)
+        print(f"inserted {i}", flush=True)
+    """
+)
+
+
+def test_kill9_during_diff_burst_rewinds_not_resyncs(tmp_path, chain):
+    """kill -9 the node mid-burst; the reopened index reconciles through the
+    journal + chain-diff walk — byte-identical to a fresh resync, with NO
+    full rebuild triggered."""
+    cons_path = str(tmp_path / "consensus.db")
+    index_path = str(tmp_path / "utxoindex.db")
+    blocks_pkl = str(tmp_path / "blocks.pkl")
+    with open(blocks_pkl, "wb") as f:
+        pickle.dump((chain.params, chain.blocks), f)
+    script = str(tmp_path / "killme.py")
+    with open(script, "w") as f:
+        f.write(_KILL_SCRIPT)
+
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, script, cons_path, index_path, blocks_pkl],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    inserted = 0
+    for line in proc.stdout:
+        if line.startswith("inserted"):
+            inserted += 1
+            if inserted >= 8:
+                os.kill(proc.pid, signal.SIGKILL)
+                break
+    proc.wait()
+    assert inserted >= 8, f"inserter died early: {proc.stderr.read()}"
+
+    db = KvStore(cons_path)
+    c = Consensus(chain.params, db=db)
+    idx = UtxoIndex(c, db_path=index_path)
+    # the whole point: reconciliation, never the full-rebuild fallback
+    assert idx.open_mode in ("clean", "catchup")
+    fresh = UtxoIndex(c, db_path=str(tmp_path / "fresh.db"))
+    assert idx.content_snapshot() == fresh.content_snapshot()
+    assert idx.get_circulating_supply() == sum(e.amount for _, e in c.utxo_set.items())
+    fresh.close()
+    idx.close()
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# dual-encoding daemon streams: one node, JSON + Borsh subscribers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def daemon(tmp_path):
+    from kaspa_tpu.node.daemon import Daemon, parse_args
+
+    args = parse_args(
+        ["--appdir", str(tmp_path), "--rpclisten", "127.0.0.1:0",
+         "--rpclisten-wrpc", "127.0.0.1:0", "--bps", "2"]
+    )
+    d = Daemon(args)
+    d.start()
+    yield d, d.wrpc_server.address
+    d.stop()
+
+
+def _json_stream_key(data):
+    return [
+        (p["outpoint"]["transaction_id"], p["outpoint"]["index"], p["utxo_entry"]["amount"],
+         p["utxo_entry"]["script_public_key"]["script"])
+        for p in data
+    ]
+
+
+def _borsh_stream_key(entries):
+    return [
+        (outpoint.transaction_id.hex(), outpoint.index, entry.amount, entry.script_public_key.script.hex())
+        for _addr, outpoint, entry in entries
+    ]
+
+
+def test_two_clients_identical_filtered_streams(daemon):
+    import random
+
+    from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+    from kaspa_tpu.rpc import borsh_codec as bc
+    from kaspa_tpu.rpc.wrpc import WrpcClient
+
+    d, addr = daemon
+    miner = Miner(0, random.Random(2))
+    pay = extract_script_pub_key_address(miner.spk, "kaspasim").to_string()
+
+    client_json = WrpcClient(addr)
+    client_borsh = WrpcClient(addr, encoding="borsh")
+    try:
+        assert client_borsh.encoding == "borsh"
+        assert client_json.subscribe("utxos-changed", [pay]) == "ok"
+        client_borsh.subscribe_borsh(bc.OP_UTXOS_CHANGED_NOTIFICATION, [pay])
+
+        for _ in range(8):
+            t = client_json.call("getBlockTemplate", {"payAddress": pay})
+            client_json.call("submitBlockByTemplateHash", {"hash": t["block_hash"]})
+            d.mining.template_cache.clear()
+
+        want_events = 2
+        json_events = []
+        deadline = time.monotonic() + 60
+        while len(json_events) < want_events and time.monotonic() < deadline:
+            event, data = client_json.next_notification(timeout=30)
+            if event == "utxos-changed":
+                json_events.append(data)
+        assert len(json_events) >= want_events
+
+        borsh_events = []
+        while len(borsh_events) < len(json_events):
+            op, payload = client_borsh.borsh_notifications.get(timeout=30)
+            if op == bc.OP_UTXOS_CHANGED_NOTIFICATION:
+                borsh_events.append(bc.decode_utxos_changed_notification(io.BytesIO(payload)))
+
+        # both encodings observed the SAME filtered stream, event for event
+        for jd, bd in zip(json_events, borsh_events):
+            assert _json_stream_key(jd["added"]) == _borsh_stream_key(bd["added"])
+            assert _json_stream_key(jd["removed"]) == _borsh_stream_key(bd["removed"])
+            # scope pushdown: only the subscribed script ever appears
+            for p in jd["added"] + jd["removed"]:
+                assert p["utxo_entry"]["script_public_key"]["script"] == miner.spk.script.hex()
+            for _a, _op, entry in bd["added"] + bd["removed"]:
+                assert entry.script_public_key.script == miner.spk.script
+            # Borsh recovers the bech32 address from the script
+            assert all(a == pay for a, _op, _e in bd["added"])
+
+        # the Borsh query surface serves from the same index
+        raw = client_borsh.call_borsh(bc.OP_GET_COIN_SUPPLY, _coin_supply_req())
+        supply = bc.decode_get_coin_supply_response(io.BytesIO(raw))
+        assert supply["circulating_sompi"] == d.utxoindex.get_circulating_supply()
+        assert supply["max_sompi"] == bc.MAX_SOMPI
+
+        w = io.BytesIO()
+        bc.encode_get_balance_by_address_request(w, pay)
+        raw = client_borsh.call_borsh(bc.OP_GET_BALANCE_BY_ADDRESS, w.getvalue())
+        balance = bc.decode_get_balance_by_address_response(io.BytesIO(raw))
+        assert balance == d.utxoindex.get_balance_by_script(miner.spk.script)
+
+        w = io.BytesIO()
+        bc.encode_get_utxos_by_addresses_request(w, [pay])
+        raw = client_borsh.call_borsh(bc.OP_GET_UTXOS_BY_ADDRESSES, w.getvalue())
+        entries = bc.decode_get_utxos_by_addresses_response(io.BytesIO(raw))
+        assert sum(e.amount for _a, _op, e in entries) == balance
+        assert all(a == pay for a, _op, _e in entries)
+        # response ordering is pinned: (txid, index) ascending
+        keys = [(op_.transaction_id, op_.index) for _a, op_, _e in entries]
+        assert keys == sorted(keys)
+    finally:
+        client_json.close()
+        client_borsh.close()
+
+
+def _coin_supply_req() -> bytes:
+    from kaspa_tpu.rpc import borsh_codec as bc
+
+    w = io.BytesIO()
+    bc.encode_get_coin_supply_request(w)
+    return w.getvalue()
+
+
+def test_borsh_encoding_negotiation_rejected_for_unknown_proto(daemon):
+    from kaspa_tpu.rpc.wrpc import WrpcClient
+
+    _d, addr = daemon
+    with pytest.raises(ConnectionError):
+        WrpcClient(addr, encoding="msgpack")

@@ -117,3 +117,47 @@ def test_python_fallback_parity(tmp_path):
     native = open_store(path, native=True)
     assert native.get(b"x") == b"y"
     native.close()
+
+
+def test_engine_parity_prefix_ordering_and_batches(tmp_path):
+    """Both engines must agree on the serving index's access patterns:
+    prefix-stripped suffixes in ascending key order, exact prefix counts,
+    and batch atomicity (buffered until commit, dropped on abandon)."""
+    keys = [
+        b"U\x00\x03abc" + bytes([i]) for i in (9, 1, 5, 3)
+    ] + [b"U\x00\x03abd\x01", b"J\x00\x00\x00\x01", b"Mversion"]
+    engines = []
+    for native in (True, False):
+        eng = open_store(str(tmp_path / f"parity-{int(native)}.log"), native=native)
+        for i, k in enumerate(keys):
+            eng.put(k, f"v{i}".encode())
+        eng.delete(keys[1])
+        engines.append(eng)
+    native_eng, py_eng = engines
+    for prefix in (b"U\x00\x03abc", b"U", b"J", b"M", b"nope"):
+        assert native_eng.items_prefix(prefix) == py_eng.items_prefix(prefix)
+        assert native_eng.keys_prefix(prefix) == py_eng.keys_prefix(prefix)
+        assert native_eng.count_prefix(prefix) == py_eng.count_prefix(prefix)
+    # ordering contract: suffixes come back sorted ascending
+    suffixes = native_eng.keys_prefix(b"U\x00\x03abc")
+    assert suffixes == sorted(suffixes) == [bytes([3]), bytes([5]), bytes([9])]
+
+    # batch semantics: writes invisible pre-commit on BOTH engines is not a
+    # requirement (the python engine buffers KvStore-side), but commit must
+    # apply everything and an abandoned KvStore batch must apply nothing
+    for native in (True, False):
+        kv = KvStore(str(tmp_path / f"batch-{int(native)}.log"), native=native)
+        with kv.batch() as b:
+            b.put(b"k1", b"v1")
+            b.put(b"k2", b"v2")
+            b.delete(b"k1")
+        assert kv.engine.get(b"k1") is None
+        assert kv.engine.get(b"k2") == b"v2"
+        with pytest.raises(RuntimeError):
+            with kv.batch() as b:
+                b.put(b"k3", b"v3")
+                raise RuntimeError("abandon")
+        assert kv.engine.get(b"k3") is None, "abandoned batch must not land"
+        kv.close()
+    for eng in engines:
+        eng.close()

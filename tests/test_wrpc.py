@@ -224,3 +224,47 @@ def test_borsh_over_websocket(daemon):
         assert client.call("getBlockDagInfo")["block_count"] >= 1
     finally:
         client.close()
+
+
+def test_borsh_fixture_goldens():
+    """The committed fixtures in tests/fixtures/borsh pin the serving-tier
+    wire byte-for-byte (regenerate with tools/gen_borsh_fixtures.py after
+    an INTENTIONAL change; anything else here is a wire break)."""
+    import io
+    import json
+    import os
+
+    from kaspa_tpu.rpc import borsh_codec as bc
+    from kaspa_tpu.rpc.borsh_vectors import sample_frames
+
+    fixtures_dir = os.path.join(os.path.dirname(__file__), "fixtures", "borsh")
+    with open(os.path.join(fixtures_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    frames = sample_frames()
+    assert set(manifest) == set(frames)
+    for name, (op, data) in frames.items():
+        with open(os.path.join(fixtures_dir, f"{name}.bin"), "rb") as f:
+            golden = f.read()
+        assert data == golden, f"{name}: borsh wire bytes drifted from the committed fixture"
+        assert manifest[name]["op"] == op
+        assert manifest[name]["bytes"] == len(golden)
+
+    # op numbers are wire ABI: pin them independently of the encoders
+    assert (bc.OP_GET_UTXOS_BY_ADDRESSES, bc.OP_GET_BALANCE_BY_ADDRESS, bc.OP_GET_COIN_SUPPLY) == (145, 146, 147)
+    assert bc.OP_UTXOS_CHANGED_NOTIFICATION == 64
+
+    # the fixtures also decode: spot-check the Option<address> arms and the
+    # versioned entry payload survive a round-trip
+    _op, data = frames["get_utxos_by_addresses_response"]
+    entries = bc.decode_get_utxos_by_addresses_response(io.BytesIO(data))
+    assert len(entries) == 2
+    (addr_a, _out_a, entry_a), (addr_b, _out_b, entry_b) = entries
+    assert addr_a is not None and addr_b is None
+    assert entry_a.is_coinbase is True and entry_a.covenant_id is None
+    assert entry_b.covenant_id == b"\xee" * 32
+
+    _op, frame = frames["utxos_changed_frame"]
+    kind, _msg_id, op, r = bc.decode_frame(frame)
+    assert kind == bc.KIND_NOTIFICATION and op == bc.OP_UTXOS_CHANGED_NOTIFICATION
+    decoded = bc.decode_utxos_changed_notification(r)
+    assert len(decoded["added"]) == 1 and decoded["removed"] == []

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Regenerate the golden-vector Borsh wRPC fixtures.
+
+Encodes every sample payload from kaspa_tpu.rpc.borsh_vectors into
+tests/fixtures/borsh/<name>.bin plus a manifest with the wire op and
+sizes.  Run after an intentional wire change and commit the diff —
+tests/test_wrpc.py pins these bytes (and the op numbers: a renumbered op
+is a wire break for every deployed client).
+
+    python tools/gen_borsh_fixtures.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kaspa_tpu.rpc.borsh_vectors import sample_frames  # noqa: E402
+
+
+def main() -> None:
+    out_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures", "borsh")
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = {}
+    for name, (op, data) in sorted(sample_frames().items()):
+        with open(os.path.join(out_dir, f"{name}.bin"), "wb") as f:
+            f.write(data)
+        manifest[name] = {"op": op, "bytes": len(data)}
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(manifest)} fixtures to {os.path.relpath(out_dir)}")
+
+
+if __name__ == "__main__":
+    main()

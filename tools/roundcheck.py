@@ -83,6 +83,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-mesh", action="store_true", help="skip the multichip dryrun + mesh smoke replay")
     ap.add_argument("--skip-chaos", action="store_true", help="skip the hostile-load chaos sustain run")
     ap.add_argument("--skip-dispatch", action="store_true", help="skip the coalesced-dispatch throughput lane")
+    ap.add_argument("--skip-serving", action="store_true", help="skip the serving-tier dual-encoding + kill -9 lane")
     ap.add_argument("--chaos-blocks", type=int, default=24, help="chaos sustain main-DAG length")
     # long enough that coinbase maturity passes and real signature batches
     # flow through the sharded verify path (a 12-block replay carries 0 txs)
@@ -205,6 +206,23 @@ def main(argv: list[str] | None = None) -> int:
             and bool(result.get("replay_identical"))
         )
         evidence["sections"]["dispatch"] = sect
+        ok &= sect["ok"]
+
+    if not args.skip_serving:
+        # serving tier: one persistent daemon, one JSON + one Borsh client
+        # on the same UtxosChanged scope — the streams must be identical —
+        # then kill -9 and a reopen that reconciles (journal rewind /
+        # chain-diff catch-up), never a full resync.  Subscriber-lag
+        # histograms and per-encoding request counters land in the evidence.
+        sect = _run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "serving_check.py"), "--blocks", "10"],
+            600.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        result = _last_json_line(sect)
+        sect["result"] = result
+        sect["ok"] = sect["rc"] == 0 and bool(result and result.get("serving_ok"))
+        evidence["sections"]["serving"] = sect
         ok &= sect["ok"]
 
     if not args.skip_chaos:

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Serving-tier round evidence: dual-encoding streams + kill -9 reopen.
+
+Boots a persistent daemon child, attaches one JSON and one Borsh wRPC
+client to the same node, subscribes both to UtxosChanged scoped to the
+miner address, and mines a short chain over RPC.  Asserts the two
+encodings observed the IDENTICAL filtered stream, scrapes the serving
+metrics (subscriber-lag histograms, per-encoding request counters, drop
+counters), then kill -9s the daemon and reopens the on-disk utxoindex:
+the acceptance bit is ``open_mode != "resync"`` with content
+byte-identical to a fresh resync.  Prints one JSON line as the last
+stdout line (consumed by tools/roundcheck.py).
+
+    python tools/serving_check.py --blocks 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from kaspa_tpu.utils import jax_setup  # noqa: E402
+
+jax_setup.setup()
+
+_DAEMON_SCRIPT = textwrap.dedent(
+    """
+    import sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kaspa_tpu.utils import jax_setup; jax_setup.setup()
+    from kaspa_tpu.node.daemon import Daemon, parse_args
+
+    args = parse_args(["--appdir", sys.argv[1], "--rpclisten", "127.0.0.1:0",
+                       "--rpclisten-wrpc", "127.0.0.1:0", "--bps", "2", "--persist"])
+    d = Daemon(args)
+    d.start()
+    print("WRPC " + d.wrpc_server.address, flush=True)
+    while True:
+        time.sleep(3600)
+    """
+)
+
+
+def _json_key(pairs) -> list[tuple]:
+    return [
+        (p["outpoint"]["transaction_id"], p["outpoint"]["index"], p["utxo_entry"]["amount"],
+         p["utxo_entry"]["script_public_key"]["script"])
+        for p in pairs
+    ]
+
+
+def _borsh_key(entries) -> list[tuple]:
+    return [
+        (op.transaction_id.hex(), op.index, e.amount, e.script_public_key.script.hex())
+        for _addr, op, e in entries
+    ]
+
+
+def _scrape_serving_metrics(prom_text: str) -> dict:
+    lag: dict = {}
+    for kind, enc, val in re.findall(
+        r'kaspa_serving_subscriber_lag_seconds_(count|sum)\{encoding="([\w-]+)"\} (\S+)', prom_text
+    ):
+        lag.setdefault(enc, {})[kind] = float(val)
+    requests = {
+        enc: int(float(v))
+        for enc, v in re.findall(r'kaspa_rpc_requests_by_encoding_total\{encoding="([\w-]+)"\} (\S+)', prom_text)
+    }
+    m = re.search(r"kaspa_serving_subscriber_dropped_total (\S+)", prom_text)
+    return {
+        "subscriber_lag_seconds": lag,
+        "rpc_requests_by_encoding": requests,
+        "subscriber_dropped": int(float(m.group(1))) if m else 0,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--blocks", type=int, default=10, help="blocks mined over RPC before the kill")
+    ap.add_argument("--events", type=int, default=2, help="UtxosChanged events required on each stream")
+    ap.add_argument("--appdir", default=None, help="daemon appdir (default: a fresh temp dir)")
+    ap.add_argument("--timeout", type=float, default=120.0, help="daemon boot + stream deadline (s)")
+    args = ap.parse_args(argv)
+
+    appdir = args.appdir or tempfile.mkdtemp(prefix="serving-check-")
+    script = os.path.join(appdir, "daemon-child.py")
+    with open(script, "w") as f:
+        f.write(_DAEMON_SCRIPT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, script, appdir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+
+    result: dict = {"appdir": appdir, "blocks": args.blocks}
+    ok = False
+    client_json = client_borsh = None
+    try:
+        addr = None
+        deadline = time.monotonic() + args.timeout
+        for line in proc.stdout:
+            if line.startswith("WRPC "):
+                addr = line.split(" ", 1)[1].strip()
+                break
+            if time.monotonic() > deadline:
+                break
+        if addr is None:
+            result["error"] = "daemon never came up: " + proc.stderr.read()[-400:]
+            raise RuntimeError(result["error"])
+
+        import random
+
+        from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+        from kaspa_tpu.rpc import borsh_codec as bc
+        from kaspa_tpu.rpc.wrpc import WrpcClient
+        from kaspa_tpu.sim.simulator import Miner
+
+        miner = Miner(0, random.Random(2))
+        pay = extract_script_pub_key_address(miner.spk, "kaspasim").to_string()
+
+        client_json = WrpcClient(addr)
+        client_borsh = WrpcClient(addr, encoding="borsh")
+        client_json.subscribe("utxos-changed", [pay])
+        client_borsh.subscribe_borsh(bc.OP_UTXOS_CHANGED_NOTIFICATION, [pay])
+
+        for _ in range(args.blocks):
+            t = client_json.call("getBlockTemplate", {"payAddress": pay})
+            client_json.call("submitBlockByTemplateHash", {"hash": t["block_hash"]})
+
+        json_events = []
+        deadline = time.monotonic() + args.timeout
+        while len(json_events) < args.events and time.monotonic() < deadline:
+            try:
+                event, data = client_json.next_notification(timeout=10)
+            except Exception:  # noqa: BLE001 - keep polling to the deadline
+                continue
+            if event == "utxos-changed":
+                json_events.append(data)
+        borsh_events = []
+        while len(borsh_events) < len(json_events) and time.monotonic() < deadline:
+            try:
+                op, payload = client_borsh.borsh_notifications.get(timeout=10)
+            except Exception:  # noqa: BLE001
+                continue
+            if op == bc.OP_UTXOS_CHANGED_NOTIFICATION:
+                borsh_events.append(bc.decode_utxos_changed_notification(io.BytesIO(payload)))
+
+        result["events_json"] = len(json_events)
+        result["events_borsh"] = len(borsh_events)
+        result["streams_identical"] = (
+            len(json_events) >= args.events
+            and len(json_events) == len(borsh_events)
+            and all(
+                _json_key(j["added"]) == _borsh_key(b["added"])
+                and _json_key(j["removed"]) == _borsh_key(b["removed"])
+                for j, b in zip(json_events, borsh_events)
+            )
+        )
+
+        raw = client_borsh.call_borsh(bc.OP_GET_COIN_SUPPLY, _supply_req(bc))
+        result["circulating_sompi"] = bc.decode_get_coin_supply_response(io.BytesIO(raw))["circulating_sompi"]
+        result["metrics"] = _scrape_serving_metrics(client_json.call("getMetricsPrometheus"))
+
+        # --- kill -9, then the reopened index must reconcile, not rebuild ---
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        for c in (client_json, client_borsh):
+            c.close()
+        client_json = client_borsh = None
+
+        from kaspa_tpu.consensus.consensus import Consensus
+        from kaspa_tpu.consensus.params import simnet_params
+        from kaspa_tpu.index.utxoindex import UtxoIndex
+        from kaspa_tpu.storage.kv import KvStore
+
+        active = "consensus.db"
+        active_path = os.path.join(appdir, "ACTIVE")
+        if os.path.exists(active_path):
+            with open(active_path) as f:
+                name = f.read().strip()
+            if name and os.path.exists(os.path.join(appdir, name)):
+                active = name
+        db = KvStore(os.path.join(appdir, active))
+        c = Consensus(simnet_params(bps=2), db=db)
+        idx = UtxoIndex(c, db_path=os.path.join(appdir, "utxoindex.db"))
+        fresh = UtxoIndex(c, db_path=os.path.join(appdir, "utxoindex-fresh.db"))
+        result["reopen_mode"] = idx.open_mode
+        result["journal_rewinds"] = idx.journal_rewinds
+        result["catchup_blocks"] = idx.catchup_blocks
+        result["reopen_identical"] = idx.content_snapshot() == fresh.content_snapshot()
+        result["reopen_supply"] = idx.get_circulating_supply()
+        idx.close()
+        fresh.close()
+        db.close()
+
+        ok = (
+            result["streams_identical"]
+            and result["reopen_mode"] in ("clean", "catchup")
+            and result["reopen_identical"]
+        )
+    except Exception as e:  # noqa: BLE001 - evidence line carries the failure
+        result.setdefault("error", str(e))
+    finally:
+        for c in (client_json, client_borsh):
+            if c is not None:
+                c.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    result["serving_ok"] = ok
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def _supply_req(bc) -> bytes:
+    w = io.BytesIO()
+    bc.encode_get_coin_supply_request(w)
+    return w.getvalue()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
