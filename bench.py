@@ -51,6 +51,18 @@ RETRY_BACKOFF_S = float(os.environ.get("KASPA_TPU_BENCH_BACKOFF_S", "15"))
 # ==========================================================================
 
 
+def _compile_events(spans: list) -> list:
+    """Filter a drained span list down to jit/compile events (the
+    ``bench.jit_compile`` probe span, secp's per-shape ``secp.jit_compile``,
+    mesh shard_map traces) — the part of a trace a wedge dossier needs."""
+    out = []
+    for s in spans or []:
+        name = str(s.get("path") or s.get("name") or "")
+        if "jit" in name or "compile" in name:
+            out.append(s)
+    return out
+
+
 def _child_probe(timeout_s: float) -> bool:
     """True if the device answers a trivial jit within the timeout.
 
@@ -66,8 +78,14 @@ def _child_probe(timeout_s: float) -> bool:
         import jax
         import jax.numpy as jnp
 
-        y = jax.jit(lambda v: v + 1)(jnp.ones((8,), jnp.int32))
-        y.block_until_ready()
+        from kaspa_tpu.observability import trace
+
+        # span the first-call compile so the wedge dossier can show how far
+        # the backend got (span present+closed = compile finished; capture
+        # empty = it never came back)
+        with trace.span("bench.jit_compile", kernel="probe_add1", batch=8):
+            y = jax.jit(lambda v: v + 1)(jnp.ones((8,), jnp.int32))
+            y.block_until_ready()
         ok.append(True)
 
     t = threading.Thread(target=probe, daemon=True)
@@ -84,6 +102,10 @@ def _child_probe_main() -> None:
     from kaspa_tpu.utils import jax_setup
 
     jax_setup.setup()
+
+    from kaspa_tpu.observability import trace
+
+    trace.set_capture(64)
     t0 = time.perf_counter()
     ok = _child_probe(PROBE_TIMEOUT_S)
     devices = 0
@@ -98,6 +120,8 @@ def _child_probe_main() -> None:
                 "elapsed_s": round(time.perf_counter() - t0, 3),
                 "platform": os.environ.get("JAX_PLATFORMS", ""),
                 "devices": devices,
+                # jit/compile span evidence for the wedge dossier
+                "jit_compile_events": _compile_events(trace.drain()),
             }
         )
     )
@@ -581,7 +605,11 @@ def _cpu_fallback(log: list) -> dict | None:
         ATTEMPT_TIMEOUT_S,
     )
     if obj is not None:
-        obj.pop("observability", None)  # the dossier wants numbers, not span dumps
+        # the dossier wants numbers, not full span dumps — but keep the
+        # jit/compile events: they show whether the CPU backend compiled
+        obs = obj.pop("observability", None)
+        if obs:
+            obj["jit_compile_events"] = _compile_events(obs.get("spans"))
     log.append({"t": _utc_stamp(), "event": "cpu_fallback_result", "note": note, "result": obj})
     return obj
 
@@ -594,6 +622,17 @@ def _write_wedge_dossier(
     """Timestamped evidence file for a wedged device session."""
     out_dir = os.environ.get("KASPA_TPU_BENCH_DOSSIER_DIR", ".")
     path = os.path.join(out_dir, f"bench_wedge_{_utc_stamp()}.json")
+    # hoist every child's jit/compile spans to one top-level list: "how far
+    # did each compile get" is the first question a wedge post-mortem asks
+    compile_events: list = []
+    for entry in probe_log:
+        child = entry.get("child") if isinstance(entry, dict) else None
+        if isinstance(child, dict):
+            compile_events += child.get("jit_compile_events") or []
+            obs = child.get("observability") or {}
+            compile_events += _compile_events(obs.get("spans"))
+    if isinstance(fallback, dict):
+        compile_events += fallback.get("jit_compile_events") or []
     with open(path, "w") as f:
         json.dump(
             {
@@ -601,6 +640,7 @@ def _write_wedge_dossier(
                 "reason": reason,
                 "metric": METRIC,
                 "batch": B,
+                "jit_compile_events": compile_events,
                 "probe_log": probe_log,
                 "cpu_fallback": fallback,
             },

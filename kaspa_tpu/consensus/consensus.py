@@ -54,6 +54,7 @@ from kaspa_tpu.consensus.stores import (
 from kaspa_tpu.consensus.utxo import UtxoDiff, UtxoView, apply_diff, unapply_diff
 from kaspa_tpu.crypto import merkle
 from kaspa_tpu.crypto.muhash import MuHash
+from kaspa_tpu.observability import flight, trace
 
 
 class RuleError(Exception):
@@ -656,21 +657,35 @@ class Consensus:
     # ------------------------------------------------------------------
 
     def validate_and_insert_block(self, block: Block) -> str:
-        """Full pipeline for one block; returns the resulting block status."""
+        """Full pipeline for one block; returns the resulting block status.
+
+        Synchronous path (serial replay, tests, direct callers); the
+        concurrent pipeline inlines these stages in its own workers and
+        never enters here, so both paths can own their block's flight
+        trace without double-recording."""
         existing = self.storage.statuses.get(block.hash)
         if existing is not None and existing != StatusesStore.STATUS_HEADER_ONLY:
             return existing  # duplicate submission: no reprocessing, no events
-        self.counters.inc_blocks_submitted()
-        if self._process_header(block.header):
-            self.counters.inc_headers()
-        self._process_body(block)
-        self.counters.inc_bodies()
-        self.counters.inc_txs(len(block.transactions))
-        self.notification_root.notify_block_added(block)
-        self._update_tips(block.hash)
-        self._resolve_virtual()
-        status = self.storage.statuses.get(block.hash)
-        self.storage.flush()
+        ctx = flight.begin(block.hash) if flight.enabled() else None
+        try:
+            with trace.span("consensus.validate", parent=ctx):
+                self.counters.inc_blocks_submitted()
+                if self._process_header(block.header):
+                    self.counters.inc_headers()
+                self._process_body(block)
+                self.counters.inc_bodies()
+                self.counters.inc_txs(len(block.transactions))
+                self.notification_root.notify_block_added(block)
+                self._update_tips(block.hash)
+                self._resolve_virtual()
+                status = self.storage.statuses.get(block.hash)
+                self.storage.flush()
+        except BaseException:
+            if ctx is not None:
+                flight.end(block.hash, "error")
+            raise
+        if ctx is not None:
+            flight.end(block.hash, "ok")
         return status
 
     def validate_and_insert_header(self, header) -> str:

@@ -24,6 +24,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from dataclasses import dataclass, fields
 from kaspa_tpu.consensus.model import Header, Transaction
+from kaspa_tpu.observability import trace
 from kaspa_tpu.observability.core import REGISTRY
 
 # key prefixes (database/src/registry.rs DatabaseStorePrefixes shape)
@@ -727,15 +728,16 @@ class ConsensusStorage:
             hook()
         if not self.pending:
             return
-        with self.db.batch() as b:
-            for key, value in self.pending:
-                if value is None:
-                    b.delete(key)
-                else:
-                    b.put(key, value)
-        self.pending.clear()
-        for access in self._registered:
-            access.on_flush()
+        with trace.span("store.flush", writes=len(self.pending)):
+            with self.db.batch() as b:
+                for key, value in self.pending:
+                    if value is None:
+                        b.delete(key)
+                    else:
+                        b.put(key, value)
+            self.pending.clear()
+            for access in self._registered:
+                access.on_flush()
 
     def is_initialized(self) -> bool:
         return self.get_meta(b"init") == b"1"

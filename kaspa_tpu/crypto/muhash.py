@@ -24,6 +24,7 @@ import numpy as np
 
 from kaspa_tpu.crypto import chacha
 from kaspa_tpu.crypto import hashing as h
+from kaspa_tpu.observability import trace
 
 ELEMENT_BYTE_SIZE = 384
 PRIME = 2**3072 - 1103717  # u3072.rs:22
@@ -167,16 +168,17 @@ class MuHash:
         multiset hash is commutative (reference rayon map-reduce:
         consensus/src/pipeline/virtual_processor/utxo_validation.rs:334-363).
         """
-        adds: list[bytes] = []
-        removes: list[bytes] = []
-        for tx, entries, daa in items:
-            a, r = _tx_element_preimages(tx, entries, daa)
-            adds += a
-            removes += r
-        if adds:
-            self.numerator = self.numerator * bulk_element_product(adds, use_device) % PRIME
-        if removes:
-            self.denominator = self.denominator * bulk_element_product(removes, use_device) % PRIME
+        with trace.span("muhash.commit", txs=len(items)):
+            adds: list[bytes] = []
+            removes: list[bytes] = []
+            for tx, entries, daa in items:
+                a, r = _tx_element_preimages(tx, entries, daa)
+                adds += a
+                removes += r
+            if adds:
+                self.numerator = self.numerator * bulk_element_product(adds, use_device) % PRIME
+            if removes:
+                self.denominator = self.denominator * bulk_element_product(removes, use_device) % PRIME
 
 
 def _tx_element_preimages(tx, utxo_entries, block_daa_score: int):

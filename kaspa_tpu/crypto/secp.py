@@ -119,13 +119,14 @@ class _Batch:
         _OCCUPANCY.observe(100.0 * n / b)
         _PADDED_LANES.inc(b - n)
         shape_key = (kernel.__name__, b)
-        if shape_key not in _seen_shapes:
+        new_shape = shape_key not in _seen_shapes
+        if new_shape:
             _seen_shapes.add(shape_key)
             _NEW_SHAPES.inc(kernel.__name__)
         ok = np.zeros(b, dtype=bool)
         ok[:n] = self.ok
         pad = [0] * (b - n)
-        mask = kernel(
+        args = (
             _be32_to_limbs(self.px, b),
             _be32_to_limbs(self.py, b),
             _be32_to_limbs(self.rc, b),
@@ -133,6 +134,14 @@ class _Batch:
             self.d2 + pad,
             ok,
         )
+        if new_shape:
+            # first dispatch of a (kernel, bucket) shape pays the XLA
+            # trace+compile; surfacing it as a span is what lets a wedge
+            # dossier / flight trace say *where* a probe stalled
+            with trace.span("secp.jit_compile", kernel=kernel.__name__, bucket=b):
+                mask = kernel(*args)
+        else:
+            mask = kernel(*args)
         return np.asarray(mask)[:n]
 
 

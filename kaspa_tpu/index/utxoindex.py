@@ -37,6 +37,7 @@ from kaspa_tpu.consensus.consensus import Consensus
 from kaspa_tpu.consensus.model import TransactionOutpoint
 from kaspa_tpu.core.log import get_logger
 from kaspa_tpu.notify.notifier import Notification
+from kaspa_tpu.observability import trace
 from kaspa_tpu.observability.core import REGISTRY
 
 log = get_logger("utxoindex")
@@ -153,23 +154,27 @@ class UtxoIndex:
             return
         added = n.data.get("added", [])
         removed = n.data.get("removed", [])
-        if self.db is None:
-            for outpoint, entry in removed:
-                bucket = self._by_script.get(entry.script_public_key.script)
-                if bucket is not None:
-                    bucket.pop(outpoint, None)
-                    if not bucket:
-                        del self._by_script[entry.script_public_key.script]
-            for outpoint, entry in added:
-                self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
-            return
-        sink = n.data.get("sink", self._position)
-        try:
-            self._apply_diff(added, removed, sink)
-            _DIFFS.inc()
-        except Exception:  # noqa: BLE001 - a broken diff must not wedge consensus
-            log.exception("diff application failed at %s; rebuilding index", sink.hex()[:16])
-            self.resync()
+        with trace.span(
+            "utxoindex.apply", parent=getattr(n, "ctx", None),
+            added=len(added), removed=len(removed),
+        ):
+            if self.db is None:
+                for outpoint, entry in removed:
+                    bucket = self._by_script.get(entry.script_public_key.script)
+                    if bucket is not None:
+                        bucket.pop(outpoint, None)
+                        if not bucket:
+                            del self._by_script[entry.script_public_key.script]
+                for outpoint, entry in added:
+                    self._by_script.setdefault(entry.script_public_key.script, {})[outpoint] = entry
+                return
+            sink = n.data.get("sink", self._position)
+            try:
+                self._apply_diff(added, removed, sink)
+                _DIFFS.inc()
+            except Exception:  # noqa: BLE001 - a broken diff must not wedge consensus
+                log.exception("diff application failed at %s; rebuilding index", sink.hex()[:16])
+                self.resync()
 
     def _apply_diff(self, added, removed, new_pos: bytes, journal: bool = True) -> None:
         """ONE atomic batch: entry mutations + supply + position + journal."""

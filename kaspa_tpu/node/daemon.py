@@ -106,6 +106,13 @@ def parse_args(argv=None) -> DaemonArgs:
         "target from BENCH_SWEEP.json; flush age via KASPA_TPU_COALESCE_AGE_MS)",
     )
     p.add_argument(
+        "--flight", action=argparse.BooleanOptionalAction, default=False,
+        help="per-block flight recorder: cross-thread span trees for every "
+        "validated block in a bounded ring, served over getTraces and dumped "
+        "to <appdir>/flight-*.json on demand, crash, or breaker-open "
+        "(tools/trace_report.py --perfetto renders the dump)",
+    )
+    p.add_argument(
         "--bench-capture", action=argparse.BooleanOptionalAction, default=False,
         help="re-probe the device on the periodic tick and capture a fresh "
         "bench.py number the moment a trivial jit answers "
@@ -336,6 +343,12 @@ class Daemon:
         # super-batches once configured (> 0); mesh must resolve first so
         # 'auto' picks the sweep's best batch for the active mesh size
         self.coalesce_target = verify_dispatch.configure(getattr(args, "coalesce", None))
+        if getattr(args, "flight", False):
+            from kaspa_tpu.observability import flight
+
+            # breaker-open and crash paths dump into the appdir unprompted;
+            # getTraces serves the live ring
+            flight.enable(dump_dir=args.appdir)
         self.db = None
         if getattr(args, "persist", False):
             from kaspa_tpu.storage.kv import KvStore
@@ -630,6 +643,9 @@ class Daemon:
         "getCoinSupply": lambda rpc, p: rpc.get_coin_supply(),
         "getMetrics": lambda rpc, p: rpc.get_metrics(),
         "getMetricsPrometheus": lambda rpc, p: rpc.get_metrics_prometheus(),
+        "getTraces": lambda rpc, p: rpc.get_traces(
+            int(p.get("limit", 32)), bool(p.get("verbose", False))
+        ),
         "ping": lambda rpc, p: rpc.ping(),
         "getCurrentNetwork": lambda rpc, p: rpc.get_current_network(),
         "getInfo": lambda rpc, p: rpc.get_info(),
@@ -1047,8 +1063,20 @@ def main(argv=None) -> None:
     daemon.core.install_signal_handlers()  # SIGINT/SIGTERM -> ordered stop
     addr = daemon.start()
     print(f"kaspa-tpu node listening on {addr} (network {daemon.params.name})")
-    daemon.core.wait_for_shutdown()
-    daemon.stop()
+    try:
+        daemon.core.wait_for_shutdown()
+        daemon.stop()
+    except BaseException:
+        # crash path: the flight ring is the black box — flush it beside the
+        # log before the interpreter unwinds (no-op when --flight is off)
+        if getattr(args, "flight", False):
+            from kaspa_tpu.observability import flight
+
+            try:
+                flight.dump(reason="crash")
+            except Exception:  # noqa: BLE001 - never mask the original crash
+                pass
+        raise
 
 
 if __name__ == "__main__":

@@ -16,6 +16,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable
 
+from kaspa_tpu.observability import trace
+
 # notify/src/events.rs:44-55 (9 event types)
 EVENT_TYPES = (
     "block-added",
@@ -34,6 +36,14 @@ EVENT_TYPES = (
 class Notification:
     event_type: str
     data: dict
+    # producer-side TraceContext, captured at construction: the serving
+    # broadcaster/sender threads re-attach fanout + delivery spans to the
+    # block trace that emitted the event
+    ctx: object = None
+
+    def __post_init__(self):
+        if self.ctx is None:
+            self.ctx = trace.context()
 
 
 @dataclass
@@ -62,7 +72,7 @@ class Subscription:
         data = dict(notification.data)
         data["added"] = [u for u in data.get("added", []) if u[1].script_public_key.script in self.addresses]
         data["removed"] = [u for u in data.get("removed", []) if u[1].script_public_key.script in self.addresses]
-        return Notification(notification.event_type, data)
+        return Notification(notification.event_type, data, notification.ctx)
 
 
 class Listener:
@@ -153,8 +163,11 @@ class ConsensusNotificationRoot(Notifier):
     def __init__(self):
         super().__init__("consensus-root")
 
-    def notify_block_added(self, block):
-        self.notify(Notification("block-added", {"block": block}))
+    def notify_block_added(self, block, ctx=None):
+        # ctx: the block's own TraceContext — the pipeline's virtual worker
+        # passes it per task so fanout spans land in the right block trace
+        # even when one virtual cycle absorbs a whole batch
+        self.notify(Notification("block-added", {"block": block}, ctx))
 
     def notify_virtual_change(self, virtual_state, added_utxos, removed_utxos):
         self.notify(

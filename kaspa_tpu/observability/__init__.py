@@ -16,6 +16,7 @@ Prometheus exposition text.
 """
 
 from kaspa_tpu.observability import trace  # noqa: F401
+from kaspa_tpu.observability import flight  # noqa: F401
 from kaspa_tpu.observability.core import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS,
     PERCENT_BUCKETS,

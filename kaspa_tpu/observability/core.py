@@ -114,8 +114,12 @@ class Histogram:
             self.max = v
 
     def quantile(self, q: float) -> float:
-        """Bucket-resolution quantile estimate (upper edge of the bucket
-        holding the q-th observation; +Inf bucket reports observed max)."""
+        """Bucket-resolution quantile estimate: upper edge of the bucket
+        holding the q-th observation.  Edge cases are explicit instead of
+        interpolated: an empty histogram reports 0.0, and a quantile that
+        lands in the +Inf overflow bucket reports inf — the edges carry
+        no upper bound there, so the observed max would understate the
+        tail the caller asked about."""
         if self.count == 0:
             return 0.0
         rank = q * self.count
@@ -123,8 +127,8 @@ class Histogram:
         for i, c in enumerate(self.counts):
             seen += c
             if seen >= rank and c:
-                return self.edges[i] if i < len(self.edges) else self.max
-        return self.max
+                return self.edges[i] if i < len(self.edges) else float("inf")
+        return float("inf") if self.counts[-1] else self.max
 
     def snapshot(self) -> dict:
         out = {

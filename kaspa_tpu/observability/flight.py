@@ -1,0 +1,389 @@
+"""Per-block flight recorder: bounded ring of completed block traces.
+
+Dapper-style assembly point for the cross-thread span ids trace.py now
+stamps: ``begin(block_hash)`` opens a trace (trace_id = block hash hex)
+and returns the root ``TraceContext`` the pipeline hands across every
+queue boundary; every completed span whose ``trace`` matches an open (or
+recently completed — serving fanout lands after virtual resolution)
+trace is collected; ``end(block_hash)`` seals the trace, synthesizes the
+root "block" span over the begin..end interval, runs the critical-path
+analyzer and pushes the result into a bounded ring buffer.
+
+The ring is dumpable on demand (``dump()``), on breaker-open
+(``on_breaker_open`` — auto-dump when a dump dir is configured, wired
+from resilience/breaker.py), or on daemon crash; ``tools/trace_report.py
+--perfetto`` converts a dump into Chrome trace-event JSON loadable in
+ui.perfetto.dev (``chrome_trace`` below is the converter).
+
+Critical path: a backward "last-finisher" walk over each block's span
+DAG — from the root's end, repeatedly step to the child span that
+finished last, attributing the gap between that child's end and the
+cursor to the parent's self-time, then recurse into the child.  Queue
+waits are first-class spans (``wait.*``, recorded retroactively at
+pickup), so handoff latency is attributed by name instead of vanishing
+into parent self-time.  Per-stage critical-path milliseconds feed the
+``block_critical_path_ms{stage=...}`` histogram family.
+
+Cost discipline: when disabled (default) the only overhead is a None
+check in trace._sink — nothing here runs.  When enabled, collection is
+one lock + list append per span.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from time import perf_counter_ns
+
+from kaspa_tpu.observability import trace
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.observability.trace import TraceContext
+
+# critical-path attribution in MILLISECONDS per stage
+MS_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+CRIT_HIST = REGISTRY.histogram_family(
+    "block_critical_path_ms", "stage", MS_BUCKETS,
+    help="per-block critical-path self-time attributed to each stage/queue-wait span",
+)
+TRACES_DONE = REGISTRY.counter(
+    "flight_traces_completed", help="block traces sealed into the flight ring"
+)
+SPANS_DROPPED = REGISTRY.counter(
+    "flight_spans_dropped", help="spans whose trace was not open or already evicted"
+)
+
+# spans kept per trace before we start dropping (runaway guard)
+_MAX_SPANS_PER_TRACE = 4096
+
+
+def _hex(trace_id) -> str:
+    return trace_id.hex() if isinstance(trace_id, (bytes, bytearray)) else str(trace_id)
+
+
+def critical_path(spans: list[dict], root_id: int) -> dict:
+    """Attribute the root span's wall time to per-stage self-time.
+
+    Backward last-finisher walk: starting at the root's end, pick the
+    child whose (clipped) end is latest; the gap between that end and the
+    cursor is the parent's self-time; recurse into the child over its
+    clipped interval; continue left of the child's start.  Concurrent
+    siblings therefore contribute only along the single critical chain.
+
+    Returns {"stages": {name: ns}, "total_ns", "attributed_ns",
+    "fraction"} where fraction counts everything except the root's own
+    self-time (the unexplained remainder).
+    """
+    by_id = {s["span"]: s for s in spans if s.get("span")}
+    root = by_id.get(root_id)
+    if root is None:
+        return {"stages": {}, "total_ns": 0, "attributed_ns": 0, "fraction": 0.0}
+    children: dict[int, list] = {}
+    for s in spans:
+        p = s.get("parent") or 0
+        if p and s.get("span") != root_id and p in by_id:
+            children.setdefault(p, []).append(s)
+    stages: dict[str, int] = {}
+    _walk(root, root["start_ns"], root["end_ns"], children, stages)
+    total = max(root["end_ns"] - root["start_ns"], 0)
+    unattr = stages.get(root["name"], 0)
+    attributed = max(total - unattr, 0)
+    return {
+        "stages": stages,
+        "total_ns": total,
+        "attributed_ns": attributed,
+        "fraction": (attributed / total) if total else 0.0,
+    }
+
+
+def _walk(span: dict, lo: int, hi: int, children: dict, out: dict) -> None:
+    cursor = hi
+    kids = list(children.get(span["span"], ()))
+    name = span["name"]
+    while cursor > lo:
+        best, best_end = None, lo
+        for k in kids:
+            ks = max(k["start_ns"], lo)
+            ke = min(k["end_ns"], cursor)
+            if ks < ke and ke > best_end:
+                best, best_end = k, ke
+        if best is None:
+            out[name] = out.get(name, 0) + (cursor - lo)
+            return
+        if best_end < cursor:
+            out[name] = out.get(name, 0) + (cursor - best_end)
+        ks = max(best["start_ns"], lo)
+        _walk(best, ks, best_end, children, out)
+        cursor = ks
+        kids.remove(best)
+
+
+def chrome_trace(traces: list[dict]) -> dict:
+    """Convert flight entries to Chrome trace-event JSON (Perfetto).
+
+    One trace-event "process" per block (process_name metadata = the
+    block label), one "thread" row per OS thread that touched the block,
+    ph:"X" complete events per span, ph:"s"/"f" flow arrows for every
+    cross-thread parent->child edge.
+    """
+    events: list[dict] = []
+    flow_id = 0
+    for pid, t in enumerate(traces, start=1):
+        spans = t.get("spans", [])
+        label = t.get("label") or t.get("trace", "?")[:8]
+        events.append(
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": f"block {label}"}}
+        )
+        tids: dict[str, int] = {}
+        for s in spans:
+            th = s.get("thread", "?")
+            if th not in tids:
+                tids[th] = len(tids) + 1
+                events.append(
+                    {"ph": "M", "name": "thread_name", "pid": pid, "tid": tids[th],
+                     "args": {"name": th}}
+                )
+        by_id = {s["span"]: s for s in spans if s.get("span")}
+        for s in spans:
+            args = dict(s.get("attrs") or {})
+            args.update({"span": s.get("span"), "parent": s.get("parent"), "path": s.get("path")})
+            events.append(
+                {
+                    "ph": "X",
+                    "name": s["name"],
+                    "cat": "block",
+                    "pid": pid,
+                    "tid": tids.get(s.get("thread", "?"), 0),
+                    "ts": s["start_us"],
+                    "dur": max(s.get("dur_us", 0.0), 0.001),
+                    "args": args,
+                }
+            )
+            parent = by_id.get(s.get("parent") or 0)
+            if parent is not None and parent.get("thread") != s.get("thread"):
+                flow_id += 1
+                events.append(
+                    {"ph": "s", "id": flow_id, "name": "handoff", "cat": "flow",
+                     "pid": pid, "tid": tids.get(parent.get("thread", "?"), 0),
+                     "ts": parent["start_us"]}
+                )
+                events.append(
+                    {"ph": "f", "bp": "e", "id": flow_id, "name": "handoff", "cat": "flow",
+                     "pid": pid, "tid": tids.get(s.get("thread", "?"), 0),
+                     "ts": max(s["start_us"], parent["start_us"])}
+                )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+class FlightRecorder:
+    """Process-global recorder; use via the module-level singleton."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._open: dict[str, dict] = {}
+        self._done: dict[str, dict] = {}  # ring members, addressable for late spans
+        self._ring: deque = deque()
+        self._ring_max = 256
+        self._enabled = False
+        self.dump_dir: str | None = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def enable(self, ring: int = 256, dump_dir: str | None = None) -> None:
+        with self._mu:
+            self._ring_max = max(int(ring), 1)
+            if dump_dir is not None:
+                self.dump_dir = dump_dir
+            self._enabled = True
+        trace._flight_sink = self.record
+
+    def disable(self) -> None:
+        trace._flight_sink = None
+        with self._mu:
+            self._enabled = False
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def reset(self) -> None:
+        with self._mu:
+            self._open.clear()
+            self._done.clear()
+            self._ring.clear()
+
+    # -- trace lifecycle ------------------------------------------------
+
+    def begin(self, trace_id, label: str | None = None) -> TraceContext | None:
+        """Open a block trace; idempotent — a duplicate begin returns the
+        existing root context so unorphan/retry paths don't fork trees."""
+        if not self._enabled:
+            return None
+        tid = _hex(trace_id)
+        with self._mu:
+            t = self._open.get(tid)
+            if t is not None:
+                return TraceContext(tid, t["root"], t["label"])
+            lbl = label or ("block:" + tid[:8])
+            t = {
+                "trace": tid,
+                "label": lbl,
+                "root": trace._next_id(),
+                "t0_ns": perf_counter_ns(),
+                "wall_start": time.time(),
+                "spans": [],
+                "status": "open",
+            }
+            self._open[tid] = t
+            return TraceContext(tid, t["root"], lbl)
+
+    def record(self, rec: dict) -> None:
+        """trace._flight_sink: collect a completed span into its trace."""
+        tid = rec.get("trace")
+        if tid is None:
+            return
+        with self._mu:
+            t = self._open.get(tid) or self._done.get(tid)
+            if t is None:
+                SPANS_DROPPED.inc()
+                return
+            if len(t["spans"]) >= _MAX_SPANS_PER_TRACE:
+                SPANS_DROPPED.inc()
+                return
+            t["spans"].append(rec)
+
+    def end(self, trace_id, status: str = "ok") -> dict | None:
+        """Seal a trace: synthesize the root span, attribute the critical
+        path, and push to the ring.  Late spans (serving fanout) may keep
+        arriving until ring eviction; they join the tree but not the
+        already-computed attribution (they fall outside the root
+        interval by construction)."""
+        t1 = perf_counter_ns()
+        tid = _hex(trace_id)
+        with self._mu:
+            t = self._open.pop(tid, None)
+        if t is None:
+            return None
+        t["status"] = status
+        t["end_ns"] = t1
+        t["duration_ms"] = (t1 - t["t0_ns"]) / 1e6
+        t["spans"].append(
+            {
+                "name": "block",
+                "path": t["label"],
+                "trace": tid,
+                "span": t["root"],
+                "parent": 0,
+                "start_us": t["t0_ns"] // 1000,
+                "dur_us": (t1 - t["t0_ns"]) / 1000.0,
+                "start_ns": t["t0_ns"],
+                "end_ns": t1,
+                "thread": "block",
+                "depth": 0,
+                "attrs": {"status": status},
+            }
+        )
+        cp = critical_path(t["spans"], t["root"])
+        t["critical_path"] = {
+            "fraction": round(cp["fraction"], 4),
+            "total_ms": cp["total_ns"] / 1e6,
+            "stages_ms": {
+                k: v / 1e6 for k, v in sorted(cp["stages"].items(), key=lambda kv: -kv[1])
+            },
+        }
+        for stage, ns in cp["stages"].items():
+            if stage != "block":
+                CRIT_HIST.observe(stage, ns / 1e6)
+        TRACES_DONE.inc()
+        with self._mu:
+            self._ring.append(t)
+            self._done[tid] = t
+            while len(self._ring) > self._ring_max:
+                old = self._ring.popleft()
+                self._done.pop(old["trace"], None)
+        return t
+
+    # -- export ---------------------------------------------------------
+
+    def traces(self, limit: int = 0) -> list[dict]:
+        """Completed traces, oldest first (copies of the entry dicts)."""
+        with self._mu:
+            out = list(self._ring)
+        if limit and len(out) > limit:
+            out = out[-limit:]
+        return [dict(t) for t in out]
+
+    def summaries(self, limit: int = 32) -> list[dict]:
+        """Small JSON-safe summaries for the getTraces RPC surface."""
+        out = []
+        for t in self.traces(limit):
+            out.append(
+                {
+                    "trace": t["trace"],
+                    "label": t["label"],
+                    "status": t["status"],
+                    "duration_ms": round(t.get("duration_ms", 0.0), 3),
+                    "spans": len(t["spans"]),
+                    "threads": len({s.get("thread") for s in t["spans"]}),
+                    "critical_path": t.get("critical_path"),
+                }
+            )
+        return out
+
+    def dump(self, path: str | None = None, reason: str = "on-demand") -> str:
+        """Write the ring as a flight dump (trace_report.py input)."""
+        if path is None:
+            base = self.dump_dir or "."
+            path = os.path.join(base, f"flight_{os.getpid()}_{int(time.time())}.json")
+        doc = {
+            "format": "kaspa-flight",
+            "version": 1,
+            "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "reason": reason,
+            "traces": self.traces(),
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        return path
+
+    def on_breaker_open(self, breaker_name: str) -> str | None:
+        """Crash-style dump hook (resilience/breaker.py calls on the
+        CLOSED/HALF_OPEN -> OPEN transition).  Only writes when a dump
+        dir was configured — tests trip breakers constantly."""
+        if not self._enabled or self.dump_dir is None:
+            return None
+        try:
+            return self.dump(reason=f"breaker-open:{breaker_name}")
+        except OSError:
+            return None
+
+    def _state(self) -> dict:
+        with self._mu:
+            return {
+                "enabled": int(self._enabled),
+                "open_traces": len(self._open),
+                "completed_ring": len(self._ring),
+            }
+
+
+RECORDER = FlightRecorder()
+REGISTRY.register_collector("flight", RECORDER._state)
+
+# module-level convenience (what instrumentation call sites import)
+begin = RECORDER.begin
+end = RECORDER.end
+enable = RECORDER.enable
+disable = RECORDER.disable
+enabled = RECORDER.enabled
+dump = RECORDER.dump
+traces = RECORDER.traces
+summaries = RECORDER.summaries
+reset = RECORDER.reset
+on_breaker_open = RECORDER.on_breaker_open

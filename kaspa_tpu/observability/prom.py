@@ -35,6 +35,26 @@ def _esc(labelval: str) -> str:
     return str(labelval).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _esc_help(text: str) -> str:
+    # exposition format 0.0.4: HELP text escapes backslash and newline
+    # (quotes are NOT escaped in help text, unlike label values)
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _family_header(lines: list[str], seen: set, name: str, help_text: str, mtype: str) -> None:
+    """Emit # HELP / # TYPE exactly once per family.  Distinct raw names
+    can sanitize to the same exposition name (``_name`` folds illegal
+    chars to ``_``); the first registrant wins the header and later ones
+    only contribute samples — duplicate HELP/TYPE lines are a parse
+    error for real Prometheus servers."""
+    if name in seen:
+        return
+    seen.add(name)
+    if help_text:
+        lines.append(f"# HELP {name} {_esc_help(help_text)}")
+    lines.append(f"# TYPE {name} {mtype}")
+
+
 def _fmt(v) -> str:
     if isinstance(v, bool):
         return "1" if v else "0"
@@ -75,11 +95,10 @@ def _flatten_gauges(lines: list[str], name: str, tree: dict) -> None:
 def render(registry=REGISTRY) -> str:
     """The full registry as Prometheus exposition text."""
     lines: list[str] = []
+    seen: set[str] = set()
     for raw, m in sorted(registry._counters.items()):
         name = _name(raw)
-        if m.help:
-            lines.append(f"# HELP {name} {m.help}")
-        lines.append(f"# TYPE {name} counter")
+        _family_header(lines, seen, name, m.help, "counter")
         if isinstance(m, CounterFamily):
             for labelval, cell in sorted(m._cells.items()):
                 lines.append(f'{name}_total{{{m.label}="{_esc(labelval)}"}} {cell.value}')
@@ -87,9 +106,7 @@ def render(registry=REGISTRY) -> str:
             lines.append(f"{name}_total {m.value}")
     for raw, m in sorted(registry._histograms.items()):
         name = _name(raw)
-        if m.help:
-            lines.append(f"# HELP {name} {m.help}")
-        lines.append(f"# TYPE {name} histogram")
+        _family_header(lines, seen, name, m.help, "histogram")
         if isinstance(m, HistogramFamily):
             _render_histogram(lines, name, m.label, sorted(m._cells.items()))
         else:

@@ -6,6 +6,17 @@ latency histogram in the global registry.  Optionally a bounded in-memory
 span log captures every completed span (name, path, start, duration,
 thread, attrs) for offline replay by ``tools/trace_report.py``.
 
+Cross-thread causality (flight recorder substrate): every recorded span
+carries Dapper-style identity — ``trace`` (the block hash that owns it),
+``span`` (a process-unique id), ``parent`` (the enclosing span's id).
+Within a thread the ids flow through the TLS stack as before; across a
+queue boundary the producer captures ``trace.context()`` (a small
+immutable ``TraceContext``) and the consumer reopens the tree with
+``trace.span("stage", parent=ctx)`` or records an already-elapsed
+interval with ``trace.record_span(...)`` (queue waits, fan-back device
+spans).  ``kaspa_tpu.observability.flight`` installs ``_flight_sink`` to
+collect per-trace span sets into the ring buffer.
+
 Cost model (the contract tests/test_observability.py asserts loosely):
 - tracing disabled: ``span()`` returns a shared no-op object — well under
   a microsecond per use;
@@ -19,6 +30,7 @@ propagates unchanged.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from collections import deque
 from time import perf_counter_ns
@@ -35,6 +47,27 @@ SPAN_HIST = REGISTRY.histogram_family(
 _tls = threading.local()
 _enabled = True
 _capture: deque | None = None  # bounded span log for trace_report replay
+_flight_sink = None  # set by observability.flight when the recorder is on
+_next_id = itertools.count(1).__next__  # process-unique span ids
+
+
+class TraceContext:
+    """Immutable handle passed across thread/queue boundaries.
+
+    ``trace_id`` is the owning block hash (hex), ``span_id`` the producer
+    span to parent on, ``path`` the slash-joined ancestry so flame paths
+    stay connected in trace_report across threads.
+    """
+
+    __slots__ = ("trace_id", "span_id", "path")
+
+    def __init__(self, trace_id: str | None, span_id: int, path: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.path = path
+
+    def __repr__(self):  # debugging aid only
+        return f"TraceContext({self.trace_id!r}, {self.span_id}, {self.path!r})"
 
 
 def _stack() -> list:
@@ -58,38 +91,55 @@ _NOOP = _NoopSpan()
 
 
 class Span:
-    __slots__ = ("name", "attrs", "path", "_t0")
+    __slots__ = ("name", "attrs", "path", "_t0", "trace_id", "span_id", "parent_id", "_parent")
 
-    def __init__(self, name: str, attrs: dict):
+    def __init__(self, name: str, attrs: dict, parent: TraceContext | None = None):
         self.name = name
         self.attrs = attrs
         self.path = name
         self._t0 = 0
+        self.trace_id = None
+        self.span_id = 0
+        self.parent_id = 0
+        self._parent = parent
 
     def __enter__(self):
         st = _stack()
         if st:
-            self.path = st[-1].path + "/" + self.name
+            top = st[-1]
+            self.path = top.path + "/" + self.name
+            self.trace_id = top.trace_id
+            self.parent_id = top.span_id
+        elif self._parent is not None:
+            p = self._parent
+            self.path = p.path + "/" + self.name
+            self.trace_id = p.trace_id
+            self.parent_id = p.span_id
+        self.span_id = _next_id()
         st.append(self)
         self._t0 = perf_counter_ns()
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        dur_ns = perf_counter_ns() - self._t0
+        t1 = perf_counter_ns()
         st = _stack()
         if st and st[-1] is self:
             st.pop()
-        SPAN_HIST.observe(self.name, dur_ns * 1e-9)
-        cap = _capture
-        if cap is not None:
+        SPAN_HIST.observe(self.name, (t1 - self._t0) * 1e-9)
+        if _capture is not None or _flight_sink is not None:
             if exc_type is not None:
                 self.attrs["error"] = exc_type.__name__
-            cap.append(
+            _sink(
                 {
                     "name": self.name,
                     "path": self.path,
+                    "trace": self.trace_id,
+                    "span": self.span_id,
+                    "parent": self.parent_id,
                     "start_us": self._t0 // 1000,
-                    "dur_us": dur_ns / 1000.0,
+                    "dur_us": (t1 - self._t0) / 1000.0,
+                    "start_ns": self._t0,
+                    "end_ns": t1,
                     "thread": threading.current_thread().name,
                     "depth": len(st),
                     "attrs": self.attrs,
@@ -97,12 +147,77 @@ class Span:
             )
         return False  # never swallow the exception
 
+    def context(self) -> TraceContext:
+        """Handle for parenting work handed to another thread/queue."""
+        return TraceContext(self.trace_id, self.span_id, self.path)
 
-def span(name: str, **attrs) -> Span | _NoopSpan:
-    """Open a timed span; use as ``with trace.span("stage", key=val):``."""
+
+def _sink(rec: dict) -> None:
+    cap = _capture
+    if cap is not None:
+        cap.append(rec)
+    fs = _flight_sink
+    if fs is not None:
+        fs(rec)
+
+
+def span(name: str, parent: TraceContext | None = None, **attrs) -> Span | _NoopSpan:
+    """Open a timed span; use as ``with trace.span("stage", key=val):``.
+
+    ``parent`` (a TraceContext) grafts this span onto a tree started on
+    another thread; it only applies when this thread's span stack is
+    empty — an enclosing local span always wins.
+    """
     if not _enabled:
         return _NOOP
-    return Span(name, attrs)
+    return Span(name, attrs, parent)
+
+
+def record_span(
+    name: str,
+    parent: TraceContext | None,
+    t0_ns: int,
+    t1_ns: int,
+    **attrs,
+) -> TraceContext | None:
+    """Record an already-elapsed interval (queue wait, fan-back device
+    span) retroactively: the producer stamped ``t0_ns`` (perf_counter_ns)
+    when it enqueued, the consumer calls this at pickup.  Returns the new
+    span's context so callers can parent further children on it."""
+    if not _enabled:
+        return None
+    if t1_ns < t0_ns:
+        t1_ns = t0_ns
+    SPAN_HIST.observe(name, (t1_ns - t0_ns) * 1e-9)
+    if _capture is None and _flight_sink is None:
+        return None
+    sid = _next_id()
+    trace_id = parent.trace_id if parent is not None else None
+    parent_id = parent.span_id if parent is not None else 0
+    path = (parent.path + "/" + name) if parent is not None else name
+    _sink(
+        {
+            "name": name,
+            "path": path,
+            "trace": trace_id,
+            "span": sid,
+            "parent": parent_id,
+            "start_us": t0_ns // 1000,
+            "dur_us": (t1_ns - t0_ns) / 1000.0,
+            "start_ns": t0_ns,
+            "end_ns": t1_ns,
+            "thread": threading.current_thread().name,
+            "depth": 0,
+            "attrs": attrs,
+        }
+    )
+    return TraceContext(trace_id, sid, path)
+
+
+def context() -> TraceContext | None:
+    """TraceContext of this thread's innermost open span (None outside)."""
+    st = getattr(_tls, "stack", None)
+    return st[-1].context() if st else None
 
 
 def enabled() -> bool:

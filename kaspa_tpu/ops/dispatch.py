@@ -37,16 +37,23 @@ callers that use `dispatch_async()` to overlap their own host work.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
 from dataclasses import dataclass, field
+from time import perf_counter_ns
 
 import numpy as np
 
 from kaspa_tpu.observability import trace
 from kaspa_tpu.observability.core import REGISTRY, SIZE_BUCKETS
+
+# shared id stamped on every fan-back span of one device dispatch, so a
+# trace viewer can correlate the N per-ticket child spans that rode the
+# same super-batch
+_super_ids = itertools.count(1)
 
 DEFAULT_TARGET = 1024
 _TARGET_MIN, _TARGET_MAX = 8, 16384
@@ -112,6 +119,10 @@ class _Chunk:
     items: list  # [(pubkey, msg, sig), ...] — ownership donated on submit
     ticket: Ticket
     enqueued_at: float = field(default_factory=time.monotonic)
+    # producer's TraceContext + enqueue stamp: the dispatcher thread fans
+    # the one device span back into each submitting block's trace
+    ctx: object = None
+    enqueued_ns: int = 0
 
 
 class CoalescingDispatcher:
@@ -142,7 +153,9 @@ class CoalescingDispatcher:
         with self._lock:
             if self._closed:
                 raise RuntimeError("verify dispatcher is shut down")
-            self._pending.append(_Chunk(kind, items, ticket))
+            self._pending.append(
+                _Chunk(kind, items, ticket, ctx=trace.context(), enqueued_ns=perf_counter_ns())
+            )
             self._unresolved += 1
             if self._thread is None:
                 self._thread = threading.Thread(
@@ -261,16 +274,37 @@ class CoalescingDispatcher:
         items = [it for c in batch for it in c.items]
         try:
             fn = secp.schnorr_verify_batch if kind == "schnorr" else secp.ecdsa_verify_batch
+            t0 = perf_counter_ns()
             with trace.span("dispatch.super_batch", kind=kind, jobs=jobs, chunks=len(batch)):
                 mask = np.asarray(fn(items))
+            t1 = perf_counter_ns()
         except Exception as e:  # noqa: BLE001 - surfaced on every waiting ticket
+            t1 = perf_counter_ns()
+            self._fan_back(kind, batch, jobs, t1, t1, error=type(e).__name__)
             for c in batch:
                 self._finish(c, None, e)
             return
+        self._fan_back(kind, batch, jobs, t0, t1)
         pos = 0
         for c in batch:
             self._finish(c, mask[pos : pos + len(c.items)], None)
             pos += len(c.items)
+
+    def _fan_back(self, kind: str, batch: list[_Chunk], jobs: int, t0: int, t1: int, **extra) -> None:
+        """Fan the single device dispatch back into each submitting block's
+        trace: a retroactive ``wait.dispatch`` (enqueue -> kernel start)
+        plus a ``dispatch.device`` child covering the device interval,
+        stamped with a shared super_id so Perfetto can correlate them."""
+        sid = next(_super_ids)
+        for c in batch:
+            if c.ctx is None:
+                continue
+            trace.record_span("wait.dispatch", c.ctx, c.enqueued_ns, t0)
+            trace.record_span(
+                "dispatch.device", c.ctx, t0, t1,
+                kind=kind, jobs=len(c.items), super_jobs=jobs,
+                chunks=len(batch), super_id=sid, **extra,
+            )
 
     def _finish(self, chunk: _Chunk, mask, error) -> None:
         chunk.ticket._resolve(mask, error)

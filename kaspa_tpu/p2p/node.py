@@ -24,6 +24,7 @@ from kaspa_tpu.consensus.stores import StatusesStore
 from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.observability import flight, trace
 from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.utils.sync import LockCtx
 
@@ -697,21 +698,27 @@ class Node:
                 pass  # invalid blocks within an IBD batch are skipped
 
     def _on_relay_block(self, peer: Peer, block: Block) -> None:
-        peer.known_blocks.add(block.hash)  # sender has it: don't echo the inv back
-        parents = block.header.direct_parents()
-        # a parent already in flight inside the pipeline counts as present:
-        # the deps manager parks the child until the parent commits (the
-        # reference's out-of-order intake, deps_manager.rs) — only parents
-        # neither stored nor in flight make this an orphan
-        missing = [
-            p
-            for p in parents
-            if not self.consensus.storage.headers.has(p) and not self.pipeline.deps.is_pending(p)
-        ]
+        # flight trace starts at the wire: the pipeline's own begin() on
+        # submit is idempotent and re-joins this root, so the recorded
+        # block time includes the p2p intake hop
+        ctx = flight.begin(block.hash) if flight.enabled() else None
+        with trace.span("p2p.block_receive", parent=ctx):
+            peer.known_blocks.add(block.hash)  # sender has it: don't echo the inv back
+            parents = block.header.direct_parents()
+            # a parent already in flight inside the pipeline counts as present:
+            # the deps manager parks the child until the parent commits (the
+            # reference's out-of-order intake, deps_manager.rs) — only parents
+            # neither stored nor in flight make this an orphan
+            missing = [
+                p
+                for p in parents
+                if not self.consensus.storage.headers.has(p) and not self.pipeline.deps.is_pending(p)
+            ]
+            if missing:
+                # orphan: request missing ancestors (orphan resolution, flow.rs)
+                self.orphan_blocks[block.hash] = block
+                peer.send(MSG_REQUEST_BLOCK, missing)
         if missing:
-            # orphan: request missing ancestors (orphan resolution, flow.rs)
-            self.orphan_blocks[block.hash] = block
-            peer.send(MSG_REQUEST_BLOCK, missing)
             return
         try:
             self.pipeline.validate_and_insert_block(block)

@@ -32,7 +32,7 @@ from dataclasses import dataclass
 from time import perf_counter_ns
 
 from kaspa_tpu.consensus.stores import StatusesStore
-from kaspa_tpu.observability import trace
+from kaspa_tpu.observability import flight, trace
 from kaspa_tpu.observability.core import DEFAULT_LATENCY_BUCKETS, REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.pipeline.deps_manager import BlockTaskDependencyManager
 from kaspa_tpu.utils.sync import Channel, Closed, LockCtx
@@ -60,6 +60,7 @@ class _Task:
     header_only: bool
     future: Future
     enqueue_ns: int = 0  # set at submit / virtual hand-off for queue-wait spans
+    ctx: object = None  # flight-recorder root TraceContext (None when off)
 
 
 class ConsensusPipeline:
@@ -97,10 +98,18 @@ class ConsensusPipeline:
         """
         fut: Future = Future()
         task = _Task(block, header_only, fut, enqueue_ns=perf_counter_ns())
+        # flight recorder: the block's trace starts at intake and is sealed
+        # when the future resolves (after virtual absorption); a duplicate
+        # submission re-joins the existing open trace
+        task.ctx = flight.begin(block.hash) if flight.enabled() else None
         _SUBMITTED.inc()
         with self._idle_mu:
             self._inflight += 1
         fut.add_done_callback(self._on_done)
+        if task.ctx is not None:
+            fut.add_done_callback(
+                lambda f, h=block.hash: flight.end(h, "error" if f.exception() else "ok")
+            )
         if self.deps.register(block.hash, task):
             try:
                 self._ready.send(block.hash)
@@ -162,11 +171,15 @@ class ConsensusPipeline:
             task = self.deps.try_begin(task_id, lambda t: t.block.header.direct_parents())
             if task is None:
                 continue  # parked under a pending parent
-            _Q_WAIT.observe("stage", (perf_counter_ns() - task.enqueue_ns) * 1e-9)
+            now = perf_counter_ns()
+            _Q_WAIT.observe("stage", (now - task.enqueue_ns) * 1e-9)
+            # queue wait as a first-class span so critical-path attribution
+            # names the handoff latency instead of losing it to root self-time
+            trace.record_span("wait.stage", task.ctx, task.enqueue_ns, now)
             duplicate_status = None
             err = None
             try:
-                with trace.span("pipeline.stage"):
+                with trace.span("pipeline.stage", parent=task.ctx):
                     # GIL-releasing precompute outside the commit lock: header
                     # hash + merkle leaves hash concurrently across workers
                     blk = task.block
@@ -231,18 +244,30 @@ class ConsensusPipeline:
             _VIRT_BATCH.observe(len(batch))
             for task in batch:
                 _Q_WAIT.observe("virtual", (now - task.enqueue_ns) * 1e-9)
+                trace.record_span("wait.virtual", task.ctx, task.enqueue_ns, now)
             t_lock = perf_counter_ns()
             with self._lock:
                 _LOCK_WAIT.observe((perf_counter_ns() - t_lock) * 1e-9)
                 try:
-                    with trace.span("pipeline.virtual", batch=len(batch)):
+                    # the TLS span parents on the first task's trace: muhash /
+                    # store.flush / utxoindex children nest there; every other
+                    # task in the batch gets a synthetic same-interval span so
+                    # its trace still owns the shared virtual-cycle time
+                    t_v0 = perf_counter_ns()
+                    with trace.span("pipeline.virtual", parent=batch[0].ctx, batch=len(batch)):
                         for task in batch:
-                            consensus.notification_root.notify_block_added(task.block)
+                            consensus.notification_root.notify_block_added(task.block, task.ctx)
                             consensus._update_tips(task.block.hash)
                         # one virtual resolution absorbs the whole cycle: chain
                         # verification batches signatures across these blocks
                         consensus._resolve_virtual()
                         consensus.storage.flush()
+                    t_v1 = perf_counter_ns()
+                    for task in batch[1:]:
+                        trace.record_span(
+                            "pipeline.virtual", task.ctx, t_v0, t_v1,
+                            batch=len(batch), shared=True,
+                        )
                 except Exception as e:
                     for task in batch:
                         if not task.future.done():

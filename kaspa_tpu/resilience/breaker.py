@@ -127,6 +127,13 @@ class CircuitBreaker:
         self.transitions.append({"attempt": self.attempts, "from": self.state, "to": to})
         del self.transitions[:-_MAX_TRANSITIONS]
         self.state = to
+        if to == OPEN:
+            # crash-style evidence: when the device lane trips, persist the
+            # flight ring so the traces that led up to the trip survive
+            # (no-op unless the recorder is on and a dump dir is set)
+            from kaspa_tpu.observability import flight
+
+            flight.on_breaker_open(self.name)
 
     # --- reporting --------------------------------------------------------
 

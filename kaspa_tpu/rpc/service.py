@@ -299,6 +299,18 @@ class RpcCoreService:
 
         return prom.render()
 
+    def get_traces(self, limit: int = 32, verbose: bool = False) -> dict:
+        """Flight-recorder surface: recent completed block traces with
+        their critical-path attribution.  ``verbose`` returns the full
+        span trees (trace_report.py / Perfetto input); the default is the
+        per-block summary (spans, threads, wall ms, top stages)."""
+        from kaspa_tpu.observability import flight
+
+        out = {"enabled": flight.enabled(), "traces": flight.summaries(limit=limit)}
+        if verbose:
+            out["full"] = flight.traces()[-limit:]
+        return out
+
     # --- node info / misc (rpc.rs ping/get_info/get_current_network/...) ---
 
     def ping(self) -> dict:

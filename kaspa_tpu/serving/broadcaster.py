@@ -43,6 +43,7 @@ from collections import deque
 
 from kaspa_tpu.core.log import get_logger
 from kaspa_tpu.notify.notifier import EVENT_TYPES, Notification
+from kaspa_tpu.observability import trace
 from kaspa_tpu.observability.core import REGISTRY, SIZE_BUCKETS
 
 log = get_logger("serving")
@@ -178,24 +179,30 @@ class Subscriber:
                     return
                 else:
                     continue
-            try:
-                payload = self.encoder(notification)
-            except Exception:  # noqa: BLE001 - one bad encode must not kill the stream
-                log.exception("subscriber %s: encoding %s failed", self.name, notification.event_type)
-                continue
-            if payload is None:
-                continue
-            # blocking put with a stop-aware retry loop: socket backpressure
-            # (a full connection queue) parks THIS thread; the bounded deque
-            # above is where the policy then absorbs the overflow
-            while True:
+            # delivery rides the emitting block's trace (cross-thread via
+            # the Notification's captured context): encode + sink.put
+            with trace.span(
+                "serving.deliver", parent=getattr(notification, "ctx", None),
+                encoding=self.encoding, event=notification.event_type,
+            ):
                 try:
-                    self.sink.put(payload, timeout=0.25)
-                    break
-                except queue.Full:
-                    with self._cv:
-                        if self._stopped:
-                            return
+                    payload = self.encoder(notification)
+                except Exception:  # noqa: BLE001 - one bad encode must not kill the stream
+                    log.exception("subscriber %s: encoding %s failed", self.name, notification.event_type)
+                    continue
+                if payload is None:
+                    continue
+                # blocking put with a stop-aware retry loop: socket backpressure
+                # (a full connection queue) parks THIS thread; the bounded deque
+                # above is where the policy then absorbs the overflow
+                while True:
+                    try:
+                        self.sink.put(payload, timeout=0.25)
+                        break
+                    except queue.Full:
+                        with self._cv:
+                            if self._stopped:
+                                return
             self.delivered += 1
             lag_hist.observe(time.monotonic() - t_received)
 
@@ -353,7 +360,7 @@ class Broadcaster:
         data["added"] = added
         data["removed"] = removed
         data["spk_set"] = set(matched)
-        return Notification(n.event_type, data)
+        return Notification(n.event_type, data, n.ctx)
 
     def _run(self) -> None:
         while True:
@@ -362,21 +369,24 @@ class Broadcaster:
                 return
             t0 = time.monotonic()
             _FANOUT_EVENTS.inc(n.event_type)
-            by_script = self._index_diff(n) if n.event_type == "utxos-changed" else None
-            with self._mu:
-                targets = [
-                    (sub, sub.subscriptions[n.event_type])
-                    for sub in self._subscribers
-                    if n.event_type in sub.subscriptions
-                ]
-            for sub, scope in targets:
-                if by_script is not None and scope is not None:
-                    filtered = self._filter_utxos_changed(n, scope, by_script)
-                    if filtered is None:
-                        continue
-                    sub.offer(filtered, t0)
-                else:
-                    sub.offer(n, t0)
+            with trace.span(
+                "serving.fanout", parent=getattr(n, "ctx", None), event=n.event_type,
+            ):
+                by_script = self._index_diff(n) if n.event_type == "utxos-changed" else None
+                with self._mu:
+                    targets = [
+                        (sub, sub.subscriptions[n.event_type])
+                        for sub in self._subscribers
+                        if n.event_type in sub.subscriptions
+                    ]
+                for sub, scope in targets:
+                    if by_script is not None and scope is not None:
+                        filtered = self._filter_utxos_changed(n, scope, by_script)
+                        if filtered is None:
+                            continue
+                        sub.offer(filtered, t0)
+                    else:
+                        sub.offer(n, t0)
 
     # --- lifecycle ---
 

@@ -18,9 +18,10 @@ from kaspa_tpu.utils import jax_setup
 
 jax_setup.setup()
 
+from kaspa_tpu.observability import flight, trace
 from kaspa_tpu.ops import dispatch as coalesce
 from kaspa_tpu.ops import mesh
-from kaspa_tpu.sim.simulator import SimConfig, replay, simulate
+from kaspa_tpu.sim.simulator import SimConfig, replay, replay_pipelined, simulate
 
 
 def main() -> None:
@@ -42,6 +43,20 @@ def main() -> None:
         "default off — results are bit-identical either way)",
     )
     p.add_argument("--json", action="store_true", help="emit one JSON line")
+    p.add_argument(
+        "--pipeline", action="store_true",
+        help="replay through the concurrent ConsensusPipeline (stage workers + "
+        "virtual worker) instead of the serial loop",
+    )
+    p.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="enable the per-block flight recorder during the replay and dump "
+        "the completed-trace ring to PATH (tools/trace_report.py input)",
+    )
+    p.add_argument(
+        "--notrace", action="store_true",
+        help="disable span tracing entirely for the replay (overhead baseline)",
+    )
     p.add_argument(
         "--hostile", action="store_true",
         help="hostile-load sustain run: multisig/P2SH fast-path-bypass script mix, "
@@ -68,7 +83,17 @@ def main() -> None:
         _run_hostile(cfg, args)
         return
     res = simulate(cfg)
-    elapsed, fresh = replay(res)
+    if args.notrace:
+        trace.disable()
+    if args.trace:
+        flight.enable(ring=max(2 * args.blocks, 64))
+        flight.reset()
+    if args.pipeline:
+        # traced replays attach the serving fanout so block traces cover
+        # the full production thread topology (stage/virtual/dispatch/serving)
+        elapsed, fresh = replay_pipelined(res, fanout=bool(args.trace))
+    else:
+        elapsed, fresh = replay(res)
     sink = fresh.sink()
     out = {
         "blocks": len(res.blocks),
@@ -84,7 +109,14 @@ def main() -> None:
         # is the bit-identity acceptance check for the sharded dispatch
         "sink": sink.hex(),
         "utxo_commitment": fresh.multisets[sink].finalize().hex(),
+        "pipeline": bool(args.pipeline),
+        "tracing": not args.notrace,
     }
+    if args.trace:
+        path = flight.dump(args.trace, reason="sim-replay")
+        out["trace_path"] = path
+        out["traces"] = len(flight.traces())
+        flight.disable()
     if args.json:
         print(json.dumps(out))
     else:

@@ -273,3 +273,51 @@ def replay(result: SimResult) -> tuple[float, Consensus]:
     assert fresh.sink() == result.sink, "replay reached a different sink"
     assert fresh.get_virtual_daa_score() == result.virtual_daa_score
     return elapsed, fresh
+
+
+class _NullSink:
+    """Discarding wire sink for the traced-replay fanout subscriber."""
+
+    def put(self, item, timeout=None):
+        return None
+
+
+def replay_pipelined(
+    result: SimResult, workers: int = 2, fanout: bool = False
+) -> tuple[float, "Consensus"]:
+    """Replay through the concurrent ConsensusPipeline — stage workers,
+    virtual worker and (when configured) the coalescing dispatcher all on
+    their own threads, which is the multi-thread path the flight recorder
+    is built to trace.  Same end-state equivalence checks as ``replay``.
+
+    ``fanout=True`` attaches the serving Broadcaster with one null-sink
+    subscriber, reproducing the production p2p->pipeline->serving thread
+    topology so every block trace crosses the serving threads too."""
+    from kaspa_tpu.pipeline.pipeline import ConsensusPipeline
+
+    fresh = Consensus(result.params)
+    broadcaster = None
+    if fanout:
+        from kaspa_tpu.serving.broadcaster import Broadcaster, Subscriber
+
+        broadcaster = Broadcaster(fresh.notification_root)
+        sub = broadcaster.register(Subscriber("sim", lambda n: b"\x00", _NullSink()))
+        broadcaster.subscribe(sub, "block-added")
+        broadcaster.subscribe(sub, "utxos-changed")
+    pipe = ConsensusPipeline(fresh, workers=workers)
+    t0 = time.perf_counter()
+    try:
+        futures = [pipe.submit(b) for b in result.blocks]
+        for f in futures:
+            status = f.result(timeout=600)
+            assert status in ("utxo_valid", "utxo_pending"), f"replay rejected block: {status}"
+        elapsed = time.perf_counter() - t0
+    finally:
+        pipe.shutdown()
+        if broadcaster is not None:
+            # drains the ingest queue + subscriber deques before returning,
+            # so late serving spans are recorded before any flight.dump
+            broadcaster.close()
+    assert fresh.sink() == result.sink, "replay reached a different sink"
+    assert fresh.get_virtual_daa_score() == result.virtual_daa_score
+    return elapsed, fresh

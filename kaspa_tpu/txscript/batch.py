@@ -31,6 +31,7 @@ import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
+from time import perf_counter_ns
 
 from kaspa_tpu.consensus import hashing as chash
 from kaspa_tpu.crypto import secp
@@ -99,6 +100,10 @@ class _FallbackJob:
     token: int
     input_index: int
     run: object  # fn() -> None, raises on invalid script
+    # collector's TraceContext + enqueue stamp: pool threads re-attach the
+    # VM execution (and its queue wait) to the owning block's trace
+    ctx: object = None
+    enqueued_ns: int = 0
 
 
 def _run_fallback(job: _FallbackJob) -> Exception | None:
@@ -113,16 +118,20 @@ def _run_fallback(job: _FallbackJob) -> Exception | None:
     never flip a consensus decision (the sustain run's sink-identity check
     depends on this).
     """
-    while True:
-        try:
-            FAULTS.fire("vm.fallback.exec")
-            job.run()
-            return None
-        except FaultInjected:
-            _VM_RETRIES.inc()
-            continue
-        except Exception as e:  # noqa: BLE001 - VM raises on invalid script
-            return e
+    t0 = perf_counter_ns()
+    if job.enqueued_ns:
+        trace.record_span("wait.vm", job.ctx, job.enqueued_ns, t0)
+    with trace.span("vm.fallback", parent=job.ctx, input=job.input_index):
+        while True:
+            try:
+                FAULTS.fire("vm.fallback.exec")
+                job.run()
+                return None
+            except FaultInjected:
+                _VM_RETRIES.inc()
+                continue
+            except Exception as e:  # noqa: BLE001 - VM raises on invalid script
+                return e
 
 
 # in-flight accounting for the shared pool so daemon shutdown can drain
@@ -252,6 +261,8 @@ class BatchScriptChecker:
                         self.vm_fallback, tx, utxo_entries, i, reused, pov_daa_score,
                         seq_commit_accessor=seq_commit_accessor,
                     ),
+                    ctx=trace.context(),
+                    enqueued_ns=perf_counter_ns(),
                 )
             )
 

@@ -1,0 +1,390 @@
+"""Block flight recorder (observability/flight.py): cross-thread causal
+tracing, critical-path attribution, Chrome trace export.
+
+The contracts under test: (1) spans emitted on stage threads, the
+coalescing verify-dispatch thread (one device super-batch fanning back
+into per-ticket child spans) and the VM-fallback pool all reassemble
+into ONE connected span tree per block — no orphans; (2) the
+last-finisher critical-path walk attributes wall time to stages by
+name; (3) the ring is bounded, begin() is idempotent, late spans attach
+to sealed traces until eviction; (4) chrome_trace() emits well-formed
+trace-event JSON; (5) tracing on vs off leaves the replayed consensus
+end state bit-identical.
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+from kaspa_tpu.observability import flight, trace
+from kaspa_tpu.observability.flight import chrome_trace, critical_path
+
+
+@pytest.fixture(autouse=True)
+def _recorder_reset():
+    flight.reset()
+    yield
+    flight.disable()
+    flight.reset()
+    trace.enable()
+
+
+def _span(sid, parent, name, t0, t1, thread="t0", trace_id="aa"):
+    return {
+        "name": name, "path": name, "trace": trace_id, "span": sid,
+        "parent": parent, "start_ns": t0, "end_ns": t1,
+        "start_us": t0 // 1000, "dur_us": (t1 - t0) / 1000.0,
+        "thread": thread, "depth": 0, "attrs": {},
+    }
+
+
+# --- critical-path analyzer -------------------------------------------------
+
+
+def test_critical_path_last_finisher_walk():
+    # root [0,100], child a [10,60], grandchild g [20,40]: walking back
+    # from 100 attributes 100->60 to root, 60->40 to a, [20,40] to g,
+    # [10,20] to a (left of g), [0,10] to root.
+    spans = [
+        _span(1, 0, "root", 0, 100),
+        _span(2, 1, "a", 10, 60),
+        _span(3, 2, "g", 20, 40),
+    ]
+    cp = critical_path(spans, 1)
+    assert cp["total_ns"] == 100
+    assert cp["stages"] == {"root": 50, "a": 30, "g": 20}
+    # fraction excludes the root's own self-time (the unexplained part)
+    assert cp["fraction"] == pytest.approx(0.5)
+
+
+def test_critical_path_concurrent_siblings_single_chain():
+    # two overlapping children: only the last finisher's interval is
+    # charged where they overlap — no double counting, sum == total
+    spans = [
+        _span(1, 0, "root", 0, 100),
+        _span(2, 1, "early", 0, 70),
+        _span(3, 1, "late", 30, 100),
+    ]
+    cp = critical_path(spans, 1)
+    assert sum(cp["stages"].values()) == cp["total_ns"]
+    assert cp["stages"]["late"] == 70  # [30,100]
+    assert cp["stages"]["early"] == 30  # clipped to [0,30]
+    assert cp["fraction"] == pytest.approx(1.0)
+
+
+def test_critical_path_clips_children_to_root_interval():
+    # a child ending after the root (late serving span) must not inflate
+    # attribution past the root's wall time
+    spans = [
+        _span(1, 0, "root", 0, 100),
+        _span(2, 1, "late", 90, 500),
+    ]
+    cp = critical_path(spans, 1)
+    assert cp["total_ns"] == 100
+    assert cp["stages"]["late"] == 10
+    assert cp["fraction"] <= 1.0
+
+
+def test_critical_path_missing_root():
+    assert critical_path([], 7) == {
+        "stages": {}, "total_ns": 0, "attributed_ns": 0, "fraction": 0.0
+    }
+
+
+# --- one connected tree across super-batch + VM fallback --------------------
+
+
+def _schnorr_items(n: int):
+    from kaspa_tpu.crypto import eclib
+
+    import hashlib
+
+    items = []
+    for i in range(n):
+        msg = hashlib.sha256(bytes([i, n, 0x5F])).digest()
+        sig = eclib.schnorr_sign(msg, i + 1)
+        if i % 3 == 2:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        items.append((eclib.schnorr_pubkey(i + 1), msg, sig))
+    return items
+
+
+def _p2sh_tx(seed: int):
+    """One tx whose single input routes to the VM fallback lane."""
+    from kaspa_tpu.consensus.model import (
+        SUBNETWORK_ID_NATIVE,
+        ComputeCommit,
+        Transaction,
+        TransactionInput,
+        TransactionOutpoint,
+        TransactionOutput,
+        UtxoEntry,
+    )
+    from kaspa_tpu.txscript import standard
+
+    OP_1, OP_EQUAL = 0x51, 0x87
+    redeem = bytes([OP_1, OP_EQUAL])
+    spk = standard.pay_to_script_hash_script(redeem)
+    sig_script = bytes([OP_1]) + bytes([len(redeem)]) + redeem
+    entry = UtxoEntry(10_000, spk, 5, False)
+    tx = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(bytes([seed]) * 32, 0), sig_script, 0, ComputeCommit.sigops(0))],
+        [TransactionOutput(9_000, spk)], 0, SUBNETWORK_ID_NATIVE, 0, b"",
+    )
+    return tx, [entry]
+
+
+def _parent_chain(span, by_id):
+    chain = [span]
+    while span["parent"] in by_id:
+        span = by_id[span["parent"]]
+        chain.append(span)
+    return chain
+
+
+def test_super_batch_and_vm_fallback_one_connected_tree(monkeypatch):
+    """Three 'blocks' on three stage threads submit verify chunks that
+    coalesce into ONE device super-batch; a fourth block routes a P2SH
+    input down the VM-fallback pool.  Every block's spans — including the
+    fan-back ``dispatch.device`` children and the ``vm.fallback`` span on
+    the pool thread — must form a single connected tree under that
+    block's root, at depth 3, with zero orphans."""
+    from kaspa_tpu.ops import dispatch as coalesce
+    from kaspa_tpu.txscript.batch import BatchScriptChecker
+    from kaspa_tpu.txscript.caches import SigCache
+
+    monkeypatch.setenv("KASPA_TPU_COALESCE_AGE_MS", "10000")
+    coalesce.configure(16)
+    try:
+        flight.enable(ring=16)
+        items = _schnorr_items(6)
+        tickets = {}
+
+        def stage_block(i):
+            ctx = flight.begin(bytes([0x10 + i]) * 32)
+            with trace.span("pipeline.stage", parent=ctx):
+                with trace.span("txscript.verify"):
+                    tickets[i] = coalesce.active().submit("schnorr", items[2 * i : 2 * i + 2])
+
+        threads = [
+            threading.Thread(target=stage_block, args=(i,), name=f"stage-{i}")
+            for i in range(3)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        # first wait() nudges the age-parked queue: all three chunks flush
+        # as one super-batch on the verify-dispatch thread
+        for i in range(3):
+            tickets[i].wait(300.0)
+
+        def _vm(tx, entries, i, reused, pov_daa_score=None, seq_commit_accessor=None):
+            from kaspa_tpu.txscript.vm import TxScriptEngine
+
+            TxScriptEngine(tx, entries, i).execute()
+
+        def vm_block():
+            ctx = flight.begin(b"\xaa" * 32)
+            with trace.span("pipeline.stage", parent=ctx):
+                checker = BatchScriptChecker(SigCache(), _vm)
+                tx, entries = _p2sh_tx(9)
+                checker.collect_tx(0, tx, entries)
+                errs = checker.dispatch()
+                assert errs.get(0) is None
+
+        th = threading.Thread(target=vm_block, name="stage-vm")
+        th.start()
+        th.join()
+
+        for i in range(3):
+            assert flight.end(bytes([0x10 + i]) * 32) is not None
+        assert flight.end(b"\xaa" * 32) is not None
+
+        done = flight.traces()
+        assert len(done) == 4
+        super_ids = set()
+        for t in done:
+            spans = t["spans"]
+            by_id = {s["span"]: s for s in spans}
+            roots = [s for s in spans if s["parent"] not in by_id]
+            # exactly one root (the synthetic block span), zero orphans
+            assert len(roots) == 1 and roots[0]["name"] == "block", t["label"]
+            names = {s["name"] for s in spans}
+            if "dispatch.device" in names:
+                dev = next(s for s in spans if s["name"] == "dispatch.device")
+                # fan-back child sits at depth 3: block <- stage <- verify <- device
+                chain = [s["name"] for s in _parent_chain(dev, by_id)]
+                assert chain == ["dispatch.device", "txscript.verify", "pipeline.stage", "block"]
+                assert dev["attrs"]["super_jobs"] == 6 and dev["attrs"]["chunks"] == 3
+                assert dev["thread"] not in {s["thread"] for s in spans if s["name"] == "pipeline.stage"}
+                super_ids.add(dev["attrs"]["super_id"])
+                assert "wait.dispatch" in names  # queue wait is a first-class span
+            if t["label"].startswith("block:aaaa"):
+                assert "vm.fallback" in names
+                vm = next(s for s in spans if s["name"] == "vm.fallback")
+                chain = [s["name"] for s in _parent_chain(vm, by_id)]
+                assert chain[-1] == "block" and "pipeline.stage" in chain
+        # the three dispatcher blocks shared one super-batch
+        assert len(super_ids) == 1
+    finally:
+        coalesce.configure(0)
+
+
+# --- recorder lifecycle -----------------------------------------------------
+
+
+def test_begin_idempotent_and_disabled_noop():
+    assert flight.begin(b"\x01" * 32) is None  # disabled: zero work
+    flight.enable(ring=4)
+    a = flight.begin(b"\x01" * 32)
+    b = flight.begin(b"\x01" * 32)
+    assert a.span_id == b.span_id and a.trace_id == b.trace_id
+    flight.end(b"\x01" * 32)
+    assert flight.end(b"\x01" * 32) is None  # double end: no-op
+
+
+def test_ring_bounded_and_late_spans_attach_until_eviction():
+    flight.enable(ring=2)
+    ctxs = {}
+    for i in range(3):
+        h = bytes([i]) * 32
+        ctxs[i] = flight.begin(h)
+        flight.end(h)
+    done = flight.traces()
+    assert len(done) == 2  # bounded: oldest evicted
+    assert done[0]["trace"] == (b"\x01" * 32).hex()
+    # a late span (serving fanout after seal) still lands in its tree
+    import time
+
+    t0 = time.perf_counter_ns()
+    trace.record_span("serving.fanout", ctxs[2], t0, t0 + 1000)
+    latest = flight.traces()[-1]
+    assert any(s["name"] == "serving.fanout" for s in latest["spans"])
+    # but an evicted trace drops it (and counts the drop)
+    before = flight.SPANS_DROPPED.value
+    trace.record_span("serving.fanout", ctxs[0], t0, t0 + 1000)
+    assert flight.SPANS_DROPPED.value == before + 1
+
+
+def test_end_records_critical_path_and_histogram():
+    flight.enable(ring=4)
+    h = b"\x77" * 32
+    ctx = flight.begin(h)
+    with trace.span("pipeline.stage", parent=ctx):
+        pass
+    t = flight.end(h)
+    cp = t["critical_path"]
+    assert 0.0 <= cp["fraction"] <= 1.0
+    assert "pipeline.stage" in cp["stages_ms"]
+    fam = flight.CRIT_HIST.snapshot()
+    assert fam["pipeline.stage"]["count"] >= 1
+    assert "block" not in fam  # root self-time is the residual, not a stage
+
+
+def test_breaker_open_dump(tmp_path):
+    flight.enable(ring=4, dump_dir=str(tmp_path))
+    h = b"\x42" * 32
+    flight.begin(h)
+    flight.end(h)
+    path = flight.on_breaker_open("secp")
+    assert path is not None
+    doc = json.load(open(path))
+    assert doc["format"] == "kaspa-flight" and doc["reason"] == "breaker-open:secp"
+    assert len(doc["traces"]) == 1
+    # no dump dir -> breaker dumps are suppressed (tests trip breakers)
+    flight.RECORDER.dump_dir = None
+    assert flight.on_breaker_open("secp") is None
+
+
+# --- chrome trace-event export ----------------------------------------------
+
+
+def test_chrome_trace_export_schema():
+    t = {
+        "trace": "ab" * 16,
+        "label": "block:abababab",
+        "spans": [
+            _span(1, 0, "block", 0, 100_000, thread="block"),
+            _span(2, 1, "pipeline.stage", 10_000, 60_000, thread="stage-0"),
+            _span(3, 2, "dispatch.device", 20_000, 40_000, thread="verify-dispatch"),
+        ],
+    }
+    doc = chrome_trace([t])
+    ev = doc["traceEvents"]
+    meta = [e for e in ev if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+    assert any(e["args"]["name"] == "block block:abababab" for e in meta if e["name"] == "process_name")
+    xs = [e for e in ev if e["ph"] == "X"]
+    assert len(xs) == 3
+    for e in xs:
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["dur"] > 0 and "ts" in e
+    # both cross-thread edges got a flow arrow (s/f pairs share ids)
+    souts = [e for e in ev if e["ph"] == "s"]
+    fins = [e for e in ev if e["ph"] == "f"]
+    assert len(souts) == len(fins) == 2
+    assert {e["id"] for e in souts} == {e["id"] for e in fins}
+    json.dumps(doc)  # serializable end to end
+
+
+# --- tracing on/off bit-identity (sim sink) ---------------------------------
+
+
+def test_tracing_on_off_bit_identical_sim_sink():
+    """The recorder observes, never participates: a pipelined replay with
+    the flight recorder on and a replay with tracing disabled entirely
+    must land on the byte-identical sink + utxo commitment."""
+    from kaspa_tpu.sim.simulator import SimConfig, replay_pipelined, simulate
+
+    res = simulate(SimConfig(bps=2, num_blocks=12, txs_per_block=2, seed=11))
+
+    flight.enable(ring=64)
+    _, traced = replay_pipelined(res)
+    assert len(flight.traces()) == 12
+    flight.disable()
+
+    trace.disable()
+    try:
+        _, plain = replay_pipelined(res)
+    finally:
+        trace.enable()
+
+    assert traced.sink() == plain.sink() == res.sink
+    sink = res.sink
+    assert (
+        traced.multisets[sink].finalize() == plain.multisets[sink].finalize()
+    )
+
+
+# --- getTraces RPC surface --------------------------------------------------
+
+
+def test_get_traces_rpc_surface():
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.consensus.params import simnet_params
+    from kaspa_tpu.p2p import Node
+    from kaspa_tpu.rpc import RpcCoreService
+    from kaspa_tpu.sim.simulator import Miner
+
+    node = Node(Consensus(simnet_params(bps=2)), "flight-test")
+    service = RpcCoreService(node.consensus, node.mining, address_prefix="kaspasim")
+    try:
+        flight.enable(ring=16)
+        miner = Miner(0, random.Random(5))
+        for _ in range(4):
+            node.submit_block(node.consensus.build_block_template(miner.miner_data, []))
+        out = service.get_traces(limit=8)
+        assert out["enabled"] is True
+        assert len(out["traces"]) == 4
+        s = out["traces"][-1]
+        assert s["status"] == "ok" and s["spans"] >= 2 and s["threads"] >= 2
+        assert 0.0 <= s["critical_path"]["fraction"] <= 1.0
+        full = service.get_traces(limit=2, verbose=True)
+        assert len(full["full"]) == 2 and full["full"][-1]["spans"]
+        json.dumps(out)  # wire-safe for the daemon's JSON-RPC layer
+    finally:
+        node.pipeline.shutdown()

@@ -110,6 +110,30 @@ def test_histogram_bucket_edges():
     assert snap["p50"] == 2.0  # 3rd of 6 observations sits in the le=2 bucket
 
 
+def test_histogram_quantile_edge_cases():
+    # empty histogram: 0.0, explicitly — not NaN, not a stale max
+    h = Histogram("h", buckets=(1.0, 2.0))
+    assert h.quantile(0.5) == 0.0
+    assert h.snapshot()["count"] == 0 and "p50" not in h.snapshot()
+    # every observation in the +Inf overflow bucket: the edges carry no
+    # upper bound, so the estimate is inf — the observed max would
+    # understate the tail the caller asked about
+    for v in (10.0, 20.0):
+        h.observe(v)
+    assert h.quantile(0.5) == float("inf")
+    assert h.quantile(0.99) == float("inf")
+    assert h.snapshot()["p99"] == float("inf")
+    # mixed: quantiles below the overflow mass still resolve to edges
+    for _ in range(6):
+        h.observe(0.5)
+    assert h.quantile(0.5) == 1.0
+    assert h.quantile(0.99) == float("inf")
+    # single observation on an exact edge
+    g = Histogram("g", buckets=(1.0, 2.0))
+    g.observe(1.0)
+    assert g.quantile(0.5) == 1.0 and g.quantile(1.0) == 1.0
+
+
 def test_counter_snapshot_deterministic():
     r = Registry()
     fam = r.counter_family("jobs", "kind")
@@ -187,6 +211,92 @@ def test_prom_renders_global_registry_collectors():
         pass
     text = prom.render()
     assert "kaspa_span_duration_seconds" in text
+
+
+def test_prom_family_headers_exactly_once():
+    r = Registry()
+    # a family with several cells must emit one header block, not one per
+    # cell; distinct raw names folding to the same exposition name
+    # ("a.b" and "a:b" both sanitize their dots) must not duplicate either
+    fam = r.counter_family("jobs", "kind", help="job counts")
+    fam.inc("alpha")
+    fam.inc("beta")
+    hfam = r.histogram_family("lat", "stage", (0.1, 1.0), help="latency")
+    hfam.observe("x", 0.05)
+    hfam.observe("y", 0.5)
+    r.counter("dup.name", help="first").inc(1)
+    r.counter("dup name", help="second").inc(2)  # same sanitized name
+    lines = prom.render(r).splitlines()
+    for needle in ("# TYPE kaspa_jobs counter", "# TYPE kaspa_lat histogram"):
+        assert lines.count(needle) == 1
+    type_names = [ln.split()[2] for ln in lines if ln.startswith("# TYPE ")]
+    assert len(type_names) == len(set(type_names)), "duplicate # TYPE family"
+    help_names = [ln.split()[2] for ln in lines if ln.startswith("# HELP ")]
+    assert len(help_names) == len(set(help_names)), "duplicate # HELP family"
+    # both dup counters still contribute their samples
+    assert lines.count("kaspa_dup_name_total 1") == 1
+    assert lines.count("kaspa_dup_name_total 2") == 1
+
+
+def test_prom_help_text_escaped():
+    r = Registry()
+    r.counter("tricky", help="line one\nline two \\ backslash").inc(3)
+    text = prom.render(r)
+    # exposition 0.0.4: HELP escapes newline and backslash; the rendered
+    # output must stay one physical line per comment
+    assert "# HELP kaspa_tricky line one\\nline two \\\\ backslash" in text.splitlines()
+
+
+def test_prom_full_live_registry_parses():
+    """Parse-validate the ENTIRE live global registry (flight recorder,
+    dispatch, serving, pipeline families all registered by import time):
+    every non-comment line is ``name[{labels}] value`` with a
+    float-parseable value, every # TYPE appears exactly once per family,
+    and every typed sample's name resolves to its family via the
+    histogram/counter suffix rules."""
+    import re as _re
+
+    from kaspa_tpu.observability import flight  # noqa: F401 - registers families
+
+    with trace.span("prom.live"):
+        pass
+    lines = prom.render().splitlines()
+    assert lines, "empty exposition"
+    sample_re = _re.compile(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$')
+    types: dict[str, str] = {}
+    for ln in lines:
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, mtype = ln.split(" ", 3)
+            assert name not in types, f"duplicate # TYPE for {name}"
+            assert mtype in ("counter", "histogram")
+            types[name] = mtype
+            continue
+        if ln.startswith("# HELP "):
+            assert "\n" not in ln  # escaped, single physical line
+            continue
+        m = sample_re.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        float(m.group(3))  # value must parse
+    assert types, "no typed families rendered"
+    # suffix rules: histogram samples are _bucket/_sum/_count, counter
+    # samples are _total; every sample that wears a typed family's name
+    # must agree with that family's declared type
+    for ln in lines:
+        if not ln or ln.startswith("#"):
+            continue
+        name = sample_re.match(ln).group(1)
+        for fam, mtype in types.items():
+            if name.startswith(fam + "_") or name == fam:
+                suffix = name[len(fam):]
+                allowed = ("_bucket", "_sum", "_count") if mtype == "histogram" else ("_total",)
+                assert suffix in allowed, f"{name} disagrees with # TYPE {fam} {mtype}"
+    # the always-present span family renders real samples on the page
+    assert types.get("kaspa_span_duration_seconds") == "histogram"
+    assert any(ln.startswith("kaspa_span_duration_seconds_bucket{") for ln in lines)
+    # the flight recorder's histogram family is part of the live page
+    assert types.get("kaspa_block_critical_path_ms") == "histogram"
 
 
 # --- get_metrics sink -----------------------------------------------------

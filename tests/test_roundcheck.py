@@ -31,9 +31,12 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             # not a seat inside the tier-1 fast lane; same for the chaos
             # sustain run (three full replays of a hostile workload) and the
             # coalesced-dispatch throughput lane (bench child + dual replay)
+            # and the obs lane (traced 24-block replay plus a tracing-off
+            # overhead A/B whose 2% gate is noise under suite load)
             "--skip-mesh",
             "--skip-chaos",
             "--skip-dispatch",
+            "--skip-obs",
             "--blocks",
             "8",
             "--out",

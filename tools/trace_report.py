@@ -5,21 +5,52 @@ Input: a JSONL span log written by ``kaspa_tpu.observability.trace.dump``
 (one span dict per line: name/path/start_us/dur_us/thread/depth/attrs), or
 a JSON document embedding such a list under an ``observability`` /
 ``spans`` key — e.g. a bench.py result line or a BENCH_*.json entry whose
-``tail`` carries the snapshot.
+``tail`` carries the snapshot — or a flight-recorder dump
+(``kaspa_tpu.observability.flight.dump``: per-block span trees with
+critical-path attribution).
 
 Output: a path-aggregated flame table (total vs self time, counts,
 mean/max) plus the slowest individual spans — enough to answer "which
-stage stalled" when a bench reports 0.0 verifies/sec:
+stage stalled" when a bench reports 0.0 verifies/sec.  Flight dumps
+additionally get a per-block critical-path table, and export to the
+Chrome trace-event format that ui.perfetto.dev / chrome://tracing load:
 
     python tools/trace_report.py /tmp/spans.jsonl
     python tools/trace_report.py BENCH_r06.json --top 15
+    python tools/trace_report.py FLIGHT.json --critical-path
+    python tools/trace_report.py FLIGHT.json --perfetto trace.json
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+
+def _flight_module():
+    """Import kaspa_tpu.observability.flight, tolerating a bare checkout
+    (tools/ run from anywhere without the package installed)."""
+    try:
+        from kaspa_tpu.observability import flight
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from kaspa_tpu.observability import flight
+    return flight
+
+
+def load_flight(path: str) -> dict | None:
+    """Return the parsed flight dump if ``path`` holds one, else None."""
+    with open(path) as f:
+        head = f.read(256)
+    if '"kaspa-flight"' not in head:
+        return None
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("format") != "kaspa-flight":
+        return None
+    return doc
 
 
 def _find_spans(obj) -> list | None:
@@ -138,11 +169,82 @@ def render_report(spans: list[dict], top: int = 10) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_critical_path(doc: dict, top: int = 10) -> str:
+    """Per-block critical-path table + aggregate stage attribution for a
+    flight dump (recomputed from the span trees, so dumps predating the
+    embedded summary still work)."""
+    flight = _flight_module()
+    traces = doc.get("traces", [])
+    if not traces:
+        return "no traces in flight dump\n"
+    lines = [f"{len(traces)} block traces (dump reason: {doc.get('reason', '?')})", ""]
+    lines.append(f"{'block':<18} {'spans':>6} {'threads':>8} {'wall ms':>9} {'attrib %':>9}  top stages")
+    lines.append("-" * 100)
+    agg: dict[str, float] = {}
+    fractions = []
+    for t in traces:
+        spans = t["spans"]
+        root = next((s for s in spans if s["name"] == "block"), spans[0])
+        cp = flight.critical_path(spans, root["span"])
+        fractions.append(cp["fraction"])
+        stages = sorted(cp["stages"].items(), key=lambda kv: -kv[1])
+        for name, ns in stages:
+            agg[name] = agg.get(name, 0.0) + ns
+        top3 = " ".join(f"{n}={ns / 1e6:.1f}ms" for n, ns in stages[:3] if n != "block")
+        lines.append(
+            f"{t['label'][:16]:<18} {len(spans):>6} {len({s['thread'] for s in spans}):>8} "
+            f"{cp['total_ns'] / 1e6:>9.2f} {cp['fraction'] * 100:>8.1f}%  {top3}"
+        )
+    lines.append("")
+    lines.append(f"min/mean attribution: {min(fractions) * 100:.1f}% / {sum(fractions) / len(fractions) * 100:.1f}%")
+    lines.append("")
+    lines.append("aggregate critical-path time by stage:")
+    total = sum(agg.values()) or 1.0
+    for name, ns in sorted(agg.items(), key=lambda kv: -kv[1])[:top]:
+        lines.append(f"  {name:<28} {ns / 1e6:>10.2f} ms  {ns / total * 100:>5.1f}%")
+    return "\n".join(lines) + "\n"
+
+
+def export_perfetto(doc: dict, out_path: str) -> str:
+    """Write the Chrome trace-event JSON for a flight dump; load the file
+    at ui.perfetto.dev or chrome://tracing."""
+    flight = _flight_module()
+    chrome = flight.chrome_trace(doc.get("traces", []))
+    with open(out_path, "w") as f:
+        json.dump(chrome, f)
+    return out_path
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="per-stage flame summary from a span log")
-    ap.add_argument("log", help="span JSONL file or JSON document embedding a span list")
+    ap.add_argument("log", help="span JSONL file, JSON document embedding a span list, or flight dump")
     ap.add_argument("--top", type=int, default=10, help="slowest individual spans to list")
+    ap.add_argument(
+        "--perfetto", default=None, metavar="OUT",
+        help="convert a flight dump to Chrome trace-event JSON at OUT (open in ui.perfetto.dev)",
+    )
+    ap.add_argument(
+        "--critical-path", action="store_true",
+        help="per-block critical-path attribution table (flight dumps only)",
+    )
     args = ap.parse_args(argv)
+    doc = load_flight(args.log)
+    if args.perfetto or args.critical_path:
+        if doc is None:
+            raise SystemExit(f"{args.log}: not a flight-recorder dump (need format=kaspa-flight)")
+        if args.perfetto:
+            path = export_perfetto(doc, args.perfetto)
+            n = sum(len(t["spans"]) for t in doc.get("traces", []))
+            sys.stdout.write(f"wrote {path}: {len(doc.get('traces', []))} block traces, {n} spans\n")
+        if args.critical_path:
+            sys.stdout.write(render_critical_path(doc, top=args.top))
+        return
+    if doc is not None:
+        spans = [s for t in doc.get("traces", []) for s in t["spans"]]
+        sys.stdout.write(render_report(spans, top=args.top))
+        sys.stdout.write("\n")
+        sys.stdout.write(render_critical_path(doc, top=args.top))
+        return
     sys.stdout.write(render_report(load_spans(args.log), top=args.top))
 
 
