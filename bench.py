@@ -30,6 +30,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 BASELINE = 50_000.0  # verifies/sec/chip target
@@ -515,6 +516,9 @@ def _run_attempt(timeout_s: float) -> tuple[dict | None, str, dict | None]:
     the last evidence of what the device did before wedging."""
     env = dict(os.environ)
     env["KASPA_TPU_BENCH_CHILD"] = "1"
+    # the headline measures one fixed kernel shape; warm-bucket splitting
+    # would silently substitute smaller dispatches for it
+    env.setdefault("KASPA_TPU_COLD_BUCKET_SPLIT", "0")
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE,
@@ -579,6 +583,103 @@ def _run_json_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str
             except json.JSONDecodeError:
                 continue
     return None, f"rc={proc.returncode}, no JSON line"
+
+
+def _run_sim_json(sim_args: list, env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
+    """Fresh `python -m kaspa_tpu.sim` subprocess -> last JSON line."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kaspa_tpu.sim", *sim_args, "--json"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except Exception:
+            pass
+        return None, f"killed after {timeout_s:.0f}s"
+    for line in reversed((out or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), f"rc={proc.returncode}"
+            except json.JSONDecodeError:
+                continue
+    return None, f"rc={proc.returncode}, no JSON line"
+
+
+def _flight_virtual_fraction(path: str) -> dict | None:
+    """Aggregate a flight dump's critical-path attribution: the virtual.*
+    (+ pipeline.virtual) share of total block wall time, and the top-3
+    stages — the number ROADMAP item 2 tracks per round."""
+    from kaspa_tpu.observability import flight
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        stage_ns: dict[str, float] = {}
+        total = 0.0
+        for t in doc["traces"]:
+            cp = flight.critical_path(t["spans"], t["root"])
+            total += cp["total_ns"]
+            for stage, ns in cp["stages"].items():
+                stage_ns[stage] = stage_ns.get(stage, 0.0) + ns
+    except Exception:
+        return None
+    if not total:
+        return None
+    virt = sum(ns for s, ns in stage_ns.items() if s.startswith("virtual.") or s == "pipeline.virtual")
+    top3 = sorted(((s, ns) for s, ns in stage_ns.items() if s != "block"), key=lambda kv: -kv[1])[:3]
+    return {
+        "virtual_fraction": round(virt / total, 4),
+        "top_stages": [
+            {"stage": s, "total_ms": round(ns / 1e6, 2), "fraction": round(ns / total, 4)} for s, ns in top3
+        ],
+    }
+
+
+def _virtual_critical_path(timeout_s: float = 300.0) -> dict | None:
+    """Before/after evidence for the speculative precompute: two traced
+    24-block pipelined CPU replays — speculation off ("before", the serial
+    virtual path) and on ("after") — each reduced to its virtual.*
+    critical-path fraction + top-3 stages.  Embedded into the headline
+    JSON so BENCH_r* documents the shift even while the device wedge keeps
+    hardware numbers CPU-only.  KASPA_TPU_BENCH_VCP=0 disables."""
+    if os.environ.get("KASPA_TPU_BENCH_VCP", "1") in ("0", "off"):
+        return None
+    out: dict = {}
+    # tpb 6 matters: the build phase then carries real signature batches,
+    # so the XLA verify-kernel compile happens before t0 and the replay
+    # measures pipeline shape, not a one-time jit wall absorbed into the
+    # first virtual cycle's shared span
+    base_args = ["--bps", "4", "--blocks", "24", "--tpb", "6", "--pipeline"]
+    # the per-block fraction charges a cycle's shared span to every block
+    # it absorbed, so an uncapped fast replay (one cycle swallowing most
+    # of the 24 blocks) reads ~95% even at hit rate 1.0 — bound the cycle
+    # so before/after attribution stays comparable across runs
+    env = {"JAX_PLATFORMS": "cpu", "KASPA_TPU_VIRTUAL_BATCH_MAX": "8"}
+    for label, extra in (("before_no_spec", ["--no-spec"]), ("after_speculative", [])):
+        dump = os.path.join(tempfile.gettempdir(), f"bench_vcp_{label}.json")
+        obj, note = _run_sim_json(
+            base_args + extra + ["--trace", dump], env, timeout_s
+        )
+        frac = _flight_virtual_fraction(dump) if obj is not None else None
+        if frac is None:
+            out[label] = {"error": note}
+            continue
+        frac["replay_blocks_per_sec"] = obj.get("replay_blocks_per_sec")
+        if obj.get("speculative"):
+            frac["speculative_hit_rate"] = obj["speculative"].get("hit_rate")
+        out[label] = frac
+    return out
 
 
 def _session_probe(log: list) -> bool:
@@ -729,6 +830,9 @@ def _sweep(probe_log: list, devices: int) -> None:
                         "KASPA_TPU_BENCH_B": str(b),
                         "KASPA_TPU_BENCH_KERNEL": kernel,
                         "KASPA_TPU_MESH": str(mesh_n),
+                        # cells measure this exact bucket shape: no
+                        # warm-bucket substitution
+                        "KASPA_TPU_COLD_BUCKET_SPLIT": "0",
                     },
                     min(ATTEMPT_TIMEOUT_S, remaining),
                 )
@@ -780,8 +884,49 @@ def _sweep(probe_log: list, devices: int) -> None:
                     err = (obj or {}).get("child_error", note)
                     cell.update(value=0.0, note=f"failed: {err}")
                 cells.append(cell)
+    # per-mesh replay cells: end-to-end sim replay blocks/sec at each mesh
+    # width, the lane where ROUNDCHECK first exposed the mesh-8 regression
+    # (1.13 vs 2.7 blocks/s).  The dominant cost at mesh > 1 is the
+    # per-subprocess shard_map re-trace of the verify ladder (~3-4 min of
+    # one-time tracing each fresh process pays before the first batch),
+    # not genuine shard overhead — the cells record replay_seconds next to
+    # blocks/sec so the two are distinguishable per round.
+    replay_blocks = int(os.environ.get("KASPA_TPU_BENCH_SWEEP_REPLAY", "24"))
+    for mesh_n in meshes:
+        cell = {"lane": "replay", "mesh": mesh_n, "blocks": replay_blocks}
+        remaining = deadline - time.monotonic()
+        if remaining <= 30:
+            cell.update(value=0.0, note="sweep budget exhausted")
+            cells.append(cell)
+            continue
+        env_extra = {"JAX_PLATFORMS": "cpu"}
+        if mesh_n > 1:
+            env_extra["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={mesh_n}"
+            ).strip()
+        obj, note = _run_sim_json(
+            ["--bps", "2", "--blocks", str(replay_blocks), "--mesh", str(mesh_n)],
+            env_extra,
+            min(900.0, remaining),
+        )
+        if obj is not None and obj.get("replay_blocks_per_sec", 0) > 0:
+            cell.update(
+                value=obj["replay_blocks_per_sec"],
+                unit="replay_blocks_per_sec",
+                replay_seconds=obj.get("replay_seconds"),
+                sink=obj.get("sink"),
+                note="ok",
+            )
+        else:
+            cell.update(value=0.0, note=f"failed: {note}")
+        cells.append(cell)
     best: dict = {}
     for c in cells:
+        if c.get("lane") == "replay":
+            key = f"replay/mesh{c['mesh']}"
+            if c["value"] > best.get(key, {}).get("value", 0.0):
+                best[key] = {"value": c["value"], "replay_seconds": c.get("replay_seconds")}
+            continue
         if "coalesce_depth" in c:
             key = f"{c['kernel']}/mesh{c['mesh']}/coalesce"
             if c["value"] > best.get(key, {}).get("value", 0.0):
@@ -860,6 +1005,10 @@ def main() -> None:
                     "error": "device probe wedged at session start (see wedge dossier)",
                     "wedge_dossier": dossier,
                     "cpu_fallback_value": fb_value,
+                    # the pipeline-shape evidence is CPU-path and survives
+                    # the wedge: the round artifact still documents the
+                    # virtual critical-path shift
+                    "virtual_critical_path": _virtual_critical_path(),
                 }
             )
         )
@@ -889,6 +1038,7 @@ def main() -> None:
         if obs is not None:
             last_obs = obs
         if result is not None:
+            result["virtual_critical_path"] = _virtual_critical_path()
             print(json.dumps(result))
             return
         time.sleep(RETRY_BACKOFF_S)

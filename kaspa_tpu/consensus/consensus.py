@@ -44,7 +44,7 @@ from kaspa_tpu.consensus.processes.transaction_validator import (
     TransactionValidator,
     TxRuleError,
 )
-from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, SampledWindowManager
+from kaspa_tpu.consensus.processes.window import DIFFICULTY_WINDOW, MEDIAN_TIME_WINDOW, SampledWindowManager
 from kaspa_tpu.consensus.reachability import ORIGIN, ReachabilityService
 from kaspa_tpu.consensus.stores import (
     ConsensusStorage,
@@ -186,6 +186,11 @@ class Consensus:
         from kaspa_tpu.consensus.counters import ProcessingCounters
 
         self.counters = ProcessingCounters()
+
+        # speculative chain-state precompute (pipeline/speculative.py):
+        # attached by ConsensusPipeline when enabled; None = synchronous
+        # chain verification only (serial replay, tests, direct callers)
+        self.speculative = None
 
         # virtual/UTXO state.  The per-block columns live in ConsensusStorage
         # as bounded read-through caches (CachedDbAccess); these attributes
@@ -781,6 +786,10 @@ class Consensus:
         self._set_daa_excluded(block_hash, daa_window.mergeset_non_daa)
         self.depth_manager.store(block_hash, mdr, fp)
         self.window_manager.cache_block_window(block_hash, DIFFICULTY_WINDOW, daa_window.window)
+        # cache the median-time window too: children (and every virtual
+        # resolve whose sink is this block) then extend it incrementally
+        # instead of re-walking the selected chain from scratch
+        self.window_manager.cache_block_window(block_hash, MEDIAN_TIME_WINDOW, _w)
         self.storage.statuses.set(block_hash, StatusesStore.STATUS_HEADER_ONLY)
         return True
 
@@ -887,115 +896,139 @@ class Consensus:
 
         heap = []  # max-heap via negated key
         seen = set()
+        # blue-work sort keys fetched once per candidate: the finality
+        # filter, the heap and the virtual-parent sort all reuse them
+        blue_work: dict[bytes, int] = {}
+
+        def bw(h):
+            w = blue_work.get(h)
+            if w is None:
+                w = blue_work[h] = self.storage.ghostdag.get_blue_work(h)
+            return w
 
         def push(h):
             if h not in seen:
                 seen.add(h)
-                bw = self.storage.ghostdag.get_blue_work(h)
-                _hq.heappush(heap, ((-bw, _neg_bytes(h)), h))
+                _hq.heappush(heap, ((-bw(h), _neg_bytes(h)), h))
 
-        # finality filter (processor.rs:296-316): only tips in the future of
-        # the virtual finality point can become the sink; a heavier tip on
-        # the wrong side is a FINALITY CONFLICT — surface it, never adopt it
-        finality_point = None
-        if self.virtual_state is not None:
-            pp = self.pruning_processor.pruning_point
-            fp = self.depth_manager.calc_finality_point(self.virtual_state.ghostdag_data, pp)
-            # virtual_finality_point (processor.rs:386-391): the finality
-            # point only anchors when it sits on the pruning point's chain;
-            # otherwise the pruning point itself is the anchor (e.g. right
-            # after a trusted proof import, where the computed point falls
-            # into pruned/disconnected history)
-            if (
-                fp != ORIGIN
-                and self.reachability.has(fp)
-                and self.reachability.is_chain_ancestor_of(pp, fp)
-            ):
-                finality_point = fp
-            elif self.reachability.has(pp):
-                finality_point = pp
-        allowed_tips = []
-        for t in self.tips:
-            if finality_point is not None and not self.reachability.is_dag_ancestor_of(finality_point, t):
+        with trace.span("virtual.sink_search"):
+            # finality filter (processor.rs:296-316): only tips in the future
+            # of the virtual finality point can become the sink; a heavier tip
+            # on the wrong side is a FINALITY CONFLICT — surface it, never
+            # adopt it
+            finality_point = None
+            if self.virtual_state is not None:
+                pp = self.pruning_processor.pruning_point
+                fp = self.depth_manager.calc_finality_point(self.virtual_state.ghostdag_data, pp)
+                # virtual_finality_point (processor.rs:386-391): the finality
+                # point only anchors when it sits on the pruning point's chain;
+                # otherwise the pruning point itself is the anchor (e.g. right
+                # after a trusted proof import, where the computed point falls
+                # into pruned/disconnected history)
                 if (
-                    t not in self._finality_conflicts
-                    and self.storage.ghostdag.get_blue_work(t)
-                    > self.storage.ghostdag.get_blue_work(self.sink())
+                    fp != ORIGIN
+                    and self.reachability.has(fp)
+                    and self.reachability.is_chain_ancestor_of(pp, fp)
                 ):
-                    # a chain heavier than ours that excludes our finality
-                    # point: requires manual intervention (flow_context.rs
-                    # on_finality_conflict -> FinalityConflict notification)
-                    self._finality_conflicts[t] = "active"
-                    self.notification_root.notify(
-                        _FinalityConflictNotification(t, finality_point)
-                    )
-                continue
-            allowed_tips.append(t)
-            push(t)
-        sink = None
-        while heap:
-            _, cand = _hq.heappop(heap)
-            if self.storage.statuses.get(cand) != StatusesStore.STATUS_DISQUALIFIED and self._ensure_chain_utxo_valid(cand):
-                sink = cand
-                break
-            for p in self.storage.relations.get_parents(cand):
-                if p != ORIGIN:
-                    push(p)
-        assert sink is not None, "no valid sink found"
-        prev_sink = (
-            self.virtual_state.ghostdag_data.selected_parent if self.virtual_state is not None else None
-        )
-        # advance the reachability reindex root toward the agreed chain
-        # (inquirer.rs hint_virtual_selected_parent)
-        self.reachability.hint_virtual_selected_parent(sink)
-
-        # virtual parents: bounded count of chain-qualified tips from the
-        # finality-filtered set, sink first (pick_virtual_parents,
-        # processor.rs:1013-1146) — virtual must never merge a tip that
-        # excludes the finality point
-        others = sorted(
-            (t for t in allowed_tips if t != sink and self._ensure_chain_utxo_valid(t)),
-            key=lambda h: (self.storage.ghostdag.get_blue_work(h), h),
-            reverse=True,
-        )
-        virtual_parents = [sink] + others[: self.params.max_block_parents - 1]
-        vgd = self.ghostdag_manager.ghostdag(virtual_parents)
-        assert vgd.selected_parent == sink, "virtual selected parent must be the sink"
-
-        # compute virtual window state
-        daa_window = self.window_manager.block_daa_window(vgd)
-        bits = self.window_manager.calculate_difficulty_bits(vgd, daa_window)
-        pmt, _ = self.window_manager.calc_past_median_time(vgd)
-
-        # virtual UTXO state: replay virtual mergeset over sink position
-        self._move_utxo_position(sink)
-        ctx = self._calculate_utxo_state(vgd, daa_window.daa_score)
-        self.virtual_utxo_diff = ctx["mergeset_diff"]
-        prev_state = self.virtual_state
-        self.virtual_state = VirtualState(
-            parents=virtual_parents,
-            ghostdag_data=vgd,
-            daa_score=daa_window.daa_score,
-            bits=bits,
-            past_median_time=pmt,
-            accepted_tx_ids=ctx["accepted_tx_ids"],
-            mergeset_rewards=ctx["mergeset_rewards"],
-            mergeset_non_daa=daa_window.mergeset_non_daa,
-        )
-        # emit score notifications on every resolve; one net UtxosChanged
-        # only when the chain state actually moved
-        if prev_state is not None:
-            self.notification_root.notify_virtual_change(
-                self.virtual_state, list(self._acc_added.items()), list(self._acc_removed.items())
+                    finality_point = fp
+                elif self.reachability.has(pp):
+                    finality_point = pp
+            allowed_tips = []
+            for t in self.tips:
+                if finality_point is not None and not self.reachability.is_dag_ancestor_of(finality_point, t):
+                    if t not in self._finality_conflicts and bw(t) > bw(self.sink()):
+                        # a chain heavier than ours that excludes our finality
+                        # point: requires manual intervention (flow_context.rs
+                        # on_finality_conflict -> FinalityConflict notification)
+                        self._finality_conflicts[t] = "active"
+                        self.notification_root.notify(
+                            _FinalityConflictNotification(t, finality_point)
+                        )
+                    continue
+                allowed_tips.append(t)
+                push(t)
+            sink = None
+            while heap:
+                _, cand = _hq.heappop(heap)
+                st = self.storage.statuses.get(cand)
+                if st == StatusesStore.STATUS_UTXO_VALID or (
+                    st != StatusesStore.STATUS_DISQUALIFIED and self._ensure_chain_utxo_valid(cand)
+                ):
+                    sink = cand
+                    break
+                for p in self.storage.relations.get_parents(cand):
+                    if p != ORIGIN:
+                        push(p)
+            assert sink is not None, "no valid sink found"
+            prev_sink = (
+                self.virtual_state.ghostdag_data.selected_parent if self.virtual_state is not None else None
             )
-            if prev_sink is not None and prev_sink != sink:
-                self._notify_chain_changed(prev_sink, sink)
-        self._acc_added = {}
-        self._acc_removed = {}
-        # pruning executor: advance the pruning point + delete stale history
-        # (pipeline/pruning_processor/processor.rs worker)
-        if prev_state is not None:
-            self.pruning_processor.advance_if_possible(self.storage.ghostdag.get(sink))
+            # advance the reachability reindex root toward the agreed chain
+            # (inquirer.rs hint_virtual_selected_parent)
+            self.reachability.hint_virtual_selected_parent(sink)
+
+            # virtual parents: bounded count of chain-qualified tips from the
+            # finality-filtered set, sink first (pick_virtual_parents,
+            # processor.rs:1013-1146) — virtual must never merge a tip that
+            # excludes the finality point.  Tips already UTXO_VALID skip the
+            # requalification walk entirely
+            others = sorted(
+                (
+                    t
+                    for t in allowed_tips
+                    if t != sink
+                    and (
+                        self.storage.statuses.get(t) == StatusesStore.STATUS_UTXO_VALID
+                        or self._ensure_chain_utxo_valid(t)
+                    )
+                ),
+                key=lambda h: (bw(h), h),
+                reverse=True,
+            )
+            virtual_parents = [sink] + others[: self.params.max_block_parents - 1]
+            vgd = self.ghostdag_manager.ghostdag(virtual_parents)
+            assert vgd.selected_parent == sink, "virtual selected parent must be the sink"
+
+        with trace.span("virtual.window"):
+            # virtual window state: both windows extend the sink's cached
+            # windows (difficulty + median-time are cached at header commit),
+            # so this is an incremental mergeset merge, not a chain walk
+            daa_window = self.window_manager.block_daa_window(vgd)
+            bits = self.window_manager.calculate_difficulty_bits(vgd, daa_window)
+            pmt, _ = self.window_manager.calc_past_median_time(vgd)
+
+        with trace.span("virtual.commit"):
+            # virtual UTXO state: replay virtual mergeset over sink position.
+            # The virtual multiset is never read (only chain blocks commit to
+            # a utxo_commitment), so skip its device product outright
+            self._move_utxo_position(sink)
+            ctx = self._calculate_utxo_state(vgd, daa_window.daa_score, need_multiset=False)
+            self.virtual_utxo_diff = ctx["mergeset_diff"]
+            prev_state = self.virtual_state
+            self.virtual_state = VirtualState(
+                parents=virtual_parents,
+                ghostdag_data=vgd,
+                daa_score=daa_window.daa_score,
+                bits=bits,
+                past_median_time=pmt,
+                accepted_tx_ids=ctx["accepted_tx_ids"],
+                mergeset_rewards=ctx["mergeset_rewards"],
+                mergeset_non_daa=daa_window.mergeset_non_daa,
+            )
+            # emit score notifications on every resolve; one net UtxosChanged
+            # only when the chain state actually moved
+            if prev_state is not None:
+                self.notification_root.notify_virtual_change(
+                    self.virtual_state, list(self._acc_added.items()), list(self._acc_removed.items())
+                )
+                if prev_sink is not None and prev_sink != sink:
+                    self._notify_chain_changed(prev_sink, sink)
+            self._acc_added = {}
+            self._acc_removed = {}
+            # pruning executor: advance the pruning point + delete stale
+            # history (pipeline/pruning_processor/processor.rs worker)
+            if prev_state is not None:
+                self.pruning_processor.advance_if_possible(self.storage.ghostdag.get(sink))
 
     def _notify_chain_changed(self, prev_sink: bytes, sink: bytes) -> None:
         """VirtualChainChanged (notify/events.rs): the selected-chain path
@@ -1037,21 +1070,45 @@ class Consensus:
                 return False
             chain.append(cur)
             cur = self.storage.ghostdag.get_selected_parent(cur)
+        if not chain:
+            return True
         chain.reverse()
-        for c in chain:
-            if not self._verify_chain_block(c):
-                self.storage.statuses.set(c, StatusesStore.STATUS_DISQUALIFIED)
-                self.counters.inc_chain_disqualified()
-                return False
+        with trace.span("virtual.chain_verify", blocks=len(chain)):
+            # batch every cache-missing segment member's context into one
+            # coalesced device dispatch before the serial verify loop —
+            # k misses cost one script round-trip instead of k
+            if self.speculative is not None and len(chain) > 1:
+                self.speculative.precompute_chain(chain)
+            for c in chain:
+                if not self._verify_chain_block(c):
+                    self.storage.statuses.set(c, StatusesStore.STATUS_DISQUALIFIED)
+                    self.counters.inc_chain_disqualified()
+                    return False
         return True
 
     def _verify_chain_block(self, block: bytes) -> bool:
-        """verify_expected_utxo_state for one chain-candidate block."""
+        """verify_expected_utxo_state for one chain-candidate block.
+
+        The expensive half — mergeset replay, script batch, muhash product
+        (`_calculate_utxo_state`) — is served from the speculative
+        precompute cache when a stage worker already ran it for this
+        (block, selected_parent) position; the checks + commit half always
+        runs here, so hit and miss paths write identical state."""
         gd = self.storage.ghostdag.get(block)
         header = self.storage.headers.get(block)
         self._move_utxo_position(gd.selected_parent)
-        ctx = self._calculate_utxo_state(gd, header.daa_score)
+        entry = None
+        if self.speculative is not None:
+            entry = self.speculative.take(block, gd.selected_parent)
+        ctx = entry.ctx if entry is not None else self._calculate_utxo_state(gd, header.daa_score)
+        return self._check_and_commit_chain_block(block, gd, header, ctx)
 
+    def _check_and_commit_chain_block(self, block: bytes, gd: GhostdagData, header, ctx: dict) -> bool:
+        """The five verify_expected_utxo_state checks + the chain commit,
+        over an already-computed UTXO context (requires utxo_position ==
+        gd.selected_parent).  Check order and side effects are identical
+        whether ctx came from the synchronous path or the speculative
+        cache."""
         # 1. utxo commitment
         multiset = ctx["multiset"]
         if multiset.finalize() != header.utxo_commitment:
@@ -1179,12 +1236,41 @@ class Consensus:
         )
         return chash.tx_hash(coinbase) == chash.tx_hash(expected)
 
-    def _calculate_utxo_state(self, gd: GhostdagData, pov_daa_score: int) -> dict:
+    def _calculate_utxo_state(
+        self,
+        gd: GhostdagData,
+        pov_daa_score: int,
+        need_multiset: bool = True,
+        base=None,
+        seed_multiset: MuHash | None = None,
+        checker=None,
+        token_ns=None,
+    ) -> dict:
         """utxo_validation.rs calculate_utxo_state relative to current position
-        (must equal gd.selected_parent)."""
-        assert self.utxo_position == gd.selected_parent
+        (must equal gd.selected_parent).
+
+        ``need_multiset=False`` skips the muhash device product entirely —
+        the virtual resolve never reads it (only chain blocks commit to a
+        utxo_commitment).
+
+        Speculative mode (``checker`` given): UTXO reads go through ``base``
+        (the caller's frozen view of the selected-parent position) instead of
+        the live set, the multiset seeds from ``seed_multiset`` and its device
+        batch is deferred (returned under ``multiset_items``), and script
+        checks are staged *optimistically* on the shared checker — every
+        staged tx is treated as accepted, with the staged tokens returned
+        under ``staged_tokens`` so the caller can discard the whole context
+        if any check fails after the async dispatch resolves."""
+        speculative = checker is not None
+        if not speculative:
+            assert self.utxo_position == gd.selected_parent
+        if base is None:
+            base = self.utxo_set
         mergeset_diff = UtxoDiff()
-        multiset = self.multisets[gd.selected_parent].clone()
+        multiset = None
+        if need_multiset:
+            seed = seed_multiset if seed_multiset is not None else self.multisets[gd.selected_parent]
+            multiset = seed.clone()
         accepted_tx_ids: list[bytes] = []
         mergeset_rewards: dict[bytes, BlockRewardData] = {}
 
@@ -1200,15 +1286,28 @@ class Consensus:
         # per-merged-block acceptance (KIP-21 lane activity source):
         # (merged_block, coinbase payload, [accepted txs in block order])
         mergeset_acceptance: list = []
+        staged_tokens: list = []
 
         ordered = [(gd.selected_parent, sp_txs)] + [
             (b, self.storage.block_transactions.get(b)) for b in gd.ascending_mergeset_without_selected_parent(self.storage.ghostdag)
         ]
         for i, (merged_block, txs) in enumerate(ordered):
-            composed = UtxoView(self.utxo_set, mergeset_diff)
+            composed = UtxoView(base, mergeset_diff)
             is_selected_parent = i == 0
             flags = FLAG_SKIP_SCRIPTS if is_selected_parent else FLAG_FULL
-            validated = self._validate_transactions(txs, composed, pov_daa_score, flags)
+            if speculative:
+                # token_ns keeps tokens collision-free when several blocks
+                # share one checker (the in-cycle chain precompute)
+                staged = self._validate_transactions(
+                    txs, composed, pov_daa_score, flags,
+                    checker=checker,
+                    token_tag=("ms", i) if token_ns is None else ("ms", token_ns, i),
+                    position_anchor=gd.selected_parent,
+                )
+                staged_tokens.extend(t for t, _tx, _e, _f in staged)
+                validated = [(tx, entries, fee) for _t, tx, entries, fee in staged]
+            else:
+                validated = self._validate_transactions(txs, composed, pov_daa_score, flags)
             block_fee = 0
             accepted_here = [coinbase] if is_selected_parent else []
             for tx, entries, fee in validated:
@@ -1220,26 +1319,42 @@ class Consensus:
             cb_data = self.coinbase_manager.deserialize_coinbase_payload(txs[0].payload)
             mergeset_rewards[merged_block] = BlockRewardData(cb_data.subsidy, block_fee, cb_data.miner_data.script_public_key)
             mergeset_acceptance.append((merged_block, txs[0].payload, accepted_here))
-        multiset.add_transactions_batch(multiset_items)
+        if need_multiset and not speculative:
+            multiset.add_transactions_batch(multiset_items)
 
-        return {
+        ctx = {
             "mergeset_diff": mergeset_diff,
             "multiset": multiset,
             "accepted_tx_ids": accepted_tx_ids,
             "mergeset_rewards": mergeset_rewards,
             "mergeset_acceptance": mergeset_acceptance,
         }
+        if speculative:
+            ctx["multiset_items"] = multiset_items
+            ctx["staged_tokens"] = staged_tokens
+        return ctx
 
-    def _validate_transactions(self, txs, utxo_view, pov_daa_score, flags):
+    def _validate_transactions(
+        self, txs, utxo_view, pov_daa_score, flags, checker=None, token_tag=None, position_anchor=None
+    ):
         """validate_transactions_in_parallel: returns [(tx, entries, fee)] of
-        valid non-coinbase txs; script checks batched on device."""
-        checker = self.transaction_validator.new_checker()
+        valid non-coinbase txs; script checks batched on device.
+
+        With a shared ``checker`` (speculative mode) nothing is dispatched
+        here: the staged list [(token, tx, entries, fee)] is returned with
+        tokens namespaced by ``token_tag``, and the caller joins the async
+        handle and maps failures back.  ``position_anchor`` pins the
+        seq-commit accessor to the position the synchronous path would have
+        (it calls ``_move_utxo_position`` first; speculation does not)."""
+        shared = checker is not None
+        if not shared:
+            checker = self.transaction_validator.new_checker()
         accessor = None
         if self.params.toccata_active(pov_daa_score):
             from kaspa_tpu.consensus.smt_processor import ConsensusSeqCommitAccessor
 
             accessor = ConsensusSeqCommitAccessor(
-                self.utxo_position,
+                position_anchor if position_anchor is not None else self.utxo_position,
                 self.reachability,
                 self.storage.headers,
                 self.params.toccata_active,
@@ -1259,14 +1374,17 @@ class Consensus:
                 entries.append(entry)
             if missing:
                 continue
+            token = (token_tag, i) if shared else i
             try:
                 fee = self.transaction_validator.validate_populated_transaction_and_get_fee(
-                    tx, entries, pov_daa_score, flags, checker=checker, token=i,
+                    tx, entries, pov_daa_score, flags, checker=checker, token=token,
                     seq_commit_accessor=accessor,
                 )
             except TxRuleError:
                 continue
-            staged.append((i, tx, entries, fee))
+            staged.append((token, tx, entries, fee))
+        if shared:
+            return staged
         script_results = checker.dispatch()
         out = []
         for i, tx, entries, fee in staged:

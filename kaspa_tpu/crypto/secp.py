@@ -12,6 +12,7 @@ slice instead of per-input.
 from __future__ import annotations
 
 import hashlib
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -36,7 +37,24 @@ _PADDED_LANES = REGISTRY.counter("secp_padded_lanes", help="device lanes wasted 
 _NEW_SHAPES = REGISTRY.counter_family(
     "secp_dispatch_shapes", "kernel", help="distinct padded bucket sizes dispatched (jit recompile proxy)"
 )
+_COLD_SPLITS = REGISTRY.counter_family(
+    "secp_cold_bucket_splits", "kernel",
+    help="batches split into warm-bucket sub-dispatches to dodge a cold jit compile",
+)
 _seen_shapes: set = set()
+
+
+def _cold_split_enabled() -> bool:
+    """Warm-bucket splitting: a batch whose padded bucket was never
+    compiled is split into sub-dispatches at the largest already-warm
+    bucket instead of paying the compile wall inline.  The verify-kernel
+    jit cost grows superlinearly with batch width on the XLA formulation
+    (the wedge dossiers' recurring probe stall; ~3 min for bucket 16 on
+    CPU), so crossing into a cold bucket mid-pipeline can stall the
+    commit lock for minutes.  `KASPA_TPU_COLD_BUCKET_SPLIT=0` restores
+    pad-up-and-compile — bench sweeps that deliberately measure specific
+    bucket shapes need that."""
+    return os.environ.get("KASPA_TPU_COLD_BUCKET_SPLIT", "1") not in ("0", "off", "false")
 
 # degraded-lane occupancy: how much of the verify workload is riding the
 # host oracle instead of the device (breaker open, or a dispatch died) —
@@ -115,11 +133,19 @@ class _Batch:
         if n == 0:
             return np.zeros(0, dtype=bool)
         b = _bucket(n)
+        shape_key = (kernel.__name__, b)
+        new_shape = shape_key not in _seen_shapes
+        if new_shape and _cold_split_enabled():
+            warm = max(
+                (bk for k, bk in _seen_shapes if k == kernel.__name__ and bk < b),
+                default=None,
+            )
+            if warm is not None:
+                _COLD_SPLITS.inc(kernel.__name__)
+                return self._run_split(kernel, warm)
         _BATCH_SIZE.observe(n)
         _OCCUPANCY.observe(100.0 * n / b)
         _PADDED_LANES.inc(b - n)
-        shape_key = (kernel.__name__, b)
-        new_shape = shape_key not in _seen_shapes
         if new_shape:
             _seen_shapes.add(shape_key)
             _NEW_SHAPES.inc(kernel.__name__)
@@ -143,6 +169,26 @@ class _Batch:
         else:
             mask = kernel(*args)
         return np.asarray(mask)[:n]
+
+    def _run_split(self, kernel, warm: int) -> np.ndarray:
+        """Dispatch this batch as sub-batches of the given warm bucket
+        size — several known-compiled round trips instead of one cold
+        compile.  Sub-batches recurse through run(): a full slice reuses
+        the warm shape, the tail pads into a smaller (also warm) bucket."""
+        n = len(self.ok)
+        out = np.empty(n, dtype=bool)
+        for off in range(0, n, warm):
+            end = min(off + warm, n)
+            sub = _Batch(
+                px=self.px[off:end],
+                py=self.py[off:end],
+                rc=self.rc[off:end],
+                d1=self.d1[off:end],
+                d2=self.d2[off:end],
+                ok=self.ok[off:end],
+            )
+            out[off:end] = sub.run(kernel)
+        return out
 
 
 def _run_guarded(batch: _Batch, kernel, items: list, host_verify) -> np.ndarray:

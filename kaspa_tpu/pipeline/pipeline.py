@@ -26,6 +26,7 @@ virtual stage absorbed it (the reference's virtual_state_task).
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import Future
 from dataclasses import dataclass
@@ -35,6 +36,7 @@ from kaspa_tpu.consensus.stores import StatusesStore
 from kaspa_tpu.observability import flight, trace
 from kaspa_tpu.observability.core import DEFAULT_LATENCY_BUCKETS, REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.pipeline.deps_manager import BlockTaskDependencyManager
+from kaspa_tpu.pipeline.speculative import SpeculativeVerifier
 from kaspa_tpu.utils.sync import Channel, Closed, LockCtx
 
 # queue wait vs execute split per stage — the question the round-5 bench
@@ -64,12 +66,19 @@ class _Task:
 
 
 class ConsensusPipeline:
-    def __init__(self, consensus, workers: int = 2):
+    def __init__(self, consensus, workers: int = 2, speculative: bool | None = None):
         self.consensus = consensus
         self.deps = BlockTaskDependencyManager()
         self._ready = Channel()
         self._virtual_q = Channel()
         self._lock = LockCtx("consensus-commit", rank=10)
+        # bound the blocks absorbed per virtual cycle: a deep IBD burst must
+        # not collapse into one giant resolve with unbounded commit latency
+        self._virtual_batch_max = max(1, int(os.environ.get("KASPA_TPU_VIRTUAL_BATCH_MAX", "64")))
+        if speculative is None:
+            speculative = os.environ.get("KASPA_TPU_SPECULATIVE", "1") not in ("0", "off", "false")
+        self.speculative = SpeculativeVerifier(consensus, self._lock) if speculative else None
+        consensus.speculative = self.speculative
         self._inflight = 0
         self._idle_mu = threading.Lock()
         self._idle_cv = threading.Condition(self._idle_mu)
@@ -131,6 +140,9 @@ class ConsensusPipeline:
             t.join(timeout=10)
         self._virtual_q.close()
         self._virtual_worker_t.join(timeout=10)
+        # detach: direct (serial) callers of _verify_chain_block after
+        # shutdown must not consume stale entries
+        self.consensus.speculative = None
 
     # ------------------------------------------------------------------
     # stage workers: header + body
@@ -215,6 +227,13 @@ class ConsensusPipeline:
             # dependents: a child finishing its stages can then never overtake
             # its parent into tips/virtual resolution
             if err is None and duplicate_status is None and not task.header_only:
+                # speculative chain-state precompute runs BEFORE the virtual
+                # hand-off, so by the time the virtual worker verifies this
+                # block its (block, selected_parent) entry is already cached;
+                # device waits happen here, off the commit lock, coalescing
+                # with other speculating workers' script batches
+                if self.speculative is not None:
+                    self.speculative.run(blk.hash, task.ctx)
                 try:
                     task.enqueue_ns = perf_counter_ns()
                     self._virtual_q.send(task)
@@ -239,7 +258,7 @@ class ConsensusPipeline:
                 first = self._virtual_q.recv()
             except Closed:
                 return
-            batch = [first] + self._virtual_q.drain()
+            batch = [first] + self._virtual_q.drain(self._virtual_batch_max - 1)
             now = perf_counter_ns()
             _VIRT_BATCH.observe(len(batch))
             for task in batch:

@@ -49,6 +49,11 @@ def main() -> None:
         "virtual worker) instead of the serial loop",
     )
     p.add_argument(
+        "--no-spec", action="store_true",
+        help="disable the speculative chain-state precompute in --pipeline replays "
+        "(bit-identity baseline; results must match speculation-on exactly)",
+    )
+    p.add_argument(
         "--trace", default=None, metavar="PATH",
         help="enable the per-block flight recorder during the replay and dump "
         "the completed-trace ring to PATH (tools/trace_report.py input)",
@@ -91,7 +96,9 @@ def main() -> None:
     if args.pipeline:
         # traced replays attach the serving fanout so block traces cover
         # the full production thread topology (stage/virtual/dispatch/serving)
-        elapsed, fresh = replay_pipelined(res, fanout=bool(args.trace))
+        elapsed, fresh = replay_pipelined(
+            res, fanout=bool(args.trace), speculative=False if args.no_spec else None
+        )
     else:
         elapsed, fresh = replay(res)
     sink = fresh.sink()
@@ -112,6 +119,11 @@ def main() -> None:
         "pipeline": bool(args.pipeline),
         "tracing": not args.notrace,
     }
+    if args.pipeline:
+        from kaspa_tpu.pipeline.speculative import SpeculativeVerifier
+
+        out["speculative"] = SpeculativeVerifier.snapshot()
+        out["speculative"]["enabled"] = not args.no_spec
     if args.trace:
         path = flight.dump(args.trace, reason="sim-replay")
         out["trace_path"] = path

@@ -283,7 +283,7 @@ class _NullSink:
 
 
 def replay_pipelined(
-    result: SimResult, workers: int = 2, fanout: bool = False
+    result: SimResult, workers: int = 2, fanout: bool = False, speculative: bool | None = None
 ) -> tuple[float, "Consensus"]:
     """Replay through the concurrent ConsensusPipeline — stage workers,
     virtual worker and (when configured) the coalescing dispatcher all on
@@ -304,7 +304,7 @@ def replay_pipelined(
         sub = broadcaster.register(Subscriber("sim", lambda n: b"\x00", _NullSink()))
         broadcaster.subscribe(sub, "block-added")
         broadcaster.subscribe(sub, "utxos-changed")
-    pipe = ConsensusPipeline(fresh, workers=workers)
+    pipe = ConsensusPipeline(fresh, workers=workers, speculative=speculative)
     t0 = time.perf_counter()
     try:
         futures = [pipe.submit(b) for b in result.blocks]

@@ -57,11 +57,16 @@ class Channel:
             self._not_full.notify()
             return item
 
-    def drain(self) -> list:
-        """Atomically take everything currently queued."""
+    def drain(self, max_items: int | None = None) -> list:
+        """Atomically take everything currently queued (up to ``max_items``)."""
         with self._mu:
-            items = list(self._q)
-            self._q.clear()
+            if max_items is None or max_items >= len(self._q):
+                items = list(self._q)
+                self._q.clear()
+            elif max_items <= 0:
+                return []
+            else:
+                items = [self._q.popleft() for _ in range(max_items)]
             self._not_full.notify_all()
             return items
 

@@ -76,3 +76,50 @@ def test_finality_violating_chain_not_adopted():
 
     with pytest.raises(RpcError):
         svc.resolve_finality_conflict(main_sink)
+
+
+def test_finality_conflict_emitted_once_no_sink_search_wedge():
+    """An active conflict tip must notify exactly once, stay unadopted
+    across every subsequent virtual resolve, and never wedge sink search
+    (each later insert's resolve must still terminate with a valid sink)."""
+    params = _params()
+    c = Consensus(params)
+    miner = Miner(0, random.Random(99))
+    events = []
+    lid = c.notification_root.register(lambda n: events.append(n))
+    c.notification_root.start_notify(lid, "finality-conflict")
+
+    for i in range(40):
+        t = c.build_block_template(miner.miner_data, [], timestamp=1_000 + 600 * i)
+        assert c.validate_and_insert_block(t) in ("utxo_valid", "utxo_pending")
+    main_sink = c.sink()
+
+    # heavier fork from genesis that excludes the finality point
+    fork_tip = params.genesis.hash
+    for i in range(50):
+        blk = c.build_block_with_parents([fork_tip], miner.miner_data, [], timestamp=2_000 + 600 * i)
+        assert c.validate_and_insert_block(blk) in ("utxo_valid", "utxo_pending")
+        fork_tip = blk.hash
+    assert c.storage.ghostdag.get_blue_work(fork_tip) > c.storage.ghostdag.get_blue_work(main_sink)
+
+    def conflicts_for(tip):
+        return [
+            n for n in events
+            if n.event_type == "finality-conflict" and n.data["violating_tip"] == tip.hex()
+        ]
+
+    assert len(conflicts_for(fork_tip)) == 1
+    assert c.sink() == main_sink
+
+    # every further honest insert re-runs _resolve_virtual over the same tip
+    # set; the standing conflict must neither re-notify nor block the search
+    for i in range(6):
+        t = c.build_block_template(miner.miner_data, [], timestamp=40_000 + 600 * i)
+        assert c.validate_and_insert_block(t) in ("utxo_valid", "utxo_pending")
+        sink = c.sink()
+        assert sink != fork_tip
+        assert c.reachability.is_chain_ancestor_of(main_sink, sink)
+    assert len(conflicts_for(fork_tip)) == 1
+
+    # the violating tip must not appear among virtual parents either
+    assert fork_tip not in c.virtual_state.parents

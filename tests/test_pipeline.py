@@ -211,6 +211,46 @@ def test_pipeline_wait_for_idle_and_counters():
         pipe.shutdown()
 
 
+def test_channel_drain_cap():
+    """Channel.drain(max_items) takes at most that many, FIFO, leaving the
+    rest queued — the primitive under the virtual worker's batch bound."""
+    from kaspa_tpu.utils.sync import Channel
+
+    ch = Channel()
+    for i in range(10):
+        ch.send(i)
+    assert ch.drain(3) == [0, 1, 2]
+    assert ch.drain(0) == []
+    assert ch.drain(None) == [3, 4, 5, 6, 7, 8, 9]
+    assert ch.drain(5) == []
+
+
+def test_virtual_batch_cap(monkeypatch):
+    """KASPA_TPU_VIRTUAL_BATCH_MAX bounds blocks absorbed per virtual
+    cycle; a capped pipeline must still absorb every block (the feed stays
+    honest, the batches just get smaller)."""
+    from kaspa_tpu.pipeline.pipeline import _VIRT_BATCH
+
+    monkeypatch.setenv("KASPA_TPU_VIRTUAL_BATCH_MAX", "2")
+    topo = [(str(i), [str(i - 1)] if i > 2 else ["G"]) for i in range(2, 18)]
+    params, blocks, _ = _build_dag(topo)
+    consensus = Consensus(params)
+    count0, max0 = _VIRT_BATCH.count, _VIRT_BATCH.max
+    pipe = ConsensusPipeline(consensus, workers=2)
+    assert pipe._virtual_batch_max == 2
+    try:
+        futures = [pipe.submit(b) for b in blocks]
+        statuses = [f.result(timeout=120) for f in futures]
+    finally:
+        pipe.shutdown()
+    assert statuses[-1] == "utxo_valid"
+    assert consensus.sink() == blocks[-1].hash
+    # the histogram recorded this run's cycles, none above the cap
+    assert _VIRT_BATCH.count > count0
+    if _VIRT_BATCH.max > max0:
+        assert _VIRT_BATCH.max <= 2
+
+
 def test_relay_out_of_order_parks_on_inflight_parent():
     """VERDICT r3 #3 'done' criterion: a relayed child whose parent is
     still IN FLIGHT inside the pipeline must park in the deps manager (not

@@ -120,3 +120,47 @@ def test_point_ladder_vs_oracle():
             assert not bool(inf[i])
             assert bi.limbs_to_int(np.asarray(xa)[i]) == exp[0]
             assert bi.limbs_to_int(np.asarray(ya)[i]) == exp[1]
+
+
+def test_cold_bucket_split(monkeypatch):
+    """A batch whose padded bucket was never compiled must split into
+    sub-dispatches at the largest warm bucket instead of paying the cold
+    jit inline; masks reassemble in order, and the cold shape is never
+    recorded as compiled."""
+    import numpy as np
+
+    from kaspa_tpu.crypto import secp
+
+    calls = []
+
+    def fake_kernel(px, py, rc, d1, d2, ok):
+        calls.append(len(ok))
+        out = np.asarray(ok, dtype=bool).copy()
+        return out
+
+    fake_kernel.__name__ = "fake_kernel"
+    monkeypatch.setattr(secp, "_seen_shapes", {("fake_kernel", 8)})
+    monkeypatch.delenv("KASPA_TPU_COLD_BUCKET_SPLIT", raising=False)
+
+    batch = secp._Batch()
+    for i in range(10):
+        if i == 3:
+            batch.push_invalid()
+        else:
+            batch.push(1, 2, 3, 4, 5)
+    mask = batch.run(fake_kernel)
+    # two warm bucket-8 dispatches, bucket 16 never compiled
+    assert calls == [8, 8]
+    assert ("fake_kernel", 16) not in secp._seen_shapes
+    assert mask.tolist() == [True] * 3 + [False] + [True] * 6
+
+    # disabled: pad up into the cold bucket as before
+    calls.clear()
+    monkeypatch.setenv("KASPA_TPU_COLD_BUCKET_SPLIT", "0")
+    batch2 = secp._Batch()
+    for _ in range(10):
+        batch2.push(1, 2, 3, 4, 5)
+    mask2 = batch2.run(fake_kernel)
+    assert calls == [16]
+    assert ("fake_kernel", 16) in secp._seen_shapes
+    assert mask2.tolist() == [True] * 10

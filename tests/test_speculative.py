@@ -1,0 +1,166 @@
+"""Speculative chain-state precompute: the cache-hit commit path must be
+bit-identical to the synchronous path, hits must actually happen on chain
+extension, and the entry cache must stay consistent across forks/reorgs
+(misses fall back, never diverge)."""
+
+import random
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.consensus.model import ScriptPublicKey
+from kaspa_tpu.pipeline import ConsensusPipeline
+from kaspa_tpu.pipeline.speculative import SpeculativeVerifier
+
+MINER = MinerData(ScriptPublicKey(0, b"\x20" + b"\x07" * 32 + b"\xac"))
+
+
+def _build_chain(n):
+    params = simnet_params()
+    scratch = Consensus(params)
+    blocks = []
+    for _ in range(n):
+        blk = scratch.build_block_template(MINER, [])
+        scratch.validate_and_insert_block(blk)
+        blocks.append(blk)
+    return params, blocks, scratch
+
+
+def _build_forked_dag(total, seed=7):
+    """Poisson sibling waves: forks, merges, reorg-ish shapes — the DAG
+    where speculative entries go stale and misses must fall back cleanly."""
+    rng = random.Random(seed)
+    params = simnet_params()
+    scratch = Consensus(params)
+    tips = [params.genesis.hash]
+    blocks = []
+    while total > 0:
+        v = min(params.max_block_parents, max(1, int(rng.gauss(2.5, 1.5))), total)
+        total -= v
+        new_tips = []
+        for _ in range(v):
+            blk = scratch.build_block_with_parents(list(tips), MINER)
+            blk.header.nonce = rng.getrandbits(48)
+            blk.header.invalidate_cache()
+            scratch.validate_and_insert_block(blk)
+            new_tips.append(blk.hash)
+            blocks.append(blk)
+        tips = new_tips
+    return params, blocks, scratch
+
+
+def _replay(params, blocks, speculative):
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus, workers=3, speculative=speculative)
+    try:
+        futures = [pipe.submit(b) for b in blocks]
+        for f in futures:
+            assert f.result(timeout=120) in ("utxo_valid", "utxo_pending")
+    finally:
+        pipe.shutdown()
+    return consensus
+
+
+def test_speculative_hits_on_chain_extension():
+    """A linear chain is the common case the precompute targets: every
+    chain block should be served from the cache, none recomputed."""
+    params, blocks, scratch = _build_chain(14)
+    before = SpeculativeVerifier.snapshot()
+    consensus = _replay(params, blocks, speculative=True)
+    after = SpeculativeVerifier.snapshot()
+    assert after["hits"] > before["hits"], "no speculative hits on a linear chain"
+    assert consensus.sink() == scratch.sink()
+    sink = consensus.sink()
+    assert consensus.multisets[sink].finalize() == scratch.multisets[sink].finalize()
+    # pipeline detached the verifier at shutdown: serial callers after the
+    # pipeline must not consume stale entries
+    assert consensus.speculative is None
+
+
+def test_speculative_bit_identity_on_forked_dag():
+    """Speculation on vs off over a forky DAG: sink, utxo commitment and
+    per-block consensus data must be bit-identical — hits, misses and
+    fallbacks all converge to the same state."""
+    params, blocks, scratch = _build_forked_dag(40)
+    c_on = _replay(params, blocks, speculative=True)
+    c_off = _replay(params, blocks, speculative=False)
+    assert c_on.sink() == c_off.sink() == scratch.sink()
+    sink = c_on.sink()
+    assert (
+        c_on.multisets[sink].finalize()
+        == c_off.multisets[sink].finalize()
+        == scratch.multisets[sink].finalize()
+    )
+    assert c_on.get_virtual_daa_score() == c_off.get_virtual_daa_score()
+    for blk in blocks:
+        assert c_on.storage.ghostdag.get_blue_work(blk.hash) == c_off.storage.ghostdag.get_blue_work(blk.hash)
+        # every chain-committed block must carry identical acceptance state
+        if c_on.storage.statuses.get(blk.hash) == "utxo_valid" and c_off.storage.statuses.get(blk.hash) == "utxo_valid":
+            assert c_on.multisets[blk.hash].finalize() == c_off.multisets[blk.hash].finalize()
+            assert c_on.acceptance_data.get(blk.hash) == c_off.acceptance_data.get(blk.hash)
+
+
+def test_in_cycle_chain_precompute():
+    """When a resolve finds a pending chain with no stage-time entries
+    (the lock-starvation case), `precompute_chain` must batch the whole
+    segment into one dispatch, publish entries, and the verify loop must
+    commit every block from the cache — bit-identical to the serial
+    build."""
+    from kaspa_tpu.utils.sync import LockCtx
+
+    params, blocks, scratch = _build_chain(8)
+    c = Consensus(params)
+    # the virtual worker's pre-resolve state: headers + bodies committed,
+    # tips updated, no virtual resolution yet — every block pending
+    for b in blocks:
+        c._process_header(b.header)
+        c._process_body(b)
+    for b in blocks:
+        c._update_tips(b.hash)
+    c.speculative = SpeculativeVerifier(c, LockCtx("consensus-commit", rank=10))
+    before = SpeculativeVerifier.snapshot()
+    c._resolve_virtual()
+    after = SpeculativeVerifier.snapshot()
+    assert after["precomputes"] - before["precomputes"] >= len(blocks) - 1
+    assert after["hits"] - before["hits"] >= len(blocks) - 1
+    assert after["misses"] == before["misses"]
+    assert c.sink() == scratch.sink()
+    sink = c.sink()
+    assert c.multisets[sink].finalize() == scratch.multisets[sink].finalize()
+    for b in blocks:
+        assert c.storage.statuses.get(b.hash) == "utxo_valid"
+        assert c.acceptance_data.get(b.hash) == scratch.acceptance_data.get(b.hash)
+
+
+def test_speculative_disabled_env(monkeypatch):
+    """KASPA_TPU_SPECULATIVE=0 disables the verifier at construction."""
+    monkeypatch.setenv("KASPA_TPU_SPECULATIVE", "0")
+    params, blocks, _ = _build_chain(3)
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus, workers=2)
+    try:
+        assert pipe.speculative is None
+        assert consensus.speculative is None
+        for b in blocks:
+            assert pipe.submit(b).result(timeout=60) in ("utxo_valid", "utxo_pending")
+    finally:
+        pipe.shutdown()
+
+
+def test_speculative_cache_bounded():
+    """The entry cache must never grow past MAX_ENTRIES and take() must
+    pop (a consumed entry is gone)."""
+    params, blocks, _ = _build_chain(6)
+    consensus = Consensus(params)
+    pipe = ConsensusPipeline(consensus, workers=2, speculative=True)
+    try:
+        for b in blocks:
+            assert pipe.submit(b).result(timeout=60) in ("utxo_valid", "utxo_pending")
+        spec = pipe.speculative
+        assert len(spec._entries) <= spec.MAX_ENTRIES
+        # chain blocks were all consumed on commit
+        for b in blocks:
+            gd = consensus.storage.ghostdag.get(b.hash)
+            assert (b.hash, gd.selected_parent) not in spec._entries
+    finally:
+        pipe.shutdown()

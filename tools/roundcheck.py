@@ -173,6 +173,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-dispatch", action="store_true", help="skip the coalesced-dispatch throughput lane")
     ap.add_argument("--skip-serving", action="store_true", help="skip the serving-tier dual-encoding + kill -9 lane")
     ap.add_argument("--skip-obs", action="store_true", help="skip the flight-recorder traced-replay lane")
+    ap.add_argument("--skip-tenbps", action="store_true", help="skip the 10-BPS speculative-pipeline lane")
     ap.add_argument("--chaos-blocks", type=int, default=24, help="chaos sustain main-DAG length")
     # long enough that coinbase maturity passes and real signature batches
     # flow through the sharded verify path (a 12-block replay carries 0 txs)
@@ -378,6 +379,36 @@ def main(argv: list[str] | None = None) -> int:
             and sect["overhead"]["ok"]
         )
         evidence["sections"]["obs"] = sect
+        ok &= sect["ok"]
+
+    if not args.skip_tenbps:
+        # 10-BPS lane (ROADMAP item 2): a pipelined replay of a 10-BPS DAG
+        # with the chaos schedule off, speculation on — records the
+        # realtime_factor and the speculative hit-rate — gated on the
+        # speculation-disabled replay of the same DAG reaching the
+        # bit-identical sink + utxo_commitment (the hit path must be
+        # indistinguishable from the honest path)
+        tenbps_cmd = [
+            sys.executable, "-m", "kaspa_tpu.sim",
+            "--bps", "10", "--blocks", "24", "--tpb", "4", "--pipeline", "--json",
+        ]
+        sect = _run(tenbps_cmd, 600.0, {"JAX_PLATFORMS": "cpu"})
+        spec_on = _last_json_line(sect)
+        off = _run(tenbps_cmd + ["--no-spec"], 600.0, {"JAX_PLATFORMS": "cpu"})
+        spec_off = _last_json_line(off)
+        identical = bool(
+            spec_on and spec_off
+            and spec_on["sink"] == spec_off["sink"]
+            and spec_on["utxo_commitment"] == spec_off["utxo_commitment"]
+        )
+        sect["result"] = spec_on
+        sect["no_spec_result"] = spec_off
+        sect["identical_to_no_spec"] = identical
+        if spec_on:
+            sect["realtime_factor"] = spec_on.get("realtime_factor")
+            sect["speculative"] = spec_on.get("speculative")
+        sect["ok"] = sect["rc"] == 0 and off["rc"] == 0 and identical
+        evidence["sections"]["tenbps"] = sect
         ok &= sect["ok"]
 
     if not args.skip_chaos:
