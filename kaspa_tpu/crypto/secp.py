@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -23,7 +24,9 @@ from kaspa_tpu.observability.core import PERCENT_BUCKETS, REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.ops import bigint as bi
 from kaspa_tpu.ops.secp256k1 import points as pt
 from kaspa_tpu.ops.secp256k1.verify import ecdsa_verify, schnorr_verify
-from kaspa_tpu.resilience.breaker import device_breaker
+from kaspa_tpu.resilience import supervisor
+from kaspa_tpu.resilience.breaker import HUNG, device_breaker
+from kaspa_tpu.resilience.faults import FAULTS
 
 # batch shape telemetry: occupancy is the fraction of padded device lanes
 # doing useful work, the quantity batch-verify throughput is dominated by
@@ -42,6 +45,10 @@ _COLD_SPLITS = REGISTRY.counter_family(
     help="batches split into warm-bucket sub-dispatches to dodge a cold jit compile",
 )
 _seen_shapes: set = set()
+
+# thread-local escape hatch: pretrace_bucket() deliberately compiles a
+# cold bucket, so it must bypass the warm-bucket split
+_force_tls = threading.local()
 
 
 def _cold_split_enabled() -> bool:
@@ -135,7 +142,7 @@ class _Batch:
         b = _bucket(n)
         shape_key = (kernel.__name__, b)
         new_shape = shape_key not in _seen_shapes
-        if new_shape and _cold_split_enabled():
+        if new_shape and _cold_split_enabled() and not getattr(_force_tls, "on", False):
             warm = max(
                 (bk for k, bk in _seen_shapes if k == kernel.__name__ and bk < b),
                 default=None,
@@ -164,8 +171,17 @@ class _Batch:
             # first dispatch of a (kernel, bucket) shape pays the XLA
             # trace+compile; surfacing it as a span is what lets a wedge
             # dossier / flight trace say *where* a probe stalled
-            with trace.span("secp.jit_compile", kernel=kernel.__name__, bucket=b):
-                mask = kernel(*args)
+            try:
+                with trace.span("secp.jit_compile", kernel=kernel.__name__, bucket=b):
+                    FAULTS.fire("device.jit_compile")
+                    mask = kernel(*args)
+            except BaseException:
+                # a compile that failed (or was abandoned by the watchdog)
+                # must not leave the shape marked warm — the next dispatch
+                # would skip the split and pay a surprise compile wall
+                _seen_shapes.discard(shape_key)
+                raise
+            supervisor.note_shape(kernel.__name__, b)
         else:
             mask = kernel(*args)
         return np.asarray(mask)[:n]
@@ -191,14 +207,22 @@ class _Batch:
         return out
 
 
-def _run_guarded(batch: _Batch, kernel, items: list, host_verify) -> np.ndarray:
-    """Dispatch through the device circuit breaker.
+def _dispatch_tier(kernel, n: int) -> str:
+    """Watchdog tier: a never-seen (kernel, bucket) shape legitimately
+    pays an XLA compile, so it gets the long deadline."""
+    return "dispatch" if (kernel.__name__, _bucket(n)) in _seen_shapes else "compile"
 
-    CLOSED/probing: the device runs the batch; any dispatch exception
-    (wedged chip, XLA error, injected fault) counts toward a trip and the
-    batch reroutes.  OPEN: the host degraded lane verifies each raw triple
-    with the eclib oracle — same acceptance decisions, host throughput —
-    until a backoff-spaced probe succeeds and the breaker re-arms.
+
+def _run_guarded(batch: _Batch, kernel, items: list, host_verify) -> np.ndarray:
+    """Dispatch through the watchdog and the device circuit breaker.
+
+    CLOSED/probing: the device runs the batch on a supervised worker
+    thread; a dispatch exception (wedged chip, XLA error, injected fault)
+    counts toward a trip, while a watchdog deadline trips immediately
+    with cause ``hung`` and the batch — never lost, never double-resolved
+    — requeues below.  OPEN: the host degraded lane verifies each raw
+    triple with the eclib oracle — same acceptance decisions, host
+    throughput — until a canary probe succeeds and the breaker re-arms.
     """
     n = len(batch.ok)
     if n == 0:
@@ -206,7 +230,15 @@ def _run_guarded(batch: _Batch, kernel, items: list, host_verify) -> np.ndarray:
     br = device_breaker()
     if br.allow():
         try:
-            mask = batch.run(kernel)
+            mask = supervisor.run_supervised(
+                lambda: batch.run(kernel),
+                tier=_dispatch_tier(kernel, n),
+                kernel=kernel.__name__,
+                jobs=n,
+            )
+        except supervisor.DeviceHangError:
+            br.record_failure(cause=HUNG)
+            supervisor.note_requeue(n)
         except Exception:  # noqa: BLE001 - device boundary: any failure trips
             br.record_failure()
         else:
@@ -222,13 +254,7 @@ def _run_guarded(batch: _Batch, kernel, items: list, host_verify) -> np.ndarray:
     return mask
 
 
-def schnorr_verify_batch(items) -> np.ndarray:
-    """items: iterable of (pubkey32, msg32, sig64) -> bool mask.
-
-    Encoding/range checks and lift_x run on host (failures short-circuit to
-    False without occupying useful device lanes beyond padding).
-    """
-    items = list(items)
+def _build_schnorr_batch(items: list) -> _Batch:
     batch = _Batch()
     for pub, msg, sig in items:
         # BIP340 allows arbitrary-length messages (matching eclib oracle);
@@ -244,7 +270,17 @@ def schnorr_verify_batch(items) -> np.ndarray:
             continue
         e = schnorr_challenge(sig[:32], pub, msg)
         batch.push(pk[0], pk[1], r, s, e)
-    return _run_guarded(batch, schnorr_verify, items, eclib.schnorr_verify)
+    return batch
+
+
+def schnorr_verify_batch(items) -> np.ndarray:
+    """items: iterable of (pubkey32, msg32, sig64) -> bool mask.
+
+    Encoding/range checks and lift_x run on host (failures short-circuit to
+    False without occupying useful device lanes beyond padding).
+    """
+    items = list(items)
+    return _run_guarded(_build_schnorr_batch(items), schnorr_verify, items, eclib.schnorr_verify)
 
 
 def ecdsa_verify_batch(items) -> np.ndarray:
@@ -268,3 +304,79 @@ def ecdsa_verify_batch(items) -> np.ndarray:
         u2 = r * si % eclib.N
         batch.push(pk[0], pk[1], r, u1, u2)
     return _run_guarded(batch, ecdsa_verify, items, eclib.ecdsa_verify)
+
+
+# --- supervision hooks ----------------------------------------------------
+
+_CANARY_SECKEY = int.from_bytes(hashlib.sha256(b"kaspa-tpu canary").digest(), "big") % eclib.N or 1
+
+
+def _canary_items(count: int = 2) -> list:
+    """Tiny known-answer workload (fixed key, distinct messages): every
+    signature is valid, so a canary dispatch must return an all-True mask."""
+    pub = eclib.schnorr_pubkey(_CANARY_SECKEY)
+    out = []
+    for i in range(count):
+        msg = hashlib.sha256(b"canary-msg-%d" % i).digest()
+        out.append((pub, msg, eclib.schnorr_sign(msg, _CANARY_SECKEY)))
+    return out
+
+
+def canary_probe() -> bool:
+    """One supervised device dispatch of the known-answer batch — the
+    prober's HALF_OPEN probe.  Bypasses the breaker gate (the prober holds
+    the probe slot) and runs with fault injection suppressed so drills
+    keep their requeued==injected accounting.  True iff the device
+    answered correctly within the watchdog deadline."""
+    from kaspa_tpu.resilience import faults as faults_mod
+
+    items = _canary_items()
+    batch = _build_schnorr_batch(items)
+
+    def _dispatch():
+        with faults_mod.suppress():
+            return batch.run(schnorr_verify)
+
+    mask = supervisor.run_supervised(
+        _dispatch,
+        tier=_dispatch_tier(schnorr_verify, len(items)),
+        kernel="schnorr_verify",
+        jobs=len(items),
+    )
+    return bool(np.asarray(mask).all())
+
+
+_PRETRACE_KERNELS = {"schnorr_verify": schnorr_verify, "ecdsa_verify": ecdsa_verify}
+
+
+def pretrace_bucket(kernel_name: str, bucket: int) -> str:
+    """Compile one (kernel, bucket) shape ahead of traffic (warm-manifest
+    restart path).  Dispatches an all-invalid batch of exactly ``bucket``
+    jobs with the warm-split bypassed so the target shape itself compiles;
+    runs under the watchdog's compile tier.  Returns "warm" (already
+    compiled this process), "traced", or "error:...".
+    """
+    kernel = _PRETRACE_KERNELS.get(kernel_name)
+    if kernel is None or bucket < 8:
+        return f"error:unknown {kernel_name}/{bucket}"
+    if (kernel_name, bucket) in _seen_shapes:
+        return "warm"
+    batch = _Batch()
+    for _ in range(bucket):
+        batch.push_invalid()
+
+    def _dispatch():
+        from kaspa_tpu.resilience import faults as faults_mod
+
+        _force_tls.on = True
+        try:
+            with faults_mod.suppress():
+                return batch.run(kernel)
+        finally:
+            _force_tls.on = False
+
+    try:
+        supervisor.run_supervised(_dispatch, tier="compile", kernel=kernel_name, jobs=bucket)
+    except Exception as e:  # noqa: BLE001 - pretrace is best-effort
+        return f"error:{type(e).__name__}"
+    return "traced"

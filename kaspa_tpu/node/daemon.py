@@ -877,6 +877,13 @@ class Daemon:
             self.stratum_server = None
 
     def start(self) -> str:
+        # device supervision up before any traffic: managed breaker, canary
+        # prober, and (on warm non-CPU backends) the background pretrace of
+        # manifest shapes — off the commit lock, the restart-warmth path
+        from kaspa_tpu.resilience import supervisor
+
+        supervisor.install()
+        self._supervised = True
         self.core.start()
         seeds = getattr(self.args, "dnsseed", []) or []
         if seeds:
@@ -928,8 +935,18 @@ class Daemon:
         # same barrier for the async coalescing queue: flush staged verify
         # chunks and block until every callback has resolved — tickets
         # resolving after the db handle closes would write sig-cache entries
-        # for a consensus object that is already torn down
-        verify_dispatch.drain(timeout=10.0)
+        # for a consensus object that is already torn down.  shutdown()
+        # (vs drain) bounds the wait: if the dispatcher thread is wedged
+        # inside a hung device call, remaining tickets fail with
+        # DispatchAbandoned instead of blocking process exit
+        verify_dispatch.shutdown(timeout=10.0)
+        from kaspa_tpu.resilience import supervisor
+
+        with self._dispatch_lock:
+            # stop() may race itself; release the supervision ref once
+            was_supervised, self._supervised = getattr(self, "_supervised", False), False
+        if was_supervised:
+            supervisor.shutdown()
         # serving tier down before the stores: the broadcaster detaches from
         # the notifier (no new fanout), then the index unhooks its listener
         # and closes its own db — both idempotent, stop() may race itself

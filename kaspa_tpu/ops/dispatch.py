@@ -80,17 +80,43 @@ _COALESCED_JOBS = REGISTRY.counter_family(
 )
 
 
+class DispatchTimeout(TimeoutError):
+    """A ticket wait expired.  Carries the chunk's identity (kind, job
+    count, super_id once assigned) and the supervision verdict, so the
+    error names the wedged super-batch instead of an opaque timeout."""
+
+    def __init__(self, kind: str, jobs: int, super_id: int | None, waited_s: float, verdict: dict):
+        sup = f"super_id={super_id}" if super_id is not None else "not yet super-batched"
+        super().__init__(
+            f"verify dispatch ticket timed out after {waited_s:g}s "
+            f"(kind={kind}, jobs={jobs}, {sup}; supervisor: {verdict})"
+        )
+        self.kind = kind
+        self.jobs = jobs
+        self.super_id = super_id
+        self.waited_s = waited_s
+        self.verdict = verdict
+
+
+class DispatchAbandoned(RuntimeError):
+    """The dispatcher was abandoned (hung device thread at shutdown)
+    before this chunk resolved; the caller must treat it as unverified."""
+
+
 class Ticket:
     """Per-chunk completion handle: resolves to the [n] bool validity mask
     for exactly the items submitted (super-batch slicing is internal)."""
 
-    __slots__ = ("_engine", "_event", "_mask", "_error")
+    __slots__ = ("_engine", "_event", "_mask", "_error", "kind", "jobs", "super_id")
 
-    def __init__(self, engine: "CoalescingDispatcher | None"):
+    def __init__(self, engine: "CoalescingDispatcher | None", kind: str = "", jobs: int = 0):
         self._engine = engine
         self._event = threading.Event()
         self._mask: np.ndarray | None = None
         self._error: Exception | None = None
+        self.kind = kind
+        self.jobs = jobs
+        self.super_id: int | None = None  # stamped when the super-batch forms
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -101,13 +127,19 @@ class Ticket:
         if not self._event.is_set():
             if self._engine is not None:
                 self._engine.nudge()
-            if not self._event.wait(timeout if timeout is not None else _WAIT_CAP_S):
-                raise TimeoutError("verify dispatch ticket timed out")
+            waited = timeout if timeout is not None else _WAIT_CAP_S
+            if not self._event.wait(waited):
+                from kaspa_tpu.resilience import supervisor  # deferred: import DAG
+
+                raise DispatchTimeout(self.kind, self.jobs, self.super_id, waited, supervisor.verdict())
         if self._error is not None:
             raise self._error
         return self._mask
 
     def _resolve(self, mask: np.ndarray | None, error: Exception | None) -> None:
+        if self._event.is_set():
+            return  # first resolution wins (late results from an abandoned
+            # dispatcher thread are discarded, never merged)
         self._mask = mask
         self._error = error
         self._event.set()
@@ -123,6 +155,7 @@ class _Chunk:
     # the one device span back into each submitting block's trace
     ctx: object = None
     enqueued_ns: int = 0
+    resolved: bool = False  # guarded by the engine lock: first finish wins
 
 
 class CoalescingDispatcher:
@@ -135,9 +168,11 @@ class CoalescingDispatcher:
         self._wake = threading.Condition(self._lock)
         self._idle = threading.Condition(self._lock)
         self._pending: list[_Chunk] = []  # staging buffer (swapped at flush)
+        self._inflight: list[_Chunk] = []  # swapped out, not yet resolved
         self._urgent = False
         self._unresolved = 0  # chunks submitted but not yet resolved
         self._closed = False
+        self._abandoned = False
         self._thread: threading.Thread | None = None
 
     # -- producer side ------------------------------------------------------
@@ -145,7 +180,7 @@ class CoalescingDispatcher:
     def submit(self, kind: str, items: list) -> Ticket:
         """Queue one chunk of (pubkey, msg, sig) triples; the caller must
         not mutate `items` afterwards (donated to the dispatcher)."""
-        ticket = Ticket(self)
+        ticket = Ticket(self, kind, len(items))
         if not items:
             ticket._resolve(np.zeros(0, dtype=bool), None)
             return ticket
@@ -185,13 +220,39 @@ class CoalescingDispatcher:
                 self._idle.wait(remaining)
         return True
 
-    def close(self, timeout: float = 10.0) -> bool:
-        """Drain, then stop accepting work and retire the thread."""
+    def close(self, timeout: float = 10.0, abandon: bool = True) -> bool:
+        """Drain, then stop accepting work and retire the thread.
+
+        When the drain times out — the dispatcher thread is wedged inside
+        a device call — ``abandon=True`` (the default) bounds shutdown:
+        every unresolved ticket is failed with DispatchAbandoned and the
+        hung thread is left behind as a daemon, so daemon exit never
+        blocks on a dead device."""
         drained = self.drain(timeout)
+        if not drained and abandon:
+            self.abandon("close timeout: device thread hung")
+            return False
         with self._lock:
             self._closed = True
             self._wake.notify()
         return drained
+
+    def abandon(self, reason: str) -> int:
+        """Fail every unresolved chunk (queued or in flight) with
+        DispatchAbandoned and stop accepting work; returns the number of
+        chunks abandoned.  The wedged dispatcher thread is not joined —
+        any result it later produces hits resolved chunks and is
+        discarded."""
+        err = DispatchAbandoned(f"verify dispatcher abandoned: {reason}")
+        with self._lock:
+            self._closed = True
+            self._abandoned = True
+            victims = [c for c in self._pending + self._inflight if not c.resolved]
+            self._pending = []
+            self._wake.notify_all()
+        for c in victims:
+            self._finish(c, None, err)
+        return len(victims)
 
     def stats(self) -> dict:
         with self._lock:
@@ -199,7 +260,9 @@ class CoalescingDispatcher:
                 "target": self.target,
                 "max_age_ms": round(self.max_age_s * 1000, 3),
                 "pending_chunks": len(self._pending),
+                "inflight_chunks": len(self._inflight),
                 "unresolved_chunks": self._unresolved,
+                "abandoned": self._abandoned,
             }
 
     # -- dispatcher thread ---------------------------------------------------
@@ -224,6 +287,8 @@ class CoalescingDispatcher:
         while True:
             with self._lock:
                 while True:
+                    if self._abandoned:
+                        return
                     now = time.monotonic()
                     if not self._pending:
                         # a stale nudge with nothing queued must not force
@@ -244,6 +309,7 @@ class CoalescingDispatcher:
                 # double-buffer swap: donate the staged chunks to this flush
                 # cycle; producers refill a fresh buffer while XLA runs below
                 taken, self._pending = self._pending, []
+                self._inflight.extend(taken)
                 self._urgent = False
             self._dispatch(taken, reason)
 
@@ -271,6 +337,9 @@ class CoalescingDispatcher:
         _COALESCE_DEPTH.observe(len(batch))
         _SUPER_BATCH.observe(jobs)
         _QUEUE_AGE.observe(now - min(c.enqueued_at for c in batch))
+        sid = next(_super_ids)
+        for c in batch:
+            c.ticket.super_id = sid  # a timeout now names the super-batch
         items = [it for c in batch for it in c.items]
         try:
             fn = secp.schnorr_verify_batch if kind == "schnorr" else secp.ecdsa_verify_batch
@@ -280,22 +349,21 @@ class CoalescingDispatcher:
             t1 = perf_counter_ns()
         except Exception as e:  # noqa: BLE001 - surfaced on every waiting ticket
             t1 = perf_counter_ns()
-            self._fan_back(kind, batch, jobs, t1, t1, error=type(e).__name__)
+            self._fan_back(kind, batch, jobs, sid, t1, t1, error=type(e).__name__)
             for c in batch:
                 self._finish(c, None, e)
             return
-        self._fan_back(kind, batch, jobs, t0, t1)
+        self._fan_back(kind, batch, jobs, sid, t0, t1)
         pos = 0
         for c in batch:
             self._finish(c, mask[pos : pos + len(c.items)], None)
             pos += len(c.items)
 
-    def _fan_back(self, kind: str, batch: list[_Chunk], jobs: int, t0: int, t1: int, **extra) -> None:
+    def _fan_back(self, kind: str, batch: list[_Chunk], jobs: int, sid: int, t0: int, t1: int, **extra) -> None:
         """Fan the single device dispatch back into each submitting block's
         trace: a retroactive ``wait.dispatch`` (enqueue -> kernel start)
         plus a ``dispatch.device`` child covering the device interval,
         stamped with a shared super_id so Perfetto can correlate them."""
-        sid = next(_super_ids)
         for c in batch:
             if c.ctx is None:
                 continue
@@ -306,12 +374,22 @@ class CoalescingDispatcher:
                 chunks=len(batch), super_id=sid, **extra,
             )
 
-    def _finish(self, chunk: _Chunk, mask, error) -> None:
-        chunk.ticket._resolve(mask, error)
+    def _finish(self, chunk: _Chunk, mask, error) -> bool:
+        """Resolve one chunk exactly once; False = already resolved (a
+        late result from an abandoned dispatcher thread, discarded)."""
         with self._lock:
+            if chunk.resolved:
+                return False
+            chunk.resolved = True
+            try:
+                self._inflight.remove(chunk)
+            except ValueError:
+                pass  # abandoned straight from the staging buffer
             self._unresolved -= 1
             if self._unresolved == 0:
                 self._idle.notify_all()
+        chunk.ticket._resolve(mask, error)
+        return True
 
 
 # --- process-wide configuration (mirrors ops/mesh.py) -----------------------
@@ -381,6 +459,16 @@ def drain(timeout: float = 10.0) -> bool:
     No-op True when coalescing is disabled."""
     eng = _engine
     return eng.drain(timeout) if eng is not None else True
+
+
+def shutdown(timeout: float = 10.0) -> bool:
+    """Daemon-stop barrier: drain and retire the engine, abandoning it if
+    the device thread is hung so process exit stays bounded.  True = clean
+    drain; False = tickets were failed with DispatchAbandoned."""
+    global _engine
+    with _cfg_lock:
+        eng, _engine = _engine, None
+    return eng.close(timeout, abandon=True) if eng is not None else True
 
 
 def _dispatch_state() -> dict:

@@ -75,6 +75,10 @@ def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarra
     # raise/wedge/slow the whole batch here — above every backend path, so
     # the breaker in crypto/secp.py sees the failure whichever way it routes
     FAULTS.fire("device.verify")
+    # separate point for supervised-hang drills: mode "hang" sleeps past the
+    # watchdog deadline then completes, "wedge" sleeps then dies — either
+    # way the batch must already have been requeued on the host lane
+    FAULTS.fire("device.hang")
     from kaspa_tpu.ops import mesh
 
     n_mesh = mesh.active_size()
@@ -101,6 +105,7 @@ def schnorr_verify(px, py, r_canon, s_scalars, e_scalars, valid_in) -> np.ndarra
 def ecdsa_verify(px, py, r_n_canon, u1_scalars, u2_scalars, valid_in) -> np.ndarray:
     """Backend-dispatching batched ECDSA verify (see schnorr_verify)."""
     FAULTS.fire("device.verify")
+    FAULTS.fire("device.hang")
     from kaspa_tpu.ops import mesh
 
     n_mesh = mesh.active_size()

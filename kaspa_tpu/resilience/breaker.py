@@ -19,6 +19,17 @@ determined; only the number of denied dispatches while OPEN depends on
 wall clock.  Transition records therefore carry the attempt index (the
 deterministic coordinate) and land in SUSTAIN.json's breaker section
 alongside the wall-clock recovery latencies.
+
+Supervision (``resilience/supervisor.py``) adds two refinements:
+
+* failures carry a *cause* — ``HUNG`` (a watchdog deadline, not an
+  error) trips immediately from CLOSED, because one wedged dispatch
+  already proves the device lane is stuck; waiting for two more hangs
+  would cost two more full deadlines of stall.
+* *managed* mode: live dispatches (``allow()``) while OPEN always take
+  the degraded lane — only the canary prober's ``allow(probe=True)``
+  transitions to HALF_OPEN, so a half-open probe is never a live
+  super-batch racing a possibly-wedged device.
 """
 
 from __future__ import annotations
@@ -30,6 +41,8 @@ import time
 from kaspa_tpu.observability.core import REGISTRY
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+HUNG = "hung"  # failure cause: watchdog deadline, not a device error
 
 _TRIPS = REGISTRY.counter_family("breaker_trips", "breaker", help="breaker transitions into OPEN")
 _PROBES = REGISTRY.counter_family("breaker_probes", "breaker", help="half-open probe dispatches")
@@ -56,6 +69,9 @@ class CircuitBreaker:
         self.backoff_max = backoff_max
         self._clock = clock
         self._lock = threading.Lock()
+        # wiring that survives reset(): supervision attaches once per process
+        self._managed = False
+        self._trip_listeners: list = []
         self.reset()
 
     def reset(self) -> None:
@@ -69,27 +85,54 @@ class CircuitBreaker:
             self.recoveries = 0
             self.recovery_latencies: list[float] = []
             self.transitions: list[dict] = []
+            self.last_trip_cause: str | None = None
             self._backoff_exp = 0
             self._reopen_at = 0.0
             self._tripped_at = 0.0
 
+    # --- supervision wiring -----------------------------------------------
+
+    def set_managed(self, flag: bool) -> None:
+        """Managed = HALF_OPEN probes come only from ``allow(probe=True)``
+        (the canary prober); live dispatches stay degraded while OPEN."""
+        with self._lock:
+            self._managed = bool(flag)
+
+    def add_trip_listener(self, fn) -> None:
+        """Call ``fn()`` (no args, must not block) on every OPEN transition."""
+        with self._lock:
+            if fn not in self._trip_listeners:
+                self._trip_listeners.append(fn)
+
+    def reopen_due(self) -> bool:
+        """True when OPEN and the backoff window has elapsed."""
+        with self._lock:
+            return self.state == OPEN and self._clock() >= self._reopen_at
+
     # --- the dispatch gate ------------------------------------------------
 
-    def allow(self) -> bool:
+    def allow(self, probe: bool = False) -> bool:
         """True = dispatch to the device (counts as an attempt); False =
-        take the degraded lane."""
+        take the degraded lane.  ``probe=True`` marks the caller as the
+        canary prober — in managed mode the only path to HALF_OPEN."""
         with self._lock:
             if self.state == CLOSED:
+                if probe:
+                    return False  # nothing to probe
                 self.attempts += 1
                 return True
-            if self.state == OPEN and self._clock() >= self._reopen_at:
+            if (
+                self.state == OPEN
+                and self._clock() >= self._reopen_at
+                and (probe or not self._managed)
+            ):
                 self._transition(HALF_OPEN)
                 self.probes += 1
                 _PROBES.inc(self.name)
                 self.attempts += 1
                 return True
-            # OPEN inside the backoff window, or a HALF_OPEN probe already
-            # in flight on another thread
+            # OPEN inside the backoff window, OPEN-managed awaiting the
+            # canary, or a HALF_OPEN probe already in flight elsewhere
             self.denied += 1
             return False
 
@@ -105,26 +148,34 @@ class CircuitBreaker:
                 self._backoff_exp = 0
                 self._transition(CLOSED)
 
-    def record_failure(self) -> None:
+    def record_failure(self, cause: str | None = None) -> None:
+        """``cause=HUNG`` (a watchdog deadline) trips immediately from
+        CLOSED: one proven hang already cost a full deadline of stall."""
         with self._lock:
             self.consecutive_failures += 1
             if self.state == HALF_OPEN:
                 # failed probe: back off harder before the next one
                 self._backoff_exp += 1
-                self._open()
-            elif self.state == CLOSED and self.consecutive_failures >= self.failure_threshold:
+                self._open(cause)
+            elif self.state == CLOSED and (
+                cause == HUNG or self.consecutive_failures >= self.failure_threshold
+            ):
                 self.trips += 1
                 _TRIPS.inc(self.name)
                 self._tripped_at = self._clock()
-                self._open()
+                self.last_trip_cause = cause or "error"
+                self._open(cause)
 
-    def _open(self) -> None:
+    def _open(self, cause: str | None = None) -> None:
         delay = min(self.backoff_base * (2.0**self._backoff_exp), self.backoff_max)
         self._reopen_at = self._clock() + delay
-        self._transition(OPEN)
+        self._transition(OPEN, cause)
 
-    def _transition(self, to: str) -> None:
-        self.transitions.append({"attempt": self.attempts, "from": self.state, "to": to})
+    def _transition(self, to: str, cause: str | None = None) -> None:
+        rec = {"attempt": self.attempts, "from": self.state, "to": to}
+        if cause is not None:
+            rec["cause"] = cause
+        self.transitions.append(rec)
         del self.transitions[:-_MAX_TRANSITIONS]
         self.state = to
         if to == OPEN:
@@ -134,6 +185,8 @@ class CircuitBreaker:
             from kaspa_tpu.observability import flight
 
             flight.on_breaker_open(self.name)
+            for fn in self._trip_listeners:
+                fn()
 
     # --- reporting --------------------------------------------------------
 
@@ -141,6 +194,8 @@ class CircuitBreaker:
         with self._lock:
             return {
                 "state": self.state,
+                "managed": self._managed,
+                "last_trip_cause": self.last_trip_cause,
                 "consecutive_failures": self.consecutive_failures,
                 "attempts": self.attempts,
                 "denied": self.denied,

@@ -3,6 +3,10 @@
 Fault points are named strings compiled into the hot layers:
 
     device.verify        batch signature dispatch (ops/secp256k1/verify.py)
+    device.hang          same site, for mode "hang"/"wedge" dispatch hangs
+                         observed by the supervision watchdog
+    device.jit_compile   first-compile of a (kernel, bucket) shape
+                         (crypto/secp.py cold-bucket path)
     device.mesh.dispatch sharded shard_map dispatch (ops/mesh.py)
     vm.fallback.exec     one deferred VM fallback job (txscript/batch.py)
     p2p.send             outgoing frame (p2p/transport.py)
@@ -32,6 +36,10 @@ Modes:
                test harness)
     slow       sleep ``delay`` (default 0.02s), then continue normally
     stall      alias of slow (peer-stall reads)
+    hang       sleep ``delay`` (default 0.05s), then continue — a dispatch
+               that completes *after* its supervisor already gave up on
+               it: the late result must be discarded, the batch must have
+               been requeued exactly once (the wedge-drill invariant)
     corrupt / truncate / drop / disconnect / partial
                cooperative: ``fire`` returns a FaultAction the call site
                applies (flip frame bytes, cut a frame short, drop it,
@@ -50,12 +58,32 @@ import os
 import random
 import threading
 import time
+from contextlib import contextmanager
 
 from kaspa_tpu.observability.core import REGISTRY
 
 _INJECTIONS = REGISTRY.counter_family("fault_injections", "point", help="fired fault injections by point")
 
-_SLEEP_DEFAULTS = {"wedge": 0.05, "slow": 0.02, "stall": 0.02}
+_SLEEP_DEFAULTS = {"wedge": 0.05, "slow": 0.02, "stall": 0.02, "hang": 0.05}
+
+_suppress_tls = threading.local()
+
+
+@contextmanager
+def suppress():
+    """Disable fault injection on the current thread (canary probes and
+    warm pretraces must not fire faults *or* advance hit counters — the
+    drill's requeued==injected accounting depends on it)."""
+    prev = getattr(_suppress_tls, "on", False)
+    _suppress_tls.on = True
+    try:
+        yield
+    finally:
+        _suppress_tls.on = prev
+
+
+def is_suppressed() -> bool:
+    return getattr(_suppress_tls, "on", False)
 
 
 class FaultInjected(Exception):
@@ -139,7 +167,7 @@ class FaultRegistry:
         sleeps and returns None for slow/stall; returns a FaultAction for
         cooperative modes.
         """
-        if not self._armed:
+        if not self._armed or is_suppressed():
             return None
         with self._lock:
             spec = self._schedule.get(point)
@@ -159,7 +187,7 @@ class FaultRegistry:
         if mode == "wedge":
             time.sleep(delay)
             raise FaultWedged(point, hit, mode)
-        if mode in ("slow", "stall"):
+        if mode in ("slow", "stall", "hang"):
             time.sleep(delay)
             return None
         return FaultAction(point, hit, mode, delay, self._seed)

@@ -29,9 +29,12 @@ import random
 import time
 from dataclasses import asdict, replace
 
+import os
+
 from kaspa_tpu.consensus.consensus import Consensus
 from kaspa_tpu.observability.core import REGISTRY
-from kaspa_tpu.resilience.breaker import device_breaker
+from kaspa_tpu.resilience import supervisor
+from kaspa_tpu.resilience.breaker import CLOSED, device_breaker
 from kaspa_tpu.resilience.faults import FAULTS
 from kaspa_tpu.sim.simulator import SimConfig, simulate
 from kaspa_tpu.utils.sync import lock_trace_snapshot, set_lock_debug
@@ -182,6 +185,232 @@ def run_sustain(
             **{name: _delta(before, after, name) for name in _DELTA_COUNTERS},
         },
         "lock_traces": lock_trace_snapshot(),
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return report
+
+
+# --- the wedge drill ------------------------------------------------------
+
+
+def _await_recovery(breaker, timeout_s: float) -> bool:
+    """Poll until the canary prober re-arms the breaker (CLOSED)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if breaker.state == CLOSED:
+            return True
+        time.sleep(0.02)
+    return breaker.state == CLOSED
+
+
+def _await_late_results(expected: int, before: int, timeout_s: float) -> int:
+    """Wait for abandoned workers to finish and discard their results, so
+    the accounting in the report is complete (best-effort: a wedged real
+    device might never finish — the drill's fakes always do)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        seen = supervisor._POOL.snapshot()["late_results"] - before
+        if seen >= expected:
+            return seen
+        time.sleep(0.05)
+    return supervisor._POOL.snapshot()["late_results"] - before
+
+
+def _compile_stall_drill(seed: int, stall_delay_s: float, compile_deadline_s: float) -> dict:
+    """Micro-phase for the compile tier: wedge the first compile of a
+    genuinely cold (schnorr, bucket) shape and assert the watchdog
+    requeues it onto the host lane with the shape left cold.
+
+    The injected wedge raises *before* the kernel call, so no real XLA
+    compile runs — the phase costs ~compile_deadline_s, not minutes."""
+    from kaspa_tpu.crypto import eclib, secp
+
+    bucket = 8
+    while ("schnorr_verify", bucket) in secp._seen_shapes:
+        bucket <<= 1
+    count = bucket // 2 + 1  # pads to exactly `bucket`
+    seckey = (seed * 2 + 1) % eclib.N or 1
+    pub = eclib.schnorr_pubkey(seckey)
+    items = []
+    for i in range(count):
+        msg = bytes([i & 0xFF]) * 32
+        items.append((pub, msg, eclib.schnorr_sign(msg, seckey)))
+
+    prev_split = os.environ.get("KASPA_TPU_COLD_BUCKET_SPLIT")
+    os.environ["KASPA_TPU_COLD_BUCKET_SPLIT"] = "0"  # hit the cold shape head-on
+    FAULTS.configure({"device.jit_compile": {"mode": "wedge", "delay": stall_delay_s, "hits": [1]}}, seed)
+    try:
+        with supervisor.deadline_overrides(compile_s=compile_deadline_s):
+            mask = secp.schnorr_verify_batch(items)
+        events = FAULTS.events()
+    finally:
+        FAULTS.clear()
+        if prev_split is None:
+            os.environ.pop("KASPA_TPU_COLD_BUCKET_SPLIT", None)
+        else:
+            os.environ["KASPA_TPU_COLD_BUCKET_SPLIT"] = prev_split
+    # the abandoned worker un-marks the shape when its wedge finally fires
+    # (after stall_delay_s, well past our deadline) — wait for it so the
+    # cold-shape assertion doesn't race the cleanup
+    deadline = time.monotonic() + stall_delay_s + 5.0
+    while ("schnorr_verify", bucket) in secp._seen_shapes and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return {
+        "bucket": bucket,
+        "jobs": count,
+        "injected": len(events),
+        "events": events,
+        "all_valid": bool(mask.all()) and len(mask) == count,
+        "shape_left_cold": ("schnorr_verify", bucket) not in secp._seen_shapes,
+    }
+
+
+def run_wedge_drill(
+    cfg: SimConfig,
+    seed: int = 0,
+    out: str | None = None,
+    *,
+    hang_delay_s: float = 8.0,
+    dispatch_deadline_s: float = 5.0,
+    stall_delay_s: float = 4.0,
+    compile_deadline_s: float = 1.0,
+    hang_hits: tuple = (2, 4, 6),
+    recovery_timeout_s: float = 30.0,
+) -> dict:
+    """The supervision acceptance drill: wedge the device mid-replay and
+    prove the node degrades instead of dying.
+
+    Phase A replays the hostile workload fault-free (warming every device
+    shape) and fingerprints the end state.  Phase B installs supervision
+    (managed breaker + canary prober) and arms ``device.hang`` in mode
+    "hang": the scheduled dispatches sleep past the watchdog deadline and
+    then *complete* — the hardest case, because the late result must be
+    discarded after the batch already resolved via the host lane.  Phase C
+    replays out-of-order under those hangs.  Phase D is the compile-tier
+    micro-drill (a wedged cold-bucket jit).  The report's gates: bitwise
+    fingerprint identity, ``requeued == injected``, zero unresolved
+    tickets, breaker recovered to CLOSED by the canary alone.
+    """
+    wl = build_workload(cfg)
+    blocks = wl["blocks"]
+
+    # A: fault-free baseline — also warms every (kernel, bucket) shape so
+    # the hang phase exercises steady-state dispatch, not compiles
+    FAULTS.clear()
+    baseline = Consensus(wl["main"].params)
+    for b in blocks:
+        _insert(baseline, b)
+    base_fp = _fingerprints(baseline)
+
+    # B: supervision on.  Warm the canary's own (schnorr, bucket-8) shape
+    # first — the hostile script mix may never dispatch that shape, and a
+    # canary that compiles under a drill-shortened deadline would read as
+    # a recovery failure that is really a cold jit
+    from kaspa_tpu.crypto import secp
+
+    breaker = device_breaker()
+    breaker.reset()
+    t_warm = time.perf_counter()
+    canary_warm = secp.canary_probe()
+    canary_warm_s = round(time.perf_counter() - t_warm, 3)
+    before = REGISTRY.snapshot()["counters"]
+    pool_before = supervisor._POOL.snapshot()
+    supervisor.install(pretrace=False)
+    schedule = {
+        "device.hang": {
+            "mode": "hang",
+            "delay": hang_delay_s,
+            "hits": list(hang_hits),
+            "max": len(hang_hits),
+        }
+    }
+    try:
+        # C: out-of-order replay under dispatch hangs
+        FAULTS.configure(schedule, seed)
+        faulted = Consensus(wl["main"].params)
+        t0 = time.perf_counter()
+        with supervisor.deadline_overrides(
+            dispatch_s=dispatch_deadline_s,
+            compile_s=max(30.0, 6.0 * dispatch_deadline_s),
+        ):
+            _orphan_tolerant_replay(faulted, blocks, seed)
+            hang_events = FAULTS.events()
+            FAULTS.clear()
+            recovered_after_hangs = _await_recovery(breaker, recovery_timeout_s)
+
+            # D: compile-tier stall on a cold bucket
+            compile_stall = _compile_stall_drill(seed, stall_delay_s, compile_deadline_s)
+            recovered = _await_recovery(breaker, recovery_timeout_s)
+        elapsed = time.perf_counter() - t0
+        fp = _fingerprints(faulted)
+
+        injected = len(hang_events) + compile_stall["injected"]
+        late_seen = _await_late_results(
+            injected, pool_before["late_results"], timeout_s=hang_delay_s + 10.0
+        )
+        brk_snap = breaker.snapshot()  # while supervision (managed) is live
+    finally:
+        FAULTS.clear()
+        supervisor.shutdown()
+    after = REGISTRY.snapshot()["counters"]
+    pool_after = supervisor._POOL.snapshot()
+
+    requeued = _delta(before, after, "secp_watchdog_requeued_total")
+    from kaspa_tpu.ops import dispatch as coalesce
+
+    eng = coalesce.active()
+    tickets = {"coalescing": eng is not None}
+    if eng is not None:
+        tickets.update(eng.stats())
+    unresolved = int(tickets.get("unresolved_chunks", 0))
+    tickets["ok"] = unresolved == 0 and not tickets.get("abandoned", False)
+
+    report = {
+        "config": {
+            **asdict(cfg),
+            "fault_seed": seed,
+            "schedule": schedule,
+            "hang_delay_s": hang_delay_s,
+            "dispatch_deadline_s": dispatch_deadline_s,
+            "stall_delay_s": stall_delay_s,
+            "compile_deadline_s": compile_deadline_s,
+        },
+        "deterministic": {
+            "blocks": len(blocks),
+            "events": hang_events,
+            "fingerprints": fp,
+            "fault_free_fingerprints": base_fp,
+            "matches_fault_free": fp == base_fp,
+        },
+        "supervisor": {
+            "injected_hangs": injected,
+            "hang_phase_events": len(hang_events),
+            "canary_warm": canary_warm,
+            "canary_warm_seconds": canary_warm_s,
+            "requeued_total": requeued,
+            "requeue_matches_injected": requeued == injected,
+            "requeued_jobs": _delta(before, after, "secp_watchdog_requeued_jobs"),
+            "watchdog_timeouts": _delta(before, after, "secp_watchdog_timeouts"),
+            "abandoned_threads": pool_after["abandoned_threads"] - pool_before["abandoned_threads"],
+            "late_results": late_seen,
+            "canary_probes": _delta(before, after, "secp_watchdog_canary_probes"),
+            "recovered_after_hangs": recovered_after_hangs,
+            "recovered": recovered,
+            "verdict": supervisor.verdict(),
+        },
+        "compile_stall": compile_stall,
+        "tickets": tickets,
+        "breaker": brk_snap,
+        "kernel_cache": supervisor.cache_report(),
+        "metrics": {
+            "replay_seconds": round(elapsed, 3),
+            "blocks_per_sec": round(len(blocks) / elapsed, 2) if elapsed else None,
+            "fault_injections": _delta(before, after, "fault_injections"),
+            **{name: _delta(before, after, name) for name in _DELTA_COUNTERS},
+        },
     }
     if out:
         with open(out, "w") as f:

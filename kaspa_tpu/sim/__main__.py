@@ -75,6 +75,12 @@ def main() -> None:
         "--sustain-out", default="SUSTAIN.json", metavar="PATH",
         help="where --hostile writes its report (default SUSTAIN.json)",
     )
+    p.add_argument(
+        "--wedge-drill", action="store_true",
+        help="with --hostile: run the device-supervision wedge drill instead of the "
+        "stock sustain schedule — inject dispatch hangs + a compile stall mid-replay "
+        "and gate on bit-identity, requeue accounting, and canary recovery",
+    )
     args = p.parse_args()
 
     mesh_size = mesh.configure(args.mesh)
@@ -85,7 +91,10 @@ def main() -> None:
         hostile=args.hostile,
     )
     if args.hostile:
-        _run_hostile(cfg, args)
+        if args.wedge_drill:
+            _run_wedge(cfg, args)
+        else:
+            _run_hostile(cfg, args)
         return
     res = simulate(cfg)
     if args.notrace:
@@ -178,6 +187,49 @@ def _run_hostile(cfg, args) -> None:
             f"matches_fault_free={det['matches_fault_free']} -> {args.sustain_out}"
         )
     if not det["matches_fault_free"]:
+        raise SystemExit(2)
+
+
+def _run_wedge(cfg, args) -> None:
+    from kaspa_tpu.resilience.sustain import run_wedge_drill
+
+    report = run_wedge_drill(cfg, seed=args.seed, out=args.sustain_out)
+    det, sup, brk = report["deterministic"], report["supervisor"], report["breaker"]
+    summary = {
+        "blocks": det["blocks"],
+        "matches_fault_free": det["matches_fault_free"],
+        "injected_hangs": sup["injected_hangs"],
+        "requeued_total": sup["requeued_total"],
+        "requeue_matches_injected": sup["requeue_matches_injected"],
+        "late_results_discarded": sup["late_results"],
+        "compile_stall_ok": report["compile_stall"]["all_valid"] and report["compile_stall"]["shape_left_cold"],
+        "tickets_ok": report["tickets"]["ok"],
+        "breaker_trips": brk["trips"],
+        "breaker_recoveries": brk["recoveries"],
+        "recovered": sup["recovered"],
+        "replay_seconds": report["metrics"]["replay_seconds"],
+        "sink": det["fingerprints"]["sink"],
+        "utxo_commitment": det["fingerprints"]["utxo_commitment"],
+        "sustain_out": args.sustain_out,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"wedge drill: {det['blocks']} blocks, {sup['injected_hangs']} hangs injected, "
+            f"requeued={sup['requeued_total']} (match={sup['requeue_matches_injected']}), "
+            f"trips={brk['trips']} recovered={sup['recovered']}, "
+            f"matches_fault_free={det['matches_fault_free']} -> {args.sustain_out}"
+        )
+    ok = (
+        det["matches_fault_free"]
+        and sup["requeue_matches_injected"]
+        and sup["injected_hangs"] > 0
+        and summary["compile_stall_ok"]
+        and summary["tickets_ok"]
+        and sup["recovered"]
+    )
+    if not ok:
         raise SystemExit(2)
 
 

@@ -382,10 +382,21 @@ class DispatchHandle:
             # coalesced device lane: block on this round's tickets (wait()
             # nudges the queue, so a serial caller flushes immediately)
             with trace.span("txscript.dispatch_wait", kinds=",".join(sorted(self._tickets))):
-                if "schnorr" in self._tickets:
-                    schnorr_mask = self._tickets["schnorr"].wait()
-                if "ecdsa" in self._tickets:
-                    ecdsa_mask = self._tickets["ecdsa"].wait()
+                try:
+                    if "schnorr" in self._tickets:
+                        schnorr_mask = self._tickets["schnorr"].wait()
+                    if "ecdsa" in self._tickets:
+                        ecdsa_mask = self._tickets["ecdsa"].wait()
+                except TimeoutError as e:
+                    # infrastructure failure, not a consensus verdict: keep
+                    # the TimeoutError type but attach this handle's view
+                    if hasattr(e, "add_note"):
+                        e.add_note(
+                            "batch handle: "
+                            f"schnorr_jobs={len(self._schnorr)} ecdsa_jobs={len(self._ecdsa)} "
+                            f"fallback_jobs={len(self._fallbacks)} tokens={len(self._results)}"
+                        )
+                    raise
 
         for jobs, mask in ((self._schnorr, schnorr_mask), (self._ecdsa, ecdsa_mask)):
             if mask is not None:

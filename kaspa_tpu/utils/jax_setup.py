@@ -12,6 +12,12 @@ import os
 _DONE = False
 
 
+def cache_dir() -> str:
+    """The persistent compilation-cache directory (no jax import — the
+    warm-kernel manifest lives next to the XLA cache entries)."""
+    return os.environ.get("KASPA_TPU_JAX_CACHE", os.path.expanduser("~/.cache/kaspa_tpu_jax"))
+
+
 def setup(cache_dir: str | None = None) -> None:
     global _DONE
     if _DONE:

@@ -8,18 +8,21 @@ forced-CPU), a `--mesh 8` sim smoke replay, the flight-recorder lane (a
 traced 24-block pipelined replay whose dump must hold one connected
 >=4-thread span tree per block with >= 90% critical-path attribution and
 a valid Perfetto export, plus a tracing-off-within-2% overhead gate),
-and the hostile-load chaos sustain run (seeded fault schedule; the
-faulted replay must converge to the bit-identical fault-free end state),
-then writes a single round-evidence JSON (ROUNDCHECK.json) summarizing
-them — the artifact a driver round or a reviewer reads instead of seven
-scrollback logs.
+the hostile-load chaos sustain run (seeded fault schedule; the faulted
+replay must converge to the bit-identical fault-free end state), and the
+device-supervision wedge drill (injected dispatch hangs + a compile
+stall; watchdog requeue accounting + canary recovery, bit-identity
+gated), then writes a single round-evidence JSON (ROUNDCHECK.json)
+summarizing them — the artifact a driver round or a reviewer reads
+instead of eight scrollback logs.
 
-    python tools/roundcheck.py                 # everything
-    python tools/roundcheck.py --skip-bench    # no device probe
-    python tools/roundcheck.py --skip-mesh     # no multichip/mesh lanes
-    python tools/roundcheck.py --skip-obs      # no flight-recorder lane
-    python tools/roundcheck.py --skip-chaos    # no fault-injection sustain
-    python tools/roundcheck.py --out my.json   # custom artifact path
+    python tools/roundcheck.py                     # everything
+    python tools/roundcheck.py --skip-bench        # no device probe
+    python tools/roundcheck.py --skip-mesh         # no multichip/mesh lanes
+    python tools/roundcheck.py --skip-obs          # no flight-recorder lane
+    python tools/roundcheck.py --skip-chaos        # no fault-injection sustain
+    python tools/roundcheck.py --skip-supervision  # no wedge drill
+    python tools/roundcheck.py --out my.json       # custom artifact path
 
 Exit code 0 iff every section that ran passed.
 """
@@ -174,6 +177,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-serving", action="store_true", help="skip the serving-tier dual-encoding + kill -9 lane")
     ap.add_argument("--skip-obs", action="store_true", help="skip the flight-recorder traced-replay lane")
     ap.add_argument("--skip-tenbps", action="store_true", help="skip the 10-BPS speculative-pipeline lane")
+    ap.add_argument("--skip-supervision", action="store_true", help="skip the device-supervision wedge drill")
     ap.add_argument("--chaos-blocks", type=int, default=24, help="chaos sustain main-DAG length")
     # long enough that coinbase maturity passes and real signature batches
     # flow through the sharded verify path (a 12-block replay carries 0 txs)
@@ -436,6 +440,37 @@ def main(argv: list[str] | None = None) -> int:
             and result.get("breaker_trips", 0) >= 1
         )
         evidence["sections"]["chaos"] = sect
+        ok &= sect["ok"]
+
+    if not args.skip_supervision:
+        # supervision wedge drill: dispatch hangs + a compile stall injected
+        # mid-replay; the watchdog reroutes every wedged super-batch to the
+        # host degraded lane and the canary prober recovers the breaker —
+        # gated on bit-identity with the fault-free replay plus exact
+        # requeue accounting (no ticket lost, none double-resolved)
+        sect = _run(
+            [
+                sys.executable, "-m", "kaspa_tpu.sim",
+                "--hostile", "--wedge-drill", "--blocks", "24",
+                "--tpb", "4", "--seed", "7", "--coalesce", "256", "--json",
+                "--sustain-out", os.path.join(REPO_ROOT, "SUSTAIN_WEDGE.json"),
+            ],
+            1200.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        result = _last_json_line(sect)
+        sect["result"] = result
+        sect["ok"] = (
+            sect["rc"] == 0
+            and bool(result)
+            and bool(result.get("matches_fault_free"))
+            and bool(result.get("requeue_matches_injected"))
+            and result.get("injected_hangs", 0) > 0
+            and bool(result.get("compile_stall_ok"))
+            and bool(result.get("tickets_ok"))
+            and bool(result.get("recovered"))
+        )
+        evidence["sections"]["supervision"] = sect
         ok &= sect["ok"]
 
     evidence["ok"] = ok
