@@ -244,9 +244,18 @@ def _run_guarded(batch: _Batch, kernel, items: list, host_verify) -> np.ndarray:
         else:
             br.record_success()
             return mask
+    return _host_lane(batch, kernel.__name__, items, host_verify)
+
+
+def _host_lane(batch: _Batch, kernel_name: str, items: list, host_verify) -> np.ndarray:
+    """The bit-identical host degraded lane: same prechecks as the device
+    path (already folded into ``batch.ok``), per-item eclib oracle verify
+    for the survivors.  Shared by the breaker-open path above and the
+    fabric balancer's last failover tier."""
+    n = len(batch.ok)
     _DEGRADED_DISPATCHES.inc()
     _DEGRADED_JOBS.inc(n)
-    with trace.span("secp.degraded_dispatch", kernel=kernel.__name__, jobs=n):
+    with trace.span("secp.degraded_dispatch", kernel=kernel_name, jobs=n):
         mask = np.zeros(n, dtype=bool)
         for i, (pub, msg, sig) in enumerate(items):
             if batch.ok[i]:  # host-precheck failures stay False
@@ -283,9 +292,7 @@ def schnorr_verify_batch(items) -> np.ndarray:
     return _run_guarded(_build_schnorr_batch(items), schnorr_verify, items, eclib.schnorr_verify)
 
 
-def ecdsa_verify_batch(items) -> np.ndarray:
-    """items: iterable of (pubkey33, msg32, sig64_compact) -> bool mask."""
-    items = list(items)
+def _build_ecdsa_batch(items: list) -> _Batch:
     batch = _Batch()
     half_n = eclib.N // 2
     for pub, msg, sig in items:
@@ -303,7 +310,31 @@ def ecdsa_verify_batch(items) -> np.ndarray:
         u1 = z * si % eclib.N
         u2 = r * si % eclib.N
         batch.push(pk[0], pk[1], r, u1, u2)
-    return _run_guarded(batch, ecdsa_verify, items, eclib.ecdsa_verify)
+    return batch
+
+
+def ecdsa_verify_batch(items) -> np.ndarray:
+    """items: iterable of (pubkey33, msg32, sig64_compact) -> bool mask."""
+    items = list(items)
+    return _run_guarded(_build_ecdsa_batch(items), ecdsa_verify, items, eclib.ecdsa_verify)
+
+
+def verify_batch(kind: str, items) -> np.ndarray:
+    """Kind-dispatching batched verify ("schnorr" | "ecdsa") — the entry
+    the verify fabric's slice workers call with wire-decoded triples."""
+    return (schnorr_verify_batch if kind == "schnorr" else ecdsa_verify_batch)(items)
+
+
+def host_verify_batch(kind: str, items) -> np.ndarray:
+    """Host-only verify for one super-batch: the same precheck + eclib
+    oracle lane the breaker-open path runs, callable directly.  This is
+    the fabric balancer's final failover tier — every slice dead or hung
+    still yields bit-identical acceptance decisions, just at host
+    throughput, and it can never touch a (possibly wedged) device."""
+    items = list(items)
+    if kind == "schnorr":
+        return _host_lane(_build_schnorr_batch(items), "schnorr_verify", items, eclib.schnorr_verify)
+    return _host_lane(_build_ecdsa_batch(items), "ecdsa_verify", items, eclib.ecdsa_verify)
 
 
 # --- supervision hooks ----------------------------------------------------

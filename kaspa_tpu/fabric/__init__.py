@@ -1,0 +1,13 @@
+"""Verify fabric: the process-local verify plane as a distributed service.
+
+- `wire`     — length-prefixed message codec (gRPC framing + varints)
+- `service`  — verifyd: accepts verify super-batches, feeds slice workers
+- `client`   — one socket to one verifyd, request/response correlation
+- `balancer` — cross-host dispatch engine: least-loaded slice routing,
+  per-slice breakers, failover to the bit-identical host degraded lane
+
+`balancer.configure("HOST:PORT,...")` installs the balancer as the
+process-wide verify engine (`ops/dispatch.install`), so every existing
+caller of the coalescing dispatcher — BatchScriptChecker, the pipeline,
+daemon shutdown — routes over the fabric unchanged.
+"""

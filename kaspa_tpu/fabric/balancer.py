@@ -1,0 +1,491 @@
+"""Cross-host verify balancer: the fabric's dispatch engine.
+
+Implements the CoalescingDispatcher surface (submit/nudge/drain/close/
+abandon/stats) over remote verifyd slices, so `ops/dispatch.install()`
+makes it the process-wide verify engine and every existing caller —
+BatchScriptChecker, the pipeline stage workers, daemon shutdown — routes
+over the fabric unchanged.
+
+Routing and resilience, per super-batch (one `submit()` chunk):
+
+- the chunk goes to the **least-loaded** live slice (lowest outstanding
+  jobs among slices whose per-slice CircuitBreaker admits traffic);
+- every request carries a deadline (`KASPA_TPU_FABRIC_DEADLINE_S`,
+  default: the PR 9 dispatch-watchdog deadline).  A deadline expiry is a
+  *hang*: the slice's breaker trips immediately with cause ``hung`` —
+  the supervisor semantics, applied per slice;
+- a failed/hung/disconnected slice is retried on the **next** slice; when
+  every slice is dead or already tried, the chunk lands on the
+  **bit-identical host degraded lane** (`secp.host_verify_batch` — same
+  prechecks, eclib oracle) so a ticket always resolves, exactly once;
+- breakers are *managed* (PR 9): while OPEN, live chunks never probe a
+  possibly-hung slice — the monitor's cheap STATUS canary does, and its
+  answer re-arms the slice;
+- remote work lands in the block's flight trace: ``wait.fabric`` (submit
+  -> send), ``fabric.rpc`` (send -> response) with the server-reported
+  queue/verify times grafted as a ``fabric.remote.verify`` child span.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import threading
+import time
+from time import perf_counter_ns
+
+import numpy as np
+
+from kaspa_tpu.fabric import wire
+from kaspa_tpu.fabric.client import FabricConnection
+from kaspa_tpu.observability import trace
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.ops import dispatch as dispatch_mod
+from kaspa_tpu.ops.dispatch import DispatchAbandoned, Ticket
+from kaspa_tpu.resilience import supervisor
+from kaspa_tpu.resilience.breaker import HUNG, CircuitBreaker
+
+_REMOTE_JOBS = REGISTRY.counter_family("fabric_remote_jobs", "slice", help="verify jobs resolved by fabric slice")
+_FAILOVERS = REGISTRY.counter("fabric_failovers", help="chunks re-routed after a slice failure/hang")
+_DEGRADED = REGISTRY.counter("fabric_degraded_chunks", help="chunks resolved on the host degraded lane")
+
+_MONITOR_TICK_S = 0.05
+_RECONNECT_EVERY_S = 1.0
+
+
+def _deadline_s() -> float:
+    raw = os.environ.get("KASPA_TPU_FABRIC_DEADLINE_S")
+    if raw:
+        return float(raw)
+    return supervisor.deadline_s("dispatch")
+
+
+class _Slice:
+    """One routable (server, remote slice) lane with its own breaker."""
+
+    __slots__ = ("conn", "idx", "key", "breaker", "occupancy")
+
+    def __init__(self, conn: FabricConnection, idx: int, breaker: CircuitBreaker):
+        self.conn = conn
+        self.idx = idx
+        self.key = f"{conn.addr}#{idx}"
+        self.breaker = breaker
+        self.occupancy = 0  # outstanding chunks, guarded by the balancer lock
+
+
+class _Job:
+    __slots__ = ("ticket", "kind", "items", "ctx", "enqueued_ns", "send_ns",
+                 "deadline", "tried", "slice", "req_id", "done")
+
+    def __init__(self, ticket: Ticket, kind: str, items: list):
+        self.ticket = ticket
+        self.kind = kind
+        self.items = items
+        self.ctx = trace.context()
+        self.enqueued_ns = perf_counter_ns()
+        self.send_ns = 0
+        self.deadline = 0.0
+        self.tried: set = set()
+        self.slice: _Slice | None = None
+        self.req_id = 0
+        self.done = False
+
+
+class FabricBalancer:
+    def __init__(self, addrs: list[str], deadline_s: float | None = None):
+        self.addrs = list(addrs)
+        self.label = "fabric:" + ",".join(self.addrs)
+        self.deadline_s = deadline_s if deadline_s is not None else _deadline_s()
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._ids = itertools.count(1)
+        self._jobs: dict[int, _Job] = {}
+        self._probes: dict[int, tuple[_Slice, float]] = {}
+        self._slices: list[_Slice] = []
+        self._conns: dict[str, FabricConnection] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}  # persists across reconnects
+        self._last_dial: dict[str, float] = {}
+        self._unresolved = 0
+        self._closed = False
+        self._abandoned = False
+        self.counters = {
+            "submitted": 0, "remote": 0, "degraded": 0, "failovers": 0,
+            "late_responses": 0, "abandoned": 0,
+        }
+        self._degraded_q: queue.Queue = queue.Queue()
+        self._stopped = threading.Event()
+        for addr in self.addrs:
+            conn = FabricConnection(addr, on_message=self._on_message, on_disconnect=self._on_disconnect)
+            self._conns[addr] = conn
+            self._dial(conn)
+        threading.Thread(target=self._degraded_worker, name="fabric-degraded", daemon=True).start()
+        threading.Thread(target=self._monitor, name="fabric-monitor", daemon=True).start()
+
+    # --- connection lifecycle ----------------------------------------------
+
+    def _dial(self, conn: FabricConnection) -> bool:
+        self._last_dial[conn.addr] = time.monotonic()
+        try:
+            hello = conn.connect(timeout=3.0)
+        except Exception:  # noqa: BLE001 - dead at dial: monitor retries
+            return False
+        fresh = []
+        for i in range(max(1, int(hello.get("slices", 1)))):
+            br = self._breakers.get(f"{conn.addr}#{i}")
+            if br is None:
+                br = CircuitBreaker(
+                    f"fabric[{conn.addr}#{i}]",
+                    failure_threshold=int(os.environ.get("KASPA_TPU_BREAKER_THRESHOLD", "2")),
+                )
+                br.set_managed(True)  # only the STATUS canary probes while OPEN
+                self._breakers[f"{conn.addr}#{i}"] = br
+            fresh.append(_Slice(conn, i, br))
+        with self._lock:
+            self._slices = [s for s in self._slices if s.conn.addr != conn.addr] + fresh
+        for s in fresh:
+            s.breaker.record_success()  # a successful dial re-arms the lane
+        return True
+
+    def _on_disconnect(self, conn: FabricConnection, exc: Exception) -> None:
+        with self._lock:
+            victims = [rid for rid, job in self._jobs.items() if job.slice is not None and job.slice.conn is conn]
+            dead_probes = [rid for rid, (s, _) in self._probes.items() if s.conn is conn]
+            for rid in dead_probes:
+                del self._probes[rid]
+        for rid in victims:
+            job = self._detach(rid)
+            if job is not None:
+                job.slice.breaker.record_failure()
+                self._failover(job)
+
+    # --- the dispatch-engine surface ---------------------------------------
+
+    def submit(self, kind: str, items: list) -> Ticket:
+        """Route one chunk of (pubkey, msg, sig) triples; same contract as
+        CoalescingDispatcher.submit — the ticket resolves exactly once."""
+        ticket = Ticket(self, kind, len(items))
+        if not items:
+            ticket._resolve(np.zeros(0, dtype=bool), None)
+            return ticket
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("fabric balancer is shut down")
+            self.counters["submitted"] += 1
+            self._unresolved += 1
+        self._route(_Job(ticket, kind, list(items)))
+        return ticket
+
+    def nudge(self) -> None:
+        """No-op: chunks are sent the moment they are submitted."""
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._unresolved > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+        return True
+
+    def close(self, timeout: float = 10.0, abandon: bool = True) -> bool:
+        with self._lock:
+            self._closed = True
+        drained = self.drain(timeout)
+        if not drained and abandon:
+            self.abandon("close timeout: outstanding fabric chunks")
+        self._stopped.set()
+        self._degraded_q.put(None)
+        for conn in self._conns.values():
+            conn.close()
+        return drained
+
+    def abandon(self, reason: str) -> int:
+        err = DispatchAbandoned(f"fabric balancer abandoned: {reason}")
+        with self._lock:
+            self._closed = True
+            self._abandoned = True
+            victims = list(self._jobs.values())
+            self._jobs.clear()
+            for job in victims:
+                if job.slice is not None:
+                    job.slice.occupancy -= 1
+        stranded = []
+        while True:
+            try:
+                job = self._degraded_q.get_nowait()
+            except queue.Empty:
+                break
+            if job is not None:
+                stranded.append(job)
+        count = 0
+        for job in victims + stranded:
+            if self._complete(job, None, err, "abandoned"):
+                count += 1
+        return count
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_slice = [
+                {"slice": s.key, "occupancy": s.occupancy, "alive": s.conn.alive,
+                 "breaker": s.breaker.state, "trips": s.breaker.trips,
+                 "last_trip_cause": s.breaker.last_trip_cause}
+                for s in self._slices
+            ]
+            out = dict(self.counters)
+            out.update({
+                "deadline_s": self.deadline_s,
+                "unresolved_chunks": self._unresolved,
+                "abandoned_engine": self._abandoned,
+                "slices": per_slice,
+                # the zero-lost-tickets invariant, checkable from evidence:
+                # every submitted chunk is resolved somewhere or still open
+                "lost": self.counters["submitted"] - self.counters["remote"]
+                - self.counters["degraded"] - self.counters["abandoned"] - self._unresolved,
+            })
+            return out
+
+    # --- routing ------------------------------------------------------------
+
+    def _route(self, job: _Job) -> None:
+        while True:
+            with self._lock:
+                if job.done:
+                    return
+                if self._abandoned:
+                    break
+                ranked = sorted(
+                    (s for s in self._slices if s.key not in job.tried and s.conn.alive),
+                    key=lambda s: s.occupancy,
+                )
+            chosen = None
+            for s in ranked:
+                if s.breaker.allow():
+                    chosen = s
+                    break
+                job.tried.add(s.key)  # OPEN now = not a candidate for *this* chunk
+            if chosen is None:
+                break
+            with self._lock:
+                if job.done:
+                    return
+                job.req_id = next(self._ids)
+                job.slice = chosen
+                job.tried.add(chosen.key)
+                chosen.occupancy += 1
+                job.send_ns = perf_counter_ns()
+                job.deadline = time.monotonic() + self.deadline_s
+                self._jobs[job.req_id] = job
+            try:
+                chosen.conn.send(wire.encode_verify_req(
+                    job.req_id, job.kind, chosen.idx,
+                    job.ctx.trace_id if job.ctx is not None else None, job.items,
+                ))
+                return
+            except Exception:  # noqa: BLE001 - send failed: this slice is toast
+                detached = self._detach(job.req_id)
+                chosen.breaker.record_failure()
+                if detached is None:
+                    return  # raced a concurrent resolution
+                with self._lock:
+                    self.counters["failovers"] += 1
+                _FAILOVERS.inc()
+        # no routable slice left: the bit-identical host lane takes it
+        self._degraded_q.put(job)
+
+    def _detach(self, req_id: int) -> _Job | None:
+        """Pull an outstanding request back (failure path); None when the
+        job already resolved.  Occupancy is released here, exactly once."""
+        with self._lock:
+            job = self._jobs.pop(req_id, None)
+            if job is None or job.done:
+                return None
+            if job.slice is not None:
+                job.slice.occupancy -= 1
+            return job
+
+    def _failover(self, job: _Job) -> None:
+        with self._lock:
+            self.counters["failovers"] += 1
+        _FAILOVERS.inc()
+        self._route(job)
+
+    def _complete(self, job: _Job, mask, error, route: str) -> bool:
+        with self._lock:
+            if job.done:
+                return False
+            job.done = True
+            self._unresolved -= 1
+            self.counters[route] = self.counters.get(route, 0) + 1
+            if self._unresolved == 0:
+                self._idle.notify_all()
+        job.ticket._resolve(mask, error)
+        return True
+
+    # --- completion paths ---------------------------------------------------
+
+    def _on_message(self, conn: FabricConnection, mtype: int, msg: dict) -> None:
+        if mtype == wire.STATUS_RESP:
+            with self._lock:
+                probe = self._probes.pop(msg["req_id"], None)
+            if probe is not None:
+                probe[0].breaker.record_success()  # canary answered: re-arm
+            return
+        if mtype != wire.VERIFY_RESP:
+            return
+        t_recv = perf_counter_ns()
+        req_id = msg["req_id"]
+        if not msg["ok"]:
+            job = self._detach(req_id)
+            if job is None:
+                with self._lock:
+                    self.counters["late_responses"] += 1
+                return
+            job.slice.breaker.record_failure()
+            self._failover(job)
+            return
+        with self._lock:
+            job = self._jobs.pop(req_id, None)
+            if job is None or job.done:
+                self.counters["late_responses"] += 1
+                return
+            sl = job.slice
+            sl.occupancy -= 1
+        mask = msg["mask"]
+        if mask.shape[0] != len(job.items):
+            # a corrupted-but-decodable response must not resolve the
+            # ticket with the wrong lane count — treat as a slice failure
+            sl.breaker.record_failure()
+            self._failover(job)
+            return
+        sl.breaker.record_success()
+        _REMOTE_JOBS.inc(sl.key, len(job.items))
+        if job.ctx is not None:
+            trace.record_span("wait.fabric", job.ctx, job.enqueued_ns, job.send_ns)
+            rpc = trace.record_span(
+                "fabric.rpc", job.ctx, job.send_ns, t_recv,
+                slice=sl.key, jobs=len(job.items), kind=job.kind,
+                queue_ms=round(msg["queue_ns"] / 1e6, 3),
+                verify_ms=round(msg["verify_ns"] / 1e6, 3),
+                remote_inflight=msg["inflight"],
+            )
+            if rpc is not None and msg["verify_ns"]:
+                trace.record_span(
+                    "fabric.remote.verify", rpc, t_recv - msg["verify_ns"], t_recv, slice=sl.key
+                )
+        self._complete(job, mask, None, "remote")
+
+    def _degraded_worker(self) -> None:
+        from kaspa_tpu.crypto import secp  # deferred: jax import
+
+        while True:
+            job = self._degraded_q.get()
+            if job is None:
+                return
+            if self._abandoned:
+                self._complete(job, None, DispatchAbandoned("fabric balancer abandoned"), "abandoned")
+                continue
+            _DEGRADED.inc()
+            try:
+                with trace.span("fabric.degraded", parent=job.ctx, kind=job.kind, jobs=len(job.items)):
+                    mask = secp.host_verify_batch(job.kind, job.items)
+            except Exception as e:  # noqa: BLE001 - surfaced on the ticket
+                self._complete(job, None, e, "degraded")
+                continue
+            if job.ctx is not None:
+                trace.record_span("wait.fabric", job.ctx, job.enqueued_ns, perf_counter_ns())
+            self._complete(job, mask, None, "degraded")
+
+    # --- supervision --------------------------------------------------------
+
+    def _monitor(self) -> None:
+        while not self._stopped.wait(_MONITOR_TICK_S):
+            now = time.monotonic()
+            with self._lock:
+                hung = [rid for rid, job in self._jobs.items() if now > job.deadline]
+                dead_probes = [rid for rid, (_, dl) in self._probes.items() if now > dl]
+                probe_due = [
+                    s for s in self._slices
+                    if s.conn.alive and s.breaker.reopen_due()
+                    and all(p is not s for p, _ in self._probes.values())
+                ]
+                redial = [
+                    c for c in self._conns.values()
+                    if not c.alive and now - self._last_dial.get(c.addr, 0.0) >= _RECONNECT_EVERY_S
+                ]
+            for rid in hung:
+                job = self._detach(rid)
+                if job is not None:
+                    # the per-slice watchdog verdict: a deadline is a hang,
+                    # and one proven hang trips the slice immediately
+                    job.slice.breaker.record_failure(cause=HUNG)
+                    self._failover(job)
+            for rid in dead_probes:
+                with self._lock:
+                    probe = self._probes.pop(rid, None)
+                if probe is not None:
+                    probe[0].breaker.record_failure(cause=HUNG)
+            for s in probe_due:
+                if not s.breaker.allow(probe=True):
+                    continue
+                rid = next(self._ids)
+                with self._lock:
+                    self._probes[rid] = (s, now + min(5.0, self.deadline_s))
+                try:
+                    s.conn.send(wire.encode_status_req(rid))
+                except Exception:  # noqa: BLE001 - probe send failed
+                    with self._lock:
+                        self._probes.pop(rid, None)
+                    s.breaker.record_failure()
+            for conn in redial:
+                self._dial(conn)
+
+
+# --- process-wide configuration (mirrors ops/dispatch.py) -------------------
+
+_lock = threading.Lock()
+_balancer: FabricBalancer | None = None
+
+
+def configure(addrs: str | list[str] | None, deadline_s: float | None = None) -> FabricBalancer | None:
+    """Build a balancer for ``addrs`` ("HOST:PORT,..." or a list) and
+    install it as the process-wide verify engine; None/empty uninstalls
+    (reverting to whatever `ops/dispatch.configure` set up)."""
+    global _balancer
+    if isinstance(addrs, str):
+        addrs = [a.strip() for a in addrs.split(",") if a.strip()]
+    with _lock:
+        old, _balancer = _balancer, None
+    if old is not None:
+        old.close(timeout=5.0)
+    if not addrs:
+        return None
+    bal = FabricBalancer(addrs, deadline_s=deadline_s)
+    with _lock:
+        _balancer = bal
+    dispatch_mod.install(bal)
+    return bal
+
+
+def active() -> FabricBalancer | None:
+    return _balancer
+
+
+def shutdown(timeout: float = 10.0) -> bool:
+    global _balancer
+    with _lock:
+        bal, _balancer = _balancer, None
+    return bal.close(timeout, abandon=True) if bal is not None else True
+
+
+def _fabric_state() -> dict:
+    bal = _balancer
+    if bal is None:
+        return {"enabled": False}
+    out: dict = {"enabled": True}
+    out.update(bal.stats())
+    return out
+
+
+REGISTRY.register_collector("fabric", _fabric_state)

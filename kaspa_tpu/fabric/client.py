@@ -1,0 +1,127 @@
+"""Verify-fabric client transport: one socket to one verifyd.
+
+Dumb by design — request/response correlation, occupancy, deadlines and
+failover live in `fabric/balancer.py`; this layer owns the socket, the
+reader thread, and the `fabric.send` / `fabric.recv` fault points
+(cooperative modes mangle/drop frames or sever the connection, exactly
+like the P2P wire's `p2p.send`/`p2p.recv`)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from kaspa_tpu.fabric import wire
+from kaspa_tpu.resilience.faults import FAULTS, mangle_frame
+
+
+class FabricConnection:
+    """Socket + reader thread; delivers decoded messages to ``on_message``
+    and a single terminal ``on_disconnect(exc)`` when the stream dies."""
+
+    def __init__(self, addr: str, on_message=None, on_disconnect=None):
+        host, _, port = addr.rpartition(":")
+        self.addr = addr
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.on_message = on_message
+        self.on_disconnect = on_disconnect
+        self.hello: dict | None = None
+        self.sock: socket.socket | None = None
+        self._wlock = threading.Lock()
+        self._dead = threading.Event()
+        self._down_fired = False
+
+    @property
+    def alive(self) -> bool:
+        return self.sock is not None and not self._dead.is_set()
+
+    def connect(self, timeout: float = 5.0) -> dict:
+        """Dial and read the server HELLO; starts the reader thread.
+        Returns the HELLO fields (proto version, slice count)."""
+        sock = socket.create_connection((self.host, self.port), timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            mtype, hello = wire.read_message(lambda n: self._read_exactly(sock, n))
+            if mtype != wire.HELLO:
+                raise wire.ProtoWireError(f"expected HELLO, got {mtype:#x}")
+        except Exception:
+            sock.close()
+            raise
+        sock.settimeout(None)
+        self.sock = sock
+        self.hello = hello
+        self._dead.clear()
+        self._down_fired = False
+        threading.Thread(target=self._reader, name=f"fabric-client-{self.addr}", daemon=True).start()
+        return hello
+
+    @staticmethod
+    def _read_exactly(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("fabric server closed mid-frame")
+            buf += chunk
+        return buf
+
+    def send(self, payload: bytes) -> None:
+        """Frame + send one message; raises ConnectionError when the link
+        is down or an injected fault severs it.  A ``drop`` fault returns
+        silently — the request will deadline out upstream, which is the
+        exact failure shape of a frame lost in flight."""
+        frame = wire.frame(payload)
+        act = FAULTS.fire("fabric.send")
+        if act is not None:
+            if act.mode == "disconnect":
+                self._teardown(ConnectionError("fault: fabric.send disconnect"))
+                raise ConnectionError("fabric.send: injected disconnect")
+            frame = mangle_frame(frame, act)
+            if frame is None:
+                return  # dropped in flight
+        sock = self.sock
+        if sock is None or self._dead.is_set():
+            raise ConnectionError(f"fabric connection {self.addr} is down")
+        try:
+            with self._wlock:
+                sock.sendall(frame)
+        except OSError as e:
+            self._teardown(e)
+            raise ConnectionError(f"fabric send to {self.addr} failed: {e}") from e
+
+    def _reader(self) -> None:
+        sock = self.sock
+        try:
+            while not self._dead.is_set():
+                mtype, msg = wire.read_message(lambda n: self._read_exactly(sock, n))
+                act = FAULTS.fire("fabric.recv")
+                if act is not None:
+                    if act.mode == "disconnect":
+                        raise ConnectionError("fault: fabric.recv disconnect")
+                    if act.mode == "drop":
+                        continue  # response lost in flight -> deadline path
+                if self.on_message is not None:
+                    self.on_message(self, mtype, msg)
+        except Exception as e:  # noqa: BLE001 - any stream error is terminal
+            self._teardown(e)
+
+    def _teardown(self, exc: Exception) -> None:
+        fire = False
+        with self._wlock:
+            if not self._dead.is_set():
+                self._dead.set()
+                fire = not self._down_fired
+                self._down_fired = True
+        sock, self.sock = self.sock, None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+        if fire and self.on_disconnect is not None:
+            self.on_disconnect(self, exc)
+
+    def close(self) -> None:
+        self._teardown(ConnectionError("closed by client"))

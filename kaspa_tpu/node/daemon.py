@@ -106,6 +106,14 @@ def parse_args(argv=None) -> DaemonArgs:
         "target from BENCH_SWEEP.json; flush age via KASPA_TPU_COALESCE_AGE_MS)",
     )
     p.add_argument(
+        "--fabric", nargs="+", default=None, metavar=("MODE", "ADDR"),
+        help="verify fabric: 'serve [HOST:PORT]' runs a verifyd slice server "
+        "inside this node (default 127.0.0.1:18500, port 0 = ephemeral); "
+        "'connect ADDR[,ADDR...]' routes batch signature verification to "
+        "remote verifyd slices — least-loaded routing, per-slice breakers, "
+        "bit-identical host degraded lane when every slice is down",
+    )
+    p.add_argument(
         "--flight", action=argparse.BooleanOptionalAction, default=False,
         help="per-block flight recorder: cross-thread span trees for every "
         "validated block in a bounded ring, served over getTraces and dumped "
@@ -343,6 +351,15 @@ class Daemon:
         # super-batches once configured (> 0); mesh must resolve first so
         # 'auto' picks the sweep's best batch for the active mesh size
         self.coalesce_target = verify_dispatch.configure(getattr(args, "coalesce", None))
+        fab = getattr(args, "fabric", None) or []
+        self.fabric_mode = fab[0] if fab else None
+        if self.fabric_mode not in (None, "serve", "connect"):
+            raise SystemExit(f"--fabric mode must be serve|connect, got {self.fabric_mode!r}")
+        if self.fabric_mode == "connect" and len(fab) < 2:
+            raise SystemExit("--fabric connect requires ADDR[,ADDR...]")
+        self._fabric_arg = fab[1] if len(fab) > 1 else None
+        self.fabric_service = None
+        self.fabric_addr = None
         if getattr(args, "flight", False):
             from kaspa_tpu.observability import flight
 
@@ -487,6 +504,10 @@ class Daemon:
         self.core.bind(self.tick)
         self.core.bind(CallbackService("rpc-server", on_start=self._start_rpc_service, on_stop=self._stop_rpc_service))
         self.core.bind(CallbackService("p2p-server", on_start=self._start_p2p_service, on_stop=self._stop_p2p_service))
+        if self.fabric_mode:
+            self.core.bind(
+                CallbackService("fabric", on_start=self._start_fabric_service, on_stop=self._stop_fabric_service)
+            )
         self.wrpc_server = None
         if getattr(args, "rpclisten_wrpc", None):
             self.core.bind(
@@ -824,6 +845,32 @@ class Daemon:
             if hasattr(peer, "close"):
                 peer.close()
 
+    def _start_fabric_service(self, _core) -> list:
+        if self.fabric_mode == "serve":
+            from kaspa_tpu.fabric.service import VerifyService
+
+            self.fabric_service = VerifyService(self._fabric_arg or "127.0.0.1:18500")
+            host, port = self.fabric_service.start()
+            self.fabric_addr = f"{host}:{port}"
+            self.log.info(
+                "verify fabric serving on %s (%d slices)", self.fabric_addr, self.fabric_service.slices
+            )
+        else:
+            from kaspa_tpu.fabric import balancer as fabric_balancer
+
+            bal = fabric_balancer.configure(self._fabric_arg)
+            live = sum(1 for s in bal.stats()["slices"] if s["alive"])
+            self.log.info("verify fabric balancer over %s (%d live slices)", self._fabric_arg, live)
+        return []
+
+    def _stop_fabric_service(self) -> None:
+        # only the serve side stops here (reverse bind order): the connect-
+        # side balancer must outlive the pipeline drain in stop(), so its
+        # tickets keep resolving until validation work is idle
+        if self.fabric_service is not None:
+            self.fabric_service.stop()
+            self.fabric_service = None
+
     def _start_wrpc_service(self, _core) -> list:
         from kaspa_tpu.rpc.wrpc import WrpcServer
 
@@ -932,6 +979,12 @@ class Daemon:
         from kaspa_tpu.txscript import batch as script_batch
 
         script_batch.drain_fallback_pool(timeout=10.0)
+        if self.fabric_mode == "connect":
+            # the balancer drains (remote + degraded lanes) before the
+            # generic dispatch shutdown below closes whatever engine remains
+            from kaspa_tpu.fabric import balancer as fabric_balancer
+
+            fabric_balancer.shutdown(timeout=10.0)
         # same barrier for the async coalescing queue: flush staged verify
         # chunks and block until every callback has resolved — tickets
         # resolving after the db handle closes would write sig-cache entries

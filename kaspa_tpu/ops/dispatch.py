@@ -449,6 +449,21 @@ def configure(spec: int | str | None) -> int:
     return target
 
 
+def install(engine) -> None:
+    """Install a custom dispatch engine as the process-wide verify engine
+    (the fabric balancer uses this to become what `active()` returns, so
+    BatchScriptChecker / the pipeline / daemon shutdown pick it up
+    unchanged).  Any engine exposing the CoalescingDispatcher surface —
+    submit/nudge/drain/close/abandon/stats — qualifies; a previously live
+    engine is retired first."""
+    global _configured, _engine
+    with _cfg_lock:
+        old, _engine = _engine, engine
+        _configured = getattr(engine, "label", type(engine).__name__)
+    if old is not None and old is not engine:
+        old.close(timeout=10.0)
+
+
 def active() -> CoalescingDispatcher | None:
     """The live engine, or None when coalescing is disabled."""
     return _engine

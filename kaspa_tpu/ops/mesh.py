@@ -28,12 +28,25 @@ slice); the muhash tree product reduces each shard's slice to one U3072
 partial product on device and combines the <= mesh-size partials on host
 (one cheap 3072-bit multiply each), which keeps the result bit-identical
 to any other association order of the commutative monoid product.
+
+2-D hybrid mesh (the verify-fabric substrate): ``configure("RxC")``
+arranges the devices as R slices of C devices each — on a multi-host
+deployment via ``create_hybrid_device_mesh`` (slices map to hosts, the
+fast intra-host links carry the "shard" axis), on a single host by
+reshaping the local devices (the CPU test topology).  A fabric slice
+worker pins itself with ``slice_lane(i)`` so its dispatches run on slice
+i's devices only; unpinned dispatches shard over the whole grid.  All
+in/out specs derive from the regex partition-rule registry, and every
+path — 1-D, full grid, single slice — is batch-dim data parallelism over
+the same kernels, so masks stay bit-identical across layouts.
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+import re
 import threading
 
 import numpy as np
@@ -60,15 +73,27 @@ _DISPATCHES = REGISTRY.counter_family(
     "mesh_dispatches", "kernel", help="sharded dispatches by kernel (schnorr/ecdsa/muhash)"
 )
 
+_SLICE_DISPATCHES = REGISTRY.counter_family(
+    "mesh_slice_dispatches", "slice", help="slice-pinned verify dispatches by mesh slice"
+)
+_SLICE_JOBS = REGISTRY.counter_family(
+    "mesh_slice_jobs", "slice", help="verify jobs dispatched per mesh slice (pre-padding)"
+)
+
 _lock = threading.Lock()
 _configured: str | int | None = None  # raw spec, resolved lazily
 _active: int | None = None  # resolved mesh size (clamped to visible devices)
+_grid: tuple[int, int] | None = None  # (slices, shards-per-slice) for "RxC" specs
+_slice_tls = threading.local()  # slice_lane() pin: route dispatches to one slice
 
 
 def _mesh_state() -> dict:
+    n = active_size()
     return {
         "configured": str(_configured) if _configured is not None else "",
-        "size": active_size(),
+        "size": n,
+        "grid": "x".join(map(str, _grid)) if _grid else "",
+        "slices": slice_count(),
     }
 
 
@@ -79,42 +104,90 @@ def configure(spec: int | str | None) -> int:
     """Select the process-wide mesh size; returns the resolved size.
 
     ``spec``: an int, a decimal string, ``"auto"`` (every visible device),
-    or None (fall back to the KASPA_TPU_MESH env var, default 1).  Sizes
+    an ``"RxC"`` grid (R slices of C devices — the 2-D hybrid mesh), or
+    None (fall back to the KASPA_TPU_MESH env var, default 1).  Sizes
     above the visible device count clamp; <= 1 disables mesh dispatch.
     """
-    global _configured, _active
+    global _configured, _active, _grid
     with _lock:
         _configured = spec if spec is not None else os.environ.get("KASPA_TPU_MESH", 1)
         _active = None  # re-resolve on next use
+        _grid = None
     return active_size()
 
 
 def active_size() -> int:
     """Resolved mesh size (1 = mesh dispatch disabled)."""
-    global _configured, _active
+    global _configured, _active, _grid
     if _active is None:
         with _lock:
             if _active is None:
                 spec = _configured if _configured is not None else os.environ.get("KASPA_TPU_MESH", 1)
                 _configured = spec
-                _active = _resolve(spec)
+                _active, _grid = _resolve(spec)
     return _active
 
 
-def _resolve(spec: int | str) -> int:
+def grid() -> tuple[int, int] | None:
+    """The resolved (slices, shards-per-slice) grid, or None in 1-D mode."""
+    active_size()
+    return _grid
+
+
+def slice_count() -> int:
+    """Mesh slices of the active grid (1 in 1-D / disabled mode)."""
+    g = grid()
+    return g[0] if g else 1
+
+
+def slice_width() -> int:
+    """Devices per slice of the active grid (= mesh size in 1-D mode)."""
+    g = grid()
+    return g[1] if g else active_size()
+
+
+def _resolve(spec: int | str) -> tuple[int, int | None]:
     import jax
 
+    ndev = len(jax.devices())
     if isinstance(spec, str):
         spec = spec.strip().lower()
+        if "x" in spec:
+            r_s, _, c_s = spec.partition("x")
+            r, c = int(r_s or 1), int(c_s or 1)
+            # clamp the grid to the visible devices, preferring to keep the
+            # slice count (the fabric's unit of failover) over slice width
+            r = max(1, min(r, ndev))
+            c = max(1, min(c, ndev // r))
+            if r <= 1:
+                return (c if c > 1 else 1), None
+            return r * c, (r, c)
         if spec in ("auto", "all"):
-            n = len(jax.devices())
+            n = ndev
         else:
             n = int(spec or 1)
     else:
         n = int(spec)
     if n <= 1:
-        return 1
-    return min(n, len(jax.devices()))
+        return 1, None
+    return min(n, ndev), None
+
+
+@contextlib.contextmanager
+def slice_lane(idx: int | None):
+    """Pin this thread's verify dispatches to mesh slice ``idx`` (no-op
+    when no 2-D grid is configured or ``idx`` is None) — the fabric slice
+    workers wrap their device calls in this so concurrent slices run on
+    disjoint devices."""
+    if idx is None or _grid is None:
+        yield
+        return
+    prev = getattr(_slice_tls, "idx", None)
+    _slice_tls.idx = idx % _grid[0]
+    try:
+        yield
+    finally:
+        _slice_tls.idx = prev
 
 
 @functools.lru_cache(maxsize=None)
@@ -125,6 +198,118 @@ def _mesh(n: int):
     devices = np.array(jax.devices()[:n])
     assert len(devices) == n, f"mesh size {n} exceeds visible devices {len(jax.devices())}"
     return Mesh(devices, axis_names=("shard",))
+
+
+@functools.lru_cache(maxsize=None)
+def _device_grid(r: int, c: int) -> np.ndarray:
+    """[r, c] device array for the hybrid mesh: `create_hybrid_device_mesh`
+    when the process set actually spans hosts (slices ride the slow DCN
+    axis, shards the fast ICI axis), plain local reshape otherwise (the
+    single-host / CPU-test topology, where the hybrid helper has no slice
+    metadata to work with)."""
+    import jax
+
+    if jax.process_count() > 1:
+        try:
+            from jax.experimental.mesh_utils import create_hybrid_device_mesh
+
+            return np.asarray(
+                create_hybrid_device_mesh((1, c), (r, 1), devices=jax.devices())
+            ).reshape(r, c)
+        except Exception:  # noqa: BLE001 - topology metadata absent: fall back
+            pass
+    return np.array(jax.devices()[: r * c]).reshape(r, c)
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh2d(r: int, c: int):
+    from jax.sharding import Mesh
+
+    return Mesh(_device_grid(r, c), axis_names=("slice", "shard"))
+
+
+@functools.lru_cache(maxsize=None)
+def _slice_mesh(r: int, c: int, idx: int):
+    """1-D mesh over slice ``idx``'s row of the grid — slice-pinned
+    dispatches reuse the plain ("shard",) kernel entries on it."""
+    from jax.sharding import Mesh
+
+    return Mesh(_device_grid(r, c)[idx], axis_names=("shard",))
+
+
+# --- partition-rule registry ------------------------------------------------
+# regex -> PartitionSpec axes, first match wins (the t5x/EasyLM registry
+# idiom): verify/muhash operands are pure batch-dim data parallelism, so
+# the batch axis shards over every mesh axis and everything else
+# replicates.  register_partition_rule() lets a new kernel claim a layout
+# without touching the dispatch plumbing.
+DEFAULT_PARTITION_RULES: tuple = (
+    (r"(px|py|rc|.*digits|elements)$", (("slice", "shard"), None)),
+    (r"(valid_in|mask)$", (("slice", "shard"),)),
+    (r".*", ()),  # replicate
+)
+
+_partition_rules: list = list(DEFAULT_PARTITION_RULES)
+
+
+def register_partition_rule(pattern: str, axes: tuple) -> None:
+    """Prepend one (regex, PartitionSpec axes) rule (first match wins)."""
+    _partition_rules.insert(0, (pattern, axes))
+
+
+def _axes_for_1d(axes: tuple) -> tuple:
+    """Project a 2-D rule onto a 1-D ("shard",) mesh: the composite
+    ("slice", "shard") batch axis collapses to "shard"."""
+    return tuple("shard" if isinstance(a, tuple) else a for a in axes)
+
+
+def partition_spec_for(name: str, *, flat: bool = False):
+    """PartitionSpec for a named operand per the registry; ``flat=True``
+    projects onto the 1-D mesh axis."""
+    from jax.sharding import PartitionSpec as P
+
+    for pattern, axes in _partition_rules:
+        if re.fullmatch(pattern, name):
+            return P(*(_axes_for_1d(axes) if flat else axes))
+    return P()
+
+
+def match_partition_rules(rules, tree: dict) -> dict:
+    """Map a (possibly nested) dict of named arrays to PartitionSpecs by
+    first-matching regex on the '/'-joined path — the SNIPPETS registry
+    shape, usable for any future parameter pytree."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(prefix: str, node):
+        if isinstance(node, dict):
+            return {k: walk(f"{prefix}/{k}" if prefix else k, v) for k, v in node.items()}
+        for pattern, axes in rules:
+            if re.search(pattern, prefix):
+                return P(*axes)
+        return P()
+
+    return walk("", tree)
+
+
+def constrain(x, name: str):
+    """`with_sharding_constraint` under the registry's spec for ``name`` —
+    a no-op on CPU or when no 2-D grid is configured (the SNIPPETS [3]
+    CPU-fallback contract), so call sites never need backend guards."""
+    g = grid()
+    if g is None:
+        return x
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return x
+    try:
+        from jax.sharding import NamedSharding
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(_mesh2d(*g), partition_spec_for(name))
+        )
+    except Exception:  # noqa: BLE001 - outside jit / mesh ctx: identity
+        return x
 
 
 def _pad_rows(arr: np.ndarray, m: int) -> np.ndarray:
@@ -151,19 +336,61 @@ def _observe(kernel: str, logical: int, padded: int, n: int) -> None:
 # --- batched signature verification ---------------------------------------
 
 
-@functools.lru_cache(maxsize=None)
-def _verify_entry(kind: str, n: int):
-    """Cached shard_map-jitted verify kernel for one (kind, mesh size)."""
-    import jax
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
+_VERIFY_ARG_NAMES = ("px", "py", "rc", "d1_digits", "d2_digits", "valid_in")
 
+
+def _verify_kernel(kind: str):
     from kaspa_tpu.ops.secp256k1 import verify as v
 
-    kernel = (v.schnorr_verify_kernel if kind == "schnorr" else v.ecdsa_verify_kernel).__wrapped__
-    lane = P("shard", None)
-    flat = P("shard")
-    fn = shard_map(kernel, mesh=_mesh(n), in_specs=(lane,) * 5 + (flat,), out_specs=flat)
+    return (v.schnorr_verify_kernel if kind == "schnorr" else v.ecdsa_verify_kernel).__wrapped__
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_entry(kind: str, n: int):
+    """Cached shard_map-jitted verify kernel for one (kind, mesh size);
+    in/out specs come from the partition-rule registry projected onto the
+    1-D ("shard",) axis."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    in_specs = tuple(partition_spec_for(nm, flat=True) for nm in _VERIFY_ARG_NAMES)
+    out_specs = partition_spec_for("mask", flat=True)
+    fn = shard_map(_verify_kernel(kind), mesh=_mesh(n), in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_entry_2d(kind: str, r: int, c: int):
+    """Full-grid entry: batch axis sharded over ("slice", "shard") — the
+    same per-device local shapes (and thus the same trace cost and
+    bit-identical masks) as the 1-D entry of size r*c."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    in_specs = tuple(partition_spec_for(nm) for nm in _VERIFY_ARG_NAMES)
+    out_specs = partition_spec_for("mask")
+
+    kernel = _verify_kernel(kind)
+
+    def wrapped(*args):
+        return constrain(kernel(*args), "mask")
+
+    fn = shard_map(wrapped, mesh=_mesh2d(r, c), in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_entry_slice(kind: str, r: int, c: int, idx: int):
+    """Slice-pinned entry: the 1-D kernel over slice ``idx``'s devices, so
+    concurrent fabric slice workers occupy disjoint hardware."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    in_specs = tuple(partition_spec_for(nm, flat=True) for nm in _VERIFY_ARG_NAMES)
+    out_specs = partition_spec_for("mask", flat=True)
+    fn = shard_map(
+        _verify_kernel(kind), mesh=_slice_mesh(r, c, idx), in_specs=in_specs, out_specs=out_specs
+    )
     return jax.jit(fn)
 
 
@@ -171,6 +398,9 @@ def dispatch_verify(kind: str, px, py, rc, d1_digits, d2_digits, valid_in) -> np
     """Batch-dim sharded verify: pads to a shard multiple, dispatches the
     cached shard_map entry, unpads the mask.  Pad lanes carry zeroed limbs
     and ``valid_in=False`` so they can never contribute a True.
+
+    With a 2-D grid configured, a thread inside ``slice_lane(i)`` runs on
+    slice i's devices only; unpinned threads shard over the full grid.
     """
     from kaspa_tpu.resilience.faults import FAULTS
 
@@ -178,7 +408,15 @@ def dispatch_verify(kind: str, px, py, rc, d1_digits, d2_digits, valid_in) -> np
     # shard_map dispatch); propagates into the device breaker like any
     # other dispatch failure
     FAULTS.fire("device.mesh.dispatch")
-    n = active_size()
+    total = active_size()
+    g = _grid
+    pin = getattr(_slice_tls, "idx", None) if g else None
+    if g is None:
+        n, entry = total, _verify_entry(kind, total)
+    elif pin is not None:
+        n, entry = g[1], _verify_entry_slice(kind, g[0], g[1], pin)
+    else:
+        n, entry = total, _verify_entry_2d(kind, g[0], g[1])
     px = np.asarray(px)
     b = px.shape[0]
     if b == 0:
@@ -192,8 +430,11 @@ def dispatch_verify(kind: str, px, py, rc, d1_digits, d2_digits, valid_in) -> np
         _pad_rows(d2_digits, m),
         _pad_rows(np.asarray(valid_in, dtype=bool), m),
     )
-    mask = np.asarray(_verify_entry(kind, n)(*args))
+    mask = np.asarray(entry(*args))
     _observe(kind, b, m, n)
+    if pin is not None:
+        _SLICE_DISPATCHES.inc(str(pin))
+        _SLICE_JOBS.inc(str(pin), b)
     return mask[:b]
 
 

@@ -13,6 +13,14 @@ Fault points are named strings compiled into the hot layers:
     p2p.recv             incoming frame read (p2p/transport.py)
     storage.commit       write-batch commit (storage/kv.py, both engines)
     storage.flush        python-engine log append (storage/kv.py)
+    fabric.send          outgoing verify-fabric request (fabric/client.py);
+                         cooperative modes sever/corrupt/drop the frame,
+                         the balancer fails over to the next slice
+    fabric.recv          incoming verify-fabric frame (fabric/client.py)
+    fabric.slice_hang    verifyd slice worker pre-dispatch (fabric/
+                         service.py): mode "slow"/"hang" stalls the slice
+                         past the balancer's deadline so the per-slice
+                         breaker trips with cause ``hung``
 
 A *schedule* maps point name -> spec dict:
 

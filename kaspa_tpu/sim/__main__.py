@@ -42,6 +42,13 @@ def main() -> None:
         "queue with super-batch target N ('auto' = best batch from BENCH_SWEEP.json; "
         "default off — results are bit-identical either way)",
     )
+    p.add_argument(
+        "--fabric", default=None, metavar="ADDR[,ADDR...]",
+        help="route the replay's verify batches to remote verifyd slices "
+        "(`python -m kaspa_tpu.fabric.service`) through the cross-host "
+        "balancer; results stay bit-identical (host degraded lane on slice "
+        "loss) and the JSON report gains a 'fabric' stats block",
+    )
     p.add_argument("--json", action="store_true", help="emit one JSON line")
     p.add_argument(
         "--pipeline", action="store_true",
@@ -85,6 +92,11 @@ def main() -> None:
 
     mesh_size = mesh.configure(args.mesh)
     coalesce_target = coalesce.configure(args.coalesce)
+    fabric_bal = None
+    if args.fabric:
+        from kaspa_tpu.fabric import balancer as fabric_balancer
+
+        fabric_bal = fabric_balancer.configure(args.fabric)
     cfg = SimConfig(
         bps=args.bps, delay=args.delay, num_miners=args.miners,
         num_blocks=args.blocks, txs_per_block=args.tpb, seed=args.seed,
@@ -128,6 +140,12 @@ def main() -> None:
         "pipeline": bool(args.pipeline),
         "tracing": not args.notrace,
     }
+    if fabric_bal is not None:
+        from kaspa_tpu.fabric import balancer as fabric_balancer
+
+        fabric_bal.drain(timeout=30.0)
+        out["fabric"] = fabric_bal.stats()
+        fabric_balancer.shutdown(timeout=10.0)
     if args.pipeline:
         from kaspa_tpu.pipeline.speculative import SpeculativeVerifier
 

@@ -3,6 +3,16 @@
 Call ``setup()`` once from every entry point (tests, bench, node, tools).
 Enables the persistent XLA compilation cache so the big crypto ladders
 compile once per machine rather than once per process.
+
+``KASPA_TPU_HOST_DEVICES=N`` splits the host CPU backend into N XLA
+devices (the ergonomic spelling of
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``): it lets
+``--mesh auto`` / ``--mesh N`` / ``--mesh RxC`` find N devices on a
+CPU-only box without the caller hand-assembling XLA_FLAGS.  It must be
+seen before the first ``import jax`` in the process, so every entry
+point calls ``setup()`` at module import time, ahead of any jax-touching
+import.  An explicit device-count flag already present in XLA_FLAGS
+wins — the knob never overrides a deliberate setting.
 """
 
 from __future__ import annotations
@@ -18,11 +28,29 @@ def cache_dir() -> str:
     return os.environ.get("KASPA_TPU_JAX_CACHE", os.path.expanduser("~/.cache/kaspa_tpu_jax"))
 
 
+def _apply_host_devices() -> None:
+    """Fold KASPA_TPU_HOST_DEVICES=N into XLA_FLAGS (pre-`import jax`)."""
+    knob = os.environ.get("KASPA_TPU_HOST_DEVICES", "").strip()
+    if not knob:
+        return
+    try:
+        n = int(knob)
+    except ValueError:
+        raise SystemExit(f"KASPA_TPU_HOST_DEVICES must be an integer, got {knob!r}")
+    if n < 1:
+        raise SystemExit(f"KASPA_TPU_HOST_DEVICES must be >= 1, got {n}")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" in flags:
+        return  # an explicit XLA_FLAGS setting wins over the knob
+    os.environ["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
 def setup(cache_dir: str | None = None) -> None:
     global _DONE
     if _DONE:
         return
     _DONE = True
+    _apply_host_devices()
     import jax
 
     # KASPA_TPU_PLATFORM=cpu forces the CPU backend even where a platform

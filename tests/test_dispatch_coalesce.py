@@ -14,6 +14,8 @@ fresh XLA compile on CPU, minutes of tier-1 budget).
 import hashlib
 import json
 import random
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -125,6 +127,104 @@ def test_kernel_error_surfaces_on_ticket():
     t = coalesce.active().submit("schnorr", [(None, None, None)])
     with pytest.raises(TypeError):
         t.wait(300.0)
+
+
+# --- close() racing an in-flight job ----------------------------------------
+
+
+def _hold_kernel(monkeypatch):
+    """Replace the schnorr kernel with one that parks inside the device
+    call until released, so a super-batch can be held in flight while the
+    test races close() against it."""
+    from kaspa_tpu.crypto import secp
+
+    entered, release = threading.Event(), threading.Event()
+    real = secp.schnorr_verify_batch
+
+    def slow(items):
+        entered.set()
+        release.wait(30.0)
+        return real(items)
+
+    monkeypatch.setattr(secp, "schnorr_verify_batch", slow)
+    return entered, release, real
+
+
+def _count_resolves(monkeypatch):
+    counts: dict[int, int] = {}
+    orig = coalesce.Ticket._resolve
+
+    def counting(self, mask, error):
+        counts[id(self)] = counts.get(id(self), 0) + 1
+        return orig(self, mask, error)
+
+    monkeypatch.setattr(coalesce.Ticket, "_resolve", counting)
+    return counts
+
+
+def test_close_waits_out_in_flight_job(monkeypatch):
+    """close() while a super-batch is mid-device-call and the call
+    finishes inside the drain window: the ticket resolves exactly once,
+    with its real mask — close never clobbers a job that is about to
+    complete."""
+    entered, release, real = _hold_kernel(monkeypatch)
+    counts = _count_resolves(monkeypatch)
+    coalesce.configure(16)
+    eng = coalesce.active()
+
+    items = _schnorr_items(7)
+    direct = np.asarray(real(items)).tolist()
+    t = eng.submit("schnorr", items)
+    eng.nudge()
+    assert entered.wait(30.0)  # the chunk is now inside the kernel
+
+    threading.Timer(0.3, release.set).start()
+    assert eng.close(timeout=30.0) is True
+    assert [bool(v) for v in t.wait(1.0)] == direct
+    assert counts[id(t)] == 1
+
+
+def test_close_timeout_abandons_in_flight_job_exactly_once(monkeypatch):
+    """close() whose drain window expires while the job is still wedged
+    in the device call: the ticket fails with DispatchAbandoned, and the
+    late result the hung thread eventually produces is discarded at the
+    chunk layer — the ticket resolves exactly once, never a second time."""
+    entered, release, _ = _hold_kernel(monkeypatch)
+    counts = _count_resolves(monkeypatch)
+    coalesce.configure(16)
+    eng = coalesce.active()
+
+    finishes: list[bool] = []
+    orig_finish = eng._finish
+
+    def recording_finish(chunk, mask, error):
+        r = orig_finish(chunk, mask, error)
+        finishes.append(r)
+        return r
+
+    monkeypatch.setattr(eng, "_finish", recording_finish)
+
+    t = eng.submit("schnorr", _schnorr_items(7))
+    eng.nudge()
+    assert entered.wait(30.0)
+
+    assert eng.close(timeout=0.2) is False  # drain expires, job still wedged
+    assert t.done()
+    with pytest.raises(coalesce.DispatchAbandoned):
+        t.wait(1.0)
+    assert eng.stats()["abandoned"] is True
+    assert finishes == [True]  # the abandon resolution
+
+    # let the wedged kernel call complete; its late result must be
+    # discarded (finish returns False), not resolved into the ticket
+    release.set()
+    deadline = time.monotonic() + 30.0
+    while len(finishes) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert finishes == [True, False]
+    assert counts[id(t)] == 1
+    with pytest.raises(coalesce.DispatchAbandoned):
+        t.wait(1.0)  # still the abandonment, not the late mask
 
 
 # --- the production path ----------------------------------------------------

@@ -37,6 +37,8 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             "--skip-chaos",
             "--skip-dispatch",
             "--skip-obs",
+            # and the fabric drill (a verifyd subprocess + three replays)
+            "--skip-fabric",
             "--blocks",
             "8",
             "--out",
@@ -54,6 +56,30 @@ def test_roundcheck_writes_round_evidence(tmp_path):
     sim = evidence["sections"]["sim"]
     assert sim["ok"] and sim["result"]["blocks"] == 8
     assert "created" in evidence
+
+
+def test_roundcheck_only_selector(tmp_path):
+    """--only SECTION runs exactly the named sections (skip flags ignored)
+    and every section records its own wall_seconds in the artifact."""
+    out = tmp_path / "RC.json"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "tools", "roundcheck.py"),
+            "--only", "sim", "--skip-sim", "--blocks", "8", "--out", str(out),
+        ],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout
+    evidence = json.loads(out.read_text())
+    assert list(evidence["sections"]) == ["sim"]
+    assert evidence["sections"]["sim"]["wall_seconds"] >= 0
+    # unknown section names fail fast instead of silently running nothing
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "roundcheck.py"), "--only", "nope"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, timeout=60,
+    )
+    assert bad.returncode != 0 and "unknown --only" in bad.stdout
 
 
 def test_bench_wedge_dossier_shape(tmp_path, monkeypatch):

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# The pre-PR fast lane: tier-1 tests + wire-freeze fixture checks.
+#
+# Runs, in order:
+#   1. proto golden-fixture check  (tools/gen_proto_fixtures.py --check)
+#   2. borsh golden-fixture check  (tools/gen_borsh_fixtures.py --check)
+#   3. the tier-1 pytest fast lane (tests/, -m "not slow")
+#
+# The fixture checks re-encode every sample payload in memory and diff
+# against the committed bytes under tests/fixtures/{proto,borsh} — any
+# drift is a wire break and fails before the test suite even starts.
+# roundcheck's tier1 section shells out to this script, and it is the
+# gate to run locally before opening a PR:
+#
+#     bash tools/ci_fastlane.sh
+#
+# Exit 0 iff all three stages pass.
+
+set -u
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY="${PYTHON:-python}"
+
+fail=0
+
+echo "[ci_fastlane] 1/3 proto wire-freeze check"
+"$PY" tools/gen_proto_fixtures.py --check || fail=1
+
+echo "[ci_fastlane] 2/3 borsh wire-freeze check"
+"$PY" tools/gen_borsh_fixtures.py --check || fail=1
+
+echo "[ci_fastlane] 3/3 tier-1 fast lane"
+pytest_log="$(mktemp)"
+trap 'rm -f "$pytest_log"' EXIT
+"$PY" -m pytest tests/ -q -m "not slow" \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$pytest_log"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ]; then
+    # --continue-on-collection-errors still exits 2 when a pre-existing
+    # collection error (missing goref testdata) is carried; gate on the
+    # summary line instead, exactly as roundcheck's tier1 section does
+    summary="$(grep -E 'passed' "$pytest_log" | tail -n 1)"
+    if [ -n "$summary" ] && ! printf '%s' "$summary" | grep -q 'failed'; then
+        rc=0
+    fi
+fi
+[ "$rc" -eq 0 ] || fail=1
+
+if [ "$fail" -eq 0 ]; then
+    echo "[ci_fastlane] PASS"
+else
+    echo "[ci_fastlane] FAIL"
+fi
+exit "$fail"

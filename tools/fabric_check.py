@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Two-process verify-fabric drill: bit-identity + slice-kill failover.
+
+Spawns a standalone verifyd slice server (`python -m
+kaspa_tpu.fabric.service`), replays the same simulated DAG three ways in
+this process — local-only, over the fabric, and over the fabric with the
+server SIGKILLed mid-replay — and gates on:
+
+- the fabric replay reaching the byte-identical sink + utxo_commitment
+  of the local-only replay, with remote chunks actually served and the
+  balancer's zero-lost-tickets invariant holding (``lost == 0``);
+- the kill drill converging to the same fingerprints
+  (``matches_fault_free``) with every post-kill chunk absorbed by the
+  bit-identical host degraded lane — failover loses nothing.
+
+Prints one JSON line (the roundcheck ``fabric`` section consumes it);
+exit 0 iff every gate holds.
+
+    python tools/fabric_check.py --blocks 24
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kaspa_tpu.utils import jax_setup  # noqa: E402
+
+jax_setup.setup()
+
+from kaspa_tpu.fabric import balancer as fabric_balancer  # noqa: E402
+from kaspa_tpu.sim.simulator import SimConfig, replay, simulate  # noqa: E402
+
+
+def _log(msg: str) -> None:
+    print(f"[fabric_check] {msg}", file=sys.stderr, flush=True)
+
+
+def _spawn_server(slices: int) -> tuple[subprocess.Popen, str]:
+    """Start a verifyd on an ephemeral port; returns (proc, host:port)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kaspa_tpu.fabric.service",
+         "--listen", "127.0.0.1:0", "--slices", str(slices)],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE, text=True,
+    )
+    line = proc.stdout.readline()
+    try:
+        info = json.loads(line)
+    except (json.JSONDecodeError, TypeError):
+        proc.kill()
+        raise SystemExit(f"verifyd failed to start (got {line!r})")
+    return proc, info["fabric_listen"]
+
+
+def _stop_server(proc: subprocess.Popen) -> None:
+    if proc.poll() is None:
+        proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=10)
+    proc.stdout.close()
+
+
+def _warm_server(addr: str) -> None:
+    """One verify round-trip with a generous deadline before the drill
+    arms its short one: a fresh verifyd pays the first-dispatch kernel
+    trace/compile on its first request, and the drill must measure
+    failover behaviour, not cold-start compile latency."""
+    import hashlib
+
+    from kaspa_tpu.crypto import eclib
+
+    msg = hashlib.sha256(b"fabric-warmup").digest()
+    items = [(eclib.schnorr_pubkey(7), msg, eclib.schnorr_sign(msg, 7))]
+    warm = fabric_balancer.FabricBalancer([addr], deadline_s=300.0)  # not installed
+    try:
+        if not warm.submit("schnorr", items).wait(timeout=300.0).all():
+            raise SystemExit("fabric warmup verified a valid signature as invalid")
+    finally:
+        warm.close(timeout=10.0)
+
+
+def _fingerprints(fresh) -> dict:
+    sink = fresh.sink()
+    return {"sink": sink.hex(), "utxo_commitment": fresh.multisets[sink].finalize().hex()}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--blocks", type=int, default=24,
+                    help="replay length (>= ~24 so coinbase maturity passes and real "
+                    "signature batches flow over the wire)")
+    ap.add_argument("--tpb", type=int, default=4)
+    ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--deadline", type=float, default=3.0,
+                    help="kill-drill fabric deadline: how long a chunk may hang on the "
+                    "dead server before the per-slice breaker trips and it fails over")
+    args = ap.parse_args(argv)
+
+    t_start = time.monotonic()
+    cfg = SimConfig(bps=2, num_blocks=args.blocks, txs_per_block=args.tpb, seed=args.seed)
+    res = simulate(cfg)
+    _log(f"built {len(res.blocks)} blocks / {res.total_txs} txs")
+
+    _, fresh = replay(res)
+    base = _fingerprints(fresh)
+    _log(f"local-only replay: sink {base['sink'][:16]}…")
+
+    # --- fabric replay: identical fingerprints, chunks actually remote ---
+    proc, addr = _spawn_server(args.slices)
+    try:
+        bal = fabric_balancer.configure(addr)
+        _, fresh2 = replay(res)
+        bal.drain(timeout=30.0)
+        fab = _fingerprints(fresh2)
+        stats = bal.stats()
+    finally:
+        fabric_balancer.shutdown(timeout=10.0)
+        _stop_server(proc)
+    identity = {
+        "matches_local": fab == base,
+        "remote_chunks": stats["remote"],
+        "degraded_chunks": stats["degraded"],
+        "lost": stats["lost"],
+        "slices": stats["slices"],
+    }
+    _log(f"fabric replay: matches={identity['matches_local']} remote={stats['remote']} lost={stats['lost']}")
+
+    # --- slice-kill drill: SIGKILL the server mid-replay, lose nothing ---
+    proc2, addr2 = _spawn_server(args.slices)
+    _warm_server(addr2)
+    killed = threading.Event()
+    stop_watch = threading.Event()
+
+    def _killer(bal2):
+        # wait for the first remotely-served chunk so the kill provably
+        # lands mid-replay, then let a little more traffic through
+        while not stop_watch.is_set():
+            if bal2.stats()["remote"] >= 1:
+                time.sleep(0.3)
+                if proc2.poll() is None:
+                    proc2.send_signal(signal.SIGKILL)
+                killed.set()
+                return
+            time.sleep(0.05)
+
+    try:
+        bal2 = fabric_balancer.configure(addr2, deadline_s=args.deadline)
+        watcher = threading.Thread(target=_killer, args=(bal2,), daemon=True)
+        watcher.start()
+        _, fresh3 = replay(res)
+        bal2.drain(timeout=30.0)
+        fab3 = _fingerprints(fresh3)
+        st3 = bal2.stats()
+    finally:
+        stop_watch.set()
+        fabric_balancer.shutdown(timeout=10.0)
+        _stop_server(proc2)
+    drill = {
+        "killed_mid_replay": killed.is_set(),
+        "matches_fault_free": fab3 == base,
+        "remote_chunks": st3["remote"],
+        "degraded_chunks": st3["degraded"],
+        "failovers": st3["failovers"],
+        "breaker_trips": sum(s["trips"] for s in st3["slices"]),
+        "lost": st3["lost"],
+    }
+    _log(
+        f"kill drill: killed={drill['killed_mid_replay']} matches={drill['matches_fault_free']} "
+        f"degraded={drill['degraded_chunks']} lost={drill['lost']}"
+    )
+
+    ok = (
+        identity["matches_local"]
+        and identity["remote_chunks"] >= 1
+        and identity["lost"] == 0
+        and drill["killed_mid_replay"]
+        and drill["matches_fault_free"]
+        and drill["degraded_chunks"] >= 1
+        and drill["lost"] == 0
+    )
+    print(json.dumps({
+        "fabric_ok": ok,
+        "blocks": len(res.blocks),
+        "txs": res.total_txs,
+        "identity": identity,
+        "kill_drill": drill,
+        "seconds": round(time.monotonic() - t_start, 1),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

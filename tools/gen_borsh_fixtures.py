@@ -7,7 +7,12 @@ sizes.  Run after an intentional wire change and commit the diff —
 tests/test_wrpc.py pins these bytes (and the op numbers: a renumbered op
 is a wire break for every deployed client).
 
-    python tools/gen_borsh_fixtures.py
+    python tools/gen_borsh_fixtures.py          # rewrite fixtures
+    python tools/gen_borsh_fixtures.py --check  # re-encode in memory, diff
+
+``--check`` never touches disk: it re-encodes every sample frame and
+fails (exit 1) on any byte or op drift against the committed fixtures +
+manifest — the ci_fastlane.sh wire-freeze gate.
 """
 
 from __future__ import annotations
@@ -21,8 +26,38 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from kaspa_tpu.rpc.borsh_vectors import sample_frames  # noqa: E402
 
 
-def main() -> None:
+def check(out_dir: str) -> int:
+    """Diff in-memory re-encodes (bytes + ops) against the committed fixtures."""
+    try:
+        with open(os.path.join(out_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        manifest = {}
+    drift = []
+    frames = sorted(sample_frames().items())
+    for name, (op, data) in frames:
+        path = os.path.join(out_dir, f"{name}.bin")
+        try:
+            with open(path, "rb") as f:
+                pinned = f.read()
+        except FileNotFoundError:
+            drift.append(f"{name}: fixture missing (run tools/gen_borsh_fixtures.py)")
+            continue
+        if pinned != data:
+            drift.append(f"{name}: {len(pinned)} pinned bytes != {len(data)} re-encoded")
+        if name in manifest and manifest[name]["op"] != op:
+            drift.append(f"{name}: op renumbered {manifest[name]['op']} -> {op} (wire break)")
+    for line in drift:
+        print(f"borsh fixture drift: {line}", file=sys.stderr)
+    if not drift:
+        print(f"borsh fixtures: {len(frames)} frames byte-identical, ops stable")
+    return 1 if drift else 0
+
+
+def main(argv: list[str] | None = None) -> int:
     out_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures", "borsh")
+    if "--check" in (argv if argv is not None else sys.argv[1:]):
+        return check(out_dir)
     os.makedirs(out_dir, exist_ok=True)
     manifest = {}
     for name, (op, data) in sorted(sample_frames().items()):
@@ -33,7 +68,8 @@ def main() -> None:
         json.dump(manifest, f, indent=2, sort_keys=True)
         f.write("\n")
     print(f"wrote {len(manifest)} fixtures to {os.path.relpath(out_dir)}")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

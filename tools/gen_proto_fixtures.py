@@ -6,7 +6,12 @@ tests/fixtures/proto/<msg_type>.bin plus a manifest with sizes and the
 schema oneof key per type.  Run after an intentional schema change and
 commit the diff — tests/test_proto_wire.py pins these bytes.
 
-    python tools/gen_proto_fixtures.py
+    python tools/gen_proto_fixtures.py          # rewrite fixtures
+    python tools/gen_proto_fixtures.py --check  # re-encode in memory, diff
+
+``--check`` never touches disk: it re-encodes every sample payload and
+fails (exit 1) on any byte drift against the committed fixtures — the
+ci_fastlane.sh wire-freeze gate.
 """
 
 from __future__ import annotations
@@ -21,12 +26,41 @@ from kaspa_tpu.p2p.proto.codec import _CONVERTERS, encode_kaspad_message  # noqa
 from kaspa_tpu.p2p.proto.vectors import sample_payloads  # noqa: E402
 
 
-def main() -> None:
+def _encode_all() -> dict[str, bytes]:
+    return {
+        msg_type: encode_kaspad_message(msg_type, payload)
+        for msg_type, payload in sorted(sample_payloads().items())
+    }
+
+
+def check(out_dir: str) -> int:
+    """Diff in-memory re-encodes against the committed fixture bytes."""
+    drift = []
+    frames = _encode_all()
+    for msg_type, data in frames.items():
+        path = os.path.join(out_dir, f"{msg_type}.bin")
+        try:
+            with open(path, "rb") as f:
+                pinned = f.read()
+        except FileNotFoundError:
+            drift.append(f"{msg_type}: fixture missing (run tools/gen_proto_fixtures.py)")
+            continue
+        if pinned != data:
+            drift.append(f"{msg_type}: {len(pinned)} pinned bytes != {len(data)} re-encoded")
+    for line in drift:
+        print(f"proto fixture drift: {line}", file=sys.stderr)
+    if not drift:
+        print(f"proto fixtures: {len(frames)} frames byte-identical")
+    return 1 if drift else 0
+
+
+def main(argv: list[str] | None = None) -> int:
     out_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures", "proto")
+    if "--check" in (argv if argv is not None else sys.argv[1:]):
+        return check(out_dir)
     os.makedirs(out_dir, exist_ok=True)
     manifest = {}
-    for msg_type, payload in sorted(sample_payloads().items()):
-        data = encode_kaspad_message(msg_type, payload)
+    for msg_type, data in _encode_all().items():
         with open(os.path.join(out_dir, f"{msg_type}.bin"), "wb") as f:
             f.write(data)
         manifest[msg_type] = {"oneof": _CONVERTERS[msg_type][0], "bytes": len(data)}
@@ -34,7 +68,8 @@ def main() -> None:
         json.dump(manifest, f, indent=2, sort_keys=True)
         f.write("\n")
     print(f"wrote {len(manifest)} fixtures to {os.path.relpath(out_dir)}")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -17,12 +17,21 @@ summarizing them — the artifact a driver round or a reviewer reads
 instead of eight scrollback logs.
 
     python tools/roundcheck.py                     # everything
+    python tools/roundcheck.py --only tier1        # just one section
+    python tools/roundcheck.py --only sim --only fabric
     python tools/roundcheck.py --skip-bench        # no device probe
     python tools/roundcheck.py --skip-mesh         # no multichip/mesh lanes
     python tools/roundcheck.py --skip-obs          # no flight-recorder lane
     python tools/roundcheck.py --skip-chaos        # no fault-injection sustain
     python tools/roundcheck.py --skip-supervision  # no wedge drill
+    python tools/roundcheck.py --skip-fabric       # no two-process fabric drill
     python tools/roundcheck.py --out my.json       # custom artifact path
+
+``--only SECTION`` (repeatable, or comma-separated) runs exactly the
+named sections and ignores the skip flags; section names are the keys in
+ROUNDCHECK.json (tier1, sim, bench_probe, multichip, mesh_smoke,
+dispatch, serving, obs, tenbps, chaos, supervision, fabric).  Every
+section records its own ``wall_seconds`` in the artifact.
 
 Exit code 0 iff every section that ran passed.
 """
@@ -38,11 +47,10 @@ import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-TIER1_CMD = [
-    sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
-    "--continue-on-collection-errors", "-p", "no:cacheprovider",
-    "-p", "no:xdist", "-p", "no:randomly",
-]
+# the tier1 section shells out to the pre-PR gate script so roundcheck
+# and a bare `bash tools/ci_fastlane.sh` can never disagree on what
+# "tier-1 green" means (fast-lane pytest + proto/borsh wire-freeze checks)
+FASTLANE_CMD = ["bash", os.path.join(REPO_ROOT, "tools", "ci_fastlane.sh")]
 
 
 def _utc() -> str:
@@ -178,6 +186,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-obs", action="store_true", help="skip the flight-recorder traced-replay lane")
     ap.add_argument("--skip-tenbps", action="store_true", help="skip the 10-BPS speculative-pipeline lane")
     ap.add_argument("--skip-supervision", action="store_true", help="skip the device-supervision wedge drill")
+    ap.add_argument("--skip-fabric", action="store_true", help="skip the two-process verify-fabric drill")
+    ap.add_argument(
+        "--only", action="append", default=None, metavar="SECTION",
+        help="run only the named section(s); repeatable or comma-separated, "
+        "overrides every --skip-* flag",
+    )
     ap.add_argument("--chaos-blocks", type=int, default=24, help="chaos sustain main-DAG length")
     # long enough that coinbase maturity passes and real signature batches
     # flow through the sharded verify path (a 12-block replay carries 0 txs)
@@ -188,21 +202,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "ROUNDCHECK.json"))
     args = ap.parse_args(argv)
 
-    evidence: dict = {"created": _utc(), "sections": {}}
-    ok = True
+    # forced 8 CPU host devices: the mesh lanes must work on any box the
+    # round runs on, with or without a real accelerator
+    mesh_env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
 
-    if not args.skip_tests:
-        sect = _run(TIER1_CMD, args.test_timeout, {"JAX_PLATFORMS": "cpu"})
-        # a pre-existing collection error (missing goref testdata) is carried
-        # by --continue-on-collection-errors; "passed" in the summary line +
-        # no "failed" is the bar the driver holds us to
+    def _sect_tier1() -> dict:
+        sect = _run(FASTLANE_CMD, args.test_timeout, {"JAX_PLATFORMS": "cpu"})
+        # ci_fastlane.sh already folds the pre-existing collection error
+        # (missing goref testdata) into its exit code via the summary line
         summary = next((ln for ln in reversed(sect["tail"]) if "passed" in ln), "")
         sect["summary"] = summary.strip()
-        sect["ok"] = "passed" in summary and "failed" not in summary
-        evidence["sections"]["tier1"] = sect
-        ok &= sect["ok"]
+        sect["ok"] = sect["rc"] == 0
+        return sect
 
-    if not args.skip_sim:
+    def _sect_sim() -> dict:
         sect = _run(
             [sys.executable, "-m", "kaspa_tpu.sim", "--bps", "2", "--blocks", str(args.blocks), "--json"],
             300.0,
@@ -211,10 +224,9 @@ def main(argv: list[str] | None = None) -> int:
         result = _last_json_line(sect)
         sect["result"] = result
         sect["ok"] = sect["rc"] == 0 and result is not None
-        evidence["sections"]["sim"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    if not args.skip_bench:
+    def _sect_bench_probe() -> dict:
         sect = _run(
             [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--probe"],
             args.probe_timeout,
@@ -222,14 +234,9 @@ def main(argv: list[str] | None = None) -> int:
         result = _last_json_line(sect)
         sect["result"] = result
         sect["ok"] = bool(result and result.get("probe_ok"))
-        evidence["sections"]["bench_probe"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    # forced 8 CPU host devices: the mesh lanes must work on any box the
-    # round runs on, with or without a real accelerator
-    mesh_env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
-
-    if not args.skip_mesh:
+    def _sect_multichip() -> dict:
         # multichip dryrun: masks + muhash product checked against host
         # oracles on every visible device (round evidence for item 6)
         sect = _run(
@@ -244,9 +251,9 @@ def main(argv: list[str] | None = None) -> int:
         result = _last_json_line(sect)
         sect["result"] = result
         sect["ok"] = sect["rc"] == 0 and bool(result and result.get("dryrun_ok"))
-        evidence["sections"]["multichip"] = sect
-        ok &= sect["ok"]
+        return sect
 
+    def _sect_mesh_smoke() -> dict:
         # mesh smoke: the production batch path (BatchScriptChecker +
         # muhash) sharded over 8 host devices for a short replay — the
         # tier-1 fast lane exercises sharded dispatch at least once a round
@@ -264,10 +271,9 @@ def main(argv: list[str] | None = None) -> int:
         result = _last_json_line(sect)
         sect["result"] = result
         sect["ok"] = sect["rc"] == 0 and bool(result) and result.get("mesh") == 8
-        evidence["sections"]["mesh_smoke"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    if not args.skip_dispatch:
+    def _sect_dispatch() -> dict:
         # coalesced dispatch lane: cross-block coalescing vs legacy per-block
         # dispatch over the same jobs on the CPU bench path.  Chunk size 4
         # models the sim's per-block signature count (tpb 4; every block
@@ -299,10 +305,9 @@ def main(argv: list[str] | None = None) -> int:
             and result.get("speedup", 0.0) >= 1.3
             and bool(result.get("replay_identical"))
         )
-        evidence["sections"]["dispatch"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    if not args.skip_serving:
+    def _sect_serving() -> dict:
         # serving tier: one persistent daemon, one JSON + one Borsh client
         # on the same UtxosChanged scope — the streams must be identical —
         # then kill -9 and a reopen that reconciles (journal rewind /
@@ -316,10 +321,9 @@ def main(argv: list[str] | None = None) -> int:
         result = _last_json_line(sect)
         sect["result"] = result
         sect["ok"] = sect["rc"] == 0 and bool(result and result.get("serving_ok"))
-        evidence["sections"]["serving"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    if not args.skip_obs:
+    def _sect_obs() -> dict:
         # flight-recorder lane: a traced 24-block pipelined + coalesced
         # replay (the full production thread topology: stage workers,
         # virtual worker, verify-dispatch, serving fanout) must produce a
@@ -382,10 +386,9 @@ def main(argv: list[str] | None = None) -> int:
             and sect.get("perfetto", {}).get("ok", False)
             and sect["overhead"]["ok"]
         )
-        evidence["sections"]["obs"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    if not args.skip_tenbps:
+    def _sect_tenbps() -> dict:
         # 10-BPS lane (ROADMAP item 2): a pipelined replay of a 10-BPS DAG
         # with the chaos schedule off, speculation on — records the
         # realtime_factor and the speculative hit-rate — gated on the
@@ -412,10 +415,9 @@ def main(argv: list[str] | None = None) -> int:
             sect["realtime_factor"] = spec_on.get("realtime_factor")
             sect["speculative"] = spec_on.get("speculative")
         sect["ok"] = sect["rc"] == 0 and off["rc"] == 0 and identical
-        evidence["sections"]["tenbps"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    if not args.skip_chaos:
+    def _sect_chaos() -> dict:
         # chaos sustain: seeded fault schedule under hostile script mix +
         # attacker-fork reorg; the acceptance bit is the faulted run
         # converging to the byte-identical fault-free end state with the
@@ -439,10 +441,9 @@ def main(argv: list[str] | None = None) -> int:
             and bool(result.get("matches_fault_free"))
             and result.get("breaker_trips", 0) >= 1
         )
-        evidence["sections"]["chaos"] = sect
-        ok &= sect["ok"]
+        return sect
 
-    if not args.skip_supervision:
+    def _sect_supervision() -> dict:
         # supervision wedge drill: dispatch hangs + a compile stall injected
         # mid-replay; the watchdog reroutes every wedged super-batch to the
         # host degraded lane and the canary prober recovers the breaker —
@@ -470,7 +471,60 @@ def main(argv: list[str] | None = None) -> int:
             and bool(result.get("tickets_ok"))
             and bool(result.get("recovered"))
         )
-        evidence["sections"]["supervision"] = sect
+        return sect
+
+    def _sect_fabric() -> dict:
+        # verify fabric: spawn a real verifyd (second process), replay over
+        # the wire and gate on bit-identity with the local-only replay, then
+        # SIGKILL the server mid-replay and gate on the degraded-lane
+        # failover losing zero tickets (ISSUE acceptance: fabric smoke +
+        # slice-kill drill)
+        sect = _run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "fabric_check.py"), "--blocks", "24"],
+            900.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        result = _last_json_line(sect)
+        sect["result"] = result
+        sect["ok"] = sect["rc"] == 0 and bool(result and result.get("fabric_ok"))
+        return sect
+
+    sections: list[tuple[str, bool, object]] = [
+        ("tier1", not args.skip_tests, _sect_tier1),
+        ("sim", not args.skip_sim, _sect_sim),
+        ("bench_probe", not args.skip_bench, _sect_bench_probe),
+        ("multichip", not args.skip_mesh, _sect_multichip),
+        ("mesh_smoke", not args.skip_mesh, _sect_mesh_smoke),
+        ("dispatch", not args.skip_dispatch, _sect_dispatch),
+        ("serving", not args.skip_serving, _sect_serving),
+        ("obs", not args.skip_obs, _sect_obs),
+        ("tenbps", not args.skip_tenbps, _sect_tenbps),
+        ("chaos", not args.skip_chaos, _sect_chaos),
+        ("supervision", not args.skip_supervision, _sect_supervision),
+        ("fabric", not args.skip_fabric, _sect_fabric),
+    ]
+    only: set[str] | None = None
+    if args.only:
+        only = {name.strip() for spec in args.only for name in spec.split(",") if name.strip()}
+        known = {name for name, _, _ in sections}
+        unknown = only - known
+        if unknown:
+            ap.error(f"unknown --only section(s) {sorted(unknown)}; known: {sorted(known)}")
+
+    evidence: dict = {"created": _utc(), "sections": {}}
+    ok = True
+    for name, enabled, fn in sections:
+        if only is not None:
+            if name not in only:
+                continue
+        elif not enabled:
+            continue
+        t0 = time.monotonic()
+        sect = fn()
+        # wall_seconds covers the whole section (some run several commands;
+        # each command's own time stays in its "seconds")
+        sect["wall_seconds"] = round(time.monotonic() - t0, 1)
+        evidence["sections"][name] = sect
         ok &= sect["ok"]
 
     evidence["ok"] = ok
@@ -479,7 +533,7 @@ def main(argv: list[str] | None = None) -> int:
         f.write("\n")
     print(f"[roundcheck] {'PASS' if ok else 'FAIL'} -> {args.out}")
     for name, sect in evidence["sections"].items():
-        print(f"  {name:12s} {'ok' if sect['ok'] else 'FAIL':4s} rc={sect['rc']} {sect['seconds']}s")
+        print(f"  {name:12s} {'ok' if sect['ok'] else 'FAIL':4s} rc={sect['rc']} {sect['wall_seconds']}s")
     return 0 if ok else 1
 
 
