@@ -123,10 +123,15 @@ def _child_probe_main() -> None:
             from kaspa_tpu.resilience import supervisor
 
             rep = supervisor.cache_report()
+            entries = rep.get("entries") or []
             cache = {
                 "manifest_path": rep.get("manifest_path"),
                 "xla_cache_dir": rep.get("xla_cache_dir"),
-                "warm_entries": len(rep.get("entries") or []),
+                "warm_entries": len(entries),
+                # aggregate-RLC kernels warm in this env (family column in
+                # the manifest schema): 0 means the first --verify-mode
+                # aggregate dispatch pays a cold compile
+                "aggregate_warm_entries": sum(1 for e in entries if e.get("family") == "aggregate"),
                 "entries_total": rep.get("entries_total", 0),
             }
         except Exception:  # noqa: BLE001 - cache evidence is best-effort
@@ -441,6 +446,75 @@ def _child_dispatch_main(obs_fn) -> None:
     os._exit(0)
 
 
+def _child_aggregate_main(obs_fn) -> None:
+    """Aggregate-RLC lane (KASPA_TPU_BENCH_MODE=aggregate): ONE combined
+    multi-scalar check vs per-signature dual ladders over the SAME items on
+    the SAME backend — the delta is the tentpole speedup (the shared
+    doubling chain amortized over the batch instead of paid per lane).
+
+    Correctness before timing: an all-valid batch must come back all-True
+    on both lanes, and a small corrupted batch must bisect to the oracle
+    mask through the aggregate lane (the falsification path the tests pin).
+    """
+    from kaspa_tpu.crypto import eclib, secp
+    from kaspa_tpu.ops import mesh
+
+    total = int(os.environ.get("KASPA_TPU_BENCH_AGG_B", "512"))
+    passes = int(os.environ.get("KASPA_TPU_BENCH_AGG_PASSES", "2"))
+    check_b = int(os.environ.get("KASPA_TPU_BENCH_AGG_CHECK_B", "8"))
+    raw = _gen_unique_batch(total + check_b)
+    items = [(pub, msg, sig) for _P, pub, msg, sig in raw[:total]]
+
+    # bisection correctness on a small corrupted batch (small on purpose:
+    # each recursion bucket is a fresh ~1min XLA compile on a cold CPU
+    # backend, so the falsification check must not walk a deep bucket chain)
+    bad = [(pub, msg, sig) for _P, pub, msg, sig in raw[total:]]
+    k = len(bad) // 2
+    bad[k] = (bad[k][0], bad[k][1], bad[k][2][:32] + ((int.from_bytes(bad[k][2][32:], "big") + 1) % eclib.N).to_bytes(32, "big"))
+    expect_bad = [eclib.schnorr_verify(*it) for it in bad]
+    assert expect_bad.count(False) == 1
+    got_bad = [bool(v) for v in secp.schnorr_verify_batch_aggregate(bad)]
+    assert got_bad == expect_bad, "BENCH CORRECTNESS FAILURE: aggregate bisect mask != oracle"
+
+    # warm both lanes on the timing shape, all-valid masks oracle-checked
+    assert all(bool(v) for v in secp.schnorr_verify_batch_aggregate(items)), (
+        "BENCH CORRECTNESS FAILURE: aggregate rejected a valid batch"
+    )
+    assert all(bool(v) for v in secp.schnorr_verify_batch(items))
+
+    agg_best = ladder_best = float("inf")
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        out = secp.schnorr_verify_batch_aggregate(items)
+        agg_best = min(agg_best, time.perf_counter() - t0)
+        assert all(bool(v) for v in out)
+        t0 = time.perf_counter()
+        out = secp.schnorr_verify_batch(items)
+        ladder_best = min(ladder_best, time.perf_counter() - t0)
+        assert all(bool(v) for v in out)
+
+    agg_vps = total / agg_best
+    ladder_vps = total / ladder_best
+    print(
+        json.dumps(
+            {
+                "metric": "schnorr_aggregate_verify_throughput",
+                "value": round(agg_vps, 1),
+                "unit": UNIT,
+                "aggregate_vps": round(agg_vps, 1),
+                "ladder_vps": round(ladder_vps, 1),
+                "speedup": round(agg_vps / ladder_vps, 3),
+                "batch": total,
+                "passes": passes,
+                "mesh": mesh.active_size(),
+                "observability": obs_fn(),
+            }
+        )
+    )
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def _child_main() -> None:
     """Generate the batch, verify on device, print the JSON result line.
 
@@ -482,6 +556,10 @@ def _child_main() -> None:
 
     if os.environ.get("KASPA_TPU_BENCH_MODE") == "dispatch":
         _child_dispatch_main(_obs)
+        return  # unreachable (child exits)
+
+    if os.environ.get("KASPA_TPU_BENCH_MODE") == "aggregate":
+        _child_aggregate_main(_obs)
         return  # unreachable (child exits)
 
     if os.environ.get("KASPA_TPU_BENCH_KERNEL", "schnorr") == "ecdsa":
@@ -975,6 +1053,49 @@ def _sweep(probe_log: list, devices: int) -> None:
                     err = (obj or {}).get("child_error", note)
                     cell.update(value=0.0, note=f"failed: {err}")
                 cells.append(cell)
+    # aggregate-RLC column: combined multi-scalar check vs per-signature
+    # ladders at each batch size; the smallest batch where the aggregate
+    # lane wins becomes the recorded crossover that --verify-mode auto
+    # reads back from this file (ops/dispatch._aggregate_crossover)
+    agg_batches = [
+        int(b) for b in os.environ.get("KASPA_TPU_BENCH_AGG_BATCHES", "64,256,1024").split(",") if b.strip()
+    ]
+    agg_cells: list = []
+    for b in agg_batches:
+        cell = {"lane": "aggregate", "kernel": "schnorr", "batch": b, "mesh": 1}
+        remaining = deadline - time.monotonic()
+        if remaining <= 30:
+            cell.update(value=0.0, note="sweep budget exhausted")
+            agg_cells.append(cell)
+            continue
+        obj, note = _run_json_child(
+            {
+                "KASPA_TPU_BENCH_CHILD": "1",
+                "KASPA_TPU_BENCH_MODE": "aggregate",
+                "KASPA_TPU_BENCH_AGG_B": str(b),
+                # cells measure this exact bucket shape, like the kernel grid
+                "KASPA_TPU_COLD_BUCKET_SPLIT": "0",
+            },
+            min(ATTEMPT_TIMEOUT_S, remaining),
+        )
+        if obj is not None and obj.get("aggregate_vps", 0) > 0:
+            cell.update(
+                value=obj["aggregate_vps"],
+                ladder_vps=obj.get("ladder_vps"),
+                aggregate_speedup=obj.get("speedup"),
+                unit=obj.get("unit", UNIT),
+                note="ok",
+            )
+        else:
+            err = (obj or {}).get("child_error", note)
+            cell.update(value=0.0, note=f"failed: {err}")
+        agg_cells.append(cell)
+    cells.extend(agg_cells)
+    agg_crossover = None
+    for c in sorted(agg_cells, key=lambda c: c["batch"]):
+        if (c.get("aggregate_speedup") or 0) >= 1.0:
+            agg_crossover = c["batch"]
+            break
     # per-mesh replay cells: end-to-end sim replay blocks/sec at each mesh
     # width, the lane where ROUNDCHECK first exposed the mesh-8 regression
     # (1.13 vs 2.7 blocks/s).  The dominant cost at mesh > 1 is the
@@ -1018,6 +1139,13 @@ def _sweep(probe_log: list, devices: int) -> None:
             if c["value"] > best.get(key, {}).get("value", 0.0):
                 best[key] = {"value": c["value"], "replay_seconds": c.get("replay_seconds")}
             continue
+        if c.get("lane") == "aggregate":
+            key = f"{c['kernel']}/mesh{c['mesh']}/aggregate"
+            if c["value"] > best.get(key, {}).get("value", 0.0):
+                best[key] = {
+                    "batch": c["batch"], "value": c["value"], "speedup": c.get("aggregate_speedup"),
+                }
+            continue
         if "coalesce_depth" in c:
             key = f"{c['kernel']}/mesh{c['mesh']}/coalesce"
             if c["value"] > best.get(key, {}).get("value", 0.0):
@@ -1034,6 +1162,9 @@ def _sweep(probe_log: list, devices: int) -> None:
         "meshes": meshes,
         "cells": cells,
         "best": best,
+        # --verify-mode auto reads crossover_batch from here; cells above
+        # carry the full aggregate_speedup column
+        "aggregate": {"crossover_batch": agg_crossover, "batches": agg_batches},
         "probe_log": probe_log,
     }
     with open(out_path, "w") as f:

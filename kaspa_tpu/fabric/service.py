@@ -155,7 +155,7 @@ class VerifyService:
             with self._lock:
                 self._conns.append(conn)
             try:
-                conn.send(wire.encode_hello(self.slices))
+                conn.send(wire.encode_hello(self.slices, modes=wire.MODE_AGGREGATE))
             except OSError:
                 conn.close()
                 continue
@@ -249,6 +249,8 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", default=None, help="device mesh spec (N | auto | RxC)")
     ap.add_argument("--coalesce", default=os.environ.get("KASPA_TPU_COALESCE", "auto"),
                     help="local coalescing target feeding the slices (N | auto | off)")
+    ap.add_argument("--verify-mode", default=None, choices=("ladder", "aggregate", "auto"),
+                    help="schnorr verify lane: per-sig ladder, RLC aggregate, or auto by batch size")
     args = ap.parse_args(argv)
 
     from kaspa_tpu.utils import jax_setup
@@ -260,12 +262,15 @@ def main(argv=None) -> int:
     if args.mesh is not None:
         mesh.configure(args.mesh)
     coalesce.configure(args.coalesce)
+    if args.verify_mode is not None:
+        coalesce.set_verify_mode(args.verify_mode)
 
     svc = VerifyService(args.listen, slices=args.slices)
     host, port = svc.start()
     print(json.dumps({
         "fabric_listen": f"{host}:{port}", "slices": svc.slices,
         "mesh": mesh.active_size(), "pid": os.getpid(),
+        "verify_mode": coalesce.verify_mode(),
     }), flush=True)
 
     done = threading.Event()

@@ -6,7 +6,8 @@ payload is a 1-byte message type followed by varint/length-delimited
 fields (`p2p/proto/wire_format.py` primitives) — no schema compiler, no
 new dependency, same bounds discipline as the P2P wire.
 
-    HELLO        server -> client on accept: proto version, slice count
+    HELLO        server -> client on accept: proto version, slice count,
+                 capability mode flags (proto >= 2, e.g. MODE_AGGREGATE)
     VERIFY_REQ   req_id, kind, target slice, trace id, [(pub,msg,sig)...]
     VERIFY_RESP  req_id, status; ok: packed mask + server-side timings +
                  the slice's post-completion inflight count (the load
@@ -25,7 +26,10 @@ import numpy as np
 from kaspa_tpu.p2p.proto.framing import encode_grpc_frame, read_grpc_frame
 from kaspa_tpu.p2p.proto.wire_format import ProtoWireError, decode_varint, encode_varint
 
-PROTO_VERSION = 1
+PROTO_VERSION = 2
+
+# HELLO capability bitflags (proto >= 2; proto-1 peers simply omit them)
+MODE_AGGREGATE = 0x01  # server can run schnorr RLC aggregate verification
 
 HELLO = 0x01
 VERIFY_REQ = 0x02
@@ -52,8 +56,11 @@ def _read_bytes(buf: bytes, pos: int) -> tuple[bytes, int]:
     return buf[pos : pos + n], pos + n
 
 
-def encode_hello(slices: int, proto: int = PROTO_VERSION) -> bytes:
-    return bytes([HELLO]) + encode_varint(proto) + encode_varint(slices)
+def encode_hello(slices: int, proto: int = PROTO_VERSION, modes: int = 0) -> bytes:
+    # the modes capability varint is appended after the proto-1 fields:
+    # old decoders read exactly two varints and ignore trailing bytes, so
+    # a v2 HELLO stays backward compatible on the wire
+    return bytes([HELLO]) + encode_varint(proto) + encode_varint(slices) + encode_varint(modes)
 
 
 def encode_verify_req(req_id: int, kind: str, slice_idx: int, trace_id: str | None, items) -> bytes:
@@ -104,7 +111,10 @@ def decode(message: bytes) -> tuple[int, dict]:
     if mtype == HELLO:
         proto, pos = decode_varint(message, pos)
         slices, pos = decode_varint(message, pos)
-        return mtype, {"proto": proto, "slices": slices}
+        modes = 0
+        if pos < len(message):  # proto-1 peers send no capability flags
+            modes, pos = decode_varint(message, pos)
+        return mtype, {"proto": proto, "slices": slices, "modes": modes}
     if mtype == VERIFY_REQ:
         req_id, pos = decode_varint(message, pos)
         kind_idx, pos = decode_varint(message, pos)

@@ -342,10 +342,12 @@ class CoalescingDispatcher:
             c.ticket.super_id = sid  # a timeout now names the super-batch
         items = [it for c in batch for it in c.items]
         try:
-            fn = secp.schnorr_verify_batch if kind == "schnorr" else secp.ecdsa_verify_batch
             t0 = perf_counter_ns()
+            # verify_batch resolves the process-wide verify mode, so a
+            # coalesced schnorr super-batch takes the aggregate RLC lane
+            # exactly when a direct caller's batch of the same size would
             with trace.span("dispatch.super_batch", kind=kind, jobs=jobs, chunks=len(batch)):
-                mask = np.asarray(fn(items))
+                mask = np.asarray(secp.verify_batch(kind, items))
             t1 = perf_counter_ns()
         except Exception as e:  # noqa: BLE001 - surfaced on every waiting ticket
             t1 = perf_counter_ns()
@@ -397,6 +399,66 @@ class CoalescingDispatcher:
 _cfg_lock = threading.Lock()
 _configured: str | int | None = None
 _engine: CoalescingDispatcher | None = None
+
+# --- verify-mode selection (ladder | aggregate | auto) ----------------------
+# The dispatch module owns which schnorr lane runs: the per-signature dual
+# ladder, or the aggregate RLC multi-scalar lane (ops/secp256k1/aggregate).
+# "auto" consults the bench sweep's measured crossover batch size — below
+# it the per-batch doubling chain + bisection risk outweigh the saved
+# ladders.  secp.verify_batch calls resolve_verify_mode() on every batch,
+# so the legacy synchronous txscript lane, the coalescing dispatcher, and
+# the fabric slice workers all honor one process-wide knob.
+
+VERIFY_MODES = ("ladder", "aggregate", "auto")
+_DEFAULT_AGG_CROSSOVER = 64  # conservative floor when no sweep artifact exists
+_verify_mode: str | None = None  # None -> consult KASPA_TPU_VERIFY_MODE
+
+
+def set_verify_mode(mode: str | None) -> str:
+    """Pin the process-wide schnorr verify mode; None re-reads the
+    KASPA_TPU_VERIFY_MODE env var (default "ladder").  Returns the raw
+    mode now in force."""
+    global _verify_mode
+    if mode is not None and mode not in VERIFY_MODES:
+        raise ValueError(f"verify mode {mode!r} not in {VERIFY_MODES}")
+    with _cfg_lock:
+        _verify_mode = mode
+    return verify_mode()
+
+
+def verify_mode() -> str:
+    """The raw configured mode ("ladder" | "aggregate" | "auto")."""
+    m = _verify_mode
+    if m is None:
+        m = os.environ.get("KASPA_TPU_VERIFY_MODE", "ladder")
+    return m if m in VERIFY_MODES else "ladder"
+
+
+def _aggregate_crossover() -> int:
+    """Batch size where the aggregate lane starts winning, from the bench
+    sweep artifact's ``aggregate.crossover_batch`` (bench.py --sweep), with
+    a conservative default when no measurement exists."""
+    path = os.environ.get(
+        "KASPA_TPU_BENCH_SWEEP_PATH",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "BENCH_SWEEP.json"),
+    )
+    try:
+        with open(path) as f:
+            agg = json.load(f).get("aggregate", {})
+        x = int(agg.get("crossover_batch", 0))
+        return x if x > 0 else _DEFAULT_AGG_CROSSOVER
+    except (OSError, ValueError, TypeError):
+        return _DEFAULT_AGG_CROSSOVER
+
+
+def resolve_verify_mode(kind: str, jobs: int) -> str:
+    """The lane one concrete batch should take: "ladder" or "aggregate"."""
+    if kind != "schnorr" or jobs <= 0:
+        return "ladder"
+    m = verify_mode()
+    if m == "auto":
+        return "aggregate" if jobs >= _aggregate_crossover() else "ladder"
+    return m
 
 
 def _flush_age_s() -> float:
@@ -489,8 +551,12 @@ def shutdown(timeout: float = 10.0) -> bool:
 def _dispatch_state() -> dict:
     eng = _engine
     if eng is None:
-        return {"enabled": False, "configured": str(_configured) if _configured is not None else ""}
-    out = {"enabled": True, "configured": str(_configured)}
+        return {
+            "enabled": False,
+            "configured": str(_configured) if _configured is not None else "",
+            "verify_mode": verify_mode(),
+        }
+    out = {"enabled": True, "configured": str(_configured), "verify_mode": verify_mode()}
     out.update(eng.stats())
     return out
 

@@ -649,9 +649,10 @@ def _build_call_plain(n_padded: int, ecdsa: bool, interpret: bool):
 
 
 def _full_digits(scalars) -> np.ndarray:
-    """Host: int scalars -> [64, B] MSB-first 4-bit digits (transposed)."""
+    """Host: scalars (ints, or canonical 32-byte BE strings — the schnorr
+    s column's wire form) -> [64, B] MSB-first 4-bit digits (transposed)."""
     b = len(scalars)
-    raw = b"".join(int(k).to_bytes(32, "big") for k in scalars)
+    raw = b"".join([k if type(k) is bytes else int(k).to_bytes(32, "big") for k in scalars])
     arr = np.frombuffer(raw, dtype=np.uint8).reshape(b, 32)
     dig = np.empty((b, 64), np.uint8)
     dig[:, 0::2] = arr >> 4
@@ -731,7 +732,10 @@ def _glv_digits(scalars) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Host: scalars (ints mod n) -> (d1, d2 [N_WIN, B] MSB-first 4-bit
     digit arrays of |k1|, |k2|, sign bits [B] as (s1 | s2 << 1))."""
     b = len(scalars)
-    halves = [glv_split(k % SECP_N) for k in scalars]
+    halves = [
+        glv_split((int.from_bytes(k, "big") if type(k) is bytes else k) % SECP_N)
+        for k in scalars
+    ]
     signs = np.fromiter(
         ((k1 < 0) | ((k2 < 0) << 1) for k1, k2 in halves), dtype=np.int32, count=b
     )

@@ -124,16 +124,27 @@ _GTAB_X, _GTAB_Y = _g_multiples_table()
 def _build_p_table(px, py):
     """Per-batch projective multiples 0..15 of P. Returns [B, 16, W] arrays.
 
-    Entry 0 is the true identity (0:1:0) — complete addition handles it."""
+    Entry 0 is the true identity (0:1:0) — complete addition handles it.
+    Entries 2..15 come from one lax.scan'd point_add rather than a fully
+    unrolled chain: 14 adds in the jaxpr made XLA:CPU compile time grow
+    superlinearly with the op count (tens of seconds per bucket), while
+    the rolled form traces one add and compiles flat.  Identical math,
+    identical limbs out."""
     one = jnp.broadcast_to(jnp.asarray(FP.one), px.shape).astype(jnp.int32)
     p1 = (px, py, one)
-    tab = [point_identity(px.shape[:-1]), p1]
-    for _ in range(14):
-        tab.append(point_add(tab[-1], p1))
-    xs = jnp.stack([t[0] for t in tab], axis=-2)  # [B, 16, W]
-    ys = jnp.stack([t[1] for t in tab], axis=-2)
-    zs = jnp.stack([t[2] for t in tab], axis=-2)
-    return xs, ys, zs
+    ident = point_identity(px.shape[:-1])
+
+    def step(acc, _):
+        nxt = point_add(acc, p1)
+        return nxt, nxt
+
+    _, rest = jax.lax.scan(step, p1, None, length=14)  # 2P..15P, [14, B, W]
+    cols = []
+    for i in range(3):
+        head = jnp.stack([ident[i], p1[i]], axis=-2)  # [B, 2, W]
+        tail = jnp.moveaxis(rest[i], 0, -2)  # [B, 14, W]
+        cols.append(jnp.concatenate([head, tail], axis=-2))  # [B, 16, W]
+    return tuple(cols)
 
 
 def _gather_tab(tab, digit):

@@ -43,6 +43,12 @@ def main() -> None:
         "default off — results are bit-identical either way)",
     )
     p.add_argument(
+        "--verify-mode", default=None, choices=("ladder", "aggregate", "auto"),
+        help="schnorr verify lane: per-sig ladder (default), one RLC aggregate "
+        "multi-scalar pass per batch, or auto (aggregate above the measured "
+        "crossover batch size); results are bit-identical either way",
+    )
+    p.add_argument(
         "--fabric", default=None, metavar="ADDR[,ADDR...]",
         help="route the replay's verify batches to remote verifyd slices "
         "(`python -m kaspa_tpu.fabric.service`) through the cross-host "
@@ -92,6 +98,8 @@ def main() -> None:
 
     mesh_size = mesh.configure(args.mesh)
     coalesce_target = coalesce.configure(args.coalesce)
+    if args.verify_mode is not None:
+        coalesce.set_verify_mode(args.verify_mode)
     fabric_bal = None
     if args.fabric:
         from kaspa_tpu.fabric import balancer as fabric_balancer
@@ -133,8 +141,10 @@ def main() -> None:
         "realtime_factor": round(len(res.blocks) / args.bps / elapsed, 2),
         "mesh": mesh_size,
         "coalesce": coalesce_target,
-        # end-state fingerprints: identical across --mesh/--coalesce values
-        # is the bit-identity acceptance check for the sharded dispatch
+        "verify_mode": coalesce.verify_mode(),
+        # end-state fingerprints: identical across --mesh/--coalesce/
+        # --verify-mode values is the bit-identity acceptance check for the
+        # sharded/aggregated dispatch
         "sink": sink.hex(),
         "utxo_commitment": fresh.multisets[sink].finalize().hex(),
         "pipeline": bool(args.pipeline),

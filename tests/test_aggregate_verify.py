@@ -1,0 +1,161 @@
+"""Aggregated RLC Schnorr verification: falsification, bisection, weights.
+
+The tentpole property under test: ONE multi-scalar check over the whole
+batch accepts iff every signature is individually valid — and when it
+rejects, bisection resolves the exact per-signature mask.  The adversarial
+cases pin the two ways a batch check can be fooled:
+
+- a single corrupted signature must fail the combined check and bisect to
+  exactly its index;
+- two bad signatures whose errors CANCEL under equal fixed weights (the
+  classic RLC-batching pitfall — demonstrated against the pure-python
+  oracle below) must still be rejected under the transcript-seeded random
+  weights.
+
+Device-kernel tests are slow-marked like the other secp device suites;
+the host-only weight/digit/mode tests run in tier-1.
+"""
+
+import hashlib
+import json
+
+import pytest
+
+from kaspa_tpu.crypto import eclib, secp
+from kaspa_tpu.ops import dispatch
+from kaspa_tpu.ops.secp256k1 import aggregate as agg
+from kaspa_tpu.ops.secp256k1.verify import _scalars_to_digits
+
+
+def _gen(n, seed=0, corrupt=()):
+    """n valid (pub, msg, sig) triples; indexes in `corrupt` get s += 1."""
+    items = []
+    for i in range(n):
+        sk = int.from_bytes(hashlib.sha256(b"agg-sk-%d-%d" % (seed, i)).digest(), "big") % eclib.N or 1
+        msg = hashlib.sha256(b"agg-msg-%d-%d" % (seed, i)).digest()
+        sig = eclib.schnorr_sign(msg, sk)
+        if i in corrupt:
+            s_bad = (int.from_bytes(sig[32:], "big") + 1) % eclib.N
+            sig = sig[:32] + s_bad.to_bytes(32, "big")
+        items.append((eclib.schnorr_pubkey(sk), msg, sig))
+    return items
+
+
+# --- host-only: weights, digits, mode resolution (tier-1 fast) ---------------
+
+
+def test_weights_deterministic_and_transcript_bound():
+    items = _gen(6)
+    w1 = secp._aggregate_weights(items)
+    w2 = secp._aggregate_weights(items)
+    assert w1 == w2  # same transcript -> same weights (replayable bisection)
+    assert all(0 < w < (1 << 128) for w in w1)
+    # flipping one transcript byte reseeds every weight
+    pub, msg, sig = items[3]
+    items[3] = (pub, msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    w3 = secp._aggregate_weights(items)
+    assert w3 != w1
+    # distinct per-signature weights (the cancellation defence needs them)
+    assert len(set(w1)) == len(w1)
+
+
+def test_weight_digits_live_in_upper_windows():
+    # 128-bit weights: MSB-first 4-bit window columns 0..31 are statically
+    # zero, which is exactly what A_WINDOWS == 32 assumes
+    ws = [(1 << 128) - 1, 1, 0xDEADBEEF]
+    d = _scalars_to_digits(ws, 4)
+    assert not d[:, : agg.A_WINDOWS].any()
+    assert d[0, agg.A_WINDOWS :].tolist() == [15] * 32
+
+
+def test_scalars_to_digits_bytes_match_ints():
+    ks = [0, 1, eclib.N - 1, 0x1234567890ABCDEF]
+    as_int = _scalars_to_digits(ks, 6)
+    as_bytes = _scalars_to_digits([k.to_bytes(32, "big") for k in ks], 6)
+    assert (as_int == as_bytes).all()
+
+
+def test_resolve_verify_mode(monkeypatch, tmp_path):
+    monkeypatch.delenv("KASPA_TPU_VERIFY_MODE", raising=False)
+    dispatch.set_verify_mode(None)
+    assert dispatch.verify_mode() == "ladder"
+    assert dispatch.resolve_verify_mode("schnorr", 4096) == "ladder"
+
+    dispatch.set_verify_mode("aggregate")
+    assert dispatch.resolve_verify_mode("schnorr", 2) == "aggregate"
+    assert dispatch.resolve_verify_mode("ecdsa", 4096) == "ladder"  # schnorr-only
+
+    sweep = tmp_path / "BENCH_SWEEP.json"
+    sweep.write_text(json.dumps({"aggregate": {"crossover_batch": 128}}))
+    monkeypatch.setenv("KASPA_TPU_BENCH_SWEEP_PATH", str(sweep))
+    dispatch.set_verify_mode("auto")
+    assert dispatch.resolve_verify_mode("schnorr", 127) == "ladder"
+    assert dispatch.resolve_verify_mode("schnorr", 128) == "aggregate"
+
+    dispatch.set_verify_mode(None)  # restore env-default for later tests
+
+
+# --- device kernel: falsification + bisection (slow) -------------------------
+
+
+@pytest.mark.slow
+def test_aggregate_matches_ladder_and_oracle():
+    items = _gen(8, seed=1, corrupt={2, 5})
+    items[6] = (items[6][0], items[6][1], b"\x00" * 63)  # malformed length
+    expect = [eclib.schnorr_verify(*it) for it in items]
+    got = list(secp.schnorr_verify_batch_aggregate(items))
+    assert got == expect
+    assert got == list(secp.schnorr_verify_batch(items))
+    assert expect.count(False) == 3 and expect.count(True) == 5
+
+
+@pytest.mark.slow
+def test_single_bad_signature_bisects_to_exact_index():
+    secp.schnorr_verify_batch_aggregate(_gen(8, seed=2))  # warm bucket 8
+    checks0 = secp._AGG_CHECKS.value
+    bisect0 = secp._AGG_BISECT_STEPS.value
+
+    items = _gen(64, seed=3, corrupt={37})
+    mask = list(secp.schnorr_verify_batch_aggregate(items))
+    assert [i for i, ok in enumerate(mask) if not ok] == [37]
+    # the combined check ran (several sub-aggregate dispatches) and the
+    # failing subset was bisected, not brute-forced per-signature
+    assert secp._AGG_CHECKS.value > checks0
+    assert secp._AGG_BISECT_STEPS.value > bisect0
+
+
+@pytest.mark.slow
+def test_cancelling_errors_rejected_under_random_weights():
+    """Two tampered signatures whose errors cancel under equal weights.
+
+    s1 += d and s2 -= d leaves s1 + s2 unchanged, so the UNWEIGHTED
+    combined equation sum(s_i)*G == sum(R_i) + sum(e_i * P_i) still holds
+    — verified against the pure-python oracle below.  A fixed-weight
+    batcher accepts both forgeries; the transcript-seeded random weights
+    must reject them.
+    """
+    (pub1, msg1, sig1), (pub2, msg2, sig2) = _gen(2, seed=4)
+    d = 0x1D2C3B4A
+    s1 = (int.from_bytes(sig1[32:], "big") + d) % eclib.N
+    s2 = (int.from_bytes(sig2[32:], "big") - d) % eclib.N
+    t1 = (pub1, msg1, sig1[:32] + s1.to_bytes(32, "big"))
+    t2 = (pub2, msg2, sig2[:32] + s2.to_bytes(32, "big"))
+
+    # both individually invalid...
+    assert not eclib.schnorr_verify(*t1)
+    assert not eclib.schnorr_verify(*t2)
+
+    # ...yet the equal-weight aggregate equation holds (oracle arithmetic):
+    lhs = eclib.point_mul(eclib.G, (s1 + s2) % eclib.N)
+    rhs = None
+    for pub, msg, sig in (t1, t2):
+        p_i = eclib.lift_x(int.from_bytes(pub, "big"))
+        r_i = eclib.lift_x(int.from_bytes(sig[:32], "big"))
+        e_i = secp.schnorr_challenge(sig[:32], pub, msg)
+        rhs = eclib.point_add(rhs, eclib.point_add(r_i, eclib.point_mul(p_i, e_i)))
+    assert lhs == rhs  # the fixed-weight blind spot is real
+
+    # random weights break the cancellation: both lanes rejected
+    w = secp._aggregate_weights([t1, t2])
+    assert w[0] != w[1]
+    assert list(secp.schnorr_verify_batch_aggregate([t1, t2])) == [False, False]

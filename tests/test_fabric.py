@@ -54,9 +54,16 @@ def _schnorr_items(n: int, corrupt_every: int = 4):
 
 
 def test_wire_hello_roundtrip():
-    mtype, msg = wire.decode(wire.encode_hello(4))
+    mtype, msg = wire.decode(wire.encode_hello(4, modes=wire.MODE_AGGREGATE))
     assert mtype == wire.HELLO
-    assert msg == {"proto": wire.PROTO_VERSION, "slices": 4}
+    assert msg == {"proto": wire.PROTO_VERSION, "slices": 4, "modes": wire.MODE_AGGREGATE}
+
+
+def test_wire_hello_proto1_compat():
+    # a proto-1 HELLO has no trailing modes varint; decode defaults modes=0
+    mtype, msg = wire.decode(wire.encode_hello(2, proto=1)[: 1 + 1 + 1])
+    assert mtype == wire.HELLO
+    assert msg == {"proto": 1, "slices": 2, "modes": 0}
 
 
 def test_wire_verify_req_roundtrip():

@@ -30,8 +30,9 @@ instead of eight scrollback logs.
 ``--only SECTION`` (repeatable, or comma-separated) runs exactly the
 named sections and ignores the skip flags; section names are the keys in
 ROUNDCHECK.json (tier1, sim, bench_probe, multichip, mesh_smoke,
-dispatch, serving, obs, tenbps, chaos, supervision, fabric).  Every
-section records its own ``wall_seconds`` in the artifact.
+dispatch, aggregate, serving, obs, tenbps, chaos, supervision,
+fabric).  Every section records its own ``wall_seconds`` in the
+artifact.
 
 Exit code 0 iff every section that ran passed.
 """
@@ -182,6 +183,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-mesh", action="store_true", help="skip the multichip dryrun + mesh smoke replay")
     ap.add_argument("--skip-chaos", action="store_true", help="skip the hostile-load chaos sustain run")
     ap.add_argument("--skip-dispatch", action="store_true", help="skip the coalesced-dispatch throughput lane")
+    ap.add_argument("--skip-aggregate", action="store_true", help="skip the aggregated RLC verify lane")
     ap.add_argument("--skip-serving", action="store_true", help="skip the serving-tier dual-encoding + kill -9 lane")
     ap.add_argument("--skip-obs", action="store_true", help="skip the flight-recorder traced-replay lane")
     ap.add_argument("--skip-tenbps", action="store_true", help="skip the 10-BPS speculative-pipeline lane")
@@ -304,6 +306,56 @@ def main(argv: list[str] | None = None) -> int:
             and bool(result)
             and result.get("speedup", 0.0) >= 1.3
             and bool(result.get("replay_identical"))
+        )
+        return sect
+
+    def _sect_aggregate() -> dict:
+        # aggregated RLC verify lane: ONE random-linear-combination
+        # multi-scalar pass over the super-batch vs per-signature ladders,
+        # on the CPU bench path.  Batch 64 is the production coalesce size
+        # and sits past the measured crossover (batch 16).  Acceptance:
+        # >= 1.5x verifies/sec AND a 24-block sim replay with
+        # --verify-mode aggregate bit-identical (sink + utxo_commitment)
+        # with the ladder replay — bisection must make the two lanes
+        # indistinguishable, not just agree on all-valid batches.
+        sect = _run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+            900.0,
+            {
+                "JAX_PLATFORMS": "cpu",
+                "KASPA_TPU_BENCH_CHILD": "1",
+                "KASPA_TPU_BENCH_MODE": "aggregate",
+                "KASPA_TPU_BENCH_AGG_B": "64",
+                "KASPA_TPU_COLD_BUCKET_SPLIT": "0",
+            },
+        )
+        result = _last_json_line(sect)
+        if result is not None:
+            result.pop("observability", None)
+        sect["result"] = result
+        replay_cmd = [
+            sys.executable, "-m", "kaspa_tpu.sim",
+            "--bps", "2", "--blocks", "24", "--tpb", "4", "--json",
+        ]
+        lad = _run(replay_cmd + ["--verify-mode", "ladder"], 600.0, {"JAX_PLATFORMS": "cpu"})
+        agg = _run(replay_cmd + ["--verify-mode", "aggregate"], 600.0, {"JAX_PLATFORMS": "cpu"})
+        j_lad = _last_json_line(lad)
+        j_agg = _last_json_line(agg)
+        identical = bool(
+            j_lad and j_agg
+            and j_lad["sink"] == j_agg["sink"]
+            and j_lad["utxo_commitment"] == j_agg["utxo_commitment"]
+        )
+        sect["replay_ladder"] = j_lad
+        sect["replay_aggregate"] = j_agg
+        sect["replay_identical"] = identical
+        sect["ok"] = (
+            sect["rc"] == 0
+            and bool(result)
+            and result.get("speedup", 0.0) >= 1.5
+            and lad["rc"] == 0
+            and agg["rc"] == 0
+            and identical
         )
         return sect
 
@@ -496,6 +548,7 @@ def main(argv: list[str] | None = None) -> int:
         ("multichip", not args.skip_mesh, _sect_multichip),
         ("mesh_smoke", not args.skip_mesh, _sect_mesh_smoke),
         ("dispatch", not args.skip_dispatch, _sect_dispatch),
+        ("aggregate", not args.skip_aggregate, _sect_aggregate),
         ("serving", not args.skip_serving, _sect_serving),
         ("obs", not args.skip_obs, _sect_obs),
         ("tenbps", not args.skip_tenbps, _sect_tenbps),
