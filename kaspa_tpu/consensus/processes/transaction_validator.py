@@ -70,8 +70,8 @@ class TransactionValidator:
 
         self.vm_fallback = vm_fallback
 
-    def new_checker(self) -> BatchScriptChecker:
-        return BatchScriptChecker(self.sig_cache, self.vm_fallback)
+    def new_checker(self, traffic_class: str | None = None) -> BatchScriptChecker:
+        return BatchScriptChecker(self.sig_cache, self.vm_fallback, traffic_class=traffic_class)
 
     # --- in isolation (tx_validation_in_isolation.rs) ---
 

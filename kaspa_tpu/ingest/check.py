@@ -1,0 +1,121 @@
+"""Batched-vs-per-tx admission identity check (roundcheck ``ingest`` section).
+
+Builds a short DAG, replays it into one consensus, then drives the SAME
+deterministic flood stream (clean spends, double-spend chains, RBF churn,
+orphan storms — txflood.FloodStream) through two mempools over that one
+consensus:
+
+- **batched**: ``IngestTier.submit`` + ``pump`` waves (one shared checker
+  dispatch per wave on the ``standalone_tx`` traffic class), recording the
+  true arrival order (source-lane round-robin) as the waves prepare;
+- **per-tx**: ``validate_and_insert_transaction`` replayed one call at a
+  time in exactly that recorded order.
+
+Gates (all must hold):
+
+- pool state identity: same txids with the same fees, same orphan-pool
+  txids, and a fixed-timestamp block template selecting the same tx ids
+  in the same order (both managers share one sampling seed);
+- clean-fraction acceptance >= 0.99 on the batched path;
+- zero lost tickets (every submission resolved exactly once).
+
+Emits one JSON line; exit 0 iff ``ingest_ok``.
+
+    python -m kaspa_tpu.ingest.check --blocks 24 --tpb 4 --slots 6
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+
+from kaspa_tpu.utils import jax_setup
+
+jax_setup.setup()
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
+from kaspa_tpu.ingest.tier import IngestTier
+from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.mempool.mining_manager import MiningManager
+from kaspa_tpu.resilience.txflood import FloodStream, TxFloodConfig
+from kaspa_tpu.sim.simulator import SimConfig, simulate
+
+
+def run_check(
+    blocks: int = 24, tpb: int = 4, slots: int = 6, seed: int = 7, bps: int = 2
+) -> dict:
+    cfg = SimConfig(bps=bps, num_blocks=blocks, txs_per_block=tpb, seed=seed)
+    sim = simulate(cfg)
+    consensus = Consensus(sim.params)
+    for b in sim.blocks:
+        status = consensus.validate_and_insert_block(b)
+        assert status in ("utxo_valid", "utxo_pending"), status
+
+    # batched path, recording the true in-wave arrival order
+    batched = MiningManager(consensus, seed=seed)
+    tier = IngestTier(batched)
+    flood = FloodStream(consensus, cfg, TxFloodConfig(), random.Random(seed ^ 0xF100D))
+    arrival: list = []
+    orig_prepare = batched.prepare_transaction
+
+    def recording_prepare(tx, checker, token):
+        arrival.append(tx)
+        return orig_prepare(tx, checker, token)
+
+    batched.prepare_transaction = recording_prepare
+    for _ in range(slots):
+        flood.step(tier)
+    tier_stats = tier.stats()
+
+    # per-tx path: the same transactions, the same arrival order
+    pertx = MiningManager(consensus, seed=seed)
+    for tx in arrival:
+        try:
+            pertx.validate_and_insert_transaction(tx)
+        except (MempoolError, TxRuleError):
+            pass
+
+    pool_a = {t.hex(): e.fee for t, e in sorted(batched.mempool.pool.items())}
+    pool_b = {t.hex(): e.fee for t, e in sorted(pertx.mempool.pool.items())}
+    orphans_a = sorted(t.hex() for t in batched.mempool.orphans)
+    orphans_b = sorted(t.hex() for t in pertx.mempool.orphans)
+    ts = consensus.virtual_state.past_median_time + 1
+    template_a = [t.id().hex() for t in batched.get_block_template(flood.miner_data, timestamp=ts).transactions]
+    template_b = [t.id().hex() for t in pertx.get_block_template(flood.miner_data, timestamp=ts).transactions]
+
+    fl = flood.counters
+    clean_rate = fl["clean_accepted"] / fl["clean_submitted"] if fl["clean_submitted"] else 0.0
+    identical = pool_a == pool_b and orphans_a == orphans_b and template_a == template_b
+    return {
+        "blocks": blocks,
+        "slots": slots,
+        "flood": dict(sorted(fl.items())),
+        "pool_size": len(pool_a),
+        "orphan_size": len(orphans_a),
+        "template_txs": len(template_a),
+        "pool_identical": pool_a == pool_b,
+        "orphans_identical": orphans_a == orphans_b,
+        "template_identical": template_a == template_b,
+        "tx_acceptance_rate": round(clean_rate, 4),
+        "lost_tickets": tier_stats["lost"],
+        "waves": tier_stats["waves"],
+        "ingest_ok": identical and clean_rate >= 0.99 and tier_stats["lost"] == 0,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--blocks", type=int, default=24)
+    ap.add_argument("--tpb", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=6, help="flood slots to drive after the replay")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    report = run_check(blocks=args.blocks, tpb=args.tpb, slots=args.slots, seed=args.seed)
+    print(json.dumps(report))
+    return 0 if report["ingest_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

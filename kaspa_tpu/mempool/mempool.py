@@ -20,7 +20,14 @@ from kaspa_tpu.mempool.frontier import FeerateKey, Frontier, LaneSelectionState
 
 
 class MempoolError(Exception):
-    pass
+    """Mempool admission rejection.  ``code`` is a stable machine-readable
+    identifier (the RPC layer forwards it verbatim so clients can branch
+    without parsing prose): tx-duplicate, tx-double-spend, tx-rbf-rejected,
+    tx-fee-too-low, mempool-full, tx-gas, tx-invalid."""
+
+    def __init__(self, message: str, code: str = "tx-invalid"):
+        super().__init__(message)
+        self.code = code
 
 
 @dataclass
@@ -47,10 +54,18 @@ class MempoolConfig:
     transaction_expire_interval_daa_score: int = 60 * 10  # mempool/config.rs scale
     accepted_cache_size: int = 10_000
     allow_rbf: bool = True
+    # feerate floor for pool entry (config.rs minimum_relay_transaction_fee);
+    # 0.0 keeps the historical accept-everything behavior
+    minimum_relay_feerate: float = 0.0
 
 
 class Mempool:
-    def __init__(self, config: MempoolConfig | None = None, target_time_per_block_seconds: float = 1.0):
+    def __init__(
+        self,
+        config: MempoolConfig | None = None,
+        target_time_per_block_seconds: float = 1.0,
+        seed: int | None = None,
+    ):
         self.config = config or MempoolConfig()
         self.pool: dict[bytes, MempoolTx] = {}  # txid -> entry
         self.outpoint_index: dict[TransactionOutpoint, bytes] = {}  # spent outpoint -> txid
@@ -58,7 +73,9 @@ class Mempool:
         self.accepted: dict[bytes, int] = {}  # txid -> daa score (LRU-ish)
         self.frontier = Frontier(target_time_per_block_seconds)
         self._children: dict[bytes, set[bytes]] = {}  # parent txid -> dependent txids
-        self._rng = random.Random(0xD1CE)
+        # template-selection sampling RNG: seedable so SUSTAIN runs are
+        # byte-reproducible (same seed -> identical weighted samples)
+        self._rng = random.Random(0xD1CE if seed is None else seed)
 
     @staticmethod
     def _fkey(entry: MempoolTx) -> FeerateKey:
@@ -91,7 +108,9 @@ class Mempool:
         """
         txid = entry.tx.id()
         if self.has(txid) or txid in self.accepted:
-            raise MempoolError("transaction already in mempool or recently accepted")
+            raise MempoolError(
+                "transaction already in mempool or recently accepted", code="tx-duplicate"
+            )
         if orphan:
             if len(self.orphans) >= self.config.maximum_orphan_transaction_count:
                 # evict the lowest-feerate orphan (orphan_pool.rs limit policy)
@@ -100,7 +119,13 @@ class Mempool:
             self.orphans[txid] = entry
             return []
         if len(self.pool) >= self.config.maximum_transaction_count:
-            raise MempoolError("mempool is full")
+            raise MempoolError("mempool is full", code="mempool-full")
+        if entry.feerate < self.config.minimum_relay_feerate:
+            raise MempoolError(
+                f"transaction feerate {entry.feerate:.4f} below the minimum relay "
+                f"feerate {self.config.minimum_relay_feerate:.4f}",
+                code="tx-fee-too-low",
+            )
 
         # double-spend / RBF (replace_by_fee.rs): a conflicting tx is replaced
         # only if the new one pays a strictly higher feerate than all conflicts
@@ -109,9 +134,13 @@ class Mempool:
         evicted = []
         if conflicts:
             if not self.config.allow_rbf:
-                raise MempoolError("transaction double spends mempool transaction")
+                raise MempoolError(
+                    "transaction double spends mempool transaction", code="tx-double-spend"
+                )
             if any(self.pool[c].feerate >= entry.feerate for c in conflicts):
-                raise MempoolError("replacement feerate not higher than conflicts")
+                raise MempoolError(
+                    "replacement feerate not higher than conflicts", code="tx-rbf-rejected"
+                )
             for c in conflicts:
                 self._remove(c)
                 evicted.append(c)

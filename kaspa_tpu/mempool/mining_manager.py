@@ -18,37 +18,90 @@ from kaspa_tpu.consensus.model.block import Block
 from kaspa_tpu.consensus.processes.coinbase import MinerData
 from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
 from kaspa_tpu.mempool.mempool import Mempool, MempoolConfig, MempoolError, MempoolTx
+from kaspa_tpu.observability.core import REGISTRY
+
+_TEMPLATE_REBUILD_MS = REGISTRY.histogram(
+    "mempool_template_rebuild_ms",
+    (0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0),
+    help="block-template rebuild latency (frontier selection + build), milliseconds",
+)
 
 
 @dataclass
 class TemplateCache:
-    """block_template cache (mining/src/cache.rs): short-lived reuse window."""
+    """block_template cache (mining/src/cache.rs): short-lived reuse window.
+
+    Tx-intake invalidation can be *debounced*: a new pool entry makes the
+    cached template stale-but-still-mineable (it just misses the newest
+    txs), so ``mark_dirty`` keeps serving it until ``debounce`` seconds
+    after the last rebuild — a tx flood then costs one rebuild per debounce
+    window instead of one per transaction.  The default debounce of 0 keeps
+    the historical rebuild-on-next-request behavior; the daemon and the
+    tx-flood harness opt in.  Block acceptance calls ``clear`` (the cached
+    template may now be *invalid*), which drops it unconditionally.
+    """
 
     template: Block | None = None
     created: float = 0.0
     lifetime: float = 1.0  # seconds
+    debounce: float = 0.0  # min seconds between tx-churn-driven rebuilds
+    dirty: bool = False
 
     def get(self):
-        if self.template is not None and time.monotonic() - self.created < self.lifetime:
-            return self.template
-        return None
+        if self.template is None:
+            return None
+        age = time.monotonic() - self.created
+        if age >= self.lifetime:
+            return None
+        if self.dirty and age >= self.debounce:
+            return None
+        return self.template
 
     def set(self, template: Block):
         self.template = template
         self.created = time.monotonic()
+        self.dirty = False
+
+    def mark_dirty(self):
+        self.dirty = True
 
     def clear(self):
         self.template = None
+        self.dirty = False
+
+
+@dataclass
+class PreparedTx:
+    """One entrant past the contextual pre-checks, its signature/script jobs
+    collected into a shared checker, awaiting the batched verify verdict.
+    ``entry is None`` means the tx was parked in the orphan pool during
+    prepare (missing inputs) and needs no finish step."""
+
+    tx: Transaction
+    token: int
+    entry: MempoolTx | None
+
+    @property
+    def orphan(self) -> bool:
+        return self.entry is None
 
 
 class MiningManager:
-    def __init__(self, consensus: Consensus, config: MempoolConfig | None = None):
+    def __init__(
+        self,
+        consensus: Consensus,
+        config: MempoolConfig | None = None,
+        seed: int | None = None,
+        template_debounce: float = 0.0,
+    ):
         self.consensus = consensus
         params = consensus.params
         self.mempool = Mempool(
-            config, target_time_per_block_seconds=params.target_time_per_block / 1000.0
+            config,
+            target_time_per_block_seconds=params.target_time_per_block / 1000.0,
+            seed=seed,
         )
-        self.template_cache = TemplateCache()
+        self.template_cache = TemplateCache(debounce=template_debounce)
 
     # --- fee estimation (manager.rs get_realtime_feerate_estimations) ---
 
@@ -68,7 +121,28 @@ class MiningManager:
     def validate_and_insert_transaction(self, tx: Transaction) -> list[bytes]:
         """Validate against the virtual UTXO view and insert; returns RBF-evicted
         txids.  Raises MempoolError/TxRuleError on rejection; parks txs with
-        missing inputs in the orphan pool."""
+        missing inputs in the orphan pool.
+
+        The batched ingest tier (kaspa_tpu/ingest/) runs the same two
+        halves — ``prepare_transaction`` per entrant in arrival order, one
+        shared checker dispatch, then ``finish_transaction`` in the same
+        order — so batched admission is state-identical to this per-tx path.
+        """
+        checker = self.consensus.transaction_validator.new_checker()
+        prepared = self.prepare_transaction(tx, checker, token=0)
+        err = checker.dispatch().get(0)
+        return self.finish_transaction(prepared, err)
+
+    def prepare_transaction(self, tx: Transaction, checker, token: int) -> PreparedTx:
+        """Contextual pre-checks + signature-job collection for one entrant.
+
+        Runs everything that must see mempool/consensus state in arrival
+        order: isolation + gas-cap + header-context checks, the virtual
+        UTXO view lookup (missing inputs park the tx in the orphan pool
+        immediately), and fee/mass population — collecting the tx's
+        signature/script jobs into ``checker`` under ``token`` instead of
+        verifying inline.  Raises MempoolError/TxRuleError on pre-check
+        rejection."""
         validator = self.consensus.transaction_validator
         validator.validate_tx_in_isolation(tx)
         # per-tx gas cap (mining/src/mempool/check_transaction_limits.rs:19
@@ -76,7 +150,8 @@ class MiningManager:
         # be mined, so it must not enter the pool
         if tx.gas > self.consensus.params.gas_per_lane:
             raise MempoolError(
-                f"transaction gas {tx.gas} exceeds the per-lane cap {self.consensus.params.gas_per_lane}"
+                f"transaction gas {tx.gas} exceeds the per-lane cap {self.consensus.params.gas_per_lane}",
+                code="tx-gas",
             )
         virtual = self.consensus.virtual_state
         validator.validate_tx_in_header_context(tx, virtual.daa_score, virtual.past_median_time)
@@ -94,9 +169,8 @@ class MiningManager:
             nc = self._masses(tx)
             entry = MempoolTx(tx, fee=0, mass=nc.compute_mass, added_daa_score=virtual.daa_score, transient_mass=nc.transient_mass)
             self.mempool.insert(entry, orphan=True)
-            return []
+            return PreparedTx(tx, token, None)
 
-        checker = validator.new_checker()
         accessor = None
         if self.consensus.params.toccata_active(virtual.daa_score):
             # mempool/consensus acceptance parity for OpChainblockSeqCommit
@@ -111,14 +185,23 @@ class MiningManager:
                 self.consensus.params.finality_depth,
             )
         fee = validator.validate_populated_transaction_and_get_fee(
-            tx, entries, virtual.daa_score, checker=checker, token=0, seq_commit_accessor=accessor
+            tx, entries, virtual.daa_score, checker=checker, token=token, seq_commit_accessor=accessor
         )
-        err = checker.dispatch().get(0)
+        nc = self._masses(tx)
+        return PreparedTx(
+            tx, token, MempoolTx(tx, fee, nc.compute_mass, virtual.daa_score, nc.transient_mass)
+        )
+
+    def finish_transaction(self, prepared: PreparedTx, err) -> list[bytes]:
+        """Second half of admission: consume the verify verdict for one
+        prepared entrant and insert on success.  ``err`` is the checker's
+        per-token result (None = all signatures/scripts valid)."""
+        if prepared.entry is None:
+            return []  # parked as orphan during prepare
         if err is not None:
             raise TxRuleError(str(err))
-        nc = self._masses(tx)
-        evicted = self.mempool.insert(MempoolTx(tx, fee, nc.compute_mass, virtual.daa_score, nc.transient_mass))
-        self.template_cache.clear()
+        evicted = self.mempool.insert(prepared.entry)
+        self.template_cache.mark_dirty()
         return evicted
 
     def _masses(self, tx: Transaction):
@@ -141,8 +224,10 @@ class MiningManager:
         params = self.consensus.params
         limits = BlockMassLimits.with_shared_limit(params.max_block_mass)
         lane_limits = BlockLaneLimits(params.lanes_per_block, params.gas_per_lane)
+        t0 = time.perf_counter()
         selected = self.mempool.select_transactions(mass_limits=limits, lane_limits=lane_limits)
         template = self.consensus.build_block_template(miner_data, [e.tx for e in selected], timestamp)
+        _TEMPLATE_REBUILD_MS.observe((time.perf_counter() - t0) * 1000.0)
         self.template_cache.set(template)
         return template
 

@@ -51,6 +51,17 @@ def parse_args(argv=None) -> DaemonArgs:
     p.add_argument("--bps", type=int, default=2, help="simnet blocks per second")
     p.add_argument("--utxoindex", action=argparse.BooleanOptionalAction, default=True, help="maintain the UTXO index")
     p.add_argument(
+        "--seed", type=int, default=None,
+        help="deterministic seed for mempool template-selection sampling "
+        "(byte-reproducible template choice under congestion; default: fixed internal seed)",
+    )
+    p.add_argument(
+        "--template-debounce-ms", type=float, default=250.0,
+        help="serve a stale-but-mineable cached template for up to this long "
+        "after tx churn, so a tx flood costs one rebuild per window instead "
+        "of one per transaction (0 = rebuild on next request)",
+    )
+    p.add_argument(
         "--fanout-queue", type=int, default=1024,
         help="per-subscriber bounded notification queue length (serving tier backpressure)",
     )
@@ -284,6 +295,11 @@ class ConnectionPump:
             resp = {"id": req_id, "result": result}
         except Exception as e:  # noqa: BLE001 - wire boundary
             resp = {"id": req_id, "error": str(e)}
+            # stable machine-readable rejection code (RpcError.code):
+            # clients branch on tx-orphan/tx-duplicate/... without parsing
+            code = getattr(e, "code", None)
+            if code:
+                resp["error_code"] = code
         return (json.dumps(resp) + "\n").encode()
 
     def close(self) -> None:
@@ -404,7 +420,12 @@ class Daemon:
 
         self.cache_policy = CachePolicy().scaled(getattr(args, "ram_scale", 1.0))
         self.consensus = Consensus(self.params, db=self.db, cache_policy=self.cache_policy)
-        self.node = Node(self.consensus, name="daemon")
+        self.node = Node(
+            self.consensus,
+            name="daemon",
+            mempool_seed=getattr(args, "seed", None),
+            template_debounce=getattr(args, "template_debounce_ms", 0.0) / 1000.0,
+        )
         self.node.cmgr._factory = self._staging_factory
         self.node.cmgr.on_swap(self._on_consensus_swap)
         self.mining = self.node.mining
@@ -743,6 +764,16 @@ class Daemon:
         return "ok"
 
     def dispatch(self, method: str, params: dict):
+        if method == "submitTransaction":
+            # deliberately NOT under the dispatch lock: admission rides the
+            # batched ingest tier, whose waves take the node lock internally
+            # — concurrent submitters therefore queue up and coalesce into
+            # one verify wave instead of serializing one-by-one here
+            from kaspa_tpu.wallet.__main__ import wire_to_tx
+
+            tx = wire_to_tx(params["tx"])
+            txid = self.rpc.submit_transaction(tx)
+            return txid.hex()
         with self._dispatch_lock:
             return self._dispatch(method, params)
 
@@ -757,13 +788,6 @@ class Daemon:
                 raise ValueError("template not cached")
             status = self.node.submit_block(cached)  # insert + unorphan + relay
             return {"status": status}
-        if method == "submitTransaction":
-            from kaspa_tpu.wallet.__main__ import wire_to_tx
-
-            tx = wire_to_tx(params["tx"])
-            txid = self.rpc.submit_transaction(tx)
-            self.node.broadcast_tx(tx)
-            return txid.hex()
         fn = self._METHODS.get(method)
         if fn is None:
             raise ValueError(f"unknown method {method}")

@@ -33,6 +33,17 @@ production consensus path keeps its synchronous `dispatch()` semantics
 (submit + nudge).  Coalescing wins come from jobs that arrive while the
 device is busy — concurrent pipeline stages, the mempool lane — and from
 callers that use `dispatch_async()` to overlap their own host work.
+
+Traffic classes: a kind may be class-qualified (``"standalone_tx:schnorr"``)
+to give a workload its own batch-size dynamics without a second queue.
+Standalone-transaction admission (the ingest tier) arrives in small
+concurrent bursts rather than block-sized slabs, so the ``standalone_tx``
+class carries its own coalesce target (``KASPA_TPU_TX_COALESCE``, default
+256) and flush age (``KASPA_TPU_TX_COALESCE_AGE_MS``, default 5 ms);
+flush triggers, chunk packing, and span/counter attribution all key on
+the full qualified kind, while the device call maps back to the base
+kind — so the aggregate/auto verify-mode crossover, the fabric balancer,
+breaker degradation, and host fallback are inherited unchanged.
 """
 
 from __future__ import annotations
@@ -58,6 +69,20 @@ _super_ids = itertools.count(1)
 DEFAULT_TARGET = 1024
 _TARGET_MIN, _TARGET_MAX = 8, 16384
 _WAIT_CAP_S = 600.0  # ticket.wait() hard cap: covers a cold ladder compile
+
+# standalone-transaction admission traffic class (the ingest tier's lane)
+TX_CLASS = "standalone_tx"
+DEFAULT_TX_TARGET = 256
+
+
+def base_kind(kind: str) -> str:
+    """Strip a traffic-class qualifier: "standalone_tx:schnorr" -> "schnorr"."""
+    return kind.split(":", 1)[1] if ":" in kind else kind
+
+
+def traffic_class(kind: str) -> str:
+    """The traffic class of a (possibly qualified) kind; "block" default."""
+    return kind.split(":", 1)[0] if ":" in kind else "block"
 
 _COALESCE_DEPTH = REGISTRY.histogram(
     "dispatch_coalesce_depth", SIZE_BUCKETS,
@@ -147,7 +172,7 @@ class Ticket:
 
 @dataclass
 class _Chunk:
-    kind: str  # "schnorr" | "ecdsa"
+    kind: str  # "schnorr" | "ecdsa", optionally class-qualified ("standalone_tx:schnorr")
     items: list  # [(pubkey, msg, sig), ...] — ownership donated on submit
     ticket: Ticket
     enqueued_at: float = field(default_factory=time.monotonic)
@@ -161,9 +186,15 @@ class _Chunk:
 class CoalescingDispatcher:
     """Cross-caller coalescing queue in front of secp's batched kernels."""
 
-    def __init__(self, target: int, max_age_s: float):
+    def __init__(self, target: int, max_age_s: float, class_specs: dict | None = None):
         self.target = max(_TARGET_MIN, min(_TARGET_MAX, int(target)))
         self.max_age_s = max_age_s
+        # traffic class -> (target, max_age_s): per-class batch dynamics for
+        # class-qualified kinds; unqualified kinds use the defaults above
+        self.class_specs = {
+            cls: (max(_TARGET_MIN, min(_TARGET_MAX, int(t))), float(age))
+            for cls, (t, age) in (class_specs or {}).items()
+        }
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._idle = threading.Condition(self._lock)
@@ -259,6 +290,10 @@ class CoalescingDispatcher:
             return {
                 "target": self.target,
                 "max_age_ms": round(self.max_age_s * 1000, 3),
+                "classes": {
+                    cls: {"target": t, "max_age_ms": round(age * 1000, 3)}
+                    for cls, (t, age) in self.class_specs.items()
+                },
                 "pending_chunks": len(self._pending),
                 "inflight_chunks": len(self._inflight),
                 "unresolved_chunks": self._unresolved,
@@ -266,6 +301,14 @@ class CoalescingDispatcher:
             }
 
     # -- dispatcher thread ---------------------------------------------------
+
+    def _target_for(self, kind: str) -> int:
+        spec = self.class_specs.get(traffic_class(kind))
+        return spec[0] if spec is not None else self.target
+
+    def _age_for(self, kind: str) -> float:
+        spec = self.class_specs.get(traffic_class(kind))
+        return spec[1] if spec is not None else self.max_age_s
 
     def _flush_reason_locked(self, now: float) -> str | None:
         if not self._pending:
@@ -277,11 +320,18 @@ class CoalescingDispatcher:
         per_kind: dict[str, int] = {}
         for c in self._pending:
             per_kind[c.kind] = per_kind.get(c.kind, 0) + len(c.items)
-        if any(n >= self.target for n in per_kind.values()):
+        if any(n >= self._target_for(k) for k, n in per_kind.items()):
             return "size"
-        if now - self._pending[0].enqueued_at >= self.max_age_s:
+        if any(now - c.enqueued_at >= self._age_for(c.kind) for c in self._pending):
             return "age"
         return None
+
+    def _next_age_deadline_locked(self, now: float) -> float:
+        """Seconds until the earliest chunk ages out (the sleep bound)."""
+        return max(
+            0.0,
+            min(self._age_for(c.kind) - (now - c.enqueued_at) for c in self._pending),
+        )
 
     def _run(self) -> None:
         while True:
@@ -300,10 +350,8 @@ class CoalescingDispatcher:
                     if self._closed and not self._pending:
                         return
                     if self._pending:
-                        # sleep only until the oldest chunk ages out
-                        self._wake.wait(
-                            max(0.0, self.max_age_s - (now - self._pending[0].enqueued_at))
-                        )
+                        # sleep only until the earliest chunk ages out
+                        self._wake.wait(self._next_age_deadline_locked(now))
                     else:
                         self._wake.wait()
                 # double-buffer swap: donate the staged chunks to this flush
@@ -322,10 +370,11 @@ class CoalescingDispatcher:
         for kind, group in by_kind.items():
             # greedy whole-chunk packing into <= target super-batches (a
             # single chunk larger than the target still goes out in one)
+            target = self._target_for(kind)
             i = 0
             while i < len(group):
                 batch, jobs = [], 0
-                while i < len(group) and (not batch or jobs + len(group[i].items) <= self.target):
+                while i < len(group) and (not batch or jobs + len(group[i].items) <= target):
                     batch.append(group[i])
                     jobs += len(group[i].items)
                     i += 1
@@ -345,9 +394,11 @@ class CoalescingDispatcher:
             t0 = perf_counter_ns()
             # verify_batch resolves the process-wide verify mode, so a
             # coalesced schnorr super-batch takes the aggregate RLC lane
-            # exactly when a direct caller's batch of the same size would
+            # exactly when a direct caller's batch of the same size would;
+            # class-qualified kinds map to their base kernel here, keeping
+            # the crossover/fabric/breaker behavior identical per class
             with trace.span("dispatch.super_batch", kind=kind, jobs=jobs, chunks=len(batch)):
-                mask = np.asarray(secp.verify_batch(kind, items))
+                mask = np.asarray(secp.verify_batch(base_kind(kind), items))
             t1 = perf_counter_ns()
         except Exception as e:  # noqa: BLE001 - surfaced on every waiting ticket
             t1 = perf_counter_ns()
@@ -453,7 +504,7 @@ def _aggregate_crossover() -> int:
 
 def resolve_verify_mode(kind: str, jobs: int) -> str:
     """The lane one concrete batch should take: "ladder" or "aggregate"."""
-    if kind != "schnorr" or jobs <= 0:
+    if base_kind(kind) != "schnorr" or jobs <= 0:
         return "ladder"
     m = verify_mode()
     if m == "auto":
@@ -463,6 +514,18 @@ def resolve_verify_mode(kind: str, jobs: int) -> str:
 
 def _flush_age_s() -> float:
     return float(os.environ.get("KASPA_TPU_COALESCE_AGE_MS", "2")) / 1000.0
+
+
+def _tx_class_spec(block_target: int) -> tuple[int, float]:
+    """(target, age) for the standalone_tx class.  Admission batches are
+    built from concurrent submitters, not block-sized slabs: the default
+    target is smaller than the block-replay target and the flush age a bit
+    longer, so a burst of independent submitters coalesces while a lone
+    submitter still resolves within single-digit milliseconds."""
+    raw = os.environ.get("KASPA_TPU_TX_COALESCE", "")
+    target = int(raw) if raw else min(block_target, DEFAULT_TX_TARGET)
+    age = float(os.environ.get("KASPA_TPU_TX_COALESCE_AGE_MS", "5")) / 1000.0
+    return max(_TARGET_MIN, min(_TARGET_MAX, target)), age
 
 
 def _sweep_target() -> int:
@@ -507,7 +570,9 @@ def configure(spec: int | str | None) -> int:
     target = _sweep_target() if raw == "auto" else int(raw)
     target = max(_TARGET_MIN, min(_TARGET_MAX, target))
     with _cfg_lock:
-        _engine = CoalescingDispatcher(target, _flush_age_s())
+        _engine = CoalescingDispatcher(
+            target, _flush_age_s(), class_specs={TX_CLASS: _tx_class_spec(target)}
+        )
     return target
 
 

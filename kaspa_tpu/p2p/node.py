@@ -96,6 +96,17 @@ _ACTIVATION_GATE_SECONDS = 24 * 60 * 60
 # the threshold bans the peer's IP in the address manager, which both
 # refuses future inbound accepts and stops outbound redials
 PEER_BAN_SCORE = int(os.environ.get("KASPA_TPU_BAN_SCORE", "100"))
+# tx-relay hygiene ladder: sustained hostility crosses the ban threshold,
+# honest noise (the odd orphan, a lost RBF race) never does
+TX_ORPHAN_POINTS = 2  # orphan storm: ban after ~50 parentless relays
+TX_DOUBLE_SPEND_POINTS = 5  # double-spend/RBF-churn chains: ban after ~20
+TX_INVALID_POINTS = 30  # invalid signature/script: outright hostile
+# an INV larger than this is a flood, not gossip (the reference bounds
+# inv batching at MAX_INV_PER_TX_INV_MSG)
+MAX_INV_PER_MSG = 512
+# a requested txid the peer never delivered stops shadowing re-requests
+# after this long
+TX_REQUEST_TTL_SECONDS = 30.0
 # an IBD donor that stops making progress (no message advancing the sync
 # for this long) is abandoned — the one-active-sync slot must not be
 # wedgeable by a stalled or malicious peer
@@ -163,15 +174,34 @@ class Peer:
 class Node:
     """A full node instance: consensus + mempool + flow handlers + hub."""
 
-    def __init__(self, consensus: Consensus, name: str = "node"):
+    def __init__(
+        self,
+        consensus: Consensus,
+        name: str = "node",
+        mempool_seed: int | None = None,
+        template_debounce: float = 0.0,
+    ):
         import threading
 
         from kaspa_tpu.consensus.manager import ConsensusManager
         from kaspa_tpu.pipeline import ConsensusPipeline
 
+        from kaspa_tpu.ingest import IngestTier
+
         self.name = name
         self.cmgr = ConsensusManager(consensus)
-        self.mining = MiningManager(consensus)
+        # deterministic template-selection sampling: the same seed makes
+        # frontier weighted sampling (and thus SUSTAIN fingerprints)
+        # byte-reproducible across runs and across the consensus swaps below
+        self.mempool_seed = mempool_seed
+        # tx-churn template rebuilds collapse to one per debounce window
+        # (0 = rebuild on next request, the historical behavior)
+        self.template_debounce = template_debounce
+        self.mining = MiningManager(consensus, seed=mempool_seed, template_debounce=template_debounce)
+        # requested-but-undelivered txids: txid -> request time.  Shared
+        # across peers so N connections advertising the same flood tx cost
+        # one request, not N (flowcontext transactions_spread dedup role)
+        self._tx_requested: dict[bytes, float] = {}
         # wired by the daemon; None in bare in-process tests (flows no-op)
         self.address_manager = None
         self.listen_port = 0  # advertised in the version handshake
@@ -197,6 +227,11 @@ class Node:
         # pipeline always, consensus/src/consensus/mod.rs:369-401; there is
         # no synchronous alternative path)
         self.pipeline = ConsensusPipeline(consensus, workers=2)
+        # batched admission front door (kaspa_tpu/ingest/): RPC submits and
+        # P2P relay enqueue tickets; whoever pumps under the node lock
+        # admits every concurrently-queued entrant in one wave with a single
+        # coalesced verify dispatch (the standalone_tx traffic class)
+        self.ingest = IngestTier(self.mining, lock=self.lock)
 
     @property
     def consensus(self) -> Consensus:
@@ -207,7 +242,10 @@ class Node:
         (pending txs are dropped — they reference the stale DAG)."""
         from kaspa_tpu.pipeline import ConsensusPipeline
 
-        self.mining = MiningManager(new_consensus)
+        self.mining = MiningManager(
+            new_consensus, seed=self.mempool_seed, template_debounce=self.template_debounce
+        )
+        self.ingest.mining = self.mining  # queued entrants admit against the new DAG
         self._drop_ibd_pipeline()
         old = self.pipeline
         self.pipeline = ConsensusPipeline(new_consensus, workers=2)
@@ -304,9 +342,19 @@ class Node:
         self.broadcast_block(block)
         return status
 
-    def submit_transaction(self, tx) -> None:
-        self.mining.validate_and_insert_transaction(tx)
+    def submit_transaction(self, tx) -> list[bytes]:
+        """RPC-facing admission through the batched ingest tier.
+
+        Same contract as the old direct call — raises on rejection, parks
+        orphans silently, returns RBF-evicted txids — but concurrent
+        submitters now share one verify wave, and the relay only carries
+        txs that actually entered a pool."""
+        from kaspa_tpu.ingest import SOURCE_RPC
+
+        ticket = self.ingest.admit(tx, SOURCE_RPC)
+        evicted = ticket.raise_for_status()
         self.broadcast_tx(tx)
+        return evicted
 
     # --- flow handlers (protocol/flows/src/v7/) ---
 
@@ -443,23 +491,36 @@ class Node:
         elif msg_type == MSG_BLOCK:
             self._on_relay_block(peer, payload)
         elif msg_type == MSG_INV_TXS:
-            unknown = [t for t in payload if not self.mining.mempool.has(t)]
+            if len(payload) > MAX_INV_PER_MSG:
+                # inventory flood: refuse the oversized frame, charge the
+                # sender, and don't fan N*request traffic out of it
+                self.score_misbehavior(peer, "inv_flood", 20)
+                return
+            now = _monotonic()
+            # expire requests a peer never answered so re-advertisement works
+            if self._tx_requested:
+                self._tx_requested = {
+                    t: ts for t, ts in self._tx_requested.items()
+                    if now - ts < TX_REQUEST_TTL_SECONDS
+                }
+            mempool = self.mining.mempool
+            unknown = [
+                t
+                for t in payload
+                if not mempool.has(t) and t not in mempool.accepted and t not in self._tx_requested
+            ]
             if unknown:
+                for t in unknown:
+                    self._tx_requested[t] = now
                 peer.send(MSG_REQUEST_TXS, unknown)
         elif msg_type == MSG_REQUEST_TXS:
-            for txid in payload:
+            for txid in payload[:MAX_INV_PER_MSG]:
                 entry = self.mining.mempool.get(txid)
                 if entry is not None:
+                    peer.known_txs.add(txid)
                     peer.send(MSG_TX, entry.tx)
         elif msg_type == MSG_TX:
-            from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
-
-            peer.known_txs.add(payload.id())
-            try:
-                self.mining.validate_and_insert_transaction(payload)
-            except (MempoolError, TxRuleError):
-                return  # relay rejections are not punished unless malformed
-            self.broadcast_tx(payload)
+            self._on_relay_tx(peer, payload)
         elif msg_type == MSG_IBD_BLOCK_LOCATOR:
             # negotiate.rs donor side: highest locator entry we know anchors
             # the antipast query; unknown locator => serve from our pruning
@@ -696,6 +757,40 @@ class Node:
                 f.result(timeout=600)
             except RuleError:
                 pass  # invalid blocks within an IBD batch are skipped
+
+    def _on_relay_tx(self, peer: Peer, tx) -> None:
+        """Tx-relay intake with flood hygiene (flows/src/v7/txrelay/flow.rs).
+
+        Admission rides the batched ingest tier (source ``p2p``).  The
+        verdict feeds the misbehavior ladder: parentless relays (orphan
+        storms) and double-spend/RBF-churn chains accumulate points until
+        the peer crosses the ban score; invalid signatures/scripts are
+        charged hard.  Honest outcomes — duplicates from gossip races, a
+        fee floor, our own backpressure — are free.  Only txs that entered
+        the live pool are rebroadcast (orphans would propagate the storm).
+        """
+        from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
+        from kaspa_tpu.ingest import SOURCE_P2P
+
+        txid = tx.id()
+        peer.known_txs.add(txid)
+        self._tx_requested.pop(txid, None)
+        ticket = self.ingest.admit(tx, SOURCE_P2P)
+        if ticket.status == "accepted":
+            self.broadcast_tx(tx)
+            return
+        banned = False
+        if ticket.status == "orphaned":
+            banned = self.score_misbehavior(peer, "tx_orphan", TX_ORPHAN_POINTS)
+        elif isinstance(ticket.error, TxRuleError):
+            banned = self.score_misbehavior(peer, "invalid_tx", TX_INVALID_POINTS)
+        elif isinstance(ticket.error, MempoolError) and ticket.error.code in (
+            "tx-double-spend",
+            "tx-rbf-rejected",
+        ):
+            banned = self.score_misbehavior(peer, "tx_double_spend", TX_DOUBLE_SPEND_POINTS)
+        if banned and hasattr(peer, "close"):
+            peer.close()
 
     def _on_relay_block(self, peer: Peer, block: Block) -> None:
         # flight trace starts at the wire: the pipeline's own begin() on

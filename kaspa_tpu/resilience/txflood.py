@@ -1,0 +1,486 @@
+"""Hostile transaction-flood harness: the ingest tier's chaos acceptance run.
+
+Builds a DAG with the simulator, then replays it into a fresh consensus
+at a *true* blocks-per-second cadence while a deterministic adversary
+floods the ingest tier between block deliveries:
+
+- **clean spends** — valid P2PK spends of mature miner UTXOs, paying out
+  to a flood-owned key (so their ids can never collide with in-block
+  txs); these are the fraction the sustained-acceptance gate measures;
+- **double-spend chains** — bursts of conflicting spends of an outpoint
+  a clean flood tx already spent, each id-distinct via a skewed output
+  split; the pool must reject every one (tx-double-spend / tx-rbf-rejected);
+- **RBF churn** — fee-escalating replacement chains on one outpoint;
+  each link must evict its predecessor, thrashing the frontier and the
+  template cache (the debounce knob is what bounds the rebuild cost);
+- **orphan storms** — children of a withheld parent tx, parked in the
+  orphan pool on the missing-input path without ever touching verify.
+
+All flood traffic rides ``IngestTier.submit`` + ``pump`` (alternating
+rpc/p2p source lanes), so waves batch onto the verify plane under the
+``standalone_tx`` traffic class while the configured fault schedule
+(device-verify errors, VM-fallback retries) fires underneath — sustained
+admission through the breaker's degraded lane is the point.
+
+Flood transactions are never mined, so consensus state is independent of
+the flood by construction: the report's ``matches_fault_free`` compares
+the chaos run's end-state fingerprints against a flood-free in-order
+baseline, proving the admission tier perturbed nothing.  The new
+``ingest`` block records the sustained acceptance rate on the clean
+fraction, template-rebuild p50/p99 (from ``mempool_template_rebuild_ms``
+scoped to this run), peak mempool/orphan occupancy, and the
+lost-ticket count (must be 0: every submission resolves exactly once).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from collections import deque
+from dataclasses import asdict, dataclass
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+from kaspa_tpu.consensus.model.tx import (
+    SUBNETWORK_ID_NATIVE,
+    ComputeCommit,
+    TransactionOutpoint,
+    UtxoEntry,
+)
+from kaspa_tpu.consensus.processes.coinbase import MinerData
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.ingest.queue import SOURCE_P2P, SOURCE_RPC
+from kaspa_tpu.ingest.tier import ACCEPTED, ORPHANED, IngestTier
+from kaspa_tpu.mempool.mining_manager import _TEMPLATE_REBUILD_MS, MiningManager
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.resilience.breaker import device_breaker
+from kaspa_tpu.resilience.faults import FAULTS
+from kaspa_tpu.resilience.sustain import (
+    _DELTA_COUNTERS,
+    _delta,
+    _fingerprints,
+    _insert,
+    default_schedule,
+)
+from kaspa_tpu.sim.simulator import Miner, SimConfig, simulate
+from kaspa_tpu.txscript import standard
+
+
+@dataclass
+class TxFloodConfig:
+    """Per-block-slot flood rates (one slot per delivered block)."""
+
+    clean_per_block: int = 6
+    double_spend_per_block: int = 2  # targeted outpoints per slot
+    double_spend_chain: int = 3  # conflicting spends per targeted outpoint
+    orphans_per_block: int = 2  # children of the slot's withheld parent
+    rbf_per_block: int = 1  # replacement chains opened per slot
+    rbf_chain: int = 3  # links per chain (fee escalates each link)
+    rbf_fee_step: int = 2_000  # sompi added per replacement link
+    seed: int | None = None  # default: sim seed ^ 0xF100D
+
+
+class FloodStream:
+    """Deterministic adversarial tx generator bound to a live consensus.
+
+    Re-derives the simulator's miner keys from the sim seed (the miner
+    list is the first thing ``simulate`` draws from its rng), so it can
+    sign real spends of any mature in-chain UTXO; pays out to its own
+    key so flood txids are disjoint from every in-block txid.
+    """
+
+    _KINDS = ("clean", "double_spend", "rbf", "orphan")
+
+    def __init__(self, consensus: Consensus, cfg: SimConfig, flood: TxFloodConfig, rng: random.Random):
+        self.consensus = consensus
+        self.flood = flood
+        self.rng = rng
+        mrng = random.Random(cfg.seed)
+        self.miners = [Miner(i, mrng, hostile=cfg.hostile) for i in range(cfg.num_miners)]
+        self.seckey = rng.randrange(1, eclib.N)
+        self.spk = standard.pay_to_pub_key(eclib.schnorr_pubkey(self.seckey))
+        self.miner_data = MinerData(self.spk, extra_data=b"txflood")
+        self.mass_calc = consensus.transaction_validator.mass_calculator
+        self.spent: set[TransactionOutpoint] = set()
+        self._recent: deque = deque(maxlen=32)  # (outpoint, entry, seckey) of clean spends
+        self.counters: dict[str, int] = {"submitted": 0, "evicted": 0, "other": 0}
+        for k in self._KINDS:
+            self.counters[f"{k}_submitted"] = 0
+        for k in ("clean_accepted", "double_spend_rejected", "double_spend_landed",
+                  "orphan_parked", "rbf_replaced", "rbf_opened", "rbf_rejected"):
+            self.counters[k] = 0
+
+    # -- candidate UTXOs -----------------------------------------------
+
+    def _seckey_for(self, spk):
+        for m in self.miners:
+            if m.spk == spk:
+                return m.seckey
+        return None
+
+    def _candidates(self, limit: int) -> list:
+        """Mature miner-owned P2PK UTXOs the flood has not spent yet,
+        walking the layered virtual view (simulator tx_selector idiom)."""
+        view = self.consensus.get_virtual_utxo_view()
+        pov = self.consensus.get_virtual_daa_score()
+        maturity = self.consensus.params.coinbase_maturity
+        items = list(view.diff.add.items())
+        under = view.base
+        while hasattr(under, "base"):
+            items += list(under.diff.add.items())
+            under = under.base
+        items += list(under.items())
+        removed = set(view.diff.remove.keys())
+        out, seen = [], set()
+        for outpoint, entry in items:
+            if len(out) >= limit:
+                break
+            if outpoint in seen or outpoint in self.spent or outpoint in removed:
+                continue
+            seen.add(outpoint)
+            if view.get(outpoint) is None:
+                continue
+            if entry.is_coinbase and entry.block_daa_score + maturity > pov:
+                continue
+            seckey = self._seckey_for(entry.script_public_key)
+            if seckey is None:
+                continue
+            out.append((outpoint, entry, seckey))
+        return out
+
+    @staticmethod
+    def _take(cands: list):
+        return cands.pop(0) if cands else None
+
+    # -- tx construction ------------------------------------------------
+
+    def _spend(self, outpoint, entry, seckey, fee: int = 0, skew: int = 0) -> Transaction | None:
+        """One-input two-output spend to the flood key.  ``fee`` shrinks
+        the output sum (RBF feerate ladder); ``skew`` shifts the split so
+        conflicting spends of one outpoint get distinct txids (txid
+        excludes signature scripts)."""
+        amount = entry.amount - fee
+        half = amount // 2 - skew
+        if half <= 0 or amount - half <= 0:
+            return None
+        outputs = [TransactionOutput(half, self.spk), TransactionOutput(amount - half, self.spk)]
+        inp = TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))
+        tx = Transaction(0, [inp], outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+        tx.storage_mass = self.mass_calc.calc_contextual_masses(tx, [entry])
+        reused = chash.SigHashReusedValues()
+        msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+        sig = eclib.schnorr_sign(msg, seckey, self.rng.randbytes(32))
+        tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+        tx._id_cache = None
+        return tx
+
+    def _build_slot(self) -> list[tuple[str, Transaction]]:
+        f = self.flood
+        cands = self._candidates(f.clean_per_block + f.rbf_per_block + 2)
+        plan: list[tuple[str, Transaction]] = []
+        # reserve rbf/orphan candidates from the tail so a thin UTXO set
+        # (early run, post-reorg) doesn't let the clean loop starve them
+        n_reserve = min(f.rbf_per_block + (1 if f.orphans_per_block else 0), max(len(cands) - 1, 0))
+        reserve = [cands.pop() for _ in range(n_reserve)]
+        # double-spend targets: clean spends from *previous* slots only —
+        # the source-lane round-robin may reorder a same-slot conflict
+        # ahead of its clean target inside the wave
+        targets = list(self._recent)
+
+        for _ in range(f.clean_per_block):
+            got = self._take(cands)
+            if got is None:
+                break
+            outpoint, entry, seckey = got
+            tx = self._spend(outpoint, entry, seckey)
+            if tx is None:
+                continue
+            self.spent.add(outpoint)
+            self._recent.append(got)
+            plan.append(("clean", tx))
+
+        for _ in range(f.double_spend_per_block):
+            if not targets:
+                break
+            outpoint, entry, seckey = targets[self.rng.randrange(len(targets))]
+            for k in range(1, f.double_spend_chain + 1):
+                tx = self._spend(outpoint, entry, seckey, skew=k)
+                if tx is not None:
+                    plan.append(("double_spend", tx))
+
+        for _ in range(f.rbf_per_block):
+            got = self._take(reserve) or self._take(cands)
+            if got is None:
+                break
+            outpoint, entry, seckey = got
+            self.spent.add(outpoint)
+            for k in range(1, f.rbf_chain + 1):
+                tx = self._spend(outpoint, entry, seckey, fee=k * f.rbf_fee_step)
+                if tx is not None:
+                    plan.append(("rbf", tx))
+
+        if f.orphans_per_block:
+            got = self._take(reserve) or self._take(cands)
+            if got is not None:
+                outpoint, entry, seckey = got
+                self.spent.add(outpoint)
+                parent = self._spend(outpoint, entry, seckey)  # built, never submitted
+                if parent is not None:
+                    pov = self.consensus.get_virtual_daa_score()
+                    n_out = len(parent.outputs)
+                    for k in range(f.orphans_per_block):
+                        out = parent.outputs[k % n_out]
+                        ghost = UtxoEntry(out.value, out.script_public_key, pov, False)
+                        child = self._spend(
+                            TransactionOutpoint(parent.id(), k % n_out),
+                            ghost, self.seckey, skew=k // n_out,
+                        )
+                        if child is not None:
+                            plan.append(("orphan", child))
+        return plan
+
+    # -- submission + outcome accounting --------------------------------
+
+    def step(self, tier: IngestTier) -> int:
+        """One block slot's worth of flood: submit everything, pump one
+        batched wave, classify every resolved ticket."""
+        plan = self._build_slot()
+        tickets = []
+        for i, (kind, tx) in enumerate(plan):
+            source = SOURCE_RPC if i % 2 == 0 else SOURCE_P2P
+            tickets.append((kind, tier.submit(tx, source)))
+        tier.pump()
+        for kind, ticket in tickets:
+            self._classify(kind, ticket)
+        return len(plan)
+
+    def _classify(self, kind: str, t) -> None:
+        c = self.counters
+        c["submitted"] += 1
+        c[f"{kind}_submitted"] += 1
+        code = getattr(t.error, "code", None)
+        if kind == "clean" and t.status == ACCEPTED:
+            c["clean_accepted"] += 1
+        elif kind == "double_spend":
+            if code in ("tx-double-spend", "tx-rbf-rejected"):
+                c["double_spend_rejected"] += 1
+            elif t.status == ACCEPTED:
+                # the conflicted pool tx was mined/conflicted away first —
+                # this spend is now genuinely fresh, count it honestly
+                c["double_spend_landed"] += 1
+            else:
+                c["other"] += 1
+        elif kind == "orphan":
+            if t.status == ORPHANED:
+                c["orphan_parked"] += 1
+            else:
+                c["other"] += 1
+        elif kind == "rbf":
+            if t.status == ACCEPTED and t.evicted:
+                c["rbf_replaced"] += 1
+                c["evicted"] += len(t.evicted)
+            elif t.status == ACCEPTED:
+                c["rbf_opened"] += 1  # first link of the chain
+            elif code == "tx-rbf-rejected":
+                c["rbf_rejected"] += 1
+            else:
+                c["other"] += 1
+        elif kind == "clean":
+            c["other"] += 1
+
+
+# --- the paced chaos replay -----------------------------------------------
+
+
+def _flood_replay(
+    consensus: Consensus,
+    mining: MiningManager,
+    tier: IngestTier,
+    flood: FloodStream,
+    blocks: list,
+    seed: int,
+    pace_s: float = 0.0,
+    window: int = 8,
+) -> dict:
+    """Deliver ``blocks`` in shuffled orphan-tolerant windows (sustain.py
+    discipline) with one flood slot + one template poll per block, paced
+    to ``pace_s`` wall seconds per block when set."""
+    rng = random.Random(seed ^ 0x5EED)
+    order: list = []
+    for i in range(0, len(blocks), window):
+        chunk = list(blocks[i : i + window])
+        rng.shuffle(chunk)
+        order.extend(chunk)
+
+    def ready(b) -> bool:
+        return all(consensus.storage.headers.has(p) for p in b.header.direct_parents())
+
+    def land(b) -> None:
+        _insert(consensus, b)
+        mining.handle_new_block_transactions(list(b.transactions), consensus.get_virtual_daa_score())
+
+    peak_pool = peak_orphans = 0
+    pending: dict[bytes, object] = {}
+    t0 = time.perf_counter()
+    t_next = time.monotonic() + pace_s
+    for b in order:
+        flood.step(tier)
+        # poll the template every slot: with debounce on, a flood slot
+        # costs one rebuild per debounce window, not one per tx
+        mining.get_block_template(flood.miner_data)
+        peak_pool = max(peak_pool, len(mining.mempool.pool))
+        peak_orphans = max(peak_orphans, len(mining.mempool.orphans))
+        if pace_s:
+            now = time.monotonic()
+            if t_next > now:
+                time.sleep(t_next - now)
+            t_next = max(t_next, now) + pace_s
+        if not ready(b):
+            pending[b.hash] = b
+            continue
+        land(b)
+        progress = True
+        while progress:
+            progress = False
+            for h, pb in list(pending.items()):
+                if ready(pb):
+                    del pending[h]
+                    land(pb)
+                    progress = True
+    assert not pending, f"{len(pending)} blocks never became insertable"
+    return {
+        "peak_pool": peak_pool,
+        "peak_orphans": peak_orphans,
+        "delivery_seconds": time.perf_counter() - t0,
+    }
+
+
+def _rebuild_window(before_counts: list[int], before_count: int, before_sum: float) -> dict:
+    """p50/p99 of the template-rebuild histogram scoped to this run
+    (bucket-delta quantiles, same upper-edge semantics as Histogram)."""
+    h = _TEMPLATE_REBUILD_MS
+    counts = [a - b for a, b in zip(h.counts, before_counts)]
+    count = h.count - before_count
+
+    def q(qq: float) -> float:
+        if count == 0:
+            return 0.0
+        rank, seen = qq * count, 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= rank and c:
+                return h.edges[i] if i < len(h.edges) else float("inf")
+        return float("inf")
+
+    return {
+        "count": count,
+        "sum_ms": round(h.sum - before_sum, 3),
+        "p50_ms": q(0.50),
+        "p99_ms": q(0.99),
+    }
+
+
+def run_txflood_sustain(
+    cfg: SimConfig,
+    flood_cfg: TxFloodConfig | None = None,
+    schedule: dict | None = None,
+    seed: int = 0,
+    out: str | None = None,
+    pace: bool = True,
+    template_debounce: float = 0.25,
+) -> dict:
+    """The tx-flood sustain benchmark; returns (and optionally writes to
+    ``out``) a SUSTAIN.json-shaped report with the extra ``ingest`` block."""
+    schedule = default_schedule() if schedule is None else schedule
+    flood_cfg = flood_cfg or TxFloodConfig()
+    main = simulate(cfg)
+    blocks = main.blocks
+
+    # flood-free in-order baseline: the fingerprints the chaos run must hit
+    FAULTS.clear()
+    baseline = Consensus(main.params)
+    for b in blocks:
+        _insert(baseline, b)
+    base_fp = _fingerprints(baseline)
+
+    breaker = device_breaker()
+    breaker.reset()
+    before = REGISTRY.snapshot()["counters"]
+    rb_counts, rb_count, rb_sum = (
+        list(_TEMPLATE_REBUILD_MS.counts),
+        _TEMPLATE_REBUILD_MS.count,
+        _TEMPLATE_REBUILD_MS.sum,
+    )
+    FAULTS.configure(schedule, seed)
+    try:
+        faulted = Consensus(main.params)
+        mining = MiningManager(faulted, seed=seed, template_debounce=template_debounce)
+        tier = IngestTier(mining)
+        frng = random.Random(flood_cfg.seed if flood_cfg.seed is not None else cfg.seed ^ 0xF100D)
+        flood = FloodStream(faulted, cfg, flood_cfg, frng)
+        t0 = time.perf_counter()
+        replay_stats = _flood_replay(
+            faulted, mining, tier, flood, blocks, seed,
+            pace_s=(1.0 / cfg.bps) if pace and cfg.bps else 0.0,
+        )
+        elapsed = time.perf_counter() - t0
+        events = FAULTS.events()
+    finally:
+        FAULTS.clear()
+    after = REGISTRY.snapshot()["counters"]
+    fp = _fingerprints(faulted)
+    tier_stats = tier.stats()
+    rebuild = _rebuild_window(rb_counts, rb_count, rb_sum)
+
+    fl = flood.counters
+    clean_rate = fl["clean_accepted"] / fl["clean_submitted"] if fl["clean_submitted"] else 0.0
+    delivery_s = replay_stats["delivery_seconds"]
+    report = {
+        "config": {
+            **asdict(cfg),
+            "fault_seed": seed,
+            "schedule": schedule,
+            "flood": asdict(flood_cfg),
+            "paced": bool(pace),
+            "template_debounce_s": template_debounce,
+        },
+        "deterministic": {
+            "blocks": len(blocks),
+            "events": events,
+            "fingerprints": fp,
+            "fault_free_fingerprints": base_fp,
+            "matches_fault_free": fp == base_fp,
+        },
+        "breaker": breaker.snapshot(),
+        "ingest": {
+            "tx_acceptance_rate": round(clean_rate, 4),
+            "clean_submitted": fl["clean_submitted"],
+            "clean_accepted": fl["clean_accepted"],
+            "flood": dict(sorted(fl.items())),
+            "template_rebuilds": rebuild["count"],
+            "template_rebuild_p50_ms": rebuild["p50_ms"],
+            "template_rebuild_p99_ms": rebuild["p99_ms"],
+            "template_rebuild_sum_ms": rebuild["sum_ms"],
+            "peak_mempool_occupancy": replay_stats["peak_pool"],
+            "peak_orphan_occupancy": replay_stats["peak_orphans"],
+            "end_mempool_occupancy": len(mining.mempool.pool),
+            "lost_tickets": tier_stats["lost"],
+            "waves": tier_stats["waves"],
+            "tier": tier_stats,
+            "bps_target": cfg.bps,
+            "actual_bps": round(len(blocks) / delivery_s, 2) if delivery_s else None,
+        },
+        "metrics": {
+            "replay_seconds": round(elapsed, 3),
+            "blocks_per_sec": round(len(blocks) / elapsed, 2) if elapsed else None,
+            "fault_injections": _delta(before, after, "fault_injections"),
+            **{name: _delta(before, after, name) for name in _DELTA_COUNTERS},
+        },
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return report

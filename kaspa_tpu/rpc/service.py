@@ -30,7 +30,15 @@ from kaspa_tpu.utils.sync import lock_trace_snapshot as _lock_trace_snapshot
 
 
 class RpcError(Exception):
-    pass
+    """RPC-level rejection.  ``code`` is a stable machine-readable
+    identifier forwarded on the wire (rpc.rs RpcError submit categories):
+    clients branch on tx-orphan / tx-duplicate / tx-rbf-rejected /
+    tx-fee-too-low / tx-double-spend / mempool-full / tx-gas / tx-invalid
+    without parsing prose."""
+
+    def __init__(self, message: str, code: str = "rpc-error"):
+        super().__init__(message)
+        self.code = code
 
 
 @dataclass
@@ -191,26 +199,57 @@ class RpcCoreService:
 
     # --- transactions ---
 
-    def submit_transaction(self, tx) -> bytes:
+    def _admit_transaction(self, tx) -> list[bytes]:
+        """Shared admission for submit/replacement: through the node's
+        batched ingest tier when p2p is wired (concurrent submitters share
+        a verify wave; accepted txs are relayed), direct otherwise.  Maps
+        rejections to RpcError with the mempool's stable code, and reports
+        an orphan park explicitly — the reference's submit rejects orphans
+        unless allow_orphan, and a caller must be able to tell a parked tx
+        from a pooled one (rpc.rs RejectedTransactionIsAnOrphan)."""
         from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
 
         try:
-            self.mining.validate_and_insert_transaction(tx)
-        except (MempoolError, TxRuleError) as e:
-            raise RpcError(f"transaction rejected: {e}") from e
+            if self.p2p_node is not None:
+                evicted = self.p2p_node.submit_transaction(tx)
+            else:
+                evicted = self.mining.validate_and_insert_transaction(tx)
+        except MempoolError as e:
+            raise RpcError(f"transaction rejected: {e}", code=e.code) from e
+        except TxRuleError as e:
+            raise RpcError(f"transaction rejected: {e}", code="tx-invalid") from e
+        if tx.id() in self.mining.mempool.orphans:
+            raise RpcError(
+                f"transaction {tx.id().hex()} is an orphan (missing inputs); "
+                "it was parked in the orphan pool awaiting its parents",
+                code="tx-orphan",
+            )
+        return evicted
+
+    def submit_transaction(self, tx) -> bytes:
+        self._admit_transaction(tx)
         return tx.id()
 
-    def get_mempool_entries(self) -> list[dict]:
-        return [
-            {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass}
+    def get_mempool_entries(self, include_orphan_pool: bool = True) -> list[dict]:
+        out = [
+            {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass, "is_orphan": False}
             for txid, e in self.mining.mempool.pool.items()
         ]
+        if include_orphan_pool:
+            out.extend(
+                {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass, "is_orphan": True}
+                for txid, e in self.mining.mempool.orphans.items()
+            )
+        return out
 
     def get_mempool_entry(self, txid: bytes) -> dict:
         e = self.mining.mempool.get(txid)
-        if e is None:
-            raise RpcError(f"transaction {txid.hex()} not in mempool")
-        return {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass}
+        if e is not None:
+            return {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass, "is_orphan": False}
+        e = self.mining.mempool.orphans.get(txid)
+        if e is not None:
+            return {"transaction_id": txid.hex(), "fee": e.fee, "mass": e.mass, "is_orphan": True}
+        raise RpcError(f"transaction {txid.hex()} not in mempool")
 
     # --- utxos / balances (utxoindex-backed, rpc.rs get_utxos_by_addresses) ---
 
@@ -517,12 +556,7 @@ class RpcCoreService:
 
     def submit_transaction_replacement(self, tx) -> dict:
         """RBF submission: returns the replaced txid (rpc.rs)."""
-        from kaspa_tpu.consensus.processes.transaction_validator import TxRuleError
-
-        try:
-            evicted = self.mining.validate_and_insert_transaction(tx)
-        except (MempoolError, TxRuleError) as e:
-            raise RpcError(f"transaction rejected: {e}") from e
+        evicted = self._admit_transaction(tx)
         return {
             "transaction_id": tx.id().hex(),
             "replaced_transaction_ids": [t.hex() for t in evicted],

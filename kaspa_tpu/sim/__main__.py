@@ -81,6 +81,23 @@ def main() -> None:
         "attacker-fork deep reorg, out-of-order delivery; writes SUSTAIN.json",
     )
     p.add_argument(
+        "--txflood", action="store_true",
+        help="tx-flood sustain run: flood the batched ingest tier with clean spends, "
+        "double-spend chains, RBF churn and orphan storms between paced block "
+        "deliveries under the chaos schedule; adds the 'ingest' block to SUSTAIN.json "
+        "(combine with --hostile for the fast-path-bypass script mix)",
+    )
+    p.add_argument(
+        "--txflood-rates", default=None, metavar="JSON",
+        help="override TxFloodConfig fields for --txflood, "
+        "e.g. '{\"clean_per_block\": 12, \"rbf_chain\": 5}'",
+    )
+    p.add_argument(
+        "--no-pace", action="store_true",
+        help="with --txflood: deliver blocks as fast as possible instead of the "
+        "true --bps wall-clock cadence",
+    )
+    p.add_argument(
         "--faults", default="default", metavar="SPEC",
         help="fault schedule for --hostile: 'default', 'none', inline JSON, or @/path/to/schedule.json",
     )
@@ -110,6 +127,9 @@ def main() -> None:
         num_blocks=args.blocks, txs_per_block=args.tpb, seed=args.seed,
         hostile=args.hostile,
     )
+    if args.txflood:
+        _run_txflood(cfg, args)
+        return
     if args.hostile:
         if args.wedge_drill:
             _run_wedge(cfg, args)
@@ -215,6 +235,56 @@ def _run_hostile(cfg, args) -> None:
             f"matches_fault_free={det['matches_fault_free']} -> {args.sustain_out}"
         )
     if not det["matches_fault_free"]:
+        raise SystemExit(2)
+
+
+def _run_txflood(cfg, args) -> None:
+    from kaspa_tpu.resilience.txflood import TxFloodConfig, run_txflood_sustain
+
+    flood = TxFloodConfig()
+    if args.txflood_rates:
+        for k, v in json.loads(args.txflood_rates).items():
+            if not hasattr(flood, k):
+                raise SystemExit(f"unknown txflood rate field: {k}")
+            setattr(flood, k, v)
+    report = run_txflood_sustain(
+        cfg,
+        flood_cfg=flood,
+        schedule=_parse_schedule(args.faults),
+        seed=args.seed,
+        out=args.sustain_out,
+        pace=not args.no_pace,
+    )
+    det, ing = report["deterministic"], report["ingest"]
+    summary = {
+        "blocks": det["blocks"],
+        "matches_fault_free": det["matches_fault_free"],
+        "fault_events": len(det["events"]),
+        "txs_submitted": ing["flood"]["submitted"],
+        "tx_acceptance_rate": ing["tx_acceptance_rate"],
+        "template_rebuilds": ing["template_rebuilds"],
+        "template_rebuild_p50_ms": ing["template_rebuild_p50_ms"],
+        "template_rebuild_p99_ms": ing["template_rebuild_p99_ms"],
+        "peak_mempool_occupancy": ing["peak_mempool_occupancy"],
+        "lost_tickets": ing["lost_tickets"],
+        "waves": ing["waves"],
+        "actual_bps": ing["actual_bps"],
+        "sink": det["fingerprints"]["sink"],
+        "sustain_out": args.sustain_out,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"txflood: {det['blocks']} blocks at {ing['actual_bps']} BPS "
+            f"(target {ing['bps_target']}), {ing['flood']['submitted']} txs flooded, "
+            f"clean acceptance {ing['tx_acceptance_rate']}, "
+            f"rebuilds={ing['template_rebuilds']} p50={ing['template_rebuild_p50_ms']}ms "
+            f"p99={ing['template_rebuild_p99_ms']}ms, "
+            f"peak pool={ing['peak_mempool_occupancy']}, lost={ing['lost_tickets']}, "
+            f"matches_fault_free={det['matches_fault_free']} -> {args.sustain_out}"
+        )
+    if not det["matches_fault_free"] or ing["lost_tickets"] != 0:
         raise SystemExit(2)
 
 

@@ -186,14 +186,26 @@ class BatchScriptChecker:
     ``fallback_workers``: width of the VM fallback lane (None = shared
     default pool, sized by KASPA_TPU_VM_FALLBACK_WORKERS or cpu count;
     0/1 = serial execution at dispatch — same results either way).
+
+    ``traffic_class``: coalescing-queue traffic class for this checker's
+    device submissions (e.g. ``"standalone_tx"`` for the ingest tier's
+    admission batches).  Class-qualified kinds get their own coalesce
+    target/age and counters in ops/dispatch; results are bit-identical.
     """
 
-    def __init__(self, sig_cache: SigCache | None = None, vm_fallback=None, fallback_workers: int | None = None):
+    def __init__(
+        self,
+        sig_cache: SigCache | None = None,
+        vm_fallback=None,
+        fallback_workers: int | None = None,
+        traffic_class: str | None = None,
+    ):
         self.sig_cache = sig_cache if sig_cache is not None else SigCache()
         # contract: fn(tx, entries, input_index, reused, pov_daa_score) — the
         # daa score drives fork-activation gating inside the engine
         self.vm_fallback = vm_fallback
         self.fallback_workers = fallback_workers
+        self.traffic_class = traffic_class
         self._jobs: list[_Job] = []
         self._fallbacks: list[_FallbackJob] = []
         self._results: dict[int, Exception | None] = {}
@@ -322,12 +334,19 @@ class BatchScriptChecker:
         tickets = None
         if engine is not None:
             # chunk ownership is donated to the coalescing queue: the item
-            # lists are never touched again from this side
+            # lists are never touched again from this side.  A traffic class
+            # qualifies the kind so the queue applies per-class batch
+            # dynamics; the device call maps back to the base kernel.
+            prefix = f"{self.traffic_class}:" if self.traffic_class else ""
             tickets = {}
             if schnorr:
-                tickets["schnorr"] = engine.submit("schnorr", [(j.pubkey, j.msg, j.sig) for j in schnorr])
+                tickets["schnorr"] = engine.submit(
+                    f"{prefix}schnorr", [(j.pubkey, j.msg, j.sig) for j in schnorr]
+                )
             if ecdsa:
-                tickets["ecdsa"] = engine.submit("ecdsa", [(j.pubkey, j.msg, j.sig) for j in ecdsa])
+                tickets["ecdsa"] = engine.submit(
+                    f"{prefix}ecdsa", [(j.pubkey, j.msg, j.sig) for j in ecdsa]
+                )
         return DispatchHandle(self.sig_cache, fallbacks, pending, schnorr, ecdsa, tickets, results)
 
 

@@ -1,0 +1,125 @@
+"""Feerate estimator goldens + frontier sampling determinism.
+
+Golden values freeze the closed-form M/D/1 estimator curve
+(mining/src/feerate/mod.rs port): bucket feerates for a known
+(total_weight, inclusion_interval) pair, the outlier-removal prefix
+search in ``build_feerate_estimator`` (a whale at the frontier top must
+be excluded from the estimator weight exactly once), and the
+feerate<->time inversions.  The sampling tests pin the weighted
+in-place sampler to its seed: same seed, same frontier => the same
+template candidate sequence, which is what makes template selection
+reproducible across the batched and per-tx admission paths.
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.mempool.feerate import (
+    ALPHA,
+    FeerateEstimator,
+    FeerateEstimatorArgs,
+)
+from kaspa_tpu.mempool.frontier import COLLISION_FACTOR, FeerateKey, Frontier
+
+
+def _estimator() -> FeerateEstimator:
+    return FeerateEstimator(
+        total_weight=1000.0, inclusion_interval=0.004, target_time_per_block_seconds=1.0
+    )
+
+
+def test_golden_buckets():
+    """Frozen bucket curve for c2=1000, c1=0.004, 1s target."""
+    est = _estimator()
+    buckets = est.calc_estimations(minimum_standard_feerate=1.0).ordered_buckets()
+    golden = [
+        (1.5895232484149204, 1.0),  # priority: next-block inclusion
+        (1.3485658414484367, 1.6349608127301345),  # normal: sub-minute / 0.66 quantile
+        (1.1970292315732947, 2.336092236412274),  # mid interpolation point
+        (1.0853242347775465, 3.1328265571175917),  # low: sub-hour / 0.25 quantile
+    ]
+    assert len(buckets) == len(golden)
+    for bucket, (feerate, seconds) in zip(buckets, golden):
+        assert bucket.feerate == pytest.approx(feerate, rel=1e-12)
+        assert bucket.estimated_seconds == pytest.approx(seconds, rel=1e-12)
+    # the curve is monotone: paying more never waits longer
+    feerates = [b.feerate for b in buckets]
+    times = [b.estimated_seconds for b in buckets]
+    assert feerates == sorted(feerates, reverse=True)
+    assert times == sorted(times)
+
+
+def test_feerate_time_inversions():
+    est = _estimator()
+    assert est.feerate_to_time(2.0) == pytest.approx(0.504, rel=1e-12)
+    assert est.time_to_feerate(1.0) == pytest.approx(1.5895232484149204, rel=1e-12)
+    # round trip through both directions of the curve
+    for f in (1.1, 2.0, 7.5):
+        assert est.time_to_feerate(est.feerate_to_time(f)) == pytest.approx(f, rel=1e-9)
+    # quantile interior point + degenerate interval
+    assert est.quantile(1.0, 4.0, 0.5) == pytest.approx(1.3719886811400708, rel=1e-12)
+    assert est.quantile(2.5, 2.5, 0.7) == 2.5
+    empty = FeerateEstimator(0.0, 0.004, 1.0)
+    assert empty.quantile(1.0, 4.0, 0.5) == 1.0
+
+
+def test_frontier_estimator_removes_whale_outlier():
+    """build_feerate_estimator's prefix search must settle on the frontier
+    minus the single whale: its weight (500**ALPHA) dominates the flat tail,
+    and removing any tail tx after it makes the estimate worse (break)."""
+    fr = Frontier(target_time_per_block_seconds=1.0)
+    fr.insert(FeerateKey(fee=1_000_000, mass=2000, txid=b"\xff" * 32))
+    for i in range(64):
+        fr.insert(FeerateKey(fee=1000, mass=2000, txid=bytes([i]) * 32))
+    assert fr.tree.total_weight() == pytest.approx(500.0**ALPHA + 64 * 0.5**ALPHA)
+
+    args = FeerateEstimatorArgs(network_blocks_per_second=2, maximum_mass_per_block=100_000)
+    est = fr.build_feerate_estimator(args)
+    # the whale (and only the whale) is outside the estimator weight
+    assert est.total_weight == pytest.approx(64 * 0.5**ALPHA, rel=1e-12)
+    # one 2000-mass slot consumed out of the 100k block, avg mass decayed
+    # from INITIAL_AVG_MASS over 65 inserts of mass 2000
+    assert est.inclusion_interval == pytest.approx(0.010387635752481802, rel=1e-12)
+
+
+def _filled_frontier(n: int, mass: int = 2000) -> Frontier:
+    fr = Frontier(target_time_per_block_seconds=1.0)
+    rng = random.Random(0xFEE)
+    for i in range(n):
+        fee = rng.randrange(1_000, 1_000_000)
+        fr.insert(FeerateKey(fee=fee, mass=mass, txid=i.to_bytes(4, "big") * 8))
+    return fr
+
+
+def test_sampling_deterministic_under_fixed_seed():
+    """Same frontier + same RNG seed => the identical candidate sequence,
+    on the weighted-sampling path (total mass past the collision factor)."""
+    max_block_mass = 100_000
+    fr = _filled_frontier(400)
+    assert fr.total_mass > COLLISION_FACTOR * max_block_mass  # sampling, not greedy
+    first = fr.select(random.Random(42), max_block_mass)
+    second = fr.select(random.Random(42), max_block_mass)
+    assert first == second
+    assert len(first) > 0
+    assert len({k.txid for k in first}) == len(first)  # no duplicates sampled
+    # a different seed draws a different sequence
+    other = fr.select(random.Random(43), max_block_mass)
+    assert other != first
+    # and the same seed on an independently built identical frontier agrees
+    again = _filled_frontier(400).select(random.Random(42), max_block_mass)
+    assert again == first
+
+
+def test_small_frontier_selection_is_exact_greedy():
+    """Below the collision factor, selection is the full descending-feerate
+    walk — deterministic regardless of the RNG."""
+    max_block_mass = 100_000
+    fr = _filled_frontier(16)
+    assert fr.total_mass <= COLLISION_FACTOR * max_block_mass
+    sel_a = fr.select(random.Random(1), max_block_mass)
+    sel_b = fr.select(random.Random(999), max_block_mass)
+    assert sel_a == sel_b
+    assert len(sel_a) == 16
+    feerates = [k.feerate for k in sel_a]
+    assert feerates == sorted(feerates, reverse=True)

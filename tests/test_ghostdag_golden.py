@@ -19,6 +19,11 @@ from kaspa_tpu.consensus.stores import ConsensusStorage
 DAG_DIR = "/root/reference/testing/integration/testdata/dags"
 UNIFORM_BITS = 0x207FFFFF
 
+# the golden vectors live in the reference checkout; without it the
+# parametrization is empty and pytest reports a clean skip, not a
+# collection error
+_DAG_FILES = sorted(os.listdir(DAG_DIR)) if os.path.isdir(DAG_DIR) else []
+
 
 def string_to_hash(s: str) -> bytes:
     return s.encode().ljust(32, b"\x00")
@@ -43,7 +48,8 @@ def _mk_header(block_hash: bytes, parents: list[bytes]) -> Header:
     return hd
 
 
-@pytest.mark.parametrize("dag_file", sorted(os.listdir(DAG_DIR)))
+@pytest.mark.skipif(not _DAG_FILES, reason=f"golden DAG vectors not present at {DAG_DIR}")
+@pytest.mark.parametrize("dag_file", _DAG_FILES or ["<missing>"])
 def test_ghostdag_golden(dag_file):
     with open(os.path.join(DAG_DIR, dag_file)) as f:
         test = json.load(f)

@@ -1,0 +1,212 @@
+"""Ingest tier tests: queue fairness, backpressure, wave batching, worker
+path, and batched-vs-per-tx admission identity.
+
+Uses the same small simulated chain as the mempool tests for mature
+spendable UTXOs, then drives admission through ``IngestTier`` instead of
+``MiningManager.validate_and_insert_transaction`` directly.
+"""
+
+import random
+
+import pytest
+
+from kaspa_tpu.consensus import hashing as chash
+from kaspa_tpu.consensus.model import (
+    Transaction,
+    TransactionInput,
+    TransactionOutpoint,
+    TransactionOutput,
+)
+from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE, ComputeCommit
+from kaspa_tpu.crypto import eclib
+from kaspa_tpu.ingest.queue import SOURCE_P2P, SOURCE_RPC, IngestQueue
+from kaspa_tpu.ingest.tier import (
+    ACCEPTED,
+    ORPHANED,
+    REJECTED,
+    IngestConfig,
+    IngestTier,
+)
+from kaspa_tpu.mempool import MiningManager
+from kaspa_tpu.mempool.mempool import MempoolError
+from kaspa_tpu.sim.simulator import Miner, SimConfig, simulate
+from kaspa_tpu.txscript import standard
+
+
+@pytest.fixture(scope="module")
+def chain():
+    cfg = SimConfig(bps=2, delay=0.5, num_miners=2, num_blocks=26, txs_per_block=0, seed=17)
+    res = simulate(cfg)
+    from kaspa_tpu.consensus.consensus import Consensus
+
+    c = Consensus(res.params)
+    for b in res.blocks:
+        c.validate_and_insert_block(b)
+    sim_rng = random.Random(17)
+    miners = [Miner(i, sim_rng) for i in range(2)]
+    return c, miners
+
+
+def _spends(consensus, miner, rng, n, fee=1000):
+    """n signed single-input spends of distinct mature UTXOs of `miner`."""
+    view = consensus.get_virtual_utxo_view()
+    pov = consensus.get_virtual_daa_score()
+    maturity = consensus.params.coinbase_maturity
+    txs = []
+    for outpoint, entry in list(consensus.utxo_set.items()):
+        if len(txs) == n:
+            break
+        if view.get(outpoint) is None:
+            continue
+        if entry.script_public_key != miner.spk:
+            continue
+        if entry.is_coinbase and entry.block_daa_score + maturity > pov:
+            continue
+        tx = Transaction(
+            0,
+            [TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))],
+            [TransactionOutput(entry.amount - fee, miner.spk)],
+            0,
+            SUBNETWORK_ID_NATIVE,
+            0,
+            b"",
+        )
+        reused = chash.SigHashReusedValues()
+        msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+        sig = eclib.schnorr_sign(msg, miner.seckey, rng.randbytes(32))
+        tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+        txs.append(tx)
+    assert len(txs) == n, f"only {len(txs)} mature utxos available"
+    return txs
+
+
+# --- queue ---------------------------------------------------------------
+
+
+def test_queue_round_robin_fairness():
+    q = IngestQueue(capacity=8)
+    for x in (1, 2, 3):
+        assert q.put(SOURCE_RPC, x)
+    for x in ("a", "b"):
+        assert q.put(SOURCE_P2P, x)
+    # the wave alternates lanes (rpc first: cursor starts there) while
+    # preserving each lane's FIFO order
+    assert q.pop_wave(10) == [1, "a", 2, "b", 3]
+    assert q.depth() == 0
+
+
+def test_queue_sheds_only_the_full_lane():
+    q = IngestQueue(capacity=2)
+    assert q.put(SOURCE_P2P, "a")
+    assert q.put(SOURCE_P2P, "b")
+    assert not q.put(SOURCE_P2P, "c")  # p2p lane full: shed
+    assert q.put(SOURCE_RPC, 1)  # rpc lane unaffected
+    assert q.depth(SOURCE_P2P) == 2
+    assert q.depth(SOURCE_RPC) == 1
+
+
+# --- tier: sync (pump) path ---------------------------------------------
+
+
+def test_wave_batches_concurrent_entrants(chain):
+    consensus, miners = chain
+    tier = IngestTier(MiningManager(consensus))
+    txs = _spends(consensus, miners[0], random.Random(11), 4)
+    tickets = [tier.submit(tx, SOURCE_RPC if i % 2 == 0 else SOURCE_P2P) for i, tx in enumerate(txs)]
+    assert tier.pump() == 4
+    assert all(t.status == ACCEPTED for t in tickets)
+    stats = tier.stats()
+    assert stats["waves"] == 1  # all four entrants rode one wave
+    assert stats["lost"] == 0
+    assert all(t.raise_for_status() == [] for t in tickets)
+
+
+def test_backpressure_resolves_ticket_immediately(chain):
+    consensus, miners = chain
+    tier = IngestTier(MiningManager(consensus), config=IngestConfig(queue_capacity=1))
+    txs = _spends(consensus, miners[0], random.Random(13), 2)
+    t1 = tier.submit(txs[0], SOURCE_P2P)
+    t2 = tier.submit(txs[1], SOURCE_P2P)  # lane full: shed, not queued
+    assert t2.status == REJECTED
+    with pytest.raises(MempoolError, match="queue full"):
+        t2.raise_for_status()
+    assert t2.error.code == "ingest-backpressure"
+    tier.pump()
+    assert t1.status == ACCEPTED
+    assert tier.stats()["lost"] == 0
+
+
+def test_orphan_parks_and_duplicate_rejects(chain):
+    consensus, miners = chain
+    mgr = MiningManager(consensus)
+    tier = IngestTier(mgr)
+    orphan = Transaction(
+        0,
+        [TransactionInput(TransactionOutpoint(b"\x77" * 32, 0), b"\x01\x01", 0, ComputeCommit.sigops(1))],
+        [TransactionOutput(100, miners[0].spk)],
+        0,
+        SUBNETWORK_ID_NATIVE,
+        0,
+        b"",
+    )
+    ticket = tier.admit(orphan)
+    assert ticket.status == ORPHANED
+    assert orphan.id() in mgr.mempool.orphans
+    # resubmitting the same parked tx is a duplicate rejection
+    dup = tier.admit(orphan)
+    assert dup.status == REJECTED
+    with pytest.raises(MempoolError, match="already"):
+        dup.raise_for_status()
+
+
+# --- tier: worker-thread path --------------------------------------------
+
+
+def test_worker_thread_admits_and_drains(chain):
+    consensus, miners = chain
+    tier = IngestTier(MiningManager(consensus))
+    txs = _spends(consensus, miners[1], random.Random(19), 4)
+    tier.start()
+    try:
+        tickets = [tier.submit(tx, SOURCE_RPC if i % 2 == 0 else SOURCE_P2P) for i, tx in enumerate(txs)]
+        for t in tickets:
+            assert t.wait(30.0), "ticket not resolved by the worker"
+        assert all(t.status == ACCEPTED for t in tickets)
+    finally:
+        tier.stop()
+    stats = tier.stats()
+    assert stats["lost"] == 0
+    assert stats["submitted"] == stats["resolved"] == 4
+
+
+# --- batched vs per-tx identity ------------------------------------------
+
+
+def test_batched_admission_matches_per_tx(chain):
+    """One wave through the shared-checker split intake must leave the
+    mempool exactly as N per-tx validate_and_insert calls in the same
+    order (the roundcheck ``ingest`` gate, unit-sized)."""
+    consensus, miners = chain
+    batched_mgr = MiningManager(consensus, seed=5)
+    pertx_mgr = MiningManager(consensus, seed=5)
+    txs = _spends(consensus, miners[0], random.Random(23), 3)
+    # a conflicting higher-fee respend of the first target rides the same
+    # wave, so the RBF path is part of the identity too
+    rbf = _spends(consensus, miners[0], random.Random(29), 1, fee=5000)
+
+    tier = IngestTier(batched_mgr)
+    tickets = [tier.submit(tx) for tx in [*txs, *rbf]]
+    tier.pump()
+    assert tier.stats()["lost"] == 0
+    assert tickets[-1].status == ACCEPTED  # RBF won (strictly higher feerate)
+
+    for tx in [*txs, *rbf]:
+        try:
+            pertx_mgr.validate_and_insert_transaction(tx)
+        except MempoolError:
+            pass
+
+    pool_a = {t: e.fee for t, e in batched_mgr.mempool.pool.items()}
+    pool_b = {t: e.fee for t, e in pertx_mgr.mempool.pool.items()}
+    assert pool_a == pool_b
+    assert set(batched_mgr.mempool.orphans) == set(pertx_mgr.mempool.orphans)

@@ -39,6 +39,9 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             "--skip-obs",
             # and the fabric drill (a verifyd subprocess + three replays)
             "--skip-fabric",
+            # and the ingest lane (an identity-check subprocess + a 24-block
+            # tx-flood sustain replay)
+            "--skip-ingest",
             "--blocks",
             "8",
             "--out",

@@ -38,15 +38,6 @@ trap 'rm -f "$pytest_log"' EXIT
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$pytest_log"
 rc=${PIPESTATUS[0]}
-if [ "$rc" -ne 0 ]; then
-    # --continue-on-collection-errors still exits 2 when a pre-existing
-    # collection error (missing goref testdata) is carried; gate on the
-    # summary line instead, exactly as roundcheck's tier1 section does
-    summary="$(grep -E 'passed' "$pytest_log" | tail -n 1)"
-    if [ -n "$summary" ] && ! printf '%s' "$summary" | grep -q 'failed'; then
-        rc=0
-    fi
-fi
 [ "$rc" -eq 0 ] || fail=1
 
 if [ "$fail" -eq 0 ]; then

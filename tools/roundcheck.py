@@ -9,10 +9,12 @@ traced 24-block pipelined replay whose dump must hold one connected
 >=4-thread span tree per block with >= 90% critical-path attribution and
 a valid Perfetto export, plus a tracing-off-within-2% overhead gate),
 the hostile-load chaos sustain run (seeded fault schedule; the faulted
-replay must converge to the bit-identical fault-free end state), and the
+replay must converge to the bit-identical fault-free end state), the
 device-supervision wedge drill (injected dispatch hangs + a compile
 stall; watchdog requeue accounting + canary recovery, bit-identity
-gated), then writes a single round-evidence JSON (ROUNDCHECK.json)
+gated), and the ingest lane (batched-vs-per-tx mempool-admission
+identity plus a short tx-flood sustain; clean acceptance >= 0.99 and
+zero lost tickets), then writes a single round-evidence JSON (ROUNDCHECK.json)
 summarizing them — the artifact a driver round or a reviewer reads
 instead of eight scrollback logs.
 
@@ -25,14 +27,15 @@ instead of eight scrollback logs.
     python tools/roundcheck.py --skip-chaos        # no fault-injection sustain
     python tools/roundcheck.py --skip-supervision  # no wedge drill
     python tools/roundcheck.py --skip-fabric       # no two-process fabric drill
+    python tools/roundcheck.py --skip-ingest       # no tx-ingest admission lane
     python tools/roundcheck.py --out my.json       # custom artifact path
 
 ``--only SECTION`` (repeatable, or comma-separated) runs exactly the
 named sections and ignores the skip flags; section names are the keys in
 ROUNDCHECK.json (tier1, sim, bench_probe, multichip, mesh_smoke,
 dispatch, aggregate, serving, obs, tenbps, chaos, supervision,
-fabric).  Every section records its own ``wall_seconds`` in the
-artifact.
+fabric, ingest).  Every section records its own ``wall_seconds`` in
+the artifact.
 
 Exit code 0 iff every section that ran passed.
 """
@@ -189,6 +192,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-tenbps", action="store_true", help="skip the 10-BPS speculative-pipeline lane")
     ap.add_argument("--skip-supervision", action="store_true", help="skip the device-supervision wedge drill")
     ap.add_argument("--skip-fabric", action="store_true", help="skip the two-process verify-fabric drill")
+    ap.add_argument("--skip-ingest", action="store_true", help="skip the tx-ingest admission lane")
     ap.add_argument(
         "--only", action="append", default=None, metavar="SECTION",
         help="run only the named section(s); repeatable or comma-separated, "
@@ -210,8 +214,6 @@ def main(argv: list[str] | None = None) -> int:
 
     def _sect_tier1() -> dict:
         sect = _run(FASTLANE_CMD, args.test_timeout, {"JAX_PLATFORMS": "cpu"})
-        # ci_fastlane.sh already folds the pre-existing collection error
-        # (missing goref testdata) into its exit code via the summary line
         summary = next((ln for ln in reversed(sect["tail"]) if "passed" in ln), "")
         sect["summary"] = summary.strip()
         sect["ok"] = sect["rc"] == 0
@@ -541,6 +543,45 @@ def main(argv: list[str] | None = None) -> int:
         sect["ok"] = sect["rc"] == 0 and bool(result and result.get("fabric_ok"))
         return sect
 
+    def _sect_ingest() -> dict:
+        # ingest lane (ISSUE 12): (a) batched waves on the verify plane vs
+        # one-at-a-time validate_and_insert over the same hostile flood in
+        # the same arrival order must leave the mempool, orphan pool and a
+        # fixed-timestamp template bit-identical; (b) a short tx-flood
+        # sustain run must keep consensus bit-identical to the fault-free
+        # replay with clean acceptance >= 0.99 and zero lost tickets
+        sect = _run(
+            [sys.executable, "-m", "kaspa_tpu.ingest.check", "--blocks", "24", "--tpb", "4", "--slots", "6"],
+            600.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        identity = _last_json_line(sect)
+        sect["result"] = identity
+        flood = _run(
+            [
+                sys.executable, "-m", "kaspa_tpu.sim",
+                "--txflood", "--no-pace", "--blocks", "24", "--tpb", "4",
+                "--seed", "7", "--json",
+                "--sustain-out", os.path.join(REPO_ROOT, "SUSTAIN_TXFLOOD.json"),
+            ],
+            900.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        j_flood = _last_json_line(flood)
+        sect["flood_cmd"] = flood["cmd"]
+        sect["flood_tail"] = flood["tail"]
+        sect["flood_result"] = j_flood
+        sect["ok"] = (
+            sect["rc"] == 0
+            and bool(identity and identity.get("ingest_ok"))
+            and flood["rc"] == 0
+            and bool(j_flood)
+            and bool(j_flood.get("matches_fault_free"))
+            and j_flood.get("tx_acceptance_rate", 0.0) >= 0.99
+            and j_flood.get("lost_tickets", 1) == 0
+        )
+        return sect
+
     sections: list[tuple[str, bool, object]] = [
         ("tier1", not args.skip_tests, _sect_tier1),
         ("sim", not args.skip_sim, _sect_sim),
@@ -555,6 +596,7 @@ def main(argv: list[str] | None = None) -> int:
         ("chaos", not args.skip_chaos, _sect_chaos),
         ("supervision", not args.skip_supervision, _sect_supervision),
         ("fabric", not args.skip_fabric, _sect_fabric),
+        ("ingest", not args.skip_ingest, _sect_ingest),
     ]
     only: set[str] | None = None
     if args.only:
