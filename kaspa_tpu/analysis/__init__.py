@@ -1,0 +1,32 @@
+"""graftlint: project-invariant static analysis for the kaspa-tpu runtime.
+
+An AST-based checker framework encoding the invariants this repo keeps
+re-learning at runtime (see ISSUE 13 / README "Static analysis"):
+
+    blocking-under-lock   no device dispatch / Future.result / sleep /
+                          socket recv inside a ``with <lock>`` body
+                          (one-hop call-graph expansion included)
+    raw-lock              threading.Lock()/RLock() construction outside
+                          utils/sync.py must be a ranked LockCtx
+    tracer-hazard         module-level caches, host coercions and
+                          unrolled loops inside jitted code
+    trace-ctx-handoff     queue handoffs in instrumented subsystems must
+                          carry the flight-recorder trace context
+    registry-hygiene      fault points match the resilience/faults.py
+                          catalog; metric names are convention-clean and
+                          registered once
+
+Suppression: ``# graftlint: allow(<checker-id>) -- <justification>`` on
+the offending line (or alone on the line above).  A pragma without a
+justification is itself an error — every silence is documented.
+
+Run: ``python -m kaspa_tpu.analysis`` (or ``tools/lint.py``).
+"""
+
+from kaspa_tpu.analysis.core import (  # noqa: F401
+    CHECKERS,
+    Finding,
+    Project,
+    register_checker,
+    run_project,
+)

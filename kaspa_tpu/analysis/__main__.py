@@ -1,0 +1,67 @@
+"""graftlint CLI: ``python -m kaspa_tpu.analysis [paths...]``.
+
+Exit status 0 iff no active findings (suppressed-with-justification
+pragmas don't count).  ``--json PATH`` additionally writes the full
+LINT.json document; the human table always goes to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from kaspa_tpu.analysis import CHECKERS, run_project
+import kaspa_tpu.analysis.checkers  # noqa: F401  (registers the checkers)
+
+
+def _default_paths(root: str) -> list[str]:
+    return [os.path.join(root, "kaspa_tpu")]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kaspa_tpu.analysis",
+        description="graftlint: project-invariant static analysis",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint (default: the kaspa_tpu package)")
+    ap.add_argument("--root", default=None, help="repo root for relative paths (default: cwd)")
+    ap.add_argument("--json", dest="json_path", default=None, help="write LINT.json here")
+    ap.add_argument("--list-checkers", action="store_true", help="print the checker catalog and exit")
+    ap.add_argument("-q", "--quiet", action="store_true", help="suppress the summary table")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for cid in sorted(CHECKERS):
+            print(f"{cid:22s} {CHECKERS[cid].description}")
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    paths = [os.path.abspath(p) for p in args.paths] or _default_paths(root)
+    report = run_project(paths, root=root)
+
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if not args.quiet:
+        for finding in report["findings"]:
+            print(f"{finding['path']}:{finding['line']}: [{finding['checker']}] {finding['message']}")
+        n_active = len(report["findings"])
+        n_supp = len(report["suppressed"])
+        state = "clean" if report["ok"] else "FAILED"
+        print(
+            f"graftlint: {state} — {report['files']} files, "
+            f"{n_active} finding(s), {n_supp} suppressed "
+            f"({len(report['checkers'])} checkers)"
+        )
+        if report["counts"]:
+            for cid, n in sorted(report["counts"].items()):
+                print(f"  {cid:22s} {n}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

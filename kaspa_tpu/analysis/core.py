@@ -1,0 +1,282 @@
+"""graftlint core: findings, pragmas, the checker registry, project model.
+
+Checkers are pure functions over parsed sources: ``check(project, file)
+-> list[Finding]``.  The Project owns the file set and a lazily built
+package-wide function index (the one-hop call graph the blocking checker
+expands through).  Pragma handling lives here so every checker inherits
+the same suppression semantics:
+
+    x = threading.Lock()  # graftlint: allow(raw-lock) -- leaf metric guard
+
+    # graftlint: allow(blocking-under-lock) -- cold path, bounded 50ms
+    with self._mu:
+        time.sleep(0.05)
+
+A pragma suppresses matching findings on its own line; a pragma on a
+comment-only line covers the next source line.  The justification (text
+after ``--``/``—``) is mandatory: an allow() without one produces a
+``pragma`` finding that cannot itself be suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARN = "warn"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*allow\(\s*([a-z0-9_\-]+(?:\s*,\s*[a-z0-9_\-]+)*)\s*\)"
+    r"\s*(?:(?:--|—|–)\s*(.*?))?\s*$"
+)
+
+
+@dataclass
+class Finding:
+    path: str  # repo-relative, stable across machines
+    line: int
+    checker: str
+    message: str
+    severity: str = SEVERITY_ERROR
+    justification: str = ""  # filled when a pragma suppresses this finding
+
+    def key(self) -> tuple:
+        return (self.path, self.line, self.checker, self.message)
+
+    def as_dict(self) -> dict:
+        out = {
+            "path": self.path,
+            "line": self.line,
+            "checker": self.checker,
+            "severity": self.severity,
+            "message": self.message,
+        }
+        if self.justification:
+            out["justification"] = self.justification
+        return out
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+@dataclass
+class _Pragma:
+    line: int
+    checkers: tuple[str, ...]
+    justification: str
+    covers_next: bool  # comment-only line: applies to the following line
+
+
+@dataclass
+class SourceFile:
+    path: str  # absolute
+    rel: str  # relative to the lint root (posix separators)
+    text: str
+    tree: ast.AST
+    pragmas: list[_Pragma] = field(default_factory=list)
+
+    @property
+    def lines(self) -> list[str]:
+        return self.text.splitlines()
+
+    def allow_for(self, line: int, checker: str) -> _Pragma | None:
+        """The pragma suppressing ``checker`` at ``line``, if any."""
+        for p in self.pragmas:
+            if checker not in p.checkers and "all" not in p.checkers:
+                continue
+            if p.line == line or (p.covers_next and p.line + 1 == line):
+                return p
+        return None
+
+
+def _parse_pragmas(text: str) -> list[_Pragma]:
+    out = []
+    for i, raw in enumerate(text.splitlines(), start=1):
+        m = _PRAGMA_RE.search(raw)
+        if m is None:
+            continue
+        ids = tuple(s.strip() for s in m.group(1).split(","))
+        just = (m.group(2) or "").strip()
+        covers_next = raw.strip().startswith("#")
+        out.append(_Pragma(i, ids, just, covers_next))
+    return out
+
+
+# ----------------------------------------------------------------------
+# checker registry
+# ----------------------------------------------------------------------
+
+CHECKERS: dict[str, "CheckerSpec"] = {}
+
+
+@dataclass
+class CheckerSpec:
+    id: str
+    description: str
+    fn: object  # (project, file) -> list[Finding]
+
+
+def register_checker(checker_id: str, description: str):
+    def deco(fn):
+        CHECKERS[checker_id] = CheckerSpec(checker_id, description, fn)
+        return fn
+
+    return deco
+
+
+# ----------------------------------------------------------------------
+# project model + one-hop function index
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class FunctionInfo:
+    name: str  # bare function / method name
+    module_rel: str
+    lineno: int
+    node: ast.AST
+    blocking: list = field(default_factory=list)  # [(line, reason)] direct blockers
+
+
+class Project:
+    """The file set under analysis plus package-wide derived indexes."""
+
+    def __init__(self, root: str, files: list[SourceFile]):
+        self.root = root
+        self.files = files
+        self._fn_index: dict[str, list[FunctionInfo]] | None = None
+
+    def by_rel(self, rel: str) -> SourceFile | None:
+        for f in self.files:
+            if f.rel == rel or f.rel.endswith("/" + rel):
+                return f
+        return None
+
+    @property
+    def function_index(self) -> dict[str, list[FunctionInfo]]:
+        """bare name -> definitions across the project, with each body's
+        direct blocking calls precomputed (the one-hop expansion table)."""
+        if self._fn_index is None:
+            from kaspa_tpu.analysis.blocking import direct_blocking_calls
+
+            index: dict[str, list[FunctionInfo]] = {}
+            for f in self.files:
+                for node in ast.walk(f.tree):
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        info = FunctionInfo(
+                            node.name, f.rel, node.lineno, node,
+                            blocking=direct_blocking_calls(node),
+                        )
+                        index.setdefault(node.name, []).append(info)
+            self._fn_index = index
+        return self._fn_index
+
+    def resolve_call(self, name: str) -> FunctionInfo | None:
+        """One-hop resolution by bare name: unique project-wide definition
+        or nothing (ambiguous names are never expanded — precision over
+        recall; the direct-call check still covers their bodies)."""
+        infos = self.function_index.get(name)
+        if infos is not None and len(infos) == 1:
+            return infos[0]
+        return None
+
+
+def load_file(path: str, root: str) -> SourceFile | None:
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return None
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return SourceFile(path, rel, text, tree, _parse_pragmas(text))
+
+
+def collect_files(paths: list[str], root: str) -> list[SourceFile]:
+    seen: set[str] = set()
+    out: list[SourceFile] = []
+    for p in paths:
+        if os.path.isfile(p):
+            candidates = [p]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                candidates.extend(
+                    os.path.join(dirpath, fn) for fn in sorted(filenames) if fn.endswith(".py")
+                )
+        for c in candidates:
+            c = os.path.abspath(c)
+            if c in seen:
+                continue
+            seen.add(c)
+            sf = load_file(c, root)
+            if sf is not None:
+                out.append(sf)
+    return out
+
+
+# ----------------------------------------------------------------------
+# the run loop
+# ----------------------------------------------------------------------
+
+
+def run_project(paths: list[str], root: str | None = None) -> dict:
+    """Lint ``paths``; returns the LINT.json document shape:
+
+    {"findings": [...], "suppressed": [...], "counts": {...},
+     "files": N, "ok": bool}
+
+    ``ok`` is False iff any active finding remains — including ``pragma``
+    findings for allow() lines missing a justification.
+    """
+    root = root or os.getcwd()
+    files = collect_files(paths, root)
+    project = Project(root, files)
+
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in files:
+        raised: list[Finding] = []
+        for spec in CHECKERS.values():
+            raised.extend(spec.fn(project, f))
+        used_pragmas: set[int] = set()
+        for finding in raised:
+            pragma = f.allow_for(finding.line, finding.checker)
+            if pragma is not None and pragma.justification:
+                finding.justification = pragma.justification
+                used_pragmas.add(pragma.line)
+                suppressed.append(finding)
+            else:
+                active.append(finding)
+        # pragma hygiene: every allow() must carry a justification.  (An
+        # allow() that matches nothing is harmless — checkers evolve — but
+        # a silent one is an undocumented hole in the gate.)
+        for p in f.pragmas:
+            if not p.justification:
+                active.append(
+                    Finding(
+                        f.rel, p.line, "pragma",
+                        f"allow({', '.join(p.checkers)}) carries no justification "
+                        "(write `# graftlint: allow(<id>) -- <why>`)",
+                    )
+                )
+
+    active.sort(key=Finding.key)
+    suppressed.sort(key=Finding.key)
+    counts: dict[str, int] = {}
+    for finding in active:
+        counts[finding.checker] = counts.get(finding.checker, 0) + 1
+    return {
+        "tool": "graftlint",
+        "root": os.path.basename(os.path.abspath(root)),
+        "files": len(files),
+        "checkers": sorted(CHECKERS),
+        "counts": counts,
+        "findings": [x.as_dict() for x in active],
+        "suppressed": [x.as_dict() for x in suppressed],
+        "ok": not active,
+    }

@@ -65,9 +65,9 @@ class Core:
         self.keep_running.set()
         self._services: list[Service] = []
         self._workers: list[threading.Thread] = []
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- service-list guard in the generic runner; no ranked lock is ever taken under it
         self._shutdown_once = threading.Event()
-        self._shutdown_mu = threading.Lock()
+        self._shutdown_mu = threading.Lock()  # graftlint: allow(raw-lock) -- shutdown-once latch; held only to flip a flag
 
     def bind(self, service: Service) -> None:
         with self._mu:

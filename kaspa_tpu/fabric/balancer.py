@@ -32,6 +32,8 @@ import itertools
 import os
 import queue
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 from time import perf_counter_ns
 
@@ -97,8 +99,8 @@ class FabricBalancer:
         self.addrs = list(addrs)
         self.label = "fabric:" + ",".join(self.addrs)
         self.deadline_s = deadline_s if deadline_s is not None else _deadline_s()
-        self._lock = threading.Lock()
-        self._idle = threading.Condition(self._lock)
+        self._lock = ranked_lock("fabric.balancer", reentrant=False)
+        self._idle = self._lock.condition()
         self._ids = itertools.count(1)
         self._jobs: dict[int, _Job] = {}
         self._probes: dict[int, tuple[_Slice, float]] = {}
@@ -444,7 +446,7 @@ class FabricBalancer:
 
 # --- process-wide configuration (mirrors ops/dispatch.py) -------------------
 
-_lock = threading.Lock()
+_lock = ranked_lock("fabric.config")
 _balancer: FabricBalancer | None = None
 
 

@@ -11,6 +11,8 @@ from __future__ import annotations
 import socket
 import threading
 
+from kaspa_tpu.utils.sync import ranked_lock
+
 from kaspa_tpu.fabric import wire
 from kaspa_tpu.resilience.faults import FAULTS, mangle_frame
 
@@ -28,7 +30,7 @@ class FabricConnection:
         self.on_disconnect = on_disconnect
         self.hello: dict | None = None
         self.sock: socket.socket | None = None
-        self._wlock = threading.Lock()
+        self._wlock = ranked_lock("fabric.wire", reentrant=False)
         self._dead = threading.Event()
         self._down_fired = False
 

@@ -30,6 +30,8 @@ import os
 import queue
 import socket
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 from time import perf_counter_ns
 
 from kaspa_tpu.fabric import wire
@@ -49,7 +51,7 @@ class _Conn:
     def __init__(self, sock: socket.socket, peer: str):
         self.sock = sock
         self.peer = peer
-        self._wlock = threading.Lock()
+        self._wlock = ranked_lock("fabric.wire", reentrant=False)
         self.alive = True
 
     def read_exactly(self, n: int) -> bytes:
@@ -89,7 +91,7 @@ class VerifyService:
         self._queues: list[queue.Queue] = [queue.Queue() for _ in range(self.slices)]
         self._inflight = [0] * self.slices
         self._served = [0] * self.slices
-        self._lock = threading.Lock()
+        self._lock = ranked_lock("fabric.service", reentrant=False)
         self._listener: socket.socket | None = None
         self._conns: list[_Conn] = []
         self._threads: list[threading.Thread] = []
@@ -168,7 +170,7 @@ class VerifyService:
             while conn.alive:
                 mtype, msg = wire.read_message(conn.read_exactly)
                 if mtype == wire.VERIFY_REQ:
-                    self._queues[msg["slice"] % self.slices].put((conn, msg, perf_counter_ns()))
+                    self._queues[msg["slice"] % self.slices].put((conn, msg, perf_counter_ns()))  # graftlint: allow(trace-ctx-handoff) -- remote span grafting rides msg['trace_id']; the server has no local parent ctx to attach
                 elif mtype == wire.STATUS_REQ:
                     with self._lock:
                         per_slice = [

@@ -13,6 +13,8 @@ admission path's worst-case memory bounded under hostile floods.
 from __future__ import annotations
 
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 from collections import deque
 
 from kaspa_tpu.observability.core import REGISTRY
@@ -42,8 +44,8 @@ class IngestQueue:
         self._lanes: dict[str, deque] = {s: deque() for s in sources}
         self._order: tuple[str, ...] = tuple(sources)
         self._next = 0  # round-robin cursor into _order
-        self._mu = threading.Lock()
-        self._nonempty = threading.Condition(self._mu)
+        self._mu = ranked_lock("ingest.queue", reentrant=False)
+        self._nonempty = self._mu.condition()
 
     def put(self, source: str, item) -> bool:
         """Enqueue on the source's lane; False (shed) when that lane is full."""

@@ -29,6 +29,8 @@ lost — every accepted submission resolves exactly once, even on
 from __future__ import annotations
 
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 from dataclasses import dataclass
 
@@ -108,7 +110,7 @@ class IngestTier:
 
     def __init__(self, mining, lock=None, config: IngestConfig | None = None):
         self.mining = mining
-        self.lock = lock if lock is not None else threading.RLock()
+        self.lock = lock if lock is not None else ranked_lock("ingest.state")
         self.config = config or IngestConfig()
         self.queue = IngestQueue(self.config.queue_capacity)
         self._worker: threading.Thread | None = None
@@ -117,7 +119,7 @@ class IngestTier:
         self._submitted = 0
         self._resolved = 0
         self._waves = 0
-        self._mu = threading.Lock()
+        self._mu = ranked_lock("ingest.stats", reentrant=False)
 
     # -- lifecycle ------------------------------------------------------
 

@@ -30,7 +30,7 @@ class SyncRateRule:
         self._samples: deque[tuple[int, float]] = deque()
         self._total_received = 0
         self._total_expected = 0.0
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- leaf difficulty-stats guard; never nests
 
     def check_rule(self, received_blocks: int, expected_blocks: float, finality_recent: bool) -> None:
         with self._mu:

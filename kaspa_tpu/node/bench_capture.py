@@ -52,7 +52,7 @@ class BenchCapture:
         )
         self.out_path = os.path.join(appdir, "BENCH_CAPTURE.json")
         self.log = logger
-        self._busy = threading.Lock()
+        self._busy = threading.Lock()  # graftlint: allow(raw-lock) -- single-writer busy latch for the bench artifact; never nests
         self._last_attempt = float("-inf")  # first tick probes immediately
         self.captures = 0
         self.probe_failures = 0

@@ -26,6 +26,7 @@ from kaspa_tpu.mempool import MiningManager
 from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.p2p import Node
 from kaspa_tpu.rpc import RpcCoreService
+from kaspa_tpu.utils.sync import ranked_lock
 
 # per-encoding request counters (rpc/wrpc/server metrics): line-json is the
 # TCP transport, json/borsh are the WebSocket text/binary frame paths
@@ -862,7 +863,7 @@ class Daemon:
                 self.address_manager.add_local_address(NetAddress(external_ip, listen_port))
             self.log.info("publicly routable address %s:%d registered", external_ip, listen_port)
 
-        self._upnp_lock = threading.Lock()
+        self._upnp_lock = ranked_lock("daemon.upnp", reentrant=False)
         self._upnp_stopped = False
         threading.Thread(target=run, daemon=True, name="upnp-setup").start()
 

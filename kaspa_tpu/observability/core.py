@@ -214,7 +214,7 @@ class Registry:
     """
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- metrics registry leaf; imported by utils-adjacent layers, ranking it would cycle the import DAG
         self._counters: dict[str, Counter | CounterFamily] = {}
         self._histograms: dict[str, Histogram | HistogramFamily] = {}
         # name -> list of weakref-able callables contributing gauge trees

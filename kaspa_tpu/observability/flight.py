@@ -186,7 +186,7 @@ class FlightRecorder:
     """Process-global recorder; use via the module-level singleton."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- flight-recorder ring leaf, taken inside every span under arbitrary ranks
         self._open: dict[str, dict] = {}
         self._done: dict[str, dict] = {}  # ring members, addressable for late spans
         self._ring: deque = deque()

@@ -52,6 +52,8 @@ import itertools
 import json
 import os
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 from dataclasses import dataclass, field
 from time import perf_counter_ns
@@ -195,9 +197,9 @@ class CoalescingDispatcher:
             cls: (max(_TARGET_MIN, min(_TARGET_MAX, int(t))), float(age))
             for cls, (t, age) in (class_specs or {}).items()
         }
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
-        self._idle = threading.Condition(self._lock)
+        self._lock = ranked_lock("dispatch.queue", reentrant=False)
+        self._wake = self._lock.condition()
+        self._idle = self._lock.condition()
         self._pending: list[_Chunk] = []  # staging buffer (swapped at flush)
         self._inflight: list[_Chunk] = []  # swapped out, not yet resolved
         self._urgent = False
@@ -447,7 +449,7 @@ class CoalescingDispatcher:
 
 # --- process-wide configuration (mirrors ops/mesh.py) -----------------------
 
-_cfg_lock = threading.Lock()
+_cfg_lock = ranked_lock("dispatch.config")
 _configured: str | int | None = None
 _engine: CoalescingDispatcher | None = None
 

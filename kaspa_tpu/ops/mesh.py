@@ -49,6 +49,8 @@ import os
 import re
 import threading
 
+from kaspa_tpu.utils.sync import ranked_lock
+
 import numpy as np
 
 from kaspa_tpu.observability.core import PERCENT_BUCKETS, REGISTRY, SIZE_BUCKETS
@@ -80,7 +82,7 @@ _SLICE_JOBS = REGISTRY.counter_family(
     "mesh_slice_jobs", "slice", help="verify jobs dispatched per mesh slice (pre-padding)"
 )
 
-_lock = threading.Lock()
+_lock = ranked_lock("mesh.config")
 _configured: str | int | None = None  # raw spec, resolved lazily
 _active: int | None = None  # resolved mesh size (clamped to visible devices)
 _grid: tuple[int, int] | None = None  # (slices, shards-per-slice) for "RxC" specs

@@ -52,7 +52,7 @@ class AddressManager:
         self._banned: dict[str, int] = {}  # ip -> ban timestamp ms
         # our own publicly routable addresses: gossiped, never dialed
         self.local_addresses: set[NetAddress] = set()
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # graftlint: allow(raw-lock) -- address-book leaf guard; no ranked lock taken while held
         self._rng = random.Random(0xADD7)
 
     def add_local_address(self, address: NetAddress) -> None:
@@ -195,7 +195,7 @@ class ConnectionManager:
         self._clock = time.monotonic
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # graftlint: allow(raw-lock) -- connection-manager bookkeeping leaf; no ranked lock taken while held
 
     def add_connection_request(self, address: NetAddress, is_permanent: bool = False) -> None:
         with self._lock:

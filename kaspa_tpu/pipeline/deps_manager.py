@@ -10,6 +10,8 @@ releases its dependents (or the next queued duplicate of the same hash).
 from __future__ import annotations
 
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -33,8 +35,8 @@ class _TaskGroup:
 class BlockTaskDependencyManager:
     def __init__(self):
         self._pending: dict[bytes, _TaskGroup] = {}
-        self._mu = threading.Lock()
-        self._idle = threading.Condition(self._mu)
+        self._mu = ranked_lock("pipeline.deps", reentrant=False)
+        self._idle = self._mu.condition()
 
     def register(self, task_id: bytes, task) -> bool:
         """Queue `task` under `task_id`.  Returns True if the id should be

@@ -37,7 +37,7 @@ from kaspa_tpu.observability import flight, trace
 from kaspa_tpu.observability.core import DEFAULT_LATENCY_BUCKETS, REGISTRY, SIZE_BUCKETS
 from kaspa_tpu.pipeline.deps_manager import BlockTaskDependencyManager
 from kaspa_tpu.pipeline.speculative import SpeculativeVerifier
-from kaspa_tpu.utils.sync import Channel, Closed, LockCtx
+from kaspa_tpu.utils.sync import Channel, Closed, LockCtx, ranked_lock
 
 # queue wait vs execute split per stage — the question the round-5 bench
 # failure could not answer ("which stage stalled?")
@@ -80,8 +80,8 @@ class ConsensusPipeline:
         self.speculative = SpeculativeVerifier(consensus, self._lock) if speculative else None
         consensus.speculative = self.speculative
         self._inflight = 0
-        self._idle_mu = threading.Lock()
-        self._idle_cv = threading.Condition(self._idle_mu)
+        self._idle_mu = ranked_lock("pipeline.idle", reentrant=False)
+        self._idle_cv = self._idle_mu.condition()
         self._workers = [
             threading.Thread(target=self._stage_worker, name=f"kaspa-stage-{i}", daemon=True)
             for i in range(max(1, workers))

@@ -52,6 +52,8 @@ Safety invariants (these are what make hit == miss bit-identical):
 from __future__ import annotations
 
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 from dataclasses import dataclass, field
 
 from kaspa_tpu.consensus.processes.transaction_validator import FLAG_FULL
@@ -123,7 +125,7 @@ class SpeculativeVerifier:
     def __init__(self, consensus, commit_lock):
         self.consensus = consensus
         self._commit_lock = commit_lock
-        self._mu = threading.Lock()
+        self._mu = ranked_lock("pipeline.speculative", reentrant=False)
         self._entries: dict[tuple[bytes, bytes], _Entry] = {}  # insertion-ordered for LRU bound
         self._by_block: dict[bytes, _Entry] = {}
 
@@ -203,7 +205,7 @@ class SpeculativeVerifier:
                 # checker, so one async submission covers the whole block
                 txs = c.storage.block_transactions.get(block_hash)
                 own_view = UtxoView(base, ctx["mergeset_diff"])
-                own_staged = c._validate_transactions(
+                own_staged = c._validate_transactions(  # graftlint: allow(blocking-under-lock) -- unreachable sync branch: _begin passes checker=dispatch_async, _validate_transactions only calls dispatch() when no async checker is supplied
                     txs, own_view, header.daa_score, FLAG_FULL,
                     checker=checker, token_tag=("own",), position_anchor=sp,
                 )

@@ -68,7 +68,7 @@ class CircuitBreaker:
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # graftlint: allow(raw-lock) -- per-breaker state leaf, taken inside dispatch under arbitrary ranks
         # wiring that survives reset(): supervision attaches once per process
         self._managed = False
         self._trip_listeners: list = []
@@ -208,7 +208,7 @@ class CircuitBreaker:
 
 
 _device_breaker: CircuitBreaker | None = None
-_device_lock = threading.Lock()
+_device_lock = threading.Lock()  # graftlint: allow(raw-lock) -- process-wide device-breaker slot guard; held only for the swap
 
 
 def device_breaker() -> CircuitBreaker:

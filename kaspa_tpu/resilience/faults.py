@@ -70,6 +70,25 @@ from contextlib import contextmanager
 
 from kaspa_tpu.observability.core import REGISTRY
 
+# The single source of truth for compiled-in fault points.  graftlint's
+# registry-hygiene checker cross-checks this catalog against every
+# FAULTS.fire(...) literal in the tree, in both directions: firing an
+# uncataloged point and cataloging a dead point are both lint errors.
+FAULT_POINTS: dict[str, str] = {
+    "device.verify": "batch signature dispatch (ops/secp256k1/verify.py, crypto/secp.py)",
+    "device.hang": "same site, mode hang/wedge dispatch hangs seen by the watchdog",
+    "device.jit_compile": "first-compile of a (kernel, bucket) shape (crypto/secp.py)",
+    "device.mesh.dispatch": "sharded shard_map dispatch (ops/mesh.py)",
+    "vm.fallback.exec": "one deferred VM fallback job (txscript/batch.py)",
+    "p2p.send": "outgoing frame (p2p/transport.py)",
+    "p2p.recv": "incoming frame read (p2p/transport.py)",
+    "storage.commit": "write-batch commit (storage/kv.py, both engines)",
+    "storage.flush": "python-engine log append (storage/kv.py)",
+    "fabric.send": "outgoing verify-fabric request (fabric/client.py)",
+    "fabric.recv": "incoming verify-fabric frame (fabric/client.py)",
+    "fabric.slice_hang": "verifyd slice worker pre-dispatch (fabric/service.py)",
+}
+
 _INJECTIONS = REGISTRY.counter_family("fault_injections", "point", help="fired fault injections by point")
 
 _SLEEP_DEFAULTS = {"wedge": 0.05, "slow": 0.02, "stall": 0.02, "hang": 0.05}
@@ -138,7 +157,9 @@ class FaultRegistry:
 
     def __init__(self):
         self._armed = False
-        self._lock = threading.Lock()
+        # leaf lock, fired while holding arbitrary subsystem ranks; it only
+        # guards counter dicts and never acquires another lock
+        self._lock = threading.Lock()  # graftlint: allow(raw-lock) -- leaf hit-counter guard, fired under arbitrary ranks
         self._schedule: dict[str, dict] = {}
         self._seed = 0
         self._hits: dict[str, int] = {}

@@ -130,7 +130,7 @@ class _Job:
     def __init__(self, fn):
         self.fn = fn
         self.event = threading.Event()
-        self.lock = threading.Lock()
+        self.lock = threading.Lock()  # graftlint: allow(raw-lock) -- per-task result latch in the watchdog pool; never nests
         self.result = None
         self.error: BaseException | None = None
         self.abandoned = False
@@ -179,7 +179,7 @@ class WorkerPool:
     did without the watchdog."""
 
     def __init__(self, max_idle: int = 2):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # graftlint: allow(raw-lock) -- worker-pool freelist guard; never nests
         self._free: list[_Worker] = []
         self._max_idle = max_idle
         self.completed = 0
@@ -250,7 +250,7 @@ class WorkerPool:
 
 _POOL = WorkerPool()
 
-_stats_lock = threading.Lock()
+_stats_lock = threading.Lock()  # graftlint: allow(raw-lock) -- watchdog stats leaf; never nests
 _REQUEUE_STATS = {"batches": 0, "jobs": 0}
 
 
@@ -304,7 +304,7 @@ def verdict() -> dict:
 
 # --- warm-kernel manifest (persistent compiled-kernel cache index) --------
 
-_manifest_lock = threading.Lock()
+_manifest_lock = threading.Lock()  # graftlint: allow(raw-lock) -- warm-manifest file guard; held around json io only, no ranked lock under it
 _pretrace_report: list | None = None
 
 
@@ -490,7 +490,7 @@ class CanaryProber(threading.Thread):
 
 # --- install / shutdown ---------------------------------------------------
 
-_install_lock = threading.Lock()
+_install_lock = threading.Lock()  # graftlint: allow(raw-lock) -- install/shutdown slot guard; held only for the swap
 _install_count = 0
 _prober: CanaryProber | None = None
 

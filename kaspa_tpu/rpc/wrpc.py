@@ -332,12 +332,12 @@ class WrpcClient:
         if encoding and echoed_proto != f"kaspa-{encoding}":
             raise ConnectionError(f"server did not accept the {encoding!r} encoding (echoed {echoed_proto!r})")
         self._responses: dict = {}  # id -> response (reader fills)
-        self._response_cv = threading.Condition()
+        self._response_cv = threading.Condition()  # graftlint: allow(raw-lock) -- client-side test helper; single condvar, no lock nesting in the process under test
         self._closed = False
         self.notifications: queue.Queue = queue.Queue()
         self.borsh_notifications: queue.Queue = queue.Queue()
         self._next_id = 0
-        self._id_lock = threading.Lock()
+        self._id_lock = threading.Lock()  # graftlint: allow(raw-lock) -- request-id counter leaf in the client helper
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="wrpc-client-reader")
         self._reader.start()
 

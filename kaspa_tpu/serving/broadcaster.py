@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import queue
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 from collections import deque
 
@@ -115,7 +117,8 @@ class Subscriber:
         self.dropped = 0
         self.delivered = 0
         self._dq: deque = deque()
-        self._cv = threading.Condition()
+        self._lock = ranked_lock("serving.subscriber", reentrant=False)
+        self._cv = self._lock.condition()
         self._stopped = False
         self._thread = threading.Thread(target=self._run, daemon=True, name=f"serving-{name}")
         self._thread.start()
@@ -125,7 +128,7 @@ class Subscriber:
     def offer(self, notification: Notification, t_received: float) -> None:
         """Enqueue one event; applies the overflow policy, never blocks."""
         disconnect = False
-        with self._cv:
+        with self._lock:
             if self._stopped:
                 return
             if len(self._dq) >= self.maxlen:
@@ -150,13 +153,13 @@ class Subscriber:
                     log.exception("subscriber %s disconnect callback failed", self.name)
 
     def queue_depth(self) -> int:
-        with self._cv:
+        with self._lock:
             return len(self._dq)
 
     # --- lifecycle ---
 
     def stop(self) -> None:
-        with self._cv:
+        with self._lock:
             self._stopped = True
             self._cv.notify_all()
 
@@ -170,7 +173,7 @@ class Subscriber:
     def _run(self) -> None:
         lag_hist = _LAG.cell(self.encoding)
         while True:
-            with self._cv:
+            with self._lock:
                 while not self._dq and not self._stopped:
                     self._cv.wait(timeout=0.5)
                 if self._dq:
@@ -200,7 +203,7 @@ class Subscriber:
                         self.sink.put(payload, timeout=0.25)
                         break
                     except queue.Full:
-                        with self._cv:
+                        with self._lock:
                             if self._stopped:
                                 return
             self.delivered += 1
@@ -226,7 +229,7 @@ class Broadcaster:
     def __init__(self, notifier, ingest_maxsize: int = 8192):
         self.notifier = notifier
         self._ingest: queue.Queue = queue.Queue(maxsize=ingest_maxsize)
-        self._mu = threading.Lock()
+        self._mu = ranked_lock("serving.broadcaster", reentrant=False)
         self._subscribers: list[Subscriber] = []
         self._event_refs: dict[str, int] = {}
         self._closed = False

@@ -30,7 +30,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvs
 _SRC = os.path.join(_NATIVE_DIR, "kvstore.cc")
 _HEADERS = (os.path.join(_NATIVE_DIR, "arena.h"),)
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libkvstore.so")
-_BUILD_LOCK = threading.Lock()
+_BUILD_LOCK = threading.Lock()  # graftlint: allow(raw-lock) -- one-shot native build guard at import depth; below any subsystem rank
 
 
 def _src_mtime() -> float:

@@ -21,7 +21,7 @@ class SigCache:
         self._map: dict[tuple, bool] = {}
         self._keys: list[tuple] = []
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # graftlint: allow(raw-lock) -- sighash cache leaf guard; never nests
         self.hits = 0
         self.misses = 0
 

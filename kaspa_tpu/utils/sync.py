@@ -125,16 +125,72 @@ def _trace_record(name: str, held_s: float) -> None:
         entry[2] = max(entry[2], held_s)
 
 
+# The global lock rank table: locks may only be acquired in strictly
+# ascending rank order on one thread (same-instance re-entry excepted).
+# Every production LockCtx takes its rank from here via ranked_lock() so
+# the whole-node partial order is reviewable in one place.  Rationale for
+# the ordering (outer → inner as ranks ascend):
+#
+#   node / ingest.state sit at the outside: RPC and P2P entry points take
+#   them first, then descend into consensus commit, then into the leaf
+#   queues/stats.  Wire/service/stats locks are leaves — nothing else is
+#   acquired while they are held — so they rank highest.  daemon.upnp is
+#   a pure leaf around a blocking-free socket probe.
+RANKS: dict[str, int] = {
+    "node": 5,                 # p2p/node.py — outermost node state
+    "ingest.state": 7,         # ingest/tier.py — mempool admission state
+    "consensus-commit": 10,    # pipeline/pipeline.py — UTXO commit section
+    "pipeline.deps": 20,       # pipeline/deps_manager.py — orphan/deps graph
+    "fabric.config": 25,       # fabric/balancer.py — process-wide balancer slot
+    "fabric.balancer": 30,     # fabric/balancer.py — slice table + breaker state
+    "dispatch.config": 35,     # ops/dispatch.py — process-wide dispatcher slot
+    "mesh.config": 38,         # ops/mesh.py — mesh/topology (re)configuration
+    "dispatch.queue": 40,      # ops/dispatch.py — verify coalescing queue
+    "ingest.queue": 45,        # ingest/queue.py — tx admission queue
+    "serving.broadcaster": 50, # serving/broadcaster.py — subscriber table
+    "serving.subscriber": 55,  # serving/broadcaster.py — per-subscriber buffer
+    "pipeline.idle": 60,       # pipeline/pipeline.py — idle/backlog condvar
+    "pipeline.speculative": 65,# pipeline/speculative.py — prefetch results
+    "fabric.wire": 70,         # fabric/client.py — per-connection write lock
+    "fabric.service": 75,      # fabric/service.py — verifyd slice state
+    "ingest.stats": 80,        # ingest/tier.py — admission counters (leaf)
+    "daemon.upnp": 85,         # node/daemon.py — UPnP probe guard (leaf)
+}
+
+
+def ranked_lock(name: str, reentrant: bool = True) -> "LockCtx":
+    """A LockCtx whose rank comes from the RANKS table (KeyError on an
+    undeclared name — adding a lock means declaring its place in the
+    global order first)."""
+    return LockCtx(name, RANKS[name], reentrant=reentrant)
+
+
 class LockCtx:
     """Ranked lock wrapper: acquiring a lock with rank <= any currently
     held rank (on the same thread) is an ordering violation — the static
     discipline that makes the pipeline deadlock-free.  Zero overhead
-    unless KASPA_TPU_LOCK_DEBUG is set."""
+    unless KASPA_TPU_LOCK_DEBUG is set.
 
-    def __init__(self, name: str, rank: int, lock=None):
+    ``condition()`` builds a threading.Condition over the *underlying*
+    lock, so condvar users keep the rank bookkeeping of ``with ctx:``
+    while wait/notify release and reacquire the raw lock underneath.
+    Note: under debug, a hold that spans ``cv.wait()`` is traced as one
+    long hold (the stack entry stays while the raw lock is released —
+    the parked thread cannot acquire anything, so order checking is
+    unaffected, but hold-time aggregates include wait time).
+    """
+
+    def __init__(self, name: str, rank: int, lock=None, reentrant: bool = True):
         self.name = name
         self.rank = rank
-        self._lock = lock if lock is not None else threading.RLock()
+        if lock is not None:
+            self._lock = lock
+        else:
+            self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def condition(self) -> threading.Condition:
+        """A Condition bound to this lock; use inside ``with ctx:``."""
+        return threading.Condition(self._lock)
 
     def __enter__(self):
         tracked = _LOCK_DEBUG

@@ -1,0 +1,350 @@
+"""graftlint tests: the five checkers on seeded fixtures, pragma
+semantics, one-hop call-graph expansion, and the full-repo self-run.
+
+Fixtures are written to tmp_path and linted with run_project — the lint
+is AST-only, so fixture code is never imported or executed (a fixture may
+freely reference names that don't resolve).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import kaspa_tpu.analysis.checkers  # noqa: F401 - registers the checkers
+from kaspa_tpu.analysis import run_project
+from kaspa_tpu.analysis.__main__ import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(tmp_path, files: dict[str, str]) -> dict:
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return run_project([str(tmp_path)], root=str(tmp_path))
+
+
+def _ids(report: dict) -> set[str]:
+    return {f["checker"] for f in report["findings"]}
+
+
+# --- blocking-under-lock --------------------------------------------------
+
+
+def test_blocking_under_lock_direct(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import time
+
+        def bad(self):
+            with self._lock:
+                time.sleep(0.1)
+                self.fut.result()
+
+        def fine(self):
+            with self._lock:
+                x = 1
+            self.fut.result()
+    """})
+    lines = [(f["line"], f["checker"]) for f in report["findings"]]
+    assert (6, "blocking-under-lock") in lines  # sleep under lock
+    assert (7, "blocking-under-lock") in lines  # .result() under lock
+    assert not any(line > 9 for line, _ in lines)
+    assert report["ok"] is False
+
+
+def test_blocking_under_lock_condvar_wait_exempt(tmp_path):
+    # a condition-variable wait RELEASES the lock — exempt by receiver
+    # naming convention; an Event.wait parks while still holding it
+    report = _lint(tmp_path, {"mod.py": """
+        def ok(self):
+            with self._mu:
+                self._cv.wait(0.5)
+
+        def bad(self):
+            with self._mu:
+                self._event.wait(0.5)
+    """})
+    lines = [f["line"] for f in report["findings"] if f["checker"] == "blocking-under-lock"]
+    assert lines == [8]
+
+
+def test_blocking_under_lock_one_hop_expansion(tmp_path):
+    report = _lint(tmp_path, {"a.py": """
+        import time
+
+        def helper():
+            time.sleep(1.0)
+
+        def caller(self):
+            with self._lock:
+                helper()
+    """})
+    msgs = [f for f in report["findings"] if f["checker"] == "blocking-under-lock"]
+    assert len(msgs) == 1 and msgs[0]["line"] == 9
+    assert "blocks indirectly" in msgs[0]["message"]
+    assert "a.py:5" in msgs[0]["message"]
+
+
+def test_one_hop_skips_ambiguous_names(tmp_path):
+    # two project-wide definitions of the same bare name: not expanded
+    report = _lint(tmp_path, {
+        "a.py": """
+            import time
+
+            def helper():
+                time.sleep(1.0)
+        """,
+        "b.py": """
+            def helper():
+                return 1
+
+            def caller(self):
+                with self._lock:
+                    helper()
+        """,
+    })
+    assert not [f for f in report["findings"] if f["checker"] == "blocking-under-lock"]
+
+
+# --- raw-lock -------------------------------------------------------------
+
+
+def test_raw_lock_flags_constructions(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import threading
+
+        a = threading.Lock()
+        b = threading.RLock()
+        c = threading.Condition()
+        d = threading.Condition(a)
+        e = threading.Event()
+    """})
+    lines = sorted(f["line"] for f in report["findings"] if f["checker"] == "raw-lock")
+    assert lines == [4, 5, 6]  # bound Condition(a) and Event are fine
+
+
+def test_raw_lock_exempts_sync_module(tmp_path):
+    report = _lint(tmp_path, {"utils/sync.py": """
+        import threading
+
+        a = threading.Lock()
+    """})
+    assert not report["findings"]
+
+
+# --- tracer-hazard --------------------------------------------------------
+
+
+def test_tracer_hazard_in_jit_bodies(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import functools
+        import jax
+        import numpy as np
+
+        _CACHE = {}
+
+        @functools.lru_cache(maxsize=None)
+        def cached_helper(x):
+            return x
+
+        @jax.jit
+        def traced(x):
+            _CACHE[1] = x
+            y = int(x)
+            z = np.add(x, x)
+            w = cached_helper(x)
+            for i in range(100):
+                y = y + i
+            return y + z + w
+    """})
+    msgs = [f["message"] for f in report["findings"] if f["checker"] == "tracer-hazard"]
+    assert any("module-level dict" in m for m in msgs)
+    assert any("coerces with int()" in m for m in msgs)
+    assert any("np.add" in m for m in msgs)
+    assert any("lru_cache'd" in m for m in msgs)
+    assert any("100-iteration" in m for m in msgs)
+
+
+def test_tracer_hazard_ignores_host_code_and_factories(tmp_path):
+    # the mesh.py idiom: an lru_cache'd FACTORY that builds a jit callable
+    # is consulted outside the trace; hazards only count inside jit bodies
+    report = _lint(tmp_path, {"mod.py": """
+        import functools
+        import jax
+        import numpy as np
+
+        _CACHE = {}
+
+        @functools.lru_cache(maxsize=None)
+        def kernel_factory(n):
+            def inner(x):
+                return x + n
+            return jax.jit(inner)
+
+        def host_only(x):
+            _CACHE[1] = int(x)
+            return np.add(x, x)
+    """})
+    hits = [f for f in report["findings"] if f["checker"] == "tracer-hazard"]
+    assert not hits
+
+
+def test_tracer_hazard_catches_shard_map_reference(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+
+        def kernel(x):
+            return np.square(x)
+
+        sharded = shard_map(kernel, mesh=None, in_specs=None, out_specs=None)
+    """})
+    hits = [f for f in report["findings"] if f["checker"] == "tracer-hazard"]
+    assert len(hits) == 1 and "np.square" in hits[0]["message"]
+
+
+# --- trace-ctx-handoff ----------------------------------------------------
+
+
+def test_trace_ctx_handoff(tmp_path):
+    report = _lint(tmp_path, {
+        "pipeline/stage.py": """
+            def bad(self, q, item):
+                q.put((item, 1))
+
+            def good(self, q, item, ctx):
+                q.put((item, ctx))
+
+            def object_payload(self, q, task):
+                q.put(task)
+        """,
+        "other/stage.py": """
+            def uninstrumented(self, q, item):
+                q.put((item, 1))
+        """,
+    })
+    hits = [(f["path"], f["line"]) for f in report["findings"] if f["checker"] == "trace-ctx-handoff"]
+    assert hits == [("pipeline/stage.py", 3)]
+
+
+# --- registry-hygiene -----------------------------------------------------
+
+
+def test_registry_hygiene_fault_points_both_directions(tmp_path):
+    report = _lint(tmp_path, {
+        "resilience/faults.py": """
+            FAULT_POINTS = {
+                "a.live": "used below",
+                "b.dead": "nothing fires this",
+            }
+        """,
+        "mod.py": """
+            from resilience.faults import FAULTS
+
+            def f():
+                FAULTS.fire("a.live")
+                FAULTS.fire("c.uncataloged")
+        """,
+    })
+    msgs = [f["message"] for f in report["findings"] if f["checker"] == "registry-hygiene"]
+    assert any("'b.dead'" in m and "dead point" in m for m in msgs)
+    assert any("'c.uncataloged'" in m and "missing from" in m for m in msgs)
+    assert not any("'a.live'" in m for m in msgs)
+
+
+def test_registry_hygiene_metric_names(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        from observability.core import REGISTRY
+
+        _A = REGISTRY.counter("good_name", help="x")
+        _B = REGISTRY.counter("Bad-Name", help="x")
+        _C = REGISTRY.histogram("good_name", (1, 2), help="dup of _A")
+    """})
+    msgs = [f["message"] for f in report["findings"] if f["checker"] == "registry-hygiene"]
+    assert any("'Bad-Name'" in m and "convention" in m for m in msgs)
+    assert any("duplicate registration of 'good_name'" in m for m in msgs)
+
+
+# --- pragmas --------------------------------------------------------------
+
+
+def test_pragma_suppresses_with_justification(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import threading
+
+        a = threading.Lock()  # graftlint: allow(raw-lock) -- fixture leaf lock
+    """})
+    assert report["ok"] is True
+    assert not report["findings"]
+    assert len(report["suppressed"]) == 1
+    assert report["suppressed"][0]["justification"] == "fixture leaf lock"
+
+
+def test_pragma_on_preceding_comment_line(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import threading
+
+        # graftlint: allow(raw-lock) -- covers the next line
+        a = threading.Lock()
+    """})
+    assert report["ok"] is True and len(report["suppressed"]) == 1
+
+
+def test_pragma_without_justification_is_an_error(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import threading
+
+        a = threading.Lock()  # graftlint: allow(raw-lock)
+    """})
+    assert report["ok"] is False
+    checkers = {f["checker"] for f in report["findings"]}
+    # the raw-lock finding stays active AND the naked pragma is flagged
+    assert checkers == {"raw-lock", "pragma"}
+
+
+def test_pragma_only_matching_checker(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import time
+
+        def f(self):
+            with self._lock:
+                time.sleep(1)  # graftlint: allow(raw-lock) -- wrong id, must not suppress
+    """})
+    assert any(f["checker"] == "blocking-under-lock" for f in report["findings"])
+
+
+# --- CLI + self-run -------------------------------------------------------
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    bad = tmp_path / "seeded"
+    bad.mkdir()
+    (bad / "mod.py").write_text("import threading\nx = threading.Lock()\n")
+    out = tmp_path / "LINT.json"
+    rc = lint_main([str(bad), "--root", str(tmp_path), "--json", str(out), "-q"])
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert doc["ok"] is False and doc["counts"] == {"raw-lock": 1}
+
+    good = tmp_path / "clean"
+    good.mkdir()
+    (good / "mod.py").write_text("x = 1\n")
+    assert lint_main([str(good), "--root", str(tmp_path), "-q"]) == 0
+
+
+def test_full_repo_self_run_is_clean():
+    """The acceptance gate: the repo lints clean, and every suppression
+    carries a justification."""
+    report = run_project([os.path.join(REPO, "kaspa_tpu")], root=REPO)
+    assert report["findings"] == [], [f["path"] + ":" + str(f["line"]) for f in report["findings"]]
+    assert report["ok"] is True
+    assert all(s["justification"] for s in report["suppressed"])
+    # the migration actually happened: suppressions are the documented
+    # exceptions, not the hot subsystems
+    hot = [s for s in report["suppressed"]
+           if s["checker"] == "raw-lock" and any(
+               part in s["path"] for part in ("pipeline/", "ingest/", "serving/", "ops/dispatch"))]
+    assert hot == []

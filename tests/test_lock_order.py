@@ -68,6 +68,92 @@ def test_node_flow_clean_under_debug_and_traced(lock_debug):
     assert all(v["total_hold_s"] >= 0 for v in trace.values())
 
 
+def test_sim_replay_and_ingest_flood_clean_under_debug(lock_debug):
+    """The PR 13 adoption gate: a 24-block sim replay through the full
+    pipeline plus an ingest flood wave, all with lock debug on.  Any rank
+    inversion on the migrated locks (RANKS table, utils/sync.py) raises
+    AssertionError here; on success the trace must carry hold-time
+    aggregates for the newly ranked subsystems."""
+    import threading
+
+    from kaspa_tpu.consensus.consensus import Consensus
+    from kaspa_tpu.ingest.tier import ACCEPTED, IngestTier
+    from kaspa_tpu.mempool import MiningManager
+    from kaspa_tpu.sim.simulator import SimConfig, simulate
+    from tests.test_ingest import _spends
+
+    from kaspa_tpu.ops import dispatch as coalesce
+    from kaspa_tpu.pipeline.pipeline import ConsensusPipeline
+
+    cfg = SimConfig(bps=2, delay=0.5, num_miners=2, num_blocks=24, txs_per_block=2, seed=23)
+    res = simulate(cfg)
+    c = Consensus(res.params)
+    coalesce.configure(64)  # engage the coalescing queue (dispatch.queue rank)
+    try:
+        pipe = ConsensusPipeline(c, workers=3)
+        futs = [pipe.submit(b) for b in res.blocks]
+        for f in futs:
+            f.result(timeout=120)
+        pipe.wait_for_idle()
+        pipe.shutdown()
+    finally:
+        coalesce.configure(0)
+
+    # flood wave: concurrent submitters race the queue locks, one pump
+    # drains through mempool admission on the verify plane
+    tier = IngestTier(MiningManager(c))
+    # simulate() draws miner keys from Random(seed) at construction, in
+    # order — reseeding reproduces the keypairs that own the sim's UTXOs
+    from kaspa_tpu.sim.simulator import Miner
+
+    sim_rng = random.Random(cfg.seed)
+    miners = [Miner(i, sim_rng) for i in range(cfg.num_miners)]
+    txs = _spends(c, miners[0], random.Random(31), 6)
+    tickets = []
+    t_mu = threading.Lock()
+
+    def _submit(tx, src):
+        tk = tier.submit(tx, src)
+        with t_mu:
+            tickets.append(tk)
+
+    threads = [
+        threading.Thread(target=_submit, args=(tx, "rpc" if i % 2 else "p2p"))
+        for i, tx in enumerate(txs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tier.pump() == len(txs)
+    assert all(t.status == ACCEPTED for t in tickets)
+    assert tier.stats()["lost"] == 0
+
+    trace = usync.lock_trace_snapshot()
+    # the replay exercises the pipeline ranks, the flood the ingest ranks
+    for name in ("consensus-commit", "pipeline.deps", "pipeline.idle",
+                 "dispatch.queue", "ingest.queue", "ingest.state", "ingest.stats"):
+        assert trace.get(name, {}).get("acquisitions", 0) > 0, f"no hold trace for {name}"
+        assert trace[name]["total_hold_s"] >= trace[name]["max_hold_s"] >= 0
+
+
+def test_ranked_lock_table_is_consistent():
+    """Every RANKS name builds, ranks are unique enough to order the
+    documented nestings, and ranked_lock rejects undeclared names."""
+    from kaspa_tpu.utils.sync import RANKS, ranked_lock
+
+    assert RANKS["node"] < RANKS["consensus-commit"] < RANKS["dispatch.queue"]
+    assert RANKS["fabric.config"] < RANKS["fabric.balancer"] < RANKS["fabric.wire"]
+    assert RANKS["serving.broadcaster"] < RANKS["serving.subscriber"]
+    lk = ranked_lock("pipeline.idle", reentrant=False)
+    assert lk.rank == RANKS["pipeline.idle"]
+    cv = lk.condition()
+    with lk:
+        cv.notify_all()  # bound to the same underlying lock: must not raise
+    with pytest.raises(KeyError):
+        ranked_lock("no-such-lock")
+
+
 def test_metrics_exposes_lock_trace(lock_debug):
     from kaspa_tpu.consensus.consensus import Consensus
     from kaspa_tpu.consensus.params import simnet_params

@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
-# The pre-PR fast lane: tier-1 tests + wire-freeze fixture checks.
+# The pre-PR fast lane: static analysis + tier-1 tests + wire-freeze
+# fixture checks.
 #
 # Runs, in order:
 #   1. proto golden-fixture check  (tools/gen_proto_fixtures.py --check)
 #   2. borsh golden-fixture check  (tools/gen_borsh_fixtures.py --check)
-#   3. the tier-1 pytest fast lane (tests/, -m "not slow")
+#   3. graftlint static analysis   (tools/lint.py, writes LINT.json)
+#   4. the tier-1 pytest fast lane (tests/, -m "not slow")
 #
 # The fixture checks re-encode every sample payload in memory and diff
 # against the committed bytes under tests/fixtures/{proto,borsh} — any
@@ -14,7 +16,7 @@
 #
 #     bash tools/ci_fastlane.sh
 #
-# Exit 0 iff all three stages pass.
+# Exit 0 iff all four stages pass.
 
 set -u
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -25,13 +27,16 @@ PY="${PYTHON:-python}"
 
 fail=0
 
-echo "[ci_fastlane] 1/3 proto wire-freeze check"
+echo "[ci_fastlane] 1/4 proto wire-freeze check"
 "$PY" tools/gen_proto_fixtures.py --check || fail=1
 
-echo "[ci_fastlane] 2/3 borsh wire-freeze check"
+echo "[ci_fastlane] 2/4 borsh wire-freeze check"
 "$PY" tools/gen_borsh_fixtures.py --check || fail=1
 
-echo "[ci_fastlane] 3/3 tier-1 fast lane"
+echo "[ci_fastlane] 3/4 graftlint static analysis"
+"$PY" tools/lint.py -q || fail=1
+
+echo "[ci_fastlane] 4/4 tier-1 fast lane"
 pytest_log="$(mktemp)"
 trap 'rm -f "$pytest_log"' EXIT
 "$PY" -m pytest tests/ -q -m "not slow" \

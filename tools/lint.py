@@ -1,0 +1,23 @@
+#!/usr/bin/env python3
+"""Repo-root graftlint wrapper: ``python tools/lint.py [paths...]``.
+
+Pins --root to the repo root (so findings are repo-relative regardless
+of cwd) and defaults --json to LINT.json next to this script's parent.
+Everything else is ``python -m kaspa_tpu.analysis``.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from kaspa_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if "--root" not in argv:
+        argv = ["--root", _ROOT, *argv]
+    if "--json" not in argv:
+        argv = ["--json", os.path.join(_ROOT, "LINT.json"), *argv]
+    sys.exit(main(argv))

@@ -1,6 +1,7 @@
 #!/usr/bin/env python
-"""One-command round evidence: fast-lane tests + sim replay + bench probe
-+ multichip dryrun + mesh smoke + flight-recorder trace + chaos sustain.
+"""One-command round evidence: graftlint + fast-lane tests + sim replay
++ bench probe + multichip dryrun + mesh smoke + flight-recorder trace
++ chaos sustain.
 
 Runs the repo's tier-1 fast lane, a short simulator replay, the bench
 session probe, the sharded multichip dryrun (on every visible device,
@@ -28,6 +29,7 @@ instead of eight scrollback logs.
     python tools/roundcheck.py --skip-supervision  # no wedge drill
     python tools/roundcheck.py --skip-fabric       # no two-process fabric drill
     python tools/roundcheck.py --skip-ingest       # no tx-ingest admission lane
+    python tools/roundcheck.py --skip-lint         # no graftlint static-analysis gate
     python tools/roundcheck.py --out my.json       # custom artifact path
 
 ``--only SECTION`` (repeatable, or comma-separated) runs exactly the
@@ -193,6 +195,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-supervision", action="store_true", help="skip the device-supervision wedge drill")
     ap.add_argument("--skip-fabric", action="store_true", help="skip the two-process verify-fabric drill")
     ap.add_argument("--skip-ingest", action="store_true", help="skip the tx-ingest admission lane")
+    ap.add_argument("--skip-lint", action="store_true", help="skip the graftlint static-analysis gate")
     ap.add_argument(
         "--only", action="append", default=None, metavar="SECTION",
         help="run only the named section(s); repeatable or comma-separated, "
@@ -211,6 +214,20 @@ def main(argv: list[str] | None = None) -> int:
     # forced 8 CPU host devices: the mesh lanes must work on any box the
     # round runs on, with or without a real accelerator
     mesh_env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+    def _sect_lint() -> dict:
+        sect = _run([sys.executable, os.path.join(REPO_ROOT, "tools", "lint.py"), "-q"], 120.0)
+        report = None
+        try:
+            with open(os.path.join(REPO_ROOT, "LINT.json")) as f:
+                report = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        sect["findings"] = len(report["findings"]) if report else None
+        sect["suppressed"] = len(report["suppressed"]) if report else None
+        sect["files"] = report["files"] if report else None
+        sect["ok"] = sect["rc"] == 0 and report is not None and report["ok"]
+        return sect
 
     def _sect_tier1() -> dict:
         sect = _run(FASTLANE_CMD, args.test_timeout, {"JAX_PLATFORMS": "cpu"})
@@ -583,6 +600,7 @@ def main(argv: list[str] | None = None) -> int:
         return sect
 
     sections: list[tuple[str, bool, object]] = [
+        ("lint", not args.skip_lint, _sect_lint),
         ("tier1", not args.skip_tests, _sect_tier1),
         ("sim", not args.skip_sim, _sect_sim),
         ("bench_probe", not args.skip_bench, _sect_bench_probe),
