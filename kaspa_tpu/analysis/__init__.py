@@ -15,6 +15,9 @@ re-learning at runtime (see ISSUE 13 / README "Static analysis"):
     registry-hygiene      fault points match the resilience/faults.py
                           catalog; metric names are convention-clean and
                           registered once
+    unbounded-queue       every deque()/Queue() outside utils/ states its
+                          overflow policy (maxlen/maxsize, a producer-side
+                          capacity check, or a justified pragma)
 
 Suppression: ``# graftlint: allow(<checker-id>) -- <justification>`` on
 the offending line (or alone on the line above).  A pragma without a

@@ -1,4 +1,4 @@
-"""The five graftlint checkers (see package docstring for the catalog).
+"""The six graftlint checkers (see package docstring for the catalog).
 
 Each checker is registered under its id and returns findings for ONE
 file; anything project-wide (the call-graph table, the fault-point
@@ -460,4 +460,77 @@ def check_registry_hygiene(project: Project, f: SourceFile) -> list[Finding]:
                             f"{canonical[0]}:{canonical[1]}): one name, one series",
                         )
                     )
+    return out
+
+
+# ----------------------------------------------------------------------
+# 6. unbounded-queue
+# ----------------------------------------------------------------------
+
+_QUEUE_CTORS = ("Queue", "LifoQueue", "PriorityQueue")
+
+
+def _is_unbounded_arg(node: ast.AST | None) -> bool:
+    """A bound argument that is literally 0/None is no bound at all."""
+    if node is None:
+        return True
+    return isinstance(node, ast.Constant) and node.value in (0, None)
+
+
+@register_checker(
+    "unbounded-queue",
+    "deque()/queue.Queue() constructed without an explicit bound outside "
+    "utils/ — every buffer in the node must state its overflow policy "
+    "(maxlen/maxsize, a capacity check at the producer, or a justified pragma)",
+)
+def check_unbounded_queue(project: Project, f: SourceFile) -> list[Finding]:
+    if f.rel.startswith("utils/") or "/utils/" in f.rel:
+        return []  # primitives layer: sync.py's waiter deque etc. are leaf internals
+    out: list[Finding] = []
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name == "deque":
+            # deque(iterable, maxlen) — bounded iff maxlen is present and real
+            maxlen = node.args[1] if len(node.args) >= 2 else None
+            if maxlen is None:
+                for kw in node.keywords:
+                    if kw.arg == "maxlen":
+                        maxlen = kw.value
+            if _is_unbounded_arg(maxlen):
+                out.append(
+                    Finding(
+                        f.rel, node.lineno, "unbounded-queue",
+                        "deque() without maxlen: under sustained overload this "
+                        "buffer grows until the process dies — bound it, enforce "
+                        "a capacity check at the producer, or pragma with the "
+                        "reason it cannot overflow",
+                    )
+                )
+        elif name in _QUEUE_CTORS:
+            maxsize = node.args[0] if node.args else None
+            if maxsize is None:
+                for kw in node.keywords:
+                    if kw.arg == "maxsize":
+                        maxsize = kw.value
+            if _is_unbounded_arg(maxsize):
+                out.append(
+                    Finding(
+                        f.rel, node.lineno, "unbounded-queue",
+                        f"{name}() without maxsize: an unbounded handoff queue "
+                        "turns overload into memory exhaustion — give it a "
+                        "maxsize and an overflow policy, or pragma with the "
+                        "reason the producer is naturally bounded",
+                    )
+                )
+        elif name == "SimpleQueue":
+            out.append(
+                Finding(
+                    f.rel, node.lineno, "unbounded-queue",
+                    "SimpleQueue() has no bound at all — use Queue(maxsize=...) "
+                    "with an overflow policy, or pragma with the reason the "
+                    "producer is naturally bounded",
+                )
+            )
     return out

@@ -64,7 +64,7 @@ class GhostdagManager:
     # --- mergeset (mergeset.rs) ---
 
     def unordered_mergeset_without_selected_parent(self, selected_parent: bytes, parents) -> set[bytes]:
-        queue = deque(p for p in parents if p != selected_parent)
+        queue = deque(p for p in parents if p != selected_parent)  # graftlint: allow(unbounded-queue) -- local BFS work-list, bounded by the block's anticone
         mergeset = set(queue)
         past: set[bytes] = set()
         while queue:

@@ -245,7 +245,7 @@ class ReachabilityService:
         """Iterative subtree-size count rooted at `block` (BFS + push-up)."""
         if block in sizes:
             return
-        queue = deque([block])
+        queue = deque([block])  # graftlint: allow(unbounded-queue) -- local BFS work-list over the reindex subtree
         counts: dict[bytes, int] = {}
         while queue:
             current = queue.popleft()
@@ -265,7 +265,7 @@ class ReachabilityService:
 
     def _propagate_interval(self, block: bytes, sizes: dict[bytes, int]) -> None:
         self._count_subtrees(block, sizes)
-        queue = deque([block])
+        queue = deque([block])  # graftlint: allow(unbounded-queue) -- local BFS work-list over the reindex subtree
         while queue:
             current = queue.popleft()
             children = self._children[current]
@@ -453,7 +453,7 @@ class ReachabilityService:
     def _current_mergeset_wo_sp(self, selected_parent: bytes, parents) -> list[bytes]:
         """Mergeset over the CURRENT (rewired) reachability relations
         (ghostdag/mergeset.rs unordered_mergeset_without_selected_parent)."""
-        queue = deque(p for p in parents if p != selected_parent)
+        queue = deque(p for p in parents if p != selected_parent)  # graftlint: allow(unbounded-queue) -- local BFS work-list, bounded by the block's anticone
         mergeset = set(queue)
         past: set[bytes] = set()
         while queue:

@@ -115,7 +115,7 @@ class FabricBalancer:
             "submitted": 0, "remote": 0, "degraded": 0, "failovers": 0,
             "late_responses": 0, "abandoned": 0,
         }
-        self._degraded_q: queue.Queue = queue.Queue()
+        self._degraded_q: queue.Queue = queue.Queue()  # graftlint: allow(unbounded-queue) -- degraded-mode fallback lane; entries are jobs already bounded by the dispatcher's inflight cap
         self._stopped = threading.Event()
         for addr in self.addrs:
             conn = FabricConnection(addr, on_message=self._on_message, on_disconnect=self._on_disconnect)

@@ -88,7 +88,7 @@ class VerifyService:
 
             slices = mesh.slice_count()
         self.slices = max(1, int(slices))
-        self._queues: list[queue.Queue] = [queue.Queue() for _ in range(self.slices)]
+        self._queues: list[queue.Queue] = [queue.Queue() for _ in range(self.slices)]  # graftlint: allow(unbounded-queue) -- per-slice dispatch handoff; producers are the bounded wire readers, a maxsize here would deadlock the service loop
         self._inflight = [0] * self.slices
         self._served = [0] * self.slices
         self._lock = ranked_lock("fabric.service", reentrant=False)

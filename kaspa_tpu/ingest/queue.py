@@ -41,11 +41,24 @@ class IngestQueue:
 
     def __init__(self, capacity: int = 10_000, sources: tuple[str, ...] = (SOURCE_RPC, SOURCE_P2P)):
         self.capacity = capacity
-        self._lanes: dict[str, deque] = {s: deque() for s in sources}
+        self._limit: int | None = None  # overload clamp (see set_capacity_limit)
+        self._lanes: dict[str, deque] = {s: deque() for s in sources}  # graftlint: allow(unbounded-queue) -- lanes are capacity-bounded by the put() check below
         self._order: tuple[str, ...] = tuple(sources)
         self._next = 0  # round-robin cursor into _order
         self._mu = ranked_lock("ingest.queue", reentrant=False)
         self._nonempty = self._mu.condition()
+
+    def set_capacity_limit(self, limit: int | None) -> None:
+        """Overload clamp: shrink the effective per-lane bound below the
+        configured capacity (None restores it).  Items already queued
+        above a new lower limit stay queued — the clamp sheds new
+        arrivals, it never drops accepted work."""
+        with self._mu:
+            self._limit = max(1, int(limit)) if limit is not None else None
+
+    def effective_capacity(self) -> int:
+        limit = self._limit
+        return min(self.capacity, limit) if limit is not None else self.capacity
 
     def put(self, source: str, item) -> bool:
         """Enqueue on the source's lane; False (shed) when that lane is full."""
@@ -53,9 +66,9 @@ class IngestQueue:
         with self._mu:
             lane = self._lanes.get(source)
             if lane is None:
-                lane = self._lanes[source] = deque()
+                lane = self._lanes[source] = deque()  # graftlint: allow(unbounded-queue) -- bounded by the effective-capacity check below
                 self._order = self._order + (source,)
-            if len(lane) >= self.capacity:
+            if len(lane) >= self.effective_capacity():
                 _BACKPRESSURE.inc(source)
                 return False
             lane.append(item)
@@ -97,5 +110,6 @@ class IngestQueue:
         with self._mu:
             return {
                 "capacity": self.capacity,
+                "effective_capacity": self.effective_capacity(),
                 "depth": {s: len(lane) for s, lane in self._lanes.items()},
             }

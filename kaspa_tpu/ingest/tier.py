@@ -51,6 +51,7 @@ _WAVE_MS = REGISTRY.histogram(
 _OUTCOMES = REGISTRY.counter_family(
     "ingest_outcomes", "outcome", help="admission verdicts (accepted/orphaned/rejected)"
 )
+from kaspa_tpu.observability.shed import SHED as _SHED  # noqa: E402  (family declared once there)
 
 ACCEPTED = "accepted"
 ORPHANED = "orphaned"
@@ -120,6 +121,20 @@ class IngestTier:
         self._resolved = 0
         self._waves = 0
         self._mu = ranked_lock("ingest.stats", reentrant=False)
+        # overload brownout state (set by resilience/overload.py): when
+        # active, new submissions are rejected up-front with the stable
+        # node-overloaded code + a retry-after hint.  Already-queued
+        # tickets still admit normally — shed new work, never accepted work.
+        self._overload_active = False
+        self._overload_retry_ms = 0
+
+    def set_overload(self, active: bool, retry_after_ms: int = 0) -> None:
+        """Brownout seam: reject new submissions with ``node-overloaded``
+        (+ retry hint) while active.  Every rejected tx still resolves its
+        AdmissionTicket — the lost==0 invariant is untouched."""
+        with self._mu:
+            self._overload_active = bool(active)
+            self._overload_retry_ms = int(retry_after_ms)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -152,6 +167,19 @@ class IngestTier:
         ticket = AdmissionTicket(tx, source)
         with self._mu:
             self._submitted += 1
+            overloaded, retry_ms = self._overload_active, self._overload_retry_ms
+        if overloaded:
+            _SHED.inc("ingest_shed")
+            self._finish_ticket(
+                ticket,
+                REJECTED,
+                error=MempoolError(
+                    "node overloaded, retry later",
+                    code="node-overloaded",
+                    retry_after_ms=retry_ms or None,
+                ),
+            )
+            return ticket
         if not self.queue.put(source, ticket):
             self._finish_ticket(
                 ticket,
@@ -268,8 +296,10 @@ class IngestTier:
     def stats(self) -> dict:
         with self._mu:
             submitted, resolved, waves = self._submitted, self._resolved, self._waves
+            overloaded = self._overload_active
         out = _OUTCOMES.snapshot()
         return {
+            "overload_active": overloaded,
             "submitted": submitted,
             "resolved": resolved,
             "lost": submitted - resolved - self.queue.depth(),

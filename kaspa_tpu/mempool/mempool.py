@@ -23,11 +23,14 @@ class MempoolError(Exception):
     """Mempool admission rejection.  ``code`` is a stable machine-readable
     identifier (the RPC layer forwards it verbatim so clients can branch
     without parsing prose): tx-duplicate, tx-double-spend, tx-rbf-rejected,
-    tx-fee-too-low, mempool-full, tx-gas, tx-invalid."""
+    tx-fee-too-low, mempool-full, tx-gas, tx-invalid, node-overloaded.
+    ``retry_after_ms`` (node-overloaded only) is a resubmission hint the
+    RPC layer forwards as ``retryAfterMs``."""
 
-    def __init__(self, message: str, code: str = "tx-invalid"):
+    def __init__(self, message: str, code: str = "tx-invalid", retry_after_ms: int | None = None):
         super().__init__(message)
         self.code = code
+        self.retry_after_ms = retry_after_ms
 
 
 @dataclass

@@ -25,6 +25,7 @@ _TEMPLATE_REBUILD_MS = REGISTRY.histogram(
     (0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0),
     help="block-template rebuild latency (frontier selection + build), milliseconds",
 )
+from kaspa_tpu.observability.shed import SHED as _SHED  # noqa: E402  (family declared once there)
 
 
 @dataclass
@@ -46,14 +47,22 @@ class TemplateCache:
     lifetime: float = 1.0  # seconds
     debounce: float = 0.0  # min seconds between tx-churn-driven rebuilds
     dirty: bool = False
+    # CRITICAL-brownout deferral: extra grace past lifetime/debounce during
+    # which a stale-but-mineable template keeps serving instead of paying a
+    # rebuild (bounded staleness: hard ceiling lifetime + defer_grace).
+    # clear() is unaffected — an *invalid* template never survives.
+    defer_grace: float = 0.0
 
     def get(self):
         if self.template is None:
             return None
         age = time.monotonic() - self.created
-        if age >= self.lifetime:
+        if age >= self.lifetime + self.defer_grace:
             return None
-        if self.dirty and age >= self.debounce:
+        if age >= self.lifetime or (self.dirty and age >= self.debounce):
+            if self.defer_grace > 0.0:
+                _SHED.inc("template_deferral")
+                return self.template
             return None
         return self.template
 
@@ -102,6 +111,12 @@ class MiningManager:
             seed=seed,
         )
         self.template_cache = TemplateCache(debounce=template_debounce)
+
+    def set_template_deferral(self, grace_s: float) -> None:
+        """Brownout seam: serve stale-but-mineable templates for up to
+        ``grace_s`` past their normal rebuild point (0 restores normal
+        rebuild behavior).  Block acceptance still clears unconditionally."""
+        self.template_cache.defer_grace = max(0.0, float(grace_s))
 
     # --- fee estimation (manager.rs get_realtime_feerate_estimations) ---
 

@@ -27,7 +27,7 @@ class SyncRateRule:
 
     def __init__(self):
         self.use_sync_rate_rule = False
-        self._samples: deque[tuple[int, float]] = deque()
+        self._samples: deque[tuple[int, float]] = deque()  # graftlint: allow(unbounded-queue) -- trimmed to the sliding window by check_rule on every sample
         self._total_received = 0
         self._total_expected = 0.0
         self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- leaf difficulty-stats guard; never nests

@@ -301,6 +301,10 @@ class ConnectionPump:
             code = getattr(e, "code", None)
             if code:
                 resp["error_code"] = code
+            # node-overloaded brownout sheds carry a resubmission hint
+            retry_ms = getattr(e, "retry_after_ms", None)
+            if retry_ms:
+                resp["retryAfterMs"] = int(retry_ms)
         return (json.dumps(resp) + "\n").encode()
 
     def close(self) -> None:
@@ -460,6 +464,29 @@ class Daemon:
         from kaspa_tpu.serving import Broadcaster
 
         self.broadcaster = Broadcaster(self.rpc.notifier)
+        # node-wide overload-control plane (resilience/overload.py): samples
+        # pressure on its own ticker, engages brownout actions through the
+        # subsystem seams.  The mining facade is rebuilt on consensus
+        # staging swaps, so signals/actions reach it through a live proxy
+        # instead of capturing the bootstrap instance.
+        from kaspa_tpu.resilience.overload import build_controller
+
+        daemon_self = self
+
+        class _MiningProxy:
+            @property
+            def mempool(self):
+                return daemon_self.node.mining.mempool
+
+            def set_template_deferral(self, grace_s: float) -> None:
+                daemon_self.node.mining.set_template_deferral(grace_s)
+
+        self.overload = build_controller(
+            mining=_MiningProxy(),
+            tier=self.node.ingest,
+            broadcaster=self.broadcaster,
+            node=self.node,
+        )
         from kaspa_tpu.mining import MiningRuleEngine
 
         allow_unsynced = getattr(args, "enable_unsynced_mining", None)
@@ -969,6 +996,7 @@ class Daemon:
 
         supervisor.install()
         self._supervised = True
+        self.overload.start(interval_s=0.5)
         self.core.start()
         seeds = getattr(self.args, "dnsseed", []) or []
         if seeds:
@@ -1000,6 +1028,10 @@ class Daemon:
         return peer
 
     def stop(self) -> None:
+        # overload ticker first: brownout actions must not re-engage while
+        # the subsystems they reach into are being torn down below
+        if getattr(self, "overload", None) is not None:
+            self.overload.shutdown()
         self.core.shutdown()  # reverse bind order: p2p, rpc, tick (blocks
         # until services are down, even when another thread began the stop)
         # drain asynchronous validation work before the db handle goes away:
@@ -1075,8 +1107,9 @@ class NotificationClient:
         self._sock = socket.create_connection((host, int(port)), timeout=timeout)
         self._rfile = self._sock.makefile("rb")
         self._timeout = timeout
+        # graftlint: allow(unbounded-queue) -- client-side helper; one request in flight, reader thread drains
         self._responses: _queue.Queue = _queue.Queue()
-        self.notifications: _queue.Queue = _queue.Queue()
+        self.notifications: _queue.Queue = _queue.Queue()  # graftlint: allow(unbounded-queue) -- client-side helper for tests/CLI; consumer polls per scripted step
         self._next_id = 0
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="rpc-notify-reader")

@@ -189,7 +189,7 @@ class FlightRecorder:
         self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- flight-recorder ring leaf, taken inside every span under arbitrary ranks
         self._open: dict[str, dict] = {}
         self._done: dict[str, dict] = {}  # ring members, addressable for late spans
-        self._ring: deque = deque()
+        self._ring: deque = deque()  # graftlint: allow(unbounded-queue) -- trimmed to _ring_max on every seal
         self._ring_max = 256
         self._enabled = False
         self.dump_dir: str | None = None

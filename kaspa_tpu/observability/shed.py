@@ -1,0 +1,22 @@
+"""The shared ``overload_shed`` counter family: one declaration site.
+
+Every brownout seam (ops/dispatch.py, ingest/tier.py, serving/broadcaster.py,
+mempool/mining_manager.py, p2p/node.py, resilience/overload.py) increments
+the same family under its own action label:
+
+    dispatch_yield      standalone-tx chunk held back for block-verify work
+    ingest_shed         tx rejected at admission with ``node-overloaded``
+    fanout_conflation   utxos-changed diffs merged for a slow subscriber
+    inv_damping         tx INV relay suppressed under SATURATED
+    template_deferral   stale-but-mineable template served past rebuild point
+
+The registry's get-or-create is idempotent, but the registry-hygiene rule
+is one name, one declaration — so the family lives here (observability is
+below every subsystem; no import cycles) and seams import SHED.
+"""
+
+from kaspa_tpu.observability.core import REGISTRY
+
+SHED = REGISTRY.counter_family(
+    "overload_shed", "action", help="work shed/deferred by brownout actions, per action"
+)

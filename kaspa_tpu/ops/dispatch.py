@@ -105,6 +105,7 @@ _FLUSHES = REGISTRY.counter_family(
 _COALESCED_JOBS = REGISTRY.counter_family(
     "dispatch_coalesced_jobs", "kind", help="verify jobs routed through the coalescing queue"
 )
+from kaspa_tpu.observability.shed import SHED as _SHED
 
 
 class DispatchTimeout(TimeoutError):
@@ -183,6 +184,7 @@ class _Chunk:
     ctx: object = None
     enqueued_ns: int = 0
     resolved: bool = False  # guarded by the engine lock: first finish wins
+    deferred: bool = False  # held back at least once by class-yield scheduling
 
 
 class CoalescingDispatcher:
@@ -200,6 +202,13 @@ class CoalescingDispatcher:
         self._lock = ranked_lock("dispatch.queue", reentrant=False)
         self._wake = self._lock.condition()
         self._idle = self._lock.condition()
+        # class-yield brownout seam: traffic classes in this set are held
+        # back from flushes while non-yield work is pending, each chunk for
+        # at most _starvation_s (the starvation bound) — the overload
+        # controller points this at TX_CLASS under pressure so block-verify
+        # super-batches keep the device to themselves
+        self._yield_classes: frozenset[str] = frozenset()
+        self._starvation_s = 0.25
         self._pending: list[_Chunk] = []  # staging buffer (swapped at flush)
         self._inflight: list[_Chunk] = []  # swapped out, not yet resolved
         self._urgent = False
@@ -287,6 +296,32 @@ class CoalescingDispatcher:
             self._finish(c, None, err)
         return len(victims)
 
+    def set_class_yield(self, classes, starvation_s: float = 0.25) -> None:
+        """Make the given traffic classes yield to other pending work.
+        A yielded chunk is excluded from flush decisions while non-yield
+        chunks are pending, but never for longer than ``starvation_s``
+        (the starvation bound) — block floods cannot starve txs forever.
+        Empty/None restores plain FIFO coalescing."""
+        with self._lock:
+            self._yield_classes = frozenset(classes or ())
+            self._starvation_s = max(0.0, float(starvation_s))
+            self._wake.notify()
+
+    def pressure(self) -> dict:
+        """Per-traffic-class backlog snapshot for the overload controller:
+        pending+inflight job counts and the oldest pending chunk age."""
+        with self._lock:
+            now = time.monotonic()
+            per: dict[str, dict] = {}
+            for c in self._pending:
+                d = per.setdefault(traffic_class(c.kind), {"jobs": 0, "oldest_age_s": 0.0})
+                d["jobs"] += len(c.items)
+                d["oldest_age_s"] = max(d["oldest_age_s"], now - c.enqueued_at)
+            for c in self._inflight:
+                d = per.setdefault(traffic_class(c.kind), {"jobs": 0, "oldest_age_s": 0.0})
+                d["jobs"] += len(c.items)
+            return per
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -300,6 +335,8 @@ class CoalescingDispatcher:
                 "inflight_chunks": len(self._inflight),
                 "unresolved_chunks": self._unresolved,
                 "abandoned": self._abandoned,
+                "yield_classes": sorted(self._yield_classes),
+                "starvation_ms": round(self._starvation_s * 1000, 3),
             }
 
     # -- dispatcher thread ---------------------------------------------------
@@ -312,28 +349,56 @@ class CoalescingDispatcher:
         spec = self.class_specs.get(traffic_class(kind))
         return spec[1] if spec is not None else self.max_age_s
 
-    def _flush_reason_locked(self, now: float) -> str | None:
-        if not self._pending:
+    def _eligible_locked(self, now: float) -> tuple[list[_Chunk], list[_Chunk]]:
+        """Split staged chunks into (eligible, held) under class-yield.
+        A chunk is held only while (a) its traffic class yields, (b) some
+        non-yield chunk is pending (otherwise there is nothing to yield
+        to), and (c) it is younger than the starvation bound.  Drain
+        bypasses yielding entirely — shutdown flushes everything."""
+        if not self._yield_classes or self._closed:
+            return self._pending, []
+        if not any(traffic_class(c.kind) not in self._yield_classes for c in self._pending):
+            return self._pending, []
+        eligible: list[_Chunk] = []
+        held: list[_Chunk] = []
+        for c in self._pending:
+            if (
+                traffic_class(c.kind) in self._yield_classes
+                and now - c.enqueued_at < self._starvation_s
+            ):
+                held.append(c)
+            else:
+                eligible.append(c)
+        return eligible, held
+
+    def _flush_reason_locked(self, now: float, eligible: list[_Chunk]) -> str | None:
+        if not eligible:
             return None
         if self._closed:
             return "drain"
         if self._urgent:
             return "nudge"
         per_kind: dict[str, int] = {}
-        for c in self._pending:
+        for c in eligible:
             per_kind[c.kind] = per_kind.get(c.kind, 0) + len(c.items)
         if any(n >= self._target_for(k) for k, n in per_kind.items()):
             return "size"
-        if any(now - c.enqueued_at >= self._age_for(c.kind) for c in self._pending):
+        if any(now - c.enqueued_at >= self._age_for(c.kind) for c in eligible):
             return "age"
         return None
 
-    def _next_age_deadline_locked(self, now: float) -> float:
-        """Seconds until the earliest chunk ages out (the sleep bound)."""
-        return max(
-            0.0,
-            min(self._age_for(c.kind) - (now - c.enqueued_at) for c in self._pending),
-        )
+    def _next_age_deadline_locked(self, now: float, held: list[_Chunk]) -> float:
+        """Seconds until the earliest chunk becomes actionable (the sleep
+        bound).  A held chunk's deadline is its starvation bound, not its
+        flush age — otherwise an expired flush age on a held chunk makes
+        this 0 and the loop busy-spins until the starvation bound."""
+        held_ids = {id(c) for c in held}  # _Chunk is unhashable (dataclass eq)
+        deadlines = [
+            (self._starvation_s if id(c) in held_ids else self._age_for(c.kind))
+            - (now - c.enqueued_at)
+            for c in self._pending
+        ]
+        return max(0.0, min(deadlines))
 
     def _run(self) -> None:
         while True:
@@ -346,19 +411,26 @@ class CoalescingDispatcher:
                         # a stale nudge with nothing queued must not force
                         # the next lone chunk into a depth-1 flush
                         self._urgent = False
-                    reason = self._flush_reason_locked(now)
+                    eligible, held = self._eligible_locked(now)
+                    reason = self._flush_reason_locked(now, eligible)
                     if reason is not None:
                         break
                     if self._closed and not self._pending:
                         return
                     if self._pending:
-                        # sleep only until the earliest chunk ages out
-                        self._wake.wait(self._next_age_deadline_locked(now))
+                        # sleep only until the earliest chunk is actionable
+                        self._wake.wait(self._next_age_deadline_locked(now, held))
                     else:
                         self._wake.wait()
-                # double-buffer swap: donate the staged chunks to this flush
-                # cycle; producers refill a fresh buffer while XLA runs below
-                taken, self._pending = self._pending, []
+                # double-buffer swap: donate the eligible chunks to this
+                # flush cycle (held chunks stay staged for a later flush);
+                # producers refill a fresh buffer while XLA runs below
+                for c in held:
+                    if not c.deferred:
+                        c.deferred = True
+                        _SHED.inc("dispatch_yield")
+                taken = eligible
+                self._pending = held
                 self._inflight.extend(taken)
                 self._urgent = False
             self._dispatch(taken, reason)

@@ -117,6 +117,7 @@ _MISBEHAVIOR_POINTS = REGISTRY.counter_family(
 )
 _PEERS_BANNED = REGISTRY.counter("p2p_peers_banned", help="peers that crossed the ban-score threshold")
 _IBD_TIMEOUTS = REGISTRY.counter("p2p_ibd_timeouts", help="in-flight syncs abandoned for lack of progress")
+from kaspa_tpu.observability.shed import SHED as _SHED  # noqa: E402  (family declared once there)
 
 # serve-side SMT snapshot lifetime (prune_caches): a snapshot nobody has
 # requested for the TTL is dead weight (it holds the full lane/segment
@@ -232,6 +233,13 @@ class Node:
         # admits every concurrently-queued entrant in one wave with a single
         # coalesced verify dispatch (the standalone_tx traffic class)
         self.ingest = IngestTier(self.mining, lock=self.lock)
+        # INV-relay damping (resilience/overload.py brownout seam): while
+        # set, outbound tx INVs are suppressed — peers re-learn the pool
+        # from post-recovery gossip; block relay is never damped
+        self.relay_damping = False
+
+    def set_relay_damping(self, active: bool) -> None:
+        self.relay_damping = bool(active)
 
     @property
     def consensus(self) -> Consensus:
@@ -330,6 +338,10 @@ class Node:
                 peer.send(MSG_INV_BLOCK, block.hash)
 
     def broadcast_tx(self, tx) -> None:
+        if self.relay_damping:
+            if self.peers:
+                _SHED.inc("inv_damping")
+            return
         for peer in list(self.peers):
             if tx.id() not in peer.known_txs:
                 peer.known_txs.add(tx.id())
@@ -789,6 +801,9 @@ class Node:
             "tx-rbf-rejected",
         ):
             banned = self.score_misbehavior(peer, "tx_double_spend", TX_DOUBLE_SPEND_POINTS)
+        # everything else — including code "node-overloaded" (OUR brownout
+        # shed the relay, the peer did nothing wrong) — stays unscored
+        # alongside duplicates, fee floors and ingest backpressure
         if banned and hasattr(peer, "close"):
             peer.close()
 

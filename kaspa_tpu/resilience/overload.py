@@ -1,0 +1,589 @@
+"""Adaptive overload control: node-wide pressure levels + brownout actions.
+
+ROADMAP item 1 wants true 10 BPS *under load*; PR 12's evidence shows the
+node simply falls behind when pushed (flood replay compute-bound, template
+rebuild cost growing 10x with pool occupancy) because nothing decides what
+to sacrifice when the verify plane saturates.  Fixed-throughput verify
+engines make admission arbitration explicit — a pipeline sized to a hard
+ceiling must shed above it.  This module is that arbiter for the whole
+node: consensus-critical block verification holds cadence while mempool
+admission, serving fanout, relay, and template rebuilds degrade
+*deliberately* — shed early, shed cheap, recover cleanly.
+
+Architecture:
+
+- ``PressureSignal``: one scalar pressure source (queue depth, occupancy,
+  windowed latency) with per-level *enter* thresholds and hysteresis
+  *exit* thresholds (``enter * exit_ratio``) so a value oscillating around
+  one boundary cannot flap the level.
+- ``OverloadController``: samples every signal (lock-free — signal reads
+  take only their own subsystem locks), takes the max requested level
+  across signals, and moves the node level at most ONE step per decision:
+  escalate after ``rise_samples`` consecutive over-threshold samples,
+  de-escalate after ``fall_samples`` consecutive clear samples.  Level
+  state lives under the rank-8 ``overload.state`` lock (below every
+  subsystem lock its actions touch).
+- ``BrownoutAction``: declarative (name, level, engage, release) entries.
+  Actions are applied OUTSIDE the controller lock, after the level
+  decision, by the single sampling thread — engage(level) fires for every
+  action at or below the new level (re-fired on each level change so
+  actions can tune per level), release() when the level drops below.
+
+Levels: NOMINAL -> ELEVATED -> SATURATED -> CRITICAL.
+
+Observability: ``overload_transitions{to}`` counter, the shared
+``overload_shed{action}`` family (each subsystem increments its own
+label), an ``overload`` REGISTRY collector (level gauge + signal values
+-> getMetrics / kaspa_overload_level in Prometheus), and one flight trace
+("overload") that accumulates a retroactive span per level dwell —
+sealed into the ring at ``shutdown()``.
+
+Every shed still resolves its AdmissionTicket: brownouts reject or defer,
+they never lose work — ``stats()["lost"] == 0`` stays invariant.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from time import perf_counter_ns
+
+from kaspa_tpu.observability import flight, trace
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.utils.sync import ranked_lock
+
+NOMINAL, ELEVATED, SATURATED, CRITICAL = 0, 1, 2, 3
+LEVELS = ("NOMINAL", "ELEVATED", "SATURATED", "CRITICAL")
+
+_TRANSITIONS = REGISTRY.counter_family(
+    "overload_transitions", "to", help="overload level transitions, by destination level"
+)
+from kaspa_tpu.observability.shed import SHED as _SHED
+
+# retained transition records in stats() (ring-bounded; telemetry only)
+_MAX_TRANSITIONS = 64
+
+
+def level_name(level: int) -> str:
+    return LEVELS[max(NOMINAL, min(CRITICAL, int(level)))]
+
+
+@dataclass
+class PressureSignal:
+    """One scalar pressure source with hysteresis thresholds.
+
+    ``enter`` is the (ELEVATED, SATURATED, CRITICAL) ascending threshold
+    triple; a level's *exit* threshold is ``enter * exit_ratio`` — between
+    exit and enter the signal votes to HOLD the level it already reached
+    but never to enter it, which is what stops boundary noise flapping.
+    """
+
+    name: str
+    read: object  # () -> float; exceptions read as 0.0 (signal absent)
+    enter: tuple  # ascending thresholds for ELEVATED, SATURATED, CRITICAL
+    exit_ratio: float = 0.7
+
+    def classify(self, value: float) -> tuple[int, int]:
+        """(enter_level, hold_level) this value votes for."""
+        up = hold = NOMINAL
+        for i, thr in enumerate(self.enter):
+            lvl = i + 1
+            if value >= thr:
+                up = lvl
+            if value >= thr * self.exit_ratio:
+                hold = lvl
+        return up, hold
+
+
+@dataclass
+class BrownoutAction:
+    """One declarative brownout: engaged while node level >= ``level``.
+
+    ``engage(level)`` is re-invoked on every level change at/above the
+    action's level (actions tune themselves per level); ``release()``
+    restores normal behavior.  Both run outside the controller lock on
+    the sampling thread; exceptions are swallowed (a broken action must
+    not wedge the controller).
+    """
+
+    name: str
+    level: int
+    engage: object  # (level: int) -> None
+    release: object  # () -> None
+
+
+class OverloadController:
+    """Hysteresis-damped node pressure level + brownout-action driver.
+
+    Deterministic by construction: given a fixed sequence of signal
+    values (and an injectable ``clock``), the level trace is a pure
+    function of the schedule — the unit tests and the sim drill both
+    rely on this.  ``sample()`` is intended to be called from ONE place
+    (the daemon ticker thread, or the drill loop); concurrent callers
+    are safe for level state but would interleave action application.
+    """
+
+    def __init__(
+        self,
+        signals,
+        actions=(),
+        *,
+        rise_samples: int = 2,
+        fall_samples: int = 3,
+        clock=time.monotonic,
+    ):
+        self.signals = list(signals)
+        self.actions = sorted(actions, key=lambda a: (a.level, a.name))
+        self.rise_samples = max(1, int(rise_samples))
+        self.fall_samples = max(1, int(fall_samples))
+        self._clock = clock
+        self._lock = ranked_lock("overload.state", reentrant=False)
+        self._level = NOMINAL
+        self._up_streak = 0
+        self._down_streak = 0
+        self._samples = 0
+        self._dwell = [0.0, 0.0, 0.0, 0.0]
+        self._dwell_since = clock()
+        self._dwell_since_ns = perf_counter_ns()
+        self._transitions: list[dict] = []
+        self._last_signals: dict[str, dict] = {}
+        # level the actions currently reflect (sampling-thread-only state)
+        self._engaged_level = NOMINAL
+        self._shut = False
+        self._ticker: threading.Thread | None = None
+        self._stop = threading.Event()
+        REGISTRY.register_collector("overload", self._collect)
+
+    # -- sampling state machine ----------------------------------------
+
+    def sample(self) -> int:
+        """One controller decision: read signals, damp, move <=1 level,
+        apply actions.  Returns the level now in force."""
+        readings = []
+        for s in self.signals:  # no lock held: reads take subsystem locks
+            try:
+                v = float(s.read())
+            except Exception:  # noqa: BLE001 - an absent signal reads as no pressure
+                v = 0.0
+            readings.append((s, v))
+        transition = None
+        with self._lock:
+            want = hold = NOMINAL
+            last: dict[str, dict] = {}
+            for s, v in readings:
+                up, hd = s.classify(v)
+                want = max(want, up)
+                hold = max(hold, hd)
+                last[s.name] = {"value": round(v, 3), "votes": LEVELS[up]}
+            self._last_signals = last
+            self._samples += 1
+            if want > self._level:
+                self._up_streak += 1
+                self._down_streak = 0
+                if self._up_streak >= self.rise_samples:
+                    transition = self._set_level_locked(self._level + 1)
+                    self._up_streak = 0
+            elif hold < self._level:
+                self._down_streak += 1
+                self._up_streak = 0
+                if self._down_streak >= self.fall_samples:
+                    transition = self._set_level_locked(self._level - 1)
+                    self._down_streak = 0
+            else:
+                self._up_streak = 0
+                self._down_streak = 0
+            level = self._level
+        if transition is not None:
+            self._record_transition_span(*transition)
+        self._apply_actions(level)
+        return level
+
+    def _set_level_locked(self, new: int):
+        now = self._clock()
+        prev = self._level
+        self._dwell[prev] += now - self._dwell_since
+        t0_ns, t1_ns = self._dwell_since_ns, perf_counter_ns()
+        self._dwell_since = now
+        self._dwell_since_ns = t1_ns
+        self._level = new
+        _TRANSITIONS.inc(LEVELS[new])
+        self._transitions.append(
+            {"at": round(now, 3), "from": LEVELS[prev], "to": LEVELS[new]}
+        )
+        if len(self._transitions) > _MAX_TRANSITIONS:
+            del self._transitions[: len(self._transitions) - _MAX_TRANSITIONS]
+        return prev, new, t0_ns, t1_ns
+
+    def _record_transition_span(self, prev: int, new: int, t0_ns: int, t1_ns: int) -> None:
+        """Level-dwell span on the controller's own flight trace: one span
+        per completed dwell, attributed to the level just left."""
+        if not flight.enabled():
+            return
+        ctx = flight.begin("overload", "overload-controller")
+        if ctx is not None:
+            trace.record_span(
+                f"overload.{LEVELS[prev]}", ctx, t0_ns, t1_ns, to=LEVELS[new]
+            )
+
+    def _apply_actions(self, level: int) -> None:
+        """Engage/release brownout actions to match ``level``.  Runs with
+        NO controller lock held: actions descend into subsystem locks
+        (dispatch.queue, ingest.queue, serving.broadcaster ...) and the
+        rank-8 controller lock sits below all of them."""
+        prev = self._engaged_level
+        if level == prev:
+            return
+        for a in self.actions:
+            try:
+                if level >= a.level:
+                    a.engage(level)  # re-fired per level change: per-level tuning
+                elif prev >= a.level:
+                    a.release()
+            except Exception:  # noqa: BLE001 - a broken action must not wedge control
+                pass
+        self._engaged_level = level
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, interval_s: float = 0.25) -> None:
+        """Spawn the sampling ticker (daemon mode; the sim drill calls
+        sample() itself for determinism)."""
+        if self._ticker is not None:
+            return
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(interval_s):
+                self.sample()
+
+        self._ticker = threading.Thread(target=_run, name="overload-ticker", daemon=True)
+        self._ticker.start()
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        """Stop sampling, release every engaged action, seal the flight
+        trace into the ring."""
+        self._stop.set()
+        t = self._ticker
+        if t is not None:
+            t.join(timeout)
+            self._ticker = None
+        if self._shut:
+            return
+        self._shut = True
+        prev = self._engaged_level
+        for a in self.actions:
+            if prev >= a.level:
+                try:
+                    a.release()
+                except Exception:  # noqa: BLE001
+                    pass
+        self._engaged_level = NOMINAL
+        if flight.enabled() and self._transitions:
+            # seal the dwell-in-progress, then the trace (ring export)
+            with self._lock:
+                t0_ns, lvl = self._dwell_since_ns, self._level
+            self._record_transition_span(lvl, lvl, t0_ns, perf_counter_ns())
+            flight.end("overload", status="shutdown")
+
+    # -- telemetry ------------------------------------------------------
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def stats(self) -> dict:
+        with self._lock:
+            now = self._clock()
+            dwell = list(self._dwell)
+            dwell[self._level] += now - self._dwell_since
+            return {
+                "level": self._level,
+                "level_name": LEVELS[self._level],
+                "samples": self._samples,
+                "up_streak": self._up_streak,
+                "down_streak": self._down_streak,
+                "dwell_seconds": {LEVELS[i]: round(d, 3) for i, d in enumerate(dwell)},
+                "transitions": list(self._transitions),
+                "signals": dict(self._last_signals),
+                "actions": [
+                    {
+                        "name": a.name,
+                        "level": LEVELS[a.level],
+                        "engaged": self._engaged_level >= a.level,
+                    }
+                    for a in self.actions
+                ],
+                "shed": _SHED.snapshot(),
+            }
+
+    def _collect(self) -> dict:
+        """REGISTRY collector: numeric leaves flatten into Prometheus
+        gauges (kaspa_overload_level etc.) and the getMetrics snapshot's
+        ``overload`` section."""
+        with self._lock:
+            return {
+                "level": self._level,
+                "level_name": LEVELS[self._level],
+                "samples": self._samples,
+                "signals": {k: v["value"] for k, v in self._last_signals.items()},
+            }
+
+
+# ---------------------------------------------------------------------------
+# default wiring: the node's standard signal set + brownout registry
+# ---------------------------------------------------------------------------
+
+# (ELEVATED, SATURATED, CRITICAL) enter thresholds per signal.  Tuned on
+# the 200-block --hostile --txflood --overload drill at 10 BPS (see
+# SUSTAIN.json overload block): the flood ramp crosses SATURATED at peak
+# and decays back below every exit threshold once the ramp subsides.
+DEFAULT_THRESHOLDS: dict[str, tuple] = {
+    "mempool": (40, 120, 400),              # pool occupancy (txs)
+    "ingest_queue": (64, 256, 1024),        # queued admission tickets
+    "template_lag_ms": (25.0, 100.0, 400.0),  # windowed rebuild mean
+    "dispatch_tx_backlog": (256, 1024, 4096),  # standalone_tx verify jobs
+    "fanout_depth": (64, 256, 768),         # deepest subscriber queue
+    "commit_wait_ms": (50.0, 200.0, 800.0),  # windowed wait.* critical path
+}
+
+
+def _windowed_hist_mean(hist) -> object:
+    """() -> mean of the histogram's observations since the last call
+    (0.0 when none).  Survives REGISTRY.reset: a count regression just
+    re-anchors the window."""
+    state = {"count": 0, "sum": 0.0}
+
+    def read() -> float:
+        dc = hist.count - state["count"]
+        ds = hist.sum - state["sum"]
+        state["count"], state["sum"] = hist.count, hist.sum
+        return (ds / dc) if dc > 0 else 0.0
+
+    return read
+
+
+def _windowed_wait_mean() -> object:
+    """Windowed mean over the flight recorder's ``wait.*`` critical-path
+    cells — commit-lock and queue-handoff pressure as the blocks actually
+    experienced it."""
+    state: dict[str, tuple] = {}
+
+    def read() -> float:
+        total_dc, total_ds = 0, 0.0
+        for name, h in list(flight.CRIT_HIST._cells.items()):
+            if not name.startswith("wait."):
+                continue
+            pc, ps = state.get(name, (0, 0.0))
+            dc, ds = h.count - pc, h.sum - ps
+            state[name] = (h.count, h.sum)
+            if dc > 0:
+                total_dc += dc
+                total_ds += ds
+        return (total_ds / total_dc) if total_dc else 0.0
+
+    return read
+
+
+def default_signals(
+    *,
+    mining=None,
+    tier=None,
+    broadcaster=None,
+    fanout_depth_fn=None,
+    thresholds: dict | None = None,
+) -> list[PressureSignal]:
+    """The node's standard pressure-signal set, built from whatever
+    subsystems exist (absent ones contribute no signal).  ``thresholds``
+    overrides DEFAULT_THRESHOLDS per signal name."""
+    thr = dict(DEFAULT_THRESHOLDS)
+    thr.update(thresholds or {})
+    out: list[PressureSignal] = []
+
+    if mining is not None:
+        out.append(
+            PressureSignal("mempool", lambda: len(mining.mempool.pool), thr["mempool"])
+        )
+        # the histogram is declared by mining_manager (one name, one series);
+        # mining is only ever a MiningManager here, so the module is loaded
+        from kaspa_tpu.mempool.mining_manager import _TEMPLATE_REBUILD_MS
+
+        out.append(
+            PressureSignal(
+                "template_lag_ms",
+                _windowed_hist_mean(_TEMPLATE_REBUILD_MS),
+                thr["template_lag_ms"],
+            )
+        )
+    if tier is not None:
+        out.append(
+            PressureSignal("ingest_queue", lambda: tier.queue.depth(), thr["ingest_queue"])
+        )
+
+    def _tx_backlog() -> float:
+        from kaspa_tpu.ops import dispatch
+
+        eng = dispatch.active()
+        if eng is None:
+            return 0.0
+        return eng.pressure().get(dispatch.TX_CLASS, {}).get("jobs", 0)
+
+    out.append(PressureSignal("dispatch_tx_backlog", _tx_backlog, thr["dispatch_tx_backlog"]))
+
+    if fanout_depth_fn is not None:
+        out.append(PressureSignal("fanout_depth", fanout_depth_fn, thr["fanout_depth"]))
+    elif broadcaster is not None:
+        out.append(
+            PressureSignal("fanout_depth", broadcaster.max_queue_depth, thr["fanout_depth"])
+        )
+
+    out.append(PressureSignal("commit_wait_ms", _windowed_wait_mean(), thr["commit_wait_ms"]))
+    return out
+
+
+@dataclass
+class BrownoutKnobs:
+    """Per-level tuning for the default action registry."""
+
+    # ingest lane capacity clamp per level (ELEVATED shrinks, deeper
+    # levels shrink harder); None entries leave the configured capacity
+    ingest_caps: dict = field(
+        default_factory=lambda: {ELEVATED: 2048, SATURATED: 256, CRITICAL: 32}
+    )
+    # retry-after hint (ms) on node-overloaded rejections, per level
+    retry_after_ms: dict = field(default_factory=lambda: {SATURATED: 500, CRITICAL: 2000})
+    # standalone_tx starvation bound under class-yield, per level
+    yield_starvation_s: dict = field(default_factory=lambda: {ELEVATED: 0.25, SATURATED: 0.5, CRITICAL: 1.0})
+    # subscriber queue depth at which utxos-changed diffs conflate
+    conflate_floor: dict = field(default_factory=lambda: {ELEVATED: 64, SATURATED: 16, CRITICAL: 4})
+    # template staleness grace under CRITICAL (seconds past normal rebuild)
+    template_grace_s: float = 2.0
+
+
+def _per_level(table: dict, level: int):
+    """Highest entry at or below ``level`` (actions engage at their own
+    level and keep tightening as the node escalates)."""
+    best = None
+    for lvl in sorted(table):
+        if lvl <= level:
+            best = table[lvl]
+    return best
+
+
+def default_actions(
+    *,
+    tier=None,
+    broadcaster=None,
+    node=None,
+    mining=None,
+    knobs: BrownoutKnobs | None = None,
+) -> list[BrownoutAction]:
+    """The node's standard brownout registry, wired through existing
+    seams.  Order of engagement as pressure rises:
+
+      ELEVATED:  dispatch class-yield (txs yield to block verify),
+                 ingest lane caps shrink, fanout diff-conflation arms
+      SATURATED: ingest rejects with node-overloaded (+ retryAfterMs),
+                 INV tx-relay damping
+      CRITICAL:  template-rebuild deferral (bounded staleness grace)
+    """
+    k = knobs or BrownoutKnobs()
+    out: list[BrownoutAction] = []
+
+    def _yield_engage(level: int) -> None:
+        from kaspa_tpu.ops import dispatch
+
+        eng = dispatch.active()
+        if eng is not None:
+            eng.set_class_yield(
+                {dispatch.TX_CLASS}, _per_level(k.yield_starvation_s, level) or 0.25
+            )
+
+    def _yield_release() -> None:
+        from kaspa_tpu.ops import dispatch
+
+        eng = dispatch.active()
+        if eng is not None:
+            eng.set_class_yield(())
+
+    out.append(BrownoutAction("dispatch_yield", ELEVATED, _yield_engage, _yield_release))
+
+    if tier is not None:
+        out.append(
+            BrownoutAction(
+                "ingest_caps",
+                ELEVATED,
+                lambda level: tier.queue.set_capacity_limit(_per_level(k.ingest_caps, level)),
+                lambda: tier.queue.set_capacity_limit(None),
+            )
+        )
+        out.append(
+            BrownoutAction(
+                "ingest_shed",
+                SATURATED,
+                lambda level: tier.set_overload(
+                    True, _per_level(k.retry_after_ms, level) or 500
+                ),
+                lambda: tier.set_overload(False),
+            )
+        )
+    if broadcaster is not None:
+        out.append(
+            BrownoutAction(
+                "fanout_conflation",
+                ELEVATED,
+                lambda level: broadcaster.set_conflation(_per_level(k.conflate_floor, level)),
+                lambda: broadcaster.set_conflation(None),
+            )
+        )
+    if node is not None:
+        out.append(
+            BrownoutAction(
+                "inv_damping",
+                SATURATED,
+                lambda level: node.set_relay_damping(True),
+                lambda: node.set_relay_damping(False),
+            )
+        )
+    if mining is not None:
+        out.append(
+            BrownoutAction(
+                "template_deferral",
+                CRITICAL,
+                lambda level: mining.set_template_deferral(k.template_grace_s),
+                lambda: mining.set_template_deferral(0.0),
+            )
+        )
+    return out
+
+
+def build_controller(
+    *,
+    mining=None,
+    tier=None,
+    broadcaster=None,
+    node=None,
+    fanout_depth_fn=None,
+    thresholds: dict | None = None,
+    knobs: BrownoutKnobs | None = None,
+    rise_samples: int = 2,
+    fall_samples: int = 3,
+    clock=time.monotonic,
+) -> OverloadController:
+    """Standard node wiring: default signals + default actions over
+    whatever subsystems exist."""
+    return OverloadController(
+        default_signals(
+            mining=mining,
+            tier=tier,
+            broadcaster=broadcaster,
+            fanout_depth_fn=fanout_depth_fn,
+            thresholds=thresholds,
+        ),
+        default_actions(
+            tier=tier, broadcaster=broadcaster, node=node, mining=mining, knobs=knobs
+        ),
+        rise_samples=rise_samples,
+        fall_samples=fall_samples,
+        clock=clock,
+    )

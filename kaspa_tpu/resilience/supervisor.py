@@ -142,7 +142,7 @@ class _Worker(threading.Thread):
     def __init__(self, pool: "WorkerPool"):
         super().__init__(name=f"secp-supervised-{next(self._ids)}", daemon=True)
         self._pool = pool
-        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._q: queue.SimpleQueue = queue.SimpleQueue()  # graftlint: allow(unbounded-queue) -- one job in flight per supervised worker by construction (submit awaits the verdict)
 
     def submit(self, job: _Job) -> None:
         self._q.put(job)

@@ -25,7 +25,9 @@ byte-identical ``deterministic`` sections.
 from __future__ import annotations
 
 import json
+import platform
 import random
+import sys
 import time
 from dataclasses import asdict, replace
 
@@ -118,6 +120,35 @@ def _orphan_tolerant_replay(consensus: Consensus, blocks: list, seed: int, windo
     assert not pending, f"{len(pending)} orphans never became insertable"
 
 
+def run_meta(wall: dict | None = None) -> dict:
+    """Volatile per-run facts (timestamp, host, interpreter, wall-clock
+    telemetry), quarantined under ONE artifact key so diffing two runs of
+    the same workload+schedule+seed (``stable_view``) ignores them
+    wholesale instead of chasing churn field by field."""
+    return {
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": platform.node(),
+        "python": sys.version.split()[0],
+        "wall": wall or {},
+    }
+
+
+def stable_view(report: dict) -> dict:
+    """The diffable surface of a SUSTAIN-family artifact: everything but
+    ``run_meta``.  (``metrics`` stays — its throughput numbers are the
+    run's headline, reviewed rather than diffed.)"""
+    return {k: v for k, v in report.items() if k != "run_meta"}
+
+
+def _split_breaker(snapshot: dict) -> tuple[dict, dict]:
+    """(stable fields, volatile fields) of a breaker snapshot: recovery
+    latencies and timestamped transition records differ every run and
+    belong under ``run_meta.wall``."""
+    snap = dict(snapshot)
+    wall = {k: snap.pop(k) for k in ("recovery_latency_seconds", "transitions") if k in snap}
+    return snap, wall
+
+
 def _counter_value(counters: dict, name: str):
     v = counters.get(name, 0)
     return dict(v) if isinstance(v, dict) else v
@@ -168,6 +199,7 @@ def run_sustain(
     after = REGISTRY.snapshot()["counters"]
     fp = _fingerprints(faulted)
 
+    brk_stable, brk_wall = _split_breaker(breaker.snapshot())
     report = {
         "config": {**asdict(cfg), "fault_seed": seed, "schedule": schedule},
         "deterministic": {
@@ -177,14 +209,14 @@ def run_sustain(
             "fault_free_fingerprints": base_fp,
             "matches_fault_free": fp == base_fp,
         },
-        "breaker": breaker.snapshot(),
+        "breaker": brk_stable,
         "metrics": {
             "replay_seconds": round(elapsed, 3),
             "blocks_per_sec": round(len(blocks) / elapsed, 2) if elapsed else None,
             "fault_injections": _delta(before, after, "fault_injections"),
             **{name: _delta(before, after, name) for name in _DELTA_COUNTERS},
         },
-        "lock_traces": lock_trace_snapshot(),
+        "run_meta": run_meta(wall={"breaker": brk_wall, "lock_traces": lock_trace_snapshot()}),
     }
     if out:
         with open(out, "w") as f:
@@ -351,7 +383,8 @@ def run_wedge_drill(
         late_seen = _await_late_results(
             injected, pool_before["late_results"], timeout_s=hang_delay_s + 10.0
         )
-        brk_snap = breaker.snapshot()  # while supervision (managed) is live
+        # snapshot while supervision (managed) is live
+        brk_stable, brk_wall = _split_breaker(breaker.snapshot())
     finally:
         FAULTS.clear()
         supervisor.shutdown()
@@ -403,7 +436,7 @@ def run_wedge_drill(
         },
         "compile_stall": compile_stall,
         "tickets": tickets,
-        "breaker": brk_snap,
+        "breaker": brk_stable,
         "kernel_cache": supervisor.cache_report(),
         "metrics": {
             "replay_seconds": round(elapsed, 3),
@@ -411,6 +444,7 @@ def run_wedge_drill(
             "fault_injections": _delta(before, after, "fault_injections"),
             **{name: _delta(before, after, name) for name in _DELTA_COUNTERS},
         },
+        "run_meta": run_meta(wall={"breaker": brk_wall}),
     }
     if out:
         with open(out, "w") as f:

@@ -35,6 +35,7 @@ lost-ticket count (must be 0: every submission resolves exactly once).
 from __future__ import annotations
 
 import json
+import queue
 import random
 import time
 from collections import deque
@@ -53,17 +54,24 @@ from kaspa_tpu.consensus.processes.coinbase import MinerData
 from kaspa_tpu.crypto import eclib
 from kaspa_tpu.ingest.queue import SOURCE_P2P, SOURCE_RPC
 from kaspa_tpu.ingest.tier import ACCEPTED, ORPHANED, IngestTier
+from kaspa_tpu.mempool.mempool import MempoolConfig
 from kaspa_tpu.mempool.mining_manager import _TEMPLATE_REBUILD_MS, MiningManager
+from kaspa_tpu.notify.notifier import Notification
 from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.observability.shed import SHED
 from kaspa_tpu.resilience.breaker import device_breaker
 from kaspa_tpu.resilience.faults import FAULTS
+from kaspa_tpu.resilience.overload import LEVELS, NOMINAL, SATURATED, build_controller
 from kaspa_tpu.resilience.sustain import (
     _DELTA_COUNTERS,
     _delta,
     _fingerprints,
     _insert,
+    _split_breaker,
     default_schedule,
+    run_meta,
 )
+from kaspa_tpu.serving.broadcaster import Subscriber
 from kaspa_tpu.sim.simulator import Miner, SimConfig, simulate
 from kaspa_tpu.txscript import standard
 
@@ -105,11 +113,13 @@ class FloodStream:
         self.mass_calc = consensus.transaction_validator.mass_calculator
         self.spent: set[TransactionOutpoint] = set()
         self._recent: deque = deque(maxlen=32)  # (outpoint, entry, seckey) of clean spends
+        self.last_build_s = 0.0  # adversary tx-construction cost of the last slot
         self.counters: dict[str, int] = {"submitted": 0, "evicted": 0, "other": 0}
         for k in self._KINDS:
             self.counters[f"{k}_submitted"] = 0
         for k in ("clean_accepted", "double_spend_rejected", "double_spend_landed",
-                  "orphan_parked", "rbf_replaced", "rbf_opened", "rbf_rejected"):
+                  "orphan_parked", "rbf_replaced", "rbf_opened", "rbf_rejected",
+                  "overload_rejected"):
             self.counters[k] = 0
 
     # -- candidate UTXOs -----------------------------------------------
@@ -176,20 +186,27 @@ class FloodStream:
         tx._id_cache = None
         return tx
 
-    def _build_slot(self) -> list[tuple[str, Transaction]]:
+    def _build_slot(self, scale: float = 1.0) -> list[tuple[str, Transaction]]:
         f = self.flood
-        cands = self._candidates(f.clean_per_block + f.rbf_per_block + 2)
+        # overload-ramp hook: every per-slot rate scales together, so the
+        # adversary's tx mix keeps its shape as the flood intensifies
+        # (scale=1.0 reproduces the unscaled slot exactly)
+        n_clean = int(round(f.clean_per_block * scale))
+        n_ds = int(round(f.double_spend_per_block * scale))
+        n_rbf = int(round(f.rbf_per_block * scale))
+        n_orph = int(round(f.orphans_per_block * scale))
+        cands = self._candidates(n_clean + n_rbf + 2)
         plan: list[tuple[str, Transaction]] = []
         # reserve rbf/orphan candidates from the tail so a thin UTXO set
         # (early run, post-reorg) doesn't let the clean loop starve them
-        n_reserve = min(f.rbf_per_block + (1 if f.orphans_per_block else 0), max(len(cands) - 1, 0))
+        n_reserve = min(n_rbf + (1 if n_orph else 0), max(len(cands) - 1, 0))
         reserve = [cands.pop() for _ in range(n_reserve)]
         # double-spend targets: clean spends from *previous* slots only —
         # the source-lane round-robin may reorder a same-slot conflict
         # ahead of its clean target inside the wave
         targets = list(self._recent)
 
-        for _ in range(f.clean_per_block):
+        for _ in range(n_clean):
             got = self._take(cands)
             if got is None:
                 break
@@ -201,7 +218,7 @@ class FloodStream:
             self._recent.append(got)
             plan.append(("clean", tx))
 
-        for _ in range(f.double_spend_per_block):
+        for _ in range(n_ds):
             if not targets:
                 break
             outpoint, entry, seckey = targets[self.rng.randrange(len(targets))]
@@ -210,7 +227,7 @@ class FloodStream:
                 if tx is not None:
                     plan.append(("double_spend", tx))
 
-        for _ in range(f.rbf_per_block):
+        for _ in range(n_rbf):
             got = self._take(reserve) or self._take(cands)
             if got is None:
                 break
@@ -221,7 +238,7 @@ class FloodStream:
                 if tx is not None:
                     plan.append(("rbf", tx))
 
-        if f.orphans_per_block:
+        if n_orph:
             got = self._take(reserve) or self._take(cands)
             if got is not None:
                 outpoint, entry, seckey = got
@@ -230,7 +247,7 @@ class FloodStream:
                 if parent is not None:
                     pov = self.consensus.get_virtual_daa_score()
                     n_out = len(parent.outputs)
-                    for k in range(f.orphans_per_block):
+                    for k in range(n_orph):
                         out = parent.outputs[k % n_out]
                         ghost = UtxoEntry(out.value, out.script_public_key, pov, False)
                         child = self._spend(
@@ -243,10 +260,15 @@ class FloodStream:
 
     # -- submission + outcome accounting --------------------------------
 
-    def step(self, tier: IngestTier) -> int:
+    def step(self, tier: IngestTier, scale: float = 1.0) -> int:
         """One block slot's worth of flood: submit everything, pump one
-        batched wave, classify every resolved ticket."""
-        plan = self._build_slot()
+        batched wave, classify every resolved ticket.  ``scale`` multiplies
+        every per-slot rate (the overload ramp); tx-construction wall time
+        lands in ``last_build_s`` so cadence measurement can exclude the
+        adversary's own signing cost."""
+        t_build = time.perf_counter()
+        plan = self._build_slot(scale)
+        self.last_build_s = time.perf_counter() - t_build
         tickets = []
         for i, (kind, tx) in enumerate(plan):
             source = SOURCE_RPC if i % 2 == 0 else SOURCE_P2P
@@ -261,6 +283,11 @@ class FloodStream:
         c["submitted"] += 1
         c[f"{kind}_submitted"] += 1
         code = getattr(t.error, "code", None)
+        if code == "node-overloaded":
+            # brownout shed at admission: counted on its own, outside the
+            # per-kind outcome buckets — the tx never reached the mempool
+            c["overload_rejected"] += 1
+            return
         if kind == "clean" and t.status == ACCEPTED:
             c["clean_accepted"] += 1
         elif kind == "double_spend":
@@ -303,10 +330,20 @@ def _flood_replay(
     seed: int,
     pace_s: float = 0.0,
     window: int = 8,
+    scale_fn=None,
+    on_slot=None,
 ) -> dict:
     """Deliver ``blocks`` in shuffled orphan-tolerant windows (sustain.py
     discipline) with one flood slot + one template poll per block, paced
-    to ``pace_s`` wall seconds per block when set."""
+    to ``pace_s`` wall seconds per block when set.
+
+    The overload drill's hooks: ``scale_fn(slot) -> float`` sets the
+    flood-rate multiplier per slot; ``on_slot(slot, scale) -> level``
+    runs after the slot's node work (samples the controller, drives the
+    drill's slow subscriber) and reports the overload level in force.
+    Per-slot wall time — minus the adversary's tx-build cost and the
+    pacing sleep — lands in ``slot_walls`` so the report can compare
+    cadence at NOMINAL vs SATURATED."""
     rng = random.Random(seed ^ 0x5EED)
     order: list = []
     for i in range(0, len(blocks), window):
@@ -323,15 +360,26 @@ def _flood_replay(
 
     peak_pool = peak_orphans = 0
     pending: dict[bytes, object] = {}
+    slot_walls: list[float] = []
+    slot_levels: list[int] = []
+    slot_scales: list[float] = []
+    slot_plans: list[int] = []
     t0 = time.perf_counter()
     t_next = time.monotonic() + pace_s
-    for b in order:
-        flood.step(tier)
+    for i, b in enumerate(order):
+        scale = scale_fn(i) if scale_fn is not None else 1.0
+        t_slot = time.perf_counter()
+        slot_plans.append(flood.step(tier, scale))
         # poll the template every slot: with debounce on, a flood slot
         # costs one rebuild per debounce window, not one per tx
         mining.get_block_template(flood.miner_data)
         peak_pool = max(peak_pool, len(mining.mempool.pool))
         peak_orphans = max(peak_orphans, len(mining.mempool.orphans))
+        level = on_slot(i, scale) if on_slot is not None else None
+        slot_walls.append(time.perf_counter() - t_slot - flood.last_build_s)
+        slot_scales.append(scale)
+        if level is not None:
+            slot_levels.append(level)
         if pace_s:
             now = time.monotonic()
             if t_next > now:
@@ -354,6 +402,10 @@ def _flood_replay(
         "peak_pool": peak_pool,
         "peak_orphans": peak_orphans,
         "delivery_seconds": time.perf_counter() - t0,
+        "slot_walls": slot_walls,
+        "slot_levels": slot_levels,
+        "slot_scales": slot_scales,
+        "slot_plans": slot_plans,
     }
 
 
@@ -382,6 +434,102 @@ def _rebuild_window(before_counts: list[int], before_count: int, before_sum: flo
     }
 
 
+# --- the overload ramp drill ------------------------------------------------
+
+
+@dataclass
+class OverloadRampConfig:
+    """Flood-rate ramp profile for the overload-control acceptance drill.
+
+    Phases, as fractions of the block count: warm at scale 1.0 (the
+    cadence baseline), linear ramp 1.0 -> ``peak_scale``, hold at peak
+    (where the controller must reach SATURATED and shed), then cooldown
+    at scale 0.0 — recovery back to NOMINAL is part of the run, not an
+    epilogue."""
+
+    peak_scale: float = 8.0
+    warm_frac: float = 0.20
+    ramp_frac: float = 0.25
+    hold_frac: float = 0.30
+    samples_per_slot: int = 2  # controller decisions per block slot
+    rise_samples: int = 2
+    fall_samples: int = 3
+    # per-signal override atop the drill defaults: name -> (elev, sat, crit).
+    # The drill re-tunes fanout_depth below DEFAULT_THRESHOLDS because its
+    # single subscriber's queue is depth-pinned (~conflate floor 64) once
+    # the fanout_conflation action engages at ELEVATED — the SATURATED
+    # enter must sit under that pin or the brownout self-stabilizes one
+    # level early and the drill never proves the saturated regime.
+    thresholds: dict | None = None
+    DRILL_THRESHOLDS = {"fanout_depth": (24, 56, 2000)}
+    expire_daa: int | None = None  # mempool expiry horizon; default max(6, blocks//6)
+    fanout_per_slot: int = 4  # synthetic utxos-changed events per slot at scale 1.0
+
+    def scale_for(self, slot: int, total: int) -> float:
+        if total <= 0:
+            return 1.0
+        frac = slot / total
+        if frac < self.warm_frac:
+            return 1.0
+        if frac < self.warm_frac + self.ramp_frac:
+            t = (frac - self.warm_frac) / self.ramp_frac
+            return 1.0 + t * (self.peak_scale - 1.0)
+        if frac < self.warm_frac + self.ramp_frac + self.hold_frac:
+            return self.peak_scale
+        return 0.0
+
+
+class _BlockedSink:
+    """Subscriber sink that refuses payloads while ``blocked`` — the
+    drill's slow consumer.  The drill blocks it while the flood runs
+    above nominal rate (fanout depth builds, conflation engages) and
+    unblocks it for cooldown so the fanout pressure signal can actually
+    decay.  A blocked put honours ``timeout`` the way a full socket
+    queue would — the subscriber's sender retry loop paces on it."""
+
+    def __init__(self):
+        self.blocked = False
+        self.accepted = 0
+
+    def put(self, item, timeout=None):
+        if self.blocked:
+            if timeout:
+                time.sleep(min(float(timeout), 0.25))
+            raise queue.Full
+        self.accepted += 1
+
+
+class _FanoutShim:
+    """Adapts the drill's single Subscriber to the two broadcaster-facing
+    seams the controller wires: the ``fanout_depth`` pressure signal and
+    the ``fanout_conflation`` brownout action."""
+
+    def __init__(self, sub: Subscriber):
+        self.sub = sub
+
+    def max_queue_depth(self) -> int:
+        return self.sub.queue_depth()
+
+    def set_conflation(self, floor) -> None:
+        self.sub.conflate_floor = floor
+
+
+class _RelayStub:
+    """Records INV-damping engagement.  The drill has no live P2P mesh,
+    so this proves the action fires (and releases) without synthesizing
+    shed counts — real ``inv_damping`` sheds come from the daemon path
+    and the unit tests."""
+
+    def __init__(self):
+        self.damped = False
+        self.engagements = 0
+
+    def set_relay_damping(self, active: bool) -> None:
+        if active and not self.damped:
+            self.engagements += 1
+        self.damped = bool(active)
+
+
 def run_txflood_sustain(
     cfg: SimConfig,
     flood_cfg: TxFloodConfig | None = None,
@@ -390,9 +538,17 @@ def run_txflood_sustain(
     out: str | None = None,
     pace: bool = True,
     template_debounce: float = 0.25,
+    overload: OverloadRampConfig | None = None,
 ) -> dict:
     """The tx-flood sustain benchmark; returns (and optionally writes to
-    ``out``) a SUSTAIN.json-shaped report with the extra ``ingest`` block."""
+    ``out``) a SUSTAIN.json-shaped report with the extra ``ingest`` block.
+
+    With ``overload`` set, the flood ramps per ``OverloadRampConfig``
+    while a live ``OverloadController`` (standard signals + brownout
+    registry, wired to the run's mining/tier plus a drill fanout
+    subscriber and relay stub) is sampled deterministically every slot;
+    the report gains the ``overload`` block (level trace, dwell times,
+    shed counters, NOMINAL-vs-SATURATED cadence, recovery)."""
     schedule = default_schedule() if schedule is None else schedule
     flood_cfg = flood_cfg or TxFloodConfig()
     main = simulate(cfg)
@@ -414,21 +570,145 @@ def run_txflood_sustain(
         _TEMPLATE_REBUILD_MS.sum,
     )
     FAULTS.configure(schedule, seed)
+    controller = sink = sub = relay = None
+    scale_fn = on_slot = None
+    shed_before: dict = {}
     try:
         faulted = Consensus(main.params)
-        mining = MiningManager(faulted, seed=seed, template_debounce=template_debounce)
+        mp_cfg = None
+        if overload is not None:
+            # a scaled-down expiry horizon so pool occupancy admitted at
+            # peak decays during cooldown block deliveries — controller
+            # recovery is gated on the signals genuinely subsiding
+            expire = overload.expire_daa
+            if expire is None:
+                expire = max(6, len(blocks) // 6)
+            mp_cfg = MempoolConfig(transaction_expire_interval_daa_score=expire)
+        mining = MiningManager(
+            faulted, config=mp_cfg, seed=seed, template_debounce=template_debounce
+        )
         tier = IngestTier(mining)
         frng = random.Random(flood_cfg.seed if flood_cfg.seed is not None else cfg.seed ^ 0xF100D)
         flood = FloodStream(faulted, cfg, flood_cfg, frng)
+        if overload is not None:
+            sink = _BlockedSink()
+            sub = Subscriber("overload-drill", lambda n: b"x", sink, maxlen=1_000_000)
+            relay = _RelayStub()
+            drill_thr = dict(OverloadRampConfig.DRILL_THRESHOLDS)
+            drill_thr.update(overload.thresholds or {})
+            controller = build_controller(
+                mining=mining,
+                tier=tier,
+                broadcaster=_FanoutShim(sub),
+                node=relay,
+                thresholds=drill_thr,
+                rise_samples=overload.rise_samples,
+                fall_samples=overload.fall_samples,
+            )
+            shed_before = dict(SHED.snapshot())
+            n_total = len(blocks)
+
+            def scale_fn(i: int) -> float:
+                return overload.scale_for(i, n_total)
+
+            def on_slot(i: int, scale: float) -> int:
+                # drive the drill's slow consumer: keeps up at nominal
+                # rate (clean cadence baseline), falls behind once the
+                # flood ramps, catches up during cooldown
+                sink.blocked = scale > 1.0
+                if scale > 0:
+                    for _ in range(max(1, int(round(overload.fanout_per_slot * scale)))):
+                        sub.offer(
+                            Notification("utxos-changed", {"added": [i], "removed": []}),
+                            time.monotonic(),
+                        )
+                level = NOMINAL
+                for _ in range(max(1, overload.samples_per_slot)):
+                    level = controller.sample()
+                return level
+
         t0 = time.perf_counter()
         replay_stats = _flood_replay(
             faulted, mining, tier, flood, blocks, seed,
             pace_s=(1.0 / cfg.bps) if pace and cfg.bps else 0.0,
+            scale_fn=scale_fn, on_slot=on_slot,
         )
         elapsed = time.perf_counter() - t0
         events = FAULTS.events()
     finally:
         FAULTS.clear()
+    overload_block = None
+    if controller is not None:
+        # post-run settle: the daemon's ticker keeps sampling after load
+        # subsides — give the hysteresis fall path the same chance here,
+        # bounded so a stuck signal fails the recovery gate instead of
+        # hanging the run
+        sink.blocked = False
+        settle_samples = 0
+        settle_budget = 4 * max(1, overload.fall_samples) * 3
+        while controller.level() != NOMINAL and settle_samples < settle_budget:
+            controller.sample()
+            settle_samples += 1
+            time.sleep(0.02)  # let the drill subscriber's sender drain
+        ctrl = controller.stats()
+        controller.shutdown()
+        sub.stop()
+        shed_after = SHED.snapshot()
+        shed = {
+            k: shed_after.get(k, 0) - shed_before.get(k, 0)
+            for k in shed_after
+            if shed_after.get(k, 0) - shed_before.get(k, 0)
+        }
+        walls = replay_stats.pop("slot_walls")
+        levels = replay_stats.pop("slot_levels")
+        scales = replay_stats.pop("slot_scales")
+        plans = replay_stats.pop("slot_plans")
+        # cadence baseline: nominal slots where the flood actually built
+        # work — the early supply-starved slots (coinbase maturity) do
+        # near-zero work and would deflate the denominator
+        nom_w = [w for w, lv, n in zip(walls, levels, plans) if lv == NOMINAL and n > 0]
+        if not nom_w:
+            nom_w = [w for w, lv in zip(walls, levels) if lv == NOMINAL]
+        sat_w = [w for w, lv in zip(walls, levels) if lv >= SATURATED]
+        nom_s = sum(nom_w) / len(nom_w) if nom_w else None
+        sat_s = sum(sat_w) / len(sat_w) if sat_w else None
+        overload_block = {
+            "enabled": True,
+            "ramp": asdict(overload),
+            "levels": {
+                "max": LEVELS[max(levels)] if levels else LEVELS[NOMINAL],
+                "final": ctrl["level_name"],
+                "per_slot": [LEVELS[lv] for lv in levels],
+            },
+            "transitions": ctrl["transitions"],
+            "dwell_seconds": ctrl["dwell_seconds"],
+            "shed": shed,
+            "recovered": ctrl["level"] == NOMINAL,
+            "settle_samples": settle_samples,
+            "cadence": {
+                "nominal_slot_s": round(nom_s, 5) if nom_s is not None else None,
+                "saturated_slot_s": round(sat_s, 5) if sat_s is not None else None,
+                "saturated_over_nominal": (
+                    round(sat_s / nom_s, 3) if nom_s and sat_s is not None else None
+                ),
+                "nominal_slots": len(nom_w),
+                "saturated_slots": len(sat_w),
+            },
+            "signals_last": ctrl["signals"],
+            "fanout": {
+                "conflated": sub.conflated,
+                "dropped": sub.dropped,
+                "delivered": sink.accepted,
+                "end_depth": sub.queue_depth(),
+            },
+            "relay_damping_engagements": relay.engagements,
+            "overload_rejected": flood.counters["overload_rejected"],
+            "peak_scale_slots": sum(1 for s in scales if s == overload.peak_scale),
+        }
+    else:
+        for k in ("slot_walls", "slot_levels", "slot_scales", "slot_plans"):
+            replay_stats.pop(k, None)
+
     after = REGISTRY.snapshot()["counters"]
     fp = _fingerprints(faulted)
     tier_stats = tier.stats()
@@ -437,6 +717,7 @@ def run_txflood_sustain(
     fl = flood.counters
     clean_rate = fl["clean_accepted"] / fl["clean_submitted"] if fl["clean_submitted"] else 0.0
     delivery_s = replay_stats["delivery_seconds"]
+    brk_stable, brk_wall = _split_breaker(breaker.snapshot())
     report = {
         "config": {
             **asdict(cfg),
@@ -453,7 +734,7 @@ def run_txflood_sustain(
             "fault_free_fingerprints": base_fp,
             "matches_fault_free": fp == base_fp,
         },
-        "breaker": breaker.snapshot(),
+        "breaker": brk_stable,
         "ingest": {
             "tx_acceptance_rate": round(clean_rate, 4),
             "clean_submitted": fl["clean_submitted"],
@@ -478,7 +759,10 @@ def run_txflood_sustain(
             "fault_injections": _delta(before, after, "fault_injections"),
             **{name: _delta(before, after, name) for name in _DELTA_COUNTERS},
         },
+        "run_meta": run_meta(wall={"breaker": brk_wall}),
     }
+    if overload_block is not None:
+        report["overload"] = overload_block
     if out:
         with open(out, "w") as f:
             json.dump(report, f, indent=2, sort_keys=True)

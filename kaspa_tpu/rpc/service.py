@@ -33,12 +33,16 @@ class RpcError(Exception):
     """RPC-level rejection.  ``code`` is a stable machine-readable
     identifier forwarded on the wire (rpc.rs RpcError submit categories):
     clients branch on tx-orphan / tx-duplicate / tx-rbf-rejected /
-    tx-fee-too-low / tx-double-spend / mempool-full / tx-gas / tx-invalid
-    without parsing prose."""
+    tx-fee-too-low / tx-double-spend / mempool-full / tx-gas / tx-invalid /
+    node-overloaded without parsing prose.  ``node-overloaded`` (a brownout
+    shed, not a verdict on the tx) additionally carries ``retry_after_ms``,
+    forwarded on the wire as ``retryAfterMs`` — the client should back off
+    and resubmit the identical tx."""
 
-    def __init__(self, message: str, code: str = "rpc-error"):
+    def __init__(self, message: str, code: str = "rpc-error", retry_after_ms: int | None = None):
         super().__init__(message)
         self.code = code
+        self.retry_after_ms = retry_after_ms
 
 
 @dataclass
@@ -215,7 +219,11 @@ class RpcCoreService:
             else:
                 evicted = self.mining.validate_and_insert_transaction(tx)
         except MempoolError as e:
-            raise RpcError(f"transaction rejected: {e}", code=e.code) from e
+            raise RpcError(
+                f"transaction rejected: {e}",
+                code=e.code,
+                retry_after_ms=getattr(e, "retry_after_ms", None),
+            ) from e
         except TxRuleError as e:
             raise RpcError(f"transaction rejected: {e}", code="tx-invalid") from e
         if tx.id() in self.mining.mempool.orphans:

@@ -334,8 +334,9 @@ class WrpcClient:
         self._responses: dict = {}  # id -> response (reader fills)
         self._response_cv = threading.Condition()  # graftlint: allow(raw-lock) -- client-side test helper; single condvar, no lock nesting in the process under test
         self._closed = False
+        # graftlint: allow(unbounded-queue) -- client-side test helper; lives for one scripted exchange
         self.notifications: queue.Queue = queue.Queue()
-        self.borsh_notifications: queue.Queue = queue.Queue()
+        self.borsh_notifications: queue.Queue = queue.Queue()  # graftlint: allow(unbounded-queue) -- client-side test helper; lives for one scripted exchange
         self._next_id = 0
         self._id_lock = threading.Lock()  # graftlint: allow(raw-lock) -- request-id counter leaf in the client helper
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="wrpc-client-reader")

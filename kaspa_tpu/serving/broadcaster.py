@@ -78,6 +78,21 @@ _FILTER_SCAN = REGISTRY.histogram(
     "serving_filter_scanned_scripts", buckets=SIZE_BUCKETS,
     help="scripts iterated to scope-filter one UtxosChanged event for one subscriber",
 )
+from kaspa_tpu.observability.shed import SHED as _SHED  # noqa: E402  (family declared once there)
+
+
+def _conflate_utxos_changed(old: Notification, new: Notification) -> Notification:
+    """Merge two consecutive utxos-changed events into one (brownout
+    diff-conflation for slow subscribers).  Added/removed lists concatenate
+    in arrival order — replaying the merged diff yields the same final
+    UTXO view a client would reach applying both — and the scope set is
+    the union."""
+    data = dict(new.data)
+    data["added"] = list(old.data.get("added", ())) + list(new.data.get("added", ()))
+    data["removed"] = list(old.data.get("removed", ())) + list(new.data.get("removed", ()))
+    if old.data.get("spk_set") is not None or new.data.get("spk_set") is not None:
+        data["spk_set"] = set(old.data.get("spk_set") or ()) | set(new.data.get("spk_set") or ())
+    return Notification(new.event_type, data, new.ctx)
 
 
 class Subscriber:
@@ -116,7 +131,11 @@ class Subscriber:
         self.subscriptions: dict[str, frozenset | None] = {}
         self.dropped = 0
         self.delivered = 0
-        self._dq: deque = deque()
+        self.conflated = 0
+        # brownout knob: queue depth at/above which consecutive
+        # utxos-changed events merge instead of appending (None = off)
+        self.conflate_floor: int | None = None
+        self._dq: deque = deque()  # graftlint: allow(unbounded-queue) -- bounded by the maxlen overflow policy in offer()
         self._lock = ranked_lock("serving.subscriber", reentrant=False)
         self._cv = self._lock.condition()
         self._stopped = False
@@ -139,7 +158,23 @@ class Subscriber:
                     self.dropped += 1
                     _SUB_DROPS.inc()
             if not disconnect:
-                self._dq.append((notification, t_received))
+                floor = self.conflate_floor
+                if (
+                    floor is not None
+                    and len(self._dq) >= max(1, floor)
+                    and notification.event_type == "utxos-changed"
+                    and self._dq
+                    and self._dq[-1][0].event_type == "utxos-changed"
+                ):
+                    # brownout diff-conflation: a slow subscriber gets one
+                    # merged diff (oldest t_received kept — lag telemetry
+                    # still reflects how far behind the consumer is)
+                    prev_n, prev_t = self._dq[-1]
+                    self._dq[-1] = (_conflate_utxos_changed(prev_n, notification), prev_t)
+                    self.conflated += 1
+                    _SHED.inc("fanout_conflation")
+                else:
+                    self._dq.append((notification, t_received))
                 _QUEUE_DEPTH.observe(len(self._dq))
                 self._cv.notify()
         if disconnect:
@@ -230,6 +265,7 @@ class Broadcaster:
         self.notifier = notifier
         self._ingest: queue.Queue = queue.Queue(maxsize=ingest_maxsize)
         self._mu = ranked_lock("serving.broadcaster", reentrant=False)
+        self._conflate_floor: int | None = None
         self._subscribers: list[Subscriber] = []
         self._event_refs: dict[str, int] = {}
         self._closed = False
@@ -249,13 +285,30 @@ class Broadcaster:
             "queue_depths": {s.name: s.queue_depth() for s in subs},
             "dropped": {s.name: s.dropped for s in subs if s.dropped},
             "delivered": sum(s.delivered for s in subs),
+            "conflated": sum(s.conflated for s in subs),
         }
+
+    def max_queue_depth(self) -> int:
+        """Deepest per-subscriber queue (the overload fanout signal)."""
+        with self._mu:
+            subs = list(self._subscribers)
+        return max((s.queue_depth() for s in subs), default=0)
+
+    def set_conflation(self, floor: int | None) -> None:
+        """Brownout seam: enable utxos-changed diff-conflation for every
+        subscriber whose queue depth reaches ``floor`` (None disables)."""
+        with self._mu:
+            self._conflate_floor = floor
+            subs = list(self._subscribers)
+        for s in subs:
+            s.conflate_floor = floor
 
     # --- subscriber lifecycle (call under the daemon dispatch lock) ---
 
     def register(self, sub: Subscriber) -> Subscriber:
         with self._mu:
             self._subscribers.append(sub)
+            sub.conflate_floor = self._conflate_floor
         return sub
 
     def unregister(self, sub: Subscriber) -> None:

@@ -93,6 +93,20 @@ def main() -> None:
         "e.g. '{\"clean_per_block\": 12, \"rbf_chain\": 5}'",
     )
     p.add_argument(
+        "--overload", action="store_true",
+        help="with --txflood: ramp the flood rate (warm -> linear ramp -> hold at "
+        "peak -> cooldown) against a live overload controller wired to the run's "
+        "mining/ingest tier; gates on shed>0, SATURATED reached, cadence within "
+        "1.5x of nominal, and recovery to NOMINAL; adds the 'overload' block to "
+        "the sustain report",
+    )
+    p.add_argument(
+        "--overload-config", default=None, metavar="JSON",
+        help="override OverloadRampConfig fields for --overload, e.g. "
+        "'{\"peak_scale\": 6, \"thresholds\": {\"mempool\": [15, 40, 120]}, "
+        "\"expire_daa\": 6}'",
+    )
+    p.add_argument(
         "--no-pace", action="store_true",
         help="with --txflood: deliver blocks as fast as possible instead of the "
         "true --bps wall-clock cadence",
@@ -114,6 +128,11 @@ def main() -> None:
     args = p.parse_args()
 
     mesh_size = mesh.configure(args.mesh)
+    if args.overload and args.coalesce is None:
+        # the dispatch_yield brownout action needs a live coalescing engine
+        # to act on — overload runs default it on rather than silently
+        # exercising a no-op action
+        args.coalesce = "auto"
     coalesce_target = coalesce.configure(args.coalesce)
     if args.verify_mode is not None:
         coalesce.set_verify_mode(args.verify_mode)
@@ -239,7 +258,11 @@ def _run_hostile(cfg, args) -> None:
 
 
 def _run_txflood(cfg, args) -> None:
-    from kaspa_tpu.resilience.txflood import TxFloodConfig, run_txflood_sustain
+    from kaspa_tpu.resilience.txflood import (
+        OverloadRampConfig,
+        TxFloodConfig,
+        run_txflood_sustain,
+    )
 
     flood = TxFloodConfig()
     if args.txflood_rates:
@@ -247,6 +270,14 @@ def _run_txflood(cfg, args) -> None:
             if not hasattr(flood, k):
                 raise SystemExit(f"unknown txflood rate field: {k}")
             setattr(flood, k, v)
+    ramp = None
+    if args.overload:
+        ramp = OverloadRampConfig()
+        if args.overload_config:
+            for k, v in json.loads(args.overload_config).items():
+                if not hasattr(ramp, k):
+                    raise SystemExit(f"unknown overload config field: {k}")
+                setattr(ramp, k, v)
     report = run_txflood_sustain(
         cfg,
         flood_cfg=flood,
@@ -254,6 +285,7 @@ def _run_txflood(cfg, args) -> None:
         seed=args.seed,
         out=args.sustain_out,
         pace=not args.no_pace,
+        overload=ramp,
     )
     det, ing = report["deterministic"], report["ingest"]
     summary = {
@@ -272,6 +304,27 @@ def _run_txflood(cfg, args) -> None:
         "sink": det["fingerprints"]["sink"],
         "sustain_out": args.sustain_out,
     }
+    ov_ok = True
+    if ramp is not None:
+        ov = report["overload"]
+        ratio = ov["cadence"]["saturated_over_nominal"]
+        ov_ok = (
+            ov["levels"]["max"] in ("SATURATED", "CRITICAL")
+            and sum(ov["shed"].values()) > 0
+            and ov["recovered"]
+            and ratio is not None
+            and ratio <= 1.5
+        )
+        summary.update(
+            {
+                "overload_max_level": ov["levels"]["max"],
+                "overload_recovered": ov["recovered"],
+                "overload_shed": sum(ov["shed"].values()),
+                "overload_rejected": ov["overload_rejected"],
+                "cadence_saturated_over_nominal": ratio,
+                "overload_ok": ov_ok,
+            }
+        )
     if args.json:
         print(json.dumps(summary))
     else:
@@ -284,7 +337,15 @@ def _run_txflood(cfg, args) -> None:
             f"peak pool={ing['peak_mempool_occupancy']}, lost={ing['lost_tickets']}, "
             f"matches_fault_free={det['matches_fault_free']} -> {args.sustain_out}"
         )
-    if not det["matches_fault_free"] or ing["lost_tickets"] != 0:
+        if ramp is not None:
+            ov = report["overload"]
+            print(
+                f"overload: max={ov['levels']['max']} final={ov['levels']['final']} "
+                f"shed={ov['shed']} "
+                f"cadence sat/nom={ov['cadence']['saturated_over_nominal']} "
+                f"recovered={ov['recovered']} ok={ov_ok}"
+            )
+    if not det["matches_fault_free"] or ing["lost_tickets"] != 0 or not ov_ok:
         raise SystemExit(2)
 
 

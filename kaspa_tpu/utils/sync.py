@@ -139,6 +139,7 @@ def _trace_record(name: str, held_s: float) -> None:
 RANKS: dict[str, int] = {
     "node": 5,                 # p2p/node.py — outermost node state
     "ingest.state": 7,         # ingest/tier.py — mempool admission state
+    "overload.state": 8,       # resilience/overload.py — controller level state
     "consensus-commit": 10,    # pipeline/pipeline.py — UTXO commit section
     "pipeline.deps": 20,       # pipeline/deps_manager.py — orphan/deps graph
     "fabric.config": 25,       # fabric/balancer.py — process-wide balancer slot

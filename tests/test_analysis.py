@@ -1,4 +1,4 @@
-"""graftlint tests: the five checkers on seeded fixtures, pragma
+"""graftlint tests: the six checkers on seeded fixtures, pragma
 semantics, one-hop call-graph expansion, and the full-repo self-run.
 
 Fixtures are written to tmp_path and linted with run_project — the lint
@@ -266,6 +266,51 @@ def test_registry_hygiene_metric_names(tmp_path):
     msgs = [f["message"] for f in report["findings"] if f["checker"] == "registry-hygiene"]
     assert any("'Bad-Name'" in m and "convention" in m for m in msgs)
     assert any("duplicate registration of 'good_name'" in m for m in msgs)
+
+
+# --- unbounded-queue ------------------------------------------------------
+
+
+def test_unbounded_queue_flags_missing_bounds(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import queue
+        from collections import deque
+
+        a = deque()
+        b = deque([], None)
+        c = deque([], 32)
+        d = deque(maxlen=8)
+        e = queue.Queue()
+        f = queue.Queue(0)
+        g = queue.Queue(maxsize=128)
+        h = queue.SimpleQueue()
+    """})
+    lines = sorted(f["line"] for f in report["findings"] if f["checker"] == "unbounded-queue")
+    # deque()/deque([], None), Queue()/Queue(0), SimpleQueue(); the bounded
+    # constructions on lines 7, 8, 11 are the stated overflow policy
+    assert lines == [5, 6, 9, 10, 12]
+
+
+def test_unbounded_queue_exempts_utils_layer(tmp_path):
+    # the primitives layer (utils/sync.py waiter deques etc.) owns its
+    # buffers as leaf internals — the policy applies to subsystem queues
+    report = _lint(tmp_path, {"utils/sync.py": """
+        from collections import deque
+
+        waiters = deque()
+    """})
+    assert not [f for f in report["findings"] if f["checker"] == "unbounded-queue"]
+
+
+def test_unbounded_queue_pragma_suppression(tmp_path):
+    report = _lint(tmp_path, {"mod.py": """
+        import queue
+
+        q = queue.SimpleQueue()  # graftlint: allow(unbounded-queue) -- drained same-call, bounded by caller batch
+    """})
+    assert report["ok"] is True
+    assert not report["findings"]
+    assert [s["checker"] for s in report["suppressed"]] == ["unbounded-queue"]
 
 
 # --- pragmas --------------------------------------------------------------

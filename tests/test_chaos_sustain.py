@@ -55,8 +55,13 @@ def test_sustain_converges_and_is_deterministic(tmp_path, workload):
     assert r1["metrics"]["secp_degraded_dispatches"] >= 1
     assert r1["metrics"]["txscript_vm_fault_retries"] >= 1
     assert r1["deterministic"]["events"], "no faults fired"
-    # report carries the non-deterministic observability sections
-    assert "lock_traces" in r1 and r1["metrics"]["replay_seconds"] > 0
+    # non-deterministic observability sections live under run_meta.wall,
+    # so artifact diffing over the stable view stays churn-free
+    assert "lock_traces" in r1["run_meta"]["wall"]
+    assert r1["metrics"]["replay_seconds"] > 0
+    from kaspa_tpu.resilience.sustain import stable_view
+
+    assert "run_meta" not in stable_view(r1)
 
 
 def test_hostile_workload_exercises_vm_fallback_scripts(workload):

@@ -42,6 +42,8 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             # and the ingest lane (an identity-check subprocess + a 24-block
             # tx-flood sustain replay)
             "--skip-ingest",
+            # and the brownout ramp drill (another 24-block flood replay)
+            "--skip-overload",
             "--blocks",
             "8",
             "--out",

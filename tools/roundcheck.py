@@ -13,9 +13,12 @@ the hostile-load chaos sustain run (seeded fault schedule; the faulted
 replay must converge to the bit-identical fault-free end state), the
 device-supervision wedge drill (injected dispatch hangs + a compile
 stall; watchdog requeue accounting + canary recovery, bit-identity
-gated), and the ingest lane (batched-vs-per-tx mempool-admission
+gated), the ingest lane (batched-vs-per-tx mempool-admission
 identity plus a short tx-flood sustain; clean acceptance >= 0.99 and
-zero lost tickets), then writes a single round-evidence JSON (ROUNDCHECK.json)
+zero lost tickets), and the overload lane (a tx-flood replay with the
+adaptive brownout ramp; the controller must reach SATURATED, shed load
+with zero lost tickets, hold cadence within 1.5x of nominal, and settle
+back to NOMINAL), then writes a single round-evidence JSON (ROUNDCHECK.json)
 summarizing them — the artifact a driver round or a reviewer reads
 instead of eight scrollback logs.
 
@@ -29,6 +32,7 @@ instead of eight scrollback logs.
     python tools/roundcheck.py --skip-supervision  # no wedge drill
     python tools/roundcheck.py --skip-fabric       # no two-process fabric drill
     python tools/roundcheck.py --skip-ingest       # no tx-ingest admission lane
+    python tools/roundcheck.py --skip-overload     # no brownout ramp drill
     python tools/roundcheck.py --skip-lint         # no graftlint static-analysis gate
     python tools/roundcheck.py --out my.json       # custom artifact path
 
@@ -36,8 +40,8 @@ instead of eight scrollback logs.
 named sections and ignores the skip flags; section names are the keys in
 ROUNDCHECK.json (tier1, sim, bench_probe, multichip, mesh_smoke,
 dispatch, aggregate, serving, obs, tenbps, chaos, supervision,
-fabric, ingest).  Every section records its own ``wall_seconds`` in
-the artifact.
+fabric, ingest, overload).  Every section records its own
+``wall_seconds`` in the artifact.
 
 Exit code 0 iff every section that ran passed.
 """
@@ -195,6 +199,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-supervision", action="store_true", help="skip the device-supervision wedge drill")
     ap.add_argument("--skip-fabric", action="store_true", help="skip the two-process verify-fabric drill")
     ap.add_argument("--skip-ingest", action="store_true", help="skip the tx-ingest admission lane")
+    ap.add_argument("--skip-overload", action="store_true", help="skip the brownout ramp drill")
     ap.add_argument("--skip-lint", action="store_true", help="skip the graftlint static-analysis gate")
     ap.add_argument(
         "--only", action="append", default=None, metavar="SECTION",
@@ -599,6 +604,41 @@ def main(argv: list[str] | None = None) -> int:
         )
         return sect
 
+    def _sect_overload() -> dict:
+        # overload lane (ISSUE 14): a tx-flood replay with the adaptive
+        # brownout ramp engaged — flood scale climbs past the pressure
+        # thresholds, the controller must reach SATURATED, every brownout
+        # seam sheds observably (zero lost tickets — every shed tx still
+        # resolves its admission ticket), block cadence under SATURATED
+        # stays within 1.5x of loaded-nominal, and the controller settles
+        # back to NOMINAL once the flood drains.  The late ramp fractions
+        # leave the 24-block warm phase long enough for coinbase maturity,
+        # so the NOMINAL cadence baseline carries real flood traffic.
+        sect = _run(
+            [
+                sys.executable, "-m", "kaspa_tpu.sim",
+                "--txflood", "--overload", "--no-pace", "--blocks", "24",
+                "--tpb", "4", "--seed", "7", "--json",
+                "--overload-config", '{"warm_frac": 0.5, "ramp_frac": 0.2, "hold_frac": 0.2}',
+                "--sustain-out", os.path.join(REPO_ROOT, "SUSTAIN_OVERLOAD.json"),
+            ],
+            900.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        result = _last_json_line(sect)
+        sect["result"] = result
+        sect["ok"] = (
+            sect["rc"] == 0
+            and bool(result)
+            and bool(result.get("matches_fault_free"))
+            and result.get("lost_tickets", 1) == 0
+            and result.get("overload_max_level") in ("SATURATED", "CRITICAL")
+            and result.get("overload_shed", 0) > 0
+            and bool(result.get("overload_recovered"))
+            and bool(result.get("overload_ok"))
+        )
+        return sect
+
     sections: list[tuple[str, bool, object]] = [
         ("lint", not args.skip_lint, _sect_lint),
         ("tier1", not args.skip_tests, _sect_tier1),
@@ -615,6 +655,7 @@ def main(argv: list[str] | None = None) -> int:
         ("supervision", not args.skip_supervision, _sect_supervision),
         ("fabric", not args.skip_fabric, _sect_fabric),
         ("ingest", not args.skip_ingest, _sect_ingest),
+        ("overload", not args.skip_overload, _sect_overload),
     ]
     only: set[str] | None = None
     if args.only:
