@@ -1,11 +1,24 @@
-"""graftlint: project-invariant static analysis for the kaspa-tpu runtime.
+"""graftlint v2: whole-program static analysis for the kaspa-tpu runtime.
 
 An AST-based checker framework encoding the invariants this repo keeps
-re-learning at runtime (see ISSUE 13 / README "Static analysis"):
+re-learning at runtime (see ISSUEs 13/15 / README "Static analysis").
+The v2 engine builds a module-qualified project call graph
+(``analysis/callgraph.py``) and runs fixpoint propagation of may-block /
+may-raise facts over it, so interprocedural checkers see chains of any
+depth — not one hop.
+
+Per-file checkers:
 
     blocking-under-lock   no device dispatch / Future.result / sleep /
-                          socket recv inside a ``with <lock>`` body
-                          (one-hop call-graph expansion included)
+                          socket recv inside a ``with <lock>`` body —
+                          including *transitively*, through call chains
+                          of any depth (fixpoint over the call graph)
+    exception-path        manual lock.acquire() followed by a
+                          raise-reachable call before .release() without
+                          try/finally leaks the lock on the throw path
+    resource-lifecycle    Ticket/AdmissionTicket resolve exactly once on
+                          every path; flight spans close;
+                          faults.suppress() is a context manager
     raw-lock              threading.Lock()/RLock() construction outside
                           utils/sync.py must be a ranked LockCtx
     tracer-hazard         module-level caches, host coercions and
@@ -19,17 +32,29 @@ re-learning at runtime (see ISSUE 13 / README "Static analysis"):
                           overflow policy (maxlen/maxsize, a producer-side
                           capacity check, or a justified pragma)
 
+Project checkers (run once over the whole tree):
+
+    env-knob              every KASPA_TPU_* read reconciles against the
+                          committed KNOBS.md catalog (regen: --knobs)
+    kernel-shape          [gated: --shapes] jax.eval_shape every reachable
+                          kernel family x bucket x mesh signature; fail on
+                          dtype drift and WARM_COVERAGE holes
+
 Suppression: ``# graftlint: allow(<checker-id>) -- <justification>`` on
-the offending line (or alone on the line above).  A pragma without a
-justification is itself an error — every silence is documented.
+the offending line, alone on the line above, or anywhere on a multi-line
+statement's span.  A pragma without a justification is itself an error —
+every silence is documented.  ``--ratchet`` pins the suppression count
+and per-checker finding counts to the committed LINT.json baseline.
 
 Run: ``python -m kaspa_tpu.analysis`` (or ``tools/lint.py``).
 """
 
 from kaspa_tpu.analysis.core import (  # noqa: F401
     CHECKERS,
+    PROJECT_CHECKERS,
     Finding,
     Project,
     register_checker,
+    register_project_checker,
     run_project,
 )

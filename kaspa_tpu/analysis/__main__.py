@@ -1,8 +1,16 @@
 """graftlint CLI: ``python -m kaspa_tpu.analysis [paths...]``.
 
 Exit status 0 iff no active findings (suppressed-with-justification
-pragmas don't count).  ``--json PATH`` additionally writes the full
+pragmas don't count) and — under ``--ratchet`` — no regression against
+the committed baseline.  ``--json PATH`` additionally writes the full
 LINT.json document; the human table always goes to stdout.
+
+v2 flags:
+  --shapes    enable the gated kernel-shape audit (imports jax)
+  --knobs     (re)generate KNOBS.md from the env-knob census and exit
+  --ratchet   compare against the committed LINT.json baseline: fail if
+              the suppression count or any per-checker active-finding
+              count grew (reads the baseline BEFORE overwriting --json)
 """
 
 from __future__ import annotations
@@ -13,54 +21,132 @@ import os
 import sys
 
 from kaspa_tpu.analysis import CHECKERS, run_project
-import kaspa_tpu.analysis.checkers  # noqa: F401  (registers the checkers)
+from kaspa_tpu.analysis.core import PROJECT_CHECKERS
+import kaspa_tpu.analysis.checkers  # noqa: F401  (registers the per-file checkers)
+import kaspa_tpu.analysis.lifecycle  # noqa: F401  (resource-lifecycle, exception-path)
+import kaspa_tpu.analysis.envknobs  # noqa: F401  (env-knob)
+import kaspa_tpu.analysis.shapes  # noqa: F401  (kernel-shape, gated)
 
 
 def _default_paths(root: str) -> list[str]:
     return [os.path.join(root, "kaspa_tpu")]
 
 
+def _load_baseline(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def check_ratchet(baseline: dict | None, report: dict) -> list[str]:
+    """Regressions of ``report`` against the committed ``baseline``:
+    suppression count and per-checker active counts may shrink, never
+    grow.  A missing/unreadable baseline is itself a failure — the
+    ratchet only means something against a committed document."""
+    if baseline is None:
+        return ["ratchet: no committed baseline LINT.json to compare against"]
+    out: list[str] = []
+    base_supp = len(baseline.get("suppressed", []))
+    new_supp = len(report.get("suppressed", []))
+    if new_supp > base_supp:
+        out.append(
+            f"ratchet: suppression count grew {base_supp} -> {new_supp} "
+            "(new pragmas need the debt paid down elsewhere)"
+        )
+    base_counts = baseline.get("counts", {})
+    for cid, n in sorted(report.get("counts", {}).items()):
+        if n > base_counts.get(cid, 0):
+            out.append(
+                f"ratchet: {cid} active findings grew "
+                f"{base_counts.get(cid, 0)} -> {n}"
+            )
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kaspa_tpu.analysis",
-        description="graftlint: project-invariant static analysis",
+        description="graftlint: project-invariant static analysis (v2 whole-program engine)",
     )
     ap.add_argument("paths", nargs="*", help="files/dirs to lint (default: the kaspa_tpu package)")
     ap.add_argument("--root", default=None, help="repo root for relative paths (default: cwd)")
     ap.add_argument("--json", dest="json_path", default=None, help="write LINT.json here")
     ap.add_argument("--list-checkers", action="store_true", help="print the checker catalog and exit")
+    ap.add_argument("--shapes", action="store_true", help="enable the gated kernel-shape audit (imports jax)")
+    ap.add_argument("--knobs", action="store_true", help="(re)generate KNOBS.md from the env-knob census and exit")
+    ap.add_argument(
+        "--ratchet",
+        action="store_true",
+        help="fail if suppressions or per-checker findings grew vs the committed --json baseline",
+    )
     ap.add_argument("-q", "--quiet", action="store_true", help="suppress the summary table")
     args = ap.parse_args(argv)
 
     if args.list_checkers:
         for cid in sorted(CHECKERS):
             print(f"{cid:22s} {CHECKERS[cid].description}")
+        for cid in sorted(PROJECT_CHECKERS):
+            spec = PROJECT_CHECKERS[cid]
+            gate = " [gated]" if spec.gated else ""
+            print(f"{cid:22s} {spec.description}{gate}")
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
     paths = [os.path.abspath(p) for p in args.paths] or _default_paths(root)
-    report = run_project(paths, root=root)
+
+    if args.knobs:
+        from kaspa_tpu.analysis.core import Project, collect_files
+        from kaspa_tpu.analysis.envknobs import render_knobs_md, scan_knob_sites
+
+        project = Project(root, collect_files(paths, root))
+        knobs_path = os.path.join(root, "KNOBS.md")
+        existing = None
+        if os.path.isfile(knobs_path):
+            with open(knobs_path, encoding="utf-8") as fh:
+                existing = fh.read()
+        census = scan_knob_sites(project)
+        with open(knobs_path, "w", encoding="utf-8") as fh:
+            fh.write(render_knobs_md(census, existing))
+        print(f"KNOBS.md: {len(census)} knobs from {sum(len(v) for v in census.values())} sites")
+        return 0
+
+    baseline = _load_baseline(args.json_path) if (args.ratchet and args.json_path) else None
+    options = {"kernel-shape": True} if args.shapes else None
+    report = run_project(paths, root=root, options=options)
+
+    ratchet_failures: list[str] = []
+    if args.ratchet:
+        ratchet_failures = check_ratchet(baseline, report)
+        report["ratchet"] = {"ok": not ratchet_failures, "failures": ratchet_failures}
 
     if args.json_path:
         with open(args.json_path, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
             fh.write("\n")
 
+    ok = report["ok"] and not ratchet_failures
     if not args.quiet:
         for finding in report["findings"]:
             print(f"{finding['path']}:{finding['line']}: [{finding['checker']}] {finding['message']}")
+        for msg in ratchet_failures:
+            print(msg)
         n_active = len(report["findings"])
         n_supp = len(report["suppressed"])
-        state = "clean" if report["ok"] else "FAILED"
+        state = "clean" if ok else "FAILED"
         print(
             f"graftlint: {state} — {report['files']} files, "
             f"{n_active} finding(s), {n_supp} suppressed "
-            f"({len(report['checkers'])} checkers)"
+            f"({len(report['checkers'])} checkers, engine {report['engine']})"
         )
         if report["counts"]:
             for cid, n in sorted(report["counts"].items()):
                 print(f"  {cid:22s} {n}")
-    return 0 if report["ok"] else 1
+    elif ratchet_failures:
+        for msg in ratchet_failures:
+            print(msg, file=sys.stderr)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

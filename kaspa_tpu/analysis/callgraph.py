@@ -1,0 +1,332 @@
+"""Whole-program call graph + fixpoint fact propagation (the v2 engine).
+
+The v1 engine expanded calls exactly one hop through a bare-name index:
+a blocking call buried two frames deep was invisible.  This module builds
+a *module-qualified* call graph over the whole lint set and runs fixpoint
+transitive propagation of dataflow facts over it, so a checker asking
+"can this call block?" gets an answer of any depth, with the full call
+chain as evidence.
+
+Resolution strategy (precision over recall, ambiguity tracked — never
+silently guessed):
+
+1. bare ``name(...)``   -> a def in the same module, else a ``from x
+   import name`` target resolved through the import table, else the
+   unique project-wide definition of that bare name;
+2. ``mod.name(...)``    -> module-level def in the module the alias
+   imports (``import kaspa_tpu.ops.mesh as mod`` / ``from kaspa_tpu.ops
+   import mesh``);
+3. ``self.name(...)`` / ``cls.name(...)`` -> the method in the enclosing
+   class (same module);
+4. ``recv.name(...)``   -> the unique method of that name across every
+   class in the project; when several classes define it, receiver-name
+   heuristics narrow the field (a receiver called ``ticket`` selects a
+   class named ``Ticket``); anything still plural is recorded as an
+   *ambiguous* site — counted, reported in the LINT.json callgraph
+   section, and never expanded.
+
+Facts propagated to fixpoint (monotone booleans, BFS over reverse edges
+so cycles and mutual recursion terminate and every chain is shortest):
+
+- ``may-block``: seeded from :func:`blocking.direct_blocking_calls`;
+  each infected node carries the hop-by-hop chain down to the primitive
+  blocking call for the finding message.
+- ``may-raise``: seeded from explicit ``raise`` statements; drives the
+  exception-path analysis in the lifecycle checker.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from kaspa_tpu.analysis.blocking import (
+    _terminal_name,
+    _walk_shallow,
+    direct_blocking_calls,
+)
+
+# bare names never worth resolving even when unique project-wide: tiny
+# accessors and stdlib look-alikes dominate, and an expansion through one
+# of these is noise, not evidence
+NO_EXPAND = {
+    "get", "set", "len", "items", "keys", "values", "append", "pop",
+    "int", "str", "float", "bool", "list", "dict", "tuple", "print",
+    "isinstance", "getattr", "setattr", "hasattr", "range", "min", "max",
+}
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    line: int
+    name: str  # terminal callee name ("dispatch" for self.eng.dispatch())
+    recv: str  # terminal receiver name ("eng"), "" for bare calls
+    is_attr: bool
+    target: "FuncNode | None" = None  # resolved callee
+    candidates: tuple = ()  # qnames when ambiguous (len > 1, unresolved)
+
+
+@dataclass
+class FuncNode:
+    """A module-qualified function/method definition."""
+
+    qname: str  # "kaspa_tpu/ops/dispatch.py::Ticket.wait"
+    name: str
+    rel: str
+    cls: str  # enclosing class name, "" for module-level defs
+    lineno: int
+    node: ast.AST
+    blocking: list = field(default_factory=list)  # [(line, reason)] direct
+    raises: bool = False  # contains an explicit `raise` (lexically)
+    sites: list = field(default_factory=list)  # [CallSite]
+    callers: list = field(default_factory=list)  # [(FuncNode, CallSite)]
+    # fixpoint facts
+    block_chain: list | None = None  # [{"rel","line","what"}], last = reason
+    may_raise: bool = False
+
+
+def _module_of(rel: str) -> str:
+    """Repo-relative path -> dotted module ("a/b/c.py" -> "a.b.c")."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def _direct_raises(fn_node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Raise) for n in _walk_shallow(fn_node))
+
+
+def _collect_sites(fn_node: ast.AST) -> list[CallSite]:
+    out = []
+    for n in _walk_shallow(fn_node):
+        if not isinstance(n, ast.Call):
+            continue
+        name = _terminal_name(n.func)
+        if not name or name.startswith("__"):
+            continue
+        if isinstance(n.func, ast.Attribute):
+            out.append(CallSite(n.lineno, name, _terminal_name(n.func.value), True))
+        else:
+            out.append(CallSite(n.lineno, name, "", False))
+    return out
+
+
+class CallGraph:
+    """Project-wide call graph with resolved edges and fixpoint facts."""
+
+    def __init__(self, files):
+        self.files = files
+        self.nodes: list[FuncNode] = []
+        # (module, name) -> module-level FuncNode
+        self.module_defs: dict[tuple[str, str], FuncNode] = {}
+        # (module, Class, name) -> method FuncNode
+        self.methods: dict[tuple[str, str, str], FuncNode] = {}
+        # bare name -> [FuncNode] across the project (defs + methods)
+        self.bare: dict[str, list[FuncNode]] = {}
+        # method name -> [FuncNode] (methods only, for receiver heuristics)
+        self.method_index: dict[str, list[FuncNode]] = {}
+        # per-module import tables
+        self._mod_alias: dict[str, dict[str, str]] = {}  # alias -> dotted module
+        self._sym_alias: dict[str, dict[str, tuple[str, str]]] = {}  # alias -> (module, symbol)
+        self._modules: set[str] = set()
+        self.ambiguous_sites = 0
+        self.resolved_sites = 0
+        self._build()
+        self._resolve_all()
+        self._fixpoint()
+
+    # -- construction -------------------------------------------------------
+
+    def _build(self) -> None:
+        for f in self.files:
+            mod = _module_of(f.rel)
+            self._modules.add(mod)
+            self._mod_alias[mod] = {}
+            self._sym_alias[mod] = {}
+            self._collect_imports(f.tree, mod)
+            self._collect_defs(f, mod, f.tree, cls="", prefix="")
+
+    def _collect_imports(self, tree: ast.AST, mod: str) -> None:
+        pkg = mod.rsplit(".", 1)[0] if "." in mod else ""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    # `import a.b.c` binds `a`; `import a.b.c as m` binds a.b.c
+                    self._mod_alias[mod][alias] = a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.names:
+                base = node.module or ""
+                if node.level:  # relative import: resolve against this package
+                    parts = pkg.split(".") if pkg else []
+                    parts = parts[: len(parts) - (node.level - 1)] if node.level > 1 else parts
+                    base = ".".join(parts + ([node.module] if node.module else []))
+                for a in node.names:
+                    alias = a.asname or a.name
+                    # `from pkg import mod` is a module alias when pkg.mod
+                    # is in the lint set, a symbol import otherwise
+                    if f"{base}.{a.name}" in self._modules or self._looks_like_module(base, a.name):
+                        self._mod_alias[mod][alias] = f"{base}.{a.name}"
+                    else:
+                        self._sym_alias[mod][alias] = (base, a.name)
+
+    def _looks_like_module(self, base: str, name: str) -> bool:
+        dotted = f"{base}.{name}"
+        return any(f.rel in (dotted.replace(".", "/") + ".py", dotted.replace(".", "/") + "/__init__.py") for f in self.files)
+
+    def _collect_defs(self, f, mod: str, tree: ast.AST, cls: str, prefix: str) -> None:
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_defs(f, mod, node, cls=node.name, prefix=prefix)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local = f"{prefix}{cls + '.' if cls else ''}{node.name}"
+                fn = FuncNode(
+                    qname=f"{f.rel}::{local}",
+                    name=node.name,
+                    rel=f.rel,
+                    cls=cls,
+                    lineno=node.lineno,
+                    node=node,
+                    blocking=direct_blocking_calls(node),
+                    raises=_direct_raises(node),
+                    sites=_collect_sites(node),
+                )
+                self.nodes.append(fn)
+                self.bare.setdefault(node.name, []).append(fn)
+                if cls:
+                    self.methods.setdefault((mod, cls, node.name), fn)
+                    self.method_index.setdefault(node.name, []).append(fn)
+                else:
+                    self.module_defs.setdefault((mod, node.name), fn)
+                # nested defs become their own nodes (they run later,
+                # elsewhere — their calls must not leak into the parent)
+                self._collect_defs(f, mod, node, cls="", prefix=f"{local}.")
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_site(self, site: CallSite, rel: str, cls: str) -> "FuncNode | None":
+        """Resolve one call site in the context of (file, enclosing class).
+        Returns the target, or None (ambiguity lands in site.candidates)."""
+        if site.name in NO_EXPAND:
+            return None
+        mod = _module_of(rel)
+        if not site.is_attr:
+            hit = self.module_defs.get((mod, site.name))
+            if hit is not None:
+                return hit
+            sym = self._sym_alias.get(mod, {}).get(site.name)
+            if sym is not None:
+                hit = self.module_defs.get((sym[0], sym[1]))
+                if hit is not None:
+                    return hit
+            return self._unique_bare(site)
+        # attribute call
+        if site.recv in ("self", "cls") and cls:
+            hit = self.methods.get((mod, cls, site.name))
+            if hit is not None:
+                return hit
+        target_mod = self._mod_alias.get(mod, {}).get(site.recv)
+        if target_mod is not None:
+            return self.module_defs.get((target_mod, site.name))
+        return self._method_heuristic(site)
+
+    def _unique_bare(self, site: CallSite) -> "FuncNode | None":
+        infos = self.bare.get(site.name, [])
+        if len(infos) == 1:
+            return infos[0]
+        if len(infos) > 1:
+            site.candidates = tuple(n.qname for n in infos)
+        return None
+
+    def _method_heuristic(self, site: CallSite) -> "FuncNode | None":
+        cands = self.method_index.get(site.name, [])
+        if len(cands) == 1:
+            return cands[0]
+        if not cands:
+            return None
+        # receiver-name narrowing: `ticket.wait()` selects class Ticket.
+        # Both directions of the substring test run (receiver "admission"
+        # vs class AdmissionTicket; receiver "tier" vs class IngestTier);
+        # exact match wins outright over substring matches.
+        rl = site.recv.lower().strip("_")
+        if rl:
+            exact = [c for c in cands if c.cls.lower() == rl]
+            if len(exact) == 1:
+                return exact[0]
+            subs = [c for c in cands if rl in c.cls.lower() or c.cls.lower() in rl]
+            if len(subs) == 1:
+                return subs[0]
+            if subs:
+                cands = subs
+        site.candidates = tuple(sorted(c.qname for c in cands))
+        return None
+
+    def _resolve_all(self) -> None:
+        for fn in self.nodes:
+            for site in fn.sites:
+                target = self.resolve_site(site, fn.rel, fn.cls)
+                if target is not None:
+                    site.target = target
+                    target.callers.append((fn, site))
+                    self.resolved_sites += 1
+                elif site.candidates:
+                    self.ambiguous_sites += 1
+
+    # -- fixpoint -----------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        # may-block: BFS from direct blockers over reverse edges.  A node's
+        # fact is set exactly once (first = shortest chain), so recursion
+        # cycles and mutual recursion terminate trivially.
+        queue = []
+        for fn in self.nodes:
+            if fn.blocking:
+                line, reason = fn.blocking[0]
+                fn.block_chain = [{"rel": fn.rel, "line": line, "what": reason}]
+                queue.append(fn)
+        i = 0
+        while i < len(queue):
+            g = queue[i]
+            i += 1
+            for caller, site in g.callers:
+                if caller.block_chain is None:
+                    caller.block_chain = [
+                        {"rel": caller.rel, "line": site.line, "what": f"{site.name}()"}
+                    ] + g.block_chain
+                    queue.append(caller)
+        # may-raise: same propagation, boolean only
+        queue = [fn for fn in self.nodes if fn.raises]
+        for fn in queue:
+            fn.may_raise = True
+        i = 0
+        while i < len(queue):
+            g = queue[i]
+            i += 1
+            for caller, _site in g.callers:
+                if not caller.may_raise:
+                    caller.may_raise = True
+                    queue.append(caller)
+
+    # -- queries ------------------------------------------------------------
+
+    def node_for(self, rel: str, fn_ast: ast.AST) -> "FuncNode | None":
+        for fn in self.nodes:
+            if fn.rel == rel and fn.node is fn_ast:
+                return fn
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "functions": len(self.nodes),
+            "resolved_edges": self.resolved_sites,
+            "ambiguous_sites": self.ambiguous_sites,
+            "may_block": sum(1 for n in self.nodes if n.block_chain),
+            "may_raise": sum(1 for n in self.nodes if n.may_raise),
+        }
+
+
+def render_chain(chain: list) -> str:
+    """Human form of a may-block chain: "a.py:12 f() -> b.py:9 sleep..."."""
+    return " -> ".join(f"{h['rel']}:{h['line']} {h['what']}" for h in chain)

@@ -1,10 +1,13 @@
-"""The six graftlint checkers (see package docstring for the catalog).
+"""The graftlint per-file checkers (see package docstring for the catalog).
 
 Each checker is registered under its id and returns findings for ONE
-file; anything project-wide (the call-graph table, the fault-point
+file; anything project-wide (the fixpoint call graph, the fault-point
 catalog, the metric-name census) is computed once and cached on the
 Project.  Checkers never import the modules they analyze — everything is
 AST-only, so linting a file with a seeded deadlock cannot hang the lint.
+(The two project-level checkers that DO import runtime modules — the
+kernel-shape audit and the env-knob catalog — live in shapes.py and
+envknobs.py and run once per project, the former only when gated on.)
 """
 
 from __future__ import annotations
@@ -18,25 +21,40 @@ from kaspa_tpu.analysis.blocking import (
     blocking_reason,
     is_lock_expr,
 )
+from kaspa_tpu.analysis.callgraph import NO_EXPAND, CallSite, render_chain
 from kaspa_tpu.analysis.core import Finding, Project, SourceFile, register_checker
 
 # ----------------------------------------------------------------------
-# 1. blocking-under-lock
+# 1. blocking-under-lock (fixpoint transitive expansion)
 # ----------------------------------------------------------------------
 
-# bare names never worth a one-hop expansion even when the project
-# defines exactly one function of that name (tiny accessors dominate)
-_NO_EXPAND = {"get", "set", "len", "items", "keys", "values", "append", "pop"}
+
+def walk_with_context(tree: ast.AST):
+    """Yield (node, enclosing_class_name, enclosing_function_ast) for every
+    node — the resolution context the call graph needs at a use site."""
+    stack = [(tree, "", None)]
+    while stack:
+        node, cls, fn = stack.pop()
+        yield node, cls, fn
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append((child, child.name, fn))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.append((child, cls, child))
+            else:
+                stack.append((child, cls, fn))
 
 
 @register_checker(
     "blocking-under-lock",
     "device dispatch / Future.result / sleep / socket recv / thread join "
-    "inside a `with <lock>` body (one-hop call-graph expansion)",
+    "inside a `with <lock>` body, at ANY call depth (whole-program "
+    "fixpoint expansion through the module-qualified call graph)",
 )
 def check_blocking_under_lock(project: Project, f: SourceFile) -> list[Finding]:
     out: list[Finding] = []
-    for node in ast.walk(f.tree):
+    graph = project.callgraph
+    for node, cls, _fn in walk_with_context(f.tree):
         if not isinstance(node, (ast.With, ast.AsyncWith)):
             continue
         lock_names = [
@@ -58,21 +76,30 @@ def check_blocking_under_lock(project: Project, f: SourceFile) -> list[Finding]:
                     )
                 )
                 continue
-            # one-hop expansion: a unique project-wide definition whose
-            # body blocks directly is as bad as blocking inline
-            if name in _NO_EXPAND or name.startswith("__"):
+            # transitive expansion: resolve the callee through the
+            # module-qualified call graph; its fixpoint may-block fact
+            # carries the full chain down to the primitive blocking call
+            if name in NO_EXPAND or name.startswith("__"):
                 continue
-            info = project.resolve_call(name)
-            if info is not None and info.blocking:
-                bline, breason = info.blocking[0]
+            site = _site_for(inner)
+            target = graph.resolve_site(site, f.rel, cls)
+            if target is not None and target.block_chain:
                 out.append(
                     Finding(
                         f.rel, inner.lineno, "blocking-under-lock",
-                        f"{name}() while holding {held} blocks indirectly: "
-                        f"{info.module_rel}:{bline} {breason}",
+                        f"{name}() while holding {held} blocks transitively "
+                        f"(depth {len(target.block_chain)}): "
+                        f"{render_chain(target.block_chain)}",
                     )
                 )
     return out
+
+
+def _site_for(call: ast.Call) -> CallSite:
+    name = _terminal_name(call.func)
+    if isinstance(call.func, ast.Attribute):
+        return CallSite(call.lineno, name, _terminal_name(call.func.value), True)
+    return CallSite(call.lineno, name, "", False)
 
 
 def _body_calls(with_node):

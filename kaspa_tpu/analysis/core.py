@@ -77,17 +77,63 @@ class SourceFile:
     text: str
     tree: ast.AST
     pragmas: list[_Pragma] = field(default_factory=list)
+    _spans: list[tuple[int, int]] | None = None
 
     @property
     def lines(self) -> list[str]:
         return self.text.splitlines()
 
+    @property
+    def stmt_spans(self) -> list[tuple[int, int]]:
+        """(start, end) line spans of statements, for pragma coverage on
+        multi-line statements.  Simple statements span their whole
+        extent; compound statements (def/class/if/with/...) span only
+        their *header* — decorators through the line before the first
+        body statement — so a pragma above a decorated def covers the
+        def, never the body."""
+        if self._spans is None:
+            spans = []
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.stmt) or node.end_lineno is None:
+                    continue
+                start = node.lineno
+                decos = getattr(node, "decorator_list", [])
+                if decos:
+                    start = min(start, min(d.lineno for d in decos))
+                body = getattr(node, "body", None)
+                if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+                    end = max(start, body[0].lineno - 1)  # header only
+                else:
+                    end = node.end_lineno
+                if end > start:  # single-line statements use exact match
+                    spans.append((start, end))
+            self._spans = spans
+        return self._spans
+
+    def _span_containing(self, line: int) -> tuple[int, int] | None:
+        best = None
+        for s, e in self.stmt_spans:
+            if s <= line <= e and (best is None or (e - s) < (best[1] - best[0])):
+                best = (s, e)
+        return best
+
     def allow_for(self, line: int, checker: str) -> _Pragma | None:
-        """The pragma suppressing ``checker`` at ``line``, if any."""
+        """The pragma suppressing ``checker`` at ``line``, if any.
+
+        Exact-line and comment-above semantics as v1, widened to
+        multi-line statements: a pragma anywhere on a statement's span
+        (e.g. on the closing paren of a wrapped call, or on the comment
+        line above a decorated def) covers findings anchored to any line
+        of that same statement's span."""
         for p in self.pragmas:
             if checker not in p.checkers and "all" not in p.checkers:
                 continue
             if p.line == line or (p.covers_next and p.line + 1 == line):
+                return p
+            span = self._span_containing(p.line)
+            if span is None and p.covers_next:
+                span = self._span_containing(p.line + 1)
+            if span is not None and span[0] <= line <= span[1]:
                 return p
         return None
 
@@ -110,6 +156,7 @@ def _parse_pragmas(text: str) -> list[_Pragma]:
 # ----------------------------------------------------------------------
 
 CHECKERS: dict[str, "CheckerSpec"] = {}
+PROJECT_CHECKERS: dict[str, "CheckerSpec"] = {}
 
 
 @dataclass
@@ -117,6 +164,7 @@ class CheckerSpec:
     id: str
     description: str
     fn: object  # (project, file) -> list[Finding]
+    gated: bool = False  # only runs when project.options[id] is truthy
 
 
 def register_checker(checker_id: str, description: str):
@@ -127,27 +175,33 @@ def register_checker(checker_id: str, description: str):
     return deco
 
 
-# ----------------------------------------------------------------------
-# project model + one-hop function index
-# ----------------------------------------------------------------------
+def register_project_checker(checker_id: str, description: str, gated: bool = False):
+    """A checker that runs ONCE over the whole project — ``fn(project) ->
+    list[Finding]`` — instead of per file (the kernel-shape audit, the
+    env-knob catalog).  ``gated`` checkers only run when explicitly
+    enabled via ``Project.options[checker_id]`` (they may import heavy
+    runtime dependencies like jax)."""
+
+    def deco(fn):
+        PROJECT_CHECKERS[checker_id] = CheckerSpec(checker_id, description, fn, gated=gated)
+        return fn
+
+    return deco
 
 
-@dataclass
-class FunctionInfo:
-    name: str  # bare function / method name
-    module_rel: str
-    lineno: int
-    node: ast.AST
-    blocking: list = field(default_factory=list)  # [(line, reason)] direct blockers
+# ----------------------------------------------------------------------
+# project model + the whole-program call graph (v2 engine)
+# ----------------------------------------------------------------------
 
 
 class Project:
     """The file set under analysis plus package-wide derived indexes."""
 
-    def __init__(self, root: str, files: list[SourceFile]):
+    def __init__(self, root: str, files: list[SourceFile], options: dict | None = None):
         self.root = root
         self.files = files
-        self._fn_index: dict[str, list[FunctionInfo]] | None = None
+        self.options = options or {}
+        self._callgraph = None
 
     def by_rel(self, rel: str) -> SourceFile | None:
         for f in self.files:
@@ -156,32 +210,14 @@ class Project:
         return None
 
     @property
-    def function_index(self) -> dict[str, list[FunctionInfo]]:
-        """bare name -> definitions across the project, with each body's
-        direct blocking calls precomputed (the one-hop expansion table)."""
-        if self._fn_index is None:
-            from kaspa_tpu.analysis.blocking import direct_blocking_calls
+    def callgraph(self):
+        """The module-qualified call graph with fixpoint may-block /
+        may-raise facts (built once per run, shared by every checker)."""
+        if self._callgraph is None:
+            from kaspa_tpu.analysis.callgraph import CallGraph
 
-            index: dict[str, list[FunctionInfo]] = {}
-            for f in self.files:
-                for node in ast.walk(f.tree):
-                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                        info = FunctionInfo(
-                            node.name, f.rel, node.lineno, node,
-                            blocking=direct_blocking_calls(node),
-                        )
-                        index.setdefault(node.name, []).append(info)
-            self._fn_index = index
-        return self._fn_index
-
-    def resolve_call(self, name: str) -> FunctionInfo | None:
-        """One-hop resolution by bare name: unique project-wide definition
-        or nothing (ambiguous names are never expanded — precision over
-        recall; the direct-call check still covers their bodies)."""
-        infos = self.function_index.get(name)
-        if infos is not None and len(infos) == 1:
-            return infos[0]
-        return None
+            self._callgraph = CallGraph(self.files)
+        return self._callgraph
 
 
 def load_file(path: str, root: str) -> SourceFile | None:
@@ -224,34 +260,39 @@ def collect_files(paths: list[str], root: str) -> list[SourceFile]:
 # ----------------------------------------------------------------------
 
 
-def run_project(paths: list[str], root: str | None = None) -> dict:
+def run_project(paths: list[str], root: str | None = None, options: dict | None = None) -> dict:
     """Lint ``paths``; returns the LINT.json document shape:
 
-    {"findings": [...], "suppressed": [...], "counts": {...},
-     "files": N, "ok": bool}
+    {"engine": "v2", "findings": [...], "suppressed": [...],
+     "counts": {...}, "files": N, "callgraph": {...}, "ok": bool}
 
     ``ok`` is False iff any active finding remains — including ``pragma``
-    findings for allow() lines missing a justification.
+    findings for allow() lines missing a justification.  ``options``
+    enables gated project-level checkers (``{"kernel-shape": True}``) and
+    carries checker configuration.
     """
     root = root or os.getcwd()
     files = collect_files(paths, root)
-    project = Project(root, files)
+    project = Project(root, files, options=options)
+    by_rel = {f.rel: f for f in files}
 
     active: list[Finding] = []
     suppressed: list[Finding] = []
-    for f in files:
-        raised: list[Finding] = []
-        for spec in CHECKERS.values():
-            raised.extend(spec.fn(project, f))
-        used_pragmas: set[int] = set()
+
+    def _file_findings(f: SourceFile, raised: list[Finding]) -> None:
         for finding in raised:
             pragma = f.allow_for(finding.line, finding.checker)
             if pragma is not None and pragma.justification:
                 finding.justification = pragma.justification
-                used_pragmas.add(pragma.line)
                 suppressed.append(finding)
             else:
                 active.append(finding)
+
+    for f in files:
+        raised: list[Finding] = []
+        for spec in CHECKERS.values():
+            raised.extend(spec.fn(project, f))
+        _file_findings(f, raised)
         # pragma hygiene: every allow() must carry a justification.  (An
         # allow() that matches nothing is harmless — checkers evolve — but
         # a silent one is an undocumented hole in the gate.)
@@ -265,18 +306,42 @@ def run_project(paths: list[str], root: str | None = None) -> dict:
                     )
                 )
 
+    # project-level checkers run once; their findings still honor pragmas
+    # when anchored to a file in the lint set
+    sections: dict[str, object] = {}
+    for spec in PROJECT_CHECKERS.values():
+        if spec.gated and not project.options.get(spec.id):
+            continue
+        raised = spec.fn(project)
+        if isinstance(raised, tuple):  # (findings, report-section payload)
+            raised, payload = raised
+            sections[spec.id.replace("-", "_")] = payload
+        for finding in raised:
+            f = by_rel.get(finding.path)
+            if f is not None:
+                pragma = f.allow_for(finding.line, finding.checker)
+                if pragma is not None and pragma.justification:
+                    finding.justification = pragma.justification
+                    suppressed.append(finding)
+                    continue
+            active.append(finding)
+
     active.sort(key=Finding.key)
     suppressed.sort(key=Finding.key)
     counts: dict[str, int] = {}
     for finding in active:
         counts[finding.checker] = counts.get(finding.checker, 0) + 1
-    return {
+    report = {
         "tool": "graftlint",
+        "engine": "v2",
         "root": os.path.basename(os.path.abspath(root)),
         "files": len(files),
-        "checkers": sorted(CHECKERS),
+        "checkers": sorted(set(CHECKERS) | set(PROJECT_CHECKERS)),
         "counts": counts,
+        "callgraph": project.callgraph.stats() if project._callgraph is not None else None,
         "findings": [x.as_dict() for x in active],
         "suppressed": [x.as_dict() for x in suppressed],
         "ok": not active,
     }
+    report.update(sections)
+    return report

@@ -1,0 +1,238 @@
+"""env-knob coherence: every ``KASPA_TPU_*`` site has a catalog row.
+
+The runtime has grown ~90 ``KASPA_TPU_*`` environment reads across 20+
+files; nothing ties them together, so knobs drift (two call sites reading
+the same variable with *different* literal defaults is a live bug class —
+the breaker threshold did exactly that).  This checker extracts a census
+of every knob site from the ASTs and reconciles it against the committed
+``KNOBS.md`` catalog at the lint root:
+
+- a knob read in code but absent from KNOBS.md  → finding at the read
+- a KNOBS.md row whose knob no longer has a site → finding at the row
+- a site whose literal default differs from the catalog default → finding
+- a catalog row with an empty Doc cell → finding (the catalog exists so
+  an operator can grep one file; an undocumented row defeats that)
+
+Dynamic names built from f-strings (``f"KASPA_TPU_WATCHDOG_{tier}_S"``)
+are censused with ``*`` in place of each interpolated piece and matched
+against a catalog row spelled the same way.
+
+``tools/lint.py --knobs`` regenerates KNOBS.md from the census,
+preserving hand-written Doc cells, so the fix for a stale catalog is one
+command.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from kaspa_tpu.analysis.core import Finding, Project, register_project_checker
+
+_KNOB_RE = re.compile(r"^KASPA_TPU_[A-Z0-9_]+$")
+_ROW_RE = re.compile(r"^\|\s*`([A-Z0-9_*]+)`\s*\|\s*(.*?)\s*\|\s*`?(.*?)`?\s*\|\s*(.*?)\s*\|\s*$")
+
+
+def _knob_name(node: ast.AST) -> str | None:
+    """The knob named by this expression: a literal, or an f-string with
+    ``*`` standing in for interpolated pieces."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if _KNOB_RE.match(node.value) else None
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        name = "".join(parts)
+        return name if name.startswith("KASPA_TPU_") else None
+    return None
+
+
+def _env_site(node: ast.AST):
+    """(knob, default-repr | None, kind) for an environment access node."""
+    # os.environ.get("K", default) / os.getenv("K", default) / env.get("K")
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in ("get", "getenv", "pop", "setdefault") and node.args:
+            knob = _knob_name(node.args[0])
+            if knob is not None:
+                default = None
+                # only get/getenv fallbacks are knob *defaults* (a pop(k,
+                # None) sentinel is cleanup, not configuration)
+                if (
+                    attr in ("get", "getenv")
+                    and len(node.args) > 1
+                    and isinstance(node.args[1], ast.Constant)
+                    and node.args[1].value is not None
+                ):
+                    default = repr(node.args[1].value)
+                return knob, default, "read"
+    # os.environ["K"] — read or write; both count as sites
+    if isinstance(node, ast.Subscript):
+        knob = _knob_name(node.slice)
+        if knob is not None:
+            return knob, None, "index"
+    return None
+
+
+def scan_knob_sites(project: Project) -> dict[str, list[dict]]:
+    """{knob: [{"rel", "line", "default"}...]} across the lint file set."""
+    census: dict[str, list[dict]] = {}
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            site = _env_site(node)
+            if site is None:
+                continue
+            knob, default, _kind = site
+            census.setdefault(knob, []).append(
+                {"rel": f.rel, "line": node.lineno, "default": default}
+            )
+    for sites in census.values():
+        sites.sort(key=lambda s: (s["rel"], s["line"]))
+    return census
+
+
+def _owner(sites: list[dict]) -> str:
+    """Owning module: the file providing a literal default, else the
+    first site."""
+    for s in sites:
+        if s["default"] is not None:
+            return s["rel"]
+    return sites[0]["rel"]
+
+
+def _catalog_default(sites: list[dict]) -> str:
+    """The most common literal default across sites (ties break on first
+    appearance); em-dash when no site supplies one."""
+    tally: dict[str, int] = {}
+    for s in sites:
+        if s["default"] is not None:
+            tally[s["default"]] = tally.get(s["default"], 0) + 1
+    if not tally:
+        return "—"
+    best = max(tally.values())
+    for s in sites:
+        if s["default"] is not None and tally[s["default"]] == best:
+            return f"`{s['default']}`"
+    return "—"
+
+
+def parse_knobs_md(text: str) -> dict[str, dict]:
+    """{knob: {"line", "default", "owner", "doc"}} from KNOBS.md rows."""
+    out: dict[str, dict] = {}
+    for i, raw in enumerate(text.splitlines(), start=1):
+        m = _ROW_RE.match(raw)
+        if m is None or set(m.group(1)) <= {"-"}:
+            continue
+        knob = m.group(1)
+        if not knob.startswith("KASPA_TPU_"):
+            continue
+        out[knob] = {
+            "line": i,
+            "default": m.group(2).strip(),
+            "owner": m.group(3).strip(),
+            "doc": m.group(4).strip(),
+        }
+    return out
+
+
+def render_knobs_md(census: dict[str, list[dict]], existing_text: str | None) -> str:
+    """The full KNOBS.md document; Doc cells survive regeneration."""
+    docs = {}
+    prior_defaults = {}
+    if existing_text:
+        rows = parse_knobs_md(existing_text)
+        docs = {k: row["doc"] for k, row in rows.items()}
+        prior_defaults = {k: row["default"] for k, row in rows.items()}
+    lines = [
+        "# KNOBS.md — `KASPA_TPU_*` environment knobs",
+        "",
+        "Generated by `python tools/lint.py --knobs` from the env-knob census;",
+        "the Doc column is hand-written and survives regeneration.  The",
+        "`env-knob` checker fails the lint gate when this file and the code",
+        "disagree (unknown knob, dead row, conflicting defaults, empty doc).",
+        "",
+        "| Knob | Default | Owner | Doc |",
+        "|------|---------|-------|-----|",
+    ]
+    for knob in sorted(census):
+        sites = census[knob]
+        # a committed default that is still observed at some site stays
+        # (site-default conflicts are resolved by choosing the committed
+        # one and pragma-ing the divergent site; don't flip-flop on regen)
+        observed = {f"`{s['default']}`" for s in sites if s["default"] is not None}
+        default = prior_defaults.get(knob)
+        if default not in observed:
+            default = _catalog_default(sites)
+        lines.append(f"| `{knob}` | {default} | `{_owner(sites)}` | {docs.get(knob, '')} |")
+    return "\n".join(lines) + "\n"
+
+
+@register_project_checker(
+    "env-knob",
+    "every KASPA_TPU_* environment read appears in KNOBS.md with a "
+    "matching default and a doc line, and every cataloged knob still has "
+    "a site (regen: tools/lint.py --knobs)",
+)
+def check_env_knobs(project: Project):
+    census = scan_knob_sites(project)
+    knobs_path = os.path.join(project.root, "KNOBS.md")
+    catalog: dict[str, dict] = {}
+    if os.path.isfile(knobs_path):
+        with open(knobs_path, encoding="utf-8") as fh:
+            catalog = parse_knobs_md(fh.read())
+
+    findings: list[Finding] = []
+    payload = {
+        "knobs": len(census),
+        "sites": sum(len(v) for v in census.values()),
+        "cataloged": len(catalog),
+    }
+    if not census and not catalog:
+        return findings, payload  # project doesn't use env knobs at all
+
+    for knob, sites in sorted(census.items()):
+        row = catalog.get(knob)
+        if row is None:
+            s = sites[0]
+            findings.append(
+                Finding(
+                    s["rel"], s["line"], "env-knob",
+                    f"{knob} is read here but missing from KNOBS.md — run "
+                    "`python tools/lint.py --knobs` and document it",
+                )
+            )
+            continue
+        if not row["doc"]:
+            findings.append(
+                Finding(
+                    "KNOBS.md", row["line"], "env-knob",
+                    f"{knob} has an empty Doc cell — one line on what it tunes",
+                )
+            )
+        # the committed row is the truth a site must match; a divergence
+        # pragma'd at one site must not re-flag the canonical one
+        expected = row["default"] if row["default"] not in ("", "—") else _catalog_default(sites)
+        for s in sites:
+            if s["default"] is not None and f"`{s['default']}`" != expected:
+                findings.append(
+                    Finding(
+                        s["rel"], s["line"], "env-knob",
+                        f"{knob} read with default {s['default']} here but "
+                        f"{expected} elsewhere/in KNOBS.md — one knob, one "
+                        "default (or pragma the deliberate divergence)",
+                    )
+                )
+    for knob, row in sorted(catalog.items()):
+        if knob not in census:
+            findings.append(
+                Finding(
+                    "KNOBS.md", row["line"], "env-knob",
+                    f"{knob} is cataloged but no longer read anywhere in the "
+                    "lint set — delete the row (or the knob regressed)",
+                )
+            )
+    return findings, payload

@@ -1,0 +1,498 @@
+"""Resource-lifecycle protocol checking (graftlint v2).
+
+Every subsystem promises ``lost == 0``: a Ticket / AdmissionTicket handed
+out MUST resolve exactly once on every path; a flight span MUST close; a
+``faults.suppress()`` is a context manager, not a statement.  Those
+contracts were enforced only dynamically (the sustain drills count lost
+tickets after the fact) — this module enforces them at lint time with a
+branch-sensitive walk over each function body.
+
+Protocol registry (``PROTOCOLS``) — each entry names how a tracked value
+is *acquired*, which method calls *resolve* it, and what counts as an
+*escape* (ownership transfer: returned, passed to a call, stored into an
+attribute/container — after which resolution is someone else's job):
+
+- ``ticket``:  ``x = <recv>.submit(...)`` / ``x = <recv>.admit(...)`` /
+  ``x = Ticket(...)`` / ``x = AdmissionTicket(...)``.  Resolved by
+  ``.wait()`` / ``.resolve()`` / ``._resolve()`` / ``.cancel()``.  A path
+  that returns or falls off the function with the value still pending
+  drops the ticket — exactly the early-return bug class the overload
+  plane had to hand-patch.  Resolving twice on one path is also a
+  finding (``lost == 0`` is an exactly-once contract, not at-least-once).
+- ``span``:    ``trace.span(...)`` must be entered — a with-item, or
+  escaped to a caller; a bare/assigned-and-never-entered span silently
+  detaches its subtree from the block trace.
+- ``suppress``: ``faults.suppress()`` returns a context manager; calling
+  it as a statement arms nothing and the next injected fault fires
+  through the "suppressed" section.
+
+Exception paths: raise-exits do NOT require resolution (the exception
+propagates — the caller never received the value), matching how
+``submit()`` surfaces shutdown.  The separate ``exception-path`` checker
+instead flags manual ``lock.acquire()`` followed by raise-reachable calls
+(per the call graph's fixpoint may-raise fact) without ``try/finally``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kaspa_tpu.analysis.blocking import _terminal_name, is_lock_expr
+from kaspa_tpu.analysis.core import Finding, Project, SourceFile, register_checker
+
+# -- protocol registry -------------------------------------------------------
+
+ACQUIRE_METHODS = {"submit", "admit"}  # x = recv.submit(...) hands out a ticket
+ACQUIRE_CTORS = {"Ticket", "AdmissionTicket"}
+# .submit()/.admit() only hands out a ticket on dispatcher-like receivers
+# (bridge.submit() returns a bool; pool.submit() fire-and-forget is fine)
+_RECV_HINTS = ("ingest", "dispatch", "engine", "pool", "tier", "executor", "coalesc")
+# producer side resolves exactly once; calling twice on one path is a bug
+PRODUCER_RESOLVE = {"resolve", "_resolve", "cancel"}
+# consumer side: waiting/consuming the outcome discharges the obligation
+# and may legitimately repeat (wait() then raise_for_status())
+CONSUMER_RESOLVE = {"wait", "raise_for_status"}
+RESOLVE_METHODS = PRODUCER_RESOLVE | CONSUMER_RESOLVE
+# reading the outcome fields consumes an (already-resolved) ticket too —
+# ingest.admit() returns resolved tickets whose callers branch on .status
+CONSUME_ATTRS = {"status", "error", "evicted"}
+# pure queries that must NOT count as resolution (reading liveness keeps
+# the obligation alive — `if t.done()` is exactly the early-return shape)
+QUERY_METHODS = {"done", "stats", "render"}
+
+PROTOCOLS = {
+    "ticket": {
+        "description": "Ticket/AdmissionTicket must resolve exactly once on every path",
+        "acquire_methods": ACQUIRE_METHODS,
+        "acquire_ctors": ACQUIRE_CTORS,
+        "resolve": RESOLVE_METHODS,
+    },
+    "span": {"description": "flight spans must close (use `with trace.span(...)`)"},
+    "suppress": {"description": "faults.suppress() must be a context manager"},
+}
+
+_PENDING, _RESOLVED, _ESCAPED = "pending", "resolved", "escaped"
+_MAX_STATES = 32  # path-merge cap: beyond this, pessimistically union
+
+
+class _PathReport:
+    def __init__(self):
+        self.findings: list[tuple] = []  # (line, message) dedup'd
+        self._seen: set[tuple] = set()
+
+    def add(self, line: int, message: str) -> None:
+        key = (line, message)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.findings.append(key)
+
+
+def _is_acquire_call(value: ast.AST) -> int | None:
+    """Acquire line if this expression hands out a tracked ticket value."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _terminal_name(value.func)
+    if isinstance(value.func, ast.Attribute) and name in ACQUIRE_METHODS:
+        recv = _terminal_name(value.func.value).lower()
+        if any(h in recv for h in _RECV_HINTS):
+            return value.lineno
+        return None
+    if isinstance(value.func, ast.Name) and name in ACQUIRE_CTORS:
+        return value.lineno
+    return None
+
+
+def _mentions(expr: ast.AST | None, names) -> set[str]:
+    if expr is None:
+        return set()
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name) and n.id in names}
+
+
+def _process_expr(expr: ast.AST | None, state: dict, report: _PathReport) -> None:
+    """Update ticket states for one expression: resolve-method calls mark
+    resolved (twice = finding), passing the value anywhere marks escaped."""
+    if expr is None:
+        return
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
+            v = n.value.id
+            if v in state and n.attr in CONSUME_ATTRS and state[v][0] == _PENDING:
+                state[v] = (_ESCAPED, n.lineno)  # outcome consumed by field read
+        if not isinstance(n, ast.Call):
+            continue
+        if isinstance(n.func, ast.Attribute) and isinstance(n.func.value, ast.Name):
+            v = n.func.value.id
+            if v in state:
+                if n.func.attr in PRODUCER_RESOLVE:
+                    if state[v][0] == _RESOLVED:
+                        report.add(
+                            n.lineno,
+                            f"`{v}` resolved twice on one path (first at line "
+                            f"{state[v][1]}): tickets resolve exactly once",
+                        )
+                    state[v] = (_RESOLVED, n.lineno)
+                elif n.func.attr in CONSUMER_RESOLVE and state[v][0] == _PENDING:
+                    state[v] = (_ESCAPED, n.lineno)
+                # queries and other attribute access keep the obligation
+        for a in list(n.args) + [k.value for k in n.keywords]:
+            for v in _mentions(a, state):
+                if state[v][0] == _PENDING:
+                    state[v] = (_ESCAPED, n.lineno)
+
+
+def _check_exit(state: dict, line: int, report: _PathReport, why: str) -> None:
+    for v, (status, acq_line) in state.items():
+        if status == _PENDING:
+            report.add(
+                acq_line,
+                f"ticket `{v}` acquired here may go unresolved: {why} at line "
+                f"{line} drops it (resolve, return, or hand it off on every path)",
+            )
+
+
+def _merge(states: list[dict]) -> list[dict]:
+    uniq: list[dict] = []
+    for st in states:
+        if st not in uniq:
+            uniq.append(st)
+    if len(uniq) <= _MAX_STATES:
+        return uniq
+    # pessimistic union: a var is pending if pending in ANY state
+    merged: dict = {}
+    for st in uniq:
+        for v, val in st.items():
+            if v not in merged or val[0] == _PENDING:
+                merged[v] = val
+    return [merged]
+
+
+def _exec_block(stmts: list, states: list[dict], report: _PathReport) -> list[tuple]:
+    """Abstractly execute a statement list; returns [(exit_kind, state)]
+    with exit_kind in {"fall", "return", "raise", "break", "continue"}."""
+    exits: list[tuple] = []
+    for stmt in stmts:
+        new_states: list[dict] = []
+        for st in states:
+            for kind, st2 in _exec_stmt(stmt, st, report):
+                if kind == "fall":
+                    new_states.append(st2)
+                else:
+                    exits.append((kind, st2))
+        states = _merge(new_states)
+        if not states:
+            break
+    exits.extend(("fall", st) for st in states)
+    return exits
+
+
+def _exec_stmt(stmt: ast.AST, state: dict, report: _PathReport) -> list[tuple]:
+    state = dict(state)
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return [("fall", state)]  # nested defs run later, elsewhere
+    if isinstance(stmt, ast.Return):
+        _process_expr(stmt.value, state, report)
+        for v in _mentions(stmt.value, state):
+            if state[v][0] == _PENDING:
+                state[v] = (_ESCAPED, stmt.lineno)
+        _check_exit(state, stmt.lineno, report, "return")
+        return [("return", state)]
+    if isinstance(stmt, ast.Raise):
+        # the exception propagates: the caller never received the value,
+        # so a pending ticket on a raise path is NOT a drop
+        return [("raise", state)]
+    if isinstance(stmt, (ast.Break, ast.Continue)):
+        return [("break" if isinstance(stmt, ast.Break) else "continue", state)]
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        value = stmt.value
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        acq = _is_acquire_call(value) if isinstance(stmt, ast.Assign) else None
+        if acq is not None and len(targets) == 1 and isinstance(targets[0], ast.Name):
+            v = targets[0].id
+            if v in state and state[v][0] == _PENDING:
+                report.add(
+                    state[v][1],
+                    f"ticket `{v}` acquired here is overwritten at line "
+                    f"{stmt.lineno} while still unresolved",
+                )
+            state[v] = (_PENDING, acq)
+            return [("fall", state)]
+        _process_expr(value, state, report)
+        # storing a tracked value into an attribute/subscript/container
+        # transfers ownership
+        if any(not isinstance(t, ast.Name) for t in targets):
+            for v in _mentions(value, state):
+                if state[v][0] == _PENDING:
+                    state[v] = (_ESCAPED, stmt.lineno)
+        else:
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in state and state[t.id][0] == _PENDING:
+                    # plain reassignment drops the pending value
+                    if not _mentions(value, {t.id}):
+                        report.add(
+                            state[t.id][1],
+                            f"ticket `{t.id}` acquired here is overwritten at "
+                            f"line {stmt.lineno} while still unresolved",
+                        )
+                        del state[t.id]
+        return [("fall", state)]
+    if isinstance(stmt, ast.Expr):
+        _process_expr(stmt.value, state, report)
+        return [("fall", state)]
+    if isinstance(stmt, ast.If):
+        _process_expr(stmt.test, state, report)
+        return _exec_block(stmt.body, [dict(state)], report) + _exec_block(
+            stmt.orelse, [dict(state)], report
+        )
+    if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+        if isinstance(stmt, ast.While):
+            _process_expr(stmt.test, state, report)
+        else:
+            _process_expr(stmt.iter, state, report)
+        body_exits = _exec_block(stmt.body, [dict(state)], report)
+        after: list[dict] = [dict(state)]  # zero iterations
+        out: list[tuple] = []
+        for kind, st in body_exits:
+            if kind in ("fall", "break", "continue"):
+                after.append(st)
+            else:
+                out.append((kind, st))
+        out.extend(_exec_block(stmt.orelse, _merge(after), report))
+        return out
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            _process_expr(item.context_expr, state, report)
+        return _exec_block(stmt.body, [dict(state)], report)
+    if isinstance(stmt, ast.Try):
+        body_exits = _exec_block(stmt.body, [dict(state)], report)
+        out: list[tuple] = []
+        fall_states: list[dict] = []
+        for kind, st in body_exits:
+            if kind == "fall":
+                fall_states.append(st)
+            elif kind == "raise" and stmt.handlers:
+                pass  # swallowed: handler paths below model it
+            else:
+                out.append((kind, st))
+        for h in stmt.handlers:
+            out.extend(_exec_block(h.body, [dict(state)], report))
+        out.extend(_exec_block(stmt.orelse, _merge(fall_states), report))
+        if stmt.finalbody:
+            final_out: list[tuple] = []
+            for kind, st in out:
+                for fkind, fst in _exec_block(stmt.finalbody, [st], report):
+                    final_out.append((fkind if fkind != "fall" else kind, fst))
+            out = final_out
+        return out
+    # anything else (pass, assert, del, global, import...) — process
+    # embedded expressions conservatively and fall through
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, ast.expr):
+            _process_expr(child, state, report)
+    return [("fall", state)]
+
+
+def _has_acquire(fn_node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call) and _is_acquire_call(n) is not None
+        for n in ast.walk(fn_node)
+    )
+
+
+@register_checker(
+    "resource-lifecycle",
+    "protocol values (Ticket/AdmissionTicket resolve exactly once per "
+    "path; flight spans close; faults.suppress() is a context manager) "
+    "tracked through branches and returns",
+)
+def check_resource_lifecycle(project: Project, f: SourceFile) -> list[Finding]:
+    out: list[Finding] = []
+    # -- ticket protocol: branch-sensitive per-function walk ---------------
+    for node in ast.walk(f.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _has_acquire(node):
+            continue
+        report = _PathReport()
+        exits = _exec_block(node.body, [{}], report)
+        end_line = node.body[-1].end_lineno or node.body[-1].lineno
+        for kind, st in exits:
+            if kind == "fall":
+                _check_exit(st, end_line, report, "falling off the function")
+        for line, message in sorted(report.findings):
+            out.append(Finding(f.rel, line, "resource-lifecycle", message))
+    # -- span + suppress protocols: structural, whole-file -----------------
+    out.extend(_check_span_and_suppress(f))
+    return out
+
+
+def _check_span_and_suppress(f: SourceFile) -> list[Finding]:
+    out: list[Finding] = []
+    with_items: set[int] = set()  # id() of context_expr nodes
+    assigned_spans: dict[str, int] = {}
+    entered_names: set[str] = set()
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_items.add(id(item.context_expr))
+                name = _terminal_name(item.context_expr)
+                if isinstance(item.context_expr, ast.Name):
+                    entered_names.add(item.context_expr.id)
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name == "span" and _span_receiver_ok(node):
+            if id(node) in with_items:
+                continue
+            parent_assign = _assigned_name(f.tree, node)
+            if parent_assign is not None and parent_assign in entered_names:
+                continue  # `sp = trace.span(...)` later entered via `with sp:`
+            if _escapes(f.tree, node):
+                continue  # returned / passed on: the receiver must close it
+            out.append(
+                Finding(
+                    f.rel, node.lineno, "resource-lifecycle",
+                    "flight span is never entered/closed: use `with "
+                    "trace.span(...)` so the subtree stays attached to the "
+                    "block trace",
+                )
+            )
+        elif name == "suppress" and _suppress_receiver_ok(node):
+            if id(node) not in with_items:
+                out.append(
+                    Finding(
+                        f.rel, node.lineno, "resource-lifecycle",
+                        "faults.suppress() returns a context manager — calling "
+                        "it as a statement arms nothing (write `with "
+                        "faults.suppress():`)",
+                    )
+                )
+    return out
+
+
+def _span_receiver_ok(node: ast.Call) -> bool:
+    if isinstance(node.func, ast.Attribute):
+        return _terminal_name(node.func.value) == "trace"
+    return False  # bare span(...) is too generic a name to police
+
+
+def _suppress_receiver_ok(node: ast.Call) -> bool:
+    if isinstance(node.func, ast.Attribute):
+        recv = _terminal_name(node.func.value).lower()
+        return "fault" in recv  # faults / faults_mod / FAULTS
+    return False
+
+
+def _assigned_name(tree: ast.AST, call: ast.Call) -> str | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                return node.targets[0].id
+    return None
+
+
+def _escapes(tree: ast.AST, call: ast.Call) -> bool:
+    """Is this call expression returned, yielded, or an argument?"""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Return, ast.Yield)) and node.value is not None:
+            if any(n is call for n in ast.walk(node.value)):
+                return True
+        if isinstance(node, ast.Call) and node is not call:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if any(n is call for n in ast.walk(a)):
+                    return True
+    return False
+
+
+# -- exception-path analysis -------------------------------------------------
+
+
+@register_checker(
+    "exception-path",
+    "manual lock.acquire() followed by a raise-reachable call (fixpoint "
+    "may-raise fact) before .release() without try/finally — the lock "
+    "leaks on the exception path",
+)
+def check_exception_path(project: Project, f: SourceFile) -> list[Finding]:
+    from kaspa_tpu.analysis.checkers import _site_for, walk_with_context
+
+    out: list[Finding] = []
+    graph = project.callgraph
+    for node, cls, _fn in walk_with_context(f.tree):
+        body = getattr(node, "body", None)
+        if not isinstance(body, list):
+            continue
+        for i, stmt in enumerate(body):
+            recv = _manual_acquire(stmt)
+            if recv is None:
+                continue
+            # `x.acquire()` immediately wrapped in try/finally-with-release
+            # is the blessed shape
+            if i + 1 < len(body) and _protected_release(body[i + 1], recv):
+                continue
+            risky = _risky_before_release(body[i + 1 :], recv, graph, f.rel, cls)
+            if risky is not None:
+                out.append(
+                    Finding(
+                        f.rel, stmt.lineno, "exception-path",
+                        f"{recv}.acquire() leaks on an exception path: "
+                        f"{risky[1]} at line {risky[0]} can raise before "
+                        f".release() — wrap in try/finally",
+                    )
+                )
+    return out
+
+
+def _manual_acquire(stmt: ast.AST) -> str | None:
+    if (
+        isinstance(stmt, ast.Expr)
+        and isinstance(stmt.value, ast.Call)
+        and isinstance(stmt.value.func, ast.Attribute)
+        and stmt.value.func.attr == "acquire"
+        and is_lock_expr(stmt.value.func.value)
+    ):
+        return _terminal_name(stmt.value.func.value)
+    return None
+
+
+def _protected_release(stmt: ast.AST, recv: str) -> bool:
+    if not isinstance(stmt, ast.Try) or not stmt.finalbody:
+        return False
+    return any(_is_release(s, recv) for s in stmt.finalbody)
+
+
+def _is_release(stmt: ast.AST, recv: str) -> bool:
+    return (
+        isinstance(stmt, ast.Expr)
+        and isinstance(stmt.value, ast.Call)
+        and isinstance(stmt.value.func, ast.Attribute)
+        and stmt.value.func.attr == "release"
+        and _terminal_name(stmt.value.func.value) == recv
+    )
+
+
+def _risky_before_release(stmts: list, recv: str, graph, rel: str, cls: str):
+    """(line, what) of the first raise-reachable operation between the
+    acquire and the matching release in this block, or None when the
+    release never appears (released elsewhere — out of scope) or nothing
+    risky sits in between."""
+    from kaspa_tpu.analysis.checkers import _site_for
+
+    risky = None
+    saw_release = False
+    for stmt in stmts:
+        if _is_release(stmt, recv):
+            saw_release = True
+            break
+        if risky is None:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Raise):
+                    risky = (n.lineno, "explicit raise")
+                    break
+                if isinstance(n, ast.Call):
+                    site = _site_for(n)
+                    target = graph.resolve_site(site, rel, cls)
+                    if target is not None and target.may_raise:
+                        risky = (n.lineno, f"{site.name}() (may raise)")
+                        break
+    return risky if (saw_release and risky is not None) else None

@@ -27,6 +27,8 @@ import math
 import secrets
 import socketserver
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 
 from kaspa_tpu.consensus import hashing as chash
@@ -135,7 +137,7 @@ class ShareHandler:
         self.clamp_pow2 = clamp_pow2
         self.now = now
         self.workers: dict[str, WorkerStats] = {}
-        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- leaf stats guard in the stratum sidecar; never nests
+        self._mu = ranked_lock("stratum.stats")
 
     def worker(self, name: str) -> WorkerStats:
         with self._mu:
@@ -185,7 +187,7 @@ class MiningState:
         self._jobs: dict[int, object] = {}
         self._next = 0
         self._seen_shares: set = set()
-        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- leaf share-dedup guard in the stratum sidecar; never nests
+        self._mu = ranked_lock("stratum.shares")
         self.shares_accepted = 0
         self.shares_stale = 0
         self.shares_duplicate = 0

@@ -11,6 +11,8 @@ from __future__ import annotations
 import signal
 import threading
 
+from kaspa_tpu.utils.sync import ranked_lock
+
 from kaspa_tpu.core.log import get_logger
 
 log = get_logger("core")
@@ -65,9 +67,9 @@ class Core:
         self.keep_running.set()
         self._services: list[Service] = []
         self._workers: list[threading.Thread] = []
-        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- service-list guard in the generic runner; no ranked lock is ever taken under it
+        self._mu = ranked_lock("service.list")
         self._shutdown_once = threading.Event()
-        self._shutdown_mu = threading.Lock()  # graftlint: allow(raw-lock) -- shutdown-once latch; held only to flip a flag
+        self._shutdown_mu = ranked_lock("service.shutdown")
 
     def bind(self, service: Service) -> None:
         with self._mu:

@@ -14,13 +14,15 @@ import os
 import subprocess
 import threading
 
+from kaspa_tpu.utils.sync import ranked_lock
+
 import numpy as np
 
 _CONSTANTS = np.array([0x61707865, 0x3320646E, 0x79622D32, 0x6B206574], dtype=np.uint32)
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "hostcrypto", "hostcrypto.cc")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "native", "hostcrypto", "libhostcrypto.so")
-_LOCK = threading.Lock()  # graftlint: allow(raw-lock) -- one-shot native build guard at import depth; below any subsystem rank
+_LOCK = ranked_lock("chacha.build")
 _LIB = None
 _LIB_FAILED = False
 

@@ -199,7 +199,10 @@ class _Batch:
                 # would skip the split and pay a surprise compile wall
                 _seen_shapes.discard(shape_key)
                 raise
-            supervisor.note_shape(kernel.__name__, b)
+            supervisor.note_shape(
+                kernel.__name__, b,
+                family="ecdsa" if "ecdsa" in kernel.__name__ else "ladder",
+            )
         else:
             mask = kernel(*args)
         return np.asarray(mask)[:n]
@@ -701,6 +704,16 @@ def pretrace_bucket(kernel_name: str, bucket: int) -> str:
     """
     if kernel_name == _AGG_KERNEL_NAME:
         return _pretrace_aggregate_bucket(bucket) if bucket >= 8 else f"error:unknown {kernel_name}/{bucket}"
+    if kernel_name == "muhash_tree":
+        from kaspa_tpu.ops import muhash_ops
+
+        def _dispatch():
+            return muhash_ops.pretrace_bucket(bucket)
+
+        try:
+            return supervisor.run_supervised(_dispatch, tier="compile", kernel=kernel_name, jobs=bucket)
+        except Exception as e:  # noqa: BLE001 - pretrace is best-effort
+            return f"error:{type(e).__name__}"
     kernel = _PRETRACE_KERNELS.get(kernel_name)
     if kernel is None or bucket < 8:
         return f"error:unknown {kernel_name}/{bucket}"

@@ -138,6 +138,7 @@ class FabricBalancer:
             if br is None:
                 br = CircuitBreaker(
                     f"fabric[{conn.addr}#{i}]",
+                    # graftlint: allow(env-knob) -- remote slices fail fast on purpose: a slice two strikes down should stop taking traffic before the deadline tax compounds
                     failure_threshold=int(os.environ.get("KASPA_TPU_BREAKER_THRESHOLD", "2")),
                 )
                 br.set_managed(True)  # only the STATUS canary probes while OPEN

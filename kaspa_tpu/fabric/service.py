@@ -249,6 +249,7 @@ def main(argv=None) -> int:
     ap.add_argument("--slices", type=int, default=None,
                     help="slice worker lanes (default: mesh slice count)")
     ap.add_argument("--mesh", default=None, help="device mesh spec (N | auto | RxC)")
+    # graftlint: allow(env-knob) -- verifyd exists to batch: its CLI default is sweep-seeded auto, deliberately diverging from the in-node default of off
     ap.add_argument("--coalesce", default=os.environ.get("KASPA_TPU_COALESCE", "auto"),
                     help="local coalescing target feeding the slices (N | auto | off)")
     ap.add_argument("--verify-mode", default=None, choices=("ladder", "aggregate", "auto"),

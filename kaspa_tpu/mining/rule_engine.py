@@ -13,6 +13,8 @@ to revive it.
 from __future__ import annotations
 
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 from collections import deque
 
@@ -30,7 +32,7 @@ class SyncRateRule:
         self._samples: deque[tuple[int, float]] = deque()  # graftlint: allow(unbounded-queue) -- trimmed to the sliding window by check_rule on every sample
         self._total_received = 0
         self._total_expected = 0.0
-        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- leaf difficulty-stats guard; never nests
+        self._mu = ranked_lock("mining.stats")
 
     def check_rule(self, received_blocks: int, expected_blocks: float, finality_recent: bool) -> None:
         with self._mu:

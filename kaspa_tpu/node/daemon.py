@@ -803,6 +803,7 @@ class Daemon:
             txid = self.rpc.submit_transaction(tx)
             return txid.hex()
         with self._dispatch_lock:
+            # graftlint: allow(blocking-under-lock) -- RPC mutation path serializes consensus work by design; device round trips run under the dispatch lock deliberately
             return self._dispatch(method, params)
 
     def _dispatch(self, method: str, params: dict):
@@ -876,13 +877,19 @@ class Daemon:
             except (UpnpError, OSError, _http_client.HTTPException) as e:
                 self.log.info("UPnP unavailable: %s", e)
                 return
+            stale = None
             with self._upnp_lock:
                 if self._upnp_stopped:
                     # the daemon shut down while discovery was in flight:
                     # tear the fresh mapping down instead of leaking it
-                    extender.stop()
-                    return
-                self.upnp_extender = extender
+                    stale = extender
+                else:
+                    self.upnp_extender = extender
+            if stale is not None:
+                # outside the lock: stop() joins the renewal thread, and a
+                # join under daemon.upnp would stall the shutdown path
+                stale.stop()
+                return
             if self.address_manager is not None:
                 from kaspa_tpu.p2p.address_manager import NetAddress
 
@@ -970,10 +977,12 @@ class Daemon:
                 sink_ts = ConsensusApi(self.consensus).get_sink_timestamp()
                 if not self.rule_engine.should_mine(sink_ts):
                     raise ValueError("node is not synced: block templates unavailable")
+                # graftlint: allow(blocking-under-lock) -- template build runs consensus (and its device waves) under the dispatch lock by design, same gate as the RPC path
                 return self.mining.get_block_template(miner_data)
 
         def submit(block):
             with self._dispatch_lock:
+                # graftlint: allow(blocking-under-lock) -- stratum submit serializes with the RPC mutation path; insert+unorphan device waits are the locked section's job
                 return self.node.submit_block(block)
 
         bridge = StratumBridge(template_source, submit)
@@ -1024,6 +1033,7 @@ class Daemon:
             self.address_manager.add_address(na)
             self.address_manager.mark_connection_success(na)
         with self.node.lock:
+            # graftlint: allow(blocking-under-lock) -- connect-path IBD kick runs the flow under the node lock; handlers assume it, and batch-verify waits are the IBD design
             self.node.ibd_from(peer)
         return peer
 
@@ -1072,12 +1082,17 @@ class Daemon:
             supervisor.shutdown()
         # serving tier down before the stores: the broadcaster detaches from
         # the notifier (no new fanout), then the index unhooks its listener
-        # and closes its own db — both idempotent, stop() may race itself
+        # and closes its own db.  Snapshot-and-null under the lock, close
+        # outside it: broadcaster.close() joins the fanout thread, and a
+        # racing stop() sees None instead of double-closing
         with self._dispatch_lock:
-            if getattr(self, "broadcaster", None) is not None:
-                self.broadcaster.close()
-            if self.utxoindex is not None:
-                self.utxoindex.close()
+            bc = getattr(self, "broadcaster", None)
+            self.broadcaster = None
+            ui, self.utxoindex = self.utxoindex, None
+        if bc is not None:
+            bc.close()
+        if ui is not None:
+            ui.close()
         # quiesce dispatch before closing the native handle: an in-flight
         # handler finishes under the lock; later ones see db == None and
         # stage() no-ops (server is already down, nothing new arrives).

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import threading
 import weakref
+
+from kaspa_tpu.utils.sync import ranked_lock
 from bisect import bisect_left
 
 # log-spaced latency edges in SECONDS: 10 µs .. 10 s (spans, dispatch, IO)
@@ -214,7 +216,7 @@ class Registry:
     """
 
     def __init__(self):
-        self._mu = threading.Lock()  # graftlint: allow(raw-lock) -- metrics registry leaf; imported by utils-adjacent layers, ranking it would cycle the import DAG
+        self._mu = ranked_lock("observability.registry")
         self._counters: dict[str, Counter | CounterFamily] = {}
         self._histograms: dict[str, Histogram | HistogramFamily] = {}
         # name -> list of weakref-able callables contributing gauge trees

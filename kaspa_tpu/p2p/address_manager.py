@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 from dataclasses import dataclass, field
 
@@ -52,7 +54,7 @@ class AddressManager:
         self._banned: dict[str, int] = {}  # ip -> ban timestamp ms
         # our own publicly routable addresses: gossiped, never dialed
         self.local_addresses: set[NetAddress] = set()
-        self._lock = threading.RLock()  # graftlint: allow(raw-lock) -- address-book leaf guard; no ranked lock taken while held
+        self._lock = ranked_lock("p2p.addressbook")
         self._rng = random.Random(0xADD7)
 
     def add_local_address(self, address: NetAddress) -> None:
@@ -195,7 +197,7 @@ class ConnectionManager:
         self._clock = time.monotonic
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._lock = threading.RLock()  # graftlint: allow(raw-lock) -- connection-manager bookkeeping leaf; no ranked lock taken while held
+        self._lock = ranked_lock("p2p.connmgr")
 
     def add_connection_request(self, address: NetAddress, is_permanent: bool = False) -> None:
         with self._lock:
@@ -236,6 +238,7 @@ class ConnectionManager:
             # per-peer IBD flow kicks off on connect (flow registration);
             # _on_chain_info no-ops when the peer has nothing we lack
             with self.node.lock:
+                # graftlint: allow(blocking-under-lock) -- dial-path IBD kick mirrors the daemon connect path: flow handlers run under the node lock by design
                 self.node.ibd_from(peer)
             return True
         except (OSError, ConnectionError):

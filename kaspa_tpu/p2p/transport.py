@@ -238,6 +238,7 @@ class WirePeer:
                 _BYTES_RX.inc(nbytes)
                 _MSGS_RX.inc(msg_type)
                 with self.node.lock:
+                    # graftlint: allow(blocking-under-lock) -- every p2p message is handled under the node lock (the node's serialization point); IBD batch inserts legitimately wait on verify futures there
                     self.node._handle(self, msg_type, payload)
                 if self.handshaken and not steady:
                     steady = True

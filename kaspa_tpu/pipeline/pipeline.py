@@ -279,6 +279,7 @@ class ConsensusPipeline:
                             consensus._update_tips(task.block.hash)
                         # one virtual resolution absorbs the whole cycle: chain
                         # verification batches signatures across these blocks
+                        # graftlint: allow(blocking-under-lock) -- the virtual cycle's device work runs under the pipeline lock by design: the pipeline thread is the sole consumer and the watchdog monitors progress
                         consensus._resolve_virtual()
                         consensus.storage.flush()
                     t_v1 = perf_counter_ns()

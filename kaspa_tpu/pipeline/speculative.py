@@ -198,6 +198,7 @@ class SpeculativeVerifier:
                     return None
 
                 checker = c.transaction_validator.new_checker()
+                # graftlint: allow(blocking-under-lock) -- unreachable sync branch: checker is supplied, so _validate_transactions inside never takes its synchronous dispatch() path here
                 ctx = c._calculate_utxo_state(
                     gd, header.daa_score, base=base, seed_multiset=seed, checker=checker
                 )

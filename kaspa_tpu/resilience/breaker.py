@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 
 from kaspa_tpu.observability.core import REGISTRY
@@ -208,7 +210,7 @@ class CircuitBreaker:
 
 
 _device_breaker: CircuitBreaker | None = None
-_device_lock = threading.Lock()  # graftlint: allow(raw-lock) -- process-wide device-breaker slot guard; held only for the swap
+_device_lock = ranked_lock("breaker.slot")
 
 
 def device_breaker() -> CircuitBreaker:

@@ -42,6 +42,8 @@ import json
 import os
 import queue
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 import time
 from contextlib import contextmanager
 
@@ -130,7 +132,7 @@ class _Job:
     def __init__(self, fn):
         self.fn = fn
         self.event = threading.Event()
-        self.lock = threading.Lock()  # graftlint: allow(raw-lock) -- per-task result latch in the watchdog pool; never nests
+        self.lock = ranked_lock("watchdog.task")
         self.result = None
         self.error: BaseException | None = None
         self.abandoned = False
@@ -179,7 +181,7 @@ class WorkerPool:
     did without the watchdog."""
 
     def __init__(self, max_idle: int = 2):
-        self._lock = threading.Lock()  # graftlint: allow(raw-lock) -- worker-pool freelist guard; never nests
+        self._lock = ranked_lock("watchdog.pool")
         self._free: list[_Worker] = []
         self._max_idle = max_idle
         self.completed = 0
@@ -250,7 +252,7 @@ class WorkerPool:
 
 _POOL = WorkerPool()
 
-_stats_lock = threading.Lock()  # graftlint: allow(raw-lock) -- watchdog stats leaf; never nests
+_stats_lock = ranked_lock("watchdog.stats")
 _REQUEUE_STATS = {"batches": 0, "jobs": 0}
 
 
@@ -304,7 +306,7 @@ def verdict() -> dict:
 
 # --- warm-kernel manifest (persistent compiled-kernel cache index) --------
 
-_manifest_lock = threading.Lock()  # graftlint: allow(raw-lock) -- warm-manifest file guard; held around json io only, no ranked lock under it
+_manifest_lock = ranked_lock("supervisor.manifest")
 _pretrace_report: list | None = None
 
 
@@ -490,7 +492,7 @@ class CanaryProber(threading.Thread):
 
 # --- install / shutdown ---------------------------------------------------
 
-_install_lock = threading.Lock()  # graftlint: allow(raw-lock) -- install/shutdown slot guard; held only for the swap
+_install_lock = ranked_lock("supervisor.install")
 _install_count = 0
 _prober: CanaryProber | None = None
 

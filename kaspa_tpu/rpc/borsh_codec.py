@@ -640,6 +640,7 @@ def handle_frame(daemon, data: bytes, notification_sink=None, subscriber_ref=Non
             w = io.BytesIO()
             try:
                 with daemon._dispatch_lock:
+                    # graftlint: allow(blocking-under-lock) -- borsh submit serializes with the RPC mutation path under the dispatch lock; insert+unorphan device waits are deliberate
                     daemon.node.submit_block(block)
                 encode_submit_block_response(w, None)
             except (RuleError, ValueError) as e:

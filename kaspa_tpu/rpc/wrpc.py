@@ -28,6 +28,8 @@ import socketserver
 import struct
 import threading
 
+from kaspa_tpu.utils.sync import ranked_lock
+
 from kaspa_tpu.core.log import get_logger
 
 log = get_logger("wrpc")
@@ -338,7 +340,7 @@ class WrpcClient:
         self.notifications: queue.Queue = queue.Queue()
         self.borsh_notifications: queue.Queue = queue.Queue()  # graftlint: allow(unbounded-queue) -- client-side test helper; lives for one scripted exchange
         self._next_id = 0
-        self._id_lock = threading.Lock()  # graftlint: allow(raw-lock) -- request-id counter leaf in the client helper
+        self._id_lock = ranked_lock("wrpc.ids")
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="wrpc-client-reader")
         self._reader.start()
 

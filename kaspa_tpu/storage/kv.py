@@ -18,6 +18,8 @@ import struct
 import subprocess
 import threading
 
+from kaspa_tpu.utils.sync import ranked_lock
+
 from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.resilience.faults import FAULTS, FaultInjected
 
@@ -30,7 +32,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvs
 _SRC = os.path.join(_NATIVE_DIR, "kvstore.cc")
 _HEADERS = (os.path.join(_NATIVE_DIR, "arena.h"),)
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libkvstore.so")
-_BUILD_LOCK = threading.Lock()  # graftlint: allow(raw-lock) -- one-shot native build guard at import depth; below any subsystem rank
+_BUILD_LOCK = ranked_lock("storage.build")
 
 
 def _src_mtime() -> float:

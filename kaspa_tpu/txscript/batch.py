@@ -29,6 +29,8 @@ from __future__ import annotations
 import functools
 import os
 import threading
+
+from kaspa_tpu.utils.sync import ranked_lock
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from time import perf_counter_ns
@@ -62,7 +64,7 @@ def _default_fallback_workers() -> int:
     return max(2, min(8, os.cpu_count() or 2))
 
 
-_pool_lock = threading.Lock()  # graftlint: allow(raw-lock) -- VM fallback pool slot guard; held only for the swap
+_pool_lock = ranked_lock("txscript.pool")
 _pool: ThreadPoolExecutor | None = None
 
 
@@ -136,7 +138,7 @@ def _run_fallback(job: _FallbackJob) -> Exception | None:
 
 # in-flight accounting for the shared pool so daemon shutdown can drain
 # the deferred VM lane instead of abandoning futures mid-dispatch
-_inflight_lock = threading.Lock()  # graftlint: allow(raw-lock) -- in-flight counter leaf for shutdown drain accounting
+_inflight_lock = ranked_lock("txscript.inflight")
 _inflight = 0
 _inflight_zero = threading.Event()
 _inflight_zero.set()

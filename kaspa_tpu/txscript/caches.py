@@ -13,6 +13,8 @@ from __future__ import annotations
 import random
 import threading
 
+from kaspa_tpu.utils.sync import ranked_lock
+
 
 class SigCache:
     def __init__(self, size: int = 10_000, seed: int | None = None):
@@ -21,7 +23,7 @@ class SigCache:
         self._map: dict[tuple, bool] = {}
         self._keys: list[tuple] = []
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()  # graftlint: allow(raw-lock) -- sighash cache leaf guard; never nests
+        self._lock = ranked_lock("txscript.cache")
         self.hits = 0
         self.misses = 0
 

@@ -137,6 +137,7 @@ def _trace_record(name: str, held_s: float) -> None:
 #   acquired while they are held — so they rank highest.  daemon.upnp is
 #   a pure leaf around a blocking-free socket probe.
 RANKS: dict[str, int] = {
+    "service.shutdown": 3,     # core/service.py — held across service.stop() fan-out
     "node": 5,                 # p2p/node.py — outermost node state
     "ingest.state": 7,         # ingest/tier.py — mempool admission state
     "overload.state": 8,       # resilience/overload.py — controller level state
@@ -156,6 +157,26 @@ RANKS: dict[str, int] = {
     "fabric.service": 75,      # fabric/service.py — verifyd slice state
     "ingest.stats": 80,        # ingest/tier.py — admission counters (leaf)
     "daemon.upnp": 85,         # node/daemon.py — UPnP probe guard (leaf)
+    # leaves (nothing ranked is ever acquired while holding these)
+    "p2p.addressbook": 86,     # p2p/address_manager.py — address-book state
+    "p2p.connmgr": 87,         # p2p/address_manager.py — dial bookkeeping
+    "breaker.slot": 89,        # resilience/breaker.py — device-breaker slot swap
+    "supervisor.install": 90,  # resilience/supervisor.py — install/shutdown slot
+    "supervisor.manifest": 91, # resilience/supervisor.py — warm-manifest file io
+    "watchdog.pool": 92,       # resilience/supervisor.py — worker freelist
+    "watchdog.task": 93,       # resilience/supervisor.py — per-job result latch
+    "watchdog.stats": 94,      # resilience/supervisor.py — requeue counters
+    "txscript.pool": 96,       # txscript/batch.py — VM fallback pool slot
+    "txscript.inflight": 97,   # txscript/batch.py — drain accounting
+    "txscript.cache": 98,      # txscript/caches.py — sighash/sig cache
+    "mining.stats": 99,        # mining/rule_engine.py — sync-rate window
+    "stratum.stats": 100,      # bridge/stratum.py — per-worker vardiff stats
+    "stratum.shares": 101,     # bridge/stratum.py — job ring + share dedup
+    "service.list": 102,       # core/service.py — bound-services list
+    "wrpc.ids": 104,           # rpc/wrpc.py — client request-id counter
+    "storage.build": 105,      # storage/kv.py — one-shot native build guard
+    "chacha.build": 106,       # crypto/chacha.py — one-shot native build guard
+    "observability.registry": 110,  # observability/core.py — metric registration (innermost)
 }
 
 

@@ -33,8 +33,8 @@ echo "[ci_fastlane] 1/4 proto wire-freeze check"
 echo "[ci_fastlane] 2/4 borsh wire-freeze check"
 "$PY" tools/gen_borsh_fixtures.py --check || fail=1
 
-echo "[ci_fastlane] 3/4 graftlint static analysis"
-"$PY" tools/lint.py -q || fail=1
+echo "[ci_fastlane] 3/4 graftlint static analysis (ratcheted vs committed LINT.json)"
+"$PY" tools/lint.py -q --ratchet || fail=1
 
 echo "[ci_fastlane] 4/4 tier-1 fast lane"
 pytest_log="$(mktemp)"

@@ -2,8 +2,12 @@
 """Repo-root graftlint wrapper: ``python tools/lint.py [paths...]``.
 
 Pins --root to the repo root (so findings are repo-relative regardless
-of cwd) and defaults --json to LINT.json next to this script's parent.
-Everything else is ``python -m kaspa_tpu.analysis``.
+of cwd), defaults --json to LINT.json next to this script's parent, and
+turns on the gated kernel-shape audit (``--no-shapes`` opts out — e.g.
+in environments without a importable jax).  Everything else is
+``python -m kaspa_tpu.analysis``; in particular ``--ratchet`` compares
+against the *committed* LINT.json before overwriting it, and ``--knobs``
+regenerates KNOBS.md.
 """
 
 import os
@@ -20,4 +24,8 @@ if __name__ == "__main__":
         argv = ["--root", _ROOT, *argv]
     if "--json" not in argv:
         argv = ["--json", os.path.join(_ROOT, "LINT.json"), *argv]
+    if "--no-shapes" in argv:
+        argv = [a for a in argv if a != "--no-shapes"]
+    elif "--shapes" not in argv and "--knobs" not in argv:
+        argv = ["--shapes", *argv]
     sys.exit(main(argv))

@@ -221,7 +221,9 @@ def main(argv: list[str] | None = None) -> int:
     mesh_env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
 
     def _sect_lint() -> dict:
-        sect = _run([sys.executable, os.path.join(REPO_ROOT, "tools", "lint.py"), "-q"], 120.0)
+        t0 = time.monotonic()
+        sect = _run([sys.executable, os.path.join(REPO_ROOT, "tools", "lint.py"), "-q", "--ratchet"], 120.0)
+        sect["wall_s"] = round(time.monotonic() - t0, 2)
         report = None
         try:
             with open(os.path.join(REPO_ROOT, "LINT.json")) as f:
@@ -231,7 +233,15 @@ def main(argv: list[str] | None = None) -> int:
         sect["findings"] = len(report["findings"]) if report else None
         sect["suppressed"] = len(report["suppressed"]) if report else None
         sect["files"] = report["files"] if report else None
-        sect["ok"] = sect["rc"] == 0 and report is not None and report["ok"]
+        sect["engine"] = report.get("engine") if report else None
+        sect["callgraph"] = report.get("callgraph") if report else None
+        sect["ratchet"] = report.get("ratchet") if report else None
+        sect["ok"] = (
+            sect["rc"] == 0
+            and report is not None
+            and report["ok"]
+            and report.get("engine") == "v2"
+        )
         return sect
 
     def _sect_tier1() -> dict:
