@@ -133,6 +133,15 @@ def parse_args(argv=None) -> DaemonArgs:
         "bit-identical host degraded lane when every slice is down",
     )
     p.add_argument(
+        "--serving-pool", type=int,
+        default=int(os.environ.get("KASPA_TPU_SERVING_POOL", "0")),
+        metavar="N",
+        help="drain serving-tier subscribers with a shared crew of N sender "
+        "threads instead of one thread per subscriber (0 = per-subscriber "
+        "threads, the historical shape; the 50k-subscriber load harness "
+        "runs pooled)",
+    )
+    p.add_argument(
         "--flight", action=argparse.BooleanOptionalAction, default=False,
         help="per-block flight recorder: cross-thread span trees for every "
         "validated block in a bounded ring, served over getTraces and dumped "
@@ -438,6 +447,15 @@ class Daemon:
 
         self._fanout_queue = getattr(args, "fanout_queue", None) or 1024
         self._fanout_policy = getattr(args, "fanout_policy", None) or "drop-oldest"
+        # shared sender crew (--serving-pool / KASPA_TPU_SERVING_POOL):
+        # None keeps the historical thread-per-subscriber shape
+        pool_workers = int(getattr(args, "serving_pool", 0) or 0)
+        if pool_workers > 0:
+            from kaspa_tpu.serving import SenderPool
+
+            self.serving_pool = SenderPool(workers=pool_workers)
+        else:
+            self.serving_pool = None
         self._sub_seq = itertools.count(1)
         self.utxoindex = self._make_utxoindex(self.consensus) if args.utxoindex else None
         from kaspa_tpu.p2p.address_manager import AddressManager, ConnectionManager
@@ -640,6 +658,7 @@ class Daemon:
             maxlen=self._fanout_queue,
             policy=self._fanout_policy,
             on_disconnect=stop.set if stop is not None else None,
+            pool=self.serving_pool,
         )
 
     def make_borsh_subscriber(self, sink, stop=None):
@@ -655,6 +674,7 @@ class Daemon:
             maxlen=self._fanout_queue,
             policy=self._fanout_policy,
             on_disconnect=stop.set if stop is not None else None,
+            pool=self.serving_pool,
         )
 
     # --- staging consensus (proof IBD) ---
@@ -1088,9 +1108,12 @@ class Daemon:
         with self._dispatch_lock:
             bc = getattr(self, "broadcaster", None)
             self.broadcaster = None
+            pool, self.serving_pool = getattr(self, "serving_pool", None), None
             ui, self.utxoindex = self.utxoindex, None
         if bc is not None:
             bc.close()
+        if pool is not None:
+            pool.close()
         if ui is not None:
             ui.close()
         # quiesce dispatch before closing the native handle: an in-flight

@@ -13,6 +13,7 @@ arrive with the service-runtime milestone.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -40,10 +41,20 @@ class Notification:
     # broadcaster/sender threads re-attach fanout + delivery spans to the
     # block trace that emitted the event
     ctx: object = None
+    # origin-block accept stamp (perf_counter_ns at construction on the
+    # consensus thread): the serving tier measures block-accept -> wire
+    # lag against this, and conflation keeps the OLDEST stamp so merged
+    # diffs cannot hide staleness.  Carried outside ``data`` so payload
+    # bytes are identical with or without latency tracing.
+    t_accept_ns: int = 0
+    # how many earlier diffs were conflated into this one (0 = pristine)
+    merged: int = 0
 
     def __post_init__(self):
         if self.ctx is None:
             self.ctx = trace.context()
+        if self.t_accept_ns == 0:
+            self.t_accept_ns = time.perf_counter_ns()
 
 
 @dataclass
@@ -72,7 +83,10 @@ class Subscription:
         data = dict(notification.data)
         data["added"] = [u for u in data.get("added", []) if u[1].script_public_key.script in self.addresses]
         data["removed"] = [u for u in data.get("removed", []) if u[1].script_public_key.script in self.addresses]
-        return Notification(notification.event_type, data, notification.ctx)
+        return Notification(
+            notification.event_type, data, notification.ctx,
+            t_accept_ns=notification.t_accept_ns, merged=notification.merged,
+        )
 
 
 class Listener:

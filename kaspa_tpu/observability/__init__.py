@@ -19,6 +19,7 @@ from kaspa_tpu.observability import trace  # noqa: F401
 from kaspa_tpu.observability import flight  # noqa: F401
 from kaspa_tpu.observability.core import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS,
+    MS_LATENCY_BUCKETS,
     PERCENT_BUCKETS,
     REGISTRY,
     SIZE_BUCKETS,

@@ -29,6 +29,15 @@ DEFAULT_LATENCY_BUCKETS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# log-spaced latency edges in MILLISECONDS: 10 µs .. 10 s.  Shared by the
+# flight recorder's critical-path attribution and the serving tier's
+# block-accept -> wire lag families (serving_lag_ms{stage}) so the two
+# views of the same interval are bucket-compatible.
+MS_LATENCY_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
 # power-of-two size edges (batch sizes, queue depths)
 SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536)
 

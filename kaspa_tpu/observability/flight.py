@@ -39,14 +39,13 @@ from collections import deque
 from time import perf_counter_ns
 
 from kaspa_tpu.observability import trace
-from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.observability.core import MS_LATENCY_BUCKETS, REGISTRY
 from kaspa_tpu.observability.trace import TraceContext
 
-# critical-path attribution in MILLISECONDS per stage
-MS_BUCKETS = (
-    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-    25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
-)
+# critical-path attribution in MILLISECONDS per stage (the edges are the
+# registry-wide ms ladder, so serving_lag_ms and block_critical_path_ms
+# quantiles compare bucket-for-bucket)
+MS_BUCKETS = MS_LATENCY_BUCKETS
 
 CRIT_HIST = REGISTRY.histogram_family(
     "block_critical_path_ms", "stage", MS_BUCKETS,

@@ -59,6 +59,16 @@ def _fmt(v) -> str:
     if isinstance(v, bool):
         return "1" if v else "0"
     if isinstance(v, float):
+        # non-finite gauges (a quantile in the +Inf overflow bucket, an
+        # empty histogram's min) must use the exposition spellings —
+        # repr() would emit 'inf'/'nan' which Go's ParseFloat accepts but
+        # the text-format spec does not promise
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        if v == float("-inf"):
+            return "-Inf"
         return repr(v)
     return str(v)
 

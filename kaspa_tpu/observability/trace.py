@@ -224,6 +224,13 @@ def enabled() -> bool:
     return _enabled
 
 
+def sinks_active() -> bool:
+    """True when completed spans actually land somewhere (capture log or
+    flight ring).  Ultra-hot paths use this to skip building retroactive
+    spans nobody would collect."""
+    return _capture is not None or _flight_sink is not None
+
+
 def enable() -> None:
     global _enabled
     _enabled = True

@@ -344,6 +344,7 @@ DEFAULT_THRESHOLDS: dict[str, tuple] = {
     "template_lag_ms": (25.0, 100.0, 400.0),  # windowed rebuild mean
     "dispatch_tx_backlog": (256, 1024, 4096),  # standalone_tx verify jobs
     "fanout_depth": (64, 256, 768),         # deepest subscriber queue
+    "fanout_lag_ms": (25.0, 100.0, 400.0),  # windowed serving queue_wait mean
     "commit_wait_ms": (50.0, 200.0, 800.0),  # windowed wait.* critical path
 }
 
@@ -435,6 +436,22 @@ def default_signals(
     elif broadcaster is not None:
         out.append(
             PressureSignal("fanout_depth", broadcaster.max_queue_depth, thr["fanout_depth"])
+        )
+    if broadcaster is not None or fanout_depth_fn is not None:
+        # time-domain twin of fanout_depth: the windowed mean of the
+        # serving tier's queue_wait stage (serving_lag_ms) — depth says
+        # how much is queued, this says how long events actually sat
+        # there.  A few deep-but-fast queues stay quiet; shallow queues
+        # on a stalled sender crew raise it immediately.  Reads 0 while
+        # stage tracing is off (no new observations -> no pressure).
+        from kaspa_tpu.serving.broadcaster import _LAG_QUEUE_WAIT
+
+        out.append(
+            PressureSignal(
+                "fanout_lag_ms",
+                _windowed_hist_mean(_LAG_QUEUE_WAIT),
+                thr["fanout_lag_ms"],
+            )
         )
 
     out.append(PressureSignal("commit_wait_ms", _windowed_wait_mean(), thr["commit_wait_ms"]))

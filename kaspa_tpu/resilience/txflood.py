@@ -461,7 +461,15 @@ class OverloadRampConfig:
     # enter must sit under that pin or the brownout self-stabilizes one
     # level early and the drill never proves the saturated regime.
     thresholds: dict | None = None
-    DRILL_THRESHOLDS = {"fanout_depth": (24, 56, 2000)}
+    # fanout_lag_ms is parked out of reach: the drill's single consumer
+    # parks 0.25 s per sink retry BY DESIGN, so its queue_wait mean is
+    # seconds whenever the flood ramps — a real serving tier has a sender
+    # crew, the drill has a deliberately wedged one.  The depth signal is
+    # the one this drill's cadence was tuned against.
+    DRILL_THRESHOLDS = {
+        "fanout_depth": (24, 56, 2000),
+        "fanout_lag_ms": (1e12, 1e12, 1e12),
+    }
     expire_daa: int | None = None  # mempool expiry horizon; default max(6, blocks//6)
     fanout_per_slot: int = 4  # synthetic utxos-changed events per slot at scale 1.0
 
@@ -620,7 +628,7 @@ def run_txflood_sustain(
                     for _ in range(max(1, int(round(overload.fanout_per_slot * scale)))):
                         sub.offer(
                             Notification("utxos-changed", {"added": [i], "removed": []}),
-                            time.monotonic(),
+                            time.perf_counter_ns(),
                         )
                 level = NOMINAL
                 for _ in range(max(1, overload.samples_per_slot)):

@@ -313,6 +313,7 @@ class RpcCoreService:
         from dataclasses import asdict
 
         sc = self.consensus.transaction_validator.sig_cache
+        obs = observability_snapshot()
         return {
             "uptime_seconds": time.time() - self.start_time,
             "block_count": self.api.get_block_count(),
@@ -336,7 +337,11 @@ class RpcCoreService:
             # span/histogram/counter registry (observability/core): per-stage
             # pipeline latencies, secp batch occupancy, jit compile counts,
             # store cache hit rates — the same tree prom.render() exports
-            "observability": observability_snapshot(),
+            "observability": obs,
+            # serving-plane latency observatory (the Broadcaster collector):
+            # fanout state + per-stage block-accept -> wire lag quantiles
+            # (serving_lag_ms), surfaced top-level so dashboards don't dig
+            "serving": obs.get("serving", {}),
         }
 
     def get_metrics_prometheus(self) -> str:

@@ -5,9 +5,13 @@ between the in-process Notifier chain and the RPC wire transports.
 """
 
 from kaspa_tpu.serving.broadcaster import (  # noqa: F401
+    LAG_STAGES,
     POLICIES,
     POLICY_DISCONNECT,
     POLICY_DROP_OLDEST,
     Broadcaster,
     Subscriber,
+    set_stage_tracing,
+    stage_tracing_enabled,
 )
+from kaspa_tpu.serving.pool import SenderPool  # noqa: F401

@@ -36,17 +36,18 @@ the publisher, absorbs it.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 
 from kaspa_tpu.utils.sync import ranked_lock
-import time
 from collections import deque
+from time import perf_counter_ns
 
 from kaspa_tpu.core.log import get_logger
 from kaspa_tpu.notify.notifier import EVENT_TYPES, Notification
 from kaspa_tpu.observability import trace
-from kaspa_tpu.observability.core import REGISTRY, SIZE_BUCKETS
+from kaspa_tpu.observability.core import MS_LATENCY_BUCKETS, REGISTRY, SIZE_BUCKETS
 
 log = get_logger("serving")
 
@@ -78,6 +79,55 @@ _FILTER_SCAN = REGISTRY.histogram(
     "serving_filter_scanned_scripts", buckets=SIZE_BUCKETS,
     help="scripts iterated to scope-filter one UtxosChanged event for one subscriber",
 )
+
+# --- the latency observatory: block-accept -> wire lag, per stage -------
+#
+# Every Notification carries its origin block's accept stamp
+# (``t_accept_ns``, perf_counter_ns on the consensus thread).  The serving
+# tier decomposes accept-to-socket lag into the stages below, in
+# MILLISECONDS on the shared registry ladder (same edges as the flight
+# recorder's critical-path families, so the two views line up bucket for
+# bucket).  ``end_to_end`` is accept -> socket-write-complete; for a
+# conflated event it is measured from the OLDEST merged diff's stamp.
+LAG_STAGES = ("accept_to_fanout", "queue_wait", "encode", "socket_write", "end_to_end")
+_LAG_MS = REGISTRY.histogram_family(
+    "serving_lag_ms", "stage", MS_LATENCY_BUCKETS,
+    help="block-accept to subscriber-socket-write notification lag decomposed by delivery stage (ms)",
+)
+_CONFLATE_MERGED = REGISTRY.histogram(
+    "serving_conflation_merged_diffs", buckets=SIZE_BUCKETS,
+    help="diffs folded into each delivered conflated utxos-changed notification",
+)
+# hot-path cells held once (the documented CounterFamily/HistogramFamily
+# pattern): the delivery path runs per subscriber per event — at 50k
+# subscribers a per-observe dict lookup is measurable against the 2%
+# instrumentation-overhead budget
+_LAG_ACCEPT_TO_FANOUT = _LAG_MS.cell("accept_to_fanout")
+_LAG_QUEUE_WAIT = _LAG_MS.cell("queue_wait")
+_LAG_ENCODE = _LAG_MS.cell("encode")
+_LAG_SOCKET_WRITE = _LAG_MS.cell("socket_write")
+_LAG_END_TO_END = _LAG_MS.cell("end_to_end")
+
+# Tracing-off gate: with KASPA_TPU_SERVING_TRACE=0 the per-stage lag
+# clock reads, histogram observes and retroactive queue-wait spans are
+# all skipped — the payload byte stream is identical either way (stamps
+# ride the Notification object, never the encoded data), and the
+# roundcheck serving_load lane holds the off/on throughput ratio to the
+# >=0.98x overhead gate.
+_STAGE_TRACE = os.environ.get("KASPA_TPU_SERVING_TRACE", "1") != "0"
+
+
+def stage_tracing_enabled() -> bool:
+    return _STAGE_TRACE
+
+
+def set_stage_tracing(on: bool) -> None:
+    """Flip per-stage serving lag instrumentation at runtime (the load
+    harness A/Bs the overhead gate through this seam)."""
+    global _STAGE_TRACE
+    _STAGE_TRACE = bool(on)
+
+
 from kaspa_tpu.observability.shed import SHED as _SHED  # noqa: E402  (family declared once there)
 
 
@@ -92,17 +142,30 @@ def _conflate_utxos_changed(old: Notification, new: Notification) -> Notificatio
     data["removed"] = list(old.data.get("removed", ())) + list(new.data.get("removed", ()))
     if old.data.get("spk_set") is not None or new.data.get("spk_set") is not None:
         data["spk_set"] = set(old.data.get("spk_set") or ()) | set(new.data.get("spk_set") or ())
-    return Notification(new.event_type, data, new.ctx)
+    # lag honesty under brownout: the merged diff is only as fresh as its
+    # OLDEST constituent — keep that accept stamp so conflation cannot
+    # hide how stale a slow subscriber's view really is
+    t_accept = min(old.t_accept_ns, new.t_accept_ns)
+    return Notification(
+        new.event_type, data, new.ctx,
+        t_accept_ns=t_accept, merged=old.merged + new.merged + 1,
+    )
 
 
 class Subscriber:
-    """One remote consumer: bounded queue + dedicated sender thread.
+    """One remote consumer: bounded queue + a sender (thread or pool).
 
-    ``encoder(notification) -> bytes | None`` runs on the sender thread
+    ``encoder(notification) -> bytes | None`` runs on the sender side
     (never on the broadcaster or consensus thread); ``None`` means the
     encoding cannot represent the event and it is skipped.  ``sink`` must
     expose ``put(item, timeout=...)`` raising ``queue.Full`` — the
     connection pump's outbound queue or a WebSocket frame adapter.
+
+    With ``pool=None`` (default, the daemon's historical shape) each
+    subscriber owns a dedicated sender thread.  With a ``SenderPool``
+    (``kaspa_tpu.serving.pool``) the subscriber is a passive queue drained
+    by the pool's shared workers — the shape the 50k-virtual-subscriber
+    load harness needs, where one thread per consumer is not an option.
     """
 
     def __init__(
@@ -115,6 +178,7 @@ class Subscriber:
         maxlen: int = 1024,
         policy: str = POLICY_DROP_OLDEST,
         on_disconnect=None,
+        pool=None,
     ):
         if policy not in POLICIES:
             raise ValueError(f"unknown backpressure policy {policy!r}")
@@ -139,14 +203,28 @@ class Subscriber:
         self._lock = ranked_lock("serving.subscriber", reentrant=False)
         self._cv = self._lock.condition()
         self._stopped = False
-        self._thread = threading.Thread(target=self._run, daemon=True, name=f"serving-{name}")
-        self._thread.start()
+        self._lag_cell = _LAG.cell(encoding)
+        self._pool = pool
+        # pool mode: True while this subscriber sits in (or is being
+        # drained from) the pool's ready queue; guarded by self._lock so
+        # a subscriber is scheduled at most once at any moment
+        self._scheduled = False
+        if pool is None:
+            self._thread = threading.Thread(target=self._run, daemon=True, name=f"serving-{name}")
+            self._thread.start()
+        else:
+            self._thread = None
 
     # --- broadcaster side ---
 
-    def offer(self, notification: Notification, t_received: float) -> None:
-        """Enqueue one event; applies the overflow policy, never blocks."""
+    def offer(self, notification: Notification, t_received_ns: int) -> None:
+        """Enqueue one event; applies the overflow policy, never blocks.
+
+        ``t_received_ns`` is the broadcaster-receipt stamp
+        (perf_counter_ns) — queue-wait lag is measured from it.
+        """
         disconnect = False
+        kick = False
         with self._lock:
             if self._stopped:
                 return
@@ -167,16 +245,23 @@ class Subscriber:
                     and self._dq[-1][0].event_type == "utxos-changed"
                 ):
                     # brownout diff-conflation: a slow subscriber gets one
-                    # merged diff (oldest t_received kept — lag telemetry
-                    # still reflects how far behind the consumer is)
+                    # merged diff (oldest receipt AND oldest accept stamp
+                    # kept — lag telemetry still reflects how far behind
+                    # the consumer is)
                     prev_n, prev_t = self._dq[-1]
                     self._dq[-1] = (_conflate_utxos_changed(prev_n, notification), prev_t)
                     self.conflated += 1
                     _SHED.inc("fanout_conflation")
                 else:
-                    self._dq.append((notification, t_received))
+                    self._dq.append((notification, t_received_ns))
                 _QUEUE_DEPTH.observe(len(self._dq))
-                self._cv.notify()
+                if self._pool is None:
+                    self._cv.notify()
+                elif not self._scheduled:
+                    self._scheduled = True
+                    kick = True
+        if kick:
+            self._pool.schedule(self)
         if disconnect:
             _SUB_DISCONNECTS.inc()
             log.info("subscriber %s overflowed (policy=disconnect): tearing down", self.name)
@@ -200,49 +285,97 @@ class Subscriber:
 
     def close(self, timeout: float = 2.0) -> None:
         self.stop()
-        if self._thread is not threading.current_thread():
+        if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join(timeout=timeout)
 
-    # --- sender thread ---
+    # --- sender side (dedicated thread or pool worker) ---
+
+    def _deliver(self, notification: Notification, t_received_ns: int) -> bool:
+        """Encode + write one event to the sink, recording per-stage lag.
+        Returns False only when the subscriber stopped mid-write."""
+        staged = _STAGE_TRACE
+        ctx = getattr(notification, "ctx", None)
+        t_dq = perf_counter_ns() if staged else 0
+        if staged:
+            _LAG_QUEUE_WAIT.observe((t_dq - t_received_ns) * 1e-6)
+            if trace.sinks_active():
+                # retroactive span: the interval this event sat in the
+                # bounded subscriber queue, grafted onto the emitting
+                # block's trace (flight ring / capture log only — when
+                # neither collects, skip building a span nobody keeps)
+                ctx_wait = trace.record_span(
+                    "wait.serving_queue", ctx, t_received_ns, t_dq, subscriber=self.name
+                )
+                if ctx_wait is not None:
+                    ctx = ctx_wait
+        # delivery rides the emitting block's trace (cross-thread via
+        # the Notification's captured context): encode + sink.put
+        with trace.span(
+            "serving.deliver", parent=ctx,
+            encoding=self.encoding, event=notification.event_type,
+            merged=notification.merged,
+        ):
+            try:
+                payload = self.encoder(notification)
+            except Exception:  # noqa: BLE001 - one bad encode must not kill the stream
+                log.exception("subscriber %s: encoding %s failed", self.name, notification.event_type)
+                return True
+            t_enc = perf_counter_ns() if staged else 0
+            if payload is None:
+                return True
+            # blocking put with a stop-aware retry loop: socket backpressure
+            # (a full connection queue) parks THIS sender; the bounded deque
+            # above is where the policy then absorbs the overflow
+            while True:
+                try:
+                    self.sink.put(payload, timeout=0.25)
+                    break
+                except queue.Full:
+                    with self._lock:
+                        if self._stopped:
+                            return False
+        self.delivered += 1
+        self._lag_cell.observe((perf_counter_ns() - t_received_ns) * 1e-9)
+        if staged:
+            t_done = perf_counter_ns()
+            _LAG_ENCODE.observe((t_enc - t_dq) * 1e-6)
+            _LAG_SOCKET_WRITE.observe((t_done - t_enc) * 1e-6)
+            _LAG_END_TO_END.observe((t_done - notification.t_accept_ns) * 1e-6)
+            if notification.merged:
+                _CONFLATE_MERGED.observe(notification.merged + 1)
+        return True
 
     def _run(self) -> None:
-        lag_hist = _LAG.cell(self.encoding)
         while True:
             with self._lock:
                 while not self._dq and not self._stopped:
                     self._cv.wait(timeout=0.5)
                 if self._dq:
-                    notification, t_received = self._dq.popleft()
+                    notification, t_received_ns = self._dq.popleft()
                 elif self._stopped:
                     return
                 else:
                     continue
-            # delivery rides the emitting block's trace (cross-thread via
-            # the Notification's captured context): encode + sink.put
-            with trace.span(
-                "serving.deliver", parent=getattr(notification, "ctx", None),
-                encoding=self.encoding, event=notification.event_type,
-            ):
-                try:
-                    payload = self.encoder(notification)
-                except Exception:  # noqa: BLE001 - one bad encode must not kill the stream
-                    log.exception("subscriber %s: encoding %s failed", self.name, notification.event_type)
-                    continue
-                if payload is None:
-                    continue
-                # blocking put with a stop-aware retry loop: socket backpressure
-                # (a full connection queue) parks THIS thread; the bounded deque
-                # above is where the policy then absorbs the overflow
-                while True:
-                    try:
-                        self.sink.put(payload, timeout=0.25)
-                        break
-                    except queue.Full:
-                        with self._lock:
-                            if self._stopped:
-                                return
-            self.delivered += 1
-            lag_hist.observe(time.monotonic() - t_received)
+            if not self._deliver(notification, t_received_ns):
+                return
+
+    def _pool_drain(self, batch: int) -> bool:
+        """Pool-worker seam: deliver up to ``batch`` queued events.
+        Returns True when events remain (the worker must reschedule this
+        subscriber), False when the queue drained or the subscriber
+        stopped — in both False cases ``_scheduled`` has been cleared
+        under the lock, so the next ``offer`` re-kicks the pool."""
+        for _ in range(max(1, batch)):
+            with self._lock:
+                if self._stopped or not self._dq:
+                    self._scheduled = False
+                    return False
+                notification, t_received_ns = self._dq.popleft()
+            if not self._deliver(notification, t_received_ns):
+                with self._lock:
+                    self._scheduled = False
+                return False
+        return True
 
 
 class Broadcaster:
@@ -269,24 +402,54 @@ class Broadcaster:
         self._subscribers: list[Subscriber] = []
         self._event_refs: dict[str, int] = {}
         self._closed = False
+        # fanout-thread utilization: ns spent processing events (vs idle
+        # blocked on the ingest queue) and events handled — written only
+        # by the broadcaster thread, read by the saturation probe
+        self.fanout_busy_ns = 0
+        self.fanout_events = 0
         self._lid = notifier.register(self.publish)
         self._thread = threading.Thread(target=self._run, daemon=True, name="serving-broadcaster")
         self._thread.start()
-        REGISTRY.register_collector("serving_broadcaster", self._collect)
+        REGISTRY.register_collector("serving", self._collect)
 
     # --- observability ---
 
     def _collect(self) -> dict:
+        """The ``serving`` block of the observability snapshot (getMetrics
+        + Prometheus gauges): fanout state plus per-stage lag quantiles."""
         with self._mu:
             subs = list(self._subscribers)
-        return {
+        out = {
             "subscribers": len(subs),
             "ingest_depth": self._ingest.qsize(),
-            "queue_depths": {s.name: s.queue_depth() for s in subs},
-            "dropped": {s.name: s.dropped for s in subs if s.dropped},
+            "max_queue_depth": max((s.queue_depth() for s in subs), default=0),
+            "dropped": sum(s.dropped for s in subs),
             "delivered": sum(s.delivered for s in subs),
             "conflated": sum(s.conflated for s in subs),
+            "stage_tracing": int(_STAGE_TRACE),
+            "fanout": {"events": self.fanout_events, "busy_ns": self.fanout_busy_ns},
+            # key must NOT be "lag_ms": the gauge tree flattens to
+            # kaspa_serving_<key>_p50 in the Prometheus export, and a
+            # _p50 sample under the TYPEd kaspa_serving_lag_ms histogram
+            # family name is an exposition-format violation
+            "lag_quantiles_ms": {
+                stage: {
+                    "count": h.count,
+                    "p50": h.quantile(0.50),
+                    "p99": h.quantile(0.99),
+                    "p999": h.quantile(0.999),
+                }
+                for stage, h in sorted(_LAG_MS._cells.items())
+                if h.count
+            },
         }
+        if len(subs) <= 64:
+            # per-subscriber detail only at interactive population sizes —
+            # a 50k-subscriber load run must not turn every metrics scrape
+            # into a 50k-entry gauge dump
+            out["queue_depths"] = {s.name: s.queue_depth() for s in subs}
+            out["dropped_by_subscriber"] = {s.name: s.dropped for s in subs if s.dropped}
+        return out
 
     def max_queue_depth(self) -> int:
         """Deepest per-subscriber queue (the overload fanout signal)."""
@@ -416,15 +579,19 @@ class Broadcaster:
         data["added"] = added
         data["removed"] = removed
         data["spk_set"] = set(matched)
-        return Notification(n.event_type, data, n.ctx)
+        return Notification(n.event_type, data, n.ctx, t_accept_ns=n.t_accept_ns, merged=n.merged)
 
     def _run(self) -> None:
         while True:
             n = self._ingest.get()
             if n is None:
                 return
-            t0 = time.monotonic()
+            t0_ns = perf_counter_ns()
             _FANOUT_EVENTS.inc(n.event_type)
+            if _STAGE_TRACE and n.t_accept_ns:
+                # consensus-side half of the lag budget: block accept ->
+                # fanout-thread pickup (includes the ingest queue wait)
+                _LAG_ACCEPT_TO_FANOUT.observe((t0_ns - n.t_accept_ns) * 1e-6)
             with trace.span(
                 "serving.fanout", parent=getattr(n, "ctx", None), event=n.event_type,
             ):
@@ -440,9 +607,11 @@ class Broadcaster:
                         filtered = self._filter_utxos_changed(n, scope, by_script)
                         if filtered is None:
                             continue
-                        sub.offer(filtered, t0)
+                        sub.offer(filtered, t0_ns)
                     else:
-                        sub.offer(n, t0)
+                        sub.offer(n, t0_ns)
+            self.fanout_events += 1
+            self.fanout_busy_ns += perf_counter_ns() - t0_ns
 
     # --- lifecycle ---
 

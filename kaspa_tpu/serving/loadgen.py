@@ -1,0 +1,396 @@
+"""Virtual-subscriber load generation for the serving latency observatory.
+
+Drives a real ``Broadcaster`` (the production fanout path: ingest queue,
+per-event script indexing, scope filtering, bounded subscriber queues,
+sender pool) with a deterministic synthetic population:
+
+* **Virtual subscribers** are real ``Subscriber`` objects in pool mode —
+  no thread per consumer.  Most terminate in a ``MemorySink`` (zero fds);
+  a configurable *wire cohort* terminates in a datagram socketpair whose
+  far ends are drained by ONE selector-driven reader thread, so socket
+  write pressure and kernel buffer behavior are exercised without a
+  thread or fd explosion (2 fds per wire subscriber, preflighted by
+  ``kaspa_tpu.utils.fdbudget``).
+* **Address scopes are zipf-distributed**: subscriber k watches a few
+  addresses sampled from a power-law popularity ranking, so hot addresses
+  accumulate thousands of watchers exactly like a real exchange wallet.
+* **The diff driver** publishes paced utxos-changed notifications whose
+  addresses are mostly uniform (background payments) with a configurable
+  hot fraction sampled by popularity (bursts that fan out wide).
+
+Every delivered notification carries its origin accept stamp in the
+payload, so lag is measured at the LAST hop (sink/datagram receipt) on
+the same monotonic clock that stamped it — independent of (and therefore
+able to cross-check) the ``serving_lag_ms`` histograms the broadcaster
+records internally.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import selectors
+import socket
+import struct
+import threading
+import time
+from bisect import bisect_left
+from time import perf_counter_ns
+
+from kaspa_tpu.core.log import get_logger
+from kaspa_tpu.notify.notifier import Notification, Notifier
+from kaspa_tpu.serving.broadcaster import Broadcaster, Subscriber
+from kaspa_tpu.serving.pool import SenderPool
+
+log = get_logger("serving")
+
+_FRAME = struct.Struct("<qii")  # accept stamp ns, merged count, added count
+
+
+# --------------------------------------------------------------------------
+# synthetic address universe
+# --------------------------------------------------------------------------
+
+
+class _Spk:
+    __slots__ = ("script",)
+
+    def __init__(self, script: bytes):
+        self.script = script
+
+
+class _Entry:
+    """Minimal stand-in for a UTXO entry: exactly the attribute surface
+    ``Broadcaster._index_diff`` and scope filtering touch."""
+
+    __slots__ = ("script_public_key", "amount")
+
+    def __init__(self, script: bytes, amount: int):
+        self.script_public_key = _Spk(script)
+        self.amount = amount
+
+
+class AddressUniverse:
+    """Deterministic address set with zipf(s) popularity ranking."""
+
+    def __init__(self, count: int = 50_000, s: float = 1.05, seed: int = 0):
+        self.count = int(count)
+        self.scripts = [b"spk-%08d" % i for i in range(self.count)]
+        self.entries = [_Entry(spk, 100_000_000 + i) for i, spk in enumerate(self.scripts)]
+        # cumulative zipf weights for O(log n) popularity sampling
+        total = 0.0
+        cum = []
+        for rank in range(1, self.count + 1):
+            total += 1.0 / (rank**s)
+            cum.append(total)
+        self._cum = cum
+        self.seed = seed
+
+    def sample_hot(self, rnd: random.Random, k: int) -> list[int]:
+        """k address indices by popularity (zipf weights, with repeats)."""
+        cum, top = self._cum, self._cum[-1]
+        return [
+            min(self.count - 1, bisect_left(cum, rnd.random() * top)) for _ in range(k)
+        ]
+
+    def sample_uniform(self, rnd: random.Random, k: int) -> list[int]:
+        return [rnd.randrange(self.count) for _ in range(k)]
+
+
+# --------------------------------------------------------------------------
+# lag recording + sinks
+# --------------------------------------------------------------------------
+
+
+class LagRecorder:
+    """Bounded lag-sample store shared by every sink: exact quantiles over
+    up to ``cap`` samples (oldest overwritten ring-style past the cap) and
+    a total observation count.  list.append / index assignment are
+    GIL-atomic, so sinks on pool workers record lock-free."""
+
+    def __init__(self, cap: int = 200_000):
+        self.cap = int(cap)
+        self.samples: list[float] = []
+        self.count = 0
+
+    def record(self, lag_ms: float) -> None:
+        if len(self.samples) < self.cap:
+            self.samples.append(lag_ms)
+        else:
+            self.samples[self.count % self.cap] = lag_ms
+        self.count += 1
+
+    def reset(self) -> None:
+        self.samples = []
+        self.count = 0
+
+    QS = (("p50", 0.50), ("p99", 0.99), ("p999", 0.999))
+
+    def percentiles(self) -> dict:
+        if not self.samples:
+            return {"count": self.count, **{name: 0.0 for name, _ in self.QS}}
+        ordered = sorted(self.samples)
+        out: dict = {"count": self.count, "max": ordered[-1]}
+        for name, q in self.QS:
+            out[name] = ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+        return out
+
+
+class MemorySink:
+    """Zero-fd sink: unpacks the accept stamp and records last-hop lag."""
+
+    __slots__ = ("rec",)
+
+    def __init__(self, rec: LagRecorder):
+        self.rec = rec
+
+    def put(self, payload: bytes, timeout=None) -> None:
+        t_accept, _merged, _adds = _FRAME.unpack_from(payload)
+        self.rec.record((perf_counter_ns() - t_accept) * 1e-6)
+
+
+class WireSink:
+    """Datagram-socketpair sink: the sender side of a wire-cohort
+    subscriber.  SOCK_DGRAM keeps message boundaries, so the reader needs
+    no stream reassembly and a kernel-buffer overflow surfaces here as
+    ``queue.Full`` — engaging the subscriber's real overflow policy."""
+
+    __slots__ = ("sock",)
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def put(self, payload: bytes, timeout=None) -> None:
+        self.sock.settimeout(timeout)
+        try:
+            self.sock.send(payload)
+        except (socket.timeout, BlockingIOError, OSError) as e:
+            raise queue.Full from e
+
+
+class WireReader:
+    """One selector thread draining every wire-cohort receive socket."""
+
+    def __init__(self, rec: LagRecorder):
+        self.rec = rec
+        self.received = 0
+        self._sel = selectors.DefaultSelector()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="loadgen-wire-reader")
+        self._started = False
+
+    def add(self, sock: socket.socket) -> None:
+        sock.setblocking(False)
+        self._sel.register(sock, selectors.EVENT_READ)
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            for key, _ in self._sel.select(timeout=0.1):
+                sock = key.fileobj
+                while True:
+                    try:
+                        payload = sock.recv(65536)
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    except OSError:
+                        break
+                    if not payload:
+                        break
+                    t_accept, _merged, _adds = _FRAME.unpack_from(payload)
+                    self.rec.record((perf_counter_ns() - t_accept) * 1e-6)
+                    self.received += 1
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._started:
+            self._thread.join(timeout=2.0)
+        for key in list(self._sel.get_map().values()):
+            try:
+                key.fileobj.close()
+            except OSError:
+                pass
+        self._sel.close()
+
+
+def _encode(n: Notification) -> bytes:
+    """The virtual wire encoding: accept stamp + merge count + diff size.
+    A fixed-size frame keeps encode cost flat so stage timings measure the
+    serving plane, not a JSON library."""
+    return _FRAME.pack(n.t_accept_ns, n.merged, len(n.data.get("added", ())))
+
+
+# --------------------------------------------------------------------------
+# the population
+# --------------------------------------------------------------------------
+
+
+class LoadGen:
+    """A broadcaster + sender pool + ramped virtual-subscriber population.
+
+    Deterministic for a fixed seed: scope assignment, diff addresses and
+    pacing order all come from one ``random.Random``.
+    """
+
+    def __init__(
+        self,
+        *,
+        seed: int = 7,
+        addresses: int = 50_000,
+        zipf_s: float = 1.05,
+        scope_min: int = 1,
+        scope_max: int = 8,
+        sub_maxlen: int = 1024,
+        pool_workers: int = 2,
+        pool_batch: int = 64,
+        ingest_maxsize: int = 8192,
+        recorder_cap: int = 200_000,
+    ):
+        self.rnd = random.Random(seed)
+        self.universe = AddressUniverse(addresses, zipf_s, seed)
+        self.scope_min = max(1, int(scope_min))
+        self.scope_max = max(self.scope_min, int(scope_max))
+        self.sub_maxlen = int(sub_maxlen)
+        self.notifier = Notifier("loadgen-root")
+        self.pool = SenderPool(workers=pool_workers, batch=pool_batch)
+        self.broadcaster = Broadcaster(self.notifier, ingest_maxsize=ingest_maxsize)
+        self.recorder = LagRecorder(cap=recorder_cap)
+        self.wire_reader: WireReader | None = None
+        self.subscribers: list[Subscriber] = []
+        self.disconnects = 0
+        self.events_published = 0
+        self._seq = 0
+
+    # --- population ramp ---
+
+    def ramp_to(self, n: int, wire: int = 0) -> None:
+        """Grow the population to ``n`` subscribers, the first ``wire`` of
+        the NEW ones terminating in datagram socketpairs."""
+        n = int(n)
+        wire_left = int(wire)
+        while len(self.subscribers) < n:
+            i = len(self.subscribers)
+            k = self.rnd.randint(self.scope_min, self.scope_max)
+            scope = {self.universe.scripts[j] for j in self.universe.sample_hot(self.rnd, k)}
+            if wire_left > 0:
+                wire_left -= 1
+                send_sock, recv_sock = socket.socketpair(socket.AF_UNIX, socket.SOCK_DGRAM)
+                if self.wire_reader is None:
+                    self.wire_reader = WireReader(self.recorder)
+                self.wire_reader.add(recv_sock)
+                sink = WireSink(send_sock)
+            else:
+                sink = MemorySink(self.recorder)
+            sub = Subscriber(
+                f"vsub-{i:06d}", _encode, sink,
+                encoding="loadgen", maxlen=self.sub_maxlen, pool=self.pool,
+                on_disconnect=self._on_disconnect,
+            )
+            self.broadcaster.register(sub)
+            self.broadcaster.subscribe(sub, "utxos-changed", scope)
+            self.subscribers.append(sub)
+
+    def _on_disconnect(self) -> None:
+        self.disconnects += 1
+
+    # --- diff driver ---
+
+    def publish_diff(self, size: int = 24, hot_frac: float = 0.125) -> None:
+        """One synthetic utxos-changed diff: ``size`` touched addresses,
+        ``hot_frac`` of them popularity-sampled (wide fanout), the rest
+        uniform (background payments).  The Notification stamps its own
+        accept time at construction — the same seam consensus uses."""
+        hot = max(0, min(size, int(round(size * hot_frac))))
+        idxs = self.universe.sample_hot(self.rnd, hot) + self.universe.sample_uniform(
+            self.rnd, size - hot
+        )
+        added = []
+        spk_set = set()
+        for j in idxs:
+            e = self.universe.entries[j]
+            added.append((self._seq, e))
+            spk_set.add(e.script_public_key.script)
+            self._seq += 1
+        self.broadcaster.publish(
+            Notification(
+                "utxos-changed",
+                {"added": added, "removed": [], "spk_set": spk_set},
+            )
+        )
+        self.events_published += 1
+
+    def drive(self, events: int, pace_hz: float = 0.0, size: int = 24, hot_frac: float = 0.125) -> float:
+        """Publish ``events`` diffs, paced at ``pace_hz`` (0 = unpaced
+        back-to-back).  Returns the wall seconds spent publishing."""
+        period = (1.0 / pace_hz) if pace_hz > 0 else 0.0
+        t0 = time.monotonic()
+        deadline = t0
+        for _ in range(int(events)):
+            self.publish_diff(size=size, hot_frac=hot_frac)
+            if period:
+                deadline += period
+                delay = deadline - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+        return time.monotonic() - t0
+
+    # --- settling + stats ---
+
+    def drain(self, timeout: float = 60.0, settle: float = 0.05) -> bool:
+        """Wait until the ingest queue, every subscriber queue and the
+        sender pool go idle and the lag-sample count stops moving."""
+        deadline = time.monotonic() + timeout
+        last_count = -1
+        while time.monotonic() < deadline:
+            busy = (
+                not self.broadcaster._ingest.empty()
+                or self.pool.pending() > 0
+                or any(s.queue_depth() for s in self.subscribers)
+            )
+            count = self.recorder.count
+            if not busy and count == last_count:
+                return True
+            last_count = count
+            time.sleep(settle)
+        return False
+
+    def dropped(self) -> int:
+        return sum(s.dropped for s in self.subscribers)
+
+    def conflated(self) -> int:
+        return sum(s.conflated for s in self.subscribers)
+
+    def delivered(self) -> int:
+        return sum(s.delivered for s in self.subscribers)
+
+    def fanout_busy_ns(self) -> int:
+        return self.broadcaster.fanout_busy_ns
+
+    def reset_window(self) -> dict:
+        """Snapshot-and-reset the measurement window (between ramp stages):
+        returns the marker the next window's deltas are computed against."""
+        marker = {
+            "busy_ns": self.broadcaster.fanout_busy_ns,
+            "events": self.broadcaster.fanout_events,
+            "dropped": self.dropped(),
+            "conflated": self.conflated(),
+            "delivered": self.delivered(),
+            "disconnects": self.disconnects,
+        }
+        self.recorder.reset()
+        return marker
+
+    def close(self) -> None:
+        self.broadcaster.close()
+        self.pool.close()
+        if self.wire_reader is not None:
+            self.wire_reader.close()
+        for s in self.subscribers:
+            sink = s.sink
+            if isinstance(sink, WireSink):
+                try:
+                    sink.sock.close()
+                except OSError:
+                    pass

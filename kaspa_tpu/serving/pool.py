@@ -1,0 +1,118 @@
+"""Shared sender pool: N worker threads drain M subscriber queues.
+
+The daemon's historical shape is one sender thread per ``Subscriber`` —
+fine for tens of RPC clients, impossible for the 50k-virtual-subscriber
+load harness (and the ROADMAP's million-subscriber target).  A
+``SenderPool`` inverts that: subscribers become passive bounded queues
+and a small fixed crew of workers delivers for whichever subscribers
+have pending events.
+
+Scheduling contract (with ``Subscriber`` in broadcaster.py):
+
+* ``Subscriber.offer`` sets ``_scheduled`` under the subscriber lock the
+  first time the queue goes non-empty and calls ``pool.schedule(sub)``
+  AFTER releasing it — each subscriber sits in the ready queue at most
+  once, so the queue is bounded by the subscriber population.
+* A worker pops a subscriber and calls ``sub._pool_drain(batch)``, which
+  delivers up to ``batch`` events.  If events remain the worker re-queues
+  the subscriber (round-robin fairness: a firehose subscriber cannot
+  starve the rest); if the queue drained, ``_pool_drain`` clears
+  ``_scheduled`` under the subscriber lock so the next ``offer`` re-kicks.
+
+Lock order is broadcaster(50) -> pool(52) -> subscriber(55); ``schedule``
+is always called lock-free or under the subscriber lock's CALLER (never
+inside it), and workers take the pool queue's lock and the subscriber
+lock strictly in rank order.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from kaspa_tpu.core.log import get_logger
+from kaspa_tpu.observability.core import REGISTRY
+
+log = get_logger("serving")
+
+_POOL_ROUNDS = REGISTRY.counter(
+    "serving_pool_drain_rounds", help="subscriber drain rounds executed by sender-pool workers"
+)
+_POOL_RESCHEDULES = REGISTRY.counter(
+    "serving_pool_reschedules", help="drain rounds that hit the fairness batch limit and re-queued the subscriber"
+)
+
+# Safety valve far above any realistic subscriber population; the
+# scheduled-flag contract bounds live entries to one per subscriber.
+_READY_MAXSIZE = 1 << 20
+
+
+class SenderPool:
+    """Fixed crew of sender threads shared by many pooled Subscribers."""
+
+    def __init__(self, workers: int = 2, batch: int = 64, name: str = "serving-pool"):
+        self.workers = max(1, int(workers))
+        self.batch = max(1, int(batch))
+        self._ready: queue.Queue = queue.Queue(maxsize=_READY_MAXSIZE)
+        self._stopping = False
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True, name=f"{name}-{i}")
+            for i in range(self.workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # --- subscriber side (called by Subscriber.offer / workers) ---
+
+    def schedule(self, sub) -> None:
+        """Queue a subscriber for draining.  The caller guarantees the
+        at-most-once invariant via the subscriber's ``_scheduled`` flag."""
+        try:
+            self._ready.put_nowait(sub)
+        except queue.Full:  # pragma: no cover - means >1M live subscribers
+            # deliver inline rather than strand the subscriber with its
+            # _scheduled flag set and nobody coming
+            log.error("sender-pool ready queue overflow; draining %s inline", sub.name)
+            while sub._pool_drain(self.batch):
+                pass
+
+    def pending(self) -> int:
+        """Subscribers currently queued for a drain round."""
+        return self._ready.qsize()
+
+    # --- worker loop ---
+
+    def _work(self) -> None:
+        while True:
+            sub = self._ready.get()
+            if sub is None:
+                return
+            _POOL_ROUNDS.inc()
+            try:
+                more = sub._pool_drain(self.batch)
+            except Exception:  # noqa: BLE001 - one bad subscriber must not kill the crew
+                log.exception("sender-pool drain failed for %s", sub.name)
+                with sub._lock:
+                    sub._scheduled = False
+                continue
+            if more:
+                if self._stopping:
+                    with sub._lock:
+                        sub._scheduled = False
+                    continue
+                _POOL_RESCHEDULES.inc()
+                self.schedule(sub)
+
+    # --- lifecycle ---
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting work and join the workers.  Queued subscribers
+        still in flight finish their current drain round; their remaining
+        events stay queued (the owning connections are torn down by the
+        caller, same as per-thread subscribers on daemon shutdown)."""
+        self._stopping = True
+        for _ in self._threads:
+            self._ready.put(None)
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=timeout)

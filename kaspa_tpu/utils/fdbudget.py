@@ -1,0 +1,73 @@
+"""File-descriptor budget preflight for socket-heavy harnesses.
+
+The serving load harness opens a socketpair (2 fds) per wire-cohort
+subscriber on top of whatever the process already holds.  Hitting
+``RLIMIT_NOFILE`` mid-ramp surfaces as a cryptic ``EMFILE`` from deep
+inside socket creation, after minutes of setup — so the harness preflights
+the budget up front and fails with the remedy instead.
+
+``preflight(required)`` answers "can this process open ``required`` MORE
+fds right now?"; ``budget()`` reports the full accounting (recorded in
+``SERVING_LOAD.json`` run_meta so an artifact read on another machine
+carries the limit it ran under).
+"""
+
+from __future__ import annotations
+
+import os
+
+# fds we refuse to hand to the caller: stdio, log files, late-bound
+# sockets, the JAX runtime's own handles all need room to breathe
+HEADROOM = 128
+
+
+class FdBudgetError(RuntimeError):
+    """Raised when a requested fd budget cannot fit under RLIMIT_NOFILE."""
+
+
+def fd_limit() -> int:
+    """Soft RLIMIT_NOFILE (0 when the platform cannot say)."""
+    try:
+        import resource
+
+        return resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    except Exception:  # noqa: BLE001 - non-POSIX fallback
+        return 0
+
+
+def fds_in_use() -> int:
+    """Open descriptors right now (0 when /proc is unavailable)."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+def budget(headroom: int = HEADROOM) -> dict:
+    """Current accounting: limit, in-use, headroom, what's left to spend."""
+    limit = fd_limit()
+    in_use = fds_in_use()
+    return {
+        "limit": limit,
+        "in_use": in_use,
+        "headroom": headroom,
+        "available": max(0, limit - in_use - headroom) if limit else 0,
+    }
+
+
+def preflight(required: int, *, what: str = "file descriptors", headroom: int = HEADROOM) -> dict:
+    """Assert ``required`` more fds fit under the soft limit; returns the
+    ``budget()`` dict (for run_meta) on success, raises ``FdBudgetError``
+    with the ``ulimit -n`` remedy on failure."""
+    b = budget(headroom)
+    if b["limit"] and required > b["available"]:
+        need = required + b["in_use"] + headroom
+        raise FdBudgetError(
+            f"fd budget exceeded: {what} needs {required} fds but only "
+            f"{b['available']} fit under RLIMIT_NOFILE={b['limit']} "
+            f"({b['in_use']} already open + {headroom} headroom). "
+            f"Raise the limit (`ulimit -n {need}` before launching, or "
+            f"bump nofile in /etc/security/limits.conf) or shrink the "
+            f"wire cohort (--wire)."
+        )
+    return b

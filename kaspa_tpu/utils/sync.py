@@ -150,6 +150,9 @@ RANKS: dict[str, int] = {
     "dispatch.queue": 40,      # ops/dispatch.py — verify coalescing queue
     "ingest.queue": 45,        # ingest/queue.py — tx admission queue
     "serving.broadcaster": 50, # serving/broadcaster.py — subscriber table
+    # (serving/pool.py's ready queue is a stdlib Queue — its internal lock
+    # is a leaf taken between broadcaster(50) and subscriber(55) acquisitions,
+    # never while either ranked lock is held)
     "serving.subscriber": 55,  # serving/broadcaster.py — per-subscriber buffer
     "pipeline.idle": 60,       # pipeline/pipeline.py — idle/backlog condvar
     "pipeline.speculative": 65,# pipeline/speculative.py — prefetch results

@@ -273,12 +273,12 @@ def test_subscriber_conflation_merges_for_slow_consumer():
     sub = Subscriber("slow", lambda n: b"x", Sink(), maxlen=64)
     try:
         sub.conflate_floor = 1
-        sub.offer(Notification("utxos-changed", {"added": [1], "removed": []}), time.monotonic())
+        sub.offer(Notification("utxos-changed", {"added": [1], "removed": []}), time.perf_counter_ns())
         assert parked.wait(2.0)  # sender picked up event 1 and parked on the sink
         for i in (2, 3, 4, 5):
             sub.offer(
                 Notification("utxos-changed", {"added": [i], "removed": [i * 10]}),
-                time.monotonic(),
+                time.perf_counter_ns(),
             )
         # events 2..5 conflated into ONE pending merged diff, in order
         assert sub.queue_depth() == 1

@@ -44,6 +44,10 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             "--skip-ingest",
             # and the brownout ramp drill (another 24-block flood replay)
             "--skip-overload",
+            # and the serving latency observatory (a 50k-virtual-subscriber
+            # ramp + overhead A/B, minutes of wall and timing-sensitive —
+            # it gets its own `roundcheck --only serving_load` run)
+            "--skip-serving_load",
             # and the lint lane: the v2 gate runs the gated kernel-shape
             # audit (real eval_shape traces, ~50 s on CPU) — it gets its
             # own `roundcheck --only lint` acceptance run

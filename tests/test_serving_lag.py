@@ -1,0 +1,390 @@
+"""Serving latency observatory: per-stage lag instrumentation on the
+broadcaster delivery path (serving_lag_ms histograms, conflation lag
+honesty, Prometheus exposition of the new families, tracing-off payload
+bit-identity, and the overload pressure signal fed by queue-wait lag)."""
+
+from __future__ import annotations
+
+import queue
+import re
+import threading
+import time
+from time import perf_counter_ns
+
+import pytest
+
+from kaspa_tpu.notify.notifier import Notification, Notifier
+from kaspa_tpu.observability import core as obs_core
+from kaspa_tpu.observability import prom
+from kaspa_tpu.observability.core import MS_LATENCY_BUCKETS
+from kaspa_tpu.serving import Broadcaster, Subscriber
+from kaspa_tpu.serving import broadcaster as broadcaster_mod
+
+
+@pytest.fixture(autouse=True)
+def _restore_stage_tracing():
+    prev = broadcaster_mod.stage_tracing_enabled()
+    yield
+    broadcaster_mod.set_stage_tracing(prev)
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _stage_counts() -> dict:
+    return {s: broadcaster_mod._LAG_MS.cell(s).count for s in broadcaster_mod.LAG_STAGES}
+
+
+# ---------------------------------------------------------------------------
+# accept stamps + per-stage feed
+# ---------------------------------------------------------------------------
+
+
+def test_notification_carries_accept_stamp():
+    t0 = perf_counter_ns()
+    n = Notification("block-added", {"n": 1})
+    assert t0 <= n.t_accept_ns <= perf_counter_ns()
+    assert n.merged == 0
+    # an explicit stamp (conflation, filtering) is preserved verbatim
+    m = Notification("block-added", {"n": 2}, t_accept_ns=123, merged=3)
+    assert (m.t_accept_ns, m.merged) == (123, 3)
+
+
+def test_scope_filter_propagates_stamp_and_merge_count():
+    class _Spk:
+        def __init__(self, s):
+            self.script = s
+
+    class _Entry:
+        def __init__(self, s):
+            self.script_public_key = _Spk(s)
+
+    s = b"\x01" * 4
+    n = Notification(
+        "utxos-changed",
+        {"added": [("op", _Entry(s))], "removed": [], "spk_set": {s}},
+        t_accept_ns=777, merged=2,
+    )
+    f = Broadcaster._filter_utxos_changed(n, frozenset({s}), Broadcaster._index_diff(n))
+    assert (f.t_accept_ns, f.merged) == (777, 2)
+
+
+def test_per_stage_lag_feed_through_delivery():
+    broadcaster_mod.set_stage_tracing(True)
+    before = _stage_counts()
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    sink: queue.Queue = queue.Queue()
+    sub = Subscriber("lagged", lambda n: str(n.data["n"]).encode(), sink)
+    total = 5
+    try:
+        bc.register(sub)
+        bc.subscribe(sub, "block-added")
+        for i in range(total):
+            root.notify(Notification("block-added", {"n": i}))
+        got = [sink.get(timeout=10) for _ in range(total)]
+        assert got == [str(i).encode() for i in range(total)]
+        assert _wait_until(lambda: sub.delivered == total)
+    finally:
+        bc.close()
+    after = _stage_counts()
+    # every stage of the observatory saw every delivery (one fanout pickup
+    # + one delivery per event: a single subscriber)
+    for stage in broadcaster_mod.LAG_STAGES:
+        assert after[stage] - before[stage] == total, stage
+
+
+def test_stage_tracing_off_skips_lag_observes_but_not_delivery():
+    before = _stage_counts()
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    sink: queue.Queue = queue.Queue()
+    sub = Subscriber("untraced", lambda n: str(n.data["n"]).encode(), sink)
+    try:
+        bc.register(sub)
+        bc.subscribe(sub, "block-added")
+        broadcaster_mod.set_stage_tracing(False)
+        assert not broadcaster_mod.stage_tracing_enabled()
+        for i in range(3):
+            root.notify(Notification("block-added", {"n": i}))
+        assert [sink.get(timeout=10) for _ in range(3)] == [b"0", b"1", b"2"]
+        assert _wait_until(lambda: sub.delivered == 3)
+        # the legacy per-encoding lag family still feeds (serving_check
+        # scrapes it) ...
+        assert broadcaster_mod._LAG.cell("json").count > 0
+    finally:
+        bc.close()
+    # ... but none of the per-stage families moved
+    assert _stage_counts() == before
+
+
+# ---------------------------------------------------------------------------
+# conflation: lag honesty under brownout
+# ---------------------------------------------------------------------------
+
+
+def _diff(n_added: int, t_accept_ns: int, merged: int = 0) -> Notification:
+    class _Spk:
+        def __init__(self, s):
+            self.script = s
+
+    class _Entry:
+        def __init__(self, s):
+            self.script_public_key = _Spk(s)
+
+    s = b"\x07" * 4
+    return Notification(
+        "utxos-changed",
+        {"added": [(f"op{i}", _Entry(s)) for i in range(n_added)], "removed": [], "spk_set": {s}},
+        t_accept_ns=t_accept_ns, merged=merged,
+    )
+
+
+def test_conflation_keeps_oldest_accept_stamp_and_merge_count():
+    old = _diff(2, t_accept_ns=1_000)
+    new = _diff(3, t_accept_ns=9_000)
+    merged = broadcaster_mod._conflate_utxos_changed(old, new)
+    assert merged.t_accept_ns == 1_000  # the OLDEST constituent's stamp
+    assert merged.merged == 1
+    assert len(merged.data["added"]) == 5
+    # merging again accumulates (and min() is order-independent)
+    newer = _diff(1, t_accept_ns=500)
+    again = broadcaster_mod._conflate_utxos_changed(merged, newer)
+    assert again.t_accept_ns == 500
+    assert again.merged == 2
+
+
+def test_conflated_delivery_reports_lag_from_oldest_diff():
+    """The delivered merged diff's end_to_end lag must cover the OLDEST
+    merged constituent's age — conflation cannot hide staleness."""
+    broadcaster_mod.set_stage_tracing(True)
+    age_ns = 5_000_000_000  # 5s: far above anything this suite produces
+    old = _diff(1, t_accept_ns=perf_counter_ns() - age_ns)
+    new = _diff(1, t_accept_ns=perf_counter_ns())
+    merged = broadcaster_mod._conflate_utxos_changed(old, new)
+
+    e2e = broadcaster_mod._LAG_END_TO_END
+    conf = broadcaster_mod._CONFLATE_MERGED
+    sum_before, merged_count_before = e2e.sum, conf.count
+    sub = Subscriber("conflated", lambda n: b"x", queue.Queue())
+    try:
+        assert sub._deliver(merged, perf_counter_ns())
+    finally:
+        sub.close()
+    # one delivery, whose end_to_end observation is >= the old diff's age
+    assert e2e.sum - sum_before >= age_ns * 1e-6 * 0.99
+    assert conf.count - merged_count_before == 1  # 2 diffs folded into 1
+
+
+def test_offer_path_conflation_merges_with_oldest_stamp():
+    """Through the real offer() brownout path: a wedged subscriber at the
+    conflate floor folds queued diffs, keeping the oldest accept stamp."""
+    released = threading.Event()
+
+    class _WedgedSink:
+        def put(self, item, timeout=None):
+            if not released.is_set():
+                time.sleep(min(timeout or 0.02, 0.02))
+                raise queue.Full
+            self.got = item
+
+    sub = Subscriber("brownout", lambda n: b"x", _WedgedSink(), maxlen=8)
+    sub.conflate_floor = 1
+    try:
+        t_old = perf_counter_ns() - 1_000_000
+        # first event is popped by the sender (wedged in put); the next two
+        # meet at the floor and conflate in-queue
+        sub.offer(_diff(1, t_accept_ns=perf_counter_ns()), perf_counter_ns())
+        assert _wait_until(lambda: sub.queue_depth() == 0)
+        sub.offer(_diff(1, t_accept_ns=t_old), perf_counter_ns())
+        sub.offer(_diff(1, t_accept_ns=perf_counter_ns()), perf_counter_ns())
+        assert _wait_until(lambda: sub.conflated == 1)
+        assert sub.queue_depth() == 1
+        queued, _t = sub._dq[-1]
+        assert queued.merged == 1
+        assert queued.t_accept_ns == t_old
+        released.set()
+        assert _wait_until(lambda: sub.delivered == 2)
+    finally:
+        sub.close()
+
+
+# ---------------------------------------------------------------------------
+# quantile edges + Prometheus exposition of the new families
+# ---------------------------------------------------------------------------
+
+
+def test_ms_lag_histogram_quantile_edges():
+    h = obs_core.Histogram("t", MS_LATENCY_BUCKETS)
+    assert h.quantile(0.99) == 0.0  # empty -> 0.0, not NaN
+    h.observe(0.3)
+    assert h.quantile(0.5) == 0.5  # upper edge of the holding bucket
+    h.observe(50_000.0)  # above the 10_000ms top edge
+    assert h.quantile(0.999) == float("inf")  # overflow bucket -> inf
+
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$'
+)
+
+
+def test_prom_render_of_serving_lag_parses_and_sums():
+    """Render serving_lag_ms (with an overflow observation, so an inf
+    quantile gauge exists) on an isolated registry and validate the text
+    against the exposition grammar: every sample line parses, bucket
+    counts are cumulative, +Inf closes each series, and non-finite values
+    use the spec spellings (never Python's 'inf')."""
+    reg = obs_core.Registry()
+    fam = reg.histogram_family("serving_lag_ms", "stage", MS_LATENCY_BUCKETS)
+    for stage, values in {
+        "queue_wait": (0.05, 0.4, 3.0),
+        "end_to_end": (1.0, 250.0, 50_000.0),  # one in the +Inf overflow
+    }.items():
+        for v in values:
+            fam.observe(stage, v)
+    quantiles = {
+        stage: {"p50": h.quantile(0.5), "p999": h.quantile(0.999)}
+        for stage, h in fam._cells.items()
+    }
+    assert quantiles["end_to_end"]["p999"] == float("inf")
+    # the collector key deliberately differs from the histogram family
+    # name: gauge samples may not wear a TYPEd family's name with a
+    # non-histogram suffix (_p50 under "# TYPE ... histogram" is invalid)
+    reg.register_collector("serving", lambda: {"lag_quantiles_ms": quantiles})
+
+    text = prom.render(registry=reg)
+    assert "inf" not in text  # the spelling is +Inf, capital I
+    assert re.search(r'kaspa_serving_lag_quantiles_ms_p999\{key="end_to_end"\} \+Inf', text)
+    series: dict[str, list[int]] = {}
+    counts: dict[str, int] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"unparseable exposition line: {line!r}"
+        m = re.match(r'^kaspa_serving_lag_ms_bucket\{stage="(\w+)",le="([^"]+)"\} (\d+)$', line)
+        if m:
+            series.setdefault(m.group(1), []).append(int(m.group(3)))
+        m = re.match(r'^kaspa_serving_lag_ms_count\{stage="(\w+)"\} (\d+)$', line)
+        if m:
+            counts[m.group(1)] = int(m.group(2))
+    assert set(series) == {"queue_wait", "end_to_end"}
+    for stage, cum in series.items():
+        assert cum == sorted(cum), f"{stage}: bucket counts not cumulative"
+        assert len(cum) == len(MS_LATENCY_BUCKETS) + 1  # edges + le="+Inf"
+        assert cum[-1] == counts[stage] == 3
+
+
+def test_prom_fmt_nonfinite_spellings():
+    assert prom._fmt(float("inf")) == "+Inf"
+    assert prom._fmt(float("-inf")) == "-Inf"
+    assert prom._fmt(float("nan")) == "NaN"
+    assert prom._fmt(1.5) == "1.5"
+
+
+# ---------------------------------------------------------------------------
+# collector block + tracing-off bit-identity + overload signal
+# ---------------------------------------------------------------------------
+
+
+def test_serving_collector_reports_lag_quantiles_and_fanout():
+    broadcaster_mod.set_stage_tracing(True)
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    sink: queue.Queue = queue.Queue()
+    sub = Subscriber("snap", lambda n: b"x", sink)
+    try:
+        bc.register(sub)
+        bc.subscribe(sub, "block-added")
+        root.notify(Notification("block-added", {"n": 0}))
+        sink.get(timeout=10)
+        assert _wait_until(lambda: bc.fanout_events >= 1)
+        snap = obs_core.REGISTRY.snapshot()["serving"]
+    finally:
+        bc.close()
+    assert snap["subscribers"] == 1
+    assert snap["stage_tracing"] == 1
+    assert snap["fanout"]["events"] >= 1
+    assert snap["fanout"]["busy_ns"] > 0
+    for stage in ("queue_wait", "encode", "socket_write", "end_to_end"):
+        q = snap["lag_quantiles_ms"][stage]
+        assert q["count"] > 0
+        assert 0.0 <= q["p50"] <= q["p99"] <= q["p999"]
+
+
+def _collect_payload_stream(tracing_on: bool, events: list[Notification]) -> list[bytes]:
+    broadcaster_mod.set_stage_tracing(tracing_on)
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    sink: queue.Queue = queue.Queue()
+    scope = {b"\x01" * 4}
+    sub = Subscriber(
+        "stream", lambda n: repr([(op, e.script_public_key.script) for op, e in n.data["added"]]).encode(), sink
+    )
+    try:
+        bc.register(sub)
+        bc.subscribe(sub, "utxos-changed", scope)
+        for n in events:
+            root.notify(n)
+        out = [sink.get(timeout=10) for _ in range(len(events))]
+        assert _wait_until(lambda: sub.delivered == len(events))
+        return out
+    finally:
+        bc.close()
+
+
+def test_tracing_off_payload_stream_bit_identical():
+    """KASPA_TPU_SERVING_TRACE only toggles telemetry: the encoded byte
+    stream a subscriber receives (through the full fanout + scope-filter +
+    delivery path) is identical with stage tracing on and off — accept
+    stamps ride the Notification object, never the payload."""
+
+    class _Spk:
+        def __init__(self, s):
+            self.script = s
+
+    class _Entry:
+        def __init__(self, s):
+            self.script_public_key = _Spk(s)
+
+    def mk_events():
+        s, other = b"\x01" * 4, b"\x02" * 4
+        return [
+            Notification(
+                "utxos-changed",
+                {"added": [(f"op{i}-{j}", _Entry(s)) for j in range(i + 1)]
+                 + [(f"alien{i}", _Entry(other))],
+                 "removed": [], "spk_set": {s, other}},
+            )
+            for i in range(6)
+        ]
+
+    stream_on = _collect_payload_stream(True, mk_events())
+    stream_off = _collect_payload_stream(False, mk_events())
+    assert stream_on == stream_off
+
+
+def test_overload_default_signals_include_fanout_lag():
+    from kaspa_tpu.resilience.overload import DEFAULT_THRESHOLDS, default_signals
+
+    root = Notifier("rpc")
+    bc = Broadcaster(root)
+    try:
+        signals = {s.name: s for s in default_signals(broadcaster=bc)}
+    finally:
+        bc.close()
+    assert "fanout_lag_ms" in signals
+    assert signals["fanout_lag_ms"].enter == DEFAULT_THRESHOLDS["fanout_lag_ms"]
+    # windowed mean: reads 0.0 when nothing new was observed since last read
+    sig = signals["fanout_lag_ms"]
+    sig.read()
+    assert sig.read() == 0.0
+    broadcaster_mod._LAG_QUEUE_WAIT.observe(40.0)
+    assert sig.read() == pytest.approx(40.0)

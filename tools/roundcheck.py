@@ -34,6 +34,7 @@ instead of eight scrollback logs.
     python tools/roundcheck.py --skip-ingest       # no tx-ingest admission lane
     python tools/roundcheck.py --skip-overload     # no brownout ramp drill
     python tools/roundcheck.py --skip-lint         # no graftlint static-analysis gate
+    python tools/roundcheck.py --skip-serving_load # no 50k-subscriber latency observatory run
     python tools/roundcheck.py --out my.json       # custom artifact path
 
 ``--only SECTION`` (repeatable, or comma-separated) runs exactly the
@@ -201,6 +202,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-ingest", action="store_true", help="skip the tx-ingest admission lane")
     ap.add_argument("--skip-overload", action="store_true", help="skip the brownout ramp drill")
     ap.add_argument("--skip-lint", action="store_true", help="skip the graftlint static-analysis gate")
+    ap.add_argument("--skip-serving_load", action="store_true",
+                    help="skip the 50k-virtual-subscriber serving latency observatory run")
+    ap.add_argument("--serving-load-subscribers", type=int, default=50_000,
+                    help="final population for the serving_load section")
     ap.add_argument(
         "--only", action="append", default=None, metavar="SECTION",
         help="run only the named section(s); repeatable or comma-separated, "
@@ -407,6 +412,28 @@ def main(argv: list[str] | None = None) -> int:
         result = _last_json_line(sect)
         sect["result"] = result
         sect["ok"] = sect["rc"] == 0 and bool(result and result.get("serving_ok"))
+        return sect
+
+    def _sect_serving_load() -> dict:
+        # serving latency observatory (tools/serving_load.py): a ramped
+        # >=50k-virtual-subscriber run against the production Broadcaster
+        # (zipf address scopes, paced diff driver, shared sender pool,
+        # fd-budgeted wire cohort).  Gates: zero drops at nominal pace,
+        # bounded final-stage p99 accept->delivery lag, and the tracing-off
+        # overhead check (PR 7 convention: off >= 0.98x of the default
+        # instrumented leg).  Full evidence lands in SERVING_LOAD.json.
+        sect = _run(
+            [
+                sys.executable, os.path.join(REPO_ROOT, "tools", "serving_load.py"),
+                "--subscribers", str(args.serving_load_subscribers),
+                "--out", os.path.join(REPO_ROOT, "SERVING_LOAD.json"),
+            ],
+            900.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        result = _last_json_line(sect)
+        sect["result"] = result
+        sect["ok"] = sect["rc"] == 0 and bool(result and result.get("serving_load_ok"))
         return sect
 
     def _sect_obs() -> dict:
@@ -659,6 +686,7 @@ def main(argv: list[str] | None = None) -> int:
         ("dispatch", not args.skip_dispatch, _sect_dispatch),
         ("aggregate", not args.skip_aggregate, _sect_aggregate),
         ("serving", not args.skip_serving, _sect_serving),
+        ("serving_load", not args.skip_serving_load, _sect_serving_load),
         ("obs", not args.skip_obs, _sect_obs),
         ("tenbps", not args.skip_tenbps, _sect_tenbps),
         ("chaos", not args.skip_chaos, _sect_chaos),

@@ -1,0 +1,433 @@
+#!/usr/bin/env python
+"""Serving-plane latency observatory: the >=50k-virtual-subscriber load run.
+
+Drives the PRODUCTION serving stack — Broadcaster ingest queue, per-event
+script indexing, zipf-scoped per-subscriber filtering, bounded subscriber
+queues, shared sender pool — with a deterministic ramped population of
+virtual subscribers (``kaspa_tpu/serving/loadgen.py``: memory sinks plus a
+datagram-socketpair wire cohort drained by one selector thread; no
+thread-per-subscriber, fd budget preflighted).  Emits ``SERVING_LOAD.json``:
+
+* p50/p99/p999 block-accept -> last-hop notification lag per ramp stage,
+  measured at the sinks on the same monotonic clock that stamped the diff
+  (cross-checking the broadcaster's own ``serving_lag_ms`` histograms);
+* drop / disconnect / conflation rates (gated: zero drops at nominal pace);
+* the lag-vs-population curve and the fanout-thread saturation point;
+* the tracing-off overhead gate (PR 7 convention, best-of-N per leg:
+  disabling ``KASPA_TPU_SERVING_TRACE`` instrumentation must not LOSE
+  throughput — ``off >= 0.98 * on``; the raw on/off ratio is reported);
+* optionally (``--daemon-probe``) a daemon-child smoke: a real node, a
+  real wRPC subscriber, mined blocks, and the ``serving_lag_ms`` families
+  visible in its Prometheus export.
+
+Prints one JSON line as the last stdout line (tools/roundcheck.py's
+``serving_load`` section consumes it).
+
+    python tools/serving_load.py --subscribers 50000 --out SERVING_LOAD.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from kaspa_tpu.serving import broadcaster as broadcaster_mod  # noqa: E402
+from kaspa_tpu.serving.loadgen import LoadGen  # noqa: E402
+from kaspa_tpu.utils import fdbudget  # noqa: E402
+
+OVERHEAD_GATE = 0.98
+WIRE_AUTO_CAP = 256
+
+_DAEMON_SCRIPT = textwrap.dedent(
+    """
+    import sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kaspa_tpu.utils import jax_setup; jax_setup.setup()
+    from kaspa_tpu.node.daemon import Daemon, parse_args
+
+    args = parse_args(["--appdir", sys.argv[1], "--rpclisten", "127.0.0.1:0",
+                       "--rpclisten-wrpc", "127.0.0.1:0", "--bps", "2",
+                       "--serving-pool", "2"])
+    d = Daemon(args)
+    d.start()
+    print("WRPC " + d.wrpc_server.address, flush=True)
+    while True:
+        time.sleep(3600)
+    """
+)
+
+
+def _stage_plan(n: int) -> list[int]:
+    """Ramp milestones up to the full population (the lag-vs-population
+    curve's x axis)."""
+    plan = sorted({max(1000, n // 25), max(2000, n // 5), n // 2, n})
+    return [p for p in plan if p <= n] or [n]
+
+
+def _run_stage(lg: LoadGen, events: int, pace_hz: float, size: int, hot_frac: float) -> dict:
+    marker = lg.reset_window()
+    t0 = time.monotonic()
+    publish_wall = lg.drive(events, pace_hz=pace_hz, size=size, hot_frac=hot_frac)
+    drained = lg.drain(timeout=120.0)
+    wall = time.monotonic() - t0
+    delivered = lg.delivered() - marker["delivered"]
+    busy_ns = lg.fanout_busy_ns() - marker["busy_ns"]
+    return {
+        "population": len(lg.subscribers),
+        "events": events,
+        "pace_hz": pace_hz,
+        "publish_wall_s": round(publish_wall, 4),
+        "wall_s": round(wall, 4),
+        "drained": drained,
+        "delivered": delivered,
+        "deliveries_per_event": round(delivered / events, 1) if events else 0.0,
+        "dropped": lg.dropped() - marker["dropped"],
+        "disconnects": lg.disconnects - marker["disconnects"],
+        "conflated": lg.conflated() - marker["conflated"],
+        "fanout_busy_frac": round(busy_ns / (wall * 1e9), 4) if wall > 0 else 0.0,
+        "lag_ms": {k: (round(v, 3) if isinstance(v, float) else v)
+                   for k, v in lg.recorder.percentiles().items()},
+    }
+
+
+def _overhead_ab(args) -> dict:
+    """Best-of-N unpaced delivery throughput, stage tracing on vs off, on
+    a dedicated mid-size population (legs interleaved so drift hits both)."""
+    lg = LoadGen(
+        seed=args.seed ^ 0xAB, addresses=min(args.addresses, 10_000),
+        zipf_s=args.zipf_s, pool_workers=args.pool_workers,
+    )
+    try:
+        lg.ramp_to(args.overhead_population)
+
+        def leg(on: bool) -> float:
+            broadcaster_mod.set_stage_tracing(on)
+            marker = lg.reset_window()
+            t0 = time.monotonic()
+            lg.drive(args.overhead_events, pace_hz=0.0, size=args.diff_size, hot_frac=args.hot_frac)
+            # fine settle: the drain poll quantum must stay well under the
+            # leg wall or it becomes the dominant noise term in the ratio
+            if not lg.drain(timeout=60.0, settle=0.002):
+                return 0.0
+            wall = time.monotonic() - t0
+            return (lg.delivered() - marker["delivered"]) / wall if wall > 0 else 0.0
+
+        leg(True)  # warmup (jit-free, but caches/allocator settle)
+        best_on = best_off = 0.0
+        for _ in range(args.overhead_rounds):
+            best_off = max(best_off, leg(False))
+            best_on = max(best_on, leg(True))
+    finally:
+        broadcaster_mod.set_stage_tracing(True)
+        lg.close()
+    return {
+        "population": args.overhead_population,
+        "events_per_leg": args.overhead_events,
+        "rounds": args.overhead_rounds,
+        "tracing_on_dps": round(best_on, 1),
+        "tracing_off_dps": round(best_off, 1),
+        # PR 7 gate direction: the off leg must reach >=0.98x of the
+        # default (instrumented) leg — turning telemetry off never loses
+        # throughput.  on/off is the honest instrumentation-cost ratio.
+        "off_over_on": round(best_off / best_on, 4) if best_on else 0.0,
+        "on_over_off": round(best_on / best_off, 4) if best_off else 0.0,
+        "gate": OVERHEAD_GATE,
+        "ok": best_on > 0 and best_off >= OVERHEAD_GATE * best_on,
+    }
+
+
+def _saturation_probe(lg: LoadGen, events: int, size: int, hot_frac: float) -> dict:
+    """Unpaced burst: the fanout thread's indexing+filter+offer capacity
+    (events/s of pure busy time) and the end-to-end drain throughput."""
+    marker = lg.reset_window()
+    t0 = time.monotonic()
+    lg.drive(events, pace_hz=0.0, size=size, hot_frac=hot_frac)
+    drained = lg.drain(timeout=180.0)
+    wall = time.monotonic() - t0
+    busy_s = (lg.fanout_busy_ns() - marker["busy_ns"]) * 1e-9
+    delivered = lg.delivered() - marker["delivered"]
+    return {
+        "events": events,
+        "wall_s": round(wall, 4),
+        "drained": drained,
+        "fanout_busy_s": round(busy_s, 4),
+        # pace above this and the fanout thread itself becomes the wall
+        "fanout_saturation_events_per_s": round(events / busy_s, 2) if busy_s > 0 else 0.0,
+        "end_to_end_events_per_s": round(events / wall, 2) if wall > 0 else 0.0,
+        "deliveries_per_s": round(delivered / wall, 1) if wall > 0 else 0.0,
+        "lag_ms": {k: (round(v, 3) if isinstance(v, float) else v)
+                   for k, v in lg.recorder.percentiles().items()},
+    }
+
+
+def _daemon_probe(timeout_s: float) -> dict:
+    """Boot a real daemon child (pooled senders), stream one UtxosChanged
+    over wRPC, and assert the serving_lag_ms families show up in its
+    Prometheus export and getMetrics serving block."""
+    appdir = tempfile.mkdtemp(prefix="serving-load-")
+    script = os.path.join(appdir, "daemon-child.py")
+    with open(script, "w") as f:
+        f.write(_DAEMON_SCRIPT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, script, appdir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    out: dict = {"ok": False}
+    client = None
+    try:
+        addr = None
+        deadline = time.monotonic() + timeout_s
+        for line in proc.stdout:
+            if line.startswith("WRPC "):
+                addr = line.split(" ", 1)[1].strip()
+                break
+            if time.monotonic() > deadline:
+                break
+        if addr is None:
+            out["error"] = "daemon never came up: " + proc.stderr.read()[-400:]
+            return out
+
+        import random
+
+        from kaspa_tpu.crypto.addresses import extract_script_pub_key_address
+        from kaspa_tpu.rpc.wrpc import WrpcClient
+        from kaspa_tpu.sim.simulator import Miner
+
+        miner = Miner(0, random.Random(2))
+        pay = extract_script_pub_key_address(miner.spk, "kaspasim").to_string()
+        client = WrpcClient(addr)
+        client.subscribe("utxos-changed", [pay])
+        for _ in range(6):
+            t = client.call("getBlockTemplate", {"payAddress": pay})
+            client.call("submitBlockByTemplateHash", {"hash": t["block_hash"]})
+        events = 0
+        while events < 1 and time.monotonic() < deadline:
+            try:
+                event, _data = client.next_notification(timeout=10)
+            except Exception:  # noqa: BLE001 - keep polling to the deadline
+                continue
+            if event == "utxos-changed":
+                events += 1
+        prom_text = client.call("getMetricsPrometheus")
+        stages = set(
+            re.findall(r'kaspa_serving_lag_ms_bucket\{stage="([\w-]+)"', prom_text)
+        )
+        counts = {
+            stage: int(float(v))
+            for stage, v in re.findall(
+                r'kaspa_serving_lag_ms_count\{stage="([\w-]+)"\} (\S+)', prom_text
+            )
+        }
+        serving = client.call("getMetrics").get("serving", {})
+        out.update(
+            {
+                "events": events,
+                "prom_stages": sorted(stages),
+                "prom_stage_counts": counts,
+                "metrics_serving_block": bool(serving),
+                "lag_ms_quantiles": serving.get("lag_quantiles_ms", {}),
+                "ok": (
+                    events >= 1
+                    and {"accept_to_fanout", "queue_wait", "encode", "socket_write", "end_to_end"}
+                    <= stages
+                    and counts.get("end_to_end", 0) >= 1
+                    and bool(serving)
+                ),
+            }
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 - evidence carries the failure
+        out.setdefault("error", str(e))
+        return out
+    finally:
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--subscribers", type=int, default=50_000, help="final virtual-subscriber population")
+    ap.add_argument("--wire", default="auto",
+                    help="wire-cohort size: socketpair-backed subscribers (2 fds each); "
+                    "'auto' fits the fd budget (capped at %d)" % WIRE_AUTO_CAP)
+    ap.add_argument("--addresses", type=int, default=50_000, help="synthetic address universe size")
+    ap.add_argument("--zipf-s", type=float, default=1.05, help="zipf exponent for address popularity")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--events-per-stage", type=int, default=12, help="diffs published per ramp stage")
+    ap.add_argument("--pace-hz", type=float, default=3.0, help="nominal diff pace (0 = unpaced)")
+    ap.add_argument("--diff-size", type=int, default=24, help="addresses touched per diff")
+    ap.add_argument("--hot-frac", type=float, default=0.125, help="fraction of diff addresses popularity-sampled")
+    ap.add_argument("--pool-workers", type=int, default=2, help="shared sender-pool workers")
+    ap.add_argument("--sub-maxlen", type=int, default=1024, help="per-subscriber queue bound")
+    ap.add_argument("--overhead-population", type=int, default=2000)
+    ap.add_argument("--overhead-events", type=int, default=60)
+    ap.add_argument("--overhead-rounds", type=int, default=3)
+    ap.add_argument("--saturation-events", type=int, default=12)
+    ap.add_argument("--p99-budget-ms", type=float, default=5000.0,
+                    help="final-stage p99 lag gate at nominal pace (measured "
+                    "1.9-3.4s across runs at 50k subscribers on one CPU core; "
+                    "an unhealthy fanout shows tens of seconds)")
+    ap.add_argument("--daemon-probe", action=argparse.BooleanOptionalAction, default=False,
+                    help="also boot a daemon child and verify serving_lag_ms on the real wire")
+    ap.add_argument("--daemon-timeout", type=float, default=180.0)
+    ap.add_argument("--out", default=None, help="write SERVING_LOAD.json here")
+    args = ap.parse_args(argv)
+
+    result: dict = {
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    ok = False
+    lg = None
+    try:
+        # --- fd preflight (satellite: fail fast with the remedy, never
+        # EMFILE mid-ramp) ---
+        if args.wire == "auto":
+            b = fdbudget.budget()
+            wire = max(0, min(WIRE_AUTO_CAP, b["available"] // 2))
+            fd = fdbudget.preflight(2 * wire, what=f"wire cohort of {wire} subscribers")
+        else:
+            wire = int(args.wire)
+            fd = fdbudget.preflight(2 * wire, what=f"wire cohort of {wire} subscribers")
+        result["run_meta"] = {
+            "seed": args.seed,
+            "subscribers": args.subscribers,
+            "wire_cohort": wire,
+            "addresses": args.addresses,
+            "zipf_s": args.zipf_s,
+            "diff_size": args.diff_size,
+            "hot_frac": args.hot_frac,
+            "pace_hz": args.pace_hz,
+            "pool_workers": args.pool_workers,
+            "sub_maxlen": args.sub_maxlen,
+            "fd_budget": fd,
+            "cpu_count": os.cpu_count(),
+            "stage_tracing": broadcaster_mod.stage_tracing_enabled(),
+        }
+
+        # --- tracing-off overhead gate (dedicated mid-size population) ---
+        result["overhead"] = _overhead_ab(args)
+
+        # --- the ramp: lag vs population at nominal pace ---
+        lg = LoadGen(
+            seed=args.seed, addresses=args.addresses, zipf_s=args.zipf_s,
+            sub_maxlen=args.sub_maxlen, pool_workers=args.pool_workers,
+        )
+        stages = []
+        wire_left = wire
+        for target in _stage_plan(args.subscribers):
+            grow = target - len(lg.subscribers)
+            take_wire = min(wire_left, grow)
+            wire_left -= take_wire
+            t_ramp = time.monotonic()
+            lg.ramp_to(target, wire=take_wire)
+            stage = _run_stage(
+                lg, args.events_per_stage, args.pace_hz, args.diff_size, args.hot_frac
+            )
+            stage["ramp_s"] = round(time.monotonic() - t_ramp - stage["wall_s"], 4)
+            stages.append(stage)
+        result["stages"] = stages
+        result["lag_vs_population"] = [
+            {"population": s["population"], "p50_ms": s["lag_ms"]["p50"],
+             "p99_ms": s["lag_ms"]["p99"], "p999_ms": s["lag_ms"]["p999"]}
+            for s in stages
+        ]
+
+        # --- saturation probe at full population ---
+        result["saturation"] = _saturation_probe(
+            lg, args.saturation_events, args.diff_size, args.hot_frac
+        )
+
+        # --- aggregate rates over the nominal-pace stages ---
+        delivered = sum(s["delivered"] for s in stages)
+        dropped = sum(s["dropped"] for s in stages)
+        conflated = sum(s["conflated"] for s in stages)
+        disconnects = sum(s["disconnects"] for s in stages)
+        result["rates"] = {
+            "delivered": delivered,
+            "drop_rate": round(dropped / delivered, 6) if delivered else 0.0,
+            "disconnect_rate": round(disconnects / max(1, len(lg.subscribers)), 6),
+            "conflation_rate": round(conflated / delivered, 6) if delivered else 0.0,
+        }
+
+        # the broadcaster's own per-stage histogram view (collector block:
+        # what getMetrics["serving"] / the Prometheus gauges export)
+        from kaspa_tpu.observability.core import REGISTRY
+
+        serving = REGISTRY.snapshot().get("serving", {})
+        serving.pop("queue_depths", None)
+        serving.pop("dropped_by_subscriber", None)
+        result["registry_serving"] = serving
+
+        if args.daemon_probe:
+            result["daemon_probe"] = _daemon_probe(args.daemon_timeout)
+
+        final = stages[-1]
+        gates = {
+            "population": {
+                "value": final["population"], "min": args.subscribers,
+                "ok": final["population"] >= args.subscribers,
+            },
+            "drained": {"ok": all(s["drained"] for s in stages)},
+            "drop_rate_nominal": {"value": result["rates"]["drop_rate"], "ok": dropped == 0},
+            "p99_bounded": {
+                "value": final["lag_ms"]["p99"], "budget_ms": args.p99_budget_ms,
+                "ok": 0.0 < final["lag_ms"]["p99"] <= args.p99_budget_ms,
+            },
+            "overhead": {"value": result["overhead"]["off_over_on"], "ok": result["overhead"]["ok"]},
+        }
+        if args.daemon_probe:
+            gates["daemon_probe"] = {"ok": result["daemon_probe"]["ok"]}
+        result["gates"] = gates
+        ok = all(g["ok"] for g in gates.values())
+    except fdbudget.FdBudgetError as e:
+        result["error"] = str(e)
+        print(str(e), file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - evidence line carries the failure
+        import traceback
+
+        result["error"] = str(e)
+        traceback.print_exc()
+    finally:
+        if lg is not None:
+            lg.close()
+
+    result["serving_load_ok"] = ok
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=False)
+            f.write("\n")
+    summary = {
+        "serving_load_ok": ok,
+        "population": result.get("stages", [{}])[-1].get("population", 0),
+        "p50_ms": result.get("stages", [{}])[-1].get("lag_ms", {}).get("p50", 0.0),
+        "p99_ms": result.get("stages", [{}])[-1].get("lag_ms", {}).get("p99", 0.0),
+        "drop_rate": result.get("rates", {}).get("drop_rate", 1.0),
+        "overhead_off_over_on": result.get("overhead", {}).get("off_over_on", 0.0),
+        "fanout_saturation_events_per_s": result.get("saturation", {}).get(
+            "fanout_saturation_events_per_s", 0.0
+        ),
+        "error": result.get("error"),
+    }
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
