@@ -38,6 +38,7 @@ so cycles and mutual recursion terminate and every chain is shortest):
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 
 from kaspa_tpu.analysis.blocking import (
@@ -54,6 +55,22 @@ NO_EXPAND = {
     "int", "str", "float", "bool", "list", "dict", "tuple", "print",
     "isinstance", "getattr", "setattr", "hasattr", "range", "min", "max",
 }
+
+
+# camelCase / digit-run word splitter for receiver-name narrowing
+_WORD_RE = re.compile(r"[A-Z]+(?![a-z])|[A-Z]?[a-z0-9]+")
+
+
+def _words_align(recv: str, cls: str) -> bool:
+    """True when receiver and class name share a word-boundary-anchored
+    stem: some word of one is a prefix of some word of the other."""
+    rwords = [w for w in recv.split("_") if w]
+    cwords = [w.lower() for w in _WORD_RE.findall(cls)]
+    return any(
+        cw.startswith(rw) or rw.startswith(cw)
+        for rw in rwords
+        for cw in cwords
+    )
 
 
 @dataclass
@@ -247,15 +264,18 @@ class CallGraph:
         if not cands:
             return None
         # receiver-name narrowing: `ticket.wait()` selects class Ticket.
-        # Both directions of the substring test run (receiver "admission"
-        # vs class AdmissionTicket; receiver "tier" vs class IngestTier);
-        # exact match wins outright over substring matches.
+        # Both directions run, aligned at word boundaries (receiver
+        # "admission" vs class AdmissionTicket; receiver "tier" vs class
+        # IngestTier); exact match wins outright.  Matches must anchor at
+        # the start of a camelCase / snake_case word — a raw substring
+        # test accepts accidents that straddle word boundaries (receiver
+        # "db" inside "Sharde|dB|roadcaster") and misresolves the site.
         rl = site.recv.lower().strip("_")
         if rl:
             exact = [c for c in cands if c.cls.lower() == rl]
             if len(exact) == 1:
                 return exact[0]
-            subs = [c for c in cands if rl in c.cls.lower() or c.cls.lower() in rl]
+            subs = [c for c in cands if _words_align(rl, c.cls)]
             if len(subs) == 1:
                 return subs[0]
             if subs:

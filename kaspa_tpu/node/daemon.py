@@ -142,6 +142,16 @@ def parse_args(argv=None) -> DaemonArgs:
         "runs pooled)",
     )
     p.add_argument(
+        "--fanout-shards", type=int,
+        default=int(os.environ.get("KASPA_TPU_FANOUT_SHARDS", "1")),
+        metavar="N",
+        help="partition the serving fanout across N shard workers with a "
+        "scope-pushdown inverted index (subscribers hash-partitioned by "
+        "connection id; 1 = the single-fanout broadcaster, bit-identical "
+        "delivered streams either way; with --serving-pool the crew splits "
+        "into per-shard pools)",
+    )
+    p.add_argument(
         "--flight", action=argparse.BooleanOptionalAction, default=False,
         help="per-block flight recorder: cross-thread span trees for every "
         "validated block in a bounded ring, served over getTraces and dumped "
@@ -448,14 +458,19 @@ class Daemon:
         self._fanout_queue = getattr(args, "fanout_queue", None) or 1024
         self._fanout_policy = getattr(args, "fanout_policy", None) or "drop-oldest"
         # shared sender crew (--serving-pool / KASPA_TPU_SERVING_POOL):
-        # None keeps the historical thread-per-subscriber shape
+        # None keeps the historical thread-per-subscriber shape.  With
+        # --fanout-shards > 1 the crew is owned per shard instead (the
+        # ShardedBroadcaster builds one pool per shard from the same
+        # worker budget), so no shared pool is created here.
         pool_workers = int(getattr(args, "serving_pool", 0) or 0)
-        if pool_workers > 0:
+        self._fanout_shards = max(1, int(getattr(args, "fanout_shards", 1) or 1))
+        if pool_workers > 0 and self._fanout_shards <= 1:
             from kaspa_tpu.serving import SenderPool
 
             self.serving_pool = SenderPool(workers=pool_workers)
         else:
             self.serving_pool = None
+        self._serving_pool_workers = pool_workers
         self._sub_seq = itertools.count(1)
         self.utxoindex = self._make_utxoindex(self.consensus) if args.utxoindex else None
         from kaspa_tpu.p2p.address_manager import AddressManager, ConnectionManager
@@ -479,9 +494,28 @@ class Daemon:
         # every remote subscriber.  Bound to the notifier OBJECT, which
         # survives consensus staging swaps via rebind_parent, so the
         # broadcaster (and its wildcard listener id) lives daemon-long.
-        from kaspa_tpu.serving import Broadcaster
+        # --fanout-shards N > 1 swaps in the subscriber-partitioned tier
+        # behind the same surface (bit-identical delivered streams).
+        from kaspa_tpu.serving.broadcaster import tune_gil_switch_interval
 
-        self.broadcaster = Broadcaster(self.rpc.notifier)
+        tune_gil_switch_interval()
+        if self._fanout_shards > 1:
+            from kaspa_tpu.serving import ShardedBroadcaster
+
+            per_shard = (
+                max(1, -(-self._serving_pool_workers // self._fanout_shards))
+                if self._serving_pool_workers > 0
+                else 0
+            )
+            self.broadcaster = ShardedBroadcaster(
+                self.rpc.notifier,
+                shards=self._fanout_shards,
+                pool_workers=per_shard,
+            )
+        else:
+            from kaspa_tpu.serving import Broadcaster
+
+            self.broadcaster = Broadcaster(self.rpc.notifier)
         # node-wide overload-control plane (resilience/overload.py): samples
         # pressure on its own ticker, engages brownout actions through the
         # subsystem seams.  The mining facade is rebuilt on consensus
@@ -647,18 +681,30 @@ class Daemon:
 
     # --- serving-tier subscribers (one per connection, lazily created) ---
 
+    def _subscriber_placement(self, name: str):
+        """(pool, shard) a new subscriber must be built with: its shard's
+        sender crew under --fanout-shards, the shared pool (or None)
+        otherwise."""
+        bc = self.broadcaster
+        if bc is not None and hasattr(bc, "sender_pool_for"):
+            return bc.sender_pool_for(name), bc.shard_of(name)
+        return self.serving_pool, None
+
     def make_json_subscriber(self, sink, stop=None):
         from kaspa_tpu.serving import Subscriber
 
+        name = f"json-{next(self._sub_seq)}"
+        pool, shard = self._subscriber_placement(name)
         return Subscriber(
-            f"json-{next(self._sub_seq)}",
+            name,
             _json_notification_line,
             sink,
             encoding="json",
             maxlen=self._fanout_queue,
             policy=self._fanout_policy,
             on_disconnect=stop.set if stop is not None else None,
-            pool=self.serving_pool,
+            pool=pool,
+            shard=shard,
         )
 
     def make_borsh_subscriber(self, sink, stop=None):
@@ -666,15 +712,18 @@ class Daemon:
         from kaspa_tpu.serving import Subscriber
 
         prefix = self.args.address_prefix
+        name = f"borsh-{next(self._sub_seq)}"
+        pool, shard = self._subscriber_placement(name)
         return Subscriber(
-            f"borsh-{next(self._sub_seq)}",
+            name,
             lambda n: borsh_codec.encode_notification(n, prefix),
             sink,
             encoding="borsh",
             maxlen=self._fanout_queue,
             policy=self._fanout_policy,
             on_disconnect=stop.set if stop is not None else None,
-            pool=self.serving_pool,
+            pool=pool,
+            shard=shard,
         )
 
     # --- staging consensus (proof IBD) ---

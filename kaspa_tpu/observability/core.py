@@ -278,6 +278,21 @@ class Registry:
         with self._mu:
             self._collectors.setdefault(name, []).append(ref)
 
+    def unregister_collector(self, name: str, fn) -> None:
+        """Detach one collector from ``name`` (close() symmetry).  The
+        weak refs already prune collected owners, but an owner that is
+        closed yet not garbage-collected would keep contributing to the
+        merged snapshot — torn-down subsystems unregister explicitly."""
+        with self._mu:
+            refs = self._collectors.get(name)
+            if not refs:
+                return
+            kept = [r for r in refs if r() is not None and r() != fn]
+            if kept:
+                self._collectors[name] = kept
+            else:
+                self._collectors.pop(name, None)
+
     # -- snapshot (hot-ish; lock-free) ---------------------------------
 
     def snapshot(self) -> dict:

@@ -444,14 +444,22 @@ def default_signals(
         # there.  A few deep-but-fast queues stay quiet; shallow queues
         # on a stalled sender crew raise it immediately.  Reads 0 while
         # stage tracing is off (no new observations -> no pressure).
-        from kaspa_tpu.serving.broadcaster import _LAG_QUEUE_WAIT
+        # Sharded tier: MAX of per-shard windowed means — one wedged
+        # shard must trip ELEVATED even when the other shards' fast
+        # deliveries would dilute a global mean below threshold.
+        if broadcaster is not None and hasattr(broadcaster, "shard_wait_cells"):
+            readers = [_windowed_hist_mean(c) for c in broadcaster.shard_wait_cells()]
 
+            def _shard_lag_max(_readers=readers) -> float:
+                return max((r() for r in _readers), default=0.0)
+
+            lag_fn = _shard_lag_max
+        else:
+            from kaspa_tpu.serving.broadcaster import _LAG_QUEUE_WAIT
+
+            lag_fn = _windowed_hist_mean(_LAG_QUEUE_WAIT)
         out.append(
-            PressureSignal(
-                "fanout_lag_ms",
-                _windowed_hist_mean(_LAG_QUEUE_WAIT),
-                thr["fanout_lag_ms"],
-            )
+            PressureSignal("fanout_lag_ms", lag_fn, thr["fanout_lag_ms"])
         )
 
     out.append(PressureSignal("commit_wait_ms", _windowed_wait_mean(), thr["commit_wait_ms"]))
@@ -494,6 +502,7 @@ def default_actions(
     node=None,
     mining=None,
     knobs: BrownoutKnobs | None = None,
+    thresholds: dict | None = None,
 ) -> list[BrownoutAction]:
     """The node's standard brownout registry, wired through existing
     seams.  Order of engagement as pressure rises:
@@ -545,14 +554,41 @@ def default_actions(
             )
         )
     if broadcaster is not None:
-        out.append(
-            BrownoutAction(
-                "fanout_conflation",
-                ELEVATED,
-                lambda level: broadcaster.set_conflation(_per_level(k.conflate_floor, level)),
-                lambda: broadcaster.set_conflation(None),
+        if hasattr(broadcaster, "shard_depths"):
+            # sharded tier: conflation engages PER SHARD — only the
+            # partitions actually under depth pressure start folding
+            # diffs; subscribers on healthy shards keep full-resolution
+            # streams.  (Re-engagement on each level change re-evaluates
+            # which shards are pressured; release clears every shard.)
+            depth_thr = (thresholds or {}).get(
+                "fanout_depth", DEFAULT_THRESHOLDS["fanout_depth"]
             )
-        )
+
+            def _conflate_engage(level: int) -> None:
+                floor = _per_level(k.conflate_floor, level)
+                trip = depth_thr[0]
+                for idx, depth in enumerate(broadcaster.shard_depths()):
+                    broadcaster.set_conflation(
+                        floor if depth >= trip else None, shard=idx
+                    )
+
+            def _conflate_release() -> None:
+                broadcaster.set_conflation(None)
+
+            out.append(
+                BrownoutAction(
+                    "fanout_conflation", ELEVATED, _conflate_engage, _conflate_release
+                )
+            )
+        else:
+            out.append(
+                BrownoutAction(
+                    "fanout_conflation",
+                    ELEVATED,
+                    lambda level: broadcaster.set_conflation(_per_level(k.conflate_floor, level)),
+                    lambda: broadcaster.set_conflation(None),
+                )
+            )
     if node is not None:
         out.append(
             BrownoutAction(
@@ -598,7 +634,8 @@ def build_controller(
             thresholds=thresholds,
         ),
         default_actions(
-            tier=tier, broadcaster=broadcaster, node=node, mining=mining, knobs=knobs
+            tier=tier, broadcaster=broadcaster, node=node, mining=mining,
+            knobs=knobs, thresholds=thresholds,
         ),
         rise_samples=rise_samples,
         fall_samples=fall_samples,

@@ -15,3 +15,5 @@ from kaspa_tpu.serving.broadcaster import (  # noqa: F401
     stage_tracing_enabled,
 )
 from kaspa_tpu.serving.pool import SenderPool  # noqa: F401
+from kaspa_tpu.serving.scope_index import ScopeIndex  # noqa: F401
+from kaspa_tpu.serving.shards import ShardedBroadcaster  # noqa: F401

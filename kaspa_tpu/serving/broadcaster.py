@@ -38,11 +38,13 @@ from __future__ import annotations
 
 import os
 import queue
+import sys
 import threading
 
 from kaspa_tpu.utils.sync import ranked_lock
 from collections import deque
-from time import perf_counter_ns
+from contextlib import nullcontext
+from time import monotonic, perf_counter_ns
 
 from kaspa_tpu.core.log import get_logger
 from kaspa_tpu.notify.notifier import EVENT_TYPES, Notification
@@ -98,6 +100,15 @@ _CONFLATE_MERGED = REGISTRY.histogram(
     "serving_conflation_merged_diffs", buckets=SIZE_BUCKETS,
     help="diffs folded into each delivered conflated utxos-changed notification",
 )
+# Sharded fanout tier (serving/shards.py): queue_wait decomposed per
+# shard, so the overload plane can take the MAX across shards — one
+# wedged shard must trip ELEVATED even while the other shards' fast
+# deliveries would dilute a global mean to quiet.  Subscribers carrying a
+# shard id observe into their shard's cell next to the global stage cell.
+_SHARD_QUEUE_WAIT = REGISTRY.histogram_family(
+    "serving_shard_queue_wait_ms", "shard", MS_LATENCY_BUCKETS,
+    help="subscriber queue_wait lag per fanout shard (sharded serving tier; ms)",
+)
 # hot-path cells held once (the documented CounterFamily/HistogramFamily
 # pattern): the delivery path runs per subscriber per event — at 50k
 # subscribers a per-observe dict lookup is measurable against the 2%
@@ -117,6 +128,21 @@ _LAG_END_TO_END = _LAG_MS.cell("end_to_end")
 _STAGE_TRACE = os.environ.get("KASPA_TPU_SERVING_TRACE", "1") != "0"
 
 
+def register_serving_collector(collect) -> None:
+    """The one registration site for the ``serving`` collector.  Both
+    fanout tiers (Broadcaster and the sharded tier) publish their
+    snapshot under this name; the registry merges numeric leaves across
+    live instances, so whichever tier the daemon constructed reports."""
+    REGISTRY.register_collector("serving", collect)
+
+
+def unregister_serving_collector(collect) -> None:
+    """close() symmetry for ``register_serving_collector``: a torn-down
+    tier must stop contributing to the merged snapshot immediately, not
+    whenever the garbage collector gets to it."""
+    REGISTRY.unregister_collector("serving", collect)
+
+
 def stage_tracing_enabled() -> bool:
     return _STAGE_TRACE
 
@@ -126,6 +152,28 @@ def set_stage_tracing(on: bool) -> None:
     harness A/Bs the overhead gate through this seam)."""
     global _STAGE_TRACE
     _STAGE_TRACE = bool(on)
+
+
+def tune_gil_switch_interval() -> float:
+    """Raise the interpreter's GIL switch interval for fanout-heavy
+    processes and return the interval now in effect (seconds).
+
+    The delivery path is pure-Python churn spread across many threads
+    (shard routers, sender-pool crews, the wire selector); at the default
+    5 ms quantum the interpreter forces a GIL handoff mid-burst thousands
+    of times per second and the cache/convoy cost shows up directly as
+    delivery throughput (~45% on the 50k-subscriber load harness on one
+    core).  ``KASPA_TPU_GIL_SWITCH_MS`` (default 20, 0 disables) is
+    raise-only: an operator who set a larger interval process-wide keeps
+    it, and library code never *shrinks* the quantum behind the
+    embedder's back."""
+    try:
+        ms = float(os.environ.get("KASPA_TPU_GIL_SWITCH_MS", "20") or 0.0)
+    except ValueError:
+        ms = 0.0
+    if ms > 0 and ms * 1e-3 > sys.getswitchinterval():
+        sys.setswitchinterval(ms * 1e-3)
+    return sys.getswitchinterval()
 
 
 from kaspa_tpu.observability.shed import SHED as _SHED  # noqa: E402  (family declared once there)
@@ -179,6 +227,7 @@ class Subscriber:
         policy: str = POLICY_DROP_OLDEST,
         on_disconnect=None,
         pool=None,
+        shard: int | None = None,
     ):
         if policy not in POLICIES:
             raise ValueError(f"unknown backpressure policy {policy!r}")
@@ -189,6 +238,17 @@ class Subscriber:
         self.maxlen = max(1, int(maxlen))
         self.policy = policy
         self.on_disconnect = on_disconnect
+        # sharded fanout tier: which shard owns this subscriber (None =
+        # the single-fanout path, byte-identical to the historical shape).
+        # Sharded subscribers additionally keep an active-event set and an
+        # in-flight marker (both under self._lock) so unsubscribe can
+        # guarantee "no delivery of that event completes after unsubscribe
+        # returns" — see retract().
+        self.shard = shard
+        self._shard_wait_cell = _SHARD_QUEUE_WAIT.cell(str(shard)) if shard is not None else None
+        self._active_events: set | None = set() if shard is not None else None
+        self._inflight_event: str | None = None
+        self._retract_waiting = 0  # retract() callers parked on the cv
         # event type -> None (wildcard) | frozenset of script pubkeys.
         # Mutated copy-on-write under the owning Broadcaster's lock; the
         # broadcaster thread reads the frozen value without copying it.
@@ -217,17 +277,32 @@ class Subscriber:
 
     # --- broadcaster side ---
 
-    def offer(self, notification: Notification, t_received_ns: int) -> None:
+    def offer(
+        self, notification: Notification, t_received_ns: int, defer_kick: bool = False
+    ) -> bool:
         """Enqueue one event; applies the overflow policy, never blocks.
 
         ``t_received_ns`` is the broadcaster-receipt stamp
         (perf_counter_ns) — queue-wait lag is measured from it.
+
+        ``defer_kick=True`` (sharded fanout workers): when a pool kick is
+        due, return True instead of scheduling — the caller batches one
+        ``schedule_many`` for the whole routed event rather than paying a
+        ready-queue wakeup per subscriber.  Returns False otherwise.
         """
         disconnect = False
         kick = False
         with self._lock:
             if self._stopped:
-                return
+                return False
+            if (
+                self._active_events is not None
+                and notification.event_type not in self._active_events
+            ):
+                # sharded tier: a fanout worker routed from a membership
+                # snapshot taken before an unsubscribe landed — the event
+                # is no longer deliverable for this subscriber
+                return False
             if len(self._dq) >= self.maxlen:
                 if self.policy == POLICY_DISCONNECT:
                     disconnect = True
@@ -261,6 +336,8 @@ class Subscriber:
                     self._scheduled = True
                     kick = True
         if kick:
+            if defer_kick:
+                return True
             self._pool.schedule(self)
         if disconnect:
             _SUB_DISCONNECTS.inc()
@@ -271,10 +348,59 @@ class Subscriber:
                     self.on_disconnect()
                 except Exception:  # noqa: BLE001 - teardown callback must not kill fanout
                     log.exception("subscriber %s disconnect callback failed", self.name)
+        return False
 
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._dq)
+
+    # --- sharded-tier event membership (no-ops for shard=None) ---
+
+    def activate(self, event: str) -> None:
+        """Mark ``event`` deliverable.  The owning shard calls this under
+        its shard lock in the same critical section that adds the index
+        entry, so a routing snapshot either misses this subscriber or
+        sees the event active — never a half-state."""
+        with self._lock:
+            if self._active_events is not None:
+                self._active_events.add(event)
+
+    def retract(self, event: str, timeout: float = 5.0) -> None:
+        """Make "no delivery of ``event`` completes after this returns"
+        true: drop the event from the active set (in-flight offers from a
+        stale routing snapshot bounce), purge queued entries of the type,
+        and wait out a delivery already mid-``_deliver``."""
+        with self._lock:
+            if self._active_events is not None:
+                self._active_events.discard(event)
+            if self._dq:
+                kept = [it for it in self._dq if it[0].event_type != event]
+                if len(kept) != len(self._dq):
+                    self._dq.clear()
+                    self._dq.extend(kept)
+            deadline = monotonic() + timeout
+            self._retract_waiting += 1
+            try:
+                while self._inflight_event == event and not self._stopped:
+                    left = deadline - monotonic()
+                    if left <= 0:
+                        log.warning(
+                            "subscriber %s: in-flight %s delivery outlived the "
+                            "retract timeout", self.name, event,
+                        )
+                        break
+                    self._cv.wait(timeout=left)
+            finally:
+                self._retract_waiting -= 1
+
+    def _clear_inflight(self) -> None:
+        # fast path (single-fanout subscribers): _inflight_event is never
+        # set, so the plain delivery loop pays one attribute check
+        if self._inflight_event is not None:
+            with self._lock:
+                self._inflight_event = None
+                if self._retract_waiting:
+                    self._cv.notify_all()
 
     # --- lifecycle ---
 
@@ -294,27 +420,51 @@ class Subscriber:
         """Encode + write one event to the sink, recording per-stage lag.
         Returns False only when the subscriber stopped mid-write."""
         staged = _STAGE_TRACE
-        ctx = getattr(notification, "ctx", None)
+        sinks_live = trace.sinks_active()
+        ctx = getattr(notification, "ctx", None) if sinks_live else None
         t_dq = perf_counter_ns() if staged else 0
         if staged:
-            _LAG_QUEUE_WAIT.observe((t_dq - t_received_ns) * 1e-6)
-            if trace.sinks_active():
+            wait_ms = (t_dq - t_received_ns) * 1e-6
+            _LAG_QUEUE_WAIT.observe(wait_ms)
+            if self._shard_wait_cell is not None:
+                self._shard_wait_cell.observe(wait_ms)
+            if sinks_live:
                 # retroactive span: the interval this event sat in the
                 # bounded subscriber queue, grafted onto the emitting
                 # block's trace (flight ring / capture log only — when
-                # neither collects, skip building a span nobody keeps)
-                ctx_wait = trace.record_span(
-                    "wait.serving_queue", ctx, t_received_ns, t_dq, subscriber=self.name
-                )
+                # neither collects, skip building a span nobody keeps).
+                # Sharded subscribers tag the span with their shard so a
+                # block's tree stays readable across shard threads.
+                if self.shard is None:
+                    ctx_wait = trace.record_span(
+                        "wait.serving_queue", ctx, t_received_ns, t_dq, subscriber=self.name
+                    )
+                else:
+                    ctx_wait = trace.record_span(
+                        "wait.serving_queue", ctx, t_received_ns, t_dq,
+                        subscriber=self.name, shard=self.shard,
+                    )
                 if ctx_wait is not None:
                     ctx = ctx_wait
         # delivery rides the emitting block's trace (cross-thread via
-        # the Notification's captured context): encode + sink.put
-        with trace.span(
-            "serving.deliver", parent=ctx,
-            encoding=self.encoding, event=notification.event_type,
-            merged=notification.merged,
-        ):
+        # the Notification's captured context): encode + sink.put.
+        # Span construction is gated on a live sink — at 10^5 deliveries
+        # per event the per-span cost is the fanout tier's hot path
+        if not sinks_live:
+            deliver_span = nullcontext()
+        elif self.shard is None:
+            deliver_span = trace.span(
+                "serving.deliver", parent=ctx,
+                encoding=self.encoding, event=notification.event_type,
+                merged=notification.merged,
+            )
+        else:
+            deliver_span = trace.span(
+                "serving.deliver", parent=ctx,
+                encoding=self.encoding, event=notification.event_type,
+                merged=notification.merged, shard=self.shard,
+            )
+        with deliver_span:
             try:
                 payload = self.encoder(notification)
             except Exception:  # noqa: BLE001 - one bad encode must not kill the stream
@@ -352,11 +502,19 @@ class Subscriber:
                     self._cv.wait(timeout=0.5)
                 if self._dq:
                     notification, t_received_ns = self._dq.popleft()
+                    if self._active_events is not None:
+                        self._inflight_event = notification.event_type
                 elif self._stopped:
                     return
                 else:
                     continue
-            if not self._deliver(notification, t_received_ns):
+            try:
+                ok = self._deliver(notification, t_received_ns)
+            finally:
+                # even on an unexpected sink/encoder escape: a retract()
+                # waiting on this event must not stall to its timeout
+                self._clear_inflight()
+            if not ok:
                 return
 
     def _pool_drain(self, batch: int) -> bool:
@@ -364,18 +522,37 @@ class Subscriber:
         Returns True when events remain (the worker must reschedule this
         subscriber), False when the queue drained or the subscriber
         stopped — in both False cases ``_scheduled`` has been cleared
-        under the lock, so the next ``offer`` re-kicks the pool."""
-        for _ in range(max(1, batch)):
-            with self._lock:
-                if self._stopped or not self._dq:
-                    self._scheduled = False
-                    return False
-                notification, t_received_ns = self._dq.popleft()
-            if not self._deliver(notification, t_received_ns):
+        under the lock, so the next ``offer`` re-kicks the pool.
+
+        The sharded in-flight marker is cleared inside the NEXT
+        iteration's lock acquisition (one round trip per delivery, not
+        two); the ``finally`` covers the exits where no next acquisition
+        happens, so a parked retract() never waits out its timeout."""
+        cleared = True
+        try:
+            for _ in range(max(1, batch)):
                 with self._lock:
-                    self._scheduled = False
-                return False
-        return True
+                    if not cleared:
+                        self._inflight_event = None
+                        cleared = True
+                        if self._retract_waiting:
+                            self._cv.notify_all()
+                    if self._stopped or not self._dq:
+                        self._scheduled = False
+                        return False
+                    notification, t_received_ns = self._dq.popleft()
+                    if self._active_events is not None:
+                        self._inflight_event = notification.event_type
+                        cleared = False
+                ok = self._deliver(notification, t_received_ns)
+                if not ok:
+                    with self._lock:
+                        self._scheduled = False
+                    return False
+            return True
+        finally:
+            if not cleared:
+                self._clear_inflight()
 
 
 class Broadcaster:
@@ -410,7 +587,7 @@ class Broadcaster:
         self._lid = notifier.register(self.publish)
         self._thread = threading.Thread(target=self._run, daemon=True, name="serving-broadcaster")
         self._thread.start()
-        REGISTRY.register_collector("serving", self._collect)
+        register_serving_collector(self._collect)
 
     # --- observability ---
 
@@ -456,6 +633,12 @@ class Broadcaster:
         with self._mu:
             subs = list(self._subscribers)
         return max((s.queue_depth() for s in subs), default=0)
+
+    def pending(self) -> int:
+        """Events queued at the fanout ingest (shared drain seam with the
+        sharded tier — load harnesses poll this instead of reaching into
+        the queue object)."""
+        return self._ingest.qsize()
 
     def set_conflation(self, floor: int | None) -> None:
         """Brownout seam: enable utxos-changed diff-conflation for every
@@ -631,3 +814,4 @@ class Broadcaster:
         self._thread.join(timeout=5.0)
         for sub in subs:
             sub.close()
+        unregister_serving_collector(self._collect)

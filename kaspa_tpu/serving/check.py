@@ -1,0 +1,242 @@
+"""Sharded-vs-single fanout identity check (roundcheck ``serving_load`` section).
+
+Builds one deterministic subscriber population (zipf-ish scopes over a
+small address universe, a wildcard cohort, a block-added cohort) and one
+recorded diff sequence (24 "blocks" of utxos-changed diffs with explicit
+accept stamps, plus block-added beats), then replays the SAME sequence
+through:
+
+- **single**: the PR 6 ``Broadcaster`` (one fanout thread, per-subscriber
+  scope filtering);
+- **sharded**: ``ShardedBroadcaster`` with N shards (splitter + scope
+  index + partitioned workers).
+
+Mid-sequence (at drained barriers, so ordering stays comparable) the
+population churns exactly the way a live node's would: scopes grow,
+subscribers unsubscribe, unregister and join — the index-maintenance
+paths, not just steady-state routing.
+
+Gate: per-subscriber delivered byte streams are **bit-identical** between
+the two runs (the canonical encoder serializes every payload field the
+wire encodings can see: diff pairs in order, scope set, accept stamp,
+merge count).  Emits one JSON line; exit 0 iff ``serving_identity_ok``.
+
+    python -m kaspa_tpu.serving.check --shards 4 --blocks 24
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+from kaspa_tpu.notify.notifier import Notification, Notifier
+from kaspa_tpu.serving.broadcaster import Broadcaster, Subscriber
+from kaspa_tpu.serving.loadgen import AddressUniverse
+from kaspa_tpu.serving.shards import ShardedBroadcaster
+
+
+def _canon_encode(n: Notification) -> bytes:
+    """Canonical byte serialization of everything a wire encoding could
+    render: any routing/payload divergence between the two fanout tiers
+    becomes a byte difference."""
+    return repr(
+        (
+            n.event_type,
+            [(k, e.script_public_key.script, e.amount) for k, e in n.data.get("added", ())],
+            [(k, e.script_public_key.script, e.amount) for k, e in n.data.get("removed", ())],
+            sorted(n.data.get("spk_set") or ()),
+            n.t_accept_ns,
+            n.merged,
+        )
+    ).encode()
+
+
+class _CaptureSink:
+    __slots__ = ("items",)
+
+    def __init__(self):
+        self.items: list[bytes] = []
+
+    def put(self, payload: bytes, timeout=None) -> None:
+        self.items.append(payload)
+
+
+def _scope_plan(universe: AddressUniverse, subs: int, seed: int) -> list:
+    """[(name, scope-or-None, also_blocks)] — deterministic population."""
+    rnd = random.Random(seed)
+    plan = []
+    for i in range(subs):
+        name = f"csub-{i:04d}"
+        if i % 17 == 0:
+            scope = None  # wildcard cohort
+        else:
+            k = rnd.randint(1, 6)
+            scope = {universe.scripts[j] for j in universe.sample_hot(rnd, k)}
+        plan.append((name, scope, i % 11 == 0))
+    return plan
+
+
+def _diff_plan(universe: AddressUniverse, blocks: int, seed: int) -> list:
+    """Recorded diff sequence: per block, one utxos-changed diff (mixed
+    hot/uniform addresses, a few removed pairs) and — every 3rd block — a
+    block-added beat.  Accept stamps are explicit (block ordinal), so the
+    two replays produce identical bytes regardless of wall clock."""
+    rnd = random.Random(seed ^ 0x5EED)
+    seq = 0
+    out = []
+    for b in range(blocks):
+        idxs = universe.sample_hot(rnd, 4) + universe.sample_uniform(rnd, 12)
+        added, removed, spk_set = [], [], set()
+        for j in idxs:
+            e = universe.entries[j]
+            added.append((seq, e))
+            spk_set.add(e.script_public_key.script)
+            seq += 1
+        for j in universe.sample_uniform(rnd, 3):
+            e = universe.entries[j]
+            removed.append((seq, e))
+            spk_set.add(e.script_public_key.script)
+            seq += 1
+        out.append(
+            Notification(
+                "utxos-changed",
+                {"added": added, "removed": removed, "spk_set": spk_set},
+                None,
+                t_accept_ns=b + 1,
+            )
+        )
+        if b % 3 == 0:
+            out.append(
+                Notification("block-added", {"block": f"blk-{b:04d}"}, None, t_accept_ns=b + 1)
+            )
+    return out
+
+
+def _drain(bc, subs: list, timeout: float = 30.0) -> bool:
+    """Barrier: fanout queues empty, subscriber queues empty, delivered
+    counts stable across two polls."""
+    deadline = time.monotonic() + timeout
+    last = -1
+    while time.monotonic() < deadline:
+        busy = bc.pending() > 0 or any(s.queue_depth() for s in subs)
+        total = sum(s.delivered for s in subs)
+        if not busy and total == last:
+            return True
+        last = total
+        time.sleep(0.01)
+    return False
+
+
+def _replay(make_bc, universe: AddressUniverse, plan: list, diffs: list, seed: int) -> dict:
+    """Run the recorded sequence through one fanout tier; returns
+    {subscriber name: [delivered payload bytes, ...]} plus drain flags."""
+    notifier = Notifier("serving-check")
+    bc = make_bc(notifier)
+    sinks: dict[str, _CaptureSink] = {}
+    by_name: dict[str, Subscriber] = {}
+    for name, scope, also_blocks in plan:
+        sink = _CaptureSink()
+        sinks[name] = sink
+        sub = Subscriber(name, _canon_encode, sink, encoding="check", maxlen=4096)
+        by_name[name] = sub
+        bc.register(sub)
+        bc.subscribe(sub, "utxos-changed", scope)
+        if also_blocks:
+            bc.subscribe(sub, "block-added")
+
+    rnd = random.Random(seed ^ 0xC0FFEE)
+    drains_ok = True
+    third = max(1, len(diffs) // 3)
+    live = [name for name, _, _ in plan]
+
+    def barrier() -> None:
+        nonlocal drains_ok
+        drains_ok = _drain(bc, [by_name[n] for n in live]) and drains_ok
+
+    for i, n in enumerate(diffs):
+        notifier.notify(n)
+        if i == third:
+            # churn wave 1: scopes grow (delta index maintenance), a few
+            # subscribers unsubscribe utxos-changed
+            barrier()
+            for name in live[3:30:7]:
+                grow = {universe.scripts[j] for j in universe.sample_hot(rnd, 2)}
+                bc.subscribe(by_name[name], "utxos-changed", grow)
+            for name in live[5:40:9]:
+                if "utxos-changed" in by_name[name].subscriptions:
+                    bc.unsubscribe(by_name[name], "utxos-changed")
+        elif i == 2 * third:
+            # churn wave 2: unregisters + late joiners
+            barrier()
+            for name in list(live[2:36:11]):
+                bc.unregister(by_name[name])
+                by_name[name].close()
+                live.remove(name)
+            for j in range(4):
+                name = f"csub-late{j}"
+                sink = _CaptureSink()
+                sinks[name] = sink
+                sub = Subscriber(name, _canon_encode, sink, encoding="check", maxlen=4096)
+                by_name[name] = sub
+                bc.register(sub)
+                scope = {universe.scripts[x] for x in universe.sample_hot(rnd, 3)}
+                bc.subscribe(sub, "utxos-changed", scope)
+                live.append(name)
+    barrier()
+    bc.close()
+    return {
+        "streams": {name: list(sink.items) for name, sink in sorted(sinks.items())},
+        "drained": drains_ok,
+    }
+
+
+def run_check(shards: int = 4, blocks: int = 24, subs: int = 120, seed: int = 11) -> dict:
+    universe = AddressUniverse(400, 1.05, seed)
+    plan = _scope_plan(universe, subs, seed)
+    single = _replay(
+        lambda notifier: Broadcaster(notifier),
+        universe, plan, _diff_plan(universe, blocks, seed), seed,
+    )
+    sharded = _replay(
+        lambda notifier: ShardedBroadcaster(notifier, shards=shards),
+        universe, plan, _diff_plan(universe, blocks, seed), seed,
+    )
+    a, b = single["streams"], sharded["streams"]
+    mismatched = sorted(
+        name for name in set(a) | set(b) if a.get(name) != b.get(name)
+    )
+    identical = not mismatched
+    deliveries = sum(len(v) for v in a.values())
+    return {
+        "shards": shards,
+        "blocks": blocks,
+        "subscribers": subs,
+        "deliveries_single": deliveries,
+        "deliveries_sharded": sum(len(v) for v in b.values()),
+        "streams_identical": identical,
+        "mismatched": mismatched[:8],
+        "drained_single": single["drained"],
+        "drained_sharded": sharded["drained"],
+        "serving_identity_ok": identical
+        and deliveries > 0
+        and single["drained"]
+        and sharded["drained"],
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--blocks", type=int, default=24)
+    ap.add_argument("--subs", type=int, default=120)
+    ap.add_argument("--seed", type=int, default=11)
+    args = ap.parse_args(argv)
+    report = run_check(shards=args.shards, blocks=args.blocks, subs=args.subs, seed=args.seed)
+    print(json.dumps(report))
+    return 0 if report["serving_identity_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,7 @@ from kaspa_tpu.core.log import get_logger
 from kaspa_tpu.notify.notifier import Notification, Notifier
 from kaspa_tpu.serving.broadcaster import Broadcaster, Subscriber
 from kaspa_tpu.serving.pool import SenderPool
+from kaspa_tpu.serving.shards import ShardedBroadcaster
 
 log = get_logger("serving")
 
@@ -247,6 +248,7 @@ class LoadGen:
         pool_batch: int = 64,
         ingest_maxsize: int = 8192,
         recorder_cap: int = 200_000,
+        shards: int = 0,
     ):
         self.rnd = random.Random(seed)
         self.universe = AddressUniverse(addresses, zipf_s, seed)
@@ -254,8 +256,19 @@ class LoadGen:
         self.scope_max = max(self.scope_min, int(scope_max))
         self.sub_maxlen = int(sub_maxlen)
         self.notifier = Notifier("loadgen-root")
-        self.pool = SenderPool(workers=pool_workers, batch=pool_batch)
-        self.broadcaster = Broadcaster(self.notifier, ingest_maxsize=ingest_maxsize)
+        self.shards = int(shards)
+        if self.shards > 1:
+            # sharded tier: the pool budget splits across per-shard crews
+            # (each shard owns its senders), no shared pool
+            per_shard = max(1, -(-pool_workers // self.shards)) if pool_workers > 0 else 0
+            self.pool = None
+            self.broadcaster = ShardedBroadcaster(
+                self.notifier, shards=self.shards, ingest_maxsize=ingest_maxsize,
+                pool_workers=per_shard, pool_batch=pool_batch,
+            )
+        else:
+            self.pool = SenderPool(workers=pool_workers, batch=pool_batch)
+            self.broadcaster = Broadcaster(self.notifier, ingest_maxsize=ingest_maxsize)
         self.recorder = LagRecorder(cap=recorder_cap)
         self.wire_reader: WireReader | None = None
         self.subscribers: list[Subscriber] = []
@@ -283,10 +296,16 @@ class LoadGen:
                 sink = WireSink(send_sock)
             else:
                 sink = MemorySink(self.recorder)
+            name = f"vsub-{i:06d}"
+            if self.shards > 1:
+                pool = self.broadcaster.sender_pool_for(name)
+                shard = self.broadcaster.shard_of(name)
+            else:
+                pool, shard = self.pool, None
             sub = Subscriber(
-                f"vsub-{i:06d}", _encode, sink,
-                encoding="loadgen", maxlen=self.sub_maxlen, pool=self.pool,
-                on_disconnect=self._on_disconnect,
+                name, _encode, sink,
+                encoding="loadgen", maxlen=self.sub_maxlen, pool=pool,
+                on_disconnect=self._on_disconnect, shard=shard,
             )
             self.broadcaster.register(sub)
             self.broadcaster.subscribe(sub, "utxos-changed", scope)
@@ -345,9 +364,13 @@ class LoadGen:
         last_count = -1
         while time.monotonic() < deadline:
             busy = (
-                not self.broadcaster._ingest.empty()
-                or self.pool.pending() > 0
-                or any(s.queue_depth() for s in self.subscribers)
+                self.broadcaster.pending() > 0
+                or self._senders_pending() > 0
+                # lock-free depth probe: len(deque) is GIL-atomic, and at
+                # 50k subscribers a locked queue_depth() sweep costs ~0.1 s
+                # of the single core per poll — the measuring loop would
+                # starve the delivery threads it is waiting on
+                or any(s._dq for s in self.subscribers)
             )
             count = self.recorder.count
             if not busy and count == last_count:
@@ -355,6 +378,11 @@ class LoadGen:
             last_count = count
             time.sleep(settle)
         return False
+
+    def _senders_pending(self) -> int:
+        if self.pool is not None:
+            return self.pool.pending()
+        return self.broadcaster.senders_pending()
 
     def dropped(self) -> int:
         return sum(s.dropped for s in self.subscribers)
@@ -384,7 +412,8 @@ class LoadGen:
 
     def close(self) -> None:
         self.broadcaster.close()
-        self.pool.close()
+        if self.pool is not None:
+            self.pool.close()
         if self.wire_reader is not None:
             self.wire_reader.close()
         for s in self.subscribers:

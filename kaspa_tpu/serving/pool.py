@@ -76,6 +76,24 @@ class SenderPool:
             while sub._pool_drain(self.batch):
                 pass
 
+    def schedule_many(self, subs) -> None:
+        """Queue a routed event's worth of subscribers in chunks: one
+        ready-queue entry (one worker wakeup) per ``batch`` subscribers
+        instead of one per subscriber — the sharded fanout workers kick
+        their whole matched set this way after offering outside the shard
+        lock.  The at-most-once invariant is the caller's, same as
+        ``schedule``."""
+        subs = list(subs)
+        for i in range(0, len(subs), self.batch):
+            chunk = subs[i : i + self.batch]
+            try:
+                self._ready.put_nowait(chunk)
+            except queue.Full:  # pragma: no cover - same valve as schedule()
+                log.error("sender-pool ready queue overflow; draining %d subscribers inline", len(chunk))
+                for sub in chunk:
+                    while sub._pool_drain(self.batch):
+                        pass
+
     def pending(self) -> int:
         """Subscribers currently queued for a drain round."""
         return self._ready.qsize()
@@ -84,24 +102,29 @@ class SenderPool:
 
     def _work(self) -> None:
         while True:
-            sub = self._ready.get()
-            if sub is None:
+            item = self._ready.get()
+            if item is None:
                 return
-            _POOL_ROUNDS.inc()
-            try:
-                more = sub._pool_drain(self.batch)
-            except Exception:  # noqa: BLE001 - one bad subscriber must not kill the crew
-                log.exception("sender-pool drain failed for %s", sub.name)
-                with sub._lock:
-                    sub._scheduled = False
-                continue
-            if more:
-                if self._stopping:
+            if isinstance(item, list):
+                _POOL_ROUNDS.inc(len(item))  # one inc per chunk, not per sub
+            else:
+                _POOL_ROUNDS.inc()
+                item = (item,)
+            for sub in item:
+                try:
+                    more = sub._pool_drain(self.batch)
+                except Exception:  # noqa: BLE001 - one bad subscriber must not kill the crew
+                    log.exception("sender-pool drain failed for %s", sub.name)
                     with sub._lock:
                         sub._scheduled = False
                     continue
-                _POOL_RESCHEDULES.inc()
-                self.schedule(sub)
+                if more:
+                    if self._stopping:
+                        with sub._lock:
+                            sub._scheduled = False
+                        continue
+                    _POOL_RESCHEDULES.inc()
+                    self.schedule(sub)
 
     # --- lifecycle ---
 

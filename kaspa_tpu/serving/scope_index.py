@@ -1,0 +1,125 @@
+"""Scope-pushdown inverted index: script pubkey -> watching subscribers.
+
+The single-fanout ``Broadcaster`` answers "who gets this diff?" by
+scanning EVERY subscriber and intersecting its scope with the diff
+(O(subscribers x min(scope, diff)) per event).  At 50k subscribers that
+scan *is* the saturation wall the PR 16 load harness measured.  The
+``ScopeIndex`` inverts the question: maintain script -> subscriber-set
+entries on subscribe/unsubscribe/scope-mutation, so routing one diff
+costs O(affected subscribers) — subscribers whose scopes miss the diff
+are never touched (notify/src/address/tracker.rs role, inverted).
+
+Wildcard subscribers (scope ``None``: "every address") live in a
+separate always-hit set; they never inflate the per-script entries.
+
+The index stores no payloads and makes no ordering promises of its own —
+``route`` returns each affected subscriber's matched-script list in diff
+order, and the caller sorts before building the payload, preserving the
+single-fanout path's deterministic sorted-script payload byte-for-byte
+(see ``serving/shards.py`` and the identity harness in
+``serving/check.py``).
+
+Thread safety: none here — every instance is owned by exactly one fanout
+shard and mutated/read under that shard's ``serving.shard`` ranked lock.
+"""
+
+from __future__ import annotations
+
+
+class ScopeIndex:
+    """Inverted script->subscriber index for utxos-changed routing."""
+
+    __slots__ = ("_watchers", "_wildcard")
+
+    def __init__(self):
+        # script pubkey (bytes) -> set of subscribers watching it
+        self._watchers: dict = {}
+        # subscribers with a wildcard scope: hit by every diff
+        self._wildcard: set = set()
+
+    # --- maintenance (subscribe / unsubscribe / scope mutation) ---
+
+    def add(self, sub, scope) -> None:
+        """Index ``sub`` under every script in ``scope`` (``None`` =
+        wildcard)."""
+        if scope is None:
+            self._wildcard.add(sub)
+            return
+        watchers = self._watchers
+        for s in scope:
+            w = watchers.get(s)
+            if w is None:
+                watchers[s] = {sub}
+            else:
+                w.add(sub)
+
+    def discard(self, sub, scope) -> None:
+        """Drop ``sub``'s entries for ``scope`` (``None`` = wildcard).
+        Unknown scripts / absent memberships are ignored."""
+        if scope is None:
+            self._wildcard.discard(sub)
+            return
+        watchers = self._watchers
+        for s in scope:
+            w = watchers.get(s)
+            if w is not None:
+                w.discard(sub)
+                if not w:
+                    del watchers[s]
+
+    def update(self, sub, old, new) -> None:
+        """Move ``sub`` from scope ``old`` to scope ``new`` touching only
+        the delta — a million-address scope growing by one script costs
+        one entry, not a re-index."""
+        if old == new:
+            return
+        if old is None or new is None:
+            self.discard(sub, old)
+            self.add(sub, new)
+            return
+        self.add(sub, new - old)
+        self.discard(sub, old - new)
+
+    def clear(self) -> None:
+        self._watchers.clear()
+        self._wildcard.clear()
+
+    # --- routing ---
+
+    def route(self, scripts) -> dict:
+        """Affected scoped subscribers for a diff touching ``scripts``
+        (any iterable of script pubkeys, e.g. the per-event by_script
+        index): {subscriber: [matched script, ...]}.  Matched lists
+        follow ``scripts`` iteration order — callers sort before building
+        payloads.  Wildcard subscribers are NOT included; read
+        ``wildcard`` (always-hit) separately."""
+        hits: dict = {}
+        watchers = self._watchers
+        for s in scripts:
+            subs = watchers.get(s)
+            if not subs:
+                continue
+            for sub in subs:
+                lst = hits.get(sub)
+                if lst is None:
+                    hits[sub] = [s]
+                else:
+                    lst.append(s)
+        return hits
+
+    # --- introspection (tests / metrics) ---
+
+    @property
+    def wildcard(self) -> set:
+        return self._wildcard
+
+    def watchers(self, script):
+        """Subscribers indexed under one script (empty tuple when none)."""
+        return self._watchers.get(script, ())
+
+    def script_count(self) -> int:
+        return len(self._watchers)
+
+    def entry_count(self) -> int:
+        """Total (script, subscriber) pairs — the index's memory weight."""
+        return sum(len(w) for w in self._watchers.values())

@@ -1,0 +1,572 @@
+"""Sharded fanout tier: subscriber-partitioned fanout workers.
+
+The PR 6 ``Broadcaster`` is ONE fanout thread that scope-filters every
+diff against every subscriber — the serving plane's serial stage, and the
+wall the PR 16 load harness measured (~2 diffs/s fanout saturation at 50k
+subscribers).  This module horizontalizes it the way PR 10's fabric
+scaled the verify plane: N pipelined workers behind the SAME interface,
+call-site-free.
+
+  consensus root ──> rpc Notifier ──(one wildcard listener)──> publish
+                                                                  │ ingest queue
+                                                         splitter thread:
+                                                         index diff by script ONCE
+                                                  ┌───────────┼───────────┐
+                                             shard 0       shard 1  ...  shard N-1
+                                             bounded q     bounded q     bounded q
+                                             worker:       worker:       worker:
+                                             ScopeIndex    ScopeIndex    ScopeIndex
+                                             route+offer   route+offer   route+offer
+                                                  │            │             │
+                                             its subscribers (hash-partitioned,
+                                             each with its shard's sender pool)
+
+Two multiplications over the single-fanout path:
+
+* **Scope pushdown** — each shard owns a ``ScopeIndex`` slice, so routing
+  a diff costs O(affected subscribers), never a full-population scan; and
+  subscribers sharing a matched-script set share ONE filtered payload
+  (the zipf-hot case: thousands of watchers on one exchange address).
+* **Partitioned workers** — subscribers are hash-partitioned by stable
+  subscriber id (crc32, never Python's salted ``hash``), each shard with
+  its own bounded queue and optionally its own ``SenderPool`` crew, so
+  fanout work parallelizes across cores and one slow shard never blocks
+  the others' offers.
+
+Delivered streams are bit-identical to the single-fanout path —
+``serving/check.py`` proves it on a recorded diff sequence, and
+``daemon --fanout-shards 1`` keeps today's ``Broadcaster`` verbatim.
+
+Lock order (utils/sync.py RANKS): serving.shards(49) facade state ->
+serving.shard(51) per-shard index/membership -> serving.subscriber(55);
+the shard hand-off queues are stdlib Queues whose internal lock is a leaf
+(the splitter holds no ranked lock while putting, workers none while
+getting), and offers happen OUTSIDE the shard lock from a membership
+snapshot — the unsubscribe guarantee is enforced at the subscriber
+(``Subscriber.retract``: active-event set + queued purge + in-flight
+wait), not by stretching the shard lock across sink writes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import zlib
+from time import perf_counter_ns
+
+from kaspa_tpu.core.log import get_logger
+from kaspa_tpu.notify.notifier import EVENT_TYPES, Notification
+from kaspa_tpu.observability import trace
+from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.serving import broadcaster as _bmod
+from kaspa_tpu.serving.broadcaster import (
+    _FANOUT_EVENTS,
+    _INGEST_DROPS,
+    _LAG_ACCEPT_TO_FANOUT,
+    _LAG_MS,
+    _SHARD_QUEUE_WAIT,
+    Broadcaster,
+    Subscriber,
+)
+from kaspa_tpu.serving.pool import SenderPool
+from kaspa_tpu.serving.scope_index import ScopeIndex
+from kaspa_tpu.utils.sync import ranked_lock
+
+log = get_logger("serving")
+
+_SHARD_ROUTED = REGISTRY.counter_family(
+    "serving_shard_routed", "shard",
+    help="subscriber offers routed by each fanout shard worker",
+)
+
+
+def shard_of(name: str, shards: int) -> int:
+    """Stable subscriber-id -> shard partition.  crc32 (not ``hash``):
+    Python string hashing is salted per process, and the partition must
+    be identical across restarts and between the daemon and its tools."""
+    return zlib.crc32(name.encode()) % shards
+
+
+def filter_payload(n: Notification, matched: list, by_script: dict) -> Notification:
+    """Scoped utxos-changed payload for a routed subscriber: byte-for-byte
+    ``Broadcaster._filter_utxos_changed`` (sorted matched scripts, diff
+    pairs concatenated in script order, scope set of matched scripts),
+    minus the per-subscriber scope scan the index already answered."""
+    matched = sorted(matched)
+    added: list = []
+    removed: list = []
+    for s in matched:
+        a, r = by_script[s]
+        added.extend(a)
+        removed.extend(r)
+    data = dict(n.data)
+    data["added"] = added
+    data["removed"] = removed
+    data["spk_set"] = set(matched)
+    return Notification(n.event_type, data, n.ctx, t_accept_ns=n.t_accept_ns, merged=n.merged)
+
+
+class _Routed:
+    """One split event crossing a shard queue.  An object (not a bare
+    tuple) so the payload visibly carries its trace context — the
+    Notification's ``ctx`` rides inside, same as the single-fanout path's
+    ingest queue."""
+
+    __slots__ = ("n", "by_script", "t0_ns")
+
+    def __init__(self, n: Notification, by_script: dict | None, t0_ns: int):
+        self.n = n
+        self.by_script = by_script
+        self.t0_ns = t0_ns
+
+
+class _Shard:
+    """One fanout partition: scope-index slice, membership, bounded
+    hand-off queue, worker thread, optional sender pool."""
+
+    __slots__ = (
+        "idx", "lock", "index", "event_subs", "subs", "q", "pool",
+        "thread", "busy_ns", "events", "routed",
+    )
+
+    def __init__(self, idx: int, maxsize: int, pool: SenderPool | None):
+        self.idx = idx
+        self.lock = ranked_lock("serving.shard", reentrant=False)
+        self.index = ScopeIndex()
+        # event type -> subscriber set for everything that isn't
+        # utxos-changed (those events have no scope: every subscriber of
+        # the type gets the whole notification)
+        self.event_subs: dict[str, set] = {}
+        self.subs: list[Subscriber] = []
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.pool = pool
+        self.thread: threading.Thread | None = None
+        # written only by this shard's worker, read by saturation probes
+        self.busy_ns = 0
+        self.events = 0
+        self.routed = 0
+
+
+class ShardedBroadcaster:
+    """N-shard fanout tier behind the ``Broadcaster`` surface.
+
+    Same call contract as ``Broadcaster``: one wildcard notifier listener
+    (refcounted per event type across ALL shards), ``publish`` never
+    blocks, ``subscribe``/``unsubscribe``/``register``/``unregister``
+    under the daemon dispatch lock.  ``notify``/``rpc``/``wrpc`` call
+    sites swap in via ``daemon --fanout-shards N`` with zero changes.
+
+    ``pool_workers`` > 0 gives each shard its own ``SenderPool`` crew
+    (``sender_pool_for(name)`` hands the right pool to the code creating
+    the Subscriber); 0 keeps thread-per-subscriber senders.
+    """
+
+    def __init__(
+        self,
+        notifier,
+        shards: int = 4,
+        ingest_maxsize: int = 8192,
+        shard_maxsize: int = 1024,
+        pool_workers: int = 0,
+        pool_batch: int = 64,
+    ):
+        self.notifier = notifier
+        self.shard_count = max(1, int(shards))
+        self._ingest: queue.Queue = queue.Queue(maxsize=ingest_maxsize)
+        self._mu = ranked_lock("serving.shards", reentrant=False)
+        self._conflate_floor: int | None = None
+        self._event_refs: dict[str, int] = {}
+        self._closed = False
+        self._shards = [
+            _Shard(
+                i,
+                shard_maxsize,
+                SenderPool(workers=pool_workers, batch=pool_batch, name=f"serving-shard{i}-pool")
+                if pool_workers > 0
+                else None,
+            )
+            for i in range(self.shard_count)
+        ]
+        # splitter utilization (vs blocked on the ingest queue); the
+        # per-shard twin lives on each _Shard
+        self.split_busy_ns = 0
+        self.fanout_events = 0
+        self._lid = notifier.register(self.publish)
+        self._splitter = threading.Thread(
+            target=self._split_run, daemon=True, name="serving-splitter"
+        )
+        self._splitter.start()
+        for sh in self._shards:
+            sh.thread = threading.Thread(
+                target=self._shard_run, args=(sh,), daemon=True, name=f"serving-shard-{sh.idx}"
+            )
+            sh.thread.start()
+        _bmod.register_serving_collector(self._collect)
+
+    # --- partitioning helpers ---
+
+    def shard_of(self, name: str) -> int:
+        return shard_of(name, self.shard_count)
+
+    def sender_pool_for(self, name: str) -> SenderPool | None:
+        """The pool a Subscriber named ``name`` must be constructed with
+        (its shard's crew), or None in thread-per-subscriber mode."""
+        return self._shards[self.shard_of(name)].pool
+
+    # --- observability ---
+
+    @property
+    def fanout_busy_ns(self) -> int:
+        """Total fanout-tier processing time: splitter + every shard.
+        The sum (not the max) is the conservative, core-count-free
+        saturation denominator — on one core all stages serialize, and on
+        many cores a sum-based events/busy still lower-bounds capacity."""
+        return self.split_busy_ns + sum(sh.busy_ns for sh in self._shards)
+
+    def shard_wait_cells(self) -> list:
+        """Per-shard queue_wait histogram cells in shard order — the
+        overload plane maxes windowed means across these (one wedged
+        shard trips ELEVATED; a global mean would dilute it)."""
+        return [_SHARD_QUEUE_WAIT.cell(str(i)) for i in range(self.shard_count)]
+
+    def shard_depths(self) -> list[int]:
+        """Deepest subscriber queue per shard."""
+        out = []
+        for sh in self._shards:
+            with sh.lock:
+                subs = list(sh.subs)
+            out.append(max((s.queue_depth() for s in subs), default=0))
+        return out
+
+    def max_queue_depth(self) -> int:
+        """Deepest per-subscriber queue across every shard (the overload
+        fanout signal aggregates max-across-shards by construction)."""
+        return max(self.shard_depths(), default=0)
+
+    def pending(self) -> int:
+        """Events still inside the fanout tier's queues (ingest + shard
+        hand-offs) — the load harness's drain seam."""
+        return self._ingest.qsize() + sum(sh.q.qsize() for sh in self._shards)
+
+    def senders_pending(self) -> int:
+        """Subscribers queued for a drain round across shard pools."""
+        return sum(sh.pool.pending() for sh in self._shards if sh.pool is not None)
+
+    def _collect(self) -> dict:
+        shards_out = []
+        subs_total = delivered = dropped = conflated = 0
+        depths = []
+        for sh in self._shards:
+            with sh.lock:
+                subs = list(sh.subs)
+            depth = max((s.queue_depth() for s in subs), default=0)
+            depths.append(depth)
+            subs_total += len(subs)
+            delivered += sum(s.delivered for s in subs)
+            dropped += sum(s.dropped for s in subs)
+            conflated += sum(s.conflated for s in subs)
+            shards_out.append(
+                {
+                    "shard": sh.idx,
+                    "subscribers": len(subs),
+                    "queue_depth": sh.q.qsize(),
+                    "max_sub_depth": depth,
+                    "events": sh.events,
+                    "busy_ns": sh.busy_ns,
+                    "routed": sh.routed,
+                }
+            )
+        return {
+            "subscribers": subs_total,
+            "ingest_depth": self._ingest.qsize(),
+            "max_queue_depth": max(depths, default=0),
+            "dropped": dropped,
+            "delivered": delivered,
+            "conflated": conflated,
+            "stage_tracing": int(_bmod._STAGE_TRACE),
+            "fanout": {
+                "events": self.fanout_events,
+                "busy_ns": self.fanout_busy_ns,
+                "split_busy_ns": self.split_busy_ns,
+                "shards": self.shard_count,
+            },
+            "shards": shards_out,
+            "lag_quantiles_ms": {
+                stage: {
+                    "count": h.count,
+                    "p50": h.quantile(0.50),
+                    "p99": h.quantile(0.99),
+                    "p999": h.quantile(0.999),
+                }
+                for stage, h in sorted(_LAG_MS._cells.items())
+                if h.count
+            },
+        }
+
+    # --- brownout seam ---
+
+    def set_conflation(self, floor: int | None, shard: int | None = None) -> None:
+        """Arm utxos-changed diff-conflation.  ``shard=None`` arms every
+        shard; a shard index arms only that partition — brownout engages
+        per shard, so one pressured partition conflates while the others
+        keep full-resolution diffs.  (Within a shard, conflation still
+        only folds diffs for subscribers whose own queue depth reaches
+        the floor.)"""
+        with self._mu:
+            if shard is None:
+                self._conflate_floor = floor
+            targets = self._shards if shard is None else [self._shards[shard]]
+        for sh in targets:
+            with sh.lock:
+                subs = list(sh.subs)
+            for s in subs:
+                s.conflate_floor = floor
+
+    # --- subscriber lifecycle (call under the daemon dispatch lock) ---
+
+    def register(self, sub: Subscriber) -> Subscriber:
+        k = self.shard_of(sub.name)
+        if sub.shard is None:
+            # caller built the subscriber without the shard hint (tests,
+            # legacy call sites): bind it now so delivery telemetry and
+            # the retract machinery engage
+            sub.shard = k
+            sub._shard_wait_cell = _SHARD_QUEUE_WAIT.cell(str(k))
+            sub._active_events = set(sub.subscriptions)
+        elif sub.shard != k:
+            raise ValueError(
+                f"subscriber {sub.name!r} built for shard {sub.shard} but partitions to {k}"
+            )
+        sh = self._shards[k]
+        with sh.lock:
+            sh.subs.append(sub)
+            sub.conflate_floor = self._conflate_floor
+        return sub
+
+    def unregister(self, sub: Subscriber) -> None:
+        """Detach a subscriber and release its upstream event refs.  The
+        caller closes the subscriber (joins its thread) outside any lock."""
+        sh = self._shards[self.shard_of(sub.name)]
+        with sh.lock:
+            if sub not in sh.subs:
+                return
+            sh.subs.remove(sub)
+            events = list(sub.subscriptions)
+            for event in events:
+                scope = sub.subscriptions[event]
+                if event == "utxos-changed":
+                    sh.index.discard(sub, scope)
+                else:
+                    peers = sh.event_subs.get(event)
+                    if peers is not None:
+                        peers.discard(sub)
+                        if not peers:
+                            del sh.event_subs[event]
+            sub.subscriptions = {}
+        for event in events:
+            self._release_event(event)
+        sub.stop()
+
+    def subscribe(self, sub: Subscriber, event: str, scripts: set | None = None) -> None:
+        """Same semantics as ``Broadcaster.subscribe``: repeated
+        subscribes OR scopes together, a wildcard subscribe is sticky.
+        The shard's index slice is updated by delta in the same critical
+        section that activates the event for delivery."""
+        if event not in EVENT_TYPES:
+            raise ValueError(f"unknown event type {event!r}")
+        sh = self._shards[self.shard_of(sub.name)]
+        with sh.lock:
+            known = event in sub.subscriptions
+            prev = sub.subscriptions.get(event)
+            if not scripts:
+                new = None  # wildcard (and sticky)
+            elif known and prev is None:
+                new = None  # already wildcard: narrowing via subscribe is not a thing
+            else:
+                base = prev if prev is not None else frozenset()
+                new = base | frozenset(scripts)
+            sub.subscriptions[event] = new
+            if event == "utxos-changed":
+                if known:
+                    sh.index.update(sub, prev, new)
+                else:
+                    sh.index.add(sub, new)
+            elif not known:
+                sh.event_subs.setdefault(event, set()).add(sub)
+            sub.activate(event)
+        if not known:
+            with self._mu:
+                self._event_refs[event] = self._event_refs.get(event, 0) + 1
+                first = self._event_refs[event] == 1
+            if first:
+                # upstream subscription stays wildcard: the splitter needs
+                # the full diff to index it once for every shard
+                self.notifier.start_notify(self._lid, event)
+
+    def unsubscribe(self, sub: Subscriber, event: str) -> None:
+        if event not in EVENT_TYPES:
+            raise ValueError(f"unknown event type {event!r}")
+        sh = self._shards[self.shard_of(sub.name)]
+        with sh.lock:
+            if event not in sub.subscriptions:
+                return
+            prev = sub.subscriptions.pop(event)
+            if event == "utxos-changed":
+                sh.index.discard(sub, prev)
+            else:
+                peers = sh.event_subs.get(event)
+                if peers is not None:
+                    peers.discard(sub)
+                    if not peers:
+                        del sh.event_subs[event]
+        # the hard half of the contract: a fanout worker may hold a
+        # routing snapshot that predates the index removal — retract
+        # bounces those offers, purges queued entries and waits out an
+        # in-flight delivery, so NOTHING of this event reaches the sink
+        # after this call returns
+        sub.retract(event)
+        self._release_event(event)
+
+    def _release_event(self, event: str) -> None:
+        with self._mu:
+            n = self._event_refs.get(event, 0) - 1
+            if n > 0:
+                self._event_refs[event] = n
+                return
+            self._event_refs.pop(event, None)
+            if self._closed:
+                return
+        self.notifier.stop_notify(self._lid, event)
+
+    # --- publisher side (notifier callback; must never block) ---
+
+    def publish(self, notification: Notification) -> None:
+        try:
+            self._ingest.put_nowait(notification)
+        except queue.Full:
+            _INGEST_DROPS.inc()
+
+    # --- splitter thread: index once, route per shard ---
+
+    def _offer_shard(self, sh: _Shard, item: _Routed) -> None:
+        # blocking put with a close-aware retry: a backed-up shard parks
+        # the splitter (backpressure propagates to the ingest queue, where
+        # publish drops — exactly the single-fanout overflow story)
+        while True:
+            try:
+                sh.q.put(item, timeout=0.25)
+                return
+            except queue.Full:
+                if self._closed:
+                    return
+
+    def _split_run(self) -> None:
+        while True:
+            n = self._ingest.get()
+            if n is None:
+                return
+            t0_ns = perf_counter_ns()
+            _FANOUT_EVENTS.inc(n.event_type)
+            if _bmod._STAGE_TRACE and n.t_accept_ns:
+                _LAG_ACCEPT_TO_FANOUT.observe((t0_ns - n.t_accept_ns) * 1e-6)
+            with trace.span(
+                "serving.split", parent=getattr(n, "ctx", None), event=n.event_type,
+            ):
+                by_script = (
+                    Broadcaster._index_diff(n) if n.event_type == "utxos-changed" else None
+                )
+                item = _Routed(n, by_script, t0_ns)
+                for sh in self._shards:
+                    self._offer_shard(sh, item)
+            self.fanout_events += 1
+            self.split_busy_ns += perf_counter_ns() - t0_ns
+
+    # --- shard workers: scope-index routing + offers ---
+
+    def _shard_run(self, sh: _Shard) -> None:
+        routed_cell = _SHARD_ROUTED.cell(str(sh.idx))
+        while True:
+            item = sh.q.get()
+            if item is None:
+                return
+            n = item.n
+            t1_ns = perf_counter_ns()
+            offers = 0
+            with trace.span(
+                "serving.fanout", parent=getattr(n, "ctx", None),
+                event=n.event_type, shard=sh.idx,
+            ):
+                # offers run with deferred pool kicks: subscribers needing
+                # a drain are collected and handed to the shard's pool as
+                # one schedule_many (one worker wakeup per chunk, not one
+                # per subscriber — every pooled subscriber of this shard
+                # shares sh.pool by construction)
+                kicks: list = []
+                if item.by_script is not None:
+                    # membership snapshot under the shard lock; payload
+                    # building and offers run outside it (retract closes
+                    # the unsubscribe race at the subscriber)
+                    with sh.lock:
+                        hits = sh.index.route(item.by_script)
+                        wild = list(sh.index.wildcard) if sh.index.wildcard else ()
+                    cache: dict = {}
+                    for sub, matched in hits.items():
+                        matched.sort()
+                        key = tuple(matched)
+                        filtered = cache.get(key)
+                        if filtered is None:
+                            filtered = cache[key] = filter_payload(n, matched, item.by_script)
+                        if sub.offer(filtered, item.t0_ns, defer_kick=True):
+                            kicks.append(sub)
+                        offers += 1
+                    for sub in wild:
+                        if sub.offer(n, item.t0_ns, defer_kick=True):
+                            kicks.append(sub)
+                        offers += 1
+                else:
+                    with sh.lock:
+                        targets = list(sh.event_subs.get(n.event_type, ()))
+                    for sub in targets:
+                        if sub.offer(n, item.t0_ns, defer_kick=True):
+                            kicks.append(sub)
+                        offers += 1
+                if kicks:
+                    sh.pool.schedule_many(kicks)
+            sh.events += 1
+            sh.routed += offers
+            if offers:
+                routed_cell.inc(offers)
+            sh.busy_ns += perf_counter_ns() - t1_ns
+
+    # --- lifecycle ---
+
+    def close(self) -> None:
+        """Stop the tier: detach from the notifier, stop the splitter,
+        every shard worker, every shard pool, every subscriber.  Call
+        under the daemon dispatch lock (notifier mutation), like
+        subscribe/unsubscribe."""
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+            self._event_refs.clear()
+        self.notifier.unregister(self._lid)
+        self._ingest.put(None)
+        self._splitter.join(timeout=5.0)
+        for sh in self._shards:
+            sh.q.put(None)
+        for sh in self._shards:
+            if sh.thread is not None:
+                sh.thread.join(timeout=5.0)
+        all_subs: list[Subscriber] = []
+        for sh in self._shards:
+            with sh.lock:
+                all_subs.extend(sh.subs)
+                sh.subs.clear()
+                sh.event_subs.clear()
+                sh.index.clear()
+            if sh.pool is not None:
+                sh.pool.close()
+        for sub in all_subs:
+            sub.close()
+        _bmod.unregister_serving_collector(self._collect)

@@ -71,3 +71,34 @@ def preflight(required: int, *, what: str = "file descriptors", headroom: int = 
             f"wire cohort (--wire)."
         )
     return b
+
+
+def serving_preflight(
+    *,
+    shards: int,
+    pool_workers: int,
+    wire_cohort: int,
+    what: str = "serving tier",
+    headroom: int = HEADROOM,
+) -> dict:
+    """Sharded-serving-tier budget: ``max(1, shards)`` sender-pool crews of
+    ``pool_workers`` each (a slot per worker thread — conservative: worker
+    threads hold log/epoll handles on some runtimes) plus two descriptors
+    per wire-cohort subscriber (a datagram socketpair).  Returns the
+    ``budget()`` dict extended with the accounting breakdown (recorded in
+    ``SERVING_LOAD.json`` run_meta); raises ``FdBudgetError`` on a miss."""
+    crews = max(1, int(shards))
+    worker_slots = crews * max(0, int(pool_workers))
+    socket_fds = 2 * max(0, int(wire_cohort))
+    required = worker_slots + socket_fds
+    b = preflight(
+        required,
+        what=f"{what} ({crews} shard(s) x {pool_workers} pool workers "
+        f"+ wire cohort of {wire_cohort} subscribers)",
+        headroom=headroom,
+    )
+    b["required"] = required
+    b["worker_slots"] = worker_slots
+    b["socket_fds"] = socket_fds
+    b["shards"] = crews
+    return b

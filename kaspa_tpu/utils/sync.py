@@ -149,10 +149,12 @@ RANKS: dict[str, int] = {
     "mesh.config": 38,         # ops/mesh.py — mesh/topology (re)configuration
     "dispatch.queue": 40,      # ops/dispatch.py — verify coalescing queue
     "ingest.queue": 45,        # ingest/queue.py — tx admission queue
+    "serving.shards": 49,      # serving/shards.py — sharded-fanout facade (event refs)
     "serving.broadcaster": 50, # serving/broadcaster.py — subscriber table
+    "serving.shard": 51,       # serving/shards.py — per-shard scope index + membership
     # (serving/pool.py's ready queue is a stdlib Queue — its internal lock
-    # is a leaf taken between broadcaster(50) and subscriber(55) acquisitions,
-    # never while either ranked lock is held)
+    # is a leaf taken between broadcaster(50)/shard(51) and subscriber(55)
+    # acquisitions, never while either ranked lock is held)
     "serving.subscriber": 55,  # serving/broadcaster.py — per-subscriber buffer
     "pipeline.idle": 60,       # pipeline/pipeline.py — idle/backlog condvar
     "pipeline.speculative": 65,# pipeline/speculative.py — prefetch results

@@ -236,6 +236,52 @@ def test_default_actions_drive_every_seam():
     assert mining.grace == 0.0
 
 
+def test_sharded_fanout_conflation_targets_pressured_shards_only():
+    """A broadcaster exposing shard_depths() gets the per-shard variant:
+    engagement conflates only partitions at/above the ELEVATED depth
+    trip; release clears every shard."""
+
+    class ShardedFanout:
+        def __init__(self):
+            self.depths = [10, 100, 63, 64]
+            self.floors: dict = {}
+
+        def shard_depths(self):
+            return self.depths
+
+        def set_conflation(self, floor, shard=None):
+            if shard is None:
+                self.floors = {i: floor for i in range(len(self.depths))}
+            else:
+                self.floors[shard] = floor
+
+    fanout = ShardedFanout()
+    actions = {
+        a.name: a
+        for a in default_actions(broadcaster=fanout, knobs=BrownoutKnobs())
+    }
+    # default fanout_depth trip is (64, 256, 768): shards 1 and 3 qualify
+    actions["fanout_conflation"].engage(ELEVATED)
+    assert fanout.floors == {0: None, 1: 64, 2: None, 3: 64}
+    actions["fanout_conflation"].engage(SATURATED)
+    assert fanout.floors == {0: None, 1: 16, 2: None, 3: 16}
+    actions["fanout_conflation"].release()
+    assert fanout.floors == {i: None for i in range(4)}
+
+    # a custom threshold table flows through build_controller's seam
+    fanout2 = ShardedFanout()
+    acts2 = {
+        a.name: a
+        for a in default_actions(
+            broadcaster=fanout2,
+            knobs=BrownoutKnobs(),
+            thresholds={"fanout_depth": (11, 256, 768)},
+        )
+    }
+    acts2["fanout_conflation"].engage(ELEVATED)
+    assert fanout2.floors == {0: None, 1: 64, 2: 64, 3: 64}
+
+
 # --- shedding seams ---------------------------------------------------------
 
 

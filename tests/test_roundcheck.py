@@ -52,6 +52,11 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             # audit (real eval_shape traces, ~50 s on CPU) — it gets its
             # own `roundcheck --only lint` acceptance run
             "--skip-lint",
+            # and the aggregated-verify lane: its bench child traces BOTH
+            # verify lanes from a cold process (minutes of XLA compile on
+            # CPU, ~5x everything else in this run combined) — it gets its
+            # own `roundcheck --only aggregate` acceptance run
+            "--skip-aggregate",
             "--blocks",
             "8",
             "--out",

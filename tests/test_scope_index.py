@@ -1,0 +1,170 @@
+"""ScopeIndex property tests: index routing vs the brute-force
+per-subscriber filter oracle (the single-fanout Broadcaster's own scope
+scan) under random subscribe/unsubscribe/scope-mutation interleavings."""
+
+import random
+
+import pytest
+
+from kaspa_tpu.notify.notifier import Notification
+from kaspa_tpu.serving.broadcaster import Broadcaster
+from kaspa_tpu.serving.scope_index import ScopeIndex
+from kaspa_tpu.serving.shards import filter_payload
+
+
+class _Spk:
+    __slots__ = ("script",)
+
+    def __init__(self, script):
+        self.script = script
+
+
+class _Entry:
+    __slots__ = ("script_public_key", "amount")
+
+    def __init__(self, script, amount):
+        self.script_public_key = _Spk(script)
+        self.amount = amount
+
+
+SCRIPTS = [b"spk-%03d" % i for i in range(40)]
+
+
+def _diff(rnd, seq0=0):
+    """A random utxos-changed diff over the universe (added + removed)."""
+    seq = seq0
+    added, removed, spk_set = [], [], set()
+    for _ in range(rnd.randint(1, 10)):
+        s = rnd.choice(SCRIPTS)
+        added.append((seq, _Entry(s, 1000 + seq)))
+        spk_set.add(s)
+        seq += 1
+    for _ in range(rnd.randint(0, 4)):
+        s = rnd.choice(SCRIPTS)
+        removed.append((seq, _Entry(s, 1000 + seq)))
+        spk_set.add(s)
+        seq += 1
+    return Notification(
+        "utxos-changed",
+        {"added": added, "removed": removed, "spk_set": spk_set},
+        None,
+        t_accept_ns=seq0 + 1,
+    )
+
+
+def _canon(n):
+    return (
+        [(k, e.script_public_key.script, e.amount) for k, e in n.data["added"]],
+        [(k, e.script_public_key.script, e.amount) for k, e in n.data["removed"]],
+        sorted(n.data["spk_set"]),
+        n.t_accept_ns,
+        n.merged,
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23, 101])
+def test_scope_index_matches_brute_force_oracle(seed):
+    """Random op interleavings; after every diff the index's affected set
+    and per-subscriber payloads must equal the oracle's (a plain dict of
+    sub -> scope run through _filter_utxos_changed)."""
+    rnd = random.Random(seed)
+    index = ScopeIndex()
+    oracle: dict[str, frozenset | None] = {}  # name -> scope (None = wildcard)
+
+    for step in range(300):
+        op = rnd.random()
+        name = f"sub-{rnd.randrange(25)}"
+        if op < 0.35:
+            # (re)subscribe with a fresh scope (None = wildcard 1 in 6)
+            new = (
+                None
+                if rnd.randrange(6) == 0
+                else frozenset(rnd.sample(SCRIPTS, rnd.randint(1, 6)))
+            )
+            if name in oracle:
+                index.update(name, oracle[name], new)
+            else:
+                index.add(name, new)
+            oracle[name] = new
+        elif op < 0.55 and oracle:
+            # scope mutation: grow or shrink an existing subscriber
+            name = rnd.choice(sorted(oracle))
+            old = oracle[name]
+            if old is None:
+                new = frozenset(rnd.sample(SCRIPTS, rnd.randint(1, 4)))
+            elif rnd.random() < 0.5:
+                new = old | frozenset(rnd.sample(SCRIPTS, rnd.randint(1, 3)))
+            else:
+                keep = rnd.randint(0, len(old))
+                new = frozenset(rnd.sample(sorted(old), keep)) or None
+            index.update(name, old, new)
+            oracle[name] = new
+        elif op < 0.7 and oracle:
+            # unsubscribe
+            name = rnd.choice(sorted(oracle))
+            index.discard(name, oracle.pop(name))
+        else:
+            # route a diff and compare against the oracle
+            n = _diff(rnd, seq0=step * 100)
+            by_script = Broadcaster._index_diff(n)
+            hits = index.route(by_script)
+            routed = set(hits) | set(index.wildcard)
+            expected_payloads = {}
+            affected = set()
+            for sub, scope in oracle.items():
+                if scope is None:
+                    affected.add(sub)  # wildcard: gets the raw notification
+                    continue
+                filtered = Broadcaster._filter_utxos_changed(n, scope, by_script)
+                if filtered is not None:
+                    affected.add(sub)
+                    expected_payloads[sub] = _canon(filtered)
+            assert routed == affected, f"step {step}: affected-set divergence"
+            for sub, matched in hits.items():
+                got = filter_payload(n, matched, by_script)
+                assert _canon(got) == expected_payloads[sub], (
+                    f"step {step}: payload divergence for {sub}"
+                )
+
+    # structural sanity after the churn
+    assert index.entry_count() == sum(
+        len(s) for s in oracle.values() if s is not None
+    )
+    assert index.wildcard == {s for s, sc in oracle.items() if sc is None}
+
+
+def test_scope_index_update_delta_only():
+    """update() must touch only the symmetric difference."""
+    index = ScopeIndex()
+    old = frozenset(SCRIPTS[:10])
+    index.add("a", old)
+    new = frozenset(SCRIPTS[5:15])
+    index.update("a", old, new)
+    for s in SCRIPTS[5:15]:
+        assert "a" in index.watchers(s)
+    for s in SCRIPTS[:5]:
+        assert "a" not in index.watchers(s)
+    # scripts with no watchers are pruned (no unbounded key growth)
+    assert index.script_count() == 10
+
+
+def test_scope_index_wildcard_transitions():
+    index = ScopeIndex()
+    index.add("w", None)
+    assert index.wildcard == {"w"}
+    index.update("w", None, frozenset(SCRIPTS[:3]))
+    assert index.wildcard == set()
+    assert index.entry_count() == 3
+    index.update("w", frozenset(SCRIPTS[:3]), None)
+    assert index.wildcard == {"w"}
+    assert index.entry_count() == 0
+    index.discard("w", None)
+    assert index.wildcard == set()
+
+
+def test_route_ignores_unwatched_scripts():
+    index = ScopeIndex()
+    index.add("a", frozenset({SCRIPTS[0]}))
+    hits = index.route([SCRIPTS[0], SCRIPTS[1], SCRIPTS[2]])
+    assert hits == {"a": [SCRIPTS[0]]}
+    assert index.route([SCRIPTS[5]]) == {}

@@ -1,0 +1,298 @@
+"""Sharded fanout tier: identity, routing, the unsubscribe-during-fanout
+race, per-shard brownout conflation and the overload plane's
+max-across-shards lag signal."""
+
+import time
+from time import perf_counter_ns
+
+import pytest
+
+from kaspa_tpu.notify.notifier import Notification, Notifier
+from kaspa_tpu.serving.broadcaster import _SHARD_QUEUE_WAIT, Subscriber
+from kaspa_tpu.serving.check import run_check
+from kaspa_tpu.serving.shards import ShardedBroadcaster, shard_of
+
+
+class _Spk:
+    __slots__ = ("script",)
+
+    def __init__(self, script):
+        self.script = script
+
+
+class _Entry:
+    __slots__ = ("script_public_key", "amount")
+
+    def __init__(self, script, amount):
+        self.script_public_key = _Spk(script)
+        self.amount = amount
+
+
+class ListSink:
+    def __init__(self):
+        self.items = []
+
+    def put(self, payload, timeout=None):
+        self.items.append(payload)
+
+
+class SlowSink(ListSink):
+    """Sink that takes a while per write and stamps each completion."""
+
+    def __init__(self, delay_s=0.02):
+        super().__init__()
+        self.delay_s = delay_s
+        self.done_ns = []
+
+    def put(self, payload, timeout=None):
+        time.sleep(self.delay_s)
+        self.items.append(payload)
+        self.done_ns.append(perf_counter_ns())
+
+
+def _encode(n):
+    return repr(
+        (n.event_type, sorted(n.data.get("spk_set") or ()), n.t_accept_ns, n.merged)
+    ).encode()
+
+
+def _diff(scripts, stamp):
+    added = [(i, _Entry(s, 1000 + i)) for i, s in enumerate(scripts)]
+    return Notification(
+        "utxos-changed",
+        {"added": added, "removed": [], "spk_set": set(scripts)},
+        None,
+        t_accept_ns=stamp,
+    )
+
+
+def _mk(name, sink=None, maxlen=256):
+    return Subscriber(name, _encode, sink or ListSink(), encoding="t", maxlen=maxlen)
+
+
+def _settle(bc, subs, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    last = -1
+    while time.monotonic() < deadline:
+        total = sum(s.delivered for s in subs)
+        if bc.pending() == 0 and not any(s.queue_depth() for s in subs) and total == last:
+            return True
+        last = total
+        time.sleep(0.01)
+    return False
+
+
+def test_identity_small_run():
+    """shards=3 vs single fanout: bit-identical per-subscriber streams on
+    a short recorded sequence with mid-run churn."""
+    report = run_check(shards=3, blocks=12, subs=60, seed=5)
+    assert report["serving_identity_ok"], report
+    assert report["deliveries_single"] == report["deliveries_sharded"] > 0
+
+
+def test_partition_is_stable_and_total():
+    names = [f"conn-{i}" for i in range(200)]
+    assert [shard_of(n, 4) for n in names] == [shard_of(n, 4) for n in names]
+    assert {shard_of(n, 4) for n in names} == {0, 1, 2, 3}
+    for n in names:
+        assert shard_of(n, 1) == 0
+
+
+def test_scoped_routing_and_wildcard():
+    notifier = Notifier()
+    bc = ShardedBroadcaster(notifier, shards=2)
+    try:
+        scoped = _mk("scoped")
+        wild = _mk("wild")
+        miss = _mk("miss")
+        for s in (scoped, wild, miss):
+            bc.register(s)
+        bc.subscribe(scoped, "utxos-changed", {b"S1"})
+        bc.subscribe(wild, "utxos-changed")
+        bc.subscribe(miss, "utxos-changed", {b"S9"})
+        notifier.notify(_diff([b"S1", b"S2"], 7))
+        assert _settle(bc, [scoped, wild, miss])
+        assert scoped.sink.items == [_encode(_diff([b"S1"], 7))]
+        # wildcard gets the raw notification (spk_set as published)
+        assert wild.sink.items == [_encode(_diff([b"S1", b"S2"], 7))]
+        assert miss.sink.items == []
+    finally:
+        bc.close()
+
+
+def test_unsubscribe_during_fanout_race():
+    """After unsubscribe() returns, the subscriber's sink must never see
+    another delivery of that event — queued entries are purged and the
+    in-flight one is waited out, even with routing snapshots in flight."""
+    notifier = Notifier()
+    bc = ShardedBroadcaster(notifier, shards=2, shard_maxsize=64)
+    try:
+        sink = SlowSink(delay_s=0.02)
+        victim = _mk("victim", sink=sink)
+        bc.register(victim)
+        bc.subscribe(victim, "utxos-changed", {b"S1"})
+        for i in range(12):
+            notifier.notify(_diff([b"S1"], i + 1))
+        # let the first slow delivery start
+        deadline = time.monotonic() + 5.0
+        while not sink.items and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert sink.items, "first delivery never started"
+        bc.unsubscribe(victim, "utxos-changed")
+        t_unsub = perf_counter_ns()
+        seen = len(sink.items)
+        # anything still routed afterwards must be bounced by the
+        # subscriber's active-event set
+        notifier_still_live = _diff([b"S1"], 99)
+        bc.publish(notifier_still_live)
+        time.sleep(0.3)
+        assert len(sink.items) == seen, "delivery completed after unsubscribe returned"
+        assert all(t <= t_unsub for t in sink.done_ns)
+        assert "utxos-changed" not in victim.subscriptions
+    finally:
+        bc.close()
+
+
+def test_conflation_engages_per_shard():
+    notifier = Notifier()
+    bc = ShardedBroadcaster(notifier, shards=2)
+    try:
+        # names landing on each shard
+        names0 = [f"c{i}" for i in range(40) if shard_of(f"c{i}", 2) == 0][:2]
+        names1 = [f"c{i}" for i in range(40) if shard_of(f"c{i}", 2) == 1][:2]
+        subs = {n: bc.register(_mk(n)) for n in names0 + names1}
+        bc.set_conflation(2, shard=0)
+        assert all(subs[n].conflate_floor == 2 for n in names0)
+        assert all(subs[n].conflate_floor is None for n in names1)
+        bc.set_conflation(3)  # all shards
+        assert all(s.conflate_floor == 3 for s in subs.values())
+        bc.set_conflation(None)
+        assert all(s.conflate_floor is None for s in subs.values())
+        # the facade floor applies to late registrations too
+        bc.set_conflation(5)
+        late_name = "late-sub"
+        late = bc.register(_mk(late_name))
+        assert late.conflate_floor == 5
+    finally:
+        bc.close()
+
+
+def test_overload_lag_signal_is_max_across_shards():
+    """One wedged shard's queue_wait must drive the fanout_lag_ms signal
+    even when the other shards are fast (a global mean would dilute it)."""
+    from kaspa_tpu.resilience.overload import default_signals
+
+    notifier = Notifier()
+    bc = ShardedBroadcaster(notifier, shards=3)
+    try:
+        sig = next(
+            s for s in default_signals(broadcaster=bc) if s.name == "fanout_lag_ms"
+        )
+        sig.read()  # anchor the windows
+        # shard 1 wedged (500 ms waits), shards 0/2 fast (0.1 ms)
+        _SHARD_QUEUE_WAIT.cell("1").observe(500.0)
+        for _ in range(50):
+            _SHARD_QUEUE_WAIT.cell("0").observe(0.1)
+            _SHARD_QUEUE_WAIT.cell("2").observe(0.1)
+        value = sig.read()
+        assert value == pytest.approx(500.0), value
+        # ELEVATED enter threshold (25.0) would be missed by the global
+        # mean of the same observations (~5 ms) — the max trips it
+        assert value >= sig.enter[0]
+    finally:
+        bc.close()
+
+
+def test_collector_reports_per_shard_blocks():
+    notifier = Notifier()
+    bc = ShardedBroadcaster(notifier, shards=2)
+    try:
+        sub = bc.register(_mk("m1"))
+        bc.subscribe(sub, "utxos-changed", {b"S1"})
+        notifier.notify(_diff([b"S1"], 3))
+        assert _settle(bc, [sub])
+        snap = bc._collect()
+        assert snap["fanout"]["shards"] == 2
+        assert len(snap["shards"]) == 2
+        assert {b["shard"] for b in snap["shards"]} == {0, 1}
+        assert snap["delivered"] == 1
+        assert snap["subscribers"] == 1
+        assert snap["fanout"]["events"] == 1
+        assert snap["fanout"]["busy_ns"] > 0
+    finally:
+        bc.close()
+
+
+def test_register_rejects_wrong_shard_hint():
+    notifier = Notifier()
+    bc = ShardedBroadcaster(notifier, shards=4)
+    try:
+        name = "conn-x"
+        wrong = (shard_of(name, 4) + 1) % 4
+        sub = Subscriber(name, _encode, ListSink(), encoding="t", shard=wrong)
+        with pytest.raises(ValueError):
+            bc.register(sub)
+        sub.stop()
+    finally:
+        bc.close()
+
+
+def test_daemon_fanout_shards_flag(monkeypatch, tmp_path):
+    from kaspa_tpu.node.daemon import parse_args
+
+    args = parse_args(["--appdir", str(tmp_path)])
+    assert args.fanout_shards == 1
+    args = parse_args(["--appdir", str(tmp_path), "--fanout-shards", "4"])
+    assert args.fanout_shards == 4
+    monkeypatch.setenv("KASPA_TPU_FANOUT_SHARDS", "3")
+    args = parse_args(["--appdir", str(tmp_path)])
+    assert args.fanout_shards == 3
+
+
+def test_event_refs_are_shared_across_shards():
+    """One upstream wildcard listener per event type, refcounted across
+    every shard — the notifier must see start/stop exactly once."""
+    notifier = Notifier()
+    bc = ShardedBroadcaster(notifier, shards=3)
+    try:
+        starts, stops = [], []
+        orig_start, orig_stop = notifier.start_notify, notifier.stop_notify
+        notifier.start_notify = lambda lid, ev, *a, **k: (
+            starts.append(ev), orig_start(lid, ev, *a, **k))[-1]
+        notifier.stop_notify = lambda lid, ev: (stops.append(ev), orig_stop(lid, ev))[-1]
+        subs = [bc.register(_mk(f"r{i}")) for i in range(6)]
+        for s in subs:
+            bc.subscribe(s, "utxos-changed", {b"S1"})
+        assert starts == ["utxos-changed"]
+        for s in subs[:-1]:
+            bc.unsubscribe(s, "utxos-changed")
+        assert stops == []
+        bc.unsubscribe(subs[-1], "utxos-changed")
+        assert stops == ["utxos-changed"]
+    finally:
+        bc.close()
+
+
+def test_tune_gil_switch_interval_is_raise_only(monkeypatch):
+    """The serving-tier GIL tuning never shrinks an interval the embedder
+    already set, honors the env knob, and 0 disables it entirely."""
+    import sys as _sys
+
+    from kaspa_tpu.serving.broadcaster import tune_gil_switch_interval
+
+    prev = _sys.getswitchinterval()
+    try:
+        _sys.setswitchinterval(0.005)
+        monkeypatch.setenv("KASPA_TPU_GIL_SWITCH_MS", "25")
+        assert tune_gil_switch_interval() == pytest.approx(0.025)
+        # raise-only: a larger ambient interval is kept
+        _sys.setswitchinterval(0.1)
+        assert tune_gil_switch_interval() == pytest.approx(0.1)
+        # 0 (and garbage) disable the tuning
+        _sys.setswitchinterval(0.005)
+        monkeypatch.setenv("KASPA_TPU_GIL_SWITCH_MS", "0")
+        assert tune_gil_switch_interval() == pytest.approx(0.005)
+        monkeypatch.setenv("KASPA_TPU_GIL_SWITCH_MS", "bogus")
+        assert tune_gil_switch_interval() == pytest.approx(0.005)
+    finally:
+        _sys.setswitchinterval(prev)

@@ -415,25 +415,41 @@ def main(argv: list[str] | None = None) -> int:
         return sect
 
     def _sect_serving_load() -> dict:
-        # serving latency observatory (tools/serving_load.py): a ramped
-        # >=50k-virtual-subscriber run against the production Broadcaster
-        # (zipf address scopes, paced diff driver, shared sender pool,
-        # fd-budgeted wire cohort).  Gates: zero drops at nominal pace,
-        # bounded final-stage p99 accept->delivery lag, and the tracing-off
-        # overhead check (PR 7 convention: off >= 0.98x of the default
-        # instrumented leg).  Full evidence lands in SERVING_LOAD.json.
+        # serving latency observatory (tools/serving_load.py): first the
+        # sharded-vs-single fanout identity harness (delivered streams
+        # must be bit-identical at shards=4), then a ramped >=50k-virtual-
+        # subscriber run of BOTH legs — the single-fanout baseline curve
+        # and the sharded (--shards 4) curve, the latter gated against the
+        # committed PR 16 baseline (saturation >= 1.5x, paced p99 <= 0.5x,
+        # zero drops/disconnects) on top of the historical gates (drained,
+        # bounded p99, tracing-off overhead).  Evidence: SERVING_LOAD.json.
+        ident = _run(
+            [sys.executable, "-m", "kaspa_tpu.serving.check", "--shards", "4"],
+            300.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        ident["result"] = _last_json_line(ident)
         sect = _run(
             [
                 sys.executable, os.path.join(REPO_ROOT, "tools", "serving_load.py"),
                 "--subscribers", str(args.serving_load_subscribers),
+                "--shards", "4",
                 "--out", os.path.join(REPO_ROOT, "SERVING_LOAD.json"),
             ],
-            900.0,
+            1500.0,
             {"JAX_PLATFORMS": "cpu"},
         )
         result = _last_json_line(sect)
+        sect["identity"] = ident
         sect["result"] = result
-        sect["ok"] = sect["rc"] == 0 and bool(result and result.get("serving_load_ok"))
+        identity_ok = ident["rc"] == 0 and bool(
+            ident["result"] and ident["result"].get("serving_identity_ok")
+        )
+        sect["ok"] = (
+            identity_ok
+            and sect["rc"] == 0
+            and bool(result and result.get("serving_load_ok"))
+        )
         return sect
 
     def _sect_obs() -> dict:

@@ -169,6 +169,37 @@ def render_report(spans: list[dict], top: int = 10) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_by_shard(spans: list[dict], top: int = 10) -> str:
+    """Flame table grouped by the ``shard`` attr serving spans carry
+    (``serving.fanout`` / ``serving.deliver`` / ``wait.serving_queue``):
+    per-shard totals first, then each shard's stage breakdown.  Spans
+    without a shard tag (the single-fanout path, consensus stages) group
+    under ``unsharded``."""
+    if not spans:
+        return "no spans in input\n"
+    groups: dict[str, list[dict]] = {}
+    for s in spans:
+        shard = (s.get("attrs") or {}).get("shard")
+        key = f"shard {shard}" if shard is not None else "unsharded"
+        groups.setdefault(key, []).append(s)
+    lines = [f"{len(spans)} spans over {len(groups)} shard groups", ""]
+    lines.append(f"{'group':<14} {'spans':>7} {'total ms':>10} {'max ms':>10}")
+    lines.append("-" * 44)
+    order = sorted(
+        groups, key=lambda g: -sum(float(s.get("dur_us", 0.0)) for s in groups[g])
+    )
+    for g in order:
+        durs = [float(s.get("dur_us", 0.0)) for s in groups[g]]
+        lines.append(
+            f"{g:<14} {len(durs):>7} {_ms(sum(durs))} {_ms(max(durs))}"
+        )
+    for g in order:
+        lines.append("")
+        lines.append(f"== {g} ==")
+        lines.append(render_report(groups[g], top=top).rstrip())
+    return "\n".join(lines) + "\n"
+
+
 def render_critical_path(doc: dict, top: int = 10) -> str:
     """Per-block critical-path table + aggregate stage attribution for a
     flight dump (recomputed from the span trees, so dumps predating the
@@ -227,8 +258,21 @@ def main(argv=None) -> None:
         "--critical-path", action="store_true",
         help="per-block critical-path attribution table (flight dumps only)",
     )
+    ap.add_argument(
+        "--by-shard", action="store_true",
+        help="group the flame table by the serving tier's shard span tag "
+        "(untagged spans group under 'unsharded')",
+    )
     args = ap.parse_args(argv)
     doc = load_flight(args.log)
+    if args.by_shard:
+        spans = (
+            [s for t in doc.get("traces", []) for s in t["spans"]]
+            if doc is not None
+            else load_spans(args.log)
+        )
+        sys.stdout.write(render_by_shard(spans, top=args.top))
+        return
     if args.perfetto or args.critical_path:
         if doc is None:
             raise SystemExit(f"{args.log}: not a flight-recorder dump (need format=kaspa-flight)")
