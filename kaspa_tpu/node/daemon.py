@@ -475,9 +475,9 @@ class Daemon:
         self.utxoindex = self._make_utxoindex(self.consensus) if args.utxoindex else None
         from kaspa_tpu.p2p.address_manager import AddressManager, ConnectionManager
 
-        self.address_manager = AddressManager()
+        self.address_manager = AddressManager(seed=getattr(args, "seed", None))
         self.connection_manager = ConnectionManager(
-            self.node, self.address_manager, tick_seconds=5.0
+            self.node, self.address_manager, tick_seconds=5.0, seed=getattr(args, "seed", None)
         )
         self.node.address_manager = self.address_manager
         self.rpc = RpcCoreService(
@@ -1090,20 +1090,39 @@ class Daemon:
         return self._rpc_addr
 
     def connect_peer(self, address: str):
-        """Dial a peer over the wire and catch up from it (IBD)."""
+        """Dial a peer over the wire and catch up from it (IBD).
+
+        The dial retries with deterministic exponential backoff
+        (KASPA_TPU_CONNECT_RETRIES attempts, default 5): a --connect seed
+        peer that comes up moments after us — the normal case when a swarm
+        starts N nodes in one burst, and common enough on real restarts —
+        should not cost the only startup dial we'd otherwise make."""
+        import time as _time
+
         from kaspa_tpu.p2p.address_manager import NetAddress
         from kaspa_tpu.p2p.transport import connect_outbound, get_codec
 
-        peer = connect_outbound(self.node, address, codec=get_codec(self.p2p_wire))
+        attempts = max(1, int(os.environ.get("KASPA_TPU_CONNECT_RETRIES", "5")))
+        peer = None
+        for attempt in range(attempts):
+            try:
+                peer = connect_outbound(self.node, address, codec=get_codec(self.p2p_wire))
+                break
+            except (OSError, ConnectionError):
+                if attempt == attempts - 1:
+                    raise
+                # deterministic (no jitter): 0.25s, 0.5s, 1s, 2s, capped 4s
+                _time.sleep(min(0.25 * (2.0 ** attempt), 4.0))
         # register the RESOLVED address (getpeername) so the connection
         # manager's connected-set comparison matches and never re-dials
         na = getattr(peer, "peer_address", None)
         if na is not None:
             self.address_manager.add_address(na)
             self.address_manager.mark_connection_success(na)
-        with self.node.lock:
-            # graftlint: allow(blocking-under-lock) -- connect-path IBD kick runs the flow under the node lock; handlers assume it, and batch-verify waits are the IBD design
-            self.node.ibd_from(peer)
+        # connect-path IBD kick: ibd_from only sends the chain-info request
+        # (no consensus access), so it needs no lock — the response flows
+        # run under the reader thread's node-lock acquisition
+        self.node.ibd_from(peer)
         return peer
 
     def stop(self) -> None:

@@ -328,5 +328,45 @@ class Registry:
         for m in self._histograms.values():
             m.reset()
 
+    def scope(self, namespace: str) -> "ScopedRegistry":
+        """A namespaced view: every instrument created through it gets a
+        ``<namespace>_`` name prefix inside THIS registry.  This is how N
+        node instances in one process (the swarm drill) keep per-node
+        p2p counters without colliding on the shared metric names — each
+        node reports into its own namespace, one snapshot shows them all."""
+        return ScopedRegistry(self, namespace)
+
+
+class ScopedRegistry:
+    """Registry facade that prefixes metric names with a namespace.
+
+    Same creation surface as :class:`Registry` (counter/counter_family/
+    histogram/histogram_family), delegating storage to the parent so the
+    parent's ``snapshot()``/``reset()`` cover scoped instruments too.
+    """
+
+    __slots__ = ("_parent", "namespace")
+
+    def __init__(self, parent: Registry, namespace: str):
+        self._parent = parent
+        self.namespace = namespace
+
+    def _name(self, name: str) -> str:
+        return f"{self.namespace}_{name}"
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._parent.counter(self._name(name), help)
+
+    def counter_family(self, name: str, label: str, help: str = "") -> CounterFamily:
+        return self._parent.counter_family(self._name(name), label, help)
+
+    def histogram(self, name: str, buckets=DEFAULT_LATENCY_BUCKETS, help: str = "") -> Histogram:
+        return self._parent.histogram(self._name(name), buckets, help)
+
+    def histogram_family(
+        self, name: str, label: str, buckets=DEFAULT_LATENCY_BUCKETS, help: str = ""
+    ) -> HistogramFamily:
+        return self._parent.histogram_family(self._name(name), label, buckets, help)
+
 
 REGISTRY = Registry()

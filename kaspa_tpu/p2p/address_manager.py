@@ -48,14 +48,16 @@ class _Entry:
 class AddressManager:
     """Known-peer address book with failure-weighted sampling and bans."""
 
-    def __init__(self, now_ms=None):
+    def __init__(self, now_ms=None, seed: int | None = None):
         self._now_ms = now_ms or (lambda: int(time.time() * 1000))
         self._store: dict[NetAddress, _Entry] = {}
         self._banned: dict[str, int] = {}  # ip -> ban timestamp ms
         # our own publicly routable addresses: gossiped, never dialed
         self.local_addresses: set[NetAddress] = set()
         self._lock = ranked_lock("p2p.addressbook")
-        self._rng = random.Random(0xADD7)
+        # sampling jitter: folded with --seed so seeded runs (swarm drills)
+        # iterate the address book in a reproducible order
+        self._rng = random.Random(0xADD7 if seed is None else (0xADD7 ^ seed))
 
     def add_local_address(self, address: NetAddress) -> None:
         """Register one of OUR publicly routable addresses: gossiped to
@@ -183,7 +185,14 @@ class ConnectionManager:
     and retries `permanent` requests (--connect peers) with backoff.
     """
 
-    def __init__(self, node, amgr: AddressManager, outbound_target: int = 8, tick_seconds: float = 30.0):
+    def __init__(
+        self,
+        node,
+        amgr: AddressManager,
+        outbound_target: int = 8,
+        tick_seconds: float = 30.0,
+        seed: int | None = None,
+    ):
         self.node = node  # kaspa_tpu.p2p.node.Node with .peers
         self.amgr = amgr
         self.outbound_target = outbound_target
@@ -193,7 +202,9 @@ class ConnectionManager:
         # address must not be redialed (exponential in consecutive failures)
         self._next_dial: dict[NetAddress, float] = {}
         self._fail_counts: dict[NetAddress, int] = {}
-        self._rng = random.Random(0xBACC0FF)
+        # backoff jitter: folded with --seed so seeded runs draw the same
+        # delays (fleet decorrelation survives — each node folds its own id)
+        self._rng = random.Random(0xBACC0FF if seed is None else (0xBACC0FF ^ seed))
         self._clock = time.monotonic
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -236,10 +247,9 @@ class ConnectionManager:
             peer.peer_address = address
             self.amgr.mark_connection_success(address)
             # per-peer IBD flow kicks off on connect (flow registration);
-            # _on_chain_info no-ops when the peer has nothing we lack
-            with self.node.lock:
-                # graftlint: allow(blocking-under-lock) -- dial-path IBD kick mirrors the daemon connect path: flow handlers run under the node lock by design
-                self.node.ibd_from(peer)
+            # ibd_from only sends the chain-info request — no lock needed,
+            # and _on_chain_info no-ops when the peer has nothing we lack
+            self.node.ibd_from(peer)
             return True
         except (OSError, ConnectionError):
             self.amgr.mark_connection_failure(address)

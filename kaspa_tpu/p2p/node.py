@@ -165,6 +165,9 @@ class Peer:
     inbox: deque = field(default_factory=deque)
     known_blocks: set = field(default_factory=set)
     known_txs: set = field(default_factory=set)
+    # the remote node's identity nonce (learned from its version message);
+    # link-level fault planes key partitions on (our id, remote_id)
+    remote_id: int | None = None
 
     def send(self, msg_type: str, payload) -> None:
         """Enqueue on the remote peer's inbox and drain it (sync transport)."""
@@ -181,6 +184,7 @@ class Node:
         name: str = "node",
         mempool_seed: int | None = None,
         template_debounce: float = 0.0,
+        ident: int | None = None,
     ):
         import threading
 
@@ -203,14 +207,23 @@ class Node:
         # across peers so N connections advertising the same flood tx cost
         # one request, not N (flowcontext transactions_spread dedup role)
         self._tx_requested: dict[bytes, float] = {}
+        # requested-but-undelivered relay blocks: in a mesh of N peers the
+        # same INV arrives from every neighbor while the first copy is
+        # still in flight or mid-validation; without this ledger each
+        # arrival re-requests the block and one INV burst amplifies into
+        # O(peers) block transfers per node (the swarm drill's
+        # relay-amplification budget measures exactly this)
+        self._block_requested: dict[bytes, float] = {}
         # wired by the daemon; None in bare in-process tests (flows no-op)
         self.address_manager = None
         self.listen_port = 0  # advertised in the version handshake
         import secrets
 
         # per-node identity nonce (the reference's version message peer id):
-        # a version carrying OUR id is a self-connection and is dropped
-        self.id = secrets.randbits(64)
+        # a version carrying OUR id is a self-connection and is dropped.
+        # ``ident`` pins it (swarm drills: link-level partitions key on it
+        # and the event log must be byte-reproducible); default stays random
+        self.id = secrets.randbits(64) if ident is None else int(ident)
         # advertised protocol tier; tests cap this to simulate old peers
         self.protocol_version = PROTOCOL_VERSION
         self.cmgr.on_swap(self._on_consensus_swap)
@@ -264,6 +277,21 @@ class Node:
         if cached is not None:
             self._ibd_pipeline = None
             cached[1].shutdown()
+
+    def shutdown(self) -> None:
+        """Tear down one node instance cleanly: close every peer link and
+        stop the worker pools.  Multi-instance hosts (swarm drills spin up
+        N nodes in one process) call this per node so the fleet's threads
+        and sockets don't outlive the run."""
+        for peer in list(self.peers):
+            if hasattr(peer, "close"):
+                try:
+                    peer.close()
+                except Exception:
+                    pass
+        self.peers.clear()
+        self._drop_ibd_pipeline()
+        self.pipeline.shutdown()
 
     def prune_caches(self, now: float | None = None) -> None:
         """Drop serve-side IBD snapshots that outlived their usefulness.
@@ -418,6 +446,9 @@ class Node:
                     f"protocol v10 required near Toccata activation (peer advertises v{peer_pv})", points=0
                 )
             peer.protocol_version = min(self.protocol_version, peer_pv)
+            if isinstance(payload, dict) and payload.get("id"):
+                # link identity for the partition fault plane (swarm drills)
+                peer.remote_id = payload["id"]
             if isinstance(payload, dict) and payload.get("id") and payload["id"] == self.id:
                 # gossip taught us our own address and we dialed ourselves;
                 # scrub the LISTEN address (what gossip stored), not the
@@ -491,8 +522,24 @@ class Node:
         elif msg_type == "pong":
             pass
         elif msg_type == MSG_INV_BLOCK:
-            # blockrelay/flow.rs: request unknown relay blocks
-            if not self.consensus.storage.statuses.is_valid(payload) and payload not in self.orphan_blocks:
+            # blockrelay/flow.rs: request unknown relay blocks — but only
+            # once per block fleet-wide: in a mesh every neighbor relays
+            # the same INV while the first copy is still in flight, and
+            # re-requesting from each would amplify one burst into
+            # O(peers) transfers per node (see _block_requested)
+            now = _monotonic()
+            if self._block_requested:
+                self._block_requested = {
+                    h: ts for h, ts in self._block_requested.items()
+                    if now - ts < TX_REQUEST_TTL_SECONDS
+                }
+            if (
+                not self.consensus.storage.statuses.is_valid(payload)
+                and payload not in self.orphan_blocks
+                and payload not in self._block_requested
+                and not self.pipeline.deps.is_pending(payload)
+            ):
+                self._block_requested[payload] = now
                 peer.send(MSG_REQUEST_BLOCK, [payload])
         elif msg_type == MSG_REQUEST_BLOCK:
             for h in payload:
@@ -813,6 +860,7 @@ class Node:
         # block time includes the p2p intake hop
         ctx = flight.begin(block.hash) if flight.enabled() else None
         with trace.span("p2p.block_receive", parent=ctx):
+            self._block_requested.pop(block.hash, None)  # delivered: allow re-request if invalid
             peer.known_blocks.add(block.hash)  # sender has it: don't echo the inv back
             parents = block.header.direct_parents()
             # a parent already in flight inside the pipeline counts as present:

@@ -32,21 +32,45 @@ from kaspa_tpu.observability.core import REGISTRY
 from kaspa_tpu.p2p import wire
 from kaspa_tpu.p2p.node import MIN_PROTOCOL_VERSION, MSG_VERSION, Node, ProtocolError
 from kaspa_tpu.resilience import faults as fault_mod
-from kaspa_tpu.resilience.faults import FAULTS
+from kaspa_tpu.resilience.faults import FAULTS, FaultInjected
 
-# codec cost only (socket IO excluded): encode is timed around
-# codec.encode in send(), decode around codec.decode in the reader loop —
-# blocking recv time would otherwise swamp the histogram.  Both wire
-# implementations (custom frames and protobuf/gRPC) feed the SAME
-# instruments so dashboards compare codecs without relabeling.
-_ENC_TIME = REGISTRY.histogram("p2p_frame_encode_seconds", help="wire frame encode time (codec only)")
-_DEC_TIME = REGISTRY.histogram("p2p_frame_decode_seconds", help="wire payload decode time (codec only)")
-_FRAMES_TX = REGISTRY.counter("p2p_frames_tx", help="frames enqueued for send")
-_FRAMES_RX = REGISTRY.counter("p2p_frames_rx", help="frames received and decoded")
-_BYTES_TX = REGISTRY.counter("p2p_bytes_tx", help="frame bytes enqueued for send")
-_BYTES_RX = REGISTRY.counter("p2p_bytes_rx", help="frame bytes received (incl. headers)")
-_MSGS_TX = REGISTRY.counter_family("p2p_msgs_tx", "type", help="messages sent by flow message type")
-_MSGS_RX = REGISTRY.counter_family("p2p_msgs_rx", "type", help="messages received by flow message type")
+
+class WireMetrics:
+    """The transport's instrument set, bound to one registry (or scope).
+
+    Codec cost only (socket IO excluded): encode is timed around
+    codec.encode in send(), decode around codec.decode in the reader loop —
+    blocking recv time would otherwise swamp the histogram.  Both wire
+    implementations (custom frames and protobuf/gRPC) feed the SAME
+    instruments so dashboards compare codecs without relabeling.
+
+    One process-global default instance serves the daemon (one node per
+    process).  A multi-node host — the swarm drill — hangs a scoped
+    instance on ``node.wire_metrics`` so each node's relay accounting
+    (``p2p_msgs_rx`` per message type, the amplification budget's input)
+    lands in its own namespace instead of one shared counter.
+    """
+
+    __slots__ = ("enc_time", "dec_time", "frames_tx", "frames_rx", "bytes_tx", "bytes_rx", "msgs_tx", "msgs_rx")
+
+    def __init__(self, registry=REGISTRY):
+        self.enc_time = registry.histogram("p2p_frame_encode_seconds", help="wire frame encode time (codec only)")
+        self.dec_time = registry.histogram("p2p_frame_decode_seconds", help="wire payload decode time (codec only)")
+        self.frames_tx = registry.counter("p2p_frames_tx", help="frames enqueued for send")
+        self.frames_rx = registry.counter("p2p_frames_rx", help="frames received and decoded")
+        self.bytes_tx = registry.counter("p2p_bytes_tx", help="frame bytes enqueued for send")
+        self.bytes_rx = registry.counter("p2p_bytes_rx", help="frame bytes received (incl. headers)")
+        self.msgs_tx = registry.counter_family("p2p_msgs_tx", "type", help="messages sent by flow message type")
+        self.msgs_rx = registry.counter_family("p2p_msgs_rx", "type", help="messages received by flow message type")
+
+
+_DEFAULT_METRICS = WireMetrics(REGISTRY)
+
+
+def wire_metrics_for(node) -> WireMetrics:
+    """The node's own instrument set if it carries one, else the global."""
+    m = getattr(node, "wire_metrics", None)
+    return m if m is not None else _DEFAULT_METRICS
 
 
 class CustomWireCodec:
@@ -117,6 +141,10 @@ class WirePeer:
         self.sock = sock
         self.outbound = outbound
         self.codec = codec if codec is not None else CustomWireCodec()
+        self.metrics = wire_metrics_for(node)
+        # the remote's version-handshake identity nonce (node._handle sets
+        # it on VERSION receipt); the LINKS partition plane keys on it
+        self.remote_id = None
         try:
             ip, port = sock.getpeername()[:2]
             from kaspa_tpu.p2p.address_manager import NetAddress
@@ -144,9 +172,16 @@ class WirePeer:
     def send(self, msg_type: str, payload) -> None:
         if not self.alive:
             return
+        links = fault_mod.LINKS
+        if links.active and links.drop(getattr(self.node, "id", None), self.remote_id):
+            # severed link: the frame is black-holed before it is even
+            # encoded — the sender's relay state (known_blocks dedup)
+            # still believes it left, exactly like real packet loss
+            FAULTS.fire("p2p.partition")
+            return
         t0 = perf_counter_ns()
         frame = self.codec.encode(msg_type, payload)
-        _ENC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
+        self.metrics.enc_time.observe((perf_counter_ns() - t0) * 1e-9)
         act = FAULTS.fire("p2p.send")
         if act is not None:
             if act.mode == "disconnect":
@@ -155,9 +190,9 @@ class WirePeer:
             frame = fault_mod.mangle_frame(frame, act)
             if frame is None:  # drop: the frame silently never leaves
                 return
-        _FRAMES_TX.inc()
-        _BYTES_TX.inc(len(frame))
-        _MSGS_TX.inc(msg_type)
+        self.metrics.frames_tx.inc()
+        self.metrics.bytes_tx.inc(len(frame))
+        self.metrics.msgs_tx.inc(msg_type)
         try:
             self._outq.put_nowait(frame)
         except queue.Full:
@@ -233,10 +268,10 @@ class WirePeer:
                     if self._score(self, "malformed_frame", 40):
                         raise ConnectionError("peer banned for malformed frames") from None
                     continue
-                _DEC_TIME.observe((perf_counter_ns() - t0) * 1e-9)
-                _FRAMES_RX.inc()
-                _BYTES_RX.inc(nbytes)
-                _MSGS_RX.inc(msg_type)
+                self.metrics.dec_time.observe((perf_counter_ns() - t0) * 1e-9)
+                self.metrics.frames_rx.inc()
+                self.metrics.bytes_rx.inc(nbytes)
+                self.metrics.msgs_rx.inc(msg_type)
                 with self.node.lock:
                     # graftlint: allow(blocking-under-lock) -- every p2p message is handled under the node lock (the node's serialization point); IBD batch inserts legitimately wait on verify futures there
                     self.node._handle(self, msg_type, payload)
@@ -355,6 +390,12 @@ def connect_outbound(node: Node, address: str, timeout: float = 10.0, codec=None
     wire selection is deployment configuration, not negotiated in-band —
     the version handshake only negotiates the flow tier."""
     host, port = address.rsplit(":", 1)
+    try:
+        # injected dial failure (mode "error"): presents as the failure the
+        # caller already handles so the connect-retry path absorbs it
+        FAULTS.fire("p2p.link_drop")
+    except FaultInjected as e:
+        raise ConnectionError(f"injected link drop dialing {address}") from e
     sock = socket.create_connection((host, int(port)), timeout=timeout)
     # the reader loop owns the socket deadline from here (handshake_timeout,
     # then read_timeout once handshaken)

@@ -11,6 +11,12 @@ Fault points are named strings compiled into the hot layers:
     vm.fallback.exec     one deferred VM fallback job (txscript/batch.py)
     p2p.send             outgoing frame (p2p/transport.py)
     p2p.recv             incoming frame read (p2p/transport.py)
+    p2p.partition        frame black-holed across a severed link (send
+                         path); the LINKS plane below is the programmatic
+                         control surface the swarm scheduler drives
+    p2p.link_drop        outbound dial (p2p/transport.py connect_outbound);
+                         mode "error" fails the dial before the handshake —
+                         the daemon's bounded connect retry absorbs it
     storage.commit       write-batch commit (storage/kv.py, both engines)
     storage.flush        python-engine log append (storage/kv.py)
     fabric.send          outgoing verify-fabric request (fabric/client.py);
@@ -69,6 +75,7 @@ import time
 from contextlib import contextmanager
 
 from kaspa_tpu.observability.core import REGISTRY
+from kaspa_tpu.utils.sync import ranked_lock
 
 # The single source of truth for compiled-in fault points.  graftlint's
 # registry-hygiene checker cross-checks this catalog against every
@@ -82,6 +89,8 @@ FAULT_POINTS: dict[str, str] = {
     "vm.fallback.exec": "one deferred VM fallback job (txscript/batch.py)",
     "p2p.send": "outgoing frame (p2p/transport.py)",
     "p2p.recv": "incoming frame read (p2p/transport.py)",
+    "p2p.partition": "frame black-holed across a severed link (p2p/transport.py send, LINKS plane)",
+    "p2p.link_drop": "outbound dial severed before the handshake (p2p/transport.py connect_outbound)",
     "storage.commit": "write-batch commit (storage/kv.py, both engines)",
     "storage.flush": "python-engine log append (storage/kv.py)",
     "fabric.send": "outgoing verify-fabric request (fabric/client.py)",
@@ -259,6 +268,80 @@ class FaultRegistry:
 
 FAULTS = FaultRegistry()
 REGISTRY.register_collector("faults", FAULTS.snapshot)
+
+
+class LinkPlane:
+    """Link-level network partitions: black-hole frames by (src, dst) id.
+
+    The swarm drill's fault plane.  ``partition(groups)`` severs every
+    ordered pair of node ids that straddles a group boundary; a severed
+    link silently drops frames at the sender (packet loss, not a TCP
+    reset — the sender's relay state still believes the frame left, which
+    is exactly the lie a real partition tells).  ``heal()`` restores
+    every link but keeps the per-link drop ledger for the report.
+
+    Near-zero cost while inactive (one attribute load and a branch per
+    frame, the same discipline as the FAULTS registry); the ``drop``
+    check itself is a frozenset lookup.  Endpoints are the nodes' version
+    handshake identity nonces (``Node.id``) — the only peer identity both
+    wire directions of a connection agree on.
+    """
+
+    def __init__(self):
+        # leaf lock: guards the ledger only, taken under node(5) on sends
+        self._lock = ranked_lock("p2p.links")
+        self._severed: frozenset = frozenset()
+        self._dropped: dict[tuple, int] = {}
+        self.active = False
+
+    def partition(self, groups) -> int:
+        """Sever every (src, dst) pair across the group boundary; returns
+        the number of severed ordered links.  Ids absent from ``groups``
+        keep full connectivity."""
+        severed = set()
+        for i, ga in enumerate(groups):
+            for gb in groups[i + 1 :]:
+                for a in ga:
+                    for b in gb:
+                        severed.add((a, b))
+                        severed.add((b, a))
+        with self._lock:
+            self._severed = frozenset(severed)
+            self.active = bool(severed)
+        return len(severed)
+
+    def heal(self) -> None:
+        with self._lock:
+            self._severed = frozenset()
+            self.active = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._severed = frozenset()
+            self._dropped = {}
+            self.active = False
+
+    def drop(self, src, dst) -> bool:
+        """True (and one ledger tick) iff the ``src -> dst`` link is severed.
+        Unlabeled endpoints (``None``) never match — a peer that has not
+        completed its version handshake has no identity to partition on."""
+        if src is None or dst is None or (src, dst) not in self._severed:
+            return False
+        with self._lock:
+            self._dropped[(src, dst)] = self._dropped.get((src, dst), 0) + 1
+        return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "active": self.active,
+                "severed_links": len(self._severed),
+                "dropped_frames": sum(self._dropped.values()),
+                "dropped_by_link": {f"{s}->{d}": n for (s, d), n in sorted(self._dropped.items())},
+            }
+
+
+LINKS = LinkPlane()
 
 
 def mangle_frame(frame: bytes, act: FaultAction) -> bytes | None:

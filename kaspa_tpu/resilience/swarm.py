@@ -1,0 +1,509 @@
+"""N-node deterministic swarm drills over the real P2P wire.
+
+The many-node counterpart of the single-node sustain harnesses: N full
+``p2p.node.Node`` instances live in one process, each with its own
+consensus, ingest tier and ``P2PServer``, wired into a full mesh over
+loopback sockets — the same machinery the two-daemon proto tests use
+pairwise.  A seeded, declarative *scenario schedule* then drives the
+fleet through the failure shapes a single node can never exercise:
+
+- ``partition`` / ``heal`` — the LINKS fault plane (resilience/faults.py)
+  black-holes frames by (src, dst) identity so each side extends its own
+  DAG; heal triggers an explicit pairwise locator pull, because a severed
+  link *poisons* relay state (broadcast marks ``peer.known_blocks`` even
+  for frames that never left — exactly the lie a real partition tells);
+- deep attacker reorgs — a minority side mines a heavier chain in
+  isolation and must win fleet-wide at heal;
+- ``join`` — a node IBDs into the fleet 100+ blocks late over the
+  locator/antipast flow;
+- relay-storm accounting — every mined block's INV fans across the mesh;
+  per-node ``p2p_msgs_rx{block}`` (namespaced per node through
+  ``Registry.scope``) is gated against an O(N x blocks) budget, the
+  invariant the ``_block_requested`` in-flight ledger exists to hold.
+
+Determinism: the scheduler is strictly sequential — one block is mined,
+then the miner's connected component converges on it before the next —
+so parent sets, timestamps (a virtual tick), coinbase payloads and thus
+every block hash are functions of (n, seed, scenario) alone.  The
+``deterministic`` section of SWARM.json (event log, per-node
+fingerprints, fault-free comparison) is byte-identical across runs;
+message counts and wall-clock facts are quarantined under ``fleet`` /
+``metrics`` / ``run_meta`` per the SUSTAIN.json convention.
+
+Acceptance gates (``sim --swarm N`` exits non-zero otherwise): all nodes
+bit-identical in sink + utxo_commitment, the end state matching a
+fault-free in-order replay of the same blocks, zero ingest tickets lost
+fleet-wide, and block-relay amplification within budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+
+from kaspa_tpu.consensus.consensus import Consensus
+from kaspa_tpu.consensus.params import simnet_params
+from kaspa_tpu.observability.core import Registry
+from kaspa_tpu.p2p.node import MSG_BLOCK, Node
+from kaspa_tpu.p2p.transport import P2PServer, WireMetrics, connect_outbound
+from kaspa_tpu.resilience.faults import LINKS
+from kaspa_tpu.resilience.sustain import _fingerprints, _insert, run_meta
+from kaspa_tpu.sim.simulator import Miner
+
+
+class SwarmError(RuntimeError):
+    """A drill invariant failed mid-run (a barrier timed out, a step was
+    malformed).  Distinct from gate failures, which land in the report."""
+
+
+def default_scenario(n: int, blocks: int = 24) -> list[dict]:
+    """The stock drill: base chain -> tx gossip -> minority/majority
+    partition -> heavier attacker chain -> heal + deep reorg -> a relay
+    phase that merges every tip -> late join at depth.
+
+    Node 0 is the attacker (minority side), the last node the late
+    joiner (fleets of 3+; a 2-node fleet skips the join).  The relay
+    phase runs BEFORE the join on purpose: antipast IBD serves the
+    donor sink's *past* only, so a joiner syncing right after the heal
+    would miss the losing side's blocks (they sit in the winning sink's
+    anticone) — the first post-heal block merges all tips and closes
+    that gap, which is exactly what a live network's next template does.
+    """
+    if n < 2:
+        raise SwarmError("swarm needs at least 2 nodes")
+    joiner = n - 1 if n >= 3 else None
+    active = list(range(n - 1)) if joiner is not None else list(range(n))
+    honest = active[1:] if len(active) > 1 else active
+    h = max(4, blocks // 6)
+    steps = [
+        {"op": "mine", "nodes": active, "blocks": blocks},
+        {"op": "txs", "node": active[-1], "count": 4},
+        {"op": "partition", "groups": [[0], honest]},
+        {"op": "mine", "nodes": honest, "blocks": h},
+        {"op": "mine", "nodes": [0], "blocks": 2 * h + 2},
+        {"op": "heal"},
+        {"op": "converge"},
+        {"op": "mine", "nodes": active, "blocks": max(6, blocks // 4)},
+        {"op": "converge"},
+    ]
+    if joiner is not None:
+        steps += [{"op": "join", "node": joiner}, {"op": "converge"}]
+    return steps
+
+
+def parse_scenario(spec) -> list[dict]:
+    """Scenario from CLI input: a step list, ``{"steps": [...]}``, inline
+    JSON text, or ``@/path/to/scenario.json``."""
+    if isinstance(spec, str):
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                spec = json.load(f)
+        else:
+            spec = json.loads(spec)
+    if isinstance(spec, dict):
+        spec = spec.get("steps", [])
+    if not isinstance(spec, list) or not all(isinstance(s, dict) and "op" in s for s in spec):
+        raise SwarmError("scenario must be a list of {'op': ...} steps (or {'steps': [...]})")
+    return spec
+
+
+class SwarmNode:
+    """One fleet member: Node + consensus + listener + miner identity.
+
+    The identity nonce is pinned to ``index + 1`` (the version handshake
+    advertises it, the LINKS plane partitions on it) and the wire metrics
+    are scoped to ``node<i>_`` inside the run's private registry, so N
+    instances never collide on the process-global instrument names."""
+
+    def __init__(self, index: int, params, seed: int, registry: Registry):
+        self.index = index
+        self.ident = index + 1
+        self.node = Node(Consensus(params), name=f"swarm{index}", mempool_seed=seed, ident=self.ident)
+        self.node.wire_metrics = WireMetrics(registry.scope(f"node{index}"))
+        self.miner = Miner(index, random.Random((seed << 8) ^ index))
+        self.server = P2PServer(self.node, port=0)
+        self.joined = False
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.node.shutdown()
+
+
+class SwarmRun:
+    """Scenario interpreter over a live fleet; produces the SWARM report."""
+
+    def __init__(self, n: int, seed: int = 7, scenario: list[dict] | None = None,
+                 blocks: int = 24, bps: int = 2):
+        if n < 2:
+            raise SwarmError("swarm needs at least 2 nodes")
+        self.n = n
+        self.seed = int(seed)
+        self.params = simnet_params(bps=bps)
+        self.scenario = scenario if scenario is not None else default_scenario(n, blocks)
+        self.registry = Registry()  # private: two same-seed runs both start at zero
+        self.converge_timeout = float(os.environ.get("KASPA_TPU_SWARM_CONVERGE_TIMEOUT", "60"))
+        self.amp_budget = float(os.environ.get("KASPA_TPU_SWARM_AMP_BUDGET", "1.5"))
+        self.nodes: list[SwarmNode] = []
+        self.mined: list = []  # Block objects in global mined order
+        self.events: list[dict] = []
+        self.groups: list[list[int]] | None = None  # None = full connectivity
+        self.tick = 0  # virtual clock: block timestamps are 10_000 + 600*tick
+        self.converge_walls: list[float] = []
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _joined(self) -> list[int]:
+        return [sn.index for sn in self.nodes if sn.joined]
+
+    def _component(self, idx: int) -> list[int]:
+        """Joined nodes reachable from ``idx`` under the current partition
+        (an index absent from every group keeps full mesh connectivity)."""
+        joined = self._joined()
+        if self.groups is None:
+            return joined
+        for g in self.groups:
+            if idx in g:
+                return [i for i in g if i in joined]
+        return joined
+
+    def _wait(self, predicate, what: str, timeout: float | None = None) -> float:
+        timeout = self.converge_timeout if timeout is None else timeout
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return time.monotonic() - t0
+            time.sleep(0.01)
+        raise SwarmError(f"timed out after {timeout}s waiting for {what}")
+
+    def _wait_valid(self, sn: SwarmNode, h: bytes) -> None:
+        def have() -> bool:
+            with sn.node.lock:
+                return bool(sn.node.consensus.storage.statuses.is_valid(h))
+
+        self._wait(have, f"node{sn.index} to validate block {h.hex()[:12]}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _start_fleet(self) -> None:
+        late = {s["node"] for s in self.scenario if s.get("op") == "join"}
+        for i in range(self.n):
+            sn = SwarmNode(i, self.params, self.seed, self.registry)
+            sn.joined = i not in late
+            sn.start()
+            self.nodes.append(sn)
+        joined = self._joined()
+        # full mesh among the initially-joined: one TCP connection per
+        # unordered pair (relay is bidirectional over it), dialer = higher
+        # index so the wiring order is reproducible
+        for j in joined:
+            for i in joined:
+                if i < j:
+                    connect_outbound(self.nodes[j].node, self.nodes[i].server.address)
+        expected = len(joined) - 1
+        for idx in joined:
+            node = self.nodes[idx].node
+            self._wait(
+                lambda node=node: len(node.peers) >= expected
+                and all(p.handshaken for p in list(node.peers)),
+                f"node{idx} mesh handshakes",
+            )
+        self.events.append({"op": "start", "nodes": self.n, "joined": joined})
+
+    def _teardown(self) -> None:
+        LINKS.reset()
+        for sn in self.nodes:
+            try:
+                sn.stop()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+
+    # -- scenario steps ----------------------------------------------------
+
+    def _step_mine(self, step: dict) -> dict:
+        nodes = list(step.get("nodes") or [step["node"]])
+        count = int(step.get("blocks", 1))
+        hashes = []
+        for k in range(count):
+            sn = self.nodes[nodes[k % len(nodes)]]
+            ts = 10_000 + 600 * self.tick
+            self.tick += 1
+            with sn.node.lock:
+                # graftlint: allow(blocking-under-lock) -- the node lock is the serialization point for consensus mutation (every p2p handler runs under it); template build legitimately waits on verify dispatch there
+                block = sn.node.consensus.build_block_template(sn.miner.miner_data, [], timestamp=ts)
+                # graftlint: allow(blocking-under-lock) -- same serialization point: submit inserts + unorphans synchronously, the sequential scheduler depends on it
+                sn.node.submit_block(block)
+            self.mined.append(block)
+            hashes.append(block.hash)
+            # component barrier: every reachable node validates this block
+            # before the next template is built, so parent sets (and thus
+            # hashes) are functions of the schedule alone
+            for j in self._component(sn.index):
+                if j != sn.index:
+                    self._wait_valid(self.nodes[j], block.hash)
+        return {"nodes": nodes, "blocks": [h.hex() for h in hashes]}
+
+    def _step_partition(self, step: dict) -> dict:
+        groups = [list(g) for g in step["groups"]]
+        severed = LINKS.partition([[self.nodes[i].ident for i in g] for g in groups])
+        self.groups = groups
+        return {"groups": groups, "severed": severed}
+
+    def _step_heal(self, _step: dict) -> dict:
+        LINKS.heal()
+        self.groups = None
+        # explicit pairwise locator pull: the blackhole poisoned relay
+        # state (broadcast_block marked known_blocks for dropped INVs), so
+        # gossip alone never re-offers the missed blocks — each node asks
+        # every peer to serve the antipast above their common chain block,
+        # the same path a real IBD catch-up takes
+        from kaspa_tpu.consensus.processes.sync import SyncManager
+        from kaspa_tpu.p2p.node import MSG_IBD_BLOCK_LOCATOR
+
+        for idx in self._joined():
+            node = self.nodes[idx].node
+            with node.lock:  # consensus read only; the sends happen unlocked
+                sm = SyncManager(node.consensus)
+                locator = sm.create_block_locator_from_pruning_point(
+                    node.consensus.sink(), node.consensus.pruning_processor.pruning_point
+                )
+                peers = list(node.peers)
+            for peer in peers:
+                peer.send(MSG_IBD_BLOCK_LOCATOR, locator)
+        return {}
+
+    def _step_converge(self, step: dict) -> dict:
+        joined = self._joined()
+
+        def sinks() -> list[bytes]:
+            out = []
+            for i in joined:
+                node = self.nodes[i].node
+                with node.lock:
+                    out.append(node.consensus.sink())
+            return out
+
+        wall = self._wait(
+            lambda: len(set(sinks())) == 1,
+            f"sink convergence across nodes {joined}",
+            timeout=step.get("timeout"),
+        )
+        self.converge_walls.append(round(wall, 3))
+        return {"sink": sinks()[0].hex(), "nodes": joined}
+
+    def _step_join(self, step: dict) -> dict:
+        idx = int(step["node"])
+        sn = self.nodes[idx]
+        if sn.joined:
+            raise SwarmError(f"node{idx} is already joined")
+        depth = len(self.mined)
+        sn.joined = True
+        for other in self._joined():
+            if other == idx:
+                continue
+            peer = connect_outbound(sn.node, self.nodes[other].server.address)
+            # ibd_from only sends the chain-info request (no consensus
+            # access); the response flows run under the reader's node lock
+            sn.node.ibd_from(peer)
+        return {"node": idx, "depth": depth}
+
+    def _step_txs(self, step: dict) -> dict:
+        idx = int(step.get("node", 0))
+        count = int(step.get("count", 4))
+        sn = self.nodes[idx]
+        txs = self._build_spends(sn, count)
+        if not txs:
+            raise SwarmError("no mature UTXOs for the txs step (mine past coinbase maturity first)")
+        for tx in txs:
+            sn.node.submit_transaction(tx)  # ingest front door; relays via INV
+        txids = [tx.id() for tx in txs]
+        comp = self._component(idx)
+        for j in comp:
+            node = self.nodes[j].node
+
+            def pooled(node=node) -> bool:
+                with node.lock:
+                    pool = node.mining.mempool
+                    return all(pool.has(t) or t in pool.accepted for t in txids)
+
+            self._wait(pooled, f"node{j} mempool to hold the gossiped txs")
+        return {"node": idx, "txids": [t.hex() for t in txids], "gossiped_to": comp}
+
+    def _build_spends(self, sn: SwarmNode, count: int) -> list:
+        """Deterministic clean P2PK spends of mature miner coinbase UTXOs,
+        paying back to the submitting node's miner (txflood's spend idiom;
+        txids are signature-independent, so the event log stays stable)."""
+        from kaspa_tpu.consensus import hashing as chash
+        from kaspa_tpu.consensus.model import Transaction, TransactionInput, TransactionOutput
+        from kaspa_tpu.consensus.model.tx import SUBNETWORK_ID_NATIVE, ComputeCommit
+        from kaspa_tpu.crypto import eclib
+        from kaspa_tpu.txscript import standard
+
+        rng = random.Random((self.seed << 16) ^ 0x7A5)
+        key_by_spk = {n.miner.spk: n.miner.seckey for n in self.nodes}
+        consensus = sn.node.consensus
+        with sn.node.lock:
+            view = consensus.get_virtual_utxo_view()
+            pov = consensus.get_virtual_daa_score()
+            maturity = consensus.params.coinbase_maturity
+            items = list(view.diff.add.items())
+            under = view.base
+            while hasattr(under, "base"):
+                items += list(under.diff.add.items())
+                under = under.base
+            items += list(under.items())
+            removed = set(view.diff.remove.keys())
+            cands, seen = [], set()
+            for outpoint, entry in items:
+                if outpoint in seen or outpoint in removed or view.get(outpoint) is None:
+                    continue
+                seen.add(outpoint)
+                if entry.is_coinbase and entry.block_daa_score + maturity > pov:
+                    continue
+                seckey = key_by_spk.get(entry.script_public_key)
+                if seckey is not None:
+                    cands.append((outpoint, entry, seckey))
+        cands.sort(key=lambda c: (c[0].transaction_id, c[0].index))
+        mass_calc = consensus.transaction_validator.mass_calculator
+        txs = []
+        for outpoint, entry, seckey in cands[:count]:
+            half = entry.amount // 2
+            if half <= 0:
+                continue
+            outputs = [TransactionOutput(half, sn.miner.spk), TransactionOutput(entry.amount - half, sn.miner.spk)]
+            inp = TransactionInput(outpoint, b"", 0, ComputeCommit.sigops(1))
+            tx = Transaction(0, [inp], outputs, 0, SUBNETWORK_ID_NATIVE, 0, b"")
+            tx.storage_mass = mass_calc.calc_contextual_masses(tx, [entry])
+            reused = chash.SigHashReusedValues()
+            msg = chash.calc_schnorr_signature_hash(tx, [entry], 0, chash.SIG_HASH_ALL, reused)
+            sig = eclib.schnorr_sign(msg, seckey, rng.randbytes(32))
+            tx.inputs[0].signature_script = standard.schnorr_signature_script(sig, chash.SIG_HASH_ALL)
+            tx._id_cache = None
+            txs.append(tx)
+        return txs
+
+    _STEPS = {
+        "mine": _step_mine,
+        "partition": _step_partition,
+        "heal": _step_heal,
+        "converge": _step_converge,
+        "join": _step_join,
+        "txs": _step_txs,
+    }
+
+    def _apply(self, i: int, step: dict) -> None:
+        op = step.get("op")
+        fn = self._STEPS.get(op)
+        if fn is None:
+            raise SwarmError(f"unknown scenario op {op!r} at step {i}")
+        facts = fn(self, step)
+        self.events.append({"step": i, "op": op, **facts})
+
+    # -- the run -----------------------------------------------------------
+
+    def run(self, out: str | None = None) -> dict:
+        LINKS.reset()
+        t_run = time.perf_counter()
+        try:
+            self._start_fleet()
+            for i, step in enumerate(self.scenario):
+                self._apply(i, step)
+            report = self._report(time.perf_counter() - t_run)
+        finally:
+            self._teardown()
+        if out:
+            with open(out, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True)
+                f.write("\n")
+        return report
+
+    def _report(self, wall: float) -> dict:
+        fps = {}
+        for sn in self.nodes:
+            with sn.node.lock:
+                fps[f"node{sn.index}"] = _fingerprints(sn.node.consensus)
+        converged = len({json.dumps(v, sort_keys=True) for v in fps.values()}) == 1
+
+        # fault-free comparison: the same blocks, in mined order, into one
+        # fresh consensus — partitions, reorg relays and IBD must have been
+        # pure transport noise
+        baseline = Consensus(self.params)
+        for b in self.mined:
+            _insert(baseline, b)
+        base_fp = _fingerprints(baseline)
+        matches = converged and all(v == base_fp for v in fps.values())
+
+        tickets = {}
+        for sn in self.nodes:
+            s = sn.node.ingest.stats()
+            tickets[f"node{sn.index}"] = {k: s[k] for k in ("submitted", "resolved", "lost")}
+        lost = sum(t["lost"] for t in tickets.values())
+
+        counters = self.registry.snapshot()["counters"]
+        block_rx = {
+            f"node{sn.index}": counters.get(f"node{sn.index}_p2p_msgs_rx", {}).get(MSG_BLOCK, 0)
+            for sn in self.nodes
+        }
+        total_rx = sum(block_rx.values())
+        budget = self.amp_budget * self.n * max(len(self.mined), 1)
+        amp = total_rx / (self.n * max(len(self.mined), 1))
+
+        report = {
+            "config": {
+                "n": self.n,
+                "seed": self.seed,
+                "params": self.params.name,
+                "amp_budget": self.amp_budget,
+                "scenario": self.scenario,
+            },
+            "deterministic": {
+                "blocks": len(self.mined),
+                "events": self.events,
+                "fingerprints": fps,
+                "converged": converged,
+                "fault_free_fingerprints": base_fp,
+                "matches_fault_free": matches,
+            },
+            "fleet": {
+                "tickets": tickets,
+                "lost_tickets": lost,
+                "relay": {
+                    "block_rx_by_node": block_rx,
+                    "total_block_rx": total_rx,
+                    "budget": budget,
+                    "amplification": round(amp, 3),
+                    "amp_ok": total_rx <= budget,
+                },
+                "links": LINKS.snapshot(),
+            },
+            "metrics": {
+                "wall_seconds": round(wall, 3),
+                "converge_seconds": self.converge_walls,
+            },
+            "run_meta": run_meta(),
+        }
+        return report
+
+
+def run_swarm(n: int, seed: int = 7, scenario=None, blocks: int = 24, bps: int = 2,
+              out: str | None = None) -> dict:
+    """Build, run and (optionally) persist one swarm drill."""
+    if scenario is not None:
+        scenario = parse_scenario(scenario)
+    return SwarmRun(n, seed=seed, scenario=scenario, blocks=blocks, bps=bps).run(out=out)
+
+
+def gates(report: dict) -> dict:
+    """The drill's acceptance bits, in one place for the CLI and tests."""
+    det, fleet = report["deterministic"], report["fleet"]
+    return {
+        "converged": det["converged"],
+        "matches_fault_free": det["matches_fault_free"],
+        "lost_tickets_ok": fleet["lost_tickets"] == 0,
+        "amp_ok": fleet["relay"]["amp_ok"],
+    }

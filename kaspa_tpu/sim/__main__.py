@@ -125,7 +125,28 @@ def main() -> None:
         "stock sustain schedule — inject dispatch hangs + a compile stall mid-replay "
         "and gate on bit-identity, requeue accounting, and canary recovery",
     )
+    p.add_argument(
+        "--swarm", type=int, default=None, metavar="N",
+        help="swarm drill: N in-process nodes over the real P2P wire driven by a "
+        "seeded scenario (partition/heal, deep attacker reorg, late-join IBD, "
+        "relay-storm budget); writes SWARM.json and exits non-zero unless all "
+        "nodes converge bit-identically to the fault-free replay (--blocks sets "
+        "the base-chain length, --seed the schedule seed)",
+    )
+    p.add_argument(
+        "--swarm-scenario", default=None, metavar="JSON|@PATH",
+        help="override the stock swarm schedule: inline JSON or @/path/to/scenario.json "
+        "(a list of {'op': mine|txs|partition|heal|converge|join, ...} steps)",
+    )
+    p.add_argument(
+        "--swarm-out", default="SWARM.json", metavar="PATH",
+        help="where --swarm writes its report (default SWARM.json)",
+    )
     args = p.parse_args()
+
+    if args.swarm is not None:
+        _run_swarm(args)
+        return
 
     mesh_size = mesh.configure(args.mesh)
     if args.overload and args.coalesce is None:
@@ -346,6 +367,45 @@ def _run_txflood(cfg, args) -> None:
                 f"recovered={ov['recovered']} ok={ov_ok}"
             )
     if not det["matches_fault_free"] or ing["lost_tickets"] != 0 or not ov_ok:
+        raise SystemExit(2)
+
+
+def _run_swarm(args) -> None:
+    from kaspa_tpu.resilience.swarm import gates, run_swarm
+
+    report = run_swarm(
+        args.swarm,
+        seed=args.seed,
+        scenario=args.swarm_scenario,
+        blocks=args.blocks,
+        bps=args.bps,
+        out=args.swarm_out,
+    )
+    det, fleet = report["deterministic"], report["fleet"]
+    g = gates(report)
+    summary = {
+        "nodes": args.swarm,
+        "blocks": det["blocks"],
+        "converged": g["converged"],
+        "matches_fault_free": g["matches_fault_free"],
+        "lost_tickets": fleet["lost_tickets"],
+        "amplification": fleet["relay"]["amplification"],
+        "amp_ok": g["amp_ok"],
+        "wall_seconds": report["metrics"]["wall_seconds"],
+        "sink": det["fingerprints"]["node0"]["sink"],
+        "utxo_commitment": det["fingerprints"]["node0"]["utxo_commitment"],
+        "swarm_out": args.swarm_out,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"swarm: {args.swarm} nodes, {det['blocks']} blocks mined, "
+            f"converged={g['converged']} matches_fault_free={g['matches_fault_free']} "
+            f"lost={fleet['lost_tickets']} amplification={fleet['relay']['amplification']} "
+            f"in {summary['wall_seconds']}s -> {args.swarm_out}"
+        )
+    if not all(g.values()):
         raise SystemExit(2)
 
 

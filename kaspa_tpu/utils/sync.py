@@ -165,6 +165,7 @@ RANKS: dict[str, int] = {
     # leaves (nothing ranked is ever acquired while holding these)
     "p2p.addressbook": 86,     # p2p/address_manager.py — address-book state
     "p2p.connmgr": 87,         # p2p/address_manager.py — dial bookkeeping
+    "p2p.links": 88,           # resilience/faults.py — LINKS drop ledger (frame send path)
     "breaker.slot": 89,        # resilience/breaker.py — device-breaker slot swap
     "supervisor.install": 90,  # resilience/supervisor.py — install/shutdown slot
     "supervisor.manifest": 91, # resilience/supervisor.py — warm-manifest file io

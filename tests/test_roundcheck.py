@@ -44,6 +44,10 @@ def test_roundcheck_writes_round_evidence(tmp_path):
             "--skip-ingest",
             # and the brownout ramp drill (another 24-block flood replay)
             "--skip-overload",
+            # and the swarm drill (three live nodes over loopback sockets
+            # running a full partition/heal/late-join scenario — minutes
+            # of wall; it gets its own `roundcheck --only swarm` run)
+            "--skip-swarm",
             # and the serving latency observatory (a 50k-virtual-subscriber
             # ramp + overhead A/B, minutes of wall and timing-sensitive —
             # it gets its own `roundcheck --only serving_load` run)

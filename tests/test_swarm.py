@@ -1,0 +1,137 @@
+"""Swarm drills: N live nodes over the real loopback wire (resilience/swarm.py).
+
+One shared 3-node drill (module fixture) backs the partition/heal,
+deep-reorg, late-join and relay-amplification assertions — the fleet is
+the expensive part, the gates are all facts of a single run.  The
+determinism test runs its own tiny 2-node drill twice and compares the
+``deterministic`` report sections byte-for-byte.
+
+Scenarios here deliberately omit the ``txs`` step: the schnorr-verify
+kernel's first dispatch is a one-time JIT compile that would dominate
+the fast lane; tx gossip is covered by the full default scenario under
+``roundcheck --only swarm`` and the committed SWARM.json.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kaspa_tpu.resilience.swarm import (
+    SwarmError,
+    default_scenario,
+    gates,
+    parse_scenario,
+    run_swarm,
+)
+
+_H = 4  # honest-side blocks while partitioned; attacker mines 2h+2
+
+# attacker node0 splits off, mines the heavier chain, wins at heal; the
+# post-heal relay round merges the losing tips into the winner's past so
+# node2's late IBD (antipast flow serves the donor sink's PAST only)
+# sees the whole DAG
+_SCENARIO = [
+    {"op": "mine", "nodes": [0, 1], "blocks": 8},
+    {"op": "partition", "groups": [[0], [1]]},
+    {"op": "mine", "nodes": [1], "blocks": _H},
+    {"op": "mine", "nodes": [0], "blocks": 2 * _H + 2},
+    {"op": "heal"},
+    {"op": "converge"},
+    {"op": "mine", "nodes": [0, 1], "blocks": 4},
+    {"op": "converge"},
+    {"op": "join", "node": 2},
+    {"op": "converge"},
+]
+_TOTAL = 8 + _H + (2 * _H + 2) + 4
+
+
+@pytest.fixture(scope="module")
+def drill() -> dict:
+    return run_swarm(3, seed=11, scenario=_SCENARIO)
+
+
+def test_partition_heal_convergence(drill):
+    assert all(gates(drill).values()), gates(drill)
+    det = drill["deterministic"]
+    assert det["blocks"] == _TOTAL
+    # the partition severed exactly the cross-group ordered pairs
+    part = next(e for e in det["events"] if e["op"] == "partition")
+    assert part["severed"] == 2
+    # every node ends bit-identical to the fault-free in-order replay
+    fps = det["fingerprints"]
+    assert len(fps) == 3
+    assert all(fp == det["fault_free_fingerprints"] for fp in fps.values())
+
+
+def test_deep_reorg_winner_propagates(drill):
+    """The isolated attacker's heavier chain must win fleet-wide at heal:
+    the first post-heal converged sink is the attacker's own tip."""
+    events = drill["deterministic"]["events"]
+    attacker_mine = next(
+        e for e in events if e["op"] == "mine" and e["nodes"] == [0] and len(e["blocks"]) == 2 * _H + 2
+    )
+    heal_at = next(i for i, e in enumerate(events) if e["op"] == "heal")
+    first_converge = next(e for e in events[heal_at:] if e["op"] == "converge")
+    assert first_converge["sink"] == attacker_mine["blocks"][-1]
+
+
+def test_late_join_ibd_at_depth(drill):
+    """node2 joins after the whole drill's DAG exists and IBDs all of it."""
+    events = drill["deterministic"]["events"]
+    join = next(e for e in events if e["op"] == "join")
+    assert join["node"] == 2 and join["depth"] == _TOTAL
+    # the joiner was absent from the startup mesh...
+    start = next(e for e in events if e["op"] == "start")
+    assert start["joined"] == [0, 1]
+    # ...and still ends with the same fingerprints as the donors
+    fps = drill["deterministic"]["fingerprints"]
+    assert fps["node2"] == fps["node0"] == fps["node1"]
+
+
+def test_relay_amplification_within_budget(drill):
+    """One INV burst must not amplify into O(peers) block transfers: the
+    `_block_requested` in-flight ledger keeps fleet-wide MSG_BLOCK receipts
+    under amp_budget x N x blocks."""
+    relay = drill["fleet"]["relay"]
+    assert relay["total_block_rx"] > 0  # the wire really carried blocks
+    assert relay["amp_ok"], relay
+    assert relay["amplification"] <= drill["config"]["amp_budget"]
+    # the late joiner catches up over MSG_IBD_BLOCKS batches, which do not
+    # count against the gossip budget — its MSG_BLOCK receipts stay zero
+    assert relay["block_rx_by_node"]["node2"] == 0
+    assert drill["fleet"]["lost_tickets"] == 0
+
+
+def test_seeded_determinism_two_runs():
+    """Same (n, seed, scenario) -> byte-identical `deterministic` section:
+    event log, block hashes, fingerprints, fault-free comparison."""
+    scenario = [
+        {"op": "mine", "nodes": [0, 1], "blocks": 4},
+        {"op": "partition", "groups": [[0], [1]]},
+        {"op": "mine", "nodes": [0], "blocks": 2},
+        {"op": "mine", "nodes": [1], "blocks": 3},
+        {"op": "heal"},
+        {"op": "converge"},
+    ]
+    a = run_swarm(2, seed=5, scenario=scenario)
+    b = run_swarm(2, seed=5, scenario=scenario)
+    assert json.dumps(a["deterministic"], sort_keys=True) == json.dumps(b["deterministic"], sort_keys=True)
+    assert all(gates(a).values()) and all(gates(b).values())
+    # a different seed shifts the miner identities -> different hashes
+    c = run_swarm(2, seed=6, scenario=scenario)
+    assert json.dumps(c["deterministic"], sort_keys=True) != json.dumps(a["deterministic"], sort_keys=True)
+
+
+def test_scenario_parsing_and_validation():
+    steps = parse_scenario('{"steps": [{"op": "mine", "nodes": [0], "blocks": 1}]}')
+    assert steps == [{"op": "mine", "nodes": [0], "blocks": 1}]
+    assert parse_scenario([{"op": "heal"}]) == [{"op": "heal"}]
+    with pytest.raises(SwarmError):
+        parse_scenario('[{"nodes": [0]}]')  # step without an op
+    with pytest.raises(SwarmError):
+        default_scenario(1)  # a fleet needs two nodes
+    # the stock drill keeps the relay phase between heal and join
+    ops = [s["op"] for s in default_scenario(5, blocks=24)]
+    assert ops.index("heal") < ops.index("mine", ops.index("heal")) < ops.index("join")

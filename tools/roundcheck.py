@@ -18,7 +18,11 @@ identity plus a short tx-flood sustain; clean acceptance >= 0.99 and
 zero lost tickets), and the overload lane (a tx-flood replay with the
 adaptive brownout ramp; the controller must reach SATURATED, shed load
 with zero lost tickets, hold cadence within 1.5x of nominal, and settle
-back to NOMINAL), then writes a single round-evidence JSON (ROUNDCHECK.json)
+back to NOMINAL), and the swarm lane (three real in-process nodes over
+loopback sockets: partition/heal with a deep attacker reorg and a
+late-join IBD, gated on fleet-wide bit-identity, fault-free match, zero
+lost tickets and a relay-amplification budget), then writes a single
+round-evidence JSON (ROUNDCHECK.json)
 summarizing them — the artifact a driver round or a reviewer reads
 instead of eight scrollback logs.
 
@@ -35,13 +39,14 @@ instead of eight scrollback logs.
     python tools/roundcheck.py --skip-overload     # no brownout ramp drill
     python tools/roundcheck.py --skip-lint         # no graftlint static-analysis gate
     python tools/roundcheck.py --skip-serving_load # no 50k-subscriber latency observatory run
+    python tools/roundcheck.py --skip-swarm        # no multi-node partition/heal swarm drill
     python tools/roundcheck.py --out my.json       # custom artifact path
 
 ``--only SECTION`` (repeatable, or comma-separated) runs exactly the
 named sections and ignores the skip flags; section names are the keys in
 ROUNDCHECK.json (tier1, sim, bench_probe, multichip, mesh_smoke,
 dispatch, aggregate, serving, obs, tenbps, chaos, supervision,
-fabric, ingest, overload).  Every section records its own
+fabric, ingest, overload, swarm).  Every section records its own
 ``wall_seconds`` in the artifact.
 
 Exit code 0 iff every section that ran passed.
@@ -201,6 +206,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-fabric", action="store_true", help="skip the two-process verify-fabric drill")
     ap.add_argument("--skip-ingest", action="store_true", help="skip the tx-ingest admission lane")
     ap.add_argument("--skip-overload", action="store_true", help="skip the brownout ramp drill")
+    ap.add_argument("--skip-swarm", action="store_true", help="skip the multi-node swarm partition/heal drill")
     ap.add_argument("--skip-lint", action="store_true", help="skip the graftlint static-analysis gate")
     ap.add_argument("--skip-serving_load", action="store_true",
                     help="skip the 50k-virtual-subscriber serving latency observatory run")
@@ -692,6 +698,36 @@ def main(argv: list[str] | None = None) -> int:
         )
         return sect
 
+    def _sect_swarm() -> dict:
+        # swarm drill (ISSUE 19): three real in-process nodes over loopback
+        # sockets run the seeded default scenario — partition into
+        # {attacker} x {honest}, divergent mining on both sides, heal with
+        # a deep attacker reorg, post-heal relay round, then a late joiner
+        # IBDs the whole DAG.  Gated on every node converging bit-identical
+        # (sink + utxo commitment), the run matching the fault-free replay,
+        # zero lost admission tickets fleet-wide, and block-relay traffic
+        # staying under the O(N * blocks) amplification budget.
+        sect = _run(
+            [
+                sys.executable, "-m", "kaspa_tpu.sim",
+                "--swarm", "3", "--blocks", "24", "--seed", "7", "--json",
+                "--swarm-out", os.path.join(REPO_ROOT, "SWARM.json"),
+            ],
+            900.0,
+            {"JAX_PLATFORMS": "cpu"},
+        )
+        result = _last_json_line(sect)
+        sect["result"] = result
+        sect["ok"] = (
+            sect["rc"] == 0
+            and bool(result)
+            and bool(result.get("converged"))
+            and bool(result.get("matches_fault_free"))
+            and result.get("lost_tickets", 1) == 0
+            and bool(result.get("amp_ok"))
+        )
+        return sect
+
     sections: list[tuple[str, bool, object]] = [
         ("lint", not args.skip_lint, _sect_lint),
         ("tier1", not args.skip_tests, _sect_tier1),
@@ -710,6 +746,7 @@ def main(argv: list[str] | None = None) -> int:
         ("fabric", not args.skip_fabric, _sect_fabric),
         ("ingest", not args.skip_ingest, _sect_ingest),
         ("overload", not args.skip_overload, _sect_overload),
+        ("swarm", not args.skip_swarm, _sect_swarm),
     ]
     only: set[str] | None = None
     if args.only:
